@@ -1,0 +1,419 @@
+"""Workload → pod expansion and pod sanitization (controller emulation).
+
+Reference parity: ``pkg/utils/utils.go`` —
+``MakeValidPodsByDeployment``/``ByReplicaSet`` (:132-171),
+``MakeValidPodByCronJob``/``ByJob`` (:173-217), ``MakeValidPodsByStatefulSet``
+(:219-292), ``MakeValidPodsByDaemonset`` (:325-351 via daemon predicates),
+``MakeValidPod`` sanitization (:378-463), ``NewFakeNodes`` (:885-901), and
+``GenerateValidPodsFromAppResources`` (``pkg/simulator/utils.go:37``).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import yaml
+
+from . import selectors
+from .quantity import parse_quantity
+from .objects import (
+    ANNO_NODE_LOCAL_STORAGE,
+    ANNO_POD_LOCAL_STORAGE,
+    ANNO_WORKLOAD_KIND,
+    ANNO_WORKLOAD_NAME,
+    ANNO_WORKLOAD_NAMESPACE,
+    DEFAULT_SCHEDULER_NAME,
+    LABEL_HOSTNAME,
+    LABEL_NEW_NODE,
+    Node,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    ResourceTypes,
+    Workload,
+    _rand_suffix,
+    new_uid,
+    object_from_dict,
+)
+
+# Storage-class names recognized for local storage — pkg/utils/const.go:4-16.
+SC_LVM = {"open-local-lvm", "yoda-lvm-default"}
+SC_DEVICE_SSD = {"open-local-device-ssd", "open-local-mountpoint-ssd", "yoda-mountpoint-ssd", "yoda-device-ssd"}
+SC_DEVICE_HDD = {"open-local-device-hdd", "open-local-mountpoint-hdd", "yoda-mountpoint-hdd", "yoda-device-hdd"}
+LOCAL_SC_NAMES = SC_LVM | SC_DEVICE_SSD | SC_DEVICE_HDD
+
+
+class InvalidPodError(ValueError):
+    pass
+
+
+def make_valid_pod(pod: Pod) -> Pod:
+    """Sanitize a pod the way ``MakeValidPod`` (pkg/utils/utils.go:378-463)
+    does: default namespace / DNS policy / restart policy / scheduler name,
+    strip env/mounts/probes, PVC volumes → hostPath, reset status; then run
+    basic validation."""
+    p = copy.deepcopy(pod)
+    if p.metadata.namespace == "":
+        p.metadata.namespace = "default"
+        if p.raw:
+            p.raw.setdefault("metadata", {})["namespace"] = "default"
+    if p.metadata.labels is None:
+        p.metadata.labels = {}
+    if p.metadata.annotations is None:
+        p.metadata.annotations = {}
+    if p.spec.scheduler_name == "":
+        p.spec.scheduler_name = DEFAULT_SCHEDULER_NAME
+    # Raw-dict sanitization for round-tripping.
+    if p.raw:
+        raw = copy.deepcopy(p.raw)
+        spec = raw.setdefault("spec", {})
+        spec.setdefault("dnsPolicy", "ClusterFirst")
+        spec.setdefault("restartPolicy", "Always")
+        spec.setdefault("schedulerName", DEFAULT_SCHEDULER_NAME)
+        spec.pop("imagePullSecrets", None)
+        for clist in ("containers", "initContainers"):
+            for c in spec.get(clist) or []:
+                c.setdefault("terminationMessagePolicy", "FallbackToLogsOnError")
+                c.setdefault("imagePullPolicy", "IfNotPresent")
+                if (c.get("securityContext") or {}).get("privileged") is not None:
+                    c["securityContext"]["privileged"] = False
+                c.pop("volumeMounts", None)
+                c.pop("env", None)
+                c.pop("livenessProbe", None)
+                c.pop("readinessProbe", None)
+                c.pop("startupProbe", None)
+        for v in spec.get("volumes") or []:
+            if "persistentVolumeClaim" in v:
+                v["hostPath"] = {"path": "/tmp"}
+                v.pop("persistentVolumeClaim", None)
+        raw["status"] = {}
+        p.raw = raw
+        # PVC volumes were rewritten; keep the parsed view in sync.
+        p.spec.volumes = copy.deepcopy(spec.get("volumes") or [])
+    _validate_pod(p)
+    return p
+
+
+def _validate_pod(pod: Pod) -> None:
+    """Small subset of ValidatePodCreate (pkg/utils/utils.go:495-508): the
+    checks that can actually fire on simulator inputs."""
+    if not pod.metadata.name and not pod.metadata.generate_name:
+        raise InvalidPodError("pod has no name")
+    if not pod.spec.containers:
+        raise InvalidPodError(f"pod {pod.metadata.name} has no containers")
+    for t in pod.spec.tolerations:
+        if t.operator == "Exists" and t.value:
+            raise InvalidPodError(
+                f"pod {pod.metadata.name}: toleration value must be empty when operator is Exists"
+            )
+    for res, v in pod.resource_requests().items():
+        if v < 0:
+            raise InvalidPodError(f"pod {pod.metadata.name}: negative request {res}")
+
+
+def _pod_from_template(owner: Workload, controller_kind: str) -> Pod:
+    """Build a pod from a workload's template with owner metadata — parity
+    with SetObjectMetaFromObject (pkg/utils/utils.go:297-323)."""
+    if not owner.metadata.uid:
+        owner.metadata.uid = new_uid()
+    raw = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {},
+        "spec": copy.deepcopy(owner.template_raw.get("spec") or {}),
+    }
+    pod = Pod.from_dict(raw)
+    pod.spec = copy.deepcopy(owner.template_spec)
+    pod.metadata = ObjectMeta(
+        name=f"{owner.metadata.name}-{_rand_suffix()}",
+        namespace=owner.metadata.namespace,
+        labels=dict(owner.template_metadata.labels),
+        annotations=dict(owner.template_metadata.annotations),
+        uid=new_uid(),
+        generate_name=owner.metadata.name,
+        owner_references=[
+            OwnerReference(
+                kind=controller_kind,
+                name=owner.metadata.name,
+                uid=owner.metadata.uid,
+                api_version="apps/v1" if controller_kind in ("ReplicaSet", "StatefulSet", "DaemonSet") else "batch/v1",
+            )
+        ],
+    )
+    raw["metadata"] = pod.metadata.to_dict()
+    pod.raw = raw
+    return pod
+
+
+def _add_workload_info(pod: Pod, kind: str, name: str, namespace: str) -> Pod:
+    pod.metadata.annotations[ANNO_WORKLOAD_KIND] = kind
+    pod.metadata.annotations[ANNO_WORKLOAD_NAME] = name
+    pod.metadata.annotations[ANNO_WORKLOAD_NAMESPACE] = namespace
+    return pod
+
+
+def pods_from_replica_set(rs: Workload) -> List[Pod]:
+    pods = []
+    for _ in range(max(rs.replicas, 0)):
+        pod = make_valid_pod(_pod_from_template(rs, "ReplicaSet"))
+        pods.append(_add_workload_info(pod, "ReplicaSet", rs.metadata.name, rs.metadata.namespace))
+    return pods
+
+
+def pods_from_deployment(deploy: Workload) -> List[Pod]:
+    """Deployment → generated ReplicaSet → pods. The generated RS keeps the
+    deployment's name (reference: generateReplicaSetFromDeployment names the
+    RS via SetObjectMetaFromObject → '<deploy>-<rand>')."""
+    rs = Workload(
+        kind="ReplicaSet",
+        metadata=ObjectMeta(
+            name=f"{deploy.metadata.name}-{_rand_suffix()}",
+            namespace=deploy.metadata.namespace,
+            labels=dict(deploy.template_metadata.labels),
+            annotations=dict(deploy.template_metadata.annotations),
+            uid=new_uid(),
+            generate_name=deploy.metadata.name,
+            owner_references=[
+                OwnerReference(kind="Deployment", name=deploy.metadata.name, uid=deploy.metadata.uid or new_uid(), api_version="apps/v1")
+            ],
+        ),
+        replicas=deploy.replicas,
+        selector=deploy.selector,
+        template_metadata=deploy.template_metadata,
+        template_spec=deploy.template_spec,
+        template_raw=deploy.template_raw,
+    )
+    return pods_from_replica_set(rs)
+
+
+def pods_from_job(job: Workload) -> List[Pod]:
+    pods = []
+    for _ in range(max(job.replicas, 0)):
+        pod = make_valid_pod(_pod_from_template(job, "Job"))
+        pods.append(_add_workload_info(pod, "Job", job.metadata.name, job.metadata.namespace))
+    return pods
+
+
+def pods_from_cron_job(cj: Workload) -> List[Pod]:
+    """CronJob → one manual Job instantiation → pods (reference:
+    generateJobFromCronJob, pkg/utils/utils.go:204-217)."""
+    job = Workload(
+        kind="Job",
+        metadata=ObjectMeta(
+            name=f"{cj.metadata.name}-{_rand_suffix()}",
+            namespace=cj.metadata.namespace,
+            annotations={"cronjob.kubernetes.io/instantiate": "manual", **cj.template_metadata.annotations},
+            labels=dict(cj.template_metadata.labels),
+            uid=new_uid(),
+            generate_name=cj.metadata.name,
+        ),
+        replicas=cj.replicas,
+        template_metadata=cj.template_metadata,
+        template_spec=cj.template_spec,
+        template_raw=cj.template_raw,
+    )
+    return pods_from_job(job)
+
+
+def pods_from_stateful_set(sts: Workload) -> List[Pod]:
+    """StatefulSet → ordinal-named pods + local-storage volume annotation
+    (pkg/utils/utils.go:219-292)."""
+    pods = []
+    for ordinal in range(max(sts.replicas, 0)):
+        pod = _pod_from_template(sts, "StatefulSet")
+        pod.metadata.name = f"{sts.metadata.name}-{ordinal}"
+        if pod.raw:
+            pod.raw["metadata"]["name"] = pod.metadata.name
+        pod = make_valid_pod(pod)
+        pod = _add_workload_info(pod, "StatefulSet", sts.metadata.name, sts.metadata.namespace)
+        pods.append(pod)
+    _set_storage_annotation(pods, sts.volume_claim_templates)
+    return pods
+
+
+def _set_storage_annotation(pods: List[Pod], volume_claim_templates: List[dict]) -> None:
+    """simon/pod-local-storage annotation from volumeClaimTemplates —
+    SetStorageAnnotationOnPods (pkg/utils/utils.go:247-292)."""
+    volumes = []
+    for pvc in volume_claim_templates:
+        sc = (pvc.get("spec") or {}).get("storageClassName")
+        if sc is None:
+            continue
+        size = (((pvc.get("spec") or {}).get("resources") or {}).get("requests") or {}).get("storage", 0)
+        size_b = int(parse_quantity(size))
+        if sc in SC_LVM:
+            kind = "LVM"
+        elif sc in SC_DEVICE_SSD:
+            kind = "SSD"
+        elif sc in SC_DEVICE_HDD:
+            kind = "HDD"
+        else:
+            continue  # unsupported storage class (reference logs an error)
+        volumes.append({"size": str(size_b), "kind": kind, "scName": sc})
+    if not volumes:
+        return
+    payload = json.dumps({"volumes": volumes})
+    for pod in pods:
+        pod.metadata.annotations[ANNO_POD_LOCAL_STORAGE] = payload
+
+
+def _daemon_pod_for_node(ds: Workload, node_name: str) -> Pod:
+    """DaemonSet pod pinned to a node via required node affinity on
+    metadata.name — SetDaemonSetPodNodeNameByNodeAffinity semantics."""
+    pod = _pod_from_template(ds, "DaemonSet")
+    aff = copy.deepcopy(pod.spec.affinity) or {}
+    node_aff = aff.setdefault("nodeAffinity", {})
+    required = node_aff.setdefault("requiredDuringSchedulingIgnoredDuringExecution", {})
+    pin_field = {"key": "metadata.name", "operator": "In", "values": [node_name]}
+    terms = required.get("nodeSelectorTerms") or []
+    if terms:
+        for t in terms:
+            t.setdefault("matchFields", []).append(copy.deepcopy(pin_field))
+    else:
+        terms = [{"matchFields": [pin_field]}]
+    required["nodeSelectorTerms"] = terms
+    pod.spec.affinity = aff
+    if pod.raw is not None:
+        pod.raw.setdefault("spec", {})["affinity"] = copy.deepcopy(aff)
+    return pod
+
+
+def pods_from_daemon_set(ds: Workload, nodes: List[Node]) -> List[Pod]:
+    """One pod per eligible node (MakeValidPodsByDaemonset,
+    pkg/utils/utils.go:337-351)."""
+    pods = []
+    for node in nodes:
+        pod = _daemon_pod_for_node(ds, node.metadata.name)
+        if not selectors.node_should_run_pod(node, pod):
+            continue
+        pod = make_valid_pod(pod)
+        pods.append(_add_workload_info(pod, "DaemonSet", ds.metadata.name, ds.metadata.namespace))
+    return pods
+
+
+def generate_pods_from_resources(
+    resources: ResourceTypes, nodes: Optional[List[Node]] = None, include_daemon_sets: bool = True
+) -> List[Pod]:
+    """Expand every workload in a ResourceTypes into schedulable pods —
+    GenerateValidPodsFromAppResources / GetValidPodExcludeDaemonSet
+    (pkg/simulator/utils.go:37-230). Bare pods are sanitized too. DaemonSet
+    pods are expanded per eligible node when `nodes` is given."""
+    pods: List[Pod] = []
+    for p in resources.pods:
+        pods.append(make_valid_pod(p))
+    for d in resources.deployments:
+        pods.extend(pods_from_deployment(d))
+    deploy_keys = {(d.metadata.namespace, d.metadata.name) for d in resources.deployments}
+    for rs in resources.replica_sets:
+        # Skip replica sets whose owning deployment is in the input (the
+        # deployment expands them); orphan RS snapshots still expand.
+        if any(
+            r.kind == "Deployment" and (rs.metadata.namespace, r.name) in deploy_keys
+            for r in rs.metadata.owner_references
+        ):
+            continue
+        pods.extend(pods_from_replica_set(rs))
+    for sts in resources.stateful_sets:
+        pods.extend(pods_from_stateful_set(sts))
+    for job in resources.jobs:
+        if any(r.kind == "CronJob" for r in job.metadata.owner_references):
+            continue
+        pods.extend(pods_from_job(job))
+    for cj in resources.cron_jobs:
+        pods.extend(pods_from_cron_job(cj))
+    if include_daemon_sets:
+        for ds in resources.daemon_sets:
+            pods.extend(pods_from_daemon_set(ds, nodes if nodes is not None else resources.nodes))
+    return pods
+
+
+# ---------------------------------------------------------------------------
+# YAML ingestion.
+# ---------------------------------------------------------------------------
+
+def yaml_files_in_dir(path: str) -> List[str]:
+    """File paths under a dir (or the file itself), sorted — ParseFilePath
+    (pkg/utils/utils.go:43-79)."""
+    if os.path.isfile(path):
+        return [path]
+    out = []
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            out.append(os.path.join(root, f))
+    return sorted(out)
+
+
+def load_yaml_objects(path: str) -> List[dict]:
+    """All YAML documents in a file or directory (ignores non-YAML)."""
+    docs: List[dict] = []
+    for fp in yaml_files_in_dir(path):
+        if not fp.endswith((".yaml", ".yml")):
+            continue
+        with open(fp) as f:
+            for doc in yaml.safe_load_all(f):
+                if isinstance(doc, dict):
+                    docs.append(doc)
+    return docs
+
+
+def decode_yaml_strings(contents: List[str]) -> List[dict]:
+    docs: List[dict] = []
+    for s in contents:
+        for doc in yaml.safe_load_all(s):
+            if isinstance(doc, dict):
+                docs.append(doc)
+    return docs
+
+
+def resources_from_dicts(docs: List[dict]) -> Tuple[ResourceTypes, List[str]]:
+    """Typed decode of YAML docs into ResourceTypes; returns the list of
+    skipped kinds (reference errors on unsupported kinds; we record them)."""
+    rt = ResourceTypes()
+    skipped = []
+    for d in docs:
+        obj = object_from_dict(d)
+        if obj is None or not rt.add(obj):
+            skipped.append(str(d.get("kind", "?")))
+    return rt, skipped
+
+
+def load_cluster_from_dir(path: str) -> ResourceTypes:
+    """CreateClusterResourceFromClusterConfig (pkg/simulator/simulator.go:604-619):
+    read a cluster yaml dir, and attach node-local-storage JSON annotations from
+    sibling .json files named after nodes (MatchAndSetLocalStorageAnnotationOnNode,
+    pkg/simulator/utils.go:385-401)."""
+    rt, _ = resources_from_dicts(load_yaml_objects(path))
+    storage_info: Dict[str, str] = {}
+    for fp in yaml_files_in_dir(path):
+        if fp.endswith(".json"):
+            name = os.path.splitext(os.path.basename(fp))[0]
+            with open(fp) as f:
+                storage_info[name] = f.read()
+    for node in rt.nodes:
+        if node.metadata.name in storage_info:
+            node.metadata.annotations[ANNO_NODE_LOCAL_STORAGE] = storage_info[node.metadata.name]
+    return rt
+
+
+# ---------------------------------------------------------------------------
+# Fake node fabrication — NewFakeNodes (pkg/utils/utils.go:885-901).
+# ---------------------------------------------------------------------------
+
+def new_fake_nodes(template: Node, count: int) -> List[Node]:
+    nodes = []
+    for _ in range(count):
+        node = copy.deepcopy(template)
+        name = f"simon-{_rand_suffix(8)}"
+        node.metadata.name = name
+        node.metadata.uid = new_uid()
+        node.metadata.labels = dict(node.metadata.labels)
+        node.metadata.labels[LABEL_HOSTNAME] = name
+        node.metadata.labels[LABEL_NEW_NODE] = ""
+        if node.raw:
+            node.raw.setdefault("metadata", {})["name"] = name
+            node.raw["metadata"].setdefault("labels", {}).update(node.metadata.labels)
+        nodes.append(node)
+    return nodes

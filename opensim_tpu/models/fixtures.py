@@ -1,0 +1,255 @@
+"""Test fixture builders — parity with ``pkg/test`` (MakeFakePod/Node/... with
+functional ``With*`` options, e.g. ``pkg/test/node.go:15-40``,
+``pkg/test/pod.go:13-47``)."""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Optional
+
+from .objects import (
+    ANNO_NODE_LOCAL_STORAGE,
+    ANNO_POD_LOCAL_STORAGE,
+    Node,
+    Pod,
+    Workload,
+    object_from_dict,
+)
+
+Option = Callable[[dict], None]
+
+
+# -- pod/template options ----------------------------------------------------
+
+def with_labels(labels: Dict[str, str]) -> Option:
+    def apply(d: dict) -> None:
+        d.setdefault("metadata", {}).setdefault("labels", {}).update(labels)
+
+    return apply
+
+
+def with_annotations(annotations: Dict[str, str]) -> Option:
+    def apply(d: dict) -> None:
+        d.setdefault("metadata", {}).setdefault("annotations", {}).update(annotations)
+
+    return apply
+
+
+def with_namespace(ns: str) -> Option:
+    def apply(d: dict) -> None:
+        d.setdefault("metadata", {})["namespace"] = ns
+
+    return apply
+
+
+def _pod_template(d: dict) -> dict:
+    if d.get("kind") == "CronJob":
+        return d["spec"]["jobTemplate"]["spec"].setdefault("template", {})
+    return d["spec"].setdefault("template", {})
+
+
+def _pod_spec(d: dict) -> dict:
+    # For workloads, options target the pod template.
+    if d.get("kind") in ("Deployment", "ReplicaSet", "StatefulSet", "DaemonSet", "Job", "CronJob"):
+        return _pod_template(d).setdefault("spec", {})
+    return d.setdefault("spec", {})
+
+
+def _pod_meta(d: dict) -> dict:
+    if d.get("kind") in ("Deployment", "ReplicaSet", "StatefulSet", "DaemonSet", "Job", "CronJob"):
+        return _pod_template(d).setdefault("metadata", {})
+    return d.setdefault("metadata", {})
+
+
+def with_pod_labels(labels: Dict[str, str]) -> Option:
+    def apply(d: dict) -> None:
+        _pod_meta(d).setdefault("labels", {}).update(labels)
+
+    return apply
+
+
+def with_node_name(name: str) -> Option:
+    def apply(d: dict) -> None:
+        _pod_spec(d)["nodeName"] = name
+
+    return apply
+
+
+def with_node_selector(sel: Dict[str, str]) -> Option:
+    def apply(d: dict) -> None:
+        _pod_spec(d).setdefault("nodeSelector", {}).update(sel)
+
+    return apply
+
+
+def with_tolerations(tolerations: List[dict]) -> Option:
+    def apply(d: dict) -> None:
+        _pod_spec(d).setdefault("tolerations", []).extend(tolerations)
+
+    return apply
+
+
+def with_affinity(affinity: dict) -> Option:
+    def apply(d: dict) -> None:
+        _pod_spec(d)["affinity"] = affinity
+
+    return apply
+
+
+def with_requests(requests: Dict[str, str]) -> Option:
+    def apply(d: dict) -> None:
+        spec = _pod_spec(d)
+        for c in spec.setdefault("containers", []):
+            c.setdefault("resources", {}).setdefault("requests", {}).update(requests)
+
+    return apply
+
+
+def with_host_ports(ports: List[int]) -> Option:
+    def apply(d: dict) -> None:
+        spec = _pod_spec(d)
+        for c in spec.setdefault("containers", []):
+            c.setdefault("ports", []).extend(
+                {"hostPort": p, "containerPort": p, "protocol": "TCP"} for p in ports
+            )
+
+    return apply
+
+
+def with_topology_spread(constraints: List[dict]) -> Option:
+    def apply(d: dict) -> None:
+        _pod_spec(d)["topologySpreadConstraints"] = constraints
+
+    return apply
+
+
+def with_pod_local_storage(volumes_json: str) -> Option:
+    return with_annotations({ANNO_POD_LOCAL_STORAGE: volumes_json})
+
+
+# -- node options ------------------------------------------------------------
+
+def with_taints(taints: List[dict]) -> Option:
+    def apply(d: dict) -> None:
+        d.setdefault("spec", {}).setdefault("taints", []).extend(taints)
+
+    return apply
+
+
+def with_node_local_storage(vgs: Optional[List[dict]] = None, devices: Optional[List[dict]] = None) -> Option:
+    """WithNodeLocalStorage (pkg/test/node.go:64-69): the
+    simon/node-local-storage annotation JSON."""
+    payload = json.dumps({"vgs": vgs or [], "devices": devices or []})
+    return with_annotations({ANNO_NODE_LOCAL_STORAGE: payload})
+
+
+def with_allocatable(alloc: Dict[str, str]) -> Option:
+    def apply(d: dict) -> None:
+        d.setdefault("status", {}).setdefault("allocatable", {}).update(alloc)
+        d.setdefault("status", {}).setdefault("capacity", {}).update(alloc)
+
+    return apply
+
+
+# -- builders ----------------------------------------------------------------
+
+def make_fake_pod(name: str, cpu: str = "100m", memory: str = "128Mi", *options: Option) -> Pod:
+    """MakeFakePod (pkg/test/pod.go:13-47): defaults an nginx container."""
+    d = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "containers": [
+                {
+                    "name": "nginx",
+                    "image": "nginx:latest",
+                    "resources": {"requests": {"cpu": cpu, "memory": memory}},
+                }
+            ]
+        },
+    }
+    for opt in options:
+        opt(d)
+    return Pod.from_dict(d)
+
+
+def make_fake_node(name: str, cpu: str = "32", memory: str = "64Gi", pods: str = "110", *options: Option) -> Node:
+    """MakeFakeNode (pkg/test/node.go:15-40): default 110-pod capacity."""
+    d = {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": {"kubernetes.io/hostname": name}},
+        "status": {
+            "allocatable": {"cpu": cpu, "memory": memory, "pods": pods},
+            "capacity": {"cpu": cpu, "memory": memory, "pods": pods},
+        },
+    }
+    for opt in options:
+        opt(d)
+    return Node.from_dict(d)
+
+
+def _make_workload(kind: str, name: str, replicas: int, cpu: str, memory: str, *options: Option) -> Workload:
+    labels = {"app": name}
+    d = {
+        "apiVersion": "apps/v1" if kind in ("Deployment", "ReplicaSet", "StatefulSet", "DaemonSet") else "batch/v1",
+        "kind": kind,
+        "metadata": {"name": name, "namespace": "default", "labels": dict(labels)},
+        "spec": {
+            "selector": {"matchLabels": dict(labels)},
+            "template": {
+                "metadata": {"labels": dict(labels)},
+                "spec": {
+                    "containers": [
+                        {
+                            "name": "nginx",
+                            "image": "nginx:latest",
+                            "resources": {"requests": {"cpu": cpu, "memory": memory}},
+                        }
+                    ]
+                },
+            },
+        },
+    }
+    if kind in ("Deployment", "ReplicaSet", "StatefulSet"):
+        d["spec"]["replicas"] = replicas
+    elif kind == "Job":
+        d["spec"]["completions"] = replicas
+        d["spec"].pop("selector")
+    for opt in options:
+        opt(d)
+    return Workload.from_dict(d)
+
+
+def make_fake_deployment(name: str, replicas: int = 1, cpu: str = "100m", memory: str = "128Mi", *options: Option) -> Workload:
+    return _make_workload("Deployment", name, replicas, cpu, memory, *options)
+
+
+def make_fake_replica_set(name: str, replicas: int = 1, cpu: str = "100m", memory: str = "128Mi", *options: Option) -> Workload:
+    return _make_workload("ReplicaSet", name, replicas, cpu, memory, *options)
+
+
+def make_fake_stateful_set(name: str, replicas: int = 1, cpu: str = "100m", memory: str = "128Mi", *options: Option) -> Workload:
+    return _make_workload("StatefulSet", name, replicas, cpu, memory, *options)
+
+
+def make_fake_daemon_set(name: str, cpu: str = "100m", memory: str = "128Mi", *options: Option) -> Workload:
+    return _make_workload("DaemonSet", name, 1, cpu, memory, *options)
+
+
+def make_fake_job(name: str, completions: int = 1, cpu: str = "100m", memory: str = "128Mi", *options: Option) -> Workload:
+    return _make_workload("Job", name, completions, cpu, memory, *options)
+
+
+def make_fake_cron_job(name: str, completions: int = 1, cpu: str = "100m", memory: str = "128Mi", *options: Option) -> Workload:
+    job = _make_workload("Job", name, completions, cpu, memory)
+    d = {
+        "apiVersion": "batch/v1beta1",
+        "kind": "CronJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"schedule": "* * * * *", "jobTemplate": {"spec": job.raw["spec"]}},
+    }
+    for opt in options:
+        opt(d)
+    return Workload.from_dict(d)

@@ -1,0 +1,178 @@
+"""Unit tests for the host object model — the unit layer the reference lacks
+(SURVEY.md §4: only one integration test exists upstream)."""
+
+import json
+
+import pytest
+
+from opensim_tpu.models import (
+    ANNO_POD_LOCAL_STORAGE,
+    ANNO_WORKLOAD_KIND,
+    Node,
+    Pod,
+    parse_quantity,
+    parse_quantity_milli,
+)
+from opensim_tpu.models import expand, fixtures, selectors
+
+
+def test_parse_quantity():
+    assert parse_quantity("1500m") == 1.5
+    assert parse_quantity_milli("1500m") == 1500
+    assert parse_quantity("2") == 2.0
+    assert parse_quantity("1Gi") == 1024**3
+    assert parse_quantity("61255492Ki") == 61255492 * 1024
+    assert parse_quantity("1k") == 1000
+    assert parse_quantity("0") == 0
+    assert parse_quantity(None) == 0
+    assert parse_quantity("1e3") == 1000
+    with pytest.raises(ValueError):
+        parse_quantity("banana")
+
+
+def test_pod_requests_max_of_init_containers():
+    pod = Pod.from_dict(
+        {
+            "kind": "Pod",
+            "metadata": {"name": "p"},
+            "spec": {
+                "containers": [
+                    {"name": "a", "resources": {"requests": {"cpu": "100m", "memory": "1Gi"}}},
+                    {"name": "b", "resources": {"requests": {"cpu": "200m"}}},
+                ],
+                "initContainers": [
+                    {"name": "init", "resources": {"requests": {"cpu": "1", "memory": "512Mi"}}}
+                ],
+            },
+        }
+    )
+    req = pod.resource_requests()
+    assert req["cpu"] == 1.0  # init container dominates 0.3
+    assert req["memory"] == 1024**3
+
+
+def test_deployment_expansion_names_and_owners():
+    deploy = fixtures.make_fake_deployment("web", replicas=3)
+    pods = expand.pods_from_deployment(deploy)
+    assert len(pods) == 3
+    for p in pods:
+        assert p.metadata.name.startswith("web-")
+        assert p.metadata.owner_references[0].kind == "ReplicaSet"
+        assert p.metadata.annotations[ANNO_WORKLOAD_KIND] == "ReplicaSet"
+        assert p.spec.scheduler_name == "simon-scheduler"
+    # All pods share one generated ReplicaSet owner.
+    assert len({p.metadata.owner_references[0].name for p in pods}) == 1
+
+
+def test_statefulset_ordinal_names_and_storage_annotation():
+    sts = fixtures.make_fake_stateful_set("db", replicas=2)
+    sts.volume_claim_templates = [
+        {
+            "metadata": {"name": "data"},
+            "spec": {
+                "storageClassName": "open-local-lvm",
+                "resources": {"requests": {"storage": "10Gi"}},
+            },
+        }
+    ]
+    pods = expand.pods_from_stateful_set(sts)
+    assert [p.metadata.name for p in pods] == ["db-0", "db-1"]
+    vols = json.loads(pods[0].metadata.annotations[ANNO_POD_LOCAL_STORAGE])
+    assert vols["volumes"][0]["kind"] == "LVM"
+    assert vols["volumes"][0]["size"] == str(10 * 1024**3)
+
+
+def test_daemonset_expansion_respects_taints_and_selector():
+    ds = fixtures.make_fake_daemon_set("agent")
+    tainted = fixtures.make_fake_node(
+        "tainted", "4", "8Gi", "110", fixtures.with_taints([{"key": "dedicated", "value": "x", "effect": "NoSchedule"}])
+    )
+    normal = fixtures.make_fake_node("normal")
+    pods = expand.pods_from_daemon_set(ds, [tainted, normal])
+    assert len(pods) == 1
+    # the daemon pod is pinned by matchFields node affinity, not nodeName
+    aff = pods[0].spec.affinity["nodeAffinity"]["requiredDuringSchedulingIgnoredDuringExecution"]
+    assert aff["nodeSelectorTerms"][0]["matchFields"][0]["values"] == ["normal"]
+
+    tolerant = fixtures.make_fake_daemon_set(
+        "agent2", "100m", "128Mi", fixtures.with_tolerations([{"operator": "Exists"}])
+    )
+    pods = expand.pods_from_daemon_set(tolerant, [tainted, normal])
+    assert len(pods) == 2
+
+
+def test_cronjob_expansion():
+    cj = fixtures.make_fake_cron_job("tick", completions=2)
+    pods = expand.pods_from_cron_job(cj)
+    assert len(pods) == 2
+    assert pods[0].metadata.annotations[ANNO_WORKLOAD_KIND] == "Job"
+
+
+def test_make_valid_pod_sanitization():
+    pod = Pod.from_dict(
+        {
+            "kind": "Pod",
+            "metadata": {"name": "p"},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "c",
+                        "env": [{"name": "A", "value": "B"}],
+                        "volumeMounts": [{"name": "v", "mountPath": "/x"}],
+                        "livenessProbe": {"exec": {"command": ["true"]}},
+                    }
+                ],
+                "volumes": [{"name": "v", "persistentVolumeClaim": {"claimName": "c"}}],
+            },
+        }
+    )
+    valid = expand.make_valid_pod(pod)
+    assert valid.metadata.namespace == "default"
+    c = valid.raw["spec"]["containers"][0]
+    assert "env" not in c and "volumeMounts" not in c and "livenessProbe" not in c
+    assert valid.raw["spec"]["volumes"][0]["hostPath"]["path"] == "/tmp"
+    assert "persistentVolumeClaim" not in valid.raw["spec"]["volumes"][0]
+
+
+def test_selector_matching():
+    node = fixtures.make_fake_node("n1", "4", "8Gi", "110", fixtures.with_labels({"disk": "ssd", "zone": "a"}))
+    assert selectors.match_label_selector({"matchLabels": {"disk": "ssd"}}, node.metadata.labels)
+    assert not selectors.match_label_selector(None, node.metadata.labels)
+    assert selectors.match_label_selector({}, node.metadata.labels)  # empty matches all
+    assert selectors.match_label_selector(
+        {"matchExpressions": [{"key": "disk", "operator": "In", "values": ["ssd", "hdd"]}]},
+        node.metadata.labels,
+    )
+    assert selectors.match_label_selector(
+        {"matchExpressions": [{"key": "gpu", "operator": "DoesNotExist"}]}, node.metadata.labels
+    )
+    term = {"matchExpressions": [{"key": "zone", "operator": "NotIn", "values": ["b"]}]}
+    assert selectors.match_node_selector_term(term, node)
+    assert not selectors.match_node_selector_term({}, node)  # empty term matches nothing
+
+
+def test_taint_toleration():
+    from opensim_tpu.models import Taint, Toleration
+
+    taint = Taint(key="k", value="v", effect="NoSchedule")
+    assert selectors.toleration_tolerates_taint(Toleration(key="k", operator="Exists"), taint)
+    assert selectors.toleration_tolerates_taint(Toleration(key="k", operator="Equal", value="v"), taint)
+    assert not selectors.toleration_tolerates_taint(Toleration(key="k", operator="Equal", value="w"), taint)
+    assert selectors.toleration_tolerates_taint(Toleration(operator="Exists"), taint)
+    assert not selectors.toleration_tolerates_taint(
+        Toleration(key="k", operator="Exists", effect="NoExecute"), taint
+    )
+    assert selectors.find_untolerated_taint([taint], []) is taint
+    assert selectors.find_untolerated_taint([taint], [Toleration(operator="Exists")]) is None
+
+
+def test_load_reference_examples():
+    rt = expand.load_cluster_from_dir("/root/reference/example/cluster/demo_1")
+    assert len(rt.nodes) == 4
+    assert any("simon/node-local-storage" in n.metadata.annotations for n in rt.nodes)
+    app, skipped = expand.resources_from_dicts(
+        expand.load_yaml_objects("/root/reference/example/application/simple")
+    )
+    pods = expand.generate_pods_from_resources(app, rt.nodes)
+    # 1 bare pod + 4 deployment + 2 replicaset + 2 job + 5 sts + 3 daemonset (all nodes tolerated)
+    assert len(pods) == 17

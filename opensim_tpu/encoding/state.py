@@ -1,0 +1,584 @@
+"""ClusterState tensor assembly.
+
+Builds the device-resident encoded cluster: node resource/label/taint
+tensors, per-template scheduling encodings, global inter-pod-affinity term
+tables, and the initial scan carry. This is the TPU-native replacement for
+the reference's scheduler cache + snapshot
+(``vendor/k8s.io/kubernetes/pkg/scheduler/internal/cache``): instead of an
+object graph snapshotted per cycle, the cluster IS a set of HBM tensors and
+the "snapshot" is the ``lax.scan`` carry.
+
+Shape conventions (all static, padded):
+  N  nodes (padded, ``node_valid`` masks)     R  resource axis
+  K  label keys        Tt taints/node         Tl tolerations/template
+  U  templates         T/Q/V node-affinity terms/reqs/values per template
+  A  selectors         G  global anti-affinity terms
+  Gp global preferred/symmetric-score terms   Tk topology keys
+  D  topology domains (+1 trash row for masked scatters)
+  Hp host-ports/template                      Cs spread constraints/template
+  Ti/Tn required pod-affinity/anti terms      Pp preferred node-affinity terms
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..models.objects import Node, Pod
+from . import vocab as V
+from .templates import SchedTemplate, TemplateSet
+
+_NAN = float("nan")
+
+
+class EncodedCluster(NamedTuple):
+    """Static (read-only during a scan) cluster tensors."""
+
+    # nodes
+    node_valid: np.ndarray  # [N] bool
+    alloc: np.ndarray  # [N, R] f32
+    unschedulable: np.ndarray  # [N] bool
+    taint_key: np.ndarray  # [N, Tt] i32 (-1 pad)
+    taint_val: np.ndarray  # [N, Tt] i32
+    taint_effect: np.ndarray  # [N, Tt] i32 (-1 pad)
+    label_val: np.ndarray  # [N, K] i32 (-1 absent)
+    label_num: np.ndarray  # [N, K] f32 (NaN when not numeric)
+    node_domain: np.ndarray  # [N, Tk] i32 (D = trash row when label absent)
+    domain_topo: np.ndarray  # [D+1] i32 topo-key index owning each domain (-1 trash)
+    # templates
+    req: np.ndarray  # [U, R] f32
+    tol_valid: np.ndarray  # [U, Tl] bool
+    tol_key: np.ndarray  # [U, Tl] i32 (-1 = empty key → all)
+    tol_op: np.ndarray  # [U, Tl] i32 (TOL_EQUAL/TOL_EXISTS)
+    tol_val: np.ndarray  # [U, Tl] i32
+    tol_effect: np.ndarray  # [U, Tl] i32 (-1 = all effects)
+    ns_key: np.ndarray  # [U, Qs] i32 (-1 pad) nodeSelector map
+    ns_val: np.ndarray  # [U, Qs] i32
+    has_req_aff: np.ndarray  # [U] bool
+    aff_term_valid: np.ndarray  # [U, T] bool
+    aff_key: np.ndarray  # [U, T, Q] i32
+    aff_op: np.ndarray  # [U, T, Q] i32 (OP_PAD → vacuously true)
+    aff_val: np.ndarray  # [U, T, Q, Vv] i32 (-1 pad)
+    aff_num: np.ndarray  # [U, T, Q] f32
+    pna_weight: np.ndarray  # [U, Pp] f32 (0 pad) preferred node affinity
+    pna_key: np.ndarray  # [U, Pp, Q] i32
+    pna_op: np.ndarray  # [U, Pp, Q] i32
+    pna_val: np.ndarray  # [U, Pp, Q, Vv] i32
+    pna_num: np.ndarray  # [U, Pp, Q] f32
+    ports: np.ndarray  # [U, Hp] i32 (-1 pad)
+    spr_topo: np.ndarray  # [U, Cs] i32 topo-key index (-1 pad)
+    spr_sel: np.ndarray  # [U, Cs] i32 selector id
+    spr_skew: np.ndarray  # [U, Cs] i32
+    spr_hard: np.ndarray  # [U, Cs] bool
+    at_sel: np.ndarray  # [U, Ti] i32 (-1 pad) required pod affinity
+    at_topo: np.ndarray  # [U, Ti] i32 topo-key index
+    an_sel: np.ndarray  # [U, Tn] i32 required anti-affinity
+    an_topo: np.ndarray  # [U, Tn] i32
+    pt_sel: np.ndarray  # [U, Tpp] i32 preferred pod terms (incoming side)
+    pt_topo: np.ndarray  # [U, Tpp] i32
+    pt_w: np.ndarray  # [U, Tpp] f32 signed
+    matches_sel: np.ndarray  # [U, A] bool
+    anti_g: np.ndarray  # [U, G] bool — template carries global anti term g
+    prefg_w: np.ndarray  # [U, Gp] f32 — signed weights of symmetric terms carried
+    pin: np.ndarray  # [U] i32 node index; -1 none; -2 unknown node
+    # global term tables
+    anti_g_sel: np.ndarray  # [G] i32
+    anti_g_topo: np.ndarray  # [G] i32 topo-key index
+    prefg_sel: np.ndarray  # [Gp] i32
+    prefg_topo: np.ndarray  # [Gp] i32
+    # gpu-share extension (zeros when unused)
+    gpu_mem: np.ndarray  # [U] f32 per-GPU memory request
+    gpu_count: np.ndarray  # [U] i32
+    node_gpu_mem: np.ndarray  # [N, Gd] f32 per-device total memory
+    # open-local extension
+    lvm_req: np.ndarray  # [U] f32 total LVM bytes requested
+    dev_req: np.ndarray  # [U, 2] f32 exclusive-device bytes by media (ssd, hdd) — one device each
+    dev_req_count: np.ndarray  # [U, 2] i32 number of exclusive devices by media
+    node_vg_cap: np.ndarray  # [N, Vg] f32 volume-group capacities
+    node_dev_cap: np.ndarray  # [N, Dv] f32 device capacities
+    node_dev_media: np.ndarray  # [N, Dv] i32 0=ssd 1=hdd (-1 pad)
+
+
+class ScanState(NamedTuple):
+    """Mutable carry threaded through the bind scan."""
+
+    used: np.ndarray  # [N, R] f32
+    port_used: np.ndarray  # [N, Hports] f32
+    dom_sel: np.ndarray  # [D+1, A] f32
+    dom_anti: np.ndarray  # [D+1, G] f32
+    dom_prefw: np.ndarray  # [D+1, Gp] f32
+    gpu_free: np.ndarray  # [N, Gd] f32
+    vg_free: np.ndarray  # [N, Vg] f32
+    dev_free: np.ndarray  # [N, Dv] f32 (0 when device is taken or absent)
+
+
+@dataclass
+class ClusterMeta:
+    """Host-side decode tables for reports."""
+
+    node_names: List[str] = field(default_factory=list)
+    n_real_nodes: int = 0
+    vocab: Optional[V.Vocab] = None
+    template_set: Optional[TemplateSet] = None
+    resource_names: List[str] = field(default_factory=list)
+    n_domains: int = 0
+    node_gpu_count: Optional[np.ndarray] = None  # [N] i32
+    node_vg_names: List[List[str]] = field(default_factory=list)
+    node_dev_names: List[List[str]] = field(default_factory=list)
+
+
+def _pad_to(n: int, mult: int) -> int:
+    return max(mult, mult * math.ceil(n / mult))
+
+
+def encode_labels(vocab: V.Vocab, labels: Dict[str, str], extra: Dict[str, str]) -> Dict[int, Tuple[int, float]]:
+    out: Dict[int, Tuple[int, float]] = {}
+    for k, v in {**labels, **extra}.items():
+        kid = vocab.key_id(k)
+        vid = vocab.val_id(str(v))
+        try:
+            num = float(int(str(v)))
+        except ValueError:
+            num = _NAN
+        out[kid] = (vid, num)
+    return out
+
+
+class ClusterEncoder:
+    """Accumulates nodes + pods, then materializes the tensors.
+
+    Usage:
+        enc = ClusterEncoder()
+        enc.add_nodes(nodes)
+        tmpl_ids = [enc.add_pod(p, owner_selector) for p in pods]
+        cluster, state0, meta = enc.build()
+    """
+
+    def __init__(self, node_pad: int = 8) -> None:
+        self.vocab = V.Vocab()
+        self.ts = TemplateSet()
+        self.nodes: List[Node] = []
+        self.node_index: Dict[str, int] = {}
+        self.pod_tmpl: List[int] = []
+        self.node_pad = node_pad
+
+    # -- ingestion ----------------------------------------------------------
+
+    def add_nodes(self, nodes: List[Node]) -> None:
+        for n in nodes:
+            if n.metadata.name in self.node_index:
+                continue
+            self.node_index[n.metadata.name] = len(self.nodes)
+            self.nodes.append(n)
+            # Pre-intern label/taint strings so vocab is complete.
+            encode_labels(self.vocab, n.metadata.labels, {"metadata.name": n.metadata.name})
+            for t in n.taints:
+                self.vocab.key_id(t.key)
+                self.vocab.val_id(t.value)
+            for r in n.allocatable:
+                self.vocab.resource_id(r)
+
+    def add_pod(self, pod: Pod, owner_selector: Optional[dict] = None) -> int:
+        tid = self.ts.add_pod(pod, owner_selector)
+        self.pod_tmpl.append(tid)
+        return tid
+
+    # -- template feature interning (strings → ids) -------------------------
+
+    def _intern_template(self, t: SchedTemplate) -> None:
+        vb = self.vocab
+        for r in t.requests:
+            vb.resource_id(r)
+        for k, v in t.node_selector.items():
+            vb.key_id(k)
+            vb.val_id(str(v))
+        for key, _op, val, _eff in t.tolerations:
+            if key:
+                vb.key_id(key)
+            vb.val_id(val)
+        for term in t.affinity_terms:
+            for e in (term.get("matchExpressions") or []) + (term.get("matchFields") or []):
+                vb.key_id(str(e.get("key", "")) if e.get("key") != "metadata.name" else "metadata.name")
+                for v in e.get("values") or []:
+                    vb.val_id(str(v))
+        for pref in t.pref_node_affinity:
+            for e in ((pref.get("preference") or {}).get("matchExpressions") or []) + (
+                (pref.get("preference") or {}).get("matchFields") or []
+            ):
+                vb.key_id(str(e.get("key", "")))
+                for v in e.get("values") or []:
+                    vb.val_id(str(v))
+        for proto, port, ip in t.host_ports:
+            vb.port_id(proto, port, ip)
+        for c in t.spread:
+            vb.topo_key_id(c.topo_key)
+        for term in t.aff_terms + t.anti_terms:
+            vb.topo_key_id(term.topo_key)
+        for term in t.pref_terms:
+            vb.topo_key_id(term.topo_key)
+
+    # -- node-affinity term encoding helper ---------------------------------
+
+    def _encode_terms(self, terms: List[dict], T: int, Q: int, Vv: int):
+        vb = self.vocab
+        valid = np.zeros((T,), dtype=bool)
+        key = np.full((T, Q), -1, dtype=np.int32)
+        op = np.full((T, Q), V.OP_PAD, dtype=np.int32)
+        val = np.full((T, Q, Vv), -1, dtype=np.int32)
+        num = np.full((T, Q), _NAN, dtype=np.float32)
+        for ti, term in enumerate(terms[:T]):
+            reqs = list(term.get("matchExpressions") or [])
+            for f in term.get("matchFields") or []:
+                f = dict(f)
+                f["key"] = "metadata.name"
+                reqs.append(f)
+            valid[ti] = True
+            for qi, e in enumerate(reqs[:Q]):
+                key[ti, qi] = vb.label_keys.get(str(e.get("key", "metadata.name") if e.get("key") else ""), -1)
+                if key[ti, qi] < 0:
+                    key[ti, qi] = vb.key_id(str(e.get("key", "")))
+                op[ti, qi] = V.NODE_OP_CODES.get(str(e.get("operator", "")), V.OP_PAD)
+                vals = [str(x) for x in (e.get("values") or [])]
+                for vi, x in enumerate(vals[:Vv]):
+                    val[ti, qi, vi] = vb.val_id(x)
+                if op[ti, qi] in (V.OP_GT, V.OP_LT) and vals:
+                    try:
+                        num[ti, qi] = float(int(vals[0]))
+                    except ValueError:
+                        num[ti, qi] = _NAN
+        return valid, key, op, val, num
+
+    # -- build --------------------------------------------------------------
+
+    def build(self) -> Tuple[EncodedCluster, ScanState, ClusterMeta]:
+        vb = self.vocab
+        templates = self.ts.templates or [SchedTemplate()]
+        for t in templates:
+            self._intern_template(t)
+
+        N = _pad_to(len(self.nodes), self.node_pad)
+        R = vb.n_resources
+        K = max(vb.n_label_keys, 1)
+        U = len(templates)
+        A = max(len(self.ts.selectors), 1)
+        Tk = max(vb.n_topo_keys, 1)
+        Hports = max(vb.n_ports, 1)
+
+        Tt = max([len(n.taints) for n in self.nodes] + [1])
+        Tl = max([len(t.tolerations) for t in templates] + [1])
+        Qs = max([len(t.node_selector) for t in templates] + [1])
+        T = max([len(t.affinity_terms) for t in templates] + [1])
+        Q = max(
+            [
+                len((term.get("matchExpressions") or [])) + len((term.get("matchFields") or []))
+                for t in templates
+                for term in t.affinity_terms
+            ]
+            + [1]
+        )
+        Vv = max(
+            [
+                len(e.get("values") or [])
+                for t in templates
+                for term in t.affinity_terms
+                for e in (term.get("matchExpressions") or []) + (term.get("matchFields") or [])
+            ]
+            + [
+                len(e.get("values") or [])
+                for t in templates
+                for pref in t.pref_node_affinity
+                for e in ((pref.get("preference") or {}).get("matchExpressions") or [])
+            ]
+            + [1]
+        )
+        Pp = max([len(t.pref_node_affinity) for t in templates] + [1])
+        Qp = max(
+            [
+                len(((pref.get("preference") or {}).get("matchExpressions") or []))
+                + len(((pref.get("preference") or {}).get("matchFields") or []))
+                for t in templates
+                for pref in t.pref_node_affinity
+            ]
+            + [1]
+        )
+        Qmax = max(Q, Qp)
+        Hp = max([len(t.host_ports) for t in templates] + [1])
+        Cs = max([len(t.spread) for t in templates] + [1])
+        Ti = max([len(t.aff_terms) for t in templates] + [1])
+        Tn = max([len(t.anti_terms) for t in templates] + [1])
+        Tpp = max([len(t.pref_terms) for t in templates] + [1])
+
+        # ---- node tensors
+        node_valid = np.zeros((N,), dtype=bool)
+        alloc = np.zeros((N, R), dtype=np.float32)
+        unschedulable = np.zeros((N,), dtype=bool)
+        taint_key = np.full((N, Tt), -1, dtype=np.int32)
+        taint_val = np.full((N, Tt), -1, dtype=np.int32)
+        taint_effect = np.full((N, Tt), -1, dtype=np.int32)
+        label_val = np.full((N, K), -1, dtype=np.int32)
+        label_num = np.full((N, K), _NAN, dtype=np.float32)
+
+        for i, n in enumerate(self.nodes):
+            node_valid[i] = True
+            unschedulable[i] = n.unschedulable
+            for rname, v in n.allocatable.items():
+                rid = vb.resource_id(rname)
+                if rid >= 0:
+                    alloc[i, rid] = v * 1000.0 if rname == "cpu" else v
+            for j, t in enumerate(n.taints[:Tt]):
+                taint_key[i, j] = vb.key_id(t.key)
+                taint_val[i, j] = vb.val_id(t.value)
+                taint_effect[i, j] = V.EFFECT_CODES.get(t.effect, -1)
+            for kid, (vid, num) in encode_labels(
+                vb, n.metadata.labels, {"metadata.name": n.metadata.name}
+            ).items():
+                if kid < K:
+                    label_val[i, kid] = vid
+                    label_num[i, kid] = num
+
+        # K may have grown during template interning; rebuild label arrays at
+        # final K if needed.
+        if vb.n_label_keys > K:
+            K2 = vb.n_label_keys
+            lv = np.full((N, K2), -1, dtype=np.int32)
+            ln = np.full((N, K2), _NAN, dtype=np.float32)
+            lv[:, :K] = label_val
+            ln[:, :K] = label_num
+            for i, n in enumerate(self.nodes):
+                for kid, (vid, num) in encode_labels(
+                    vb, n.metadata.labels, {"metadata.name": n.metadata.name}
+                ).items():
+                    lv[i, kid] = vid
+                    ln[i, kid] = num
+            label_val, label_num, K = lv, ln, K2
+
+        # ---- topology domains
+        domain_ids: Dict[Tuple[int, int], int] = {}
+        node_domain = np.zeros((N, Tk), dtype=np.int32)
+        topo_key_to_label = [vb.label_keys.get(k) for k in vb.topo_keys.items()]
+        for i in range(N):
+            for tki in range(Tk):
+                lk = topo_key_to_label[tki] if tki < len(topo_key_to_label) else -1
+                vid = label_val[i, lk] if (node_valid[i] and lk is not None and lk >= 0) else -1
+                if vid < 0:
+                    node_domain[i, tki] = -1
+                else:
+                    node_domain[i, tki] = domain_ids.setdefault((tki, vid), len(domain_ids))
+        D = max(len(domain_ids), 1)
+        node_domain = np.where(node_domain < 0, D, node_domain).astype(np.int32)  # D = trash row
+        domain_topo = np.full((D + 1,), -1, dtype=np.int32)
+        for (tki, _vid), did in domain_ids.items():
+            domain_topo[did] = tki
+
+        # ---- global inter-pod term tables
+        topo_idx = {k: i for i, k in enumerate(vb.topo_keys.items())}
+        anti_table: Dict[Tuple[int, int], int] = {}
+        pref_table: Dict[Tuple[int, int], int] = {}
+        for t in templates:
+            for term in t.anti_terms:
+                anti_table.setdefault((term.sel_id, topo_idx.get(term.topo_key, -1)), len(anti_table))
+            for term in t.pref_terms:
+                pref_table.setdefault((term.sel_id, topo_idx.get(term.topo_key, -1)), len(pref_table))
+            # existing pods' REQUIRED affinity terms score with hard weight 1
+            for term in t.aff_terms:
+                pref_table.setdefault((term.sel_id, topo_idx.get(term.topo_key, -1)), len(pref_table))
+        G = max(len(anti_table), 1)
+        Gp = max(len(pref_table), 1)
+        anti_g_sel = np.zeros((G,), dtype=np.int32)
+        anti_g_topo = np.zeros((G,), dtype=np.int32)
+        for (sid, tki), g in anti_table.items():
+            anti_g_sel[g] = sid
+            anti_g_topo[g] = max(tki, 0)
+        prefg_sel = np.zeros((Gp,), dtype=np.int32)
+        prefg_topo = np.zeros((Gp,), dtype=np.int32)
+        for (sid, tki), g in pref_table.items():
+            prefg_sel[g] = sid
+            prefg_topo[g] = max(tki, 0)
+
+        # ---- template tensors
+        req = np.zeros((U, R), dtype=np.float32)
+        tol_valid = np.zeros((U, Tl), dtype=bool)
+        tol_key = np.full((U, Tl), -1, dtype=np.int32)
+        tol_op = np.zeros((U, Tl), dtype=np.int32)
+        tol_val = np.full((U, Tl), -1, dtype=np.int32)
+        tol_effect = np.full((U, Tl), -1, dtype=np.int32)
+        ns_key = np.full((U, Qs), -1, dtype=np.int32)
+        ns_val = np.full((U, Qs), -1, dtype=np.int32)
+        has_req_aff = np.zeros((U,), dtype=bool)
+        aff_term_valid = np.zeros((U, T), dtype=bool)
+        aff_key = np.full((U, T, Qmax), -1, dtype=np.int32)
+        aff_op = np.full((U, T, Qmax), V.OP_PAD, dtype=np.int32)
+        aff_val = np.full((U, T, Qmax, Vv), -1, dtype=np.int32)
+        aff_num = np.full((U, T, Qmax), _NAN, dtype=np.float32)
+        pna_weight = np.zeros((U, Pp), dtype=np.float32)
+        pna_key = np.full((U, Pp, Qmax), -1, dtype=np.int32)
+        pna_op = np.full((U, Pp, Qmax), V.OP_PAD, dtype=np.int32)
+        pna_val = np.full((U, Pp, Qmax, Vv), -1, dtype=np.int32)
+        pna_num = np.full((U, Pp, Qmax), _NAN, dtype=np.float32)
+        ports = np.full((U, Hp), -1, dtype=np.int32)
+        spr_topo = np.full((U, Cs), -1, dtype=np.int32)
+        spr_sel = np.zeros((U, Cs), dtype=np.int32)
+        spr_skew = np.zeros((U, Cs), dtype=np.int32)
+        spr_hard = np.zeros((U, Cs), dtype=bool)
+        at_sel = np.full((U, Ti), -1, dtype=np.int32)
+        at_topo = np.zeros((U, Ti), dtype=np.int32)
+        an_sel = np.full((U, Tn), -1, dtype=np.int32)
+        an_topo = np.zeros((U, Tn), dtype=np.int32)
+        pt_sel = np.full((U, Tpp), -1, dtype=np.int32)
+        pt_topo = np.zeros((U, Tpp), dtype=np.int32)
+        pt_w = np.zeros((U, Tpp), dtype=np.float32)
+        anti_g = np.zeros((U, G), dtype=bool)
+        prefg_w = np.zeros((U, Gp), dtype=np.float32)
+        pin = np.full((U,), -1, dtype=np.int32)
+        gpu_mem = np.zeros((U,), dtype=np.float32)
+        gpu_count = np.zeros((U,), dtype=np.int32)
+
+        for u, t in enumerate(templates):
+            for rid, v in vb.encode_resources(t.requests).items():
+                req[u, rid] = v
+            req[u, V.RES_PODS] += 1.0  # every pod consumes one pod slot
+            if t.node_name:
+                pin[u] = self.node_index.get(t.node_name, -2)
+            for j, (key, op, val, eff) in enumerate(t.tolerations[:Tl]):
+                tol_valid[u, j] = True
+                tol_key[u, j] = vb.label_keys.get(key, -1) if key else -1
+                tol_op[u, j] = V.TOL_EXISTS if op == "Exists" else V.TOL_EQUAL
+                tol_val[u, j] = vb.label_vals.get(val, -1)
+                tol_effect[u, j] = V.EFFECT_CODES.get(eff, -1) if eff else -1
+            for j, (k, v) in enumerate(sorted(t.node_selector.items())[:Qs]):
+                ns_key[u, j] = vb.key_id(k)
+                ns_val[u, j] = vb.label_vals.get(str(v), -1)
+            if t.affinity_terms:
+                has_req_aff[u] = True
+                tv, tk_, to, tva, tn = self._encode_terms(t.affinity_terms, T, Qmax, Vv)
+                aff_term_valid[u], aff_key[u], aff_op[u], aff_val[u], aff_num[u] = tv, tk_, to, tva, tn
+            if t.pref_node_affinity:
+                terms = [p.get("preference") or {} for p in t.pref_node_affinity]
+                tv, tk_, to, tva, tn = self._encode_terms(terms, Pp, Qmax, Vv)
+                pna_key[u], pna_op[u], pna_val[u], pna_num[u] = tk_, to, tva, tn
+                for j, p in enumerate(t.pref_node_affinity[:Pp]):
+                    pna_weight[u, j] = float(p.get("weight", 0))
+            for j, (proto, port, ip) in enumerate(t.host_ports[:Hp]):
+                ports[u, j] = vb.port_id(proto, port, ip)
+            for j, c in enumerate(t.spread[:Cs]):
+                spr_topo[u, j] = topo_idx.get(c.topo_key, -1)
+                spr_sel[u, j] = c.sel_id
+                spr_skew[u, j] = c.max_skew
+                spr_hard[u, j] = c.hard
+            for j, term in enumerate(t.aff_terms[:Ti]):
+                at_sel[u, j] = term.sel_id
+                at_topo[u, j] = max(topo_idx.get(term.topo_key, -1), 0)
+            for j, term in enumerate(t.anti_terms[:Tn]):
+                an_sel[u, j] = term.sel_id
+                an_topo[u, j] = max(topo_idx.get(term.topo_key, -1), 0)
+                anti_g[u, anti_table[(term.sel_id, topo_idx.get(term.topo_key, -1))]] = True
+            for j, term in enumerate(t.pref_terms[:Tpp]):
+                pt_sel[u, j] = term.sel_id
+                pt_topo[u, j] = max(topo_idx.get(term.topo_key, -1), 0)
+                pt_w[u, j] = term.weight
+                prefg_w[u, pref_table[(term.sel_id, topo_idx.get(term.topo_key, -1))]] += term.weight
+            for term in t.aff_terms:
+                # symmetric hard-affinity weight (HardPodAffinityWeight = 1)
+                prefg_w[u, pref_table[(term.sel_id, topo_idx.get(term.topo_key, -1))]] += 1.0
+            gpu_mem[u] = t.gpu_mem
+            gpu_count[u] = t.gpu_count
+
+        matches_sel = np.zeros((U, A), dtype=bool)
+        mm = self.ts.match_matrix()
+        if mm.size:
+            matches_sel[: mm.shape[0], : mm.shape[1]] = mm
+
+        # ---- extensions: encoded by their dedicated modules (task: gpu/local)
+        from .extensions import encode_gpu_nodes, encode_local_storage, encode_local_requests
+
+        node_gpu_mem, node_gpu_count = encode_gpu_nodes(self.nodes, N)
+        node_vg_cap, node_dev_cap, node_dev_media, vg_names, dev_names = encode_local_storage(self.nodes, N)
+        lvm_req, dev_req, dev_req_count = encode_local_requests(templates)
+
+        cluster = EncodedCluster(
+            node_valid=node_valid,
+            alloc=alloc,
+            unschedulable=unschedulable,
+            taint_key=taint_key,
+            taint_val=taint_val,
+            taint_effect=taint_effect,
+            label_val=label_val,
+            label_num=label_num,
+            node_domain=node_domain,
+            domain_topo=domain_topo,
+            req=req,
+            tol_valid=tol_valid,
+            tol_key=tol_key,
+            tol_op=tol_op,
+            tol_val=tol_val,
+            tol_effect=tol_effect,
+            ns_key=ns_key,
+            ns_val=ns_val,
+            has_req_aff=has_req_aff,
+            aff_term_valid=aff_term_valid,
+            aff_key=aff_key,
+            aff_op=aff_op,
+            aff_val=aff_val,
+            aff_num=aff_num,
+            pna_weight=pna_weight,
+            pna_key=pna_key,
+            pna_op=pna_op,
+            pna_val=pna_val,
+            pna_num=pna_num,
+            ports=ports,
+            spr_topo=spr_topo,
+            spr_sel=spr_sel,
+            spr_skew=spr_skew,
+            spr_hard=spr_hard,
+            at_sel=at_sel,
+            at_topo=at_topo,
+            an_sel=an_sel,
+            an_topo=an_topo,
+            pt_sel=pt_sel,
+            pt_topo=pt_topo,
+            pt_w=pt_w,
+            matches_sel=matches_sel,
+            anti_g=anti_g,
+            prefg_w=prefg_w,
+            pin=pin,
+            anti_g_sel=anti_g_sel,
+            anti_g_topo=anti_g_topo,
+            prefg_sel=prefg_sel,
+            prefg_topo=prefg_topo,
+            gpu_mem=gpu_mem,
+            gpu_count=gpu_count,
+            node_gpu_mem=node_gpu_mem,
+            lvm_req=lvm_req,
+            dev_req=dev_req,
+            dev_req_count=dev_req_count,
+            node_vg_cap=node_vg_cap,
+            node_dev_cap=node_dev_cap,
+            node_dev_media=node_dev_media,
+        )
+
+        state0 = ScanState(
+            used=np.zeros((N, R), dtype=np.float32),
+            port_used=np.zeros((N, Hports), dtype=np.float32),
+            dom_sel=np.zeros((D + 1, A), dtype=np.float32),
+            dom_anti=np.zeros((D + 1, G), dtype=np.float32),
+            dom_prefw=np.zeros((D + 1, Gp), dtype=np.float32),
+            gpu_free=node_gpu_mem.copy(),
+            vg_free=node_vg_cap.copy(),
+            dev_free=node_dev_cap.copy(),
+        )
+
+        meta = ClusterMeta(
+            node_names=[n.metadata.name for n in self.nodes],
+            n_real_nodes=len(self.nodes),
+            vocab=vb,
+            template_set=self.ts,
+            resource_names=list(vb.resources.items()),
+            n_domains=D,
+            node_gpu_count=node_gpu_count,
+            node_vg_names=vg_names,
+            node_dev_names=dev_names,
+        )
+        return cluster, state0, meta

@@ -1,0 +1,260 @@
+"""Scheduling-template extraction.
+
+Pods expanded from the same workload share an identical scheduling-relevant
+spec; 50k pods typically collapse to a few dozen *templates*. All per-pod
+device encodings are stored once per template and gathered by ``tmpl_id``
+inside the scan — this is the shape-dedup that keeps the encoded cluster
+small and the jit cache warm.
+
+Canonical selectors: inter-pod affinity terms and topology-spread constraints
+reference label selectors; each distinct (namespace, selector) pair becomes a
+selector id, and per-template match bits (does a pod of template u match
+selector a?) are precomputed on host — the device never does string matching.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..models.objects import Pod
+from ..models.selectors import match_label_selector
+
+ZONE_LABEL = "topology.kubernetes.io/zone"
+HOSTNAME_LABEL = "kubernetes.io/hostname"
+
+# System-default topology spread (k8s 1.21 DefaultPodTopologySpread feature,
+# scoring-only): maxSkew 3 on hostname, maxSkew 5 on zone, ScheduleAnyway.
+SYSTEM_DEFAULT_SPREAD = (
+    (HOSTNAME_LABEL, 3, False),
+    (ZONE_LABEL, 5, False),
+)
+
+
+def canon_selector(ns: str, selector: Optional[dict]) -> Optional[tuple]:
+    """(namespace, matchLabels, matchExpressions) canonical form; None for a
+    nil selector (matches nothing)."""
+    if selector is None:
+        return None
+    ml = tuple(sorted((str(k), str(v)) for k, v in (selector.get("matchLabels") or {}).items()))
+    exprs = tuple(
+        sorted(
+            (
+                str(e.get("key", "")),
+                str(e.get("operator", "")),
+                tuple(sorted(str(v) for v in (e.get("values") or []))),
+            )
+            for e in (selector.get("matchExpressions") or [])
+        )
+    )
+    return (ns, ml, exprs)
+
+
+def selector_matches(canon: Optional[tuple], ns: str, labels: Dict[str, str]) -> bool:
+    """Host-side evaluation of a canonical selector against a pod's
+    namespace + labels (the golden form used to precompute match bits)."""
+    if canon is None:
+        return False
+    sel_ns, ml, exprs = canon
+    if ns != sel_ns:
+        return False
+    sel = {
+        "matchLabels": dict(ml),
+        "matchExpressions": [{"key": k, "operator": op, "values": list(vals)} for k, op, vals in exprs],
+    }
+    return match_label_selector(sel, labels)
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    sel_id: int
+    topo_key: str
+
+
+@dataclass(frozen=True)
+class PrefPodAffinityTerm:
+    sel_id: int
+    topo_key: str
+    weight: float  # signed: negative for anti-affinity
+
+
+@dataclass(frozen=True)
+class SpreadConstraint:
+    topo_key: str
+    sel_id: int
+    max_skew: int
+    hard: bool  # DoNotSchedule vs ScheduleAnyway
+
+
+@dataclass
+class SchedTemplate:
+    """One deduplicated scheduling spec."""
+
+    namespace: str = "default"
+    labels: Dict[str, str] = field(default_factory=dict)
+    requests: Dict[str, float] = field(default_factory=dict)  # resource name -> base units
+    node_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity_terms: List[dict] = field(default_factory=list)  # required node-affinity terms
+    pref_node_affinity: List[dict] = field(default_factory=list)  # {weight, preference}
+    tolerations: List[tuple] = field(default_factory=list)  # (key, op, value, effect)
+    host_ports: List[Tuple[str, int, str]] = field(default_factory=list)
+    spread: List[SpreadConstraint] = field(default_factory=list)
+    aff_terms: List[PodAffinityTerm] = field(default_factory=list)  # required pod affinity
+    anti_terms: List[PodAffinityTerm] = field(default_factory=list)  # required pod anti-affinity
+    pref_terms: List[PrefPodAffinityTerm] = field(default_factory=list)  # preferred, signed weights
+    gpu_mem: float = 0.0  # per-GPU memory request (gpu-share extension)
+    gpu_count: int = 0
+    local_volumes: tuple = ()  # ((kind, size, scName), ...) open-local extension
+
+
+class TemplateSet:
+    """Dedupes pods into templates and interns selectors."""
+
+    def __init__(self) -> None:
+        self.templates: List[SchedTemplate] = []
+        self._index: Dict[str, int] = {}
+        self.selectors: List[Optional[tuple]] = []
+        self._sel_index: Dict[Optional[tuple], int] = {}
+
+    def selector_id(self, ns: str, selector: Optional[dict]) -> int:
+        canon = canon_selector(ns, selector)
+        idx = self._sel_index.get(canon)
+        if idx is None:
+            idx = len(self.selectors)
+            self._sel_index[canon] = idx
+            self.selectors.append(canon)
+        return idx
+
+    def add_pod(self, pod: Pod, owner_selector: Optional[dict] = None) -> int:
+        """Returns the template id for this pod (creating it if new)."""
+        tmpl = self._extract(pod, owner_selector)
+        key = self._canon_key(tmpl)
+        idx = self._index.get(key)
+        if idx is None:
+            idx = len(self.templates)
+            self._index[key] = idx
+            self.templates.append(tmpl)
+        return idx
+
+    # -- extraction ---------------------------------------------------------
+
+    def _extract(self, pod: Pod, owner_selector: Optional[dict]) -> SchedTemplate:
+        ns = pod.metadata.namespace or "default"
+        t = SchedTemplate(namespace=ns, labels=dict(pod.metadata.labels))
+        t.requests = pod.resource_requests()
+        t.node_name = pod.spec.node_name
+        t.node_selector = dict(pod.spec.node_selector)
+        aff = pod.spec.affinity or {}
+        node_aff = aff.get("nodeAffinity") or {}
+        required = node_aff.get("requiredDuringSchedulingIgnoredDuringExecution")
+        if required is not None:
+            t.affinity_terms = list(required.get("nodeSelectorTerms") or [])
+            if not t.affinity_terms:
+                # empty terms matches no node; encode an impossible term
+                t.affinity_terms = [{"matchExpressions": [{"key": "", "operator": "In", "values": []}]}]
+        t.pref_node_affinity = list(node_aff.get("preferredDuringSchedulingIgnoredDuringExecution") or [])
+        t.tolerations = [
+            (tol.key, tol.operator, tol.value, tol.effect) for tol in pod.spec.tolerations
+        ]
+        t.host_ports = [(p.protocol, p.host_port, p.host_ip) for p in pod.host_ports()]
+
+        # -- inter-pod affinity
+        pod_aff = aff.get("podAffinity") or {}
+        pod_anti = aff.get("podAntiAffinity") or {}
+        for term in pod_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or []:
+            t.aff_terms.append(self._pod_term(ns, term))
+        for term in pod_anti.get("requiredDuringSchedulingIgnoredDuringExecution") or []:
+            t.anti_terms.append(self._pod_term(ns, term))
+        for pref in pod_aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+            term = self._pod_term(ns, pref.get("podAffinityTerm") or {})
+            t.pref_terms.append(PrefPodAffinityTerm(term.sel_id, term.topo_key, float(pref.get("weight", 0))))
+        for pref in pod_anti.get("preferredDuringSchedulingIgnoredDuringExecution") or []:
+            term = self._pod_term(ns, pref.get("podAffinityTerm") or {})
+            t.pref_terms.append(PrefPodAffinityTerm(term.sel_id, term.topo_key, -float(pref.get("weight", 0))))
+
+        # -- topology spread
+        explicit = pod.spec.topology_spread_constraints
+        if explicit:
+            for c in explicit:
+                sel_id = self.selector_id(ns, c.get("labelSelector"))
+                t.spread.append(
+                    SpreadConstraint(
+                        topo_key=str(c.get("topologyKey", "")),
+                        sel_id=sel_id,
+                        max_skew=int(c.get("maxSkew", 1)),
+                        hard=(c.get("whenUnsatisfiable", "DoNotSchedule") == "DoNotSchedule"),
+                    )
+                )
+        elif owner_selector is not None:
+            # System-default spreading (scoring only) using the owning
+            # workload's selector — stands in for k8s's service/RS/STS
+            # selector lookup in defaultConstraints.
+            for topo_key, max_skew, hard in SYSTEM_DEFAULT_SPREAD:
+                sel_id = self.selector_id(ns, owner_selector)
+                t.spread.append(SpreadConstraint(topo_key, sel_id, max_skew, hard))
+
+        # -- extensions (gpu-share, open-local)
+        t.gpu_mem = pod.gpu_mem_request()
+        t.gpu_count = pod.gpu_count_request()
+        from ..models.objects import ANNO_POD_LOCAL_STORAGE
+
+        storage_anno = pod.metadata.annotations.get(ANNO_POD_LOCAL_STORAGE)
+        if storage_anno:
+            try:
+                vols = json.loads(storage_anno).get("volumes") or []
+                t.local_volumes = tuple(
+                    (str(v.get("kind", "")), int(v.get("size", 0)), str(v.get("scName", ""))) for v in vols
+                )
+            except (ValueError, AttributeError):
+                t.local_volumes = ()
+        return t
+
+    def _pod_term(self, ns: str, term: dict) -> PodAffinityTerm:
+        namespaces = [str(n) for n in (term.get("namespaces") or [])]
+        # A term with explicit namespaces gets one selector id per namespace;
+        # multi-namespace terms are rare — we take the common single-ns case
+        # and fall back to the pod's own namespace per k8s default.
+        sel_ns = namespaces[0] if namespaces else ns
+        sel_id = self.selector_id(sel_ns, term.get("labelSelector"))
+        return PodAffinityTerm(sel_id=sel_id, topo_key=str(term.get("topologyKey", "")))
+
+    # -- canonical dedupe key ----------------------------------------------
+
+    @staticmethod
+    def _canon_key(t: SchedTemplate) -> str:
+        return json.dumps(
+            {
+                "ns": t.namespace,
+                "labels": sorted(t.labels.items()),
+                "req": sorted(t.requests.items()),
+                "node": t.node_name,
+                "nsel": sorted(t.node_selector.items()),
+                "aff": t.affinity_terms,
+                "paff": t.pref_node_affinity,
+                "tol": t.tolerations,
+                "ports": t.host_ports,
+                "spread": [(c.topo_key, c.sel_id, c.max_skew, c.hard) for c in t.spread],
+                "at": [(x.sel_id, x.topo_key) for x in t.aff_terms],
+                "nt": [(x.sel_id, x.topo_key) for x in t.anti_terms],
+                "pt": [(x.sel_id, x.topo_key, x.weight) for x in t.pref_terms],
+                "gpu": [t.gpu_mem, t.gpu_count],
+                "lv": list(t.local_volumes),
+            },
+            sort_keys=True,
+            default=str,
+        )
+
+    # -- host-side match precompute ----------------------------------------
+
+    def match_matrix(self):
+        """[U, A] bool: does a pod of template u match selector a?"""
+        import numpy as np
+
+        U, A = len(self.templates), len(self.selectors)
+        m = np.zeros((U, A), dtype=bool)
+        for u, t in enumerate(self.templates):
+            for a, canon in enumerate(self.selectors):
+                m[u, a] = selector_matches(canon, t.namespace, t.labels)
+        return m

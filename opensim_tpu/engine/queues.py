@@ -1,0 +1,53 @@
+"""Pod-ordering queues — parity with ``pkg/algo``.
+
+The reference sorts app pods before feeding them one at a time to the
+scheduler (``pkg/simulator/simulator.go:238-241``): AffinityQueue (pods with
+a nodeSelector first, ``pkg/algo/affinity.go:22``), then TolerationQueue
+(pods with tolerations first, ``toleration.go:19``). GreedQueue
+(``greed.go:37-67``) is flag-gated (``--use-greed``): nodeName-pinned pods
+first, then descending dominant-resource share of cluster-total cpu+memory.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..models.objects import Node, Pod
+
+
+def affinity_sort(pods: List[Pod]) -> List[Pod]:
+    """Stable partition: pods with a nodeSelector first."""
+    with_sel = [p for p in pods if p.spec.node_selector]
+    without = [p for p in pods if not p.spec.node_selector]
+    return with_sel + without
+
+
+def toleration_sort(pods: List[Pod]) -> List[Pod]:
+    """Stable partition: pods with tolerations first."""
+    with_tol = [p for p in pods if p.spec.tolerations]
+    without = [p for p in pods if not p.spec.tolerations]
+    return with_tol + without
+
+
+def _share(alloc: float, total: float) -> float:
+    if total == 0:
+        return 0.0 if alloc == 0 else 1.0
+    return alloc / total
+
+
+def greed_sort(nodes: List[Node], pods: List[Pod]) -> List[Pod]:
+    """GreedQueue: nodeName-pinned pods first, then descending dominant
+    share of pod request vs cluster-total cpu+memory."""
+    total_cpu = sum(n.allocatable.get("cpu", 0.0) for n in nodes)
+    total_mem = sum(n.allocatable.get("memory", 0.0) for n in nodes)
+
+    def pod_share(p: Pod) -> float:
+        req = p.resource_requests()
+        if not req:
+            return 0.0
+        return max(_share(req.get("cpu", 0.0), total_cpu), _share(req.get("memory", 0.0), total_mem))
+
+    pinned = [p for p in pods if p.spec.node_name]
+    rest = [p for p in pods if not p.spec.node_name]
+    rest.sort(key=pod_share, reverse=True)
+    return pinned + rest

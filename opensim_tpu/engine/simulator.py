@@ -1,0 +1,192 @@
+"""Simulation facade — parity with ``pkg/simulator/core.go``.
+
+``simulate(cluster, apps, ...)`` mirrors ``Simulate()``
+(``pkg/simulator/core.go:67-117``): expand the cluster's workloads into
+pods, schedule cluster pods first, then each app in configured order, and
+return which pods landed where plus unschedulable reasons. The fake
+apiserver + informers + scheduler goroutine of the reference collapse into
+one encoded tensor state and one jitted scan.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..encoding.state import ClusterEncoder, ClusterMeta
+from ..models import expand
+from ..models.objects import (
+    ANNO_WORKLOAD_KIND,
+    LABEL_APP_NAME,
+    Node,
+    Pod,
+    ResourceTypes,
+)
+from ..ops import kernels
+from . import queues
+from .scheduler import schedule_pods, to_device
+
+
+@dataclass
+class AppResource:
+    """Parity with core.go:54-57."""
+
+    name: str
+    resources: ResourceTypes
+
+
+@dataclass
+class UnscheduledPod:
+    """Parity with core.go:25-28."""
+
+    pod: Pod
+    reason: str
+
+
+@dataclass
+class NodeStatus:
+    """Parity with core.go:31-36."""
+
+    node: Node
+    pods: List[Pod] = field(default_factory=list)
+
+
+@dataclass
+class SimulateResult:
+    """Parity with core.go:19-23."""
+
+    unscheduled_pods: List[UnscheduledPod] = field(default_factory=list)
+    node_status: List[NodeStatus] = field(default_factory=list)
+
+    def pods_on(self, node_name: str) -> List[Pod]:
+        for ns in self.node_status:
+            if ns.node.metadata.name == node_name:
+                return ns.pods
+        return []
+
+
+def _owner_selector(pod: Pod) -> Optional[dict]:
+    """Selector used for system-default topology spreading: the owning
+    workload's pods share identical labels, so matching on the pod's own
+    labels reproduces the RS/STS selector grouping that k8s
+    buildDefaultConstraints derives from the owning objects."""
+    if pod.metadata.annotations.get(ANNO_WORKLOAD_KIND) and pod.metadata.labels:
+        return {"matchLabels": dict(pod.metadata.labels)}
+    return None
+
+
+def _cluster_pods(cluster: ResourceTypes) -> List[Pod]:
+    """GetValidPodExcludeDaemonSet (pkg/simulator/utils.go:77-230): bare
+    cluster pods minus DaemonSet-owned ones (those are re-expanded per
+    node), plus expanded cluster workloads."""
+    ds_names = {(d.metadata.namespace, d.metadata.name) for d in cluster.daemon_sets}
+    rt = ResourceTypes(
+        pods=[
+            p
+            for p in cluster.pods
+            if not any(
+                r.kind == "DaemonSet" and (p.metadata.namespace, r.name) in ds_names
+                for r in p.metadata.owner_references
+            )
+        ],
+        deployments=cluster.deployments,
+        replica_sets=cluster.replica_sets,
+        stateful_sets=cluster.stateful_sets,
+        daemon_sets=cluster.daemon_sets,
+        jobs=cluster.jobs,
+        cron_jobs=cluster.cron_jobs,
+    )
+    return expand.generate_pods_from_resources(rt, cluster.nodes)
+
+
+def _reason_string(
+    fail_counts: np.ndarray, insufficient: np.ndarray, meta: ClusterMeta, n_nodes: int
+) -> str:
+    """Reconstruct the kube-scheduler FitError message format the reference
+    surfaces (e.g. '0/4 nodes are available: 3 node(s) had taints...')."""
+    parts: List[Tuple[int, str]] = []
+    for k in range(kernels.NUM_FILTERS):
+        cnt = int(fail_counts[k])
+        if cnt <= 0:
+            continue
+        if k == kernels.F_FIT:
+            for r, rname in enumerate(meta.resource_names):
+                rcnt = int(insufficient[r])
+                if rcnt > 0:
+                    parts.append((rcnt, f"Insufficient {rname}"))
+        else:
+            parts.append((cnt, kernels.FILTER_REASONS[k]))
+    if not parts:
+        return f"0/{n_nodes} nodes are available."
+    body = ", ".join(f"{cnt} {msg}" for cnt, msg in sorted(parts, key=lambda x: x[1]))
+    return f"0/{n_nodes} nodes are available: {body}."
+
+
+def simulate(
+    cluster: ResourceTypes,
+    apps: List[AppResource],
+    use_greed: bool = False,
+    node_pad: int = 8,
+) -> SimulateResult:
+    """One full simulation: cluster pods then apps in order."""
+    enc = ClusterEncoder(node_pad=node_pad)
+    enc.add_nodes(cluster.nodes)
+
+    ordered: List[Pod] = []
+    forced: List[bool] = []
+
+    for p in _cluster_pods(cluster):
+        ordered.append(p)
+        forced.append(bool(p.spec.node_name))
+
+    for app in apps:
+        app_pods = expand.generate_pods_from_resources(app.resources, cluster.nodes)
+        for p in app_pods:
+            p.metadata.labels.setdefault(LABEL_APP_NAME, app.name)
+        # simulator.go:238-241: affinity sort then toleration sort
+        app_pods = queues.toleration_sort(queues.affinity_sort(app_pods))
+        if use_greed:
+            app_pods = queues.greed_sort(cluster.nodes, app_pods)
+        for p in app_pods:
+            ordered.append(p)
+            forced.append(bool(p.spec.node_name))
+
+    if not ordered:
+        return SimulateResult(
+            node_status=[NodeStatus(node=n, pods=[]) for n in cluster.nodes]
+        )
+
+    tmpl_ids = np.array([enc.add_pod(p, _owner_selector(p)) for p in ordered], dtype=np.int32)
+    ec, st0, meta = enc.build()
+    ec, st0 = to_device(ec, st0)
+
+    pod_valid = np.ones((len(ordered),), dtype=bool)
+    out = schedule_pods(ec, st0, tmpl_ids, pod_valid, np.array(forced, dtype=bool))
+    chosen = np.asarray(out.chosen)
+    fail_counts = np.asarray(out.fail_counts)
+    insufficient = np.asarray(out.insufficient)
+
+    node_pods: Dict[str, List[Pod]] = {n.metadata.name: [] for n in cluster.nodes}
+    unscheduled: List[UnscheduledPod] = []
+    n_nodes = meta.n_real_nodes
+
+    for i, pod in enumerate(ordered):
+        c = int(chosen[i])
+        if forced[i] and c < 0:
+            unscheduled.append(UnscheduledPod(pod, f'node "{pod.spec.node_name}" not found'))
+            continue
+        if c >= 0:
+            pod.spec.node_name = meta.node_names[c]
+            pod.phase = "Running"
+            node_pods[meta.node_names[c]].append(pod)
+        else:
+            unscheduled.append(
+                UnscheduledPod(pod, _reason_string(fail_counts[i], insufficient[i], meta, n_nodes))
+            )
+
+    return SimulateResult(
+        unscheduled_pods=unscheduled,
+        node_status=[NodeStatus(node=n, pods=node_pods[n.metadata.name]) for n in cluster.nodes],
+    )

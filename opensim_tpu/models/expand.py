@@ -66,9 +66,10 @@ def make_valid_pod(pod: Pod) -> Pod:
         p.metadata.annotations = {}
     if p.spec.scheduler_name == "":
         p.spec.scheduler_name = DEFAULT_SCHEDULER_NAME
-    # Raw-dict sanitization for round-tripping.
+    # Raw-dict sanitization for round-tripping (p.raw was already deep-copied
+    # with the pod above; mutate in place).
     if p.raw:
-        raw = copy.deepcopy(p.raw)
+        raw = p.raw
         spec = raw.setdefault("spec", {})
         spec.setdefault("dnsPolicy", "ClusterFirst")
         spec.setdefault("restartPolicy", "Always")
@@ -90,7 +91,6 @@ def make_valid_pod(pod: Pod) -> Pod:
                 v["hostPath"] = {"path": "/tmp"}
                 v.pop("persistentVolumeClaim", None)
         raw["status"] = {}
-        p.raw = raw
         # PVC volumes were rewritten; keep the parsed view in sync.
         p.spec.volumes = copy.deepcopy(spec.get("volumes") or [])
     _validate_pod(p)
@@ -242,7 +242,11 @@ def _set_storage_annotation(pods: List[Pod], volume_claim_templates: List[dict])
         sc = (pvc.get("spec") or {}).get("storageClassName")
         if sc is None:
             continue
-        size = (((pvc.get("spec") or {}).get("resources") or {}).get("requests") or {}).get("storage", 0)
+        resources = (pvc.get("spec") or {}).get("resources") or {}
+        # GetPVCRequested falls back to limits when requests.storage is absent
+        size = (resources.get("requests") or {}).get("storage")
+        if size is None:
+            size = (resources.get("limits") or {}).get("storage", 0)
         size_b = int(parse_quantity(size))
         if sc in SC_LVM:
             kind = "LVM"
@@ -318,8 +322,12 @@ def generate_pods_from_resources(
         pods.extend(pods_from_replica_set(rs))
     for sts in resources.stateful_sets:
         pods.extend(pods_from_stateful_set(sts))
+    cron_keys = {(c.metadata.namespace, c.metadata.name) for c in resources.cron_jobs}
     for job in resources.jobs:
-        if any(r.kind == "CronJob" for r in job.metadata.owner_references):
+        if any(
+            r.kind == "CronJob" and (job.metadata.namespace, r.name) in cron_keys
+            for r in job.metadata.owner_references
+        ):
             continue
         pods.extend(pods_from_job(job))
     for cj in resources.cron_jobs:

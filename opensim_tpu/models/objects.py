@@ -102,7 +102,7 @@ class ObjectMeta:
         return cls(
             name=d.get("name", "") or "",
             namespace=d.get("namespace", "") or "",
-            labels=dict(d.get("labels") or {}),
+            labels={k: str(v) for k, v in (d.get("labels") or {}).items()},
             annotations={k: str(v) for k, v in (d.get("annotations") or {}).items()},
             uid=str(d.get("uid", "") or ""),
             generate_name=d.get("generateName", "") or "",
@@ -156,7 +156,9 @@ class Taint:
         return cls(
             key=d.get("key", "") or "",
             value=str(d.get("value", "") or ""),
-            effect=d.get("effect", "") or "",
+            # k8s requires an effect on taints; default missing ones to
+            # NoSchedule so parsed and programmatic taints behave alike
+            effect=d.get("effect", "") or "NoSchedule",
         )
 
 
@@ -222,7 +224,7 @@ class PodSpec:
             containers=[Container.from_dict(c) for c in d.get("containers") or []],
             init_containers=[Container.from_dict(c) for c in d.get("initContainers") or []],
             overhead={k: parse_quantity(v) for k, v in (d.get("overhead") or {}).items()},
-            node_selector=dict(d.get("nodeSelector") or {}),
+            node_selector={k: str(v) for k, v in (d.get("nodeSelector") or {}).items()},
             affinity=copy.deepcopy(d.get("affinity")) if d.get("affinity") else None,
             tolerations=[Toleration.from_dict(t) for t in d.get("tolerations") or []],
             topology_spread_constraints=copy.deepcopy(d.get("topologySpreadConstraints") or []),
@@ -292,17 +294,23 @@ class Pod:
         return f"{self.metadata.namespace}/{self.metadata.name}"
 
     # GPU-share request, parity with GetGpuMemoryAndCountFromPodAnnotation
-    # (pkg/type/open-gpu-share/utils/pod.go:41-127): gpu-mem is requested as a
-    # container resource; gpu-count defaults to 1 when gpu-mem > 0.
+    # (pkg/type/open-gpu-share/utils/pod.go:83-100): gpu-mem (memory PER GPU)
+    # and gpu-count both come from pod *annotations*; absent count → 0.
     def gpu_mem_request(self) -> float:
-        return self.resource_requests().get(RES_GPU_MEM, 0.0)
+        val = self.metadata.annotations.get(RES_GPU_MEM)
+        if not val:
+            return 0.0
+        try:
+            return parse_quantity(val)
+        except ValueError:
+            return 0.0
 
     def gpu_count_request(self) -> int:
-        req = self.resource_requests()
-        cnt = int(req.get(RES_GPU_COUNT, 0))
-        if cnt == 0 and req.get(RES_GPU_MEM, 0) > 0:
-            cnt = 1
-        return cnt
+        try:
+            cnt = int(self.metadata.annotations.get(RES_GPU_COUNT, "0"))
+        except ValueError:
+            return 0
+        return max(cnt, 0)
 
 
 @dataclass

@@ -1,0 +1,569 @@
+"""Filter / score kernels — the vectorized scheduler plugin pipeline.
+
+Each kernel computes over the FULL node axis at once, replacing the
+reference's goroutine fan-out (``parallelize.Until`` with 16 workers,
+``vendor/.../internal/parallelize/parallelism.go:56``) with data
+parallelism on the TPU vector units. One ``pod_step`` = one pod through
+Filter → Score → selectHost, exactly the pipeline of
+``generic_scheduler.Schedule`` (``vendor/.../core/generic_scheduler.go:131-180``)
+with ``PercentageOfNodesToScore = 100`` (``pkg/simulator/utils.go:370``).
+
+Kernel ↔ reference-plugin parity map (score weights from
+``algorithmprovider/registry.go:119-132``):
+  filter: NodeName, NodeUnschedulable, TaintToleration, NodeAffinity,
+          NodePorts, NodeResourcesFit, PodTopologySpread, InterPodAffinity,
+          GpuShare (open-gpu-share.go:51-81), OpenLocal (open-local.go:51-92)
+  score:  BalancedAllocation (w1), ImageLocality (w1, 0 — no images in sim),
+          InterPodAffinity (w1), LeastAllocated (w1), NodeAffinity (w1),
+          NodePreferAvoidPods (w10000, constant), PodTopologySpread (w2),
+          TaintToleration (w1), Simon share (w1, plugin/simon.go:45-101),
+          GpuShare share (w1), OpenLocal (w1)
+
+All functions take the EncodedCluster (`ec`), the scan carry (`st`) and a
+traced template index `u`; shapes are static.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..encoding import vocab as V
+
+MAX_NODE_SCORE = 100.0
+
+# Filter kernel ids (order = reason-attribution precedence, roughly the
+# order the default profile runs them).
+F_NODE_PIN = 0  # NodeName
+F_UNSCHEDULABLE = 1
+F_TAINT = 2
+F_AFFINITY = 3  # NodeAffinity + nodeSelector
+F_PORTS = 4
+F_FIT = 5  # NodeResourcesFit
+F_SPREAD = 6
+F_INTERPOD = 7
+F_GPU = 8
+F_LOCAL = 9
+NUM_FILTERS = 10
+
+FILTER_REASONS = [
+    "node(s) didn't match the requested hostname",
+    "node(s) were unschedulable",
+    "node(s) had taints that the pod didn't tolerate",
+    "node(s) didn't match Pod's node affinity",
+    "node(s) didn't have free ports for the requested pod ports",
+    "Insufficient resources",
+    "node(s) didn't match pod topology spread constraints",
+    "node(s) didn't satisfy inter-pod affinity rules",
+    "Insufficient GPU memory in 1 GPU device",
+    "node(s) didn't have enough local storage",
+]
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _gather_label(label_arr, keys):
+    """label_arr [N, K], keys [...]-shaped int32 (may be -1) →
+    values [N, ...]; -1 keys yield -1/NaN."""
+    safe = jnp.maximum(keys, 0)
+    vals = label_arr[:, safe]  # [N, ...]
+    return vals
+
+
+def _requirements_match(ec, keys, ops, vals, nums):
+    """Evaluate node-selector requirements against all nodes.
+
+    keys/ops/nums: [...]; vals: [..., Vv]. Returns bool [N, ...] — True
+    where the requirement holds (padding requirements are vacuously True).
+    """
+    node_val = _gather_label(ec.label_val, keys)  # [N, ...]
+    node_num = _gather_label(ec.label_num, keys)  # [N, ...]
+    present = node_val >= 0
+    in_set = jnp.any(node_val[..., None] == vals[None, ...], axis=-1)  # [N, ...]
+    ops_b = ops[None, ...]
+    result = jnp.ones_like(present)
+    result = jnp.where(ops_b == V.OP_IN, present & in_set, result)
+    result = jnp.where(ops_b == V.OP_NOT_IN, ~(present & in_set), result)
+    result = jnp.where(ops_b == V.OP_EXISTS, present, result)
+    result = jnp.where(ops_b == V.OP_DOES_NOT_EXIST, ~present, result)
+    result = jnp.where(ops_b == V.OP_GT, node_num > nums[None, ...], result)
+    result = jnp.where(ops_b == V.OP_LT, node_num < nums[None, ...], result)
+    return result
+
+
+def _minmax_normalize(scores, feasible):
+    """SimonPlugin.NormalizeScore (plugin/simon.go:76-101): min-max over the
+    feasible set to [0, 100]; degenerate range → 0."""
+    big = jnp.float32(1e30)
+    lo = jnp.min(jnp.where(feasible, scores, big))
+    hi = jnp.max(jnp.where(feasible, scores, -big))
+    rng = hi - lo
+    return jnp.where(rng > 0, (scores - lo) * MAX_NODE_SCORE / rng, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# filter kernels
+# ---------------------------------------------------------------------------
+
+def node_pin_filter(ec, u):
+    """NodeName plugin: spec.nodeName must equal the node."""
+    pin = ec.pin[u]
+    n_idx = jnp.arange(ec.node_valid.shape[0])
+    return jnp.where(pin == -1, True, n_idx == pin)
+
+
+def unschedulable_filter(ec, u):
+    """NodeUnschedulable plugin: spec.unschedulable blocks unless tolerated
+    via the node.kubernetes.io/unschedulable:NoSchedule taint (we take the
+    common path: unschedulable nodes are excluded)."""
+    return ~ec.unschedulable
+
+
+def taint_filter(ec, u):
+    """TaintToleration: every NoSchedule/NoExecute taint must be tolerated."""
+    t_key = ec.taint_key  # [N, Tt]
+    t_val = ec.taint_val
+    t_eff = ec.taint_effect
+    tol_valid = ec.tol_valid[u]  # [Tl]
+    tol_key = ec.tol_key[u]
+    tol_op = ec.tol_op[u]
+    tol_val = ec.tol_val[u]
+    tol_eff = ec.tol_effect[u]
+
+    # [N, Tt, Tl]: does toleration l tolerate taint t?
+    key_ok = (tol_key[None, None, :] == -1) | (tol_key[None, None, :] == t_key[:, :, None])
+    eff_ok = (tol_eff[None, None, :] == -1) | (tol_eff[None, None, :] == t_eff[:, :, None])
+    val_ok = jnp.where(
+        tol_op[None, None, :] == V.TOL_EXISTS, True, tol_val[None, None, :] == t_val[:, :, None]
+    )
+    # empty-key (-1) tolerations require operator Exists to match all
+    empty_key_bad = (tol_key[None, None, :] == -1) & (tol_op[None, None, :] != V.TOL_EXISTS)
+    tolerated = key_ok & eff_ok & val_ok & ~empty_key_bad & tol_valid[None, None, :]
+    taint_tolerated = jnp.any(tolerated, axis=-1)  # [N, Tt]
+    taint_blocking = (t_eff == V.EFFECT_NO_SCHEDULE) | (t_eff == V.EFFECT_NO_EXECUTE)
+    return ~jnp.any(taint_blocking & ~taint_tolerated, axis=-1)
+
+
+def node_affinity_filter(ec, u):
+    """NodeAffinity plugin: nodeSelector map AND required node affinity
+    (OR over terms, AND over requirements)."""
+    # nodeSelector map: each (key, val) must match exactly.
+    ns_key = ec.ns_key[u]  # [Qs]
+    ns_val = ec.ns_val[u]
+    node_val = _gather_label(ec.label_val, ns_key)  # [N, Qs]
+    sel_ok = jnp.all((ns_key[None, :] < 0) | (node_val == ns_val[None, :]), axis=-1)
+
+    req_ok = _requirements_match(ec, ec.aff_key[u], ec.aff_op[u], ec.aff_val[u], ec.aff_num[u])
+    term_ok = jnp.all(req_ok, axis=-1)  # [N, T] AND over requirements
+    term_valid = ec.aff_term_valid[u]  # [T]
+    any_term = jnp.any(term_ok & term_valid[None, :], axis=-1)
+    aff_ok = jnp.where(ec.has_req_aff[u], any_term, True)
+    return sel_ok & aff_ok
+
+
+def ports_filter(ec, st, u):
+    """NodePorts: requested host ports must be free on the node."""
+    ports = ec.ports[u]  # [Hp]
+    safe = jnp.maximum(ports, 0)
+    used = st.port_used[:, safe]  # [N, Hp]
+    conflict = (ports[None, :] >= 0) & (used > 0)
+    return ~jnp.any(conflict, axis=-1)
+
+
+def fit_filter(ec, st, u):
+    """NodeResourcesFit (noderesources/fit.go:195-260): requested resources
+    must fit allocatable - used. Returns (mask, insufficient [N, R])."""
+    req = ec.req[u]  # [R]
+    insufficient = (req[None, :] > 0) & (st.used + req[None, :] > ec.alloc)
+    return ~jnp.any(insufficient, axis=-1), insufficient
+
+
+def spread_filter(ec, st, u, node_aff_mask):
+    """PodTopologySpread DoNotSchedule constraints
+    (podtopologyspread/filtering.go:276): for each hard constraint,
+    matchCount(domain) + selfMatch - minMatch(eligible domains) <= maxSkew."""
+    topo = ec.spr_topo[u]  # [Cs] topo-key idx, -1 pad
+    sel = ec.spr_sel[u]
+    skew = ec.spr_skew[u]
+    hard = ec.spr_hard[u]
+    active = (topo >= 0) & hard
+
+    dom = ec.node_domain[:, jnp.maximum(topo, 0)]  # [N, Cs]
+    has_label = dom < ec.domain_topo.shape[0] - 1  # trash row = missing label
+    cnt = st.dom_sel[dom, sel[None, :]]  # [N, Cs]
+    self_match = ec.matches_sel[u, sel]  # [Cs]
+
+    # min matchNum over eligible domains: nodes passing node affinity with the
+    # label present (k8s filtering.go calPreFilterState node filter).
+    eligible = node_aff_mask[:, None] & has_label & ec.node_valid[:, None]
+    big = jnp.float32(1e30)
+    min_cnt = jnp.min(jnp.where(eligible, cnt, big), axis=0)  # [Cs]
+    ok = cnt + self_match[None, :].astype(jnp.float32) - min_cnt <= skew[None, :].astype(jnp.float32)
+    ok = ok & has_label  # nodes missing the topology label fail the constraint
+    return jnp.all(ok | ~active[None, :], axis=-1)
+
+
+def interpod_filter(ec, st, u):
+    """InterPodAffinity filter (interpodaffinity/filtering.go:378):
+    1) incoming pod's required anti-affinity: no existing pod in the
+       candidate's topology domain may match;
+    2) existing pods' anti-affinity terms must not match the incoming pod;
+    3) incoming pod's required affinity: some domain pod matches (with the
+       self-match bootstrap rule)."""
+    D_trash = ec.domain_topo.shape[0] - 1
+
+    # (1) incoming anti terms
+    an_sel = ec.an_sel[u]  # [Tn]
+    an_topo = ec.an_topo[u]
+    an_active = an_sel >= 0
+    dom = ec.node_domain[:, an_topo]  # [N, Tn]
+    anti_cnt = st.dom_sel[dom, jnp.maximum(an_sel, 0)[None, :]]  # [N, Tn]
+    # k8s: a node missing the topology label forms no topology pair, so the
+    # anti-affinity term is vacuously satisfied there.
+    has_label = dom < D_trash
+    anti_ok = jnp.all(~an_active[None, :] | ~has_label | (anti_cnt == 0), axis=-1)
+
+    # (2) existing pods' anti terms (symmetric check); label-less candidate
+    # nodes can't be in any violating domain
+    g_topo = ec.anti_g_topo  # [G]
+    g_sel = ec.anti_g_sel
+    dom_g = ec.node_domain[:, g_topo]  # [N, G]
+    has_label_g = dom_g < D_trash
+    exist_cnt = st.dom_anti[dom_g, jnp.arange(g_topo.shape[0])[None, :]]  # [N, G]
+    incoming_matches = ec.matches_sel[u, g_sel]  # [G]
+    sym_ok = jnp.all(~(has_label_g & (exist_cnt > 0) & incoming_matches[None, :]), axis=-1)
+
+    # (3) incoming required affinity terms
+    at_sel = ec.at_sel[u]  # [Ti]
+    at_topo = ec.at_topo[u]
+    at_active = at_sel >= 0
+    dom_a = ec.node_domain[:, at_topo]  # [N, Ti]
+    aff_cnt = st.dom_sel[dom_a, jnp.maximum(at_sel, 0)[None, :]]  # [N, Ti]
+    has_label_a = dom_a < D_trash
+    # bootstrap: no pod matches the term anywhere AND the incoming pod
+    # matches its own term selector → term satisfiable on any node
+    dom_is_key = ec.domain_topo[None, :] == at_topo[:, None]  # [Ti, D+1]
+    total = jnp.sum(jnp.where(dom_is_key, st.dom_sel[:, jnp.maximum(at_sel, 0)].T, 0.0), axis=-1)  # [Ti]
+    self_match = ec.matches_sel[u, jnp.maximum(at_sel, 0)]  # [Ti]
+    bootstrap = (total == 0) & self_match
+    aff_ok = jnp.all(
+        ~at_active[None, :] | bootstrap[None, :] | (has_label_a & (aff_cnt > 0)), axis=-1
+    )
+
+    return anti_ok & sym_ok & aff_ok
+
+
+def gpu_filter(ec, st, u):
+    """Open-Gpu-Share filter (open-gpu-share.go:51-81 + AllocateGpuId,
+    gpunodeinfo.go:232-290): per-GPU memory × count must be packable. The
+    greedy multi-GPU packing with device reuse is equivalent to
+    sum_d floor(free_d / mem) >= count."""
+    mem = ec.gpu_mem[u]
+    cnt = ec.gpu_count[u].astype(jnp.float32)
+    chunks = jnp.sum(jnp.floor_divide(st.gpu_free, jnp.maximum(mem, 1.0)), axis=-1)  # [N]
+    ok = (chunks >= cnt) & (cnt > 0)
+    return jnp.where(mem > 0, ok, True)
+
+
+def local_filter(ec, st, u):
+    """Open-Local filter (open-local.go:51-92): LVM request fits the best
+    VG; exclusive-device requests find enough free devices of the media
+    type with sufficient capacity."""
+    lvm = ec.lvm_req[u]
+    lvm_ok = jnp.max(st.vg_free, axis=-1) >= lvm
+    ok = jnp.where(lvm > 0, lvm_ok, True)
+    for media in (0, 1):
+        size = ec.dev_req[u, media]
+        need = ec.dev_req_count[u, media].astype(jnp.int32)
+        fitting = (ec.node_dev_media == media) & (st.dev_free >= size) & (st.dev_free > 0)
+        ok = ok & jnp.where(size > 0, jnp.sum(fitting, axis=-1) >= need, True)
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# score kernels
+# ---------------------------------------------------------------------------
+
+def _nonzero_req(ec, u):
+    """GetNonzeroRequests defaults: 100m CPU / 200Mi memory when a pod
+    declares no request (used by Least/BalancedAllocation)."""
+    cpu = ec.req[u, V.RES_CPU]
+    mem = ec.req[u, V.RES_MEMORY]
+    return jnp.where(cpu > 0, cpu, 100.0), jnp.where(mem > 0, mem, 200.0 * 1024 * 1024)
+
+
+def least_allocated_score(ec, st, u):
+    """NodeResourcesLeastAllocated (least_allocated.go:93-117)."""
+    cpu_req, mem_req = _nonzero_req(ec, u)
+    cpu_score = _least_requested(st.used[:, V.RES_CPU] + cpu_req, ec.alloc[:, V.RES_CPU])
+    mem_score = _least_requested(st.used[:, V.RES_MEMORY] + mem_req, ec.alloc[:, V.RES_MEMORY])
+    return (cpu_score + mem_score) / 2.0
+
+
+def _least_requested(requested, capacity):
+    score = (capacity - requested) * MAX_NODE_SCORE / jnp.maximum(capacity, 1.0)
+    return jnp.where((capacity == 0) | (requested > capacity), 0.0, score)
+
+
+def balanced_allocation_score(ec, st, u):
+    """NodeResourcesBalancedAllocation (balanced_allocation.go:82-112)."""
+    cpu_req, mem_req = _nonzero_req(ec, u)
+    cpu_frac = (st.used[:, V.RES_CPU] + cpu_req) / jnp.maximum(ec.alloc[:, V.RES_CPU], 1.0)
+    mem_frac = (st.used[:, V.RES_MEMORY] + mem_req) / jnp.maximum(ec.alloc[:, V.RES_MEMORY], 1.0)
+    score = (1.0 - jnp.abs(cpu_frac - mem_frac)) * MAX_NODE_SCORE
+    return jnp.where((cpu_frac >= 1.0) | (mem_frac >= 1.0), 0.0, score)
+
+
+def node_affinity_score(ec, u):
+    """NodeAffinity score: sum of matching preferred-term weights, then
+    DefaultNormalizeScore (max → 100)."""
+    req_ok = _requirements_match(ec, ec.pna_key[u], ec.pna_op[u], ec.pna_val[u], ec.pna_num[u])
+    term_ok = jnp.all(req_ok, axis=-1)  # [N, Pp]
+    weights = ec.pna_weight[u]  # [Pp]
+    raw = jnp.sum(jnp.where(term_ok, weights[None, :], 0.0), axis=-1)
+    mx = jnp.max(raw)
+    return jnp.where(mx > 0, raw * MAX_NODE_SCORE / jnp.maximum(mx, 1.0), raw)
+
+
+def taint_toleration_score(ec, u):
+    """TaintToleration score: count intolerable PreferNoSchedule taints,
+    reverse-normalized (DefaultNormalizeScore reverse=true)."""
+    t_key, t_val, t_eff = ec.taint_key, ec.taint_val, ec.taint_effect
+    tol_valid = ec.tol_valid[u]
+    tol_key, tol_op, tol_val, tol_eff = ec.tol_key[u], ec.tol_op[u], ec.tol_val[u], ec.tol_effect[u]
+    key_ok = (tol_key[None, None, :] == -1) | (tol_key[None, None, :] == t_key[:, :, None])
+    eff_ok = (tol_eff[None, None, :] == -1) | (tol_eff[None, None, :] == t_eff[:, :, None])
+    val_ok = jnp.where(
+        tol_op[None, None, :] == V.TOL_EXISTS, True, tol_val[None, None, :] == t_val[:, :, None]
+    )
+    empty_key_bad = (tol_key[None, None, :] == -1) & (tol_op[None, None, :] != V.TOL_EXISTS)
+    tolerated = jnp.any(key_ok & eff_ok & val_ok & ~empty_key_bad & tol_valid[None, None, :], axis=-1)
+    intolerable = jnp.sum((t_eff == V.EFFECT_PREFER_NO_SCHEDULE) & ~tolerated, axis=-1).astype(jnp.float32)
+    mx = jnp.max(intolerable)
+    return jnp.where(mx > 0, MAX_NODE_SCORE - intolerable * MAX_NODE_SCORE / jnp.maximum(mx, 1.0), MAX_NODE_SCORE)
+
+
+def interpod_score(ec, st, u, feasible):
+    """InterPodAffinity score (interpodaffinity/scoring.go): incoming
+    preferred terms against existing pods + existing pods' symmetric
+    preferred/hard-affinity terms against the incoming pod, min-max
+    normalized over the feasible set (min/max seeded with 0 per k8s)."""
+    # incoming side: pt terms gather dom_sel counts
+    pt_sel = ec.pt_sel[u]  # [Tpp]
+    pt_topo = ec.pt_topo[u]
+    pt_w = ec.pt_w[u]
+    dom = ec.node_domain[:, pt_topo]  # [N, Tpp]
+    cnt = st.dom_sel[dom, jnp.maximum(pt_sel, 0)[None, :]]
+    incoming = jnp.sum(jnp.where(pt_sel[None, :] >= 0, cnt * pt_w[None, :], 0.0), axis=-1)
+
+    # symmetric side: existing pods' terms whose selector matches the pod
+    g_topo = ec.prefg_topo  # [Gp]
+    g_sel = ec.prefg_sel
+    dom_g = ec.node_domain[:, g_topo]  # [N, Gp]
+    w_sum = st.dom_prefw[dom_g, jnp.arange(g_topo.shape[0])[None, :]]  # [N, Gp]
+    matches = ec.matches_sel[u, g_sel].astype(jnp.float32)  # [Gp]
+    symmetric = jnp.sum(w_sum * matches[None, :], axis=-1)
+
+    raw = incoming + symmetric
+    masked = jnp.where(feasible, raw, 0.0)
+    hi = jnp.maximum(jnp.max(masked), 0.0)
+    lo = jnp.minimum(jnp.min(masked), 0.0)
+    rng = hi - lo
+    return jnp.where(rng > 0, MAX_NODE_SCORE * (raw - lo) / jnp.maximum(rng, 1.0), 0.0)
+
+
+def spread_score(ec, st, u, feasible):
+    """PodTopologySpread score (podtopologyspread/scoring.go:175-248):
+    ScheduleAnyway constraints; score_n = Σ_c cnt*log-weight + (maxSkew-1),
+    inverted-normalized so spreading wins."""
+    topo = ec.spr_topo[u]  # [Cs]
+    sel = ec.spr_sel[u]
+    skew = ec.spr_skew[u].astype(jnp.float32)
+    soft = (topo >= 0) & ~ec.spr_hard[u]
+    any_soft = jnp.any(soft)
+
+    D_trash = ec.domain_topo.shape[0] - 1
+    dom = ec.node_domain[:, jnp.maximum(topo, 0)]  # [N, Cs]
+    has_label = dom < D_trash
+    cnt = st.dom_sel[dom, sel[None, :]]  # [N, Cs]
+
+    # per-constraint normalizing weight log(size+2), size = #distinct
+    # domains among feasible, non-ignored nodes
+    ignored = feasible & ~jnp.all(has_label | ~soft[None, :], axis=-1)  # [N]
+    scored = feasible & ~ignored
+    # distinct-domain count per constraint: scatter ones into domain rows
+    Dp1 = ec.domain_topo.shape[0]
+    ones = jnp.zeros((Dp1, topo.shape[0]))
+    ones = ones.at[jnp.where(scored[:, None], dom, D_trash), jnp.arange(topo.shape[0])[None, :]].max(
+        jnp.where(scored[:, None], 1.0, 0.0)
+    )
+    size = jnp.sum(ones[:D_trash], axis=0)  # [Cs]
+    weight = jnp.log(size + 2.0)
+
+    contrib = jnp.where((soft & (ec.spr_topo[u] >= 0))[None, :] & has_label, cnt * weight[None, :] + (skew[None, :] - 1.0), 0.0)
+    raw = jnp.sum(contrib, axis=-1)  # [N]
+
+    big = jnp.float32(1e30)
+    mn = jnp.min(jnp.where(scored, raw, big))
+    mx = jnp.max(jnp.where(scored, raw, -big))
+    norm = jnp.where(
+        mx <= 0, MAX_NODE_SCORE, MAX_NODE_SCORE * (mx + mn - raw) / jnp.maximum(mx, 1.0)
+    )
+    norm = jnp.where(ignored, 0.0, norm)
+    return jnp.where(any_soft, norm, 0.0)
+
+
+def share_score(ec, st, u, feasible):
+    """Simon / Open-Gpu-Share share score (plugin/simon.go:45-74 +
+    algo.Share, pkg/algo/greed.go:70-83): max over node-allocatable
+    resources of req/(allocatable - req), min-max normalized. Static
+    allocatable is used (the fake client's node objects are never
+    decremented), so this is usage-independent — matching the reference."""
+    req = ec.req[u].at[V.RES_PODS].set(0.0)  # 'pods' request is not in PodRequestsAndLimits
+    avail = ec.alloc - req[None, :]
+    share = jnp.where(
+        avail == 0, jnp.where(req[None, :] == 0, 0.0, 1.0), req[None, :] / avail
+    )
+    # only resources the node actually declares participate
+    share = jnp.where(ec.alloc > 0, share, 0.0)
+    raw = jnp.max(share, axis=-1) * MAX_NODE_SCORE
+    # pods with no requests score MaxNodeScore on every node
+    raw = jnp.where(jnp.any(req > 0), raw, MAX_NODE_SCORE)
+    return _minmax_normalize(raw, feasible)
+
+
+class StepResult(NamedTuple):
+    feasible: jnp.ndarray  # [N] bool
+    score: jnp.ndarray  # [N] f32 weighted total
+    chosen: jnp.ndarray  # scalar i32 node index (-1 infeasible)
+    fail_counts: jnp.ndarray  # [NUM_FILTERS] i32 first-fail node counts
+    insufficient: jnp.ndarray  # [R] i32 nodes short of each resource
+
+
+def pod_step(ec, st, u) -> StepResult:
+    """One pod through the full pipeline. Mirrors scheduleOne
+    (vendor/.../scheduler/scheduler.go:441) minus the bind goroutine."""
+    valid = ec.node_valid
+    masks = [
+        node_pin_filter(ec, u),
+        unschedulable_filter(ec, u),
+        taint_filter(ec, u),
+    ]
+    aff_mask = node_affinity_filter(ec, u)
+    masks.append(aff_mask)
+    masks.append(ports_filter(ec, st, u))
+    fit_mask, insufficient = fit_filter(ec, st, u)
+    masks.append(fit_mask)
+    masks.append(spread_filter(ec, st, u, aff_mask & valid))
+    masks.append(interpod_filter(ec, st, u))
+    masks.append(gpu_filter(ec, st, u))
+    masks.append(local_filter(ec, st, u))
+
+    fail_counts = []
+    passed_so_far = valid
+    for i, m in enumerate(masks):
+        fail_counts.append(jnp.sum(passed_so_far & ~m))
+        if i == F_FIT:
+            # per-resource counts attribute only nodes that reached the fit
+            # filter (k8s reports each node under its first failing plugin)
+            insufficient = insufficient & passed_so_far[:, None]
+        passed_so_far = passed_so_far & m
+    feasible = passed_so_far
+
+    # score plugins × weights (registry.go:119-132 + the three sim plugins)
+    score = (
+        1.0 * balanced_allocation_score(ec, st, u)
+        + 1.0 * least_allocated_score(ec, st, u)
+        + 1.0 * node_affinity_score(ec, u)
+        + 1.0 * taint_toleration_score(ec, u)
+        + 1.0 * interpod_score(ec, st, u, feasible)
+        + 2.0 * spread_score(ec, st, u, feasible)
+        + 2.0 * share_score(ec, st, u, feasible)  # Simon + GpuShare (same formula, both weight 1)
+        # ImageLocality: 0 (no images in sim); NodePreferAvoidPods: constant
+    )
+
+    neg = jnp.float32(-1e30)
+    best = jnp.argmax(jnp.where(feasible, score, neg))
+    chosen = jnp.where(jnp.any(feasible), best, -1).astype(jnp.int32)
+    per_res_insufficient = jnp.sum(insufficient & valid[:, None], axis=0).astype(jnp.int32)
+    return StepResult(
+        feasible=feasible,
+        score=score,
+        chosen=chosen,
+        fail_counts=jnp.stack(fail_counts).astype(jnp.int32),
+        insufficient=per_res_insufficient,
+    )
+
+
+def bind_update(ec, st, u, node):
+    """State transition on bind — the tensorized equivalent of the Reserve +
+    Bind plugin chain writing back into the fake clientset
+    (plugin/simon.go:104-126, open-gpu-share.go:147-245, open-local.go:175-254)."""
+    N = ec.node_valid.shape[0]
+    onehot = (jnp.arange(N) == node).astype(jnp.float32)  # [N]
+
+    used = st.used + onehot[:, None] * ec.req[u][None, :]
+
+    ports = ec.ports[u]
+    port_used = st.port_used.at[node, jnp.maximum(ports, 0)].add(
+        jnp.where(ports >= 0, 1.0, 0.0), mode="drop"
+    )
+
+    Tk = ec.node_domain.shape[1]
+    doms = ec.node_domain[node]  # [Tk]
+    dom_sel = st.dom_sel.at[doms, :].add(
+        jnp.broadcast_to(ec.matches_sel[u].astype(jnp.float32)[None, :], (Tk, ec.matches_sel.shape[1]))
+    )
+
+    g_doms = ec.node_domain[node, ec.anti_g_topo]  # [G]
+    dom_anti = st.dom_anti.at[g_doms, jnp.arange(g_doms.shape[0])].add(
+        ec.anti_g[u].astype(jnp.float32)
+    )
+
+    p_doms = ec.node_domain[node, ec.prefg_topo]  # [Gp]
+    dom_prefw = st.dom_prefw.at[p_doms, jnp.arange(p_doms.shape[0])].add(ec.prefg_w[u])
+
+    # gpu-share: greedy chunk packing (tightest-fit for 1 GPU is a packing
+    # refinement the feasibility outcome doesn't depend on; we use the
+    # documented greedy-with-reuse which matches multi-GPU AllocateGpuId)
+    mem = ec.gpu_mem[u]
+    cnt = ec.gpu_count[u].astype(jnp.float32)
+    free = st.gpu_free[node]  # [Gd]
+    chunks = jnp.floor_divide(free, jnp.maximum(mem, 1.0))
+    cum = jnp.cumsum(chunks)
+    take = jnp.clip(cnt - (cum - chunks), 0.0, chunks)
+    new_free = jnp.where(mem > 0, free - take * mem, free)
+    gpu_free = st.gpu_free.at[node].set(new_free)
+
+    # open-local LVM: allocate from the VG with most free space
+    lvm = ec.lvm_req[u]
+    vg_free_n = st.vg_free[node]
+    best_vg = jnp.argmax(vg_free_n)
+    vg_free = st.vg_free.at[node, best_vg].add(jnp.where(lvm > 0, -lvm, 0.0))
+
+    # open-local exclusive devices: first-fit by index per media type
+    dev_free_n = st.dev_free[node]  # [Dv]
+    for media in (0, 1):
+        size = ec.dev_req[u, media]
+        need = ec.dev_req_count[u, media].astype(jnp.float32)
+        fitting = (ec.node_dev_media[node] == media) & (dev_free_n >= size) & (dev_free_n > 0)
+        fit_f = fitting.astype(jnp.float32)
+        cum_f = jnp.cumsum(fit_f)
+        take_d = jnp.where((cum_f <= need) & fitting & (size > 0), 1.0, 0.0)
+        dev_free_n = jnp.where(take_d > 0, 0.0, dev_free_n)
+    dev_free = st.dev_free.at[node].set(dev_free_n)
+
+    return st._replace(
+        used=used,
+        port_used=port_used,
+        dom_sel=dom_sel,
+        dom_anti=dom_anti,
+        dom_prefw=dom_prefw,
+        gpu_free=gpu_free,
+        vg_free=vg_free,
+        dev_free=dev_free,
+    )

@@ -1,0 +1,339 @@
+"""Integration test mirroring the reference's ``pkg/simulator/core_test.go``:
+build a 4-node cluster + base pods + cluster workloads from fixtures, run an
+app with every workload kind through the real ``simulate()``, and assert
+structurally (pod counts per workload, zero unschedulable) — never exact
+placement, which is tie-break dependent (core_test.go:364-591 checkResult)."""
+
+from collections import Counter
+
+from opensim_tpu.engine.simulator import AppResource, simulate
+from opensim_tpu.models import ANNO_WORKLOAD_KIND, ANNO_WORKLOAD_NAME, ResourceTypes
+from opensim_tpu.models import fixtures as fx
+from opensim_tpu.models import selectors
+from opensim_tpu.models.expand import _daemon_pod_for_node
+
+
+MASTER_LABELS = {
+    "beta.kubernetes.io/arch": "amd64",
+    "beta.kubernetes.io/os": "linux",
+    "kubernetes.io/os": "linux",
+    "node-role.kubernetes.io/master": "",
+}
+WORKER_LABELS = {
+    "beta.kubernetes.io/os": "linux",
+    "kubernetes.io/os": "linux",
+    "node-role.kubernetes.io/worker": "",
+}
+
+
+def build_cluster() -> ResourceTypes:
+    rt = ResourceTypes()
+    rt.nodes.append(
+        fx.make_fake_node(
+            "master-1",
+            "8",
+            "16Gi",
+            "110",
+            fx.with_labels(MASTER_LABELS),
+            fx.with_taints([{"key": "node-role.kubernetes.io/master", "effect": "NoSchedule"}]),
+            fx.with_node_local_storage(
+                vgs=[
+                    {"name": "yoda-pool0", "capacity": 107374182400},
+                    {"name": "yoda-pool1", "capacity": 107374182400},
+                ],
+                devices=[{"device": "/dev/vdd", "capacity": 107374182400, "mediaType": "hdd"}],
+            ),
+        )
+    )
+    rt.nodes.append(fx.make_fake_node("master-2", "8", "16Gi", "110", fx.with_labels(MASTER_LABELS)))
+    rt.nodes.append(fx.make_fake_node("master-3", "8", "16Gi", "110", fx.with_labels(MASTER_LABELS)))
+    rt.nodes.append(
+        fx.make_fake_node(
+            "worker-1",
+            "8",
+            "16Gi",
+            "110",
+            fx.with_labels(WORKER_LABELS),
+            fx.with_node_local_storage(
+                vgs=[{"name": "yoda-pool0", "capacity": 107374182400}],
+                devices=[{"device": "/dev/vdd", "capacity": 107374182400, "mediaType": "hdd"}],
+            ),
+        )
+    )
+    # base pods pinned to master-1 (pre-bound — bypass scheduling but consume
+    # resources, core_test.go:138-152)
+    for name, cpu in [
+        ("etcd-master-1", "100m"),
+        ("kube-apiserver-master-1", "250m"),
+        ("kube-controller-manager-master-1", "200m"),
+        ("kube-scheduler-master-1", "100m"),
+    ]:
+        rt.pods.append(
+            fx.make_fake_pod(name, cpu, "100Mi", fx.with_namespace("kube-system"), fx.with_node_name("master-1"))
+        )
+    # metrics-server: node affinity to masters + zone anti-affinity (the zone
+    # label doesn't exist → anti-affinity is vacuous, k8s semantics)
+    rt.deployments.append(
+        fx.make_fake_deployment(
+            "metrics-server",
+            1,
+            "1",
+            "500Mi",
+            fx.with_namespace("kube-system"),
+            fx.with_pod_labels({"k8s-app": "metrics-server"}),
+            fx.with_affinity(
+                {
+                    "nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": [
+                                {"matchExpressions": [{"key": "node-role.kubernetes.io/master", "operator": "Exists"}]}
+                            ]
+                        }
+                    },
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "labelSelector": {"matchLabels": {"k8s-app": "metrics-server"}},
+                                "topologyKey": "failure-domain.beta.kubernetes.io/zone",
+                            }
+                        ]
+                    },
+                }
+            ),
+            fx.with_tolerations([{"key": "node-role.kubernetes.io/master", "operator": "Exists", "effect": "NoSchedule"}]),
+        )
+    )
+    rt.daemon_sets.append(
+        fx.make_fake_daemon_set(
+            "kube-proxy-master",
+            "100m",
+            "64Mi",
+            fx.with_namespace("kube-system"),
+            fx.with_tolerations([{"operator": "Exists"}]),
+            fx.with_node_selector({"node-role.kubernetes.io/master": ""}),
+        )
+    )
+    rt.daemon_sets.append(
+        fx.make_fake_daemon_set(
+            "kube-proxy-worker",
+            "100m",
+            "64Mi",
+            fx.with_namespace("kube-system"),
+            fx.with_tolerations([{"operator": "Exists"}]),
+            fx.with_node_selector({"node-role.kubernetes.io/worker": ""}),
+        )
+    )
+    rt.daemon_sets.append(
+        fx.make_fake_daemon_set(
+            "coredns",
+            "100m",
+            "70Mi",
+            fx.with_namespace("kube-system"),
+            fx.with_affinity(
+                {
+                    "nodeAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": {
+                            "nodeSelectorTerms": [
+                                {"matchExpressions": [{"key": "node-role.kubernetes.io/master", "operator": "Exists"}]}
+                            ]
+                        }
+                    }
+                }
+            ),
+            fx.with_tolerations([{"key": "node-role.kubernetes.io/master", "effect": "NoSchedule"}]),
+            fx.with_node_selector({"beta.kubernetes.io/os": "linux"}),
+        )
+    )
+    return rt
+
+
+def build_app() -> ResourceTypes:
+    rt = ResourceTypes()
+    rt.deployments.append(
+        fx.make_fake_deployment(
+            "app-deploy",
+            4,
+            "1",
+            "1Gi",
+            fx.with_tolerations([{"key": "node-role.kubernetes.io/master", "operator": "Exists", "effect": "NoSchedule"}]),
+        )
+    )
+    rt.daemon_sets.append(
+        fx.make_fake_daemon_set("app-agent", "100m", "128Mi", fx.with_tolerations([{"operator": "Exists"}]))
+    )
+    rt.jobs.append(fx.make_fake_job("app-job", 2, "500m", "256Mi"))
+    rt.pods.append(fx.make_fake_pod("app-pod", "100m", "128Mi"))
+    sts = fx.make_fake_stateful_set(
+        "app-db",
+        2,
+        "1",
+        "2Gi",
+        fx.with_affinity(
+            {
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {
+                            "labelSelector": {"matchLabels": {"app": "app-db"}},
+                            "topologyKey": "kubernetes.io/hostname",
+                        }
+                    ]
+                }
+            }
+        ),
+    )
+    rt.stateful_sets.append(sts)
+    rt.replica_sets.append(fx.make_fake_replica_set("app-rs", 2, "200m", "256Mi"))
+    return rt
+
+
+def test_simulate_end_to_end():
+    cluster = build_cluster()
+    app = build_app()
+    res = simulate(cluster, [AppResource("simple", app)])
+
+    reasons = [(u.pod.metadata.name, u.reason) for u in res.unscheduled_pods]
+    assert not reasons, f"unexpected unschedulable pods: {reasons}"
+
+    all_pods = [p for ns in res.node_status for p in ns.pods]
+    by_workload = Counter(
+        (p.metadata.annotations.get(ANNO_WORKLOAD_KIND, "bare"), p.metadata.annotations.get(ANNO_WORKLOAD_NAME, p.metadata.name))
+        for p in all_pods
+    )
+    # daemonset expectations recomputed via node_should_run_pod, mirroring
+    # checkResult (core_test.go:472-479)
+    for ds in cluster.daemon_sets + app.daemon_sets:
+        expected = sum(
+            1
+            for node in cluster.nodes
+            if selectors.node_should_run_pod(node, _daemon_pod_for_node(ds, node.metadata.name))
+        )
+        assert by_workload[("DaemonSet", ds.metadata.name)] == expected, ds.metadata.name
+
+    # deployment pods are attributed through their generated ReplicaSet name
+    # (checkResult, core_test.go:519-577)
+    def count_prefix(kind: str, prefix: str) -> int:
+        return sum(c for (k, n), c in by_workload.items() if k == kind and n.startswith(prefix))
+
+    assert count_prefix("ReplicaSet", "metrics-server-") == 1
+    assert count_prefix("ReplicaSet", "app-deploy-") == 4
+    assert by_workload[("Job", "app-job")] == 2
+    assert by_workload[("StatefulSet", "app-db")] == 2
+    assert by_workload[("ReplicaSet", "app-rs")] == 2
+    assert by_workload[("bare", "app-pod")] == 1
+
+    # metrics-server must land on a master (node affinity)
+    ms_pod = [
+        p
+        for p in all_pods
+        if (p.metadata.annotations.get(ANNO_WORKLOAD_NAME) or "").startswith("metrics-server-")
+    ][0]
+    assert ms_pod.spec.node_name.startswith("master")
+
+    # anti-affinity: the two db pods are on distinct nodes
+    db_nodes = {p.spec.node_name for p in all_pods if p.metadata.annotations.get(ANNO_WORKLOAD_NAME) == "app-db"}
+    assert len(db_nodes) == 2
+
+    # pre-bound pods stayed on master-1 and consumed its resources
+    m1 = res.pods_on("master-1")
+    assert any(p.metadata.name == "etcd-master-1" for p in m1)
+
+
+def test_unschedulable_reports_reason():
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n1", "2", "4Gi"))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("fat-pod", "16", "1Gi"))
+    app.pods.append(fx.make_fake_pod("picky-pod", "100m", "128Mi", fx.with_node_selector({"disk": "ssd"})))
+    res = simulate(cluster, [AppResource("a", app)])
+    assert len(res.unscheduled_pods) == 2
+    reasons = {u.pod.metadata.name: u.reason for u in res.unscheduled_pods}
+    assert "Insufficient cpu" in reasons["fat-pod"]
+    assert "node affinity" in reasons["picky-pod"]
+    assert reasons["fat-pod"].startswith("0/1 nodes are available")
+
+
+def test_taints_block_and_tolerations_admit():
+    cluster = ResourceTypes()
+    cluster.nodes.append(
+        fx.make_fake_node("tainted", "8", "16Gi", "110", fx.with_taints([{"key": "dedicated", "value": "gpu", "effect": "NoSchedule"}]))
+    )
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("no-tol", "100m", "128Mi"))
+    app.pods.append(
+        fx.make_fake_pod("with-tol", "100m", "128Mi", fx.with_tolerations([{"key": "dedicated", "operator": "Equal", "value": "gpu", "effect": "NoSchedule"}]))
+    )
+    res = simulate(cluster, [AppResource("a", app)])
+    names = {u.pod.metadata.name for u in res.unscheduled_pods}
+    assert names == {"no-tol"}
+    assert "taint" in res.unscheduled_pods[0].reason
+
+
+def test_host_port_conflict():
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n1", "8", "16Gi"))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("p1", "100m", "128Mi", fx.with_host_ports([8080])))
+    app.pods.append(fx.make_fake_pod("p2", "100m", "128Mi", fx.with_host_ports([8080])))
+    res = simulate(cluster, [AppResource("a", app)])
+    assert len(res.unscheduled_pods) == 1
+    assert "free ports" in res.unscheduled_pods[0].reason
+
+
+def test_topology_spread_hard_constraint():
+    cluster = ResourceTypes()
+    for i in range(2):
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+    app = ResourceTypes()
+    deploy = fx.make_fake_deployment(
+        "spread",
+        3,
+        "100m",
+        "128Mi",
+        fx.with_topology_spread(
+            [
+                {
+                    "maxSkew": 1,
+                    "topologyKey": "kubernetes.io/hostname",
+                    "whenUnsatisfiable": "DoNotSchedule",
+                    "labelSelector": {"matchLabels": {"app": "spread"}},
+                }
+            ]
+        ),
+    )
+    app.deployments.append(deploy)
+    res = simulate(cluster, [AppResource("a", app)])
+    # 3 pods over 2 nodes with maxSkew 1 → 2+1 placement, all feasible
+    assert not res.unscheduled_pods
+    per_node = sorted(len(ns.pods) for ns in res.node_status)
+    assert per_node == [1, 2]
+
+
+def test_pod_affinity_colocates():
+    cluster = ResourceTypes()
+    for i in range(3):
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("anchor", "100m", "128Mi", fx.with_labels({"role": "anchor"})))
+    app.pods.append(
+        fx.make_fake_pod(
+            "follower",
+            "100m",
+            "128Mi",
+            fx.with_affinity(
+                {
+                    "podAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {"labelSelector": {"matchLabels": {"role": "anchor"}}, "topologyKey": "kubernetes.io/hostname"}
+                        ]
+                    }
+                }
+            ),
+        )
+    )
+    res = simulate(cluster, [AppResource("a", app)])
+    assert not res.unscheduled_pods
+    nodes = {}
+    for ns in res.node_status:
+        for p in ns.pods:
+            nodes[p.metadata.name] = ns.node.metadata.name
+    assert nodes["anchor"] == nodes["follower"]

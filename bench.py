@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Headline benchmark: the 50k-pod / 5k-node capacity plan.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+`value` = wall-clock seconds for the full plan (workload expansion →
+encoding → 50k-step scheduling scan → decode), measured on the available
+accelerator. `vs_baseline` = the <10 s target from BASELINE.md divided by
+the measured time (>1 means the target is beaten). The reference publishes
+no numbers (SURVEY.md §6), so the driver-set target is the yardstick.
+
+Usage: python bench.py [--pods N] [--nodes N] [--profile small|full]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/opensim-jit-cache")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from opensim_tpu.engine.simulator import AppResource, simulate  # noqa: E402
+from opensim_tpu.models import ResourceTypes, fixtures as fx  # noqa: E402
+
+
+def synthetic_cluster(n_nodes: int) -> ResourceTypes:
+    rt = ResourceTypes()
+    zones = [f"zone-{z}" for z in range(4)]
+    for i in range(n_nodes):
+        rt.nodes.append(
+            fx.make_fake_node(
+                f"node-{i:05d}",
+                "64",
+                "256Gi",
+                "256",
+                fx.with_labels(
+                    {
+                        "topology.kubernetes.io/zone": zones[i % len(zones)],
+                        "node-role.kubernetes.io/worker": "",
+                        "disk": "ssd" if i % 3 else "hdd",
+                    }
+                ),
+            )
+        )
+    return rt
+
+
+def synthetic_apps(n_pods: int) -> ResourceTypes:
+    """~20 workload templates covering the kernel surface: resource fit,
+    tolerations, node selectors, spread, anti-affinity."""
+    rt = ResourceTypes()
+    n_workloads = 20
+    per = n_pods // n_workloads
+    for w in range(n_workloads):
+        opts = []
+        if w % 4 == 0:
+            opts.append(fx.with_node_selector({"disk": "ssd"}))
+        if w % 5 == 0:
+            opts.append(
+                fx.with_topology_spread(
+                    [
+                        {
+                            "maxSkew": 5,
+                            "topologyKey": "topology.kubernetes.io/zone",
+                            "whenUnsatisfiable": "ScheduleAnyway",
+                            "labelSelector": {"matchLabels": {"app": f"bench-{w}"}},
+                        }
+                    ]
+                )
+            )
+        rt.deployments.append(
+            fx.make_fake_deployment(
+                f"bench-{w}", per, f"{100 + 20 * (w % 8)}m", f"{256 + 64 * (w % 6)}Mi", *opts
+            )
+        )
+    return rt
+
+
+def bench_defrag(n_scenarios: int, n_nodes: int, n_pods: int, warmup: bool) -> int:
+    """BASELINE.md config 5: parallel what-if node-drain scenarios.
+    Metric: scenarios/sec/chip."""
+    from opensim_tpu.planner.defrag import plan_drains
+
+    cluster = synthetic_cluster(n_nodes)
+    apps = [AppResource("bench", synthetic_apps(n_pods))]
+    candidates = [n.metadata.name for n in cluster.nodes[:n_scenarios]]
+    if warmup:
+        plan_drains(cluster, apps, candidates=candidates[:8])
+    t0 = time.time()
+    result = plan_drains(cluster, apps, candidates=candidates)
+    dt = time.time() - t0
+    print(
+        json.dumps(
+            {
+                "metric": f"defrag sweep ({len(candidates)} drain scenarios, {n_pods} pods/{n_nodes} nodes)",
+                "value": round(len(candidates) / dt, 2),
+                "unit": "scenarios/s/chip",
+                "vs_baseline": round(len(candidates) / dt, 2),  # no reference number exists
+                "drainable": len(result.drainable()),
+                "wall_s": round(dt, 2),
+            }
+        )
+    )
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pods", type=int, default=50000)
+    ap.add_argument("--nodes", type=int, default=5000)
+    ap.add_argument("--warmup", action="store_true", help="run once first to populate the jit cache")
+    ap.add_argument(
+        "--config",
+        default="plan",
+        choices=["plan", "defrag"],
+        help="plan = capacity-plan wall-clock (headline); defrag = drain-scenario sweep",
+    )
+    ap.add_argument("--scenarios", type=int, default=1000, help="defrag: number of drain scenarios")
+    args = ap.parse_args()
+
+    if args.config == "defrag":
+        return bench_defrag(args.scenarios, args.nodes, args.pods, args.warmup)
+
+    cluster = synthetic_cluster(args.nodes)
+    apps = [AppResource("bench", synthetic_apps(args.pods))]
+
+    if args.warmup:
+        simulate(cluster, apps, node_pad=128)
+
+    t0 = time.time()
+    result = simulate(cluster, apps, node_pad=128)
+    dt = time.time() - t0
+
+    scheduled = sum(len(ns.pods) for ns in result.node_status)
+    target_s = 10.0
+    print(
+        json.dumps(
+            {
+                "metric": f"{args.pods // 1000}k-pod/{args.nodes // 1000}k-node capacity plan wall-clock",
+                "value": round(dt, 3),
+                "unit": "s",
+                "vs_baseline": round(target_s / dt, 2) if dt > 0 else 0.0,
+                "scheduled": scheduled,
+                "unscheduled": len(result.unscheduled_pods),
+                "pods_per_sec": round((scheduled + len(result.unscheduled_pods)) / dt, 1),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

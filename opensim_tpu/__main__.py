@@ -1,0 +1,5 @@
+from .cli.main import main
+
+import sys
+
+sys.exit(main())

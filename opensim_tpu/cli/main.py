@@ -1,0 +1,115 @@
+"""simon CLI — parity with ``cmd/simon/simon.go``: ``simon {apply, server,
+version, gen-doc}`` with the same flags (``cmd/apply/apply.go:27-36``,
+``cmd/server/options.go:14``). Log level comes from the ``LogLevel`` env
+(``cmd/simon/simon.go:46-66``)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+from typing import List, Optional
+
+VERSION = "0.1.0"
+COMMIT_ID = os.environ.get("SIMON_COMMIT_ID", "unknown")
+
+LOG_LEVELS = {
+    "panic": logging.CRITICAL,
+    "fatal": logging.CRITICAL,
+    "error": logging.ERROR,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "info": logging.INFO,
+    "debug": logging.DEBUG,
+    "trace": logging.DEBUG,
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="simon",
+        description="Simon: a TPU-native cluster simulator for capacity planning",
+    )
+    sub = parser.add_subparsers(dest="command")
+
+    apply_p = sub.add_parser("apply", help="run a capacity-planning simulation")
+    apply_p.add_argument("-f", "--simon-config", required=True, help="path of simon config (Config CR yaml)")
+    apply_p.add_argument(
+        "-d", "--default-scheduler-config", default="", help="path of kube-scheduler config overrides"
+    )
+    apply_p.add_argument("-o", "--output-file", default="", help="redirect the report to a file")
+    apply_p.add_argument("--use-greed", action="store_true", help="use greed algorithm to sort pods")
+    apply_p.add_argument("-i", "--interactive", action="store_true", help="interactive add-node mode")
+    apply_p.add_argument(
+        "-e",
+        "--extended-resources",
+        default="",
+        help="comma-separated extended resource reports (gpu,open-local)",
+    )
+    apply_p.add_argument("--max-new-nodes", type=int, default=128, help="upper bound for the node sweep")
+
+    server_p = sub.add_parser("server", help="start the simon REST server")
+    server_p.add_argument("--kubeconfig", default="", help="kubeconfig of the real cluster")
+    server_p.add_argument("--master", default="", help="apiserver address override")
+    server_p.add_argument("--port", type=int, default=8080, help="listen port")
+
+    sub.add_parser("version", help="print version")
+
+    doc_p = sub.add_parser("gen-doc", help="generate markdown docs for the CLI")
+    doc_p.add_argument("--output-dir", default="docs/commandline", help="where to write the docs")
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    level = LOG_LEVELS.get(os.environ.get("LogLevel", "info").lower(), logging.INFO)
+    logging.basicConfig(level=level, format="%(levelname)s %(message)s")
+
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.command == "version":
+        print(f"simon version: {VERSION}, commit: {COMMIT_ID}")
+        return 0
+    if args.command == "apply":
+        from ..planner.apply import Applier, Options
+
+        opts = Options(
+            simon_config=args.simon_config,
+            default_scheduler_config=args.default_scheduler_config,
+            output_file=args.output_file,
+            use_greed=args.use_greed,
+            interactive=args.interactive,
+            extended_resources=[r for r in args.extended_resources.split(",") if r],
+            max_new_nodes=args.max_new_nodes,
+        )
+        try:
+            return Applier(opts).run()
+        except (OSError, ValueError) as e:
+            print(f"simon apply: {e}", file=sys.stderr)
+            return 1
+    if args.command == "server":
+        from ..server.rest import serve
+
+        return serve(kubeconfig=args.kubeconfig, master=args.master, port=args.port)
+    if args.command == "gen-doc":
+        return gen_doc(parser, args.output_dir)
+    parser.print_help()
+    return 2
+
+
+def gen_doc(parser: argparse.ArgumentParser, output_dir: str) -> int:
+    """Markdown CLI docs (cmd/doc/generate_markdown.go)."""
+    os.makedirs(output_dir, exist_ok=True)
+    sub_actions = [a for a in parser._actions if isinstance(a, argparse._SubParsersAction)]
+    with open(os.path.join(output_dir, "simon.md"), "w") as f:
+        f.write(f"# simon\n\n{parser.description}\n\n## Commands\n\n")
+        for action in sub_actions:
+            for name, sp in action.choices.items():
+                f.write(f"### simon {name}\n\n{sp.description or sp.prog}\n\n```\n{sp.format_help()}```\n\n")
+    print(f"docs written to {output_dir}/simon.md")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -128,6 +128,11 @@ class ClusterMeta:
     node_gpu_count: Optional[np.ndarray] = None  # [N] i32
     node_vg_names: List[List[str]] = field(default_factory=list)
     node_dev_names: List[List[str]] = field(default_factory=list)
+    # original capacities (host copies) for usage reports
+    node_gpu_mem: Optional[np.ndarray] = None  # [N, Gd] f32
+    node_vg_cap: Optional[np.ndarray] = None  # [N, Vg] f32
+    node_dev_cap: Optional[np.ndarray] = None  # [N, Dv] f32
+    node_dev_media: Optional[np.ndarray] = None  # [N, Dv] i32
 
 
 def _pad_to(n: int, mult: int) -> int:
@@ -181,8 +186,8 @@ class ClusterEncoder:
             for r in n.allocatable:
                 self.vocab.resource_id(r)
 
-    def add_pod(self, pod: Pod, owner_selector: Optional[dict] = None) -> int:
-        tid = self.ts.add_pod(pod, owner_selector)
+    def add_pod(self, pod: Pod, owner_selector: Optional[dict] = None, hint: Optional[tuple] = None) -> int:
+        tid = self.ts.add_pod(pod, owner_selector, hint=hint)
         self.pod_tmpl.append(tid)
         return tid
 
@@ -338,22 +343,6 @@ class ClusterEncoder:
                 if kid < K:
                     label_val[i, kid] = vid
                     label_num[i, kid] = num
-
-        # K may have grown during template interning; rebuild label arrays at
-        # final K if needed.
-        if vb.n_label_keys > K:
-            K2 = vb.n_label_keys
-            lv = np.full((N, K2), -1, dtype=np.int32)
-            ln = np.full((N, K2), _NAN, dtype=np.float32)
-            lv[:, :K] = label_val
-            ln[:, :K] = label_num
-            for i, n in enumerate(self.nodes):
-                for kid, (vid, num) in encode_labels(
-                    vb, n.metadata.labels, {"metadata.name": n.metadata.name}
-                ).items():
-                    lv[i, kid] = vid
-                    ln[i, kid] = num
-            label_val, label_num, K = lv, ln, K2
 
         # ---- topology domains
         domain_ids: Dict[Tuple[int, int], int] = {}
@@ -580,5 +569,9 @@ class ClusterEncoder:
             node_gpu_count=node_gpu_count,
             node_vg_names=vg_names,
             node_dev_names=dev_names,
+            node_gpu_mem=node_gpu_mem.copy(),
+            node_vg_cap=node_vg_cap.copy(),
+            node_dev_cap=node_dev_cap.copy(),
+            node_dev_media=node_dev_media.copy(),
         )
         return cluster, state0, meta

@@ -115,6 +115,7 @@ class TemplateSet:
     def __init__(self) -> None:
         self.templates: List[SchedTemplate] = []
         self._index: Dict[str, int] = {}
+        self._hint_index: Dict[tuple, int] = {}
         self.selectors: List[Optional[tuple]] = []
         self._sel_index: Dict[Optional[tuple], int] = {}
 
@@ -127,8 +128,19 @@ class TemplateSet:
             self.selectors.append(canon)
         return idx
 
-    def add_pod(self, pod: Pod, owner_selector: Optional[dict] = None) -> int:
-        """Returns the template id for this pod (creating it if new)."""
+    def add_pod(self, pod: Pod, owner_selector: Optional[dict] = None, hint: Optional[tuple] = None) -> int:
+        """Returns the template id for this pod (creating it if new).
+
+        `hint` is an optional cheap identity key (e.g. the owning workload):
+        pods expanded from one workload share an identical scheduling spec,
+        so the full canonical-extraction path runs once per workload instead
+        of once per pod — the host-side analogue of the chunked pod
+        validation the reference needed for >3k-node scale
+        (pkg/simulator/utils.go:77)."""
+        if hint is not None:
+            idx = self._hint_index.get(hint)
+            if idx is not None:
+                return idx
         tmpl = self._extract(pod, owner_selector)
         key = self._canon_key(tmpl)
         idx = self._index.get(key)
@@ -136,6 +148,8 @@ class TemplateSet:
             idx = len(self.templates)
             self._index[key] = idx
             self.templates.append(tmpl)
+        if hint is not None:
+            self._hint_index[hint] = idx
         return idx
 
     # -- extraction ---------------------------------------------------------

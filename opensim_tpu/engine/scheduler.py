@@ -27,14 +27,16 @@ from ..ops import kernels
 
 class ScheduleOutput(NamedTuple):
     chosen: jnp.ndarray  # [P] i32 node index, -1 unscheduled
-    fail_counts: jnp.ndarray  # [P, NUM_FILTERS] i32
+    fail_counts: jnp.ndarray  # [P, 6] i32 — dynamic filters (ports..local)
     insufficient: jnp.ndarray  # [P, R] i32 nodes short per resource
+    gpu_take: jnp.ndarray  # [P, Gd] f32 GPU slots packed per device
+    static_fail: jnp.ndarray  # [U, 4] i32 — static filters (pin/unsched/taint/affinity)
     final_state: ScanState
 
 
-def _step(ec: EncodedCluster, st: ScanState, x):
+def _step(ec: EncodedCluster, stat, feat, st: ScanState, x):
     u, pod_valid, forced = x
-    res = kernels.pod_step(ec, st, u)
+    res = kernels.pod_step(ec, stat, st, u, feat)
     # Pre-bound pods (spec.nodeName set) bypass the scheduler in the
     # reference (simulator.go:329-331 only waits for unbound pods): they
     # always land on their node and still consume its resources.
@@ -42,26 +44,57 @@ def _step(ec: EncodedCluster, st: ScanState, x):
     chosen = jnp.where(forced, jnp.where(pin >= 0, pin, -1), res.chosen)
     do_bind = pod_valid & (chosen >= 0)
     node = jnp.maximum(chosen, 0)
-    st_bound = kernels.bind_update(ec, st, u, node)
-    st_next = jax.tree_util.tree_map(
-        lambda a, b: jnp.where(do_bind, b, a), st, st_bound
-    )
+    st_next, gpu_take = kernels.bind_update(ec, st, u, node, do_bind, feat)
     chosen = jnp.where(do_bind, chosen, -1)
-    return st_next, (chosen, res.fail_counts, res.insufficient)
+    return st_next, (chosen, res.fail_counts, res.insufficient, gpu_take)
 
 
-@functools.partial(jax.jit, static_argnames=("unroll",))
-def schedule_pods(ec: EncodedCluster, st0: ScanState, tmpl_ids, pod_valid, forced, unroll: int = 1):
-    """Run the bind scan. tmpl_ids [P] i32, pod_valid/forced [P] bool."""
-    step = functools.partial(_step, ec)
-    final_state, (chosen, fail_counts, insufficient) = jax.lax.scan(
+@functools.partial(jax.jit, static_argnames=("features", "unroll"))
+def schedule_pods(
+    ec: EncodedCluster,
+    st0: ScanState,
+    tmpl_ids,
+    pod_valid,
+    forced,
+    features: kernels.Features = kernels.ALL_FEATURES,
+    unroll: int = 1,
+):
+    """Run the bind scan. tmpl_ids [P] i32, pod_valid/forced [P] bool.
+
+    Static per-(template, node) filter/score tables are computed once up
+    front; the scan body only evaluates usage-dependent kernels the
+    workload's `features` actually exercise."""
+    stat = kernels.precompute_static(ec)
+    step = functools.partial(_step, ec, stat, features)
+    final_state, (chosen, fail_counts, insufficient, gpu_take) = jax.lax.scan(
         step, st0, (tmpl_ids, pod_valid, forced), unroll=unroll
     )
     return ScheduleOutput(
         chosen=chosen,
         fail_counts=fail_counts,
         insufficient=insufficient,
+        gpu_take=gpu_take,
+        static_fail=stat.static_fail,
         final_state=final_state,
+    )
+
+
+def pad_pod_stream(tmpl_ids, pod_valid, forced, bucket: int = 256):
+    """Pad the pod stream to a bucket multiple so scan lengths (and thus jit
+    signatures) repeat across runs — SURVEY.md §7 'pad P and N to buckets to
+    avoid per-run jit recompiles'. Padded steps have pod_valid=False and
+    never bind."""
+    import numpy as np
+
+    P = len(tmpl_ids)
+    target = max(bucket, bucket * ((P + bucket - 1) // bucket))
+    pad = target - P
+    if pad == 0:
+        return tmpl_ids, pod_valid, forced
+    return (
+        np.concatenate([tmpl_ids, np.zeros(pad, dtype=tmpl_ids.dtype)]),
+        np.concatenate([pod_valid, np.zeros(pad, dtype=bool)]),
+        np.concatenate([forced, np.zeros(pad, dtype=bool)]),
     )
 
 

@@ -10,6 +10,8 @@ one encoded tensor state and one jitted scan.
 
 from __future__ import annotations
 
+import copy
+import json
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -18,15 +20,19 @@ import numpy as np
 from ..encoding.state import ClusterEncoder, ClusterMeta
 from ..models import expand
 from ..models.objects import (
+    ANNO_GPU_INDEX,
+    ANNO_NODE_GPU_SHARE,
+    ANNO_NODE_LOCAL_STORAGE,
     ANNO_WORKLOAD_KIND,
     LABEL_APP_NAME,
+    LABEL_GPU_CARD_MODEL,
     Node,
     Pod,
     ResourceTypes,
 )
 from ..ops import kernels
 from . import queues
-from .scheduler import schedule_pods, to_device
+from .scheduler import pad_pod_stream, schedule_pods, to_device
 
 
 @dataclass
@@ -67,6 +73,22 @@ class SimulateResult:
         return []
 
 
+def _tmpl_hint(pod: Pod) -> Optional[tuple]:
+    """Cheap template-identity key for workload-owned pods: all pods of one
+    workload expansion share a scheduling spec. DaemonSet pods embed their
+    pinned node (each targets a different one); bare pods get no hint and
+    take the full canonical path."""
+    kind = pod.metadata.annotations.get(ANNO_WORKLOAD_KIND)
+    name = pod.metadata.annotations.get("simon/workload-name")
+    if not kind or not name:
+        return None
+    # the owning object's uid disambiguates same-named workloads coming from
+    # different sources (cluster snapshot vs apps, or two apps)
+    owner_uid = pod.metadata.owner_references[0].uid if pod.metadata.owner_references else ""
+    pin = pinned_node_name(pod) if kind == "DaemonSet" else ""
+    return (pod.metadata.namespace, kind, name, owner_uid, pod.spec.node_name, pin)
+
+
 def _owner_selector(pod: Pod) -> Optional[dict]:
     """Selector used for system-default topology spreading: the owning
     workload's pods share identical labels, so matching on the pod's own
@@ -102,13 +124,20 @@ def _cluster_pods(cluster: ResourceTypes) -> List[Pod]:
 
 
 def _reason_string(
-    fail_counts: np.ndarray, insufficient: np.ndarray, meta: ClusterMeta, n_nodes: int
+    static_fail: np.ndarray,
+    fail_counts: np.ndarray,
+    insufficient: np.ndarray,
+    meta: ClusterMeta,
+    n_nodes: int,
 ) -> str:
     """Reconstruct the kube-scheduler FitError message format the reference
-    surfaces (e.g. '0/4 nodes are available: 3 node(s) had taints...')."""
+    surfaces (e.g. '0/4 nodes are available: 3 node(s) had taints...').
+    static_fail covers the 4 template-static filters, fail_counts the 6
+    usage-dependent ones."""
     parts: List[Tuple[int, str]] = []
+    merged = list(static_fail) + list(fail_counts)
     for k in range(kernels.NUM_FILTERS):
-        cnt = int(fail_counts[k])
+        cnt = int(merged[k])
         if cnt <= 0:
             continue
         if k == kernels.F_FIT:
@@ -124,13 +153,43 @@ def _reason_string(
     return f"0/{n_nodes} nodes are available: {body}."
 
 
-def simulate(
+@dataclass
+class Prepared:
+    """Expanded + encoded simulation inputs, shared by the single-run path
+    and the planner's scenario sweeps."""
+
+    ec: object
+    st0: object
+    meta: ClusterMeta
+    ordered: List[Pod]
+    tmpl_ids: np.ndarray
+    forced: np.ndarray
+    ds_target: List[int]  # node index a DaemonSet pod is pinned to, -1 otherwise
+    features: kernels.Features = kernels.ALL_FEATURES
+
+
+def pinned_node_name(pod: Pod) -> str:
+    """Target node of a DaemonSet pod pinned via matchFields metadata.name
+    (SetDaemonSetPodNodeNameByNodeAffinity semantics)."""
+    aff = (pod.spec.affinity or {}).get("nodeAffinity") or {}
+    required = aff.get("requiredDuringSchedulingIgnoredDuringExecution") or {}
+    for term in required.get("nodeSelectorTerms") or []:
+        for f in term.get("matchFields") or []:
+            if f.get("key") == "metadata.name" and f.get("operator") == "In":
+                vals = f.get("values") or []
+                if len(vals) == 1:
+                    return str(vals[0])
+    return ""
+
+
+def prepare(
     cluster: ResourceTypes,
     apps: List[AppResource],
     use_greed: bool = False,
     node_pad: int = 8,
-) -> SimulateResult:
-    """One full simulation: cluster pods then apps in order."""
+) -> Optional[Prepared]:
+    """Expand cluster + app workloads into an ordered pod stream and encode
+    everything into device tensors. Returns None when there are no pods."""
     enc = ClusterEncoder(node_pad=node_pad)
     enc.add_nodes(cluster.nodes)
 
@@ -154,19 +213,57 @@ def simulate(
             forced.append(bool(p.spec.node_name))
 
     if not ordered:
+        return None
+
+    tmpl_ids = np.array(
+        [enc.add_pod(p, _owner_selector(p), hint=_tmpl_hint(p)) for p in ordered], dtype=np.int32
+    )
+    ec_np, st0, meta = enc.build()
+    features = kernels.features_of(ec_np)
+    ec, st0 = to_device(ec_np, st0)
+    node_idx = {name: i for i, name in enumerate(meta.node_names)}
+    ds_target = [node_idx.get(pinned_node_name(p), -1) for p in ordered]
+    return Prepared(
+        ec=ec,
+        st0=st0,
+        meta=meta,
+        ordered=ordered,
+        tmpl_ids=tmpl_ids,
+        forced=np.array(forced, dtype=bool),
+        ds_target=ds_target,
+        features=features,
+    )
+
+
+def simulate(
+    cluster: ResourceTypes,
+    apps: List[AppResource],
+    use_greed: bool = False,
+    node_pad: int = 8,
+) -> SimulateResult:
+    """One full simulation: cluster pods then apps in order."""
+    prep = prepare(cluster, apps, use_greed=use_greed, node_pad=node_pad)
+    if prep is None:
         return SimulateResult(
             node_status=[NodeStatus(node=n, pods=[]) for n in cluster.nodes]
         )
-
-    tmpl_ids = np.array([enc.add_pod(p, _owner_selector(p)) for p in ordered], dtype=np.int32)
-    ec, st0, meta = enc.build()
-    ec, st0 = to_device(ec, st0)
+    ec, st0, meta = prep.ec, prep.st0, prep.meta
+    ordered, tmpl_ids, forced = prep.ordered, prep.tmpl_ids, prep.forced
 
     pod_valid = np.ones((len(ordered),), dtype=bool)
-    out = schedule_pods(ec, st0, tmpl_ids, pod_valid, np.array(forced, dtype=bool))
+    tmpl_p, valid_p, forced_p = pad_pod_stream(tmpl_ids, pod_valid, forced)
+    out = schedule_pods(ec, st0, tmpl_p, valid_p, forced_p, features=prep.features)
+    out = out._replace(
+        chosen=out.chosen[: len(ordered)],
+        fail_counts=out.fail_counts[: len(ordered)],
+        insufficient=out.insufficient[: len(ordered)],
+        gpu_take=out.gpu_take[: len(ordered)],
+    )
     chosen = np.asarray(out.chosen)
     fail_counts = np.asarray(out.fail_counts)
     insufficient = np.asarray(out.insufficient)
+    gpu_take = np.asarray(out.gpu_take)
+    static_fail = np.asarray(out.static_fail)
 
     node_pods: Dict[str, List[Pod]] = {n.metadata.name: [] for n in cluster.nodes}
     unscheduled: List[UnscheduledPod] = []
@@ -180,13 +277,83 @@ def simulate(
         if c >= 0:
             pod.spec.node_name = meta.node_names[c]
             pod.phase = "Running"
+            # gpu-index annotation parity (GetUpdatedPodAnnotationSpec,
+            # gpushare utils/pod.go:116-127): device ids, one per packed slot
+            take = gpu_take[i]
+            if take.sum() > 0:
+                ids: List[str] = []
+                for d, cnt in enumerate(take):
+                    ids.extend([str(d)] * int(round(float(cnt))))
+                pod.metadata.annotations[ANNO_GPU_INDEX] = "-".join(ids)
             node_pods[meta.node_names[c]].append(pod)
         else:
             unscheduled.append(
-                UnscheduledPod(pod, _reason_string(fail_counts[i], insufficient[i], meta, n_nodes))
+                UnscheduledPod(
+                    pod,
+                    _reason_string(
+                        static_fail[int(tmpl_ids[i])], fail_counts[i], insufficient[i], meta, n_nodes
+                    ),
+                )
             )
 
-    return SimulateResult(
-        unscheduled_pods=unscheduled,
-        node_status=[NodeStatus(node=n, pods=node_pods[n.metadata.name]) for n in cluster.nodes],
-    )
+    statuses = _node_statuses(cluster.nodes, node_pods, out, meta)
+    return SimulateResult(unscheduled_pods=unscheduled, node_status=statuses)
+
+
+def _node_statuses(nodes, node_pods, out, meta: ClusterMeta) -> List[NodeStatus]:
+    """Write final storage/GPU usage back into node annotations — parity
+    with the Bind plugins updating the fake cluster's node objects
+    (open-local.go:175-254 writes simon/node-local-storage;
+    open-gpu-share.go Reserve writes simon/node-gpu-share)."""
+    vg_free = np.asarray(out.final_state.vg_free)
+    dev_free = np.asarray(out.final_state.dev_free)
+    gpu_free = np.asarray(out.final_state.gpu_free)
+
+    statuses: List[NodeStatus] = []
+    for idx, node in enumerate(nodes):
+        node = copy.deepcopy(node)
+        pods = node_pods[node.metadata.name]
+        vg_names = meta.node_vg_names[idx] if idx < len(meta.node_vg_names) else []
+        dev_names = meta.node_dev_names[idx] if idx < len(meta.node_dev_names) else []
+        if vg_names or dev_names:
+            vgs = []
+            for j, name in enumerate(vg_names):
+                cap = float(meta.node_vg_cap[idx, j])
+                vgs.append({"name": name, "capacity": int(cap), "requested": int(cap - vg_free[idx, j])})
+            devices = []
+            for j, name in enumerate(dev_names):
+                devices.append(
+                    {
+                        "name": name,
+                        "device": name,
+                        "capacity": int(meta.node_dev_cap[idx, j]),
+                        "mediaType": "ssd" if int(meta.node_dev_media[idx, j]) == 0 else "hdd",
+                        "isAllocated": bool(dev_free[idx, j] == 0 and meta.node_dev_cap[idx, j] > 0),
+                    }
+                )
+            node.metadata.annotations[ANNO_NODE_LOCAL_STORAGE] = json.dumps({"vgs": vgs, "devices": devices})
+        gpu_count = int(meta.node_gpu_count[idx]) if meta.node_gpu_count is not None else 0
+        if gpu_count > 0:
+            devs = {}
+            for d in range(gpu_count):
+                total = float(meta.node_gpu_mem[idx, d])
+                devs[str(d)] = {
+                    "GpuTotalMemory": int(total),
+                    "GpuUsedMemory": int(total - gpu_free[idx, d]),
+                    "PodList": [p.metadata.name for p in pods if _pod_uses_device(p, d)],
+                }
+            info = {
+                "GpuCount": gpu_count,
+                "GpuTotalMemory": int(sum(v["GpuTotalMemory"] for v in devs.values())),
+                "GpuModel": node.metadata.labels.get(LABEL_GPU_CARD_MODEL, "N/A"),
+                "NumPods": sum(1 for p in pods if ANNO_GPU_INDEX in p.metadata.annotations),
+                "DevsBrief": devs,
+            }
+            node.metadata.annotations[ANNO_NODE_GPU_SHARE] = json.dumps(info)
+        statuses.append(NodeStatus(node=node, pods=pods))
+    return statuses
+
+
+def _pod_uses_device(pod: Pod, device: int) -> bool:
+    idx = pod.metadata.annotations.get(ANNO_GPU_INDEX, "")
+    return str(device) in idx.split("-") if idx else False

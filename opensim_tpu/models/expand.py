@@ -155,11 +155,36 @@ def _add_workload_info(pod: Pod, kind: str, name: str, namespace: str) -> Pod:
     return pod
 
 
+def _fast_clone(proto: Pod, name: str) -> Pod:
+    """Cheap replica of a sanitized prototype pod: fresh metadata, shared
+    (immutable after sanitization) spec internals. Replica expansion is the
+    host-side hot path at 50k-pod scale — one deepcopy per workload, not
+    per pod."""
+    from .objects import ObjectMeta, Pod as PodCls
+
+    meta = ObjectMeta(
+        name=name,
+        namespace=proto.metadata.namespace,
+        labels=dict(proto.metadata.labels),
+        annotations=dict(proto.metadata.annotations),
+        uid=new_uid(),
+        generate_name=proto.metadata.generate_name,
+        owner_references=list(proto.metadata.owner_references),
+    )
+    spec = copy.copy(proto.spec)
+    raw = {**proto.raw, "metadata": meta.to_dict()} if proto.raw else {}
+    return PodCls(metadata=meta, spec=spec, phase=proto.phase, raw=raw)
+
+
 def pods_from_replica_set(rs: Workload) -> List[Pod]:
-    pods = []
-    for _ in range(max(rs.replicas, 0)):
-        pod = make_valid_pod(_pod_from_template(rs, "ReplicaSet"))
-        pods.append(_add_workload_info(pod, "ReplicaSet", rs.metadata.name, rs.metadata.namespace))
+    n = max(rs.replicas, 0)
+    if n == 0:
+        return []
+    proto = make_valid_pod(_pod_from_template(rs, "ReplicaSet"))
+    proto = _add_workload_info(proto, "ReplicaSet", rs.metadata.name, rs.metadata.namespace)
+    pods = [proto]
+    for _ in range(n - 1):
+        pods.append(_fast_clone(proto, f"{rs.metadata.name}-{_rand_suffix()}"))
     return pods
 
 
@@ -190,10 +215,14 @@ def pods_from_deployment(deploy: Workload) -> List[Pod]:
 
 
 def pods_from_job(job: Workload) -> List[Pod]:
-    pods = []
-    for _ in range(max(job.replicas, 0)):
-        pod = make_valid_pod(_pod_from_template(job, "Job"))
-        pods.append(_add_workload_info(pod, "Job", job.metadata.name, job.metadata.namespace))
+    n = max(job.replicas, 0)
+    if n == 0:
+        return []
+    proto = make_valid_pod(_pod_from_template(job, "Job"))
+    proto = _add_workload_info(proto, "Job", job.metadata.name, job.metadata.namespace)
+    pods = [proto]
+    for _ in range(n - 1):
+        pods.append(_fast_clone(proto, f"{job.metadata.name}-{_rand_suffix()}"))
     return pods
 
 
@@ -221,15 +250,18 @@ def pods_from_cron_job(cj: Workload) -> List[Pod]:
 def pods_from_stateful_set(sts: Workload) -> List[Pod]:
     """StatefulSet → ordinal-named pods + local-storage volume annotation
     (pkg/utils/utils.go:219-292)."""
-    pods = []
-    for ordinal in range(max(sts.replicas, 0)):
-        pod = _pod_from_template(sts, "StatefulSet")
-        pod.metadata.name = f"{sts.metadata.name}-{ordinal}"
-        if pod.raw:
-            pod.raw["metadata"]["name"] = pod.metadata.name
-        pod = make_valid_pod(pod)
-        pod = _add_workload_info(pod, "StatefulSet", sts.metadata.name, sts.metadata.namespace)
-        pods.append(pod)
+    n = max(sts.replicas, 0)
+    if n == 0:
+        return []
+    proto = _pod_from_template(sts, "StatefulSet")
+    proto.metadata.name = f"{sts.metadata.name}-0"
+    if proto.raw:
+        proto.raw["metadata"]["name"] = proto.metadata.name
+    proto = make_valid_pod(proto)
+    proto = _add_workload_info(proto, "StatefulSet", sts.metadata.name, sts.metadata.namespace)
+    pods = [proto]
+    for ordinal in range(1, n):
+        pods.append(_fast_clone(proto, f"{sts.metadata.name}-{ordinal}"))
     _set_storage_annotation(pods, sts.volume_claim_templates)
     return pods
 

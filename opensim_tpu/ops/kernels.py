@@ -109,13 +109,6 @@ def _minmax_normalize(scores, feasible):
 # filter kernels
 # ---------------------------------------------------------------------------
 
-def node_pin_filter(ec, u):
-    """NodeName plugin: spec.nodeName must equal the node."""
-    pin = ec.pin[u]
-    n_idx = jnp.arange(ec.node_valid.shape[0])
-    return jnp.where(pin == -1, True, n_idx == pin)
-
-
 def unschedulable_filter(ec, u):
     """NodeUnschedulable plugin: spec.unschedulable blocks unless tolerated
     via the node.kubernetes.io/unschedulable:NoSchedule taint (we take the
@@ -318,20 +311,19 @@ def balanced_allocation_score(ec, st, u):
     return jnp.where((cpu_frac >= 1.0) | (mem_frac >= 1.0), 0.0, score)
 
 
-def node_affinity_score(ec, u):
-    """NodeAffinity score: sum of matching preferred-term weights, then
-    DefaultNormalizeScore (max → 100)."""
+def node_affinity_raw(ec, u):
+    """NodeAffinity score (pre-normalization): sum of matching
+    preferred-term weights; DefaultNormalizeScore (max → 100) is applied in
+    pod_step over the feasible set."""
     req_ok = _requirements_match(ec, ec.pna_key[u], ec.pna_op[u], ec.pna_val[u], ec.pna_num[u])
     term_ok = jnp.all(req_ok, axis=-1)  # [N, Pp]
     weights = ec.pna_weight[u]  # [Pp]
-    raw = jnp.sum(jnp.where(term_ok, weights[None, :], 0.0), axis=-1)
-    mx = jnp.max(raw)
-    return jnp.where(mx > 0, raw * MAX_NODE_SCORE / jnp.maximum(mx, 1.0), raw)
+    return jnp.sum(jnp.where(term_ok, weights[None, :], 0.0), axis=-1)
 
 
-def taint_toleration_score(ec, u):
-    """TaintToleration score: count intolerable PreferNoSchedule taints,
-    reverse-normalized (DefaultNormalizeScore reverse=true)."""
+def taint_toleration_raw(ec, u):
+    """TaintToleration score input: count of intolerable PreferNoSchedule
+    taints; reverse DefaultNormalizeScore is applied in pod_step."""
     t_key, t_val, t_eff = ec.taint_key, ec.taint_val, ec.taint_effect
     tol_valid = ec.tol_valid[u]
     tol_key, tol_op, tol_val, tol_eff = ec.tol_key[u], ec.tol_op[u], ec.tol_val[u], ec.tol_effect[u]
@@ -342,9 +334,7 @@ def taint_toleration_score(ec, u):
     )
     empty_key_bad = (tol_key[None, None, :] == -1) & (tol_op[None, None, :] != V.TOL_EXISTS)
     tolerated = jnp.any(key_ok & eff_ok & val_ok & ~empty_key_bad & tol_valid[None, None, :], axis=-1)
-    intolerable = jnp.sum((t_eff == V.EFFECT_PREFER_NO_SCHEDULE) & ~tolerated, axis=-1).astype(jnp.float32)
-    mx = jnp.max(intolerable)
-    return jnp.where(mx > 0, MAX_NODE_SCORE - intolerable * MAX_NODE_SCORE / jnp.maximum(mx, 1.0), MAX_NODE_SCORE)
+    return jnp.sum((t_eff == V.EFFECT_PREFER_NO_SCHEDULE) & ~tolerated, axis=-1).astype(jnp.float32)
 
 
 def interpod_score(ec, st, u, feasible):
@@ -376,10 +366,11 @@ def interpod_score(ec, st, u, feasible):
     return jnp.where(rng > 0, MAX_NODE_SCORE * (raw - lo) / jnp.maximum(rng, 1.0), 0.0)
 
 
-def spread_score(ec, st, u, feasible):
+def spread_score(ec, stat: StaticTables, st, u, feasible):
     """PodTopologySpread score (podtopologyspread/scoring.go:175-248):
     ScheduleAnyway constraints; score_n = Σ_c cnt*log-weight + (maxSkew-1),
-    inverted-normalized so spreading wins."""
+    inverted-normalized so spreading wins. The log(size+2) normalizing
+    weight uses the statically precomputed per-key domain count."""
     topo = ec.spr_topo[u]  # [Cs]
     sel = ec.spr_sel[u]
     skew = ec.spr_skew[u].astype(jnp.float32)
@@ -391,20 +382,11 @@ def spread_score(ec, st, u, feasible):
     has_label = dom < D_trash
     cnt = st.dom_sel[dom, sel[None, :]]  # [N, Cs]
 
-    # per-constraint normalizing weight log(size+2), size = #distinct
-    # domains among feasible, non-ignored nodes
     ignored = feasible & ~jnp.all(has_label | ~soft[None, :], axis=-1)  # [N]
     scored = feasible & ~ignored
-    # distinct-domain count per constraint: scatter ones into domain rows
-    Dp1 = ec.domain_topo.shape[0]
-    ones = jnp.zeros((Dp1, topo.shape[0]))
-    ones = ones.at[jnp.where(scored[:, None], dom, D_trash), jnp.arange(topo.shape[0])[None, :]].max(
-        jnp.where(scored[:, None], 1.0, 0.0)
-    )
-    size = jnp.sum(ones[:D_trash], axis=0)  # [Cs]
-    weight = jnp.log(size + 2.0)
+    weight = stat.spread_weight[jnp.maximum(topo, 0)]  # [Cs]
 
-    contrib = jnp.where((soft & (ec.spr_topo[u] >= 0))[None, :] & has_label, cnt * weight[None, :] + (skew[None, :] - 1.0), 0.0)
+    contrib = jnp.where(soft[None, :] & has_label, cnt * weight[None, :] + (skew[None, :] - 1.0), 0.0)
     raw = jnp.sum(contrib, axis=-1)  # [N]
 
     big = jnp.float32(1e30)
@@ -417,10 +399,10 @@ def spread_score(ec, st, u, feasible):
     return jnp.where(any_soft, norm, 0.0)
 
 
-def share_score(ec, st, u, feasible):
+def share_raw(ec, u):
     """Simon / Open-Gpu-Share share score (plugin/simon.go:45-74 +
-    algo.Share, pkg/algo/greed.go:70-83): max over node-allocatable
-    resources of req/(allocatable - req), min-max normalized. Static
+    algo.Share, pkg/algo/greed.go:70-83), pre-normalization: max over
+    node-allocatable resources of req/(allocatable - req). Static
     allocatable is used (the fake client's node objects are never
     decremented), so this is usage-independent — matching the reference."""
     req = ec.req[u].at[V.RES_PODS].set(0.0)  # 'pods' request is not in PodRequestsAndLimits
@@ -428,12 +410,153 @@ def share_score(ec, st, u, feasible):
     share = jnp.where(
         avail == 0, jnp.where(req[None, :] == 0, 0.0, 1.0), req[None, :] / avail
     )
-    # only resources the node actually declares participate
+    # only resources the node actually declares participate; negative shares
+    # (req > allocatable) floor at 0 like the Go accumulator starting at 0
     share = jnp.where(ec.alloc > 0, share, 0.0)
-    raw = jnp.max(share, axis=-1) * MAX_NODE_SCORE
+    raw = jnp.maximum(jnp.max(share, axis=-1), 0.0) * MAX_NODE_SCORE
     # pods with no requests score MaxNodeScore on every node
-    raw = jnp.where(jnp.any(req > 0), raw, MAX_NODE_SCORE)
-    return _minmax_normalize(raw, feasible)
+    return jnp.where(jnp.any(req > 0), raw, MAX_NODE_SCORE)
+
+
+class StaticTables(NamedTuple):
+    """Per-(template, node) quantities that never change during a scan —
+    precomputed once with a vmap over the template axis, so the scan body
+    only runs the usage-dependent kernels. This is the TPU answer to the
+    reference re-running every plugin per pod (generic_scheduler.go:270-345):
+    pods sharing a template share all topology-independent work."""
+
+    static_pass: jnp.ndarray  # [U, N] bool — AND of the four static filters
+    aff_mask: jnp.ndarray  # [U, N] bool (NodeAffinity + nodeSelector, for spread eligibility)
+    static_fail: jnp.ndarray  # [U, 4] i32 first-fail counts for pin/unsched/taint/affinity
+    na_raw: jnp.ndarray  # [U, N] f32 preferred-node-affinity weights
+    tt_raw: jnp.ndarray  # [U, N] f32 intolerable PreferNoSchedule counts
+    share_raw: jnp.ndarray  # [U, N] f32 Simon/GpuShare share × 100
+    spread_weight: jnp.ndarray  # [Tk] f32 log(domain count + 2) per topology key
+
+
+def precompute_static(ec) -> StaticTables:
+    """NodeName pinning is handled by the forced-bind path in the scan step
+    (pods with spec.nodeName never reach the scheduler, reference
+    simulator.go:329-331), so the pin filter is NOT part of static_pass —
+    a defrag scenario that un-forces a drained node's pods lets them
+    reschedule anywhere. Its static_fail column stays zero."""
+    U = ec.req.shape[0]
+    us = jnp.arange(U)
+    taint = jax.vmap(lambda u: taint_filter(ec, u))(us)
+    aff = jax.vmap(lambda u: node_affinity_filter(ec, u))(us)
+    unsched = jnp.broadcast_to(~ec.unschedulable[None, :], taint.shape)
+    pin = jnp.ones_like(taint)
+    valid = ec.node_valid[None, :]
+    fails = []
+    passed = jnp.broadcast_to(valid, taint.shape)
+    for m in (pin, unsched, taint, aff):
+        fails.append(jnp.sum(passed & ~m, axis=-1))
+        passed = passed & m
+
+    # topology-spread normalizing weight log(size+2): size = distinct
+    # domains per key over valid nodes. k8s computes it over the per-pod
+    # filtered set (scoring.go:96-104); using the valid set instead keeps
+    # the weight out of the scan (a documented fidelity trade: it only
+    # blends the spread score, never feasibility).
+    Dp1 = ec.domain_topo.shape[0]
+    Tk = ec.node_domain.shape[1]
+    dom_present = jnp.zeros((Dp1,), jnp.float32).at[
+        jnp.where(ec.node_valid[:, None], ec.node_domain, Dp1 - 1)
+    ].max(1.0)
+    sizes = jnp.stack(
+        [jnp.sum(jnp.where(ec.domain_topo[: Dp1 - 1] == tk, dom_present[: Dp1 - 1], 0.0)) for tk in range(Tk)]
+    )
+
+    return StaticTables(
+        static_pass=passed,
+        aff_mask=aff,
+        static_fail=jnp.stack(fails, axis=-1).astype(jnp.int32),
+        na_raw=jax.vmap(lambda u: node_affinity_raw(ec, u))(us),
+        tt_raw=jax.vmap(lambda u: taint_toleration_raw(ec, u))(us),
+        share_raw=jax.vmap(lambda u: share_raw(ec, u))(us),
+        spread_weight=jnp.log(sizes + 2.0),
+    )
+
+
+def local_score(ec, st, u):
+    """Open-Local score (open-local.go:94-138 → ScoreLVMVolume/ScoreDevice
+    Volume, vendored common.go:487-509,:660-690, StrategyBinpack default,
+    types.go:142): mean over allocated units of used/capacity × MaxScore(10).
+    The LVM unit lands on the tightest-fitting VG (ascending free-size
+    first-fit, common.go:111-116); min-max normalization happens with the
+    other score plugins in pod_step."""
+    lvm = ec.lvm_req[u]
+    big = jnp.float32(1e30)
+    fits = st.vg_free >= lvm  # [N, Vg]
+    tight_free = jnp.min(jnp.where(fits, st.vg_free, big), axis=-1)  # [N]
+    # capacity of the chosen VG: gather via argmin over masked free
+    choice = jnp.argmin(jnp.where(fits, st.vg_free, big), axis=-1)  # [N]
+    vg_cap = jnp.take_along_axis(ec.node_vg_cap, choice[:, None], axis=-1)[:, 0]
+    lvm_part = jnp.where((lvm > 0) & (tight_free < big), lvm / jnp.maximum(vg_cap, 1.0), 0.0)
+
+    parts = lvm_part
+    count = (lvm > 0).astype(jnp.float32)
+    for media in (0, 1):
+        size = ec.dev_req[u, media]
+        n_dev = ec.dev_req_count[u, media].astype(jnp.float32)
+        fitting = (ec.node_dev_media == media) & (st.dev_free >= size) & (st.dev_free > 0)
+        dev_cap = jnp.where(fitting, ec.node_dev_cap, big)
+        first_cap = jnp.min(dev_cap, axis=-1)  # first-fit proxy: smallest fitting device
+        parts = parts + jnp.where(size > 0, n_dev * size / jnp.maximum(first_cap, 1.0), 0.0)
+        count = count + jnp.where(size > 0, n_dev, 0.0)
+
+    raw = jnp.where(count > 0, parts / jnp.maximum(count, 1.0) * 10.0, 0.0)
+    return raw
+
+
+class Features(NamedTuple):
+    """Static (trace-time) feature flags of the whole workload set: any
+    kernel whose inputs are empty across every template is eliminated from
+    the compiled scan entirely. Computed host-side at encode time."""
+
+    ports: bool
+    gpu: bool
+    local: bool
+    interpod: bool  # any required pod affinity/anti-affinity term
+    prefg: bool  # any preferred/symmetric inter-pod score term
+    spread_hard: bool
+    spread_soft: bool
+    pref_node_affinity: bool
+    prefer_taints: bool
+
+    @property
+    def sel_counts(self) -> bool:
+        return self.interpod or self.spread_hard or self.spread_soft
+
+
+ALL_FEATURES = Features(*([True] * 9))
+
+
+def features_of(ec_np) -> Features:
+    """Derive feature flags from the (host-side numpy) encoded cluster."""
+    import numpy as np
+
+    return Features(
+        ports=bool((np.asarray(ec_np.ports) >= 0).any()),
+        gpu=bool((np.asarray(ec_np.gpu_mem) > 0).any()),
+        local=bool(
+            (np.asarray(ec_np.lvm_req) > 0).any() or (np.asarray(ec_np.dev_req) > 0).any()
+        ),
+        interpod=bool(
+            (np.asarray(ec_np.at_sel) >= 0).any() or (np.asarray(ec_np.an_sel) >= 0).any()
+        ),
+        prefg=bool((np.asarray(ec_np.prefg_w) != 0).any()),
+        spread_hard=bool(
+            ((np.asarray(ec_np.spr_topo) >= 0) & np.asarray(ec_np.spr_hard)).any()
+        ),
+        spread_soft=bool(
+            ((np.asarray(ec_np.spr_topo) >= 0) & ~np.asarray(ec_np.spr_hard)).any()
+        ),
+        pref_node_affinity=bool((np.asarray(ec_np.pna_weight) != 0).any()),
+        prefer_taints=bool(
+            (np.asarray(ec_np.taint_effect) == V.EFFECT_PREFER_NO_SCHEDULE).any()
+        ),
+    )
 
 
 class StepResult(NamedTuple):
@@ -444,126 +567,192 @@ class StepResult(NamedTuple):
     insufficient: jnp.ndarray  # [R] i32 nodes short of each resource
 
 
-def pod_step(ec, st, u) -> StepResult:
+def pod_step(ec, stat: StaticTables, st, u, feat: Features = ALL_FEATURES) -> StepResult:
     """One pod through the full pipeline. Mirrors scheduleOne
-    (vendor/.../scheduler/scheduler.go:441) minus the bind goroutine."""
+    (vendor/.../scheduler/scheduler.go:441) minus the bind goroutine.
+    The four static filters are a single precomputed-row gather; only
+    usage-dependent kernels the workload actually exercises evaluate per
+    step (see Features)."""
     valid = ec.node_valid
-    masks = [
-        node_pin_filter(ec, u),
-        unschedulable_filter(ec, u),
-        taint_filter(ec, u),
-    ]
-    aff_mask = node_affinity_filter(ec, u)
-    masks.append(aff_mask)
-    masks.append(ports_filter(ec, st, u))
+    aff_mask = stat.aff_mask[u]
+    static_pass = stat.static_pass[u]  # valid already folded in
+    true_mask = jnp.ones_like(static_pass)
+    masks = [ports_filter(ec, st, u) if feat.ports else true_mask]
     fit_mask, insufficient = fit_filter(ec, st, u)
     masks.append(fit_mask)
-    masks.append(spread_filter(ec, st, u, aff_mask & valid))
-    masks.append(interpod_filter(ec, st, u))
-    masks.append(gpu_filter(ec, st, u))
-    masks.append(local_filter(ec, st, u))
+    masks.append(spread_filter(ec, st, u, aff_mask & valid) if feat.spread_hard else true_mask)
+    masks.append(interpod_filter(ec, st, u) if feat.interpod else true_mask)
+    masks.append(gpu_filter(ec, st, u) if feat.gpu else true_mask)
+    masks.append(local_filter(ec, st, u) if feat.local else true_mask)
 
-    fail_counts = []
-    passed_so_far = valid
+    passed_list = []
+    passed_so_far = static_pass
+    insufficient_attributed = None
     for i, m in enumerate(masks):
-        fail_counts.append(jnp.sum(passed_so_far & ~m))
-        if i == F_FIT:
+        passed_list.append(passed_so_far)
+        if i == F_FIT - F_PORTS:
             # per-resource counts attribute only nodes that reached the fit
             # filter (k8s reports each node under its first failing plugin)
-            insufficient = insufficient & passed_so_far[:, None]
+            insufficient_attributed = insufficient & passed_so_far[:, None]
         passed_so_far = passed_so_far & m
     feasible = passed_so_far
 
-    # score plugins × weights (registry.go:119-132 + the three sim plugins)
-    score = (
-        1.0 * balanced_allocation_score(ec, st, u)
-        + 1.0 * least_allocated_score(ec, st, u)
-        + 1.0 * node_affinity_score(ec, u)
-        + 1.0 * taint_toleration_score(ec, u)
-        + 1.0 * interpod_score(ec, st, u, feasible)
-        + 2.0 * spread_score(ec, st, u, feasible)
-        + 2.0 * share_score(ec, st, u, feasible)  # Simon + GpuShare (same formula, both weight 1)
-        # ImageLocality: 0 (no images in sim); NodePreferAvoidPods: constant
-    )
+    # Failure accounting (several reductions) only runs on the rare
+    # unschedulable step — lax.cond skips it on every successful bind.
+    def count_fails(_):
+        counts = jnp.stack(
+            [jnp.sum(p & ~m) for p, m in zip(passed_list, masks)]
+        ).astype(jnp.int32)
+        per_res = jnp.sum(insufficient_attributed & valid[:, None], axis=0).astype(jnp.int32)
+        return counts, per_res
+
+    def no_fails(_):
+        return (
+            jnp.zeros((len(masks),), jnp.int32),
+            jnp.zeros((insufficient.shape[1],), jnp.int32),
+        )
+
+    any_feasible = jnp.any(feasible)
+    fail_counts, per_res_insufficient = jax.lax.cond(any_feasible, no_fails, count_fails, None)
+
+    # score plugins × weights (registry.go:119-132 + the three sim plugins).
+    # Normalization runs over the feasible set, matching the framework
+    # normalizing the filtered-node score list (framework.go:635).
+    score = balanced_allocation_score(ec, st, u) + least_allocated_score(ec, st, u)
+    if feat.pref_node_affinity:
+        na_raw = stat.na_raw[u]
+        na_max = jnp.max(jnp.where(feasible, na_raw, 0.0))
+        score = score + jnp.where(na_max > 0, na_raw * MAX_NODE_SCORE / jnp.maximum(na_max, 1.0), na_raw)
+    if feat.prefer_taints:
+        tt_raw = stat.tt_raw[u]
+        tt_max = jnp.max(jnp.where(feasible, tt_raw, 0.0))
+        score = score + jnp.where(
+            tt_max > 0, MAX_NODE_SCORE - tt_raw * MAX_NODE_SCORE / jnp.maximum(tt_max, 1.0), MAX_NODE_SCORE
+        )
+    if feat.prefg or feat.interpod:
+        score = score + interpod_score(ec, st, u, feasible)
+    if feat.spread_soft:
+        score = score + 2.0 * spread_score(ec, stat, st, u, feasible)
+    score = score + 2.0 * _minmax_normalize(stat.share_raw[u], feasible)  # Simon + GpuShare (w=1 each)
+    if feat.local:
+        score = score + _minmax_normalize(local_score(ec, st, u), feasible)
+    # ImageLocality: 0 (no images in sim); NodePreferAvoidPods: constant
 
     neg = jnp.float32(-1e30)
     best = jnp.argmax(jnp.where(feasible, score, neg))
-    chosen = jnp.where(jnp.any(feasible), best, -1).astype(jnp.int32)
-    per_res_insufficient = jnp.sum(insufficient & valid[:, None], axis=0).astype(jnp.int32)
+    chosen = jnp.where(any_feasible, best, -1).astype(jnp.int32)
     return StepResult(
         feasible=feasible,
         score=score,
         chosen=chosen,
-        fail_counts=jnp.stack(fail_counts).astype(jnp.int32),
+        fail_counts=fail_counts,
         insufficient=per_res_insufficient,
     )
 
 
-def bind_update(ec, st, u, node):
+def bind_update(ec, st, u, node, apply, feat: Features = ALL_FEATURES):
     """State transition on bind — the tensorized equivalent of the Reserve +
     Bind plugin chain writing back into the fake clientset
-    (plugin/simon.go:104-126, open-gpu-share.go:147-245, open-local.go:175-254)."""
-    N = ec.node_valid.shape[0]
-    onehot = (jnp.arange(N) == node).astype(jnp.float32)  # [N]
+    (plugin/simon.go:104-126, open-gpu-share.go:147-245, open-local.go:175-254).
 
-    used = st.used + onehot[:, None] * ec.req[u][None, :]
+    `apply` (bool scalar) gates the whole update so the scan body needs no
+    state-select afterwards. Every update is a single-ROW
+    dynamic-update-slice (``.at[row]``): with the scan carry donated, XLA
+    performs them in place, so per-step HBM traffic is O(row), not O(state)
+    — the difference between 50k binds costing ~50 MB vs ~50 GB of writes.
 
-    ports = ec.ports[u]
-    port_used = st.port_used.at[node, jnp.maximum(ports, 0)].add(
-        jnp.where(ports >= 0, 1.0, 0.0), mode="drop"
-    )
+    Returns (new_state, gpu_take[Gd]) — gpu_take is the number of requested
+    GPU slots packed onto each device (the reference's devId annotation)."""
+    applyf = apply.astype(jnp.float32)
 
-    Tk = ec.node_domain.shape[1]
-    doms = ec.node_domain[node]  # [Tk]
-    dom_sel = st.dom_sel.at[doms, :].add(
-        jnp.broadcast_to(ec.matches_sel[u].astype(jnp.float32)[None, :], (Tk, ec.matches_sel.shape[1]))
-    )
+    used = st.used.at[node].add(ec.req[u] * applyf)
 
-    g_doms = ec.node_domain[node, ec.anti_g_topo]  # [G]
-    dom_anti = st.dom_anti.at[g_doms, jnp.arange(g_doms.shape[0])].add(
-        ec.anti_g[u].astype(jnp.float32)
-    )
+    # host-port counts: one row, multi-hot over the template's ports
+    port_used = st.port_used
+    if feat.ports:
+        ports = ec.ports[u]  # [Hp]
+        Hports = st.port_used.shape[1]
+        port_hot = jnp.sum(
+            (jnp.arange(Hports)[None, :] == ports[:, None]) & (ports[:, None] >= 0), axis=0
+        ).astype(jnp.float32)  # [Hports]
+        port_used = st.port_used.at[node].add(port_hot * applyf)
 
-    p_doms = ec.node_domain[node, ec.prefg_topo]  # [Gp]
-    dom_prefw = st.dom_prefw.at[p_doms, jnp.arange(p_doms.shape[0])].add(ec.prefg_w[u])
+    # domain selector counts: one row per topology key (Tk is tiny, the
+    # Python loop unrolls into Tk dynamic-update-slices)
+    dom_sel = st.dom_sel
+    if feat.sel_counts:
+        doms = ec.node_domain[node]  # [Tk]
+        matches = ec.matches_sel[u].astype(jnp.float32) * applyf  # [A]
+        for tk in range(int(ec.node_domain.shape[1])):
+            dom_sel = dom_sel.at[doms[tk]].add(matches)
+
+    # existing-anti / symmetric-preferred term counts: element updates
+    dom_anti = st.dom_anti
+    if feat.interpod:
+        g_doms = ec.node_domain[node, ec.anti_g_topo]  # [G]
+        anti_vals = ec.anti_g[u].astype(jnp.float32) * applyf
+        for g in range(int(ec.anti_g_topo.shape[0])):
+            dom_anti = dom_anti.at[g_doms[g], g].add(anti_vals[g])
+
+    dom_prefw = st.dom_prefw
+    if feat.prefg:
+        p_doms = ec.node_domain[node, ec.prefg_topo]  # [Gp]
+        pref_vals = ec.prefg_w[u] * applyf
+        for g in range(int(ec.prefg_topo.shape[0])):
+            dom_prefw = dom_prefw.at[p_doms[g], g].add(pref_vals[g])
 
     # gpu-share: greedy chunk packing (tightest-fit for 1 GPU is a packing
     # refinement the feasibility outcome doesn't depend on; we use the
     # documented greedy-with-reuse which matches multi-GPU AllocateGpuId)
-    mem = ec.gpu_mem[u]
-    cnt = ec.gpu_count[u].astype(jnp.float32)
-    free = st.gpu_free[node]  # [Gd]
-    chunks = jnp.floor_divide(free, jnp.maximum(mem, 1.0))
-    cum = jnp.cumsum(chunks)
-    take = jnp.clip(cnt - (cum - chunks), 0.0, chunks)
-    new_free = jnp.where(mem > 0, free - take * mem, free)
-    gpu_free = st.gpu_free.at[node].set(new_free)
+    gpu_free = st.gpu_free
+    take = jnp.zeros_like(st.gpu_free[0])
+    if feat.gpu:
+        mem = ec.gpu_mem[u]
+        cnt = ec.gpu_count[u].astype(jnp.float32)
+        free = st.gpu_free[node]  # [Gd]
+        chunks = jnp.floor_divide(free, jnp.maximum(mem, 1.0))
+        cum = jnp.cumsum(chunks)
+        take = jnp.clip(cnt - (cum - chunks), 0.0, chunks)
+        take = jnp.where(mem > 0, take, 0.0)
+        gpu_free = st.gpu_free.at[node].add(-(take * mem) * applyf)
 
-    # open-local LVM: allocate from the VG with most free space
-    lvm = ec.lvm_req[u]
-    vg_free_n = st.vg_free[node]
-    best_vg = jnp.argmax(vg_free_n)
-    vg_free = st.vg_free.at[node, best_vg].add(jnp.where(lvm > 0, -lvm, 0.0))
+    vg_free = st.vg_free
+    dev_free = st.dev_free
+    if feat.local:
+        # open-local LVM: tightest-fitting VG (ascending free-size first-fit,
+        # vendored common.go:111-116)
+        lvm = ec.lvm_req[u]
+        vg_free_n = st.vg_free[node]
+        big = jnp.float32(1e30)
+        vg_choice = jnp.argmin(jnp.where(vg_free_n >= lvm, vg_free_n, big))
+        vg_hot = (jnp.arange(st.vg_free.shape[1]) == vg_choice).astype(jnp.float32)
+        vg_free = st.vg_free.at[node].add(-(vg_hot * jnp.maximum(lvm, 0.0)) * applyf)
 
-    # open-local exclusive devices: first-fit by index per media type
-    dev_free_n = st.dev_free[node]  # [Dv]
-    for media in (0, 1):
-        size = ec.dev_req[u, media]
-        need = ec.dev_req_count[u, media].astype(jnp.float32)
-        fitting = (ec.node_dev_media[node] == media) & (dev_free_n >= size) & (dev_free_n > 0)
-        fit_f = fitting.astype(jnp.float32)
-        cum_f = jnp.cumsum(fit_f)
-        take_d = jnp.where((cum_f <= need) & fitting & (size > 0), 1.0, 0.0)
-        dev_free_n = jnp.where(take_d > 0, 0.0, dev_free_n)
-    dev_free = st.dev_free.at[node].set(dev_free_n)
+        # open-local exclusive devices: first-fit by index per media type
+        dev_free_n = st.dev_free[node]  # [Dv]
+        dev_taken = jnp.zeros_like(dev_free_n)
+        for media in (0, 1):
+            size = ec.dev_req[u, media]
+            need = ec.dev_req_count[u, media].astype(jnp.float32)
+            fitting = (ec.node_dev_media[node] == media) & (dev_free_n >= size) & (dev_free_n > 0)
+            fit_f = fitting.astype(jnp.float32)
+            cum_f = jnp.cumsum(fit_f)
+            take_d = jnp.where((cum_f <= need) & fitting & (size > 0), 1.0, 0.0)
+            dev_taken = jnp.maximum(dev_taken, take_d)
+        dev_free = st.dev_free.at[node].set(
+            jnp.where((dev_taken > 0) & apply, 0.0, dev_free_n)
+        )
 
-    return st._replace(
-        used=used,
-        port_used=port_used,
-        dom_sel=dom_sel,
-        dom_anti=dom_anti,
-        dom_prefw=dom_prefw,
-        gpu_free=gpu_free,
-        vg_free=vg_free,
-        dev_free=dev_free,
+    return (
+        st._replace(
+            used=used,
+            port_used=port_used,
+            dom_sel=dom_sel,
+            dom_anti=dom_anti,
+            dom_prefw=dom_prefw,
+            gpu_free=gpu_free,
+            vg_free=vg_free,
+            dev_free=dev_free,
+        ),
+        take * applyf,
     )

@@ -1,0 +1,97 @@
+"""Scenario-parallel what-if evaluation.
+
+The reference's capacity planner re-runs the whole simulation once per
+candidate node count, interactively (``pkg/apply/apply.go:203-259``). Here a
+*batch* of scenarios — node counts, drain plans — evaluates in one jitted,
+sharded computation: every scenario shares the same EncodedCluster tensors
+and differs only in its ``node_valid`` / ``pod_valid`` masks, so the whole
+sweep is one ``vmap`` over masks, sharded across TPU cores over ICI with a
+``jax.sharding.Mesh``. This is §2.3 of SURVEY.md: the distributed backend of
+this framework is XLA collectives over the scenario axis, not NCCL/MPI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..encoding.state import EncodedCluster, ScanState
+from ..engine.scheduler import schedule_pods
+
+
+class SweepResult(NamedTuple):
+    unscheduled: jnp.ndarray  # [S] i32 — unscheduled pod count per scenario
+    used: jnp.ndarray  # [S, N, R] f32 — final per-node usage
+    chosen: jnp.ndarray  # [S, P] i32
+    vg_used: jnp.ndarray  # [S] f32 — total VG bytes allocated
+
+
+def _one_scenario(ec: EncodedCluster, st0: ScanState, tmpl_ids, forced, node_valid, pod_valid, features):
+    out = schedule_pods(
+        ec._replace(node_valid=node_valid), st0, tmpl_ids, pod_valid, forced, features=features
+    )
+    unscheduled = jnp.sum(pod_valid & (out.chosen < 0))
+    vg_used = jnp.sum(
+        jnp.where(node_valid[:, None], st0.vg_free - out.final_state.vg_free, 0.0)
+    )
+    return unscheduled.astype(jnp.int32), out.final_state.used, out.chosen, vg_used
+
+
+@functools.partial(jax.jit, static_argnames=("features",))
+def _sweep_impl(ec, st0, tmpl_ids, node_valid_masks, pod_valid_masks, forced_masks, features):
+    """Module-level jitted sweep so repeat invocations hit the jit cache
+    (a fresh closure per call would retrace every time)."""
+    return jax.vmap(
+        lambda nv, pv, fm: _one_scenario(ec, st0, tmpl_ids, fm, nv, pv, features)
+    )(node_valid_masks, pod_valid_masks, forced_masks)
+
+
+def sweep(
+    ec: EncodedCluster,
+    st0: ScanState,
+    tmpl_ids: np.ndarray,
+    forced: np.ndarray,
+    node_valid_masks: np.ndarray,  # [S, N]
+    pod_valid_masks: np.ndarray,  # [S, P]
+    mesh: Optional[Mesh] = None,
+    features=None,
+    forced_masks: Optional[np.ndarray] = None,  # [S, P] — per-scenario override
+) -> SweepResult:
+    """Evaluate S scenarios in one compiled computation. With a mesh, the
+    scenario axis is sharded across devices (pad S to a device multiple).
+    `forced_masks` lets each scenario choose which pods stay pre-bound
+    (defragmentation: a drained node's pods become schedulable again)."""
+    from ..ops.kernels import ALL_FEATURES
+
+    features = features or ALL_FEATURES
+    S = node_valid_masks.shape[0]
+    if forced_masks is None:
+        forced_masks = np.broadcast_to(np.asarray(forced, dtype=bool), (S, len(forced))).copy()
+    arrays = (node_valid_masks, pod_valid_masks, forced_masks)
+    if mesh is not None:
+        n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+        pad = (-S) % n_dev
+        if pad:
+            arrays = tuple(np.concatenate([a, a[-1:].repeat(pad, 0)]) for a in arrays)
+        shard = NamedSharding(mesh, P(mesh.axis_names[0]))
+        arrays = tuple(jax.device_put(jnp.asarray(a), shard) for a in arrays)
+        out = _sweep_impl(ec, st0, jnp.asarray(tmpl_ids), *arrays, features=features)
+        out = jax.tree_util.tree_map(lambda a: a[:S], out)
+    else:
+        out = _sweep_impl(
+            ec, st0, jnp.asarray(tmpl_ids), *(jnp.asarray(a) for a in arrays), features=features
+        )
+    return SweepResult(*out)
+
+
+def default_mesh() -> Optional[Mesh]:
+    """One-axis mesh over all local devices (scenario data parallelism)."""
+    devices = jax.devices()
+    if len(devices) <= 1:
+        return None
+    return Mesh(np.array(devices), ("s",))

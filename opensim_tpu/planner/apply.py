@@ -1,0 +1,343 @@
+"""Capacity planner — parity with ``pkg/apply/apply.go``.
+
+``Applier.run()`` mirrors ``Applier.Run`` (``apply.go:103-267``): load the
+cluster (custom yaml dir or live kubeconfig), render each app (chart or yaml
+dir), load the candidate new-node template, then find the minimum number of
+new nodes that schedules everything within the ``MaxCPU``/``MaxMemory``/
+``MaxVG`` utilization caps (``satisfyResourceSetting``, ``apply.go:689-775``).
+
+Where the reference re-simulates one candidate count at a time behind an
+interactive prompt (``apply.go:203-259``), the default mode here evaluates a
+whole *batch* of candidate counts as sharded scenarios in one compiled sweep
+(``opensim_tpu/parallel/scenarios.py``) and binary-searches the frontier.
+``--interactive`` keeps the reference's prompt loop.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import List, Optional, TextIO
+
+import numpy as np
+
+from ..engine.simulator import AppResource, SimulateResult, prepare, simulate
+from ..models import expand
+from ..models.objects import ENV_MAX_CPU, ENV_MAX_MEMORY, ENV_MAX_VG, Node, ResourceTypes
+from ..parallel import scenarios
+from . import report as report_mod
+
+
+@dataclass
+class SimonConfig:
+    """The simon/v1alpha1 Config CR (pkg/api/v1alpha1/types.go:3-29)."""
+
+    name: str = ""
+    custom_cluster: str = ""
+    kube_config: str = ""
+    app_list: List[dict] = field(default_factory=list)  # {name, path, chart}
+    new_node: str = ""
+
+    @classmethod
+    def load(cls, path: str) -> "SimonConfig":
+        import yaml
+
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        if not isinstance(doc, dict) or doc.get("kind") != "Config":
+            raise ValueError(f"{path}: not a simon Config CR")
+        spec = doc.get("spec") or {}
+        cluster = spec.get("cluster") or {}
+        cfg = cls(
+            name=(doc.get("metadata") or {}).get("name", ""),
+            custom_cluster=cluster.get("customConfig", "") or "",
+            kube_config=cluster.get("kubeConfig", "") or "",
+            app_list=list(spec.get("appList") or []),
+            new_node=spec.get("newNode", "") or "",
+        )
+        if not cfg.custom_cluster and not cfg.kube_config:
+            raise ValueError("config: spec.cluster needs customConfig or kubeConfig")
+        return cfg
+
+
+@dataclass
+class Options:
+    simon_config: str = ""
+    default_scheduler_config: str = ""
+    output_file: str = ""
+    use_greed: bool = False
+    interactive: bool = False
+    extended_resources: List[str] = field(default_factory=list)
+    max_new_nodes: int = 128  # sweep upper bound (auto mode)
+    base_dir: str = ""  # paths in the config resolve relative to this
+
+
+def _resolve(base: str, path: str) -> str:
+    return path if os.path.isabs(path) or not base else os.path.join(base, path)
+
+
+def resource_caps() -> tuple:
+    """MaxCPU / MaxMemory / MaxVG env caps (apply.go:689-719): percentages,
+    values outside [0, 100] fall back to 100."""
+    caps = []
+    for env in (ENV_MAX_CPU, ENV_MAX_MEMORY, ENV_MAX_VG):
+        raw = os.environ.get(env, "")
+        val = 100
+        if raw:
+            try:
+                val = int(raw)
+            except ValueError as e:
+                raise ValueError(f"failed to convert env {env} to int: {e}")
+            if val > 100 or val < 0:
+                val = 100
+        caps.append(val)
+    return tuple(caps)
+
+
+def satisfy_resource_setting(result: SimulateResult) -> tuple:
+    """(ok, reason) — cluster-wide occupancy vs the env caps."""
+    import json
+
+    max_cpu, max_mem, max_vg = resource_caps()
+    total_cpu = total_mem = used_cpu = used_mem = 0.0
+    vg_cap = vg_req = 0.0
+    for status in result.node_status:
+        node = status.node
+        total_cpu += node.allocatable.get("cpu", 0.0)
+        total_mem += node.allocatable.get("memory", 0.0)
+        for pod in status.pods:
+            req = pod.resource_requests()
+            used_cpu += req.get("cpu", 0.0)
+            used_mem += req.get("memory", 0.0)
+        anno = node.metadata.annotations.get("simon/node-local-storage")
+        if anno:
+            try:
+                for vg in json.loads(anno).get("vgs") or []:
+                    vg_cap += float(vg.get("capacity", 0) or 0)
+                    vg_req += float(vg.get("requested", 0) or 0)
+            except ValueError:
+                pass
+    if total_cpu > 0 and int(used_cpu / total_cpu * 100) > max_cpu:
+        return False, (
+            f"the average occupancy rate({int(used_cpu / total_cpu * 100)}%) of cpu "
+            f"goes beyond the env setting({max_cpu}%)"
+        )
+    if total_mem > 0 and int(used_mem / total_mem * 100) > max_mem:
+        return False, (
+            f"the average occupancy rate({int(used_mem / total_mem * 100)}%) of memory "
+            f"goes beyond the env setting({max_mem}%)"
+        )
+    if vg_cap > 0 and int(vg_req / vg_cap * 100) > max_vg:
+        return False, (
+            f"the average occupancy rate({int(vg_req / vg_cap * 100)}%) of vg "
+            f"goes beyond the env setting({max_vg}%)"
+        )
+    return True, ""
+
+
+class Applier:
+    def __init__(self, opts: Options) -> None:
+        self.opts = opts
+        self.config = SimonConfig.load(opts.simon_config)
+        base = opts.base_dir or os.path.dirname(os.path.abspath(opts.simon_config))
+        self.base = base
+        self.out: TextIO = sys.stdout
+
+    # -- input loading ------------------------------------------------------
+
+    def load_cluster(self) -> ResourceTypes:
+        if self.config.kube_config:
+            from ..server.snapshot import cluster_from_kubeconfig
+
+            return cluster_from_kubeconfig(_resolve(self.base, self.config.kube_config))
+        return expand.load_cluster_from_dir(_resolve(self.base, self.config.custom_cluster))
+
+    def load_apps(self) -> List[AppResource]:
+        apps = []
+        for app in self.config.app_list:
+            path = _resolve(self.base, app.get("path", ""))
+            if app.get("chart"):
+                from ..chart.render import process_chart
+
+                contents = process_chart(app.get("name", ""), path)
+                docs = expand.decode_yaml_strings(contents)
+            else:
+                docs = expand.load_yaml_objects(path)
+            rt, _ = expand.resources_from_dicts(docs)
+            apps.append(AppResource(name=app.get("name", ""), resources=rt))
+        return apps
+
+    def load_new_node(self) -> Optional[Node]:
+        if not self.config.new_node:
+            return None
+        path = _resolve(self.base, self.config.new_node)
+        rt = expand.load_cluster_from_dir(path)
+        return rt.nodes[0] if rt.nodes else None
+
+    # -- capacity search ----------------------------------------------------
+
+    def _cluster_with_new_nodes(self, cluster: ResourceTypes, template: Node, count: int) -> ResourceTypes:
+        new_cluster = copy.copy(cluster)
+        new_cluster.nodes = list(cluster.nodes) + expand.new_fake_nodes(template, count)
+        return new_cluster
+
+    def find_min_nodes_batched(
+        self, cluster: ResourceTypes, apps: List[AppResource], template: Node
+    ) -> Optional[int]:
+        """Evaluate candidate new-node counts 0..max as one sharded scenario
+        sweep; return the minimal feasible count (caps included), or None."""
+        kmax = self.opts.max_new_nodes
+        full = self._cluster_with_new_nodes(cluster, template, kmax)
+        prep = prepare(full, apps, use_greed=self.opts.use_greed)
+        if prep is None:
+            return 0
+        N = prep.ec.node_valid.shape[0]
+        n_real = len(cluster.nodes)
+        ks = np.arange(kmax + 1)
+        S = len(ks)
+        node_valid = np.zeros((S, N), dtype=bool)
+        for s, k in enumerate(ks):
+            node_valid[s, : n_real + k] = True
+        P = len(prep.ordered)
+        pod_valid = np.ones((S, P), dtype=bool)
+        for p, target in enumerate(prep.ds_target):
+            if target >= n_real:  # DaemonSet pod pinned to a candidate node
+                pod_valid[:, p] = node_valid[:, target]
+
+        res = scenarios.sweep(
+            prep.ec,
+            prep.st0,
+            prep.tmpl_ids,
+            prep.forced,
+            node_valid,
+            pod_valid,
+            mesh=scenarios.default_mesh(),
+            features=prep.features,
+        )
+        unscheduled = np.asarray(res.unscheduled)
+        used = np.asarray(res.used)  # [S, N, R]
+        max_cpu, max_mem, max_vg = resource_caps()
+        alloc = np.asarray(prep.ec.alloc)
+        vg_caps = np.asarray(prep.meta.node_vg_cap).sum(axis=-1)  # [N]
+        vg_used = np.asarray(res.vg_used)
+
+        from ..encoding.vocab import RES_CPU, RES_MEMORY
+
+        for s, k in enumerate(ks):
+            if unscheduled[s] > 0:
+                continue
+            nv = node_valid[s]
+            tot_cpu = float(alloc[nv, RES_CPU].sum())
+            tot_mem = float(alloc[nv, RES_MEMORY].sum())
+            cpu_occ = int(used[s, nv, RES_CPU].sum() / tot_cpu * 100) if tot_cpu else 0
+            mem_occ = int(used[s, nv, RES_MEMORY].sum() / tot_mem * 100) if tot_mem else 0
+            tot_vg = float(vg_caps[nv].sum())
+            vg_occ = int(vg_used[s] / tot_vg * 100) if tot_vg else 0
+            if cpu_occ <= max_cpu and mem_occ <= max_mem and vg_occ <= max_vg:
+                return int(k)
+        return None
+
+    # -- run ----------------------------------------------------------------
+
+    def run(self) -> int:
+        close_out = False
+        if self.opts.output_file:
+            self.out = open(self.opts.output_file, "w")
+            close_out = True
+        try:
+            return self._run_inner()
+        finally:
+            if close_out:
+                self.out.close()
+
+    def _run_inner(self) -> int:
+        cluster = self.load_cluster()
+        apps = self.load_apps()
+        template = self.load_new_node()
+
+        if self.opts.interactive:
+            return self._run_interactive(cluster, apps, template)
+
+        # auto mode: batched capacity search
+        result = simulate(cluster, apps, use_greed=self.opts.use_greed)
+        n_new = 0
+        if result.unscheduled_pods or not satisfy_resource_setting(result)[0]:
+            if template is None:
+                print("Simulation failed: pods are unschedulable and no newNode is configured:", file=self.out)
+                for i, up in enumerate(result.unscheduled_pods):
+                    print(f"{i:4d} {up.pod.metadata.namespace}/{up.pod.metadata.name}: {up.reason}", file=self.out)
+                return 1
+            n_new = self.find_min_nodes_batched(cluster, apps, template)
+            if n_new is None:
+                print(
+                    f"Simulation failed: still unschedulable after adding {self.opts.max_new_nodes} node(s)",
+                    file=self.out,
+                )
+                return 1
+            result = simulate(
+                self._cluster_with_new_nodes(cluster, template, n_new), apps, use_greed=self.opts.use_greed
+            )
+        print("Simulation success!", file=self.out)
+        if n_new:
+            print(f"(added {n_new} new node(s))", file=self.out)
+        report_mod.report(
+            result,
+            extended_resources=self.opts.extended_resources,
+            app_names=[a.name for a in apps],
+            out=self.out,
+        )
+        return 0
+
+    def _run_interactive(self, cluster, apps, template) -> int:
+        """The reference's prompt loop (apply.go:203-259)."""
+        n_new = 0
+        result = None
+        while True:
+            result = simulate(
+                self._cluster_with_new_nodes(cluster, template, n_new) if template else cluster,
+                apps,
+                use_greed=self.opts.use_greed,
+            )
+            if result.unscheduled_pods:
+                print(
+                    f"there are still {len(result.unscheduled_pods)} pod(s) that can not be "
+                    f"scheduled when add {n_new} nodes, you can: [show/add N/exit]"
+                )
+                choice = input("> ").strip()
+                if choice == "show":
+                    for i, up in enumerate(result.unscheduled_pods):
+                        print(f"{i:4d} {up.pod.metadata.namespace}/{up.pod.metadata.name}: {up.reason}")
+                elif choice.startswith("add"):
+                    if template is None:
+                        print("no newNode template configured (spec.newNode); cannot add nodes")
+                        continue
+                    try:
+                        n_new = int(choice.split()[1])
+                    except (IndexError, ValueError):
+                        print("usage: add <node count>")
+                elif choice == "exit":
+                    return 1
+            else:
+                ok, reason = satisfy_resource_setting(result)
+                if not ok:
+                    print(reason)
+                    choice = input("add more nodes? [add N/exit] > ").strip()
+                    if choice.startswith("add"):
+                        try:
+                            n_new = int(choice.split()[1])
+                        except (IndexError, ValueError):
+                            print("usage: add <node count>")
+                    else:
+                        return 1
+                else:
+                    break
+        print("Simulation success!", file=self.out)
+        report_mod.report(
+            result,
+            extended_resources=self.opts.extended_resources,
+            app_names=[a.name for a in apps],
+            out=self.out,
+        )
+        return 0

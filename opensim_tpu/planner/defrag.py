@@ -1,0 +1,109 @@
+"""Defragmentation / node-drain what-if sweeps.
+
+The reference has no defragmentation tool — its only what-if loop is the
+interactive add-node retry (``pkg/apply/apply.go:203-259``). This module is
+the scenario-batch generalization BASELINE.md config 5 asks for: evaluate
+hundreds of candidate drain plans as one sharded sweep. Scenario s drains
+node d_s: the node is masked out of ``node_valid`` and the pods currently
+bound to it lose their pre-bound status, so the scan reschedules them onto
+the remaining nodes under full plugin semantics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..engine.simulator import AppResource, Prepared, prepare
+from ..models.objects import ResourceTypes
+from ..parallel import scenarios
+
+
+@dataclass
+class DrainPlan:
+    node: str
+    feasible: bool
+    unscheduled: int
+    # total cpu-milli + memory freed if the drain succeeds
+    freed_cpu_milli: float = 0.0
+    freed_memory: float = 0.0
+
+
+@dataclass
+class DefragResult:
+    plans: List[DrainPlan] = field(default_factory=list)
+
+    def drainable(self) -> List[DrainPlan]:
+        return [p for p in self.plans if p.feasible]
+
+
+def plan_drains(
+    cluster: ResourceTypes,
+    apps: Optional[List[AppResource]] = None,
+    candidates: Optional[Sequence[str]] = None,
+    prep: Optional[Prepared] = None,
+) -> DefragResult:
+    """Evaluate draining each candidate node (default: every node) as a
+    batch of sharded scenarios; returns which drains keep the cluster
+    schedulable."""
+    if prep is None:
+        prep = prepare(cluster, apps or [])
+    if prep is None:
+        return DefragResult()
+
+    names = prep.meta.node_names
+    name_to_idx = {n: i for i, n in enumerate(names)}
+    cand = list(candidates) if candidates is not None else list(names)
+    cand_idx = [name_to_idx[c] for c in cand if c in name_to_idx]
+
+    N = prep.ec.node_valid.shape[0]
+    P = len(prep.ordered)
+    base_valid = np.asarray(prep.ec.node_valid)
+    S = len(cand_idx)
+    if S == 0:
+        return DefragResult()
+
+    node_valid = np.broadcast_to(base_valid, (S, N)).copy()
+    pod_valid = np.ones((S, P), dtype=bool)
+    forced = np.broadcast_to(prep.forced, (S, P)).copy()
+
+    # which pods sit on each drained node (pre-bound via spec.nodeName, or
+    # DaemonSet-pinned — DS pods of a drained node simply disappear)
+    for s, d in enumerate(cand_idx):
+        node_valid[s, d] = False
+        for p, pod in enumerate(prep.ordered):
+            if prep.ds_target[p] == d:
+                pod_valid[s, p] = False
+            elif prep.forced[p] and pod.spec.node_name == names[d]:
+                forced[s, p] = False  # reschedule the drained node's pods
+
+    res = scenarios.sweep(
+        prep.ec,
+        prep.st0,
+        prep.tmpl_ids,
+        prep.forced,
+        node_valid,
+        pod_valid,
+        mesh=scenarios.default_mesh(),
+        features=prep.features,
+        forced_masks=forced,
+    )
+    unscheduled = np.asarray(res.unscheduled)
+
+    plans = []
+    alloc = np.asarray(prep.ec.alloc)
+    from ..encoding.vocab import RES_CPU, RES_MEMORY
+
+    for s, d in enumerate(cand_idx):
+        plans.append(
+            DrainPlan(
+                node=names[d],
+                feasible=bool(unscheduled[s] == 0),
+                unscheduled=int(unscheduled[s]),
+                freed_cpu_milli=float(alloc[d, RES_CPU]),
+                freed_memory=float(alloc[d, RES_MEMORY]),
+            )
+        )
+    return DefragResult(plans=plans)

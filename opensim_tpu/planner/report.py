@@ -1,0 +1,191 @@
+"""Report rendering — plain-text parity with the pterm tables of
+``pkg/apply/apply.go:309-687`` (Node Info, Extended Resource Info, Pod Info,
+App Info)."""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, TextIO
+
+from ..engine.simulator import SimulateResult
+from ..models.objects import (
+    ANNO_GPU_INDEX,
+    ANNO_NODE_GPU_SHARE,
+    ANNO_NODE_LOCAL_STORAGE,
+    LABEL_APP_NAME,
+    LABEL_NEW_NODE,
+    RES_GPU_COUNT,
+    RES_GPU_MEM,
+)
+from ..models.quantity import format_milli, format_quantity
+
+
+def _table(rows: List[List[str]], out: TextIO) -> None:
+    if not rows:
+        return
+    widths = [max(len(str(r[c])) for r in rows) for c in range(len(rows[0]))]
+    for r in rows:
+        print(" | ".join(str(v).ljust(w) for v, w in zip(r, widths)).rstrip(), file=out)
+
+
+def contains_gpu(extended: List[str]) -> bool:
+    return "gpu" in extended
+
+
+def contains_local_storage(extended: List[str]) -> bool:
+    return "open-local" in extended
+
+
+def report(
+    result: SimulateResult,
+    extended_resources: List[str],
+    app_names: List[str],
+    out: TextIO = sys.stdout,
+) -> None:
+    report_cluster_info(result, extended_resources, out)
+    report_app_info(result, app_names, out)
+
+
+def report_cluster_info(result: SimulateResult, extended: List[str], out: TextIO) -> None:
+    print("Node Info", file=out)
+    header = ["Node", "CPU Allocatable", "CPU Requests", "Memory Allocatable", "Memory Requests"]
+    if contains_gpu(extended):
+        header += ["GPU Mem Allocatable", "GPU Mem Requests"]
+    header += ["Pod Count", "New Node"]
+    rows = [header]
+    for status in result.node_status:
+        node = status.node
+        cpu_alloc = node.allocatable.get("cpu", 0.0)
+        mem_alloc = node.allocatable.get("memory", 0.0)
+        cpu_req = sum(p.resource_requests().get("cpu", 0.0) for p in status.pods)
+        mem_req = sum(p.resource_requests().get("memory", 0.0) for p in status.pods)
+        row = [
+            node.metadata.name,
+            format_milli(int(cpu_alloc * 1000)),
+            f"{format_milli(int(cpu_req * 1000))}({int(cpu_req / cpu_alloc * 100) if cpu_alloc else 0}%)",
+            format_quantity(mem_alloc),
+            f"{format_quantity(mem_req)}({int(mem_req / mem_alloc * 100) if mem_alloc else 0}%)",
+        ]
+        if contains_gpu(extended):
+            gpu_alloc = node.allocatable.get(RES_GPU_MEM, 0.0)
+            gpu_req = sum(p.gpu_mem_request() * p.gpu_count_request() for p in status.pods)
+            row += [
+                format_quantity(gpu_alloc),
+                f"{format_quantity(gpu_req)}({int(gpu_req / gpu_alloc * 100) if gpu_alloc else 0}%)",
+            ]
+        row += [str(len(status.pods)), "√" if LABEL_NEW_NODE in node.metadata.labels else ""]
+        rows.append(row)
+    _table(rows, out)
+    print("", file=out)
+
+    if contains_local_storage(extended):
+        print("Extended Resource Info", file=out)
+        print("Node Local Storage", file=out)
+        rows = [["Node", "Storage Kind", "Storage Name", "Storage Allocatable", "Storage Requests"]]
+        for status in result.node_status:
+            anno = status.node.metadata.annotations.get(ANNO_NODE_LOCAL_STORAGE)
+            if not anno:
+                continue
+            try:
+                storage = json.loads(anno)
+            except ValueError:
+                continue
+            for vg in storage.get("vgs") or []:
+                cap = float(vg.get("capacity", 0) or 0)
+                req = float(vg.get("requested", 0) or 0)
+                rows.append(
+                    [
+                        status.node.metadata.name,
+                        "VG",
+                        vg.get("name", ""),
+                        format_quantity(cap),
+                        f"{format_quantity(req)}({int(req / cap * 100) if cap else 0}%)",
+                    ]
+                )
+            for dev in storage.get("devices") or []:
+                rows.append(
+                    [
+                        status.node.metadata.name,
+                        f"Device({dev.get('mediaType', '')})",
+                        dev.get("device", ""),
+                        format_quantity(float(dev.get("capacity", 0) or 0)),
+                        "used" if dev.get("isAllocated") else "unused",
+                    ]
+                )
+        _table(rows, out)
+        print("", file=out)
+
+    if contains_gpu(extended):
+        print("GPU Node Resource", file=out)
+        rows = [["Node", "GPU ID", "GPU Request/Capacity", "Pod List"]]
+        pod_list = []
+        for status in result.node_status:
+            pod_list.extend(status.pods)
+            anno = status.node.metadata.annotations.get(ANNO_NODE_GPU_SHARE)
+            if not anno:
+                continue
+            try:
+                info = json.loads(anno)
+            except ValueError:
+                continue
+            total = float(info.get("GpuTotalMemory", 0))
+            used = sum(float(d.get("GpuUsedMemory", 0)) for d in (info.get("DevsBrief") or {}).values())
+            rows.append(
+                [
+                    f"{status.node.metadata.name} ({info.get('GpuModel', 'N/A')})",
+                    f"{info.get('GpuCount', 0)} GPUs",
+                    f"{format_quantity(used)}/{format_quantity(total)}({int(used / total * 100) if total else 0}%)",
+                    f"{info.get('NumPods', 0)} Pods",
+                ]
+            )
+            for idx, dev in sorted((info.get("DevsBrief") or {}).items()):
+                dtot = float(dev.get("GpuTotalMemory", 0))
+                if dtot <= 0:
+                    continue
+                dused = float(dev.get("GpuUsedMemory", 0))
+                rows.append(
+                    [
+                        f"{status.node.metadata.name} ({info.get('GpuModel', 'N/A')})",
+                        str(idx),
+                        f"{format_quantity(dused)}/{format_quantity(dtot)}({int(dused / dtot * 100) if dtot else 0}%)",
+                        str(dev.get("PodList") or []),
+                    ]
+                )
+        _table(rows, out)
+
+        print("\nPod -> Node Map", file=out)
+        rows = [["Pod", "CPU Req", "Mem Req", "GPU Req", "Host Node", "GPU IDX"]]
+        for pod in sorted(pod_list, key=lambda p: p.metadata.name):
+            req = pod.resource_requests()
+            rows.append(
+                [
+                    pod.metadata.name,
+                    format_milli(int(req.get("cpu", 0.0) * 1000)),
+                    format_quantity(req.get("memory", 0.0)),
+                    format_quantity(pod.gpu_mem_request() * pod.gpu_count_request()),
+                    pod.spec.node_name,
+                    pod.metadata.annotations.get(ANNO_GPU_INDEX, ""),
+                ]
+            )
+        _table(rows, out)
+        print("", file=out)
+
+
+def report_app_info(result: SimulateResult, app_names: List[str], out: TextIO) -> None:
+    """App Info — pods per app per node (reportAppInfo, apply.go:598-687)."""
+    if not app_names:
+        return
+    print("App Info", file=out)
+    rows = [["App", "Pod Count", "Nodes"]]
+    for app in app_names:
+        pods = [
+            p
+            for status in result.node_status
+            for p in status.pods
+            if p.metadata.labels.get(LABEL_APP_NAME) == app
+        ]
+        nodes = sorted({p.spec.node_name for p in pods})
+        rows.append([app, str(len(pods)), ",".join(nodes)])
+    _table(rows, out)
+    print("", file=out)

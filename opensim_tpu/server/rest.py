@@ -1,0 +1,207 @@
+"""REST server — parity with ``pkg/server/server.go``: ``GET /healthz``,
+``POST /api/deploy-apps``, ``POST /api/scale-apps`` with the exact request/
+response DTOs (``server.go:48-93``) so existing clients can switch backends.
+
+Implementation notes vs the reference:
+- stdlib ``http.server`` replaces gin (no third-party web framework in the
+  image); single-flight busy rejection mirrors the TryLock 503 behavior
+  (``server.go:167,:234``).
+- The live-cluster informer snapshot is taken per request via the
+  Kubernetes Python client when a kubeconfig is configured; without one, the
+  server can still serve simulations whose requests carry their own nodes
+  (useful for testing and air-gapped use — a divergence the reference
+  doesn't offer).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from ..engine.simulator import AppResource, SimulateResult, simulate
+from ..models.objects import LABEL_APP_NAME, Node, ResourceTypes, object_from_dict
+from .snapshot import cluster_from_kubeconfig
+
+_deploy_lock = threading.Lock()
+_scale_lock = threading.Lock()
+
+
+def _decode_app(payload: dict) -> ResourceTypes:
+    rt = ResourceTypes()
+    kind_map = {
+        "pods": "Pod",
+        "deployments": "Deployment",
+        "daemonsets": "DaemonSet",
+        "DaemonSets": "DaemonSet",
+        "statefulsets": "StatefulSet",
+        "StatefulSets": "StatefulSet",
+        "Jobs": "Job",
+        "jobs": "Job",
+        "ConfigMaps": "ConfigMap",
+        "configmaps": "ConfigMap",
+        "Deployments": "Deployment",
+        "Pods": "Pod",
+    }
+    for key, kind in kind_map.items():
+        for obj in payload.get(key) or []:
+            obj = dict(obj)
+            obj.setdefault("kind", kind)
+            decoded = object_from_dict(obj)
+            if decoded is not None:
+                rt.add(decoded)
+    return rt
+
+
+def _decode_new_nodes(payload: dict) -> List[Node]:
+    nodes = []
+    for obj in payload.get("newnodes") or payload.get("NewNodes") or []:
+        obj = dict(obj)
+        obj.setdefault("kind", "Node")
+        nodes.append(Node.from_dict(obj))
+    return nodes
+
+
+def _response(result: SimulateResult) -> dict:
+    """getSimulateResponse (server.go:446-470): names only; node entries only
+    for nodes holding app pods."""
+    out = {"unscheduledPods": [], "nodeStatus": []}
+    for up in result.unscheduled_pods:
+        out["unscheduledPods"].append(
+            {"pod": f"{up.pod.metadata.namespace}/{up.pod.metadata.name}", "reason": up.reason}
+        )
+    for ns in result.node_status:
+        pods = [
+            f"{p.metadata.namespace}/{p.metadata.name}"
+            for p in ns.pods
+            if LABEL_APP_NAME in p.metadata.labels
+        ]
+        if pods:
+            out["nodeStatus"].append({"node": ns.node.metadata.name, "pods": pods})
+    return out
+
+
+class SimonServer:
+    def __init__(self, kubeconfig: str = "", master: str = "", base_cluster: Optional[ResourceTypes] = None):
+        self.kubeconfig = kubeconfig
+        self.master = master
+        self.base_cluster = base_cluster
+
+    def current_cluster(self) -> ResourceTypes:
+        if self.base_cluster is not None:
+            return self.base_cluster
+        if self.kubeconfig:
+            return cluster_from_kubeconfig(self.kubeconfig, self.master)
+        return ResourceTypes()
+
+    # -- handlers -----------------------------------------------------------
+
+    def deploy_apps(self, payload: dict) -> tuple:
+        if not _deploy_lock.acquire(blocking=False):
+            return 503, {"error": "the server is busy now, please try again later"}
+        try:
+            cluster = self.current_cluster()
+            cluster = _with_new_nodes(cluster, _decode_new_nodes(payload))
+            app = _decode_app(payload)
+            result = simulate(cluster, [AppResource("deploy", app)])
+            return 200, _response(result)
+        except Exception as e:  # surface as 500 like gin's error handler
+            return 500, {"error": str(e)}
+        finally:
+            _deploy_lock.release()
+
+    def scale_apps(self, payload: dict) -> tuple:
+        """scale-apps (server.go:233-312): remove the workload's existing
+        pods from the cluster snapshot, then re-simulate at the new scale."""
+        if not _scale_lock.acquire(blocking=False):
+            return 503, {"error": "the server is busy now, please try again later"}
+        try:
+            cluster = self.current_cluster()
+            cluster = _with_new_nodes(cluster, _decode_new_nodes(payload))
+            app = _decode_app(payload)
+            scaled = {
+                (w.kind, w.metadata.namespace, w.metadata.name)
+                for w in app.deployments + app.daemon_sets + app.stateful_sets
+            }
+            cluster.pods = [
+                p
+                for p in cluster.pods
+                if not _owned_by(p, scaled)
+            ]
+            result = simulate(cluster, [AppResource("scale", app)])
+            return 200, _response(result)
+        except Exception as e:
+            return 500, {"error": str(e)}
+        finally:
+            _scale_lock.release()
+
+
+def _owned_by(pod, scaled: set) -> bool:
+    for ref in pod.metadata.owner_references:
+        key = (ref.kind, pod.metadata.namespace, ref.name)
+        if key in scaled:
+            return True
+        # deployment pods are owned via a generated ReplicaSet name prefix
+        if ref.kind == "ReplicaSet" and any(
+            k == "Deployment" and ns == pod.metadata.namespace and ref.name.startswith(name + "-")
+            for k, ns, name in scaled
+        ):
+            return True
+    return False
+
+
+def _with_new_nodes(cluster: ResourceTypes, nodes: List[Node]) -> ResourceTypes:
+    import copy
+
+    out = copy.copy(cluster)
+    out.nodes = list(cluster.nodes) + nodes
+    return out
+
+
+def make_handler(server: SimonServer):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet by default
+            pass
+
+        def _send(self, code: int, body: dict) -> None:
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._send(200, {"status": "ok"})
+            else:
+                self._send(404, {"error": "not found"})
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                payload = json.loads(self.rfile.read(length) or b"{}")
+            except ValueError:
+                self._send(400, {"error": "invalid JSON body"})
+                return
+            if self.path == "/api/deploy-apps":
+                code, body = server.deploy_apps(payload)
+            elif self.path == "/api/scale-apps":
+                code, body = server.scale_apps(payload)
+            else:
+                code, body = 404, {"error": "not found"}
+            self._send(code, body)
+
+    return Handler
+
+
+def serve(kubeconfig: str = "", master: str = "", port: int = 8080) -> int:
+    server = SimonServer(kubeconfig=kubeconfig, master=master)
+    httpd = ThreadingHTTPServer(("0.0.0.0", port), make_handler(server))
+    print(f"simon server listening on :{port}")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0

@@ -1,0 +1,61 @@
+"""Live-cluster snapshot — parity with ``CreateClusterResourceFromClient``
+(``pkg/simulator/simulator.go:503-601``): list Nodes; Pods (Running +
+Pending, skip DaemonSet-owned and deleting); PDBs, Services, StorageClasses,
+PVCs, ConfigMaps, DaemonSets — via the Kubernetes Python client when
+available (gated: the client is not in the base image)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..models.objects import Node, Pod, RawObject, ResourceTypes, Workload
+
+
+def cluster_from_kubeconfig(kubeconfig: str, master: Optional[str] = None) -> ResourceTypes:
+    try:
+        from kubernetes import client, config  # type: ignore
+    except ImportError as e:
+        raise RuntimeError(
+            "live-cluster mode needs the `kubernetes` Python client, which is "
+            "not installed in this environment; use spec.cluster.customConfig "
+            "with a YAML directory instead"
+        ) from e
+
+    config.load_kube_config(config_file=kubeconfig)
+    core = client.CoreV1Api()
+    apps = client.AppsV1Api()
+    # policy/v1beta1 was removed in k8s 1.25 / kubernetes client v26
+    policy = client.PolicyV1Api() if hasattr(client, "PolicyV1Api") else client.PolicyV1beta1Api()
+    storage = client.StorageV1Api()
+    api = client.ApiClient()
+
+    def to_dict(obj) -> dict:
+        return api.sanitize_for_serialization(obj)
+
+    rt = ResourceTypes()
+    for n in core.list_node().items:
+        rt.nodes.append(Node.from_dict(to_dict(n)))
+    for p in core.list_pod_for_all_namespaces(resource_version="0").items:
+        d = to_dict(p)
+        phase = (d.get("status") or {}).get("phase", "")
+        if phase not in ("Running", "Pending"):
+            continue
+        if (d.get("metadata") or {}).get("deletionTimestamp"):
+            continue
+        owners = (d.get("metadata") or {}).get("ownerReferences") or []
+        if any(o.get("kind") == "DaemonSet" for o in owners):
+            continue
+        rt.pods.append(Pod.from_dict(d))
+    for ds in apps.list_daemon_set_for_all_namespaces().items:
+        rt.daemon_sets.append(Workload.from_dict(to_dict(ds)))
+    for pdb in policy.list_pod_disruption_budget_for_all_namespaces().items:
+        rt.pdbs.append(RawObject.from_dict(to_dict(pdb)))
+    for svc in core.list_service_for_all_namespaces().items:
+        rt.services.append(RawObject.from_dict(to_dict(svc)))
+    for sc in storage.list_storage_class().items:
+        rt.storage_classes.append(RawObject.from_dict(to_dict(sc)))
+    for pvc in core.list_persistent_volume_claim_for_all_namespaces().items:
+        rt.pvcs.append(RawObject.from_dict(to_dict(pvc)))
+    for cm in core.list_config_map_for_all_namespaces().items:
+        rt.config_maps.append(RawObject.from_dict(to_dict(cm)))
+    return rt

@@ -1,9 +1,15 @@
 """Test configuration: force an 8-device virtual CPU mesh so multi-chip
-sharding paths run without TPU hardware."""
+sharding paths run without TPU hardware.
+
+Note: the axon environment's sitecustomize overrides the JAX_PLATFORMS env
+var, so the platform must be forced through jax.config after import."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,63 @@
+"""Scenario sweep + defragmentation tests on the 8-device virtual CPU mesh."""
+
+import numpy as np
+
+from opensim_tpu.engine.simulator import AppResource, prepare
+from opensim_tpu.models import ResourceTypes
+from opensim_tpu.models import fixtures as fx
+from opensim_tpu.parallel import scenarios
+from opensim_tpu.planner.defrag import plan_drains
+
+
+def _setup(n_nodes=6, replicas=8):
+    cluster = ResourceTypes()
+    for i in range(n_nodes):
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("web", replicas, "2", "2Gi"))
+    return cluster, [AppResource("a", app)]
+
+
+def test_sweep_over_node_counts_sharded():
+    cluster, apps = _setup(n_nodes=6, replicas=16)  # 16 pods × 2cpu = 32 cpu; 6×8=48
+    prep = prepare(cluster, apps)
+    N = prep.ec.node_valid.shape[0]
+    P = len(prep.ordered)
+    # scenario s enables s+1 nodes
+    S = 6
+    node_valid = np.zeros((S, N), dtype=bool)
+    for s in range(S):
+        node_valid[s, : s + 1] = True
+    pod_valid = np.ones((S, P), dtype=bool)
+    res = scenarios.sweep(
+        prep.ec, prep.st0, prep.tmpl_ids, prep.forced, node_valid, pod_valid,
+        mesh=scenarios.default_mesh(), features=prep.features,
+    )
+    unscheduled = np.asarray(res.unscheduled)
+    # each 8-cpu node fits 4 pods of 2 cpu; 16 pods need >= 4 nodes
+    assert unscheduled.tolist() == [12, 8, 4, 0, 0, 0]
+    # monotone: more nodes never hurts
+    assert all(unscheduled[i] >= unscheduled[i + 1] for i in range(S - 1))
+
+
+def test_defrag_drain_plans():
+    # 3 nodes, light load: any single node is drainable
+    cluster, apps = _setup(n_nodes=3, replicas=3)
+    result = plan_drains(cluster, apps)
+    assert len(result.plans) == 3
+    assert all(p.feasible for p in result.plans)
+
+    # tight load: 12 pods × 2cpu = 24 cpu on 3×8 = 24 cpu — no drain possible
+    cluster, apps = _setup(n_nodes=3, replicas=12)
+    result = plan_drains(cluster, apps)
+    assert all(not p.feasible for p in result.plans)
+    assert all(p.unscheduled == 4 for p in result.plans)
+
+
+def test_defrag_reschedules_prebound_pods():
+    cluster, apps = _setup(n_nodes=3, replicas=0)
+    # a pod pre-bound to n0 must be rescheduled when n0 drains
+    cluster.pods.append(fx.make_fake_pod("pinned", "1", "1Gi", fx.with_node_name("n0")))
+    result = plan_drains(cluster, apps)
+    by_node = {p.node: p for p in result.plans}
+    assert by_node["n0"].feasible  # pod fits elsewhere

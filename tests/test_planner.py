@@ -1,0 +1,181 @@
+"""Planner, report, chart, and server tests."""
+
+import json
+import threading
+import urllib.request
+
+from opensim_tpu.chart.render import process_chart, render_template
+from opensim_tpu.engine.simulator import AppResource, simulate
+from opensim_tpu.models import ResourceTypes
+from opensim_tpu.models import fixtures as fx
+from opensim_tpu.planner import report as report_mod
+from opensim_tpu.planner.apply import Applier, Options, satisfy_resource_setting
+
+
+def _write_config(tmp_path, cluster_dir, app_dir, newnode_dir):
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(
+        f"""apiVersion: simon/v1alpha1
+kind: Config
+metadata: {{name: test}}
+spec:
+  cluster:
+    customConfig: {cluster_dir}
+  appList:
+    - name: app
+      path: {app_dir}
+  newNode: {newnode_dir}
+"""
+    )
+    return str(cfg)
+
+
+def test_applier_adds_nodes_until_schedulable(tmp_path):
+    cluster_dir = tmp_path / "cluster"
+    app_dir = tmp_path / "app"
+    newnode_dir = tmp_path / "newnode"
+    for d in (cluster_dir, app_dir, newnode_dir):
+        d.mkdir()
+    import yaml
+
+    (cluster_dir / "node.yaml").write_text(yaml.safe_dump(fx.make_fake_node("n1", "4", "8Gi").raw))
+    (app_dir / "deploy.yaml").write_text(
+        yaml.safe_dump(fx.make_fake_deployment("big", 6, "2", "2Gi").raw)
+    )
+    (newnode_dir / "node.yaml").write_text(yaml.safe_dump(fx.make_fake_node("tmpl", "8", "16Gi").raw))
+
+    out_file = tmp_path / "report.txt"
+    opts = Options(
+        simon_config=_write_config(tmp_path, cluster_dir, app_dir, newnode_dir),
+        output_file=str(out_file),
+        max_new_nodes=8,
+    )
+    rc = Applier(opts).run()
+    assert rc == 0
+    text = out_file.read_text()
+    assert "Simulation success!" in text
+    # 6 pods × 2 CPU: n1 (4 CPU) holds 2, one new 8-CPU node holds the other 4
+    assert "added 1 new node(s)" in text
+    assert "√" in text  # new-node marker in the table
+
+
+def test_applier_fails_without_new_node(tmp_path):
+    cluster_dir = tmp_path / "cluster"
+    app_dir = tmp_path / "app"
+    cluster_dir.mkdir()
+    app_dir.mkdir()
+    import yaml
+
+    (cluster_dir / "node.yaml").write_text(yaml.safe_dump(fx.make_fake_node("n1", "1", "1Gi").raw))
+    (app_dir / "deploy.yaml").write_text(yaml.safe_dump(fx.make_fake_deployment("big", 2, "4", "8Gi").raw))
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(
+        f"""apiVersion: simon/v1alpha1
+kind: Config
+metadata: {{name: test}}
+spec:
+  cluster: {{customConfig: {cluster_dir}}}
+  appList:
+    - name: app
+      path: {app_dir}
+"""
+    )
+    out_file = tmp_path / "report.txt"
+    rc = Applier(Options(simon_config=str(cfg), output_file=str(out_file))).run()
+    assert rc == 1
+    assert "Insufficient" in out_file.read_text()
+
+
+def test_satisfy_resource_setting_caps(monkeypatch):
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n1", "4", "8Gi"))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("p", "3", "1Gi"))
+    res = simulate(cluster, [AppResource("a", app)])
+    ok, _ = satisfy_resource_setting(res)
+    assert ok
+    monkeypatch.setenv("MaxCPU", "50")
+    ok, reason = satisfy_resource_setting(res)
+    assert not ok and "cpu" in reason
+    monkeypatch.delenv("MaxCPU")
+
+
+def test_report_renders_gpu_and_storage(tmp_path):
+    from opensim_tpu.models import expand
+
+    cluster = expand.load_cluster_from_dir("/root/reference/example/cluster/gpushare")
+    app, _ = expand.resources_from_dicts(
+        expand.load_yaml_objects("/root/reference/example/application/gpushare")
+    )
+    res = simulate(cluster, [AppResource("pai_gpu", app)])
+    import io
+
+    buf = io.StringIO()
+    report_mod.report(res, ["gpu"], ["pai_gpu"], out=buf)
+    text = buf.getvalue()
+    assert "GPU Node Resource" in text
+    assert "Pod -> Node Map" in text
+    assert "pai-node-00" in text
+
+
+def test_chart_render_yoda():
+    docs = process_chart("yoda", "/root/reference/example/application/charts/yoda")
+    import yaml
+
+    objs = [yaml.safe_load(d) for d in docs]
+    kinds = [o.get("kind") for o in objs]
+    assert "DaemonSet" in kinds and "CronJob" in kinds and "StorageClass" in kinds
+    # install order: StorageClass before Deployment before CronJob
+    assert kinds.index("StorageClass") < kinds.index("DaemonSet") < kinds.index("CronJob")
+    # values substituted, no template syntax left
+    joined = "\n".join(docs)
+    assert "{{" not in joined
+    assert "open-local" in joined
+
+
+def test_template_subset():
+    ctx = {"Values": {"a": {"b": "x"}, "flag": True, "n": 3}, "Release": {"Name": "r1"}}
+    assert render_template("v: {{ .Values.a.b }}", ctx) == "v: x"
+    assert render_template("{{ .Release.Name }}", ctx) == "r1"
+    assert render_template("{{- if .Values.flag }}yes{{- else }}no{{- end }}", ctx) == "yes"
+    assert render_template("{{- if .Values.missing }}yes{{- else }}no{{- end }}", ctx) == "no"
+    assert render_template("{{ int .Values.n }}", ctx) == "3"
+    assert render_template("{{ .Values.a.b | quote }}", ctx) == '"x"'
+
+
+def test_rest_server_deploy_and_healthz():
+    from opensim_tpu.server.rest import SimonServer, make_handler
+    from http.server import ThreadingHTTPServer
+
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n1", "8", "16Gi"))
+    server = SimonServer(base_cluster=cluster)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(server))
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz") as r:
+            assert json.load(r)["status"] == "ok"
+        body = json.dumps(
+            {"deployments": [fx.make_fake_deployment("web", 3, "500m", "512Mi").raw]}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/deploy-apps", data=body, method="POST"
+        )
+        with urllib.request.urlopen(req) as r:
+            resp = json.load(r)
+        assert resp["unscheduledPods"] == []
+        assert resp["nodeStatus"][0]["node"] == "n1"
+        assert len(resp["nodeStatus"][0]["pods"]) == 3
+        # malformed body → 400
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/deploy-apps", data=b"{not json", method="POST"
+        )
+        try:
+            urllib.request.urlopen(req)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        httpd.shutdown()

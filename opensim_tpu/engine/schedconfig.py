@@ -1,0 +1,119 @@
+"""Scheduler configuration — the KubeSchedulerConfiguration subset.
+
+The reference merges an optional scheduler config file over its default
+profile (``InitKubeSchedulerConfiguration`` + ``GetAndSetSchedulerConfig``,
+``pkg/simulator/utils.go:277-381``). Here the same file adjusts score-plugin
+weights and disables filter/score plugins; the result is a hashable
+``SchedulerConfig`` passed statically into the jitted scan, so each distinct
+config compiles its own specialized pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+# kube plugin names → kernel slots
+SCORE_PLUGINS = {
+    "NodeResourcesBalancedAllocation": "balanced",
+    "NodeResourcesLeastAllocated": "least",
+    "NodeAffinity": "node_affinity",
+    "TaintToleration": "taint_toleration",
+    "InterPodAffinity": "interpod",
+    "PodTopologySpread": "spread",
+    "Simon": "simon",
+    "Open-Gpu-Share": "gpu_share",
+    "Open-Local": "local",
+    # present in the default profile but structurally zero/constant in a
+    # simulation (no images, no preferAvoidPods annotations)
+    "ImageLocality": None,
+    "NodePreferAvoidPods": None,
+}
+
+FILTER_PLUGINS = {
+    "NodeUnschedulable": "unschedulable",
+    "NodeName": "node_name",
+    "TaintToleration": "taints",
+    "NodeAffinity": "node_affinity",
+    "NodePorts": "ports",
+    "NodeResourcesFit": "fit",
+    "PodTopologySpread": "spread",
+    "InterPodAffinity": "interpod",
+    "Open-Gpu-Share": "gpu",
+    "Open-Local": "local",
+}
+
+
+class SchedulerConfig(NamedTuple):
+    """Score weights (0 disables a score plugin) and filter disables.
+    Defaults mirror algorithmprovider/registry.go:119-132 plus the three
+    simulator plugins at weight 1."""
+
+    w_balanced: float = 1.0
+    w_least: float = 1.0
+    w_node_affinity: float = 1.0
+    w_taint_toleration: float = 1.0
+    w_interpod: float = 1.0
+    w_spread: float = 2.0
+    w_simon: float = 1.0
+    w_gpu_share: float = 1.0
+    w_local: float = 1.0
+    f_taints: bool = True
+    f_node_affinity: bool = True
+    f_ports: bool = True
+    f_fit: bool = True
+    f_spread: bool = True
+    f_interpod: bool = True
+    f_gpu: bool = True
+    f_local: bool = True
+    f_unschedulable: bool = True
+
+
+DEFAULT_CONFIG = SchedulerConfig()
+
+
+def load_scheduler_config(path: str) -> SchedulerConfig:
+    """Parse a KubeSchedulerConfiguration yaml and apply profile[0]'s
+    score/filter plugin overrides over the defaults."""
+    import yaml
+
+    with open(path) as f:
+        doc = yaml.safe_load(f) or {}
+    if doc.get("kind") not in ("KubeSchedulerConfiguration", None):
+        raise ValueError(f"{path}: not a KubeSchedulerConfiguration")
+    profiles = doc.get("profiles") or []
+    if not profiles:
+        return DEFAULT_CONFIG
+    plugins = profiles[0].get("plugins") or {}
+    cfg = DEFAULT_CONFIG._asdict()
+
+    # kube merge semantics (vendored mergePluginSets): disabled entries
+    # filter the defaults FIRST, then user-enabled entries are appended —
+    # so `disabled: "*"` + `enabled: [X]` leaves only X.
+    score = plugins.get("score") or {}
+    for entry in score.get("disabled") or []:
+        name = str(entry.get("name", ""))
+        if name == "*":
+            for k in list(cfg):
+                if k.startswith("w_"):
+                    cfg[k] = 0.0
+            continue
+        slot = SCORE_PLUGINS.get(name)
+        if slot:
+            cfg[f"w_{slot}"] = 0.0
+    for entry in score.get("enabled") or []:
+        slot = SCORE_PLUGINS.get(str(entry.get("name", "")))
+        if slot:
+            cfg[f"w_{slot}"] = float(entry.get("weight", 1) or 1)
+
+    filt = plugins.get("filter") or {}
+    for entry in filt.get("disabled") or []:
+        name = str(entry.get("name", ""))
+        if name == "*":
+            for k in list(cfg):
+                if k.startswith("f_"):
+                    cfg[k] = False
+            continue
+        slot = FILTER_PLUGINS.get(name)
+        if slot and slot != "node_name":
+            cfg[f"f_{slot}"] = False
+    return SchedulerConfig(**cfg)

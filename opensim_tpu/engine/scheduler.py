@@ -34,9 +34,9 @@ class ScheduleOutput(NamedTuple):
     final_state: ScanState
 
 
-def _step(ec: EncodedCluster, stat, feat, st: ScanState, x):
+def _step(ec: EncodedCluster, stat, feat, cfg, st: ScanState, x):
     u, pod_valid, forced = x
-    res = kernels.pod_step(ec, stat, st, u, feat)
+    res = kernels.pod_step(ec, stat, st, u, feat, cfg)
     # Pre-bound pods (spec.nodeName set) bypass the scheduler in the
     # reference (simulator.go:329-331 only waits for unbound pods): they
     # always land on their node and still consume its resources.
@@ -49,7 +49,7 @@ def _step(ec: EncodedCluster, stat, feat, st: ScanState, x):
     return st_next, (chosen, res.fail_counts, res.insufficient, gpu_take)
 
 
-@functools.partial(jax.jit, static_argnames=("features", "unroll"))
+@functools.partial(jax.jit, static_argnames=("features", "config", "unroll"))
 def schedule_pods(
     ec: EncodedCluster,
     st0: ScanState,
@@ -57,6 +57,7 @@ def schedule_pods(
     pod_valid,
     forced,
     features: kernels.Features = kernels.ALL_FEATURES,
+    config=None,
     unroll: int = 1,
 ):
     """Run the bind scan. tmpl_ids [P] i32, pod_valid/forced [P] bool.
@@ -64,8 +65,11 @@ def schedule_pods(
     Static per-(template, node) filter/score tables are computed once up
     front; the scan body only evaluates usage-dependent kernels the
     workload's `features` actually exercise."""
-    stat = kernels.precompute_static(ec)
-    step = functools.partial(_step, ec, stat, features)
+    from .schedconfig import DEFAULT_CONFIG
+
+    config = config or DEFAULT_CONFIG
+    stat = kernels.precompute_static(ec, config)
+    step = functools.partial(_step, ec, stat, features, config)
     final_state, (chosen, fail_counts, insufficient, gpu_take) = jax.lax.scan(
         step, st0, (tmpl_ids, pod_valid, forced), unroll=unroll
     )

@@ -15,6 +15,7 @@ import json
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+import jax
 import numpy as np
 
 from ..encoding.state import ClusterEncoder, ClusterMeta
@@ -240,19 +241,29 @@ def simulate(
     apps: List[AppResource],
     use_greed: bool = False,
     node_pad: int = 8,
+    sched_config=None,
 ) -> SimulateResult:
-    """One full simulation: cluster pods then apps in order."""
-    prep = prepare(cluster, apps, use_greed=use_greed, node_pad=node_pad)
-    if prep is None:
-        return SimulateResult(
-            node_status=[NodeStatus(node=n, pods=[]) for n in cluster.nodes]
-        )
-    ec, st0, meta = prep.ec, prep.st0, prep.meta
-    ordered, tmpl_ids, forced = prep.ordered, prep.tmpl_ids, prep.forced
+    """One full simulation: cluster pods then apps in order. `sched_config`
+    is an optional SchedulerConfig (the --default-scheduler-config merge)."""
+    from ..utils.trace import Trace
 
-    pod_valid = np.ones((len(ordered),), dtype=bool)
-    tmpl_p, valid_p, forced_p = pad_pod_stream(tmpl_ids, pod_valid, forced)
-    out = schedule_pods(ec, st0, tmpl_p, valid_p, forced_p, features=prep.features)
+    with Trace("Simulate", threshold_s=1.0) as tr:
+        prep = prepare(cluster, apps, use_greed=use_greed, node_pad=node_pad)
+        tr.step("expand and encode")
+        if prep is None:
+            return SimulateResult(
+                node_status=[NodeStatus(node=n, pods=[]) for n in cluster.nodes]
+            )
+        ec, st0, meta = prep.ec, prep.st0, prep.meta
+        ordered, tmpl_ids, forced = prep.ordered, prep.tmpl_ids, prep.forced
+
+        pod_valid = np.ones((len(ordered),), dtype=bool)
+        tmpl_p, valid_p, forced_p = pad_pod_stream(tmpl_ids, pod_valid, forced)
+        out = schedule_pods(
+            ec, st0, tmpl_p, valid_p, forced_p, features=prep.features, config=sched_config
+        )
+        jax.block_until_ready(out.chosen)  # dispatch is async; trace real device time
+        tr.step(f"schedule {len(ordered)} pods")
     out = out._replace(
         chosen=out.chosen[: len(ordered)],
         fail_counts=out.fail_counts[: len(ordered)],

@@ -434,22 +434,32 @@ class StaticTables(NamedTuple):
     spread_weight: jnp.ndarray  # [Tk] f32 log(domain count + 2) per topology key
 
 
-def precompute_static(ec) -> StaticTables:
+def precompute_static(ec, cfg=None) -> StaticTables:
     """NodeName pinning is handled by the forced-bind path in the scan step
     (pods with spec.nodeName never reach the scheduler, reference
     simulator.go:329-331), so the pin filter is NOT part of static_pass —
     a defrag scenario that un-forces a drained node's pods lets them
     reschedule anywhere. Its static_fail column stays zero."""
+    from ..engine.schedconfig import DEFAULT_CONFIG
+
+    cfg = cfg or DEFAULT_CONFIG
     U = ec.req.shape[0]
     us = jnp.arange(U)
     taint = jax.vmap(lambda u: taint_filter(ec, u))(us)
     aff = jax.vmap(lambda u: node_affinity_filter(ec, u))(us)
     unsched = jnp.broadcast_to(~ec.unschedulable[None, :], taint.shape)
-    pin = jnp.ones_like(taint)
+    true_m = jnp.ones_like(taint)
+    pin = true_m
     valid = ec.node_valid[None, :]
     fails = []
     passed = jnp.broadcast_to(valid, taint.shape)
-    for m in (pin, unsched, taint, aff):
+    for m, enabled in (
+        (pin, True),
+        (unsched, cfg.f_unschedulable),
+        (taint, cfg.f_taints),
+        (aff, cfg.f_node_affinity),
+    ):
+        m = m if enabled else true_m
         fails.append(jnp.sum(passed & ~m, axis=-1))
         passed = passed & m
 
@@ -567,23 +577,34 @@ class StepResult(NamedTuple):
     insufficient: jnp.ndarray  # [R] i32 nodes short of each resource
 
 
-def pod_step(ec, stat: StaticTables, st, u, feat: Features = ALL_FEATURES) -> StepResult:
+def pod_step(ec, stat: StaticTables, st, u, feat: Features = ALL_FEATURES, cfg=None) -> StepResult:
     """One pod through the full pipeline. Mirrors scheduleOne
     (vendor/.../scheduler/scheduler.go:441) minus the bind goroutine.
     The four static filters are a single precomputed-row gather; only
     usage-dependent kernels the workload actually exercises evaluate per
-    step (see Features)."""
+    step (see Features). `cfg` (SchedulerConfig) adjusts plugin weights and
+    disables, mirroring --default-scheduler-config."""
+    from ..engine.schedconfig import DEFAULT_CONFIG
+
+    cfg = cfg or DEFAULT_CONFIG
     valid = ec.node_valid
     aff_mask = stat.aff_mask[u]
     static_pass = stat.static_pass[u]  # valid already folded in
     true_mask = jnp.ones_like(static_pass)
-    masks = [ports_filter(ec, st, u) if feat.ports else true_mask]
-    fit_mask, insufficient = fit_filter(ec, st, u)
+    masks = [ports_filter(ec, st, u) if feat.ports and cfg.f_ports else true_mask]
+    if cfg.f_fit:
+        fit_mask, insufficient = fit_filter(ec, st, u)
+    else:
+        fit_mask, insufficient = true_mask, jnp.zeros_like(ec.alloc, dtype=bool)
     masks.append(fit_mask)
-    masks.append(spread_filter(ec, st, u, aff_mask & valid) if feat.spread_hard else true_mask)
-    masks.append(interpod_filter(ec, st, u) if feat.interpod else true_mask)
-    masks.append(gpu_filter(ec, st, u) if feat.gpu else true_mask)
-    masks.append(local_filter(ec, st, u) if feat.local else true_mask)
+    masks.append(
+        spread_filter(ec, st, u, aff_mask & valid)
+        if feat.spread_hard and cfg.f_spread
+        else true_mask
+    )
+    masks.append(interpod_filter(ec, st, u) if feat.interpod and cfg.f_interpod else true_mask)
+    masks.append(gpu_filter(ec, st, u) if feat.gpu and cfg.f_gpu else true_mask)
+    masks.append(local_filter(ec, st, u) if feat.local and cfg.f_local else true_mask)
 
     passed_list = []
     passed_so_far = static_pass
@@ -618,24 +639,34 @@ def pod_step(ec, stat: StaticTables, st, u, feat: Features = ALL_FEATURES) -> St
     # score plugins × weights (registry.go:119-132 + the three sim plugins).
     # Normalization runs over the feasible set, matching the framework
     # normalizing the filtered-node score list (framework.go:635).
-    score = balanced_allocation_score(ec, st, u) + least_allocated_score(ec, st, u)
-    if feat.pref_node_affinity:
+    score = jnp.zeros_like(stat.share_raw[u])
+    if cfg.w_balanced:
+        score = score + cfg.w_balanced * balanced_allocation_score(ec, st, u)
+    if cfg.w_least:
+        score = score + cfg.w_least * least_allocated_score(ec, st, u)
+    if feat.pref_node_affinity and cfg.w_node_affinity:
         na_raw = stat.na_raw[u]
         na_max = jnp.max(jnp.where(feasible, na_raw, 0.0))
-        score = score + jnp.where(na_max > 0, na_raw * MAX_NODE_SCORE / jnp.maximum(na_max, 1.0), na_raw)
-    if feat.prefer_taints:
+        score = score + cfg.w_node_affinity * jnp.where(
+            na_max > 0, na_raw * MAX_NODE_SCORE / jnp.maximum(na_max, 1.0), na_raw
+        )
+    if feat.prefer_taints and cfg.w_taint_toleration:
         tt_raw = stat.tt_raw[u]
         tt_max = jnp.max(jnp.where(feasible, tt_raw, 0.0))
-        score = score + jnp.where(
+        score = score + cfg.w_taint_toleration * jnp.where(
             tt_max > 0, MAX_NODE_SCORE - tt_raw * MAX_NODE_SCORE / jnp.maximum(tt_max, 1.0), MAX_NODE_SCORE
         )
-    if feat.prefg or feat.interpod:
-        score = score + interpod_score(ec, st, u, feasible)
-    if feat.spread_soft:
-        score = score + 2.0 * spread_score(ec, stat, st, u, feasible)
-    score = score + 2.0 * _minmax_normalize(stat.share_raw[u], feasible)  # Simon + GpuShare (w=1 each)
-    if feat.local:
-        score = score + _minmax_normalize(local_score(ec, st, u), feasible)
+    if (feat.prefg or feat.interpod) and cfg.w_interpod:
+        score = score + cfg.w_interpod * interpod_score(ec, st, u, feasible)
+    if feat.spread_soft and cfg.w_spread:
+        score = score + cfg.w_spread * spread_score(ec, stat, st, u, feasible)
+    if cfg.w_simon + cfg.w_gpu_share:
+        # Simon + Open-Gpu-Share share the same formula and normalization
+        score = score + (cfg.w_simon + cfg.w_gpu_share) * _minmax_normalize(
+            stat.share_raw[u], feasible
+        )
+    if feat.local and cfg.w_local:
+        score = score + cfg.w_local * _minmax_normalize(local_score(ec, st, u), feasible)
     # ImageLocality: 0 (no images in sim); NodePreferAvoidPods: constant
 
     neg = jnp.float32(-1e30)
@@ -701,9 +732,9 @@ def bind_update(ec, st, u, node, apply, feat: Features = ALL_FEATURES):
         for g in range(int(ec.prefg_topo.shape[0])):
             dom_prefw = dom_prefw.at[p_doms[g], g].add(pref_vals[g])
 
-    # gpu-share: greedy chunk packing (tightest-fit for 1 GPU is a packing
-    # refinement the feasibility outcome doesn't depend on; we use the
-    # documented greedy-with-reuse which matches multi-GPU AllocateGpuId)
+    # gpu-share packing (AllocateGpuId, gpunodeinfo.go:232-290): single-GPU
+    # pods take the tightest-fitting device; multi-GPU pods use the greedy
+    # two-pointer packing with device reuse.
     gpu_free = st.gpu_free
     take = jnp.zeros_like(st.gpu_free[0])
     if feat.gpu:
@@ -712,7 +743,14 @@ def bind_update(ec, st, u, node, apply, feat: Features = ALL_FEATURES):
         free = st.gpu_free[node]  # [Gd]
         chunks = jnp.floor_divide(free, jnp.maximum(mem, 1.0))
         cum = jnp.cumsum(chunks)
-        take = jnp.clip(cnt - (cum - chunks), 0.0, chunks)
+        take_greedy = jnp.clip(cnt - (cum - chunks), 0.0, chunks)
+        big = jnp.float32(1e30)
+        fits = free >= mem
+        tight = jnp.argmin(jnp.where(fits, free, big))
+        # a force-bound pod can land on a node where nothing fits — take 0
+        # rather than driving gpu_free negative
+        take_tight = ((jnp.arange(free.shape[0]) == tight) & jnp.any(fits)).astype(jnp.float32)
+        take = jnp.where(cnt == 1, take_tight, take_greedy)
         take = jnp.where(mem > 0, take, 0.0)
         gpu_free = st.gpu_free.at[node].add(-(take * mem) * applyf)
 

@@ -31,9 +31,15 @@ class SweepResult(NamedTuple):
     vg_used: jnp.ndarray  # [S] f32 — total VG bytes allocated
 
 
-def _one_scenario(ec: EncodedCluster, st0: ScanState, tmpl_ids, forced, node_valid, pod_valid, features):
+def _one_scenario(ec: EncodedCluster, st0: ScanState, tmpl_ids, forced, node_valid, pod_valid, features, config):
     out = schedule_pods(
-        ec._replace(node_valid=node_valid), st0, tmpl_ids, pod_valid, forced, features=features
+        ec._replace(node_valid=node_valid),
+        st0,
+        tmpl_ids,
+        pod_valid,
+        forced,
+        features=features,
+        config=config,
     )
     unscheduled = jnp.sum(pod_valid & (out.chosen < 0))
     vg_used = jnp.sum(
@@ -42,12 +48,12 @@ def _one_scenario(ec: EncodedCluster, st0: ScanState, tmpl_ids, forced, node_val
     return unscheduled.astype(jnp.int32), out.final_state.used, out.chosen, vg_used
 
 
-@functools.partial(jax.jit, static_argnames=("features",))
-def _sweep_impl(ec, st0, tmpl_ids, node_valid_masks, pod_valid_masks, forced_masks, features):
+@functools.partial(jax.jit, static_argnames=("features", "config"))
+def _sweep_impl(ec, st0, tmpl_ids, node_valid_masks, pod_valid_masks, forced_masks, features, config=None):
     """Module-level jitted sweep so repeat invocations hit the jit cache
     (a fresh closure per call would retrace every time)."""
     return jax.vmap(
-        lambda nv, pv, fm: _one_scenario(ec, st0, tmpl_ids, fm, nv, pv, features)
+        lambda nv, pv, fm: _one_scenario(ec, st0, tmpl_ids, fm, nv, pv, features, config)
     )(node_valid_masks, pod_valid_masks, forced_masks)
 
 
@@ -61,6 +67,7 @@ def sweep(
     mesh: Optional[Mesh] = None,
     features=None,
     forced_masks: Optional[np.ndarray] = None,  # [S, P] — per-scenario override
+    config=None,
 ) -> SweepResult:
     """Evaluate S scenarios in one compiled computation. With a mesh, the
     scenario axis is sharded across devices (pad S to a device multiple).
@@ -80,11 +87,16 @@ def sweep(
             arrays = tuple(np.concatenate([a, a[-1:].repeat(pad, 0)]) for a in arrays)
         shard = NamedSharding(mesh, P(mesh.axis_names[0]))
         arrays = tuple(jax.device_put(jnp.asarray(a), shard) for a in arrays)
-        out = _sweep_impl(ec, st0, jnp.asarray(tmpl_ids), *arrays, features=features)
+        out = _sweep_impl(ec, st0, jnp.asarray(tmpl_ids), *arrays, features=features, config=config)
         out = jax.tree_util.tree_map(lambda a: a[:S], out)
     else:
         out = _sweep_impl(
-            ec, st0, jnp.asarray(tmpl_ids), *(jnp.asarray(a) for a in arrays), features=features
+            ec,
+            st0,
+            jnp.asarray(tmpl_ids),
+            *(jnp.asarray(a) for a in arrays),
+            features=features,
+            config=config,
         )
     return SweepResult(*out)
 

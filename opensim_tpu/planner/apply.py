@@ -144,6 +144,11 @@ class Applier:
         base = opts.base_dir or os.path.dirname(os.path.abspath(opts.simon_config))
         self.base = base
         self.out: TextIO = sys.stdout
+        self.sched_config = None
+        if opts.default_scheduler_config:
+            from ..engine.schedconfig import load_scheduler_config
+
+            self.sched_config = load_scheduler_config(opts.default_scheduler_config)
 
     # -- input loading ------------------------------------------------------
 
@@ -215,6 +220,7 @@ class Applier:
             pod_valid,
             mesh=scenarios.default_mesh(),
             features=prep.features,
+            config=self.sched_config,
         )
         unscheduled = np.asarray(res.unscheduled)
         used = np.asarray(res.used)  # [S, N, R]
@@ -261,7 +267,7 @@ class Applier:
             return self._run_interactive(cluster, apps, template)
 
         # auto mode: batched capacity search
-        result = simulate(cluster, apps, use_greed=self.opts.use_greed)
+        result = simulate(cluster, apps, use_greed=self.opts.use_greed, sched_config=self.sched_config)
         n_new = 0
         if result.unscheduled_pods or not satisfy_resource_setting(result)[0]:
             if template is None:
@@ -277,7 +283,10 @@ class Applier:
                 )
                 return 1
             result = simulate(
-                self._cluster_with_new_nodes(cluster, template, n_new), apps, use_greed=self.opts.use_greed
+                self._cluster_with_new_nodes(cluster, template, n_new),
+                apps,
+                use_greed=self.opts.use_greed,
+                sched_config=self.sched_config,
             )
         print("Simulation success!", file=self.out)
         if n_new:
@@ -299,6 +308,7 @@ class Applier:
                 self._cluster_with_new_nodes(cluster, template, n_new) if template else cluster,
                 apps,
                 use_greed=self.opts.use_greed,
+                sched_config=self.sched_config,
             )
             if result.unscheduled_pods:
                 print(

@@ -1,0 +1,41 @@
+"""Checkpoint / resume of simulation state.
+
+The reference has no checkpointing (SURVEY.md §5 — a run is stateless
+end-to-end); here the encoded cluster + scan carry are plain tensors, so
+snapshotting mid-plan is a single ``np.savez``. This enables resuming a
+long capacity sweep, sharing an encoded 50k-pod cluster between processes,
+or diffing two planning runs.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Tuple
+
+import numpy as np
+
+from ..encoding.state import EncodedCluster, ScanState
+
+_FORMAT_VERSION = 1
+
+
+def save_state(path: str, ec: EncodedCluster, st: ScanState, extra: dict | None = None) -> None:
+    arrays = {}
+    for name, arr in ec._asdict().items():
+        arrays[f"ec_{name}"] = np.asarray(arr)
+    for name, arr in st._asdict().items():
+        arrays[f"st_{name}"] = np.asarray(arr)
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps({"version": _FORMAT_VERSION, "extra": extra or {}}).encode(), dtype=np.uint8
+    )
+    np.savez_compressed(path, **arrays)
+
+
+def load_state(path: str) -> Tuple[EncodedCluster, ScanState, dict]:
+    with np.load(path) as data:
+        meta = json.loads(bytes(data["__meta__"]).decode())
+        if meta.get("version") != _FORMAT_VERSION:
+            raise ValueError(f"{path}: unsupported checkpoint version {meta.get('version')}")
+        ec = EncodedCluster(**{k[3:]: data[k] for k in data.files if k.startswith("ec_")})
+        st = ScanState(**{k[3:]: data[k] for k in data.files if k.startswith("st_")})
+    return ec, st, meta.get("extra", {})

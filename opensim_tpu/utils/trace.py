@@ -1,0 +1,69 @@
+"""Latency tracing — parity with the ``k8s.io/utils/trace`` spans the
+reference sprinkles through the hot paths: ``Simulate`` traced at a 1 s
+threshold (``pkg/simulator/core.go:72-73``), the cluster snapshot at 100 ms
+(``simulator.go:511-512``), per-pod scheduling at 100 ms
+(``generic_scheduler.go:132-133``). Spans log only when they exceed their
+threshold, with step breakdowns.
+
+For device-side profiling the reference exposes pprof on its HTTP server
+(``pkg/server/server.go:152``); the analogue here is the JAX profiler —
+``start_profiler()`` serves the TensorBoard-compatible trace endpoint.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+log = logging.getLogger("opensim_tpu.trace")
+
+
+class Trace:
+    """Threshold-gated span with sub-steps.
+
+    with Trace("Simulate", threshold_s=1.0) as tr:
+        ...
+        tr.step("expand workloads")
+        ...
+    """
+
+    def __init__(self, name: str, threshold_s: float = 1.0) -> None:
+        self.name = name
+        self.threshold_s = threshold_s
+        self.start = 0.0
+        self.steps: List[Tuple[str, float]] = []
+
+    def __enter__(self) -> "Trace":
+        self.start = time.monotonic()
+        return self
+
+    def step(self, msg: str) -> None:
+        self.steps.append((msg, time.monotonic()))
+
+    def __exit__(self, *exc) -> None:
+        total = time.monotonic() - self.start
+        if total < self.threshold_s:
+            return
+        lines = [f'Trace "{self.name}": total {total * 1000:.0f}ms (threshold {self.threshold_s * 1000:.0f}ms):']
+        prev = self.start
+        for msg, ts in self.steps:
+            lines.append(f"  step {msg}: {(ts - prev) * 1000:.0f}ms")
+            prev = ts
+        log.warning("\n".join(lines))
+
+
+_profiler_active = False
+
+
+def start_profiler(port: int = 9999) -> Optional[int]:
+    """Start the JAX profiler server (TensorBoard trace viewer endpoint) —
+    the pprof analogue for the XLA side."""
+    global _profiler_active
+    if _profiler_active:
+        return port
+    import jax
+
+    jax.profiler.start_server(port)
+    _profiler_active = True
+    return port

@@ -1,0 +1,146 @@
+"""Randomized parity tests: the vectorized device kernels must agree with
+the host-side golden implementations in ``opensim_tpu/models/selectors.py``
+on every (template, node) pair. This is the per-kernel unit layer the
+reference lacks (SURVEY.md §4)."""
+
+import random
+
+import numpy as np
+
+from opensim_tpu.encoding.state import ClusterEncoder
+from opensim_tpu.models import ResourceTypes, fixtures as fx, selectors
+from opensim_tpu.models.objects import Node, Pod
+from opensim_tpu.ops import kernels
+
+KEYS = ["zone", "disk", "role", "tier"]
+VALUES = ["a", "b", "c", "1", "2", "10"]
+EFFECTS = ["NoSchedule", "PreferNoSchedule", "NoExecute"]
+OPS = ["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"]
+
+
+def random_node(rng: random.Random, i: int) -> Node:
+    labels = {k: rng.choice(VALUES) for k in KEYS if rng.random() < 0.6}
+    taints = [
+        {"key": rng.choice(KEYS), "value": rng.choice(VALUES), "effect": rng.choice(EFFECTS)}
+        for _ in range(rng.randrange(0, 3))
+    ]
+    return fx.make_fake_node(f"n{i}", "8", "16Gi", "110", fx.with_labels(labels), fx.with_taints(taints))
+
+
+def random_pod(rng: random.Random, i: int) -> Pod:
+    opts = []
+    if rng.random() < 0.5:
+        opts.append(fx.with_node_selector({rng.choice(KEYS): rng.choice(VALUES)}))
+    if rng.random() < 0.6:
+        terms = []
+        for _ in range(rng.randrange(1, 3)):
+            exprs = []
+            for _ in range(rng.randrange(1, 3)):
+                op = rng.choice(OPS)
+                expr = {"key": rng.choice(KEYS), "operator": op}
+                if op in ("In", "NotIn"):
+                    expr["values"] = rng.sample(VALUES, rng.randrange(1, 3))
+                elif op in ("Gt", "Lt"):
+                    expr["values"] = [rng.choice(["1", "5", "10"])]
+                exprs.append(expr)
+            terms.append({"matchExpressions": exprs})
+        opts.append(
+            fx.with_affinity(
+                {"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {"nodeSelectorTerms": terms}}}
+            )
+        )
+    if rng.random() < 0.6:
+        tols = []
+        for _ in range(rng.randrange(1, 3)):
+            op = rng.choice(["Equal", "Exists"])
+            tol = {"key": rng.choice(KEYS), "operator": op}
+            if op == "Equal":
+                tol["value"] = rng.choice(VALUES)
+            if rng.random() < 0.7:
+                tol["effect"] = rng.choice(EFFECTS)
+            tols.append(tol)
+        opts.append(fx.with_tolerations(tols))
+    return fx.make_fake_pod(f"p{i}", "100m", "128Mi", *opts)
+
+
+def test_static_filter_kernels_match_host_golden():
+    rng = random.Random(42)
+    nodes = [random_node(rng, i) for i in range(24)]
+    pods = [random_pod(rng, i) for i in range(40)]
+
+    enc = ClusterEncoder()
+    enc.add_nodes(nodes)
+    tmpl_ids = [enc.add_pod(p) for p in pods]
+    ec, st0, meta = enc.build()
+    from opensim_tpu.engine.scheduler import to_device
+
+    ec, st0 = to_device(ec, st0)
+    stat = kernels.precompute_static(ec)
+    taint_mask = np.asarray(stat.taint_mask) if hasattr(stat, "taint_mask") else None
+    aff_mask = np.asarray(stat.aff_mask)
+    static_pass = np.asarray(stat.static_pass)
+    tt_raw = np.asarray(stat.tt_raw)
+
+    for p, u in zip(pods, tmpl_ids):
+        for i, node in enumerate(nodes):
+            want_aff = selectors.pod_matches_node_selector_and_affinity(p, node)
+            assert bool(aff_mask[u, i]) == want_aff, (
+                f"affinity mismatch pod={p.metadata.name} node={node.metadata.name}: "
+                f"kernel={bool(aff_mask[u, i])} host={want_aff}"
+            )
+            want_taint = (
+                selectors.find_untolerated_taint(node.taints, p.spec.tolerations) is None
+            )
+            want_pass = want_aff and want_taint
+            assert bool(static_pass[u, i]) == want_pass, (
+                f"static_pass mismatch pod={p.metadata.name} node={node.metadata.name}"
+            )
+            want_tt = selectors.count_intolerable_prefer_no_schedule(p, node)
+            assert int(tt_raw[u, i]) == want_tt, (
+                f"PreferNoSchedule count mismatch pod={p.metadata.name} node={node.metadata.name}"
+            )
+
+
+def test_share_score_matches_reference_formula():
+    """share_raw must equal the Simon plugin formula (plugin/simon.go:57-68
+    + algo.Share) computed by hand."""
+    nodes = [fx.make_fake_node("n0", "4", "8Gi", "110")]
+    pods = [fx.make_fake_pod("p0", "1", "2Gi")]
+    enc = ClusterEncoder()
+    enc.add_nodes(nodes)
+    u = enc.add_pod(pods[0])
+    ec, st0, meta = enc.build()
+    from opensim_tpu.engine.scheduler import to_device
+
+    ec, st0 = to_device(ec, st0)
+    stat = kernels.precompute_static(ec)
+    raw = float(np.asarray(stat.share_raw)[u, 0])
+    # shares: cpu 1000m/(4000-1000)=1/3; mem 2Gi/(8-2)Gi=1/3; pods 0
+    assert abs(raw - (1 / 3) * 100) < 1e-3
+
+
+def test_daemonset_eligibility_matches_engine():
+    """node_should_run_pod (host) and the engine must agree on where DS pods
+    land — mirrored from checkResult's recomputation (core_test.go:472-479)."""
+    rng = random.Random(7)
+    nodes = [random_node(rng, i) for i in range(10)]
+    ds = fx.make_fake_daemon_set(
+        "agent", "10m", "16Mi", fx.with_node_selector({"disk": "a"}), fx.with_tolerations([{"operator": "Exists"}])
+    )
+    from opensim_tpu.engine.simulator import AppResource, simulate
+
+    cluster = ResourceTypes()
+    cluster.nodes = nodes
+    app = ResourceTypes()
+    app.daemon_sets.append(ds)
+    res = simulate(cluster, [AppResource("a", app)])
+    from opensim_tpu.models.expand import _daemon_pod_for_node
+
+    expected = {
+        n.metadata.name
+        for n in nodes
+        if selectors.node_should_run_pod(n, _daemon_pod_for_node(ds, n.metadata.name))
+    }
+    got = {ns.node.metadata.name for ns in res.node_status if ns.pods}
+    assert got == expected
+    assert not res.unscheduled_pods

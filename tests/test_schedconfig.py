@@ -1,0 +1,58 @@
+"""--default-scheduler-config parsing and effect tests."""
+
+from opensim_tpu.engine.schedconfig import DEFAULT_CONFIG, load_scheduler_config
+from opensim_tpu.engine.simulator import AppResource, simulate
+from opensim_tpu.models import ResourceTypes
+from opensim_tpu.models import fixtures as fx
+
+
+def test_load_scheduler_config(tmp_path):
+    p = tmp_path / "sched.yaml"
+    p.write_text(
+        """apiVersion: kubescheduler.config.k8s.io/v1beta1
+kind: KubeSchedulerConfiguration
+profiles:
+  - plugins:
+      score:
+        enabled:
+          - name: NodeResourcesLeastAllocated
+            weight: 5
+        disabled:
+          - name: PodTopologySpread
+      filter:
+        disabled:
+          - name: TaintToleration
+"""
+    )
+    cfg = load_scheduler_config(str(p))
+    assert cfg.w_least == 5.0
+    assert cfg.w_spread == 0.0
+    assert not cfg.f_taints
+    assert cfg.f_fit  # untouched defaults remain
+    assert cfg.w_balanced == 1.0
+
+
+def test_disabled_taint_filter_schedules_onto_tainted_node(tmp_path):
+    cluster = ResourceTypes()
+    cluster.nodes.append(
+        fx.make_fake_node(
+            "tainted", "8", "16Gi", "110",
+            fx.with_taints([{"key": "dedicated", "value": "x", "effect": "NoSchedule"}]),
+        )
+    )
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("p", "100m", "128Mi"))
+
+    # default config: blocked by the taint
+    res = simulate(cluster, [AppResource("a", app)])
+    assert len(res.unscheduled_pods) == 1
+
+    cfg = DEFAULT_CONFIG._replace(f_taints=False)
+    res = simulate(cluster, [AppResource("a", app)], sched_config=cfg)
+    assert not res.unscheduled_pods
+
+
+def test_default_config_file_is_identity(tmp_path):
+    p = tmp_path / "empty.yaml"
+    p.write_text("apiVersion: kubescheduler.config.k8s.io/v1beta1\nkind: KubeSchedulerConfiguration\n")
+    assert load_scheduler_config(str(p)) == DEFAULT_CONFIG

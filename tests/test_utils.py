@@ -1,0 +1,47 @@
+"""Tracing and checkpoint/resume tests."""
+
+import logging
+
+import numpy as np
+
+from opensim_tpu.encoding.state import ClusterEncoder
+from opensim_tpu.models import ResourceTypes, fixtures as fx
+from opensim_tpu.utils.checkpoint import load_state, save_state
+from opensim_tpu.utils.trace import Trace
+
+
+def test_trace_logs_only_over_threshold(caplog):
+    with caplog.at_level(logging.WARNING, logger="opensim_tpu.trace"):
+        with Trace("fast", threshold_s=10.0) as tr:
+            tr.step("noop")
+        assert not caplog.records
+        with Trace("slow", threshold_s=0.0) as tr:
+            tr.step("one")
+        assert any("slow" in r.message for r in caplog.records)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    enc = ClusterEncoder()
+    enc.add_nodes([fx.make_fake_node("n0"), fx.make_fake_node("n1")])
+    enc.add_pod(fx.make_fake_pod("p0", "1", "1Gi"))
+    ec, st, _meta = enc.build()
+    path = str(tmp_path / "ckpt.npz")
+    save_state(path, ec, st, extra={"round": 3})
+    ec2, st2, extra = load_state(path)
+    assert extra == {"round": 3}
+    for a, b in zip(ec, ec2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(st, st2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # resuming the scan from a checkpoint gives identical results
+    from opensim_tpu.engine.scheduler import schedule_pods, to_device
+
+    tmpl = np.zeros(4, np.int32)
+    valid = np.ones(4, bool)
+    forced = np.zeros(4, bool)
+    ecd, std = to_device(ec, st)
+    ecd2, std2 = to_device(ec2, st2)
+    out1 = schedule_pods(ecd, std, tmpl, valid, forced)
+    out2 = schedule_pods(ecd2, std2, tmpl, valid, forced)
+    np.testing.assert_array_equal(np.asarray(out1.chosen), np.asarray(out2.chosen))

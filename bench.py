@@ -27,6 +27,10 @@ from opensim_tpu.engine.simulator import AppResource, simulate  # noqa: E402
 from opensim_tpu.models import ResourceTypes, fixtures as fx  # noqa: E402
 
 
+def _fmt(n: int) -> str:
+    return f"{n // 1000}k" if n >= 1000 and n % 1000 == 0 else str(n)
+
+
 def synthetic_cluster(n_nodes: int) -> ResourceTypes:
     rt = ResourceTypes()
     zones = [f"zone-{z}" for z in range(4)]
@@ -108,6 +112,95 @@ def bench_defrag(n_scenarios: int, n_nodes: int, n_pods: int, warmup: bool) -> i
     return 0
 
 
+def affinity_apps(n_pods: int) -> ResourceTypes:
+    """BASELINE.md config 4: InterPodAffinity + PodTopologySpread heavy."""
+    rt = ResourceTypes()
+    n_workloads = 10
+    per = n_pods // n_workloads
+    for w in range(n_workloads):
+        opts = [
+            fx.with_topology_spread(
+                [
+                    {
+                        "maxSkew": 3,
+                        "topologyKey": "topology.kubernetes.io/zone",
+                        "whenUnsatisfiable": "DoNotSchedule",
+                        "labelSelector": {"matchLabels": {"app": f"aff-{w}"}},
+                    }
+                ]
+            )
+        ]
+        if w % 2 == 0:
+            opts.append(
+                fx.with_affinity(
+                    {
+                        "podAntiAffinity": {
+                            "preferredDuringSchedulingIgnoredDuringExecution": [
+                                {
+                                    "weight": 100,
+                                    "podAffinityTerm": {
+                                        "labelSelector": {"matchLabels": {"app": f"aff-{w}"}},
+                                        "topologyKey": "kubernetes.io/hostname",
+                                    },
+                                }
+                            ]
+                        }
+                    }
+                )
+            )
+        else:
+            opts.append(
+                fx.with_affinity(
+                    {
+                        "podAffinity": {
+                            "requiredDuringSchedulingIgnoredDuringExecution": [
+                                {
+                                    "labelSelector": {"matchLabels": {"app": f"aff-{w - 1}"}},
+                                    "topologyKey": "topology.kubernetes.io/zone",
+                                }
+                            ]
+                        }
+                    }
+                )
+            )
+        rt.deployments.append(fx.make_fake_deployment(f"aff-{w}", per, "100m", "256Mi", *opts))
+    return rt
+
+
+def bench_reference_example(config_path: str, extended: str, warmup: bool, label: str) -> int:
+    """BASELINE.md configs 1-2: the reference repo's example simon configs,
+    run through the full `simon apply` pipeline."""
+    from opensim_tpu.planner.apply import Applier, Options
+
+    def run() -> float:
+        t0 = time.time()
+        rc = Applier(
+            Options(
+                simon_config=config_path,
+                output_file="/dev/null",
+                extended_resources=[r for r in extended.split(",") if r],
+            )
+        ).run()
+        if rc != 0:
+            raise RuntimeError(f"simon apply failed with rc={rc}")
+        return time.time() - t0
+
+    if warmup:
+        run()
+    dt = run()
+    print(
+        json.dumps(
+            {
+                "metric": f"simon apply {label} wall-clock",
+                "value": round(dt, 3),
+                "unit": "s",
+                "vs_baseline": round(1.0 / dt, 2) if dt > 0 else 0.0,  # reference trace threshold: 1 s
+            }
+        )
+    )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--pods", type=int, default=50000)
@@ -116,17 +209,36 @@ def main() -> int:
     ap.add_argument(
         "--config",
         default="plan",
-        choices=["plan", "defrag"],
-        help="plan = capacity-plan wall-clock (headline); defrag = drain-scenario sweep",
+        choices=["plan", "defrag", "affinity", "example", "gpushare"],
+        help=(
+            "plan = capacity-plan wall-clock (headline); defrag = drain-scenario "
+            "sweep; affinity = interpod+spread heavy; example/gpushare = the "
+            "reference repo's example simon configs"
+        ),
     )
     ap.add_argument("--scenarios", type=int, default=1000, help="defrag: number of drain scenarios")
     args = ap.parse_args()
 
+    repo = os.path.dirname(os.path.abspath(__file__))
     if args.config == "defrag":
         return bench_defrag(args.scenarios, args.nodes, args.pods, args.warmup)
+    if args.config == "example":
+        return bench_reference_example(
+            os.path.join(repo, "example/simon-config.yaml"), "", args.warmup, "example/simon-config"
+        )
+    if args.config == "gpushare":
+        return bench_reference_example(
+            os.path.join(repo, "example/simon-gpushare-config.yaml"),
+            "gpu",
+            args.warmup,
+            "example/simon-gpushare-config",
+        )
 
     cluster = synthetic_cluster(args.nodes)
-    apps = [AppResource("bench", synthetic_apps(args.pods))]
+    if args.config == "affinity":
+        apps = [AppResource("bench", affinity_apps(args.pods))]
+    else:
+        apps = [AppResource("bench", synthetic_apps(args.pods))]
 
     if args.warmup:
         simulate(cluster, apps, node_pad=128)
@@ -140,7 +252,9 @@ def main() -> int:
     print(
         json.dumps(
             {
-                "metric": f"{args.pods // 1000}k-pod/{args.nodes // 1000}k-node capacity plan wall-clock",
+                "metric": f"{_fmt(args.pods)}-pod/{_fmt(args.nodes)}-node "
+                + ("affinity-heavy " if args.config == "affinity" else "")
+                + "capacity plan wall-clock",
                 "value": round(dt, 3),
                 "unit": "s",
                 "vs_baseline": round(target_s / dt, 2) if dt > 0 else 0.0,

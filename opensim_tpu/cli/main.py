@@ -62,6 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    # persistent XLA compilation cache: repeated simon invocations with the
+    # same shapes skip the (tens of seconds) first-compile cost
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.expanduser("~/.cache/opensim-tpu-jit"))
     level = LOG_LEVELS.get(os.environ.get("LogLevel", "info").lower(), logging.INFO)
     logging.basicConfig(level=level, format="%(levelname)s %(message)s")
 

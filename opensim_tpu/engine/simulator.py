@@ -279,6 +279,9 @@ def simulate(
     node_pods: Dict[str, List[Pod]] = {n.metadata.name: [] for n in cluster.nodes}
     unscheduled: List[UnscheduledPod] = []
     n_nodes = meta.n_real_nodes
+    node_names = meta.node_names
+    pod_lists = [node_pods[n] for n in node_names]
+    gpu_any = gpu_take.sum(axis=1) > 0  # one vectorized pass, not per-pod sums
 
     for i, pod in enumerate(ordered):
         c = int(chosen[i])
@@ -286,17 +289,16 @@ def simulate(
             unscheduled.append(UnscheduledPod(pod, f'node "{pod.spec.node_name}" not found'))
             continue
         if c >= 0:
-            pod.spec.node_name = meta.node_names[c]
+            pod.spec.node_name = node_names[c]
             pod.phase = "Running"
             # gpu-index annotation parity (GetUpdatedPodAnnotationSpec,
             # gpushare utils/pod.go:116-127): device ids, one per packed slot
-            take = gpu_take[i]
-            if take.sum() > 0:
+            if gpu_any[i]:
                 ids: List[str] = []
-                for d, cnt in enumerate(take):
+                for d, cnt in enumerate(gpu_take[i]):
                     ids.extend([str(d)] * int(round(float(cnt))))
                 pod.metadata.annotations[ANNO_GPU_INDEX] = "-".join(ids)
-            node_pods[meta.node_names[c]].append(pod)
+            pod_lists[c].append(pod)
         else:
             unscheduled.append(
                 UnscheduledPod(
@@ -321,8 +323,14 @@ def _node_statuses(nodes, node_pods, out, meta: ClusterMeta) -> List[NodeStatus]
     gpu_free = np.asarray(out.final_state.gpu_free)
 
     statuses: List[NodeStatus] = []
-    for idx, node in enumerate(nodes):
-        node = copy.deepcopy(node)
+    for idx, orig in enumerate(nodes):
+        # annotations get storage/GPU usage written back; shallow-copy the
+        # node and give it fresh metadata so the caller's objects stay
+        # untouched without deep-copying 5k raw dicts
+        node = copy.copy(orig)
+        node.metadata = copy.copy(orig.metadata)
+        node.metadata.annotations = dict(orig.metadata.annotations)
+        node.metadata.labels = dict(orig.metadata.labels)
         pods = node_pods[node.metadata.name]
         vg_names = meta.node_vg_names[idx] if idx < len(meta.node_vg_names) else []
         dev_names = meta.node_dev_names[idx] if idx < len(meta.node_dev_names) else []

@@ -171,8 +171,11 @@ def _fast_clone(proto: Pod, name: str) -> Pod:
         generate_name=proto.metadata.generate_name,
         owner_references=list(proto.metadata.owner_references),
     )
-    spec = copy.copy(proto.spec)
-    raw = {**proto.raw, "metadata": meta.to_dict()} if proto.raw else {}
+    # cheap shallow spec copy (node_name is set per pod at bind decode;
+    # nested lists stay shared and immutable post-sanitization)
+    spec = object.__new__(type(proto.spec))
+    spec.__dict__.update(proto.spec.__dict__)
+    raw = {**proto.raw, "metadata": {"name": name, "namespace": meta.namespace, "uid": meta.uid}} if proto.raw else {}
     return PodCls(metadata=meta, spec=spec, phase=proto.phase, raw=raw)
 
 

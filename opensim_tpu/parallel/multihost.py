@@ -1,0 +1,56 @@
+"""Multi-host execution over DCN.
+
+The reference is a single-process CLI (SURVEY.md §2.3 — no collectives, no
+multi-node execution). This framework's scale-out model:
+
+- **intra-host / ICI**: scenario batches shard across local TPU cores via
+  the one-axis mesh in ``scenarios.sweep`` (collectives ride ICI).
+- **inter-host / DCN**: ``initialize()`` joins a ``jax.distributed`` job;
+  ``global_mesh()`` then spans every process's devices, and the same sweep
+  shards the scenario axis across hosts — XLA partitions the batch so each
+  host scans its scenario shard locally and only the small per-scenario
+  summaries (unscheduled counts, usage sums) cross DCN.
+
+Typical launch (one process per host):
+    JAX_COORDINATOR=host0:1234 python -m opensim_tpu apply -f cfg.yaml
+with ``initialize()`` called from the planner when the env is present.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def initialize(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join a multi-host jax.distributed job. Parameters default from the
+    JAX_COORDINATOR / JAX_NUM_PROCESSES / JAX_PROCESS_ID env vars; returns
+    False (no-op) when unset so single-host runs need nothing."""
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR", "")
+    if not coordinator:
+        return False
+    num_processes = int(num_processes or os.environ.get("JAX_NUM_PROCESSES", "1"))
+    process_id = int(process_id if process_id is not None else os.environ.get("JAX_PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def global_mesh() -> Optional[Mesh]:
+    """One-axis mesh over every device of every process: after
+    ``initialize()``, ``jax.devices()`` spans all hosts, so the scenario
+    mesh used by sweeps is automatically global."""
+    from .scenarios import default_mesh
+
+    return default_mesh()

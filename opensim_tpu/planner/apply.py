@@ -198,14 +198,43 @@ class Applier:
         prep = prepare(full, apps, use_greed=self.opts.use_greed)
         if prep is None:
             return 0
-        N = prep.ec.node_valid.shape[0]
         n_real = len(cluster.nodes)
-        ks = np.arange(kmax + 1)
+
+        # coarse geometric sweep finds the feasibility bracket, then one
+        # fine sweep inside it. Feasibility is usually monotone in the node
+        # count, but per-node DaemonSet load interacting with the occupancy
+        # caps can make it non-monotone — so a coarse pass with no feasible
+        # point falls back to sweeping every unprobed count.
+        coarse = sorted({0, kmax} | {2**i for i in range(kmax.bit_length()) if 2**i <= kmax})
+        ok = self._feasible_counts(prep, n_real, coarse)
+        feasible_ks = [k for k, good in zip(coarse, ok) if good]
+        if not feasible_ks:
+            rest = [k for k in range(kmax + 1) if k not in set(coarse)]
+            if not rest:
+                return None
+            ok = self._feasible_counts(prep, n_real, rest)
+            feasible_rest = [k for k, good in zip(rest, ok) if good]
+            return min(feasible_rest) if feasible_rest else None
+        hi = min(feasible_ks)
+        lo = max([k for k in coarse if k < hi], default=0)
+        if hi == 0 or hi == lo + 1:
+            return int(hi)
+        fine = list(range(lo + 1, hi))
+        ok = self._feasible_counts(prep, n_real, fine)
+        for k, good in zip(fine, ok):
+            if good:
+                return int(k)
+        return int(hi)
+
+    def _feasible_counts(self, prep, n_real: int, ks: List[int]) -> List[bool]:
+        """One sharded sweep over candidate new-node counts; a count is
+        feasible when everything schedules within the env caps."""
+        N = prep.ec.node_valid.shape[0]
+        P = len(prep.ordered)
         S = len(ks)
         node_valid = np.zeros((S, N), dtype=bool)
         for s, k in enumerate(ks):
             node_valid[s, : n_real + k] = True
-        P = len(prep.ordered)
         pod_valid = np.ones((S, P), dtype=bool)
         for p, target in enumerate(prep.ds_target):
             if target >= n_real:  # DaemonSet pod pinned to a candidate node
@@ -231,8 +260,10 @@ class Applier:
 
         from ..encoding.vocab import RES_CPU, RES_MEMORY
 
-        for s, k in enumerate(ks):
+        out = []
+        for s in range(S):
             if unscheduled[s] > 0:
+                out.append(False)
                 continue
             nv = node_valid[s]
             tot_cpu = float(alloc[nv, RES_CPU].sum())
@@ -241,9 +272,8 @@ class Applier:
             mem_occ = int(used[s, nv, RES_MEMORY].sum() / tot_mem * 100) if tot_mem else 0
             tot_vg = float(vg_caps[nv].sum())
             vg_occ = int(vg_used[s] / tot_vg * 100) if tot_vg else 0
-            if cpu_occ <= max_cpu and mem_occ <= max_mem and vg_occ <= max_vg:
-                return int(k)
-        return None
+            out.append(cpu_occ <= max_cpu and mem_occ <= max_mem and vg_occ <= max_vg)
+        return out
 
     # -- run ----------------------------------------------------------------
 
@@ -259,6 +289,9 @@ class Applier:
                 self.out.close()
 
     def _run_inner(self) -> int:
+        from ..parallel.multihost import initialize
+
+        initialize()  # no-op unless JAX_COORDINATOR is set (DCN scale-out)
         cluster = self.load_cluster()
         apps = self.load_apps()
         template = self.load_new_node()

@@ -175,6 +175,17 @@ def make_handler(server: SimonServer):
         def do_GET(self):
             if self.path == "/healthz":
                 self._send(200, {"status": "ok"})
+            elif self.path.startswith("/debug/profiler"):
+                # pprof analogue (the reference registers pprof on gin,
+                # server.go:152): start the JAX profiler server and report
+                # where TensorBoard can connect
+                from ..utils.trace import start_profiler
+
+                try:
+                    port = start_profiler()
+                    self._send(200, {"profiler": "running", "port": port, "ui": "tensorboard --logdir ... (trace viewer)"})
+                except Exception as e:
+                    self._send(500, {"error": str(e)})
             else:
                 self._send(404, {"error": "not found"})
 

@@ -19,6 +19,10 @@ from ..encoding.state import EncodedCluster, ScanState
 _FORMAT_VERSION = 1
 
 
+def _npz_path(path: str) -> str:
+    return path if path.endswith(".npz") else path + ".npz"
+
+
 def save_state(path: str, ec: EncodedCluster, st: ScanState, extra: dict | None = None) -> None:
     arrays = {}
     for name, arr in ec._asdict().items():
@@ -28,11 +32,11 @@ def save_state(path: str, ec: EncodedCluster, st: ScanState, extra: dict | None 
     arrays["__meta__"] = np.frombuffer(
         json.dumps({"version": _FORMAT_VERSION, "extra": extra or {}}).encode(), dtype=np.uint8
     )
-    np.savez_compressed(path, **arrays)
+    np.savez_compressed(_npz_path(path), **arrays)
 
 
 def load_state(path: str) -> Tuple[EncodedCluster, ScanState, dict]:
-    with np.load(path) as data:
+    with np.load(_npz_path(path)) as data:
         meta = json.loads(bytes(data["__meta__"]).decode())
         if meta.get("version") != _FORMAT_VERSION:
             raise ValueError(f"{path}: unsupported checkpoint version {meta.get('version')}")

@@ -1,0 +1,201 @@
+"""Fast-path selection and input marshalling for the Pallas megakernel.
+
+`applicable()` decides whether a prepared simulation can run on
+`ops/pallas_scan.run_fast_scan` (feature subset + layout constraints);
+`schedule()` marshals the encoded cluster into the kernel's VMEM/SMEM
+layouts and runs it. Placements are identical to the XLA scan — the tests
+in tests/test_fastpath.py assert equality — so callers can switch freely.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..encoding import vocab as V
+from ..ops import kernels
+from ..ops.pallas_scan import CHUNK, FastInputs, run_fast_scan
+from .schedconfig import DEFAULT_CONFIG
+
+HOSTNAME = "kubernetes.io/hostname"
+
+_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def applicable(prep, config=None) -> bool:
+    """The megakernel covers: static filters + fit + least/balanced/share +
+    topology spread, hostname plus at most one other topology key."""
+    if config is not None and config != DEFAULT_CONFIG:
+        return False
+    f = prep.features
+    if f.ports or f.gpu or f.local or f.interpod or f.prefg:
+        return False
+    if f.pref_node_affinity or f.prefer_taints:
+        return False
+    ec = prep.ec_np if prep.ec_np is not None else prep.ec
+    N = int(ec.node_valid.shape[0])
+    if N % 128 != 0:
+        return False
+    U = int(ec.req.shape[0])
+    A = int(ec.matches_sel.shape[1])
+    R = int(ec.alloc.shape[1])
+    if R > 8 or U > 512 or A > 64:
+        return False
+    vocab = prep.meta.vocab
+    topo_keys = vocab.topo_keys.items()
+    non_host = [k for k in topo_keys if k != HOSTNAME]
+    if len(non_host) > 1:
+        return False
+    # hostname domains must be node-identity (each valid node carries its
+    # own hostname label) for the per-node count layout to be exact
+    if HOSTNAME in topo_keys:
+        tk = topo_keys.index(HOSTNAME)
+        nd = np.asarray(ec.node_domain)[:, tk]
+        nv = np.asarray(ec.node_valid)
+        trash = np.asarray(ec.domain_topo).shape[0] - 1
+        if (nd[nv] == trash).any():
+            return False
+        if len(np.unique(nd[nv])) != int(nv.sum()):
+            return False
+    # pallas compiled path only on TPU; elsewhere the interpreter would be
+    # slower than the XLA scan (tests force it via OPENSIM_FASTPATH=interpret)
+    import os
+
+    if jax.default_backend() != "tpu" and os.environ.get("OPENSIM_FASTPATH") != "interpret":
+        return False
+    # VMEM budget: three [U, N] tables, used/used_out [R, N] ×2, node_cnt
+    # [A, N], zone tables [N, Z] ×2 + [A, Z], masks/misc
+    if non_host:
+        tk = topo_keys.index(non_host[0])
+        nd = np.asarray(ec.node_domain)[:, tk]
+        Z = max(128, 128 * math.ceil(len(np.unique(nd)) / 128))
+    else:
+        Z = 128
+    vmem = ((3 * U + 4 * R + A + 4) * N + (2 * N + A) * Z) * 4
+    if vmem > _VMEM_BUDGET:
+        return False
+    return True
+
+
+_precompute_jit = jax.jit(kernels.precompute_static)
+
+
+def build_inputs(prep) -> Tuple[FastInputs, dict]:
+    cached = getattr(prep, "_fast_inputs", None)
+    if cached is not None:
+        return cached
+    # host-side numpy views: per-array np.asarray on device arrays costs a
+    # tunnel RPC each, so use the retained numpy EncodedCluster and fetch the
+    # static tables with one batched device_get
+    ec = prep.ec_np if prep.ec_np is not None else jax.device_get(prep.ec)
+    stat = jax.device_get(_precompute_jit(prep.ec))
+    N = int(ec.node_valid.shape[0])
+    U = int(ec.req.shape[0])
+    A = int(ec.matches_sel.shape[1])
+    R = int(ec.alloc.shape[1])
+    vocab = prep.meta.vocab
+    topo_keys = vocab.topo_keys.items()
+    host_tk = topo_keys.index(HOSTNAME) if HOSTNAME in topo_keys else -1
+    zone_tks = [i for i, k in enumerate(topo_keys) if k != HOSTNAME]
+    zone_tk = zone_tks[0] if zone_tks else -1
+
+    node_domain = np.asarray(ec.node_domain)
+    trash = np.asarray(ec.domain_topo).shape[0] - 1
+
+    # zone one-hots (dense, padded to 128 lanes)
+    if zone_tk >= 0:
+        zd = node_domain[:, zone_tk]
+        zone_ids, zone_inv = np.unique(zd, return_inverse=True)
+        Z = max(128, 128 * math.ceil(max(len(zone_ids), 1) / 128))
+        zone_NZ = np.zeros((N, Z), np.float32)
+        present = zd != trash
+        zone_NZ[np.arange(N)[present], zone_inv[present]] = 1.0
+        has_zone = present.astype(np.float32)[None, :]
+    else:
+        Z = 128
+        zone_NZ = np.zeros((N, Z), np.float32)
+        has_zone = np.zeros((1, N), np.float32)
+    zone_ZN = np.ascontiguousarray(zone_NZ.T)
+
+    A_pad = max(8, 8 * math.ceil(A / 8))
+    matches_AU = np.zeros((A_pad, U), np.float32)
+    matches_AU[:A, :] = np.asarray(ec.matches_sel).T.astype(np.float32)
+
+    spr_topo = np.asarray(ec.spr_topo)
+    Cs = spr_topo.shape[1]
+    spr_active = (spr_topo >= 0).astype(np.int32)
+    spr_hostname = (spr_topo == host_tk).astype(np.int32)
+    spr_sel = np.maximum(np.asarray(ec.spr_sel), 0).astype(np.int32)
+    spr_skew = np.asarray(ec.spr_skew).astype(np.float32)
+    spr_hard = np.asarray(ec.spr_hard).astype(np.int32)
+    matches_sel = np.asarray(ec.matches_sel)
+    spr_self = np.zeros((U, Cs), np.float32)
+    spread_weight = np.asarray(stat.spread_weight)
+    spr_weight = np.zeros((U, Cs), np.float32)
+    for u in range(U):
+        for c in range(Cs):
+            if spr_topo[u, c] >= 0:
+                spr_self[u, c] = float(matches_sel[u, spr_sel[u, c]])
+                spr_weight[u, c] = float(spread_weight[spr_topo[u, c]])
+
+    req_np = np.asarray(ec.req).astype(np.float32)
+    cpu_nz = np.where(req_np[:, V.RES_CPU] > 0, req_np[:, V.RES_CPU], 100.0).astype(np.float32)
+    mem_nz = np.where(req_np[:, V.RES_MEMORY] > 0, req_np[:, V.RES_MEMORY], 200.0 * 1024 * 1024).astype(
+        np.float32
+    )
+
+    fi = FastInputs(
+        alloc_T=np.ascontiguousarray(np.asarray(ec.alloc).T.astype(np.float32)),
+        used0_T=np.ascontiguousarray(np.asarray(jax.device_get(prep.st0.used)).T.astype(np.float32)),
+        static_pass=np.asarray(stat.static_pass).astype(np.float32),
+        aff_mask=np.asarray(stat.aff_mask).astype(np.float32),
+        share_raw=np.asarray(stat.share_raw).astype(np.float32),
+        share_const=np.zeros((U,), np.float32),  # folded into share_raw already
+        zone_NZ=zone_NZ,
+        zone_ZN=zone_ZN,
+        has_zone=has_zone,
+        matches_AU=matches_AU,
+        node_valid=np.asarray(ec.node_valid).astype(np.float32)[None, :],
+        req=req_np,
+        cpu_nz=cpu_nz,
+        mem_nz=mem_nz,
+        pin=np.asarray(ec.pin).astype(np.int32),
+        spr_active=spr_active,
+        spr_hostname=spr_hostname,
+        spr_sel=spr_sel,
+        spr_skew=spr_skew,
+        spr_hard=spr_hard,
+        spr_self=spr_self,
+        spr_weight=spr_weight,
+    )
+    meta = {"static_fail": np.asarray(stat.static_fail)}
+    # device-resident copies so repeated runs (capacity loops, sweeps) skip
+    # the host→device transfer of ~25 arrays
+    fi = FastInputs(*[jax.numpy.asarray(a) for a in fi])
+    try:
+        prep._fast_inputs = (fi, meta)
+    except AttributeError:
+        pass
+    return fi, meta
+
+
+def schedule(prep, tmpl_ids, pod_valid, forced, interpret: Optional[bool] = None):
+    """Run the megakernel on a padded pod stream (P % CHUNK == 0).
+    Returns (chosen [P] i32, used_final [N, R], static_fail [U, 4])."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    fi, meta = build_inputs(prep)
+    tmpl_ids = np.asarray(tmpl_ids)
+    pod_valid = np.asarray(pod_valid)
+    forced = np.asarray(forced)
+    P = len(tmpl_ids)
+    pad = (-P) % CHUNK
+    if pad:
+        tmpl_ids = np.concatenate([tmpl_ids, np.zeros(pad, tmpl_ids.dtype)])
+        pod_valid = np.concatenate([pod_valid, np.zeros(pad, bool)])
+        forced = np.concatenate([forced, np.zeros(pad, bool)])
+    chosen, used_T = run_fast_scan(fi, tmpl_ids, pod_valid, forced, interpret=interpret)
+    return np.asarray(chosen)[:P], np.asarray(used_T).T, meta["static_fail"]

@@ -1,0 +1,341 @@
+"""Pallas megakernel for the bind scan (fast path).
+
+The XLA scan pays ~5 µs of per-op overhead for each of the ~30 HLO ops in
+a scheduling step. This kernel fuses the entire step — static-filter gather,
+resource fit, Least/BalancedAllocation, Simon share, PodTopologySpread
+(hard + soft), selectHost, and the bind state update — into ONE Pallas
+program whose cluster state lives in VMEM for the whole scan: a bind costs
+VMEM-bandwidth, not kernel launches.
+
+Scope: workloads whose feature set is {resources, static filters, topology
+spread} — i.e. `Features(ports=False, gpu=False, local=False,
+interpod=False, prefg=False, ...)` with the default SchedulerConfig and at
+most two topology keys (hostname + one zone-like key). Everything else
+falls back to `engine.scheduler.schedule_pods`; `engine/fastpath.py` makes
+the choice and guarantees identical placements (tests assert equality).
+
+Layouts (N = padded node axis, lanes; rows padded to sublane multiples):
+  alloc_T     [R, N]   f32   allocatable per resource row
+  used        [R, N]   f32   scratch, persistent across the grid
+  static_pass [U, N]   f32   0/1 from kernels.precompute_static
+  aff_mask    [U, N]   f32   node-affinity mask (spread eligibility)
+  share_raw   [U, N]   f32   Simon share × 100
+  node_cnt    [A, N]   f32   scratch — per-hostname-domain selector counts
+  zone_cnt    [A, Z]   f32   scratch — per-zone selector counts
+  zone_NZ     [N, Z]   f32   node → zone one-hot
+  zone_ZN     [Z, N]   f32   transpose (for the gather matvec)
+  matches_AU  [A, U]   f32   selector-match matrix (column = template)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..encoding import vocab as V
+
+NEG = -1e30
+MAX_SCORE = 100.0
+# SMEM int32 streams tile at 1024 on current Mosaic; block shapes must match
+CHUNK = 1024
+
+
+class FastInputs(NamedTuple):
+    """Host-prepared tensors for the kernel (see engine/fastpath.py)."""
+
+    alloc_T: np.ndarray  # [R, N]
+    used0_T: np.ndarray  # [R, N]
+    static_pass: np.ndarray  # [U, N]
+    aff_mask: np.ndarray  # [U, N]
+    share_raw: np.ndarray  # [U, N]
+    share_const: np.ndarray  # [U] 1.0 where the template has no requests (score = Max everywhere)
+    zone_NZ: np.ndarray  # [N, Z]
+    zone_ZN: np.ndarray  # [Z, N]
+    has_zone: np.ndarray  # [1, N] f32
+    matches_AU: np.ndarray  # [A, U]
+    node_valid: np.ndarray  # [1, N] f32
+    # SMEM scalar tables
+    req: np.ndarray  # [U, R] f32
+    cpu_nz: np.ndarray  # [U] f32 nonzero-default cpu (milli)
+    mem_nz: np.ndarray  # [U] f32 nonzero-default memory
+    pin: np.ndarray  # [U] i32
+    # spread constraints, [U, Cs] each
+    spr_active: np.ndarray  # i32 0/1
+    spr_hostname: np.ndarray  # i32 1 = hostname topology
+    spr_sel: np.ndarray  # i32 selector id
+    spr_skew: np.ndarray  # f32
+    spr_hard: np.ndarray  # i32 0/1
+    spr_self: np.ndarray  # f32 0/1 template matches own selector
+    spr_weight: np.ndarray  # f32 log(size+2)
+
+
+def _kernel(
+    # scalar-prefetch / SMEM inputs
+    tmpl_ref,  # [CHUNK] i32
+    valid_ref,  # [CHUNK] i32
+    forced_ref,  # [CHUNK] i32
+    req_ref,  # [U, R] f32 SMEM
+    cpu_nz_ref,  # [U] f32 SMEM
+    mem_nz_ref,  # [U] f32 SMEM
+    pin_ref,  # [U] i32 SMEM
+    sa_ref, sh_ref, ss_ref, sk_ref, shard_ref, sself_ref, sw_ref,  # [U, Cs] SMEM
+    share_const_ref,  # [U] f32 SMEM
+    # VMEM inputs
+    alloc_ref,  # [R, N]
+    used0_ref,  # [R, N]
+    static_ref,  # [U, N]
+    affm_ref,  # [U, N]
+    shraw_ref,  # [U, N]
+    zone_nz_ref,  # [N, Z]
+    zone_zn_ref,  # [Z, N]
+    has_zone_ref,  # [1, N]
+    matches_ref,  # [A, U]
+    nodevalid_ref,  # [1, N]
+    # outputs
+    chosen_ref,  # [CHUNK] i32 SMEM
+    used_out_ref,  # [R, N] VMEM
+    # scratch
+    used_ref,  # [R, N]
+    node_cnt_ref,  # [A, N]
+    zone_cnt_ref,  # [A, Z]
+):
+    R, N = alloc_ref.shape
+    U = static_ref.shape[0]
+    A = node_cnt_ref.shape[0]
+    Z = zone_cnt_ref.shape[1]
+    Cs = sa_ref.shape[1]
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        used_ref[:] = used0_ref[:]
+        node_cnt_ref[:] = jnp.zeros_like(node_cnt_ref)
+        zone_cnt_ref[:] = jnp.zeros_like(zone_cnt_ref)
+
+    iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
+    iota_u = jax.lax.broadcasted_iota(jnp.int32, (U, 1), 0)
+    valid_row = nodevalid_ref[:]  # [1, N]
+
+    def body(i, _):
+        u = tmpl_ref[i]
+
+        static_row = static_ref[pl.ds(u, 1), :]  # [1, N] (valid folded in)
+
+        # --- NodeResourcesFit
+        fit = jnp.ones((1, N), jnp.float32)
+        for r in range(R):
+            req_r = req_ref[u, r]
+            over = (used_ref[pl.ds(r, 1), :] + req_r > alloc_ref[pl.ds(r, 1), :]).astype(jnp.float32)
+            fit = fit * jnp.where(req_r > 0, 1.0 - over, 1.0)
+
+        feasible = static_row * fit  # [1, N] f32 mask
+
+        # --- PodTopologySpread + scores that need per-constraint counts
+        aff_row = affm_ref[pl.ds(u, 1), :] * valid_row  # eligibility for min
+        soft_raw = jnp.zeros((1, N), jnp.float32)
+        ignored = jnp.zeros((1, N), jnp.float32)  # feasible nodes missing a soft topo label
+        any_soft = jnp.float32(0.0)
+        for c in range(Cs):
+            active = sa_ref[u, c]
+            is_host = sh_ref[u, c]
+            sel = ss_ref[u, c]
+            skew = sk_ref[u, c]
+            hard = shard_ref[u, c]
+            selfm = sself_ref[u, c]
+            weight = sw_ref[u, c]
+
+            host_cnt = node_cnt_ref[pl.ds(sel, 1), :]  # [1, N]
+            zrow = zone_cnt_ref[pl.ds(sel, 1), :]  # [1, Z]
+            zone_gather = jnp.dot(
+                zrow, zone_zn_ref[:], preferred_element_type=jnp.float32
+            )  # [1, N]
+            cnt = jnp.where(is_host == 1, host_cnt, zone_gather)
+            has_label = jnp.where(is_host == 1, jnp.ones((1, N), jnp.float32), has_zone_ref[:])
+
+            activef = (active == 1)
+            hardf = activef & (hard == 1)
+            softf = activef & (hard == 0)
+
+            # hard constraint: cnt + self - min(eligible) <= skew
+            elig = aff_row * has_label
+            masked = jnp.where(elig > 0, cnt, jnp.float32(1e30))
+            min_cnt = jnp.min(masked)
+            ok = (cnt + selfm - min_cnt <= skew) & (has_label > 0)
+            feasible = jnp.where(hardf, feasible * ok.astype(jnp.float32), feasible)
+
+            # soft constraint: raw score contribution
+            contrib = jnp.where(has_label > 0, cnt * weight + (skew - 1.0), 0.0)
+            soft_raw = soft_raw + jnp.where(softf, contrib, 0.0)
+            ignored = jnp.maximum(
+                ignored, jnp.where(softf, (1.0 - has_label), 0.0)
+            )
+            any_soft = jnp.maximum(any_soft, jnp.where(softf, 1.0, 0.0))
+
+        # --- scores
+        cpu_req = cpu_nz_ref[u]
+        mem_req = mem_nz_ref[u]
+        alloc_cpu = alloc_ref[pl.ds(V.RES_CPU, 1), :]
+        alloc_mem = alloc_ref[pl.ds(V.RES_MEMORY, 1), :]
+        used_cpu = used_ref[pl.ds(V.RES_CPU, 1), :] + cpu_req
+        used_mem = used_ref[pl.ds(V.RES_MEMORY, 1), :] + mem_req
+        l_cpu = jnp.where(
+            (alloc_cpu == 0) | (used_cpu > alloc_cpu),
+            0.0,
+            (alloc_cpu - used_cpu) * MAX_SCORE / jnp.maximum(alloc_cpu, 1.0),
+        )
+        l_mem = jnp.where(
+            (alloc_mem == 0) | (used_mem > alloc_mem),
+            0.0,
+            (alloc_mem - used_mem) * MAX_SCORE / jnp.maximum(alloc_mem, 1.0),
+        )
+        least = (l_cpu + l_mem) / 2.0
+        cpu_frac = used_cpu / jnp.maximum(alloc_cpu, 1.0)
+        mem_frac = used_mem / jnp.maximum(alloc_mem, 1.0)
+        balanced = jnp.where(
+            (cpu_frac >= 1.0) | (mem_frac >= 1.0),
+            0.0,
+            (1.0 - jnp.abs(cpu_frac - mem_frac)) * MAX_SCORE,
+        )
+
+        share_row = shraw_ref[pl.ds(u, 1), :]
+        share_row = jnp.where(share_const_ref[u] > 0, jnp.full((1, N), MAX_SCORE), share_row)
+        feas_b = feasible > 0
+        lo = jnp.min(jnp.where(feas_b, share_row, jnp.float32(1e30)))
+        hi = jnp.max(jnp.where(feas_b, share_row, jnp.float32(-1e30)))
+        rng = hi - lo
+        share_norm = jnp.where(rng > 0, (share_row - lo) * MAX_SCORE / rng, 0.0)
+
+        scored = feas_b & (ignored == 0)
+        smn = jnp.min(jnp.where(scored, soft_raw, jnp.float32(1e30)))
+        smx = jnp.max(jnp.where(scored, soft_raw, jnp.float32(-1e30)))
+        spread_norm = jnp.where(
+            smx <= 0, MAX_SCORE, MAX_SCORE * (smx + smn - soft_raw) / jnp.maximum(smx, 1.0)
+        )
+        spread_norm = jnp.where(ignored > 0, 0.0, spread_norm)
+        spread_norm = jnp.where(any_soft > 0, spread_norm, 0.0)
+
+        score = least + balanced + 2.0 * share_norm + 2.0 * spread_norm
+
+        # --- selectHost: lowest index among maxima — Mosaic's argmax breaks
+        # ties by HIGHEST index, diverging from the XLA scan's first-max
+        masked_score = jnp.where(feas_b, score, jnp.float32(NEG))
+        mx_score = jnp.max(masked_score)
+        best = jnp.min(jnp.where(masked_score == mx_score, iota_n, N)).astype(jnp.int32)
+        any_feasible = jnp.max(feasible) > 0
+        sel_choice = jnp.where(any_feasible, best, jnp.int32(-1))
+        is_forced = forced_ref[i] == 1
+        pin_u = pin_ref[u]
+        choice = jnp.where(is_forced, jnp.where(pin_u >= 0, pin_u, -1), sel_choice)
+        do_bind = (valid_ref[i] == 1) & (choice >= 0)
+        choice_out = jnp.where(do_bind, choice, -1)
+        chosen_ref[i] = choice_out
+
+        # --- bind update
+        @pl.when(do_bind)
+        def _bind():
+            c = jnp.maximum(choice, 0)
+            onehot = (iota_n == c).astype(jnp.float32)  # [1, N]
+            iota_r = jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0)
+            req_col = jnp.zeros((R, 1), jnp.float32)
+            for r in range(R):  # static unroll; .at[] would lower to scatter
+                req_col = jnp.where(iota_r == r, req_ref[u, r], req_col)
+            used_ref[:] = used_ref[:] + req_col * onehot
+
+            # matches column u via one-hot matvec: [A, U] @ [U, 1]
+            onehot_u = (iota_u == u).astype(jnp.float32)  # [U, 1]
+            m_col = jnp.dot(matches_ref[:], onehot_u, preferred_element_type=jnp.float32)  # [A, 1]
+            node_cnt_ref[:] = node_cnt_ref[:] + m_col * onehot
+            zrow_c = zone_nz_ref[pl.ds(c, 1), :]  # [1, Z]
+            zone_cnt_ref[:] = zone_cnt_ref[:] + m_col * zrow_c
+
+        return 0
+
+    jax.lax.fori_loop(0, tmpl_ref.shape[0], body, 0)
+    used_out_ref[:] = used_ref[:]
+
+
+def run_fast_scan(fi: FastInputs, tmpl_ids, pod_valid, forced, interpret: bool = False):
+    """Execute the megakernel. tmpl_ids/pod_valid/forced are [P] (P a
+    multiple of CHUNK). Returns (chosen [P] i32, used_final [R, N])."""
+    P = tmpl_ids.shape[0]
+    assert P % CHUNK == 0, P
+    R, N = fi.alloc_T.shape
+    grid = (P // CHUNK,)
+
+    smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
+    vmem = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
+
+    out = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        out_shape=(
+            jax.ShapeDtypeStruct((P,), jnp.int32),
+            jax.ShapeDtypeStruct((R, N), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM),  # tmpl
+            pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM),  # valid
+            pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM),  # forced
+            smem(),  # req
+            smem(),  # cpu_nz
+            smem(),  # mem_nz
+            smem(),  # pin
+            smem(), smem(), smem(), smem(), smem(), smem(), smem(),  # spread tables
+            smem(),  # share_const
+            vmem(),  # alloc
+            vmem(),  # used0
+            vmem(),  # static
+            vmem(),  # aff
+            vmem(),  # share_raw
+            vmem(),  # zone_NZ
+            vmem(),  # zone_ZN
+            vmem(),  # has_zone
+            vmem(),  # matches
+            vmem(),  # node_valid
+        ],
+        out_specs=(
+            pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((R, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((R, N), jnp.float32),
+            pltpu.VMEM(fi.matches_AU.shape[:1] + (N,), jnp.float32),
+            pltpu.VMEM(fi.matches_AU.shape[:1] + (fi.zone_NZ.shape[1],), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        jnp.asarray(tmpl_ids, jnp.int32),
+        jnp.asarray(pod_valid, jnp.int32),
+        jnp.asarray(forced, jnp.int32),
+        jnp.asarray(fi.req, jnp.float32),
+        jnp.asarray(fi.cpu_nz, jnp.float32),
+        jnp.asarray(fi.mem_nz, jnp.float32),
+        jnp.asarray(fi.pin, jnp.int32),
+        jnp.asarray(fi.spr_active, jnp.int32),
+        jnp.asarray(fi.spr_hostname, jnp.int32),
+        jnp.asarray(fi.spr_sel, jnp.int32),
+        jnp.asarray(fi.spr_skew, jnp.float32),
+        jnp.asarray(fi.spr_hard, jnp.int32),
+        jnp.asarray(fi.spr_self, jnp.float32),
+        jnp.asarray(fi.spr_weight, jnp.float32),
+        jnp.asarray(fi.share_const, jnp.float32),
+        jnp.asarray(fi.alloc_T, jnp.float32),
+        jnp.asarray(fi.used0_T, jnp.float32),
+        jnp.asarray(fi.static_pass, jnp.float32),
+        jnp.asarray(fi.aff_mask, jnp.float32),
+        jnp.asarray(fi.share_raw, jnp.float32),
+        jnp.asarray(fi.zone_NZ, jnp.float32),
+        jnp.asarray(fi.zone_ZN, jnp.float32),
+        jnp.asarray(fi.has_zone, jnp.float32),
+        jnp.asarray(fi.matches_AU, jnp.float32),
+        jnp.asarray(fi.node_valid, jnp.float32),
+    )
+    return out
+
+
+run_fast_scan_jit = jax.jit(run_fast_scan, static_argnames=("interpret",))

@@ -1,0 +1,161 @@
+"""The Pallas megakernel must produce IDENTICAL placements to the XLA scan
+on its supported feature subset (runs in interpret mode on CPU)."""
+
+import numpy as np
+import pytest
+
+from opensim_tpu.engine import fastpath
+
+
+@pytest.fixture(autouse=True)
+def _enable_interpret_fastpath(monkeypatch):
+    """applicable() requires a TPU backend unless interpret mode is forced
+    (the rest of the suite intentionally exercises the XLA path on CPU)."""
+    monkeypatch.setenv("OPENSIM_FASTPATH", "interpret")
+from opensim_tpu.engine.scheduler import pad_pod_stream, schedule_pods
+from opensim_tpu.engine.simulator import AppResource, prepare
+from opensim_tpu.models import ResourceTypes, fixtures as fx
+
+
+def _prep(n_nodes=16, with_spread=True, with_zone=True, replicas=64):
+    cluster = ResourceTypes()
+    for i in range(n_nodes):
+        labels = {}
+        if with_zone and i % 4 != 3:  # some nodes lack the zone label
+            labels["topology.kubernetes.io/zone"] = f"z{i % 3}"
+        cluster.nodes.append(
+            fx.make_fake_node(f"n{i:03d}", "16", "32Gi", "110", fx.with_labels(labels))
+        )
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("plain", replicas, "500m", "1Gi"))
+    app.deployments.append(fx.make_fake_deployment("tiny", replicas // 2, "100m", "128Mi"))
+    if with_spread:
+        app.deployments.append(
+            fx.make_fake_deployment(
+                "spread",
+                replicas // 2,
+                "250m",
+                "512Mi",
+                fx.with_topology_spread(
+                    [
+                        {
+                            "maxSkew": 2,
+                            "topologyKey": "kubernetes.io/hostname",
+                            "whenUnsatisfiable": "DoNotSchedule",
+                            "labelSelector": {"matchLabels": {"app": "spread"}},
+                        },
+                        {
+                            "maxSkew": 3,
+                            "topologyKey": "topology.kubernetes.io/zone",
+                            "whenUnsatisfiable": "ScheduleAnyway",
+                            "labelSelector": {"matchLabels": {"app": "spread"}},
+                        },
+                    ]
+                ),
+            )
+        )
+    # overload so some pods genuinely fail
+    app.deployments.append(fx.make_fake_deployment("fat", 8, "8", "16Gi"))
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
+    assert prep is not None
+    return prep
+
+
+def _xla_chosen(prep):
+    P = len(prep.ordered)
+    t, v, f = pad_pod_stream(prep.tmpl_ids, np.ones(P, bool), prep.forced)
+    out = schedule_pods(prep.ec, prep.st0, t, v, f, features=prep.features)
+    return np.asarray(out.chosen)[:P], np.asarray(out.final_state.used)
+
+
+def test_fastpath_applicable():
+    prep = _prep()
+    assert fastpath.applicable(prep)
+
+
+def test_fastpath_rejects_feature_rich():
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n0"))
+    app = ResourceTypes()
+    app.pods.append(
+        fx.make_fake_pod(
+            "gpu-pod", "1", "1Gi", fx.with_annotations({"alibabacloud.com/gpu-mem": "1Gi", "alibabacloud.com/gpu-count": "1"})
+        )
+    )
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
+    assert not fastpath.applicable(prep)
+
+
+@pytest.mark.parametrize("with_spread,with_zone", [(False, False), (True, True), (True, False)])
+def test_fastpath_matches_xla(with_spread, with_zone):
+    prep = _prep(with_spread=with_spread, with_zone=with_zone)
+    assert fastpath.applicable(prep)
+    P = len(prep.ordered)
+    want_chosen, want_used = _xla_chosen(prep)
+    got_chosen, got_used, _sf = fastpath.schedule(
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+    )
+    mismatches = np.nonzero(want_chosen != got_chosen)[0]
+    assert mismatches.size == 0, (
+        f"{mismatches.size} placement mismatches, first at {mismatches[:5]}: "
+        f"xla={want_chosen[mismatches[:5]]} pallas={got_chosen[mismatches[:5]]}"
+    )
+    np.testing.assert_allclose(got_used, want_used, rtol=1e-5)
+
+
+def test_fastpath_engages_through_simulate(monkeypatch):
+    """End-to-end: simulate() must take the fast branch (interpret mode on
+    CPU via OPENSIM_FASTPATH) and produce the same placements as the XLA
+    path."""
+    from opensim_tpu.engine import fastpath as fp
+    from opensim_tpu.engine.simulator import simulate
+
+    monkeypatch.setenv("OPENSIM_FASTPATH", "interpret")
+    calls = []
+    orig = fp.schedule
+
+    def spy(*args, **kwargs):
+        calls.append(1)
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(fp, "schedule", spy)
+
+    cluster = ResourceTypes()
+    for i in range(4):
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("web", 8, "1", "1Gi"))
+    res = simulate(cluster, [AppResource("a", app)])
+    assert calls, "fast path did not engage"
+    assert not res.unscheduled_pods
+    per_node = sorted(len(ns.pods) for ns in res.node_status)
+    assert sum(per_node) == 8
+
+    # same workload through the XLA path gives identical placement (pod
+    # names get fresh suffixes per expansion; compare in name order)
+    monkeypatch.delenv("OPENSIM_FASTPATH")
+    res2 = simulate(cluster, [AppResource("a", app)])
+
+    def placement_seq(r):
+        pairs = [(p.metadata.name, ns.node.metadata.name) for ns in r.node_status for p in ns.pods]
+        return [node for _name, node in sorted(pairs)]
+
+    assert placement_seq(res) == placement_seq(res2)
+
+
+def test_fastpath_forced_pods():
+    cluster = ResourceTypes()
+    for i in range(4):
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+    cluster.pods.append(fx.make_fake_pod("pinned", "1", "1Gi", fx.with_node_name("n2")))
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("d", 6, "1", "1Gi"))
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
+    assert fastpath.applicable(prep)
+    P = len(prep.ordered)
+    want_chosen, want_used = _xla_chosen(prep)
+    got_chosen, got_used, _ = fastpath.schedule(
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+    )
+    np.testing.assert_array_equal(got_chosen, want_chosen)
+    np.testing.assert_allclose(got_used, want_used, rtol=1e-5)

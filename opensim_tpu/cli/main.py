@@ -48,6 +48,7 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated extended resource reports (gpu,open-local)",
     )
     apply_p.add_argument("--max-new-nodes", type=int, default=128, help="upper bound for the node sweep")
+    apply_p.add_argument("--report-pods", action="store_true", help="include the per-node Pod Info table")
 
     server_p = sub.add_parser("server", help="start the simon REST server")
     server_p.add_argument("--kubeconfig", default="", help="kubeconfig of the real cluster")
@@ -84,6 +85,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             use_greed=args.use_greed,
             interactive=args.interactive,
             extended_resources=[r for r in args.extended_resources.split(",") if r],
+            report_pods=args.report_pods,
             max_new_nodes=args.max_new_nodes,
         )
         try:

@@ -212,17 +212,10 @@ class TemplateSet:
         # -- extensions (gpu-share, open-local)
         t.gpu_mem = pod.gpu_mem_request()
         t.gpu_count = pod.gpu_count_request()
-        from ..models.objects import ANNO_POD_LOCAL_STORAGE
-
-        storage_anno = pod.metadata.annotations.get(ANNO_POD_LOCAL_STORAGE)
-        if storage_anno:
-            try:
-                vols = json.loads(storage_anno).get("volumes") or []
-                t.local_volumes = tuple(
-                    (str(v.get("kind", "")), int(v.get("size", 0)), str(v.get("scName", ""))) for v in vols
-                )
-            except (ValueError, AttributeError):
-                t.local_volumes = ()
+        t.local_volumes = tuple(
+            (str(v.get("kind", "")), int(v.get("size", 0)), str(v.get("scName", "")))
+            for v in pod.local_volumes()
+        )
         return t
 
     def _pod_term(self, ns: str, term: dict) -> PodAffinityTerm:

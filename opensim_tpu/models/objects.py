@@ -307,6 +307,21 @@ class Pod:
         except ValueError:
             return 0.0
 
+    def local_volumes(self) -> list:
+        """Decode the simon/pod-local-storage annotation (volume dicts with
+        kind/size/scName); the single parser shared by encoding and reports."""
+        import json
+
+        raw = self.metadata.annotations.get(ANNO_POD_LOCAL_STORAGE)
+        if not raw:
+            return []
+        try:
+            data = json.loads(raw)
+            vols = data.get("volumes") if isinstance(data, dict) else None
+        except ValueError:
+            return []
+        return [v for v in (vols or []) if isinstance(v, dict)]
+
     def gpu_count_request(self) -> int:
         try:
             cnt = int(self.metadata.annotations.get(RES_GPU_COUNT, "0"))

@@ -70,6 +70,7 @@ class Options:
     use_greed: bool = False
     interactive: bool = False
     extended_resources: List[str] = field(default_factory=list)
+    report_pods: bool = False  # include the per-node Pod Info table
     max_new_nodes: int = 128  # sweep upper bound (auto mode)
     base_dir: str = ""  # paths in the config resolve relative to this
 
@@ -329,6 +330,7 @@ class Applier:
             extended_resources=self.opts.extended_resources,
             app_names=[a.name for a in apps],
             out=self.out,
+            pod_nodes=[] if self.opts.report_pods else None,
         )
         return 0
 
@@ -377,10 +379,17 @@ class Applier:
                 else:
                     break
         print("Simulation success!", file=self.out)
+        # reportNodeInfo (apply.go:528-545) asks which nodes to detail
+        try:
+            nodes = input("nodes to report pods for (comma-separated, empty = all, '-' = none) > ").strip()
+        except EOFError:
+            nodes = "-"  # scripted stdin exhausted: skip the pod table
+        pod_nodes = None if nodes == "-" else [n.strip() for n in nodes.split(",") if n.strip()]
         report_mod.report(
             result,
             extended_resources=self.opts.extended_resources,
             app_names=[a.name for a in apps],
             out=self.out,
+            pod_nodes=pod_nodes,
         )
         return 0

@@ -13,6 +13,7 @@ from ..models.objects import (
     ANNO_GPU_INDEX,
     ANNO_NODE_GPU_SHARE,
     ANNO_NODE_LOCAL_STORAGE,
+    ANNO_POD_LOCAL_STORAGE,
     LABEL_APP_NAME,
     LABEL_NEW_NODE,
     RES_GPU_COUNT,
@@ -42,9 +43,51 @@ def report(
     extended_resources: List[str],
     app_names: List[str],
     out: TextIO = sys.stdout,
+    pod_nodes: List[str] = None,
 ) -> None:
     report_cluster_info(result, extended_resources, out)
+    if pod_nodes is not None:
+        report_node_info(result, extended_resources, pod_nodes, out)
     report_app_info(result, app_names, out)
+
+
+def report_node_info(
+    result: SimulateResult, extended: List[str], nodes: List[str], out: TextIO
+) -> None:
+    """Pod Info per node — reportNodeInfo (apply.go:528-597); the reference
+    prompts for the node selection, here the caller passes it (empty list =
+    every node)."""
+    selected = set(nodes) if nodes else {ns.node.metadata.name for ns in result.node_status}
+    print("Pod Info", file=out)
+    header = ["Node", "Pod", "App Name", "CPU Requests", "Memory Requests"]
+    if contains_local_storage(extended):
+        header.append("Volume Request")
+    if contains_gpu(extended):
+        header.append("GPU Mem Requests")
+    rows = [header]
+    for status in result.node_status:
+        if status.node.metadata.name not in selected:
+            continue
+        for pod in status.pods:
+            req = pod.resource_requests()
+            row = [
+                status.node.metadata.name,
+                f"{pod.metadata.namespace}/{pod.metadata.name}",
+                pod.metadata.labels.get(LABEL_APP_NAME, ""),
+                format_milli(int(req.get("cpu", 0.0) * 1000)),
+                format_quantity(req.get("memory", 0.0)),
+            ]
+            if contains_local_storage(extended):
+                sizes = [
+                    f"{v.get('kind')}:{format_quantity(float(v.get('size', 0) or 0))}"
+                    for v in pod.local_volumes()
+                ]
+                row.append(",".join(sizes))
+            if contains_gpu(extended):
+                row.append(format_quantity(pod.gpu_mem_request() * pod.gpu_count_request()))
+            rows.append(row)
+    _table(rows, out)
+    print("", file=out)
 
 
 def report_cluster_info(result: SimulateResult, extended: List[str], out: TextIO) -> None:

@@ -1,0 +1,96 @@
+"""Coverage for the remaining small components: greed queue, chart
+tarballs, the scale-apps endpoint, report pod table, CLI doc generation."""
+
+import json
+import os
+import tarfile
+import threading
+import urllib.request
+
+from opensim_tpu.engine.queues import greed_sort
+from opensim_tpu.engine.simulator import AppResource, simulate
+from opensim_tpu.models import ResourceTypes
+from opensim_tpu.models import fixtures as fx
+
+
+def test_greed_sort_order():
+    nodes = [fx.make_fake_node("n0", "10", "100Gi")]
+    pods = [
+        fx.make_fake_pod("small", "100m", "1Gi"),
+        fx.make_fake_pod("big", "8", "10Gi"),
+        fx.make_fake_pod("pinned", "50m", "1Gi", fx.with_node_name("n0")),
+        fx.make_fake_pod("mid", "2", "2Gi"),
+    ]
+    ordered = [p.metadata.name for p in greed_sort(nodes, pods)]
+    # nodeName-pinned first, then descending dominant share (greed.go:37-67)
+    assert ordered == ["pinned", "big", "mid", "small"]
+
+
+def test_chart_tarball(tmp_path):
+    from opensim_tpu.chart.render import process_chart
+
+    src = "/root/reference/example/application/charts/yoda"
+    tgz = tmp_path / "yoda.tgz"
+    with tarfile.open(tgz, "w:gz") as tf:
+        tf.add(src, arcname="yoda")
+    docs = process_chart("yoda", str(tgz))
+    assert len(docs) >= 10
+    assert "{{" not in "\n".join(docs)
+
+
+def test_scale_apps_endpoint():
+    from http.server import ThreadingHTTPServer
+
+    from opensim_tpu.server.rest import SimonServer, make_handler
+
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n1", "8", "16Gi"))
+    # an existing deployment's pods are bound in the snapshot
+    existing = fx.make_fake_deployment("web", 2, "1", "1Gi")
+    res = simulate(cluster, [AppResource("seed", ResourceTypes(deployments=[existing]))])
+    for ns in res.node_status:
+        cluster.pods.extend(ns.pods)
+
+    server = SimonServer(base_cluster=cluster)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(server))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        scaled = fx.make_fake_deployment("web", 5, "1", "1Gi")
+        body = json.dumps({"deployments": [scaled.raw]}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/api/scale-apps", data=body, method="POST"
+        )
+        with urllib.request.urlopen(req) as r:
+            resp = json.load(r)
+        assert resp["unscheduledPods"] == []
+        # old replicas removed, 5 new ones placed
+        assert sum(len(ns["pods"]) for ns in resp["nodeStatus"]) == 5
+    finally:
+        httpd.shutdown()
+
+
+def test_report_pod_table(tmp_path):
+    import io
+
+    from opensim_tpu.planner import report as report_mod
+
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n1", "8", "16Gi"))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("p1", "500m", "1Gi"))
+    res = simulate(cluster, [AppResource("a", app)])
+    buf = io.StringIO()
+    report_mod.report(res, [], ["a"], out=buf, pod_nodes=[])
+    text = buf.getvalue()
+    assert "Pod Info" in text and "p1" in text and "500m" in text
+
+
+def test_gen_doc(tmp_path):
+    from opensim_tpu.cli.main import build_parser, gen_doc
+
+    out_dir = tmp_path / "docs"
+    assert gen_doc(build_parser(), str(out_dir)) == 0
+    text = (out_dir / "simon.md").read_text()
+    for cmd in ("apply", "server", "version", "gen-doc"):
+        assert f"simon {cmd}" in text

@@ -31,11 +31,21 @@ def applicable(prep, config=None) -> bool:
     if config is not None and config != DEFAULT_CONFIG:
         return False
     f = prep.features
-    if f.ports or f.gpu or f.local or f.interpod or f.prefg:
+    if f.ports or f.gpu or f.local:
         return False
     if f.pref_node_affinity or f.prefer_taints:
         return False
     ec = prep.ec_np if prep.ec_np is not None else prep.ec
+    # inter-pod terms are supported with bounded table sizes
+    if f.interpod or f.prefg:
+        if int(ec.anti_g_sel.shape[0]) > 16 or int(ec.prefg_sel.shape[0]) > 16:
+            return False
+        if (
+            int(ec.at_sel.shape[1]) > 4
+            or int(ec.an_sel.shape[1]) > 4
+            or int(ec.pt_sel.shape[1]) > 4
+        ):
+            return False
     N = int(ec.node_valid.shape[0])
     if N % 128 != 0:
         return False
@@ -74,7 +84,8 @@ def applicable(prep, config=None) -> bool:
         Z = max(128, 128 * math.ceil(len(np.unique(nd)) / 128))
     else:
         Z = 128
-    vmem = ((3 * U + 4 * R + A + 4) * N + (2 * N + A) * Z) * 4
+    G = 8 if (f.interpod or f.prefg) else 8  # padded term rows (scratch exists either way)
+    vmem = ((3 * U + 4 * R + A + 4 * G + 4) * N + (2 * N + A + 4 * G) * Z) * 4
     if vmem > _VMEM_BUDGET:
         return False
     return True
@@ -147,13 +158,57 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
         np.float32
     )
 
+    # inter-pod term tables: per-template incoming terms + padded global
+    # existing-term rows (host flag, carried weights, selector matches)
+    def terms(sel_arr, topo_arr):
+        sel = np.asarray(sel_arr)
+        topo = np.asarray(topo_arr)
+        active = (sel >= 0).astype(np.int32)
+        host = (topo == host_tk).astype(np.int32)
+        return active, host, np.maximum(sel, 0).astype(np.int32)
+
+    at_active, at_host, at_sel = terms(ec.at_sel, ec.at_topo)
+    an_active, an_host, an_sel = terms(ec.an_sel, ec.an_topo)
+    pt_active, pt_host, pt_sel = terms(ec.pt_sel, ec.pt_topo)
+    at_self = np.where(at_active == 1, np.take_along_axis(matches_sel, at_sel, axis=1), 0.0).astype(
+        np.float32
+    )
+    pt_w = np.asarray(ec.pt_w).astype(np.float32)
+
+    def _pad8(n: int) -> int:
+        return max(8, 8 * math.ceil(n / 8))
+
+    g_sel = np.asarray(ec.anti_g_sel)
+    g_topo = np.asarray(ec.anti_g_topo)
+    G = g_sel.shape[0]
+    G_pad = _pad8(G)
+    anti_g_host = np.zeros((G_pad,), np.int32)
+    antig_GU = np.zeros((G_pad, U), np.float32)
+    gmatch_GU = np.zeros((G_pad, U), np.float32)
+    anti_carry = np.asarray(ec.anti_g).astype(np.float32)  # [U, G]
+    for g in range(G):
+        anti_g_host[g] = 1 if g_topo[g] == host_tk else 0
+        antig_GU[g] = anti_carry[:, g]
+        gmatch_GU[g] = matches_sel[:, g_sel[g]].astype(np.float32)
+    p_sel = np.asarray(ec.prefg_sel)
+    p_topo = np.asarray(ec.prefg_topo)
+    Gp = p_sel.shape[0]
+    Gp_pad = _pad8(Gp)
+    prefg_host = np.zeros((Gp_pad,), np.int32)
+    prefg_GU = np.zeros((Gp_pad, U), np.float32)
+    pmatch_GU = np.zeros((Gp_pad, U), np.float32)
+    pref_carry = np.asarray(ec.prefg_w).astype(np.float32)  # [U, Gp]
+    for g in range(Gp):
+        prefg_host[g] = 1 if p_topo[g] == host_tk else 0
+        prefg_GU[g] = pref_carry[:, g]
+        pmatch_GU[g] = matches_sel[:, p_sel[g]].astype(np.float32)
+
     fi = FastInputs(
         alloc_T=np.ascontiguousarray(np.asarray(ec.alloc).T.astype(np.float32)),
         used0_T=np.ascontiguousarray(np.asarray(jax.device_get(prep.st0.used)).T.astype(np.float32)),
         static_pass=np.asarray(stat.static_pass).astype(np.float32),
         aff_mask=np.asarray(stat.aff_mask).astype(np.float32),
         share_raw=np.asarray(stat.share_raw).astype(np.float32),
-        share_const=np.zeros((U,), np.float32),  # folded into share_raw already
         zone_NZ=zone_NZ,
         zone_ZN=zone_ZN,
         has_zone=has_zone,
@@ -170,6 +225,23 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
         spr_hard=spr_hard,
         spr_self=spr_self,
         spr_weight=spr_weight,
+        at_active=at_active,
+        at_host=at_host,
+        at_sel=at_sel,
+        at_self=at_self,
+        an_active=an_active,
+        an_host=an_host,
+        an_sel=an_sel,
+        pt_active=pt_active,
+        pt_host=pt_host,
+        pt_sel=pt_sel,
+        pt_w=pt_w,
+        anti_g_host=anti_g_host,
+        prefg_host=prefg_host,
+        antig_GU=antig_GU,
+        gmatch_GU=gmatch_GU,
+        prefg_GU=prefg_GU,
+        pmatch_GU=pmatch_GU,
     )
     meta = {"static_fail": np.asarray(stat.static_fail)}
     # device-resident copies so repeated runs (capacity loops, sweeps) skip
@@ -197,5 +269,8 @@ def schedule(prep, tmpl_ids, pod_valid, forced, interpret: Optional[bool] = None
         tmpl_ids = np.concatenate([tmpl_ids, np.zeros(pad, tmpl_ids.dtype)])
         pod_valid = np.concatenate([pod_valid, np.zeros(pad, bool)])
         forced = np.concatenate([forced, np.zeros(pad, bool)])
-    chosen, used_T = run_fast_scan(fi, tmpl_ids, pod_valid, forced, interpret=interpret)
+    has_interpod = bool(prep.features.interpod or prep.features.prefg)
+    chosen, used_T = run_fast_scan(
+        fi, tmpl_ids, pod_valid, forced, has_interpod=has_interpod, interpret=interpret
+    )
     return np.asarray(chosen)[:P], np.asarray(used_T).T, meta["static_fail"]

@@ -3,28 +3,27 @@
 The XLA scan pays ~5 µs of per-op overhead for each of the ~30 HLO ops in
 a scheduling step. This kernel fuses the entire step — static-filter gather,
 resource fit, Least/BalancedAllocation, Simon share, PodTopologySpread
-(hard + soft), selectHost, and the bind state update — into ONE Pallas
-program whose cluster state lives in VMEM for the whole scan: a bind costs
+(hard + soft), inter-pod affinity (required / anti / preferred, incoming and
+symmetric), selectHost, and the bind state update — into ONE Pallas program
+whose cluster state lives in VMEM for the whole scan: a bind costs
 VMEM-bandwidth, not kernel launches.
 
-Scope: workloads whose feature set is {resources, static filters, topology
-spread} — i.e. `Features(ports=False, gpu=False, local=False,
-interpod=False, prefg=False, ...)` with the default SchedulerConfig and at
-most two topology keys (hostname + one zone-like key). Everything else
-falls back to `engine.scheduler.schedule_pods`; `engine/fastpath.py` makes
-the choice and guarantees identical placements (tests assert equality).
+Scope: everything except GPU-share devices, open-local storage, host ports
+and preferred-node-affinity/PreferNoSchedule scoring, with at most two
+topology keys (hostname + one zone-like key). `engine/fastpath.py` gates
+applicability and guarantees identical placements to the XLA scan (tests
+assert equality). The kernel is generated per `has_interpod` so workloads
+without inter-pod terms pay nothing for them.
 
 Layouts (N = padded node axis, lanes; rows padded to sublane multiples):
-  alloc_T     [R, N]   f32   allocatable per resource row
-  used        [R, N]   f32   scratch, persistent across the grid
-  static_pass [U, N]   f32   0/1 from kernels.precompute_static
-  aff_mask    [U, N]   f32   node-affinity mask (spread eligibility)
-  share_raw   [U, N]   f32   Simon share × 100
-  node_cnt    [A, N]   f32   scratch — per-hostname-domain selector counts
-  zone_cnt    [A, Z]   f32   scratch — per-zone selector counts
-  zone_NZ     [N, Z]   f32   node → zone one-hot
-  zone_ZN     [Z, N]   f32   transpose (for the gather matvec)
-  matches_AU  [A, U]   f32   selector-match matrix (column = template)
+  alloc_T     [R, N]    f32  allocatable per resource row
+  used        [R, N]    f32  scratch, persistent across the grid
+  static_pass [U, N]    f32  0/1 from kernels.precompute_static
+  node_cnt    [A, N]    f32  scratch — per-hostname-domain selector counts
+  zone_cnt    [A, Z]    f32  scratch — per-zone selector counts
+  anti_node   [G, N]    f32  scratch — existing-pod anti-affinity terms
+  prefw_node  [Gp, N]   f32  scratch — symmetric preferred-term weights
+  matches_AU  [A, U]    f32  selector-match matrix (column = template)
 """
 
 from __future__ import annotations
@@ -54,7 +53,6 @@ class FastInputs(NamedTuple):
     static_pass: np.ndarray  # [U, N]
     aff_mask: np.ndarray  # [U, N]
     share_raw: np.ndarray  # [U, N]
-    share_const: np.ndarray  # [U] 1.0 where the template has no requests (score = Max everywhere)
     zone_NZ: np.ndarray  # [N, Z]
     zone_ZN: np.ndarray  # [Z, N]
     has_zone: np.ndarray  # [1, N] f32
@@ -73,239 +71,323 @@ class FastInputs(NamedTuple):
     spr_hard: np.ndarray  # i32 0/1
     spr_self: np.ndarray  # f32 0/1 template matches own selector
     spr_weight: np.ndarray  # f32 log(size+2)
+    # inter-pod affinity (all zero-shaped semantics when has_interpod=False)
+    at_active: np.ndarray  # [U, Ti] i32 — incoming required affinity terms
+    at_host: np.ndarray  # [U, Ti] i32
+    at_sel: np.ndarray  # [U, Ti] i32
+    at_self: np.ndarray  # [U, Ti] f32 — bootstrap self-match
+    an_active: np.ndarray  # [U, Tn] i32 — incoming anti terms
+    an_host: np.ndarray  # [U, Tn] i32
+    an_sel: np.ndarray  # [U, Tn] i32
+    pt_active: np.ndarray  # [U, Tp] i32 — incoming preferred terms
+    pt_host: np.ndarray  # [U, Tp] i32
+    pt_sel: np.ndarray  # [U, Tp] i32
+    pt_w: np.ndarray  # [U, Tp] f32 signed weights
+    anti_g_host: np.ndarray  # [G] i32 — global existing-anti terms
+    prefg_host: np.ndarray  # [Gp] i32 — global symmetric-preferred terms
+    antig_GU: np.ndarray  # [G, U] f32 — template carries term g
+    gmatch_GU: np.ndarray  # [G, U] f32 — template matches term g's selector
+    prefg_GU: np.ndarray  # [Gp, U] f32 — carried symmetric weights
+    pmatch_GU: np.ndarray  # [Gp, U] f32 — template matches pref term's selector
 
 
-def _kernel(
-    # scalar-prefetch / SMEM inputs
-    tmpl_ref,  # [CHUNK] i32
-    valid_ref,  # [CHUNK] i32
-    forced_ref,  # [CHUNK] i32
-    req_ref,  # [U, R] f32 SMEM
-    cpu_nz_ref,  # [U] f32 SMEM
-    mem_nz_ref,  # [U] f32 SMEM
-    pin_ref,  # [U] i32 SMEM
-    sa_ref, sh_ref, ss_ref, sk_ref, shard_ref, sself_ref, sw_ref,  # [U, Cs] SMEM
-    share_const_ref,  # [U] f32 SMEM
-    # VMEM inputs
-    alloc_ref,  # [R, N]
-    used0_ref,  # [R, N]
-    static_ref,  # [U, N]
-    affm_ref,  # [U, N]
-    shraw_ref,  # [U, N]
-    zone_nz_ref,  # [N, Z]
-    zone_zn_ref,  # [Z, N]
-    has_zone_ref,  # [1, N]
-    matches_ref,  # [A, U]
-    nodevalid_ref,  # [1, N]
-    # outputs
-    chosen_ref,  # [CHUNK] i32 SMEM
-    used_out_ref,  # [R, N] VMEM
-    # scratch
-    used_ref,  # [R, N]
-    node_cnt_ref,  # [A, N]
-    zone_cnt_ref,  # [A, Z]
-):
-    R, N = alloc_ref.shape
-    U = static_ref.shape[0]
-    A = node_cnt_ref.shape[0]
-    Z = zone_cnt_ref.shape[1]
-    Cs = sa_ref.shape[1]
+def _make_kernel(has_interpod: bool, n_anti: int, n_pref: int):
+    def kernel(
+        # SMEM streams + tables
+        tmpl_ref, valid_ref, forced_ref,
+        req_ref, cpu_nz_ref, mem_nz_ref, pin_ref,
+        sa_ref, sh_ref, ss_ref, sk_ref, shard_ref, sself_ref, sw_ref,
+        ata_ref, ath_ref, ats_ref, atf_ref,
+        ana_ref, anh_ref, ans_ref,
+        pta_ref, pth_ref, pts_ref, ptw_ref,
+        agh_ref, pgh_ref,
+        # VMEM inputs
+        alloc_ref, used0_ref, static_ref, affm_ref, shraw_ref,
+        zone_nz_ref, zone_zn_ref, has_zone_ref, matches_ref, nodevalid_ref,
+        antig_ref, gmatch_ref, prefg_ref, pmatch_ref,
+        # outputs
+        chosen_ref, used_out_ref,
+        # scratch
+        used_ref, node_cnt_ref, zone_cnt_ref,
+        anti_node_ref, anti_zone_ref, prefw_node_ref, prefw_zone_ref,
+    ):
+        R, N = alloc_ref.shape
+        U = static_ref.shape[0]
+        Cs = sa_ref.shape[1]
+        Ti = ata_ref.shape[1]
+        Tn = ana_ref.shape[1]
+        Tp = pta_ref.shape[1]
 
-    @pl.when(pl.program_id(0) == 0)
-    def _init():
-        used_ref[:] = used0_ref[:]
-        node_cnt_ref[:] = jnp.zeros_like(node_cnt_ref)
-        zone_cnt_ref[:] = jnp.zeros_like(zone_cnt_ref)
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            used_ref[:] = used0_ref[:]
+            node_cnt_ref[:] = jnp.zeros_like(node_cnt_ref)
+            zone_cnt_ref[:] = jnp.zeros_like(zone_cnt_ref)
+            anti_node_ref[:] = jnp.zeros_like(anti_node_ref)
+            anti_zone_ref[:] = jnp.zeros_like(anti_zone_ref)
+            prefw_node_ref[:] = jnp.zeros_like(prefw_node_ref)
+            prefw_zone_ref[:] = jnp.zeros_like(prefw_zone_ref)
 
-    iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
-    iota_u = jax.lax.broadcasted_iota(jnp.int32, (U, 1), 0)
-    valid_row = nodevalid_ref[:]  # [1, N]
+        iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
+        iota_u = jax.lax.broadcasted_iota(jnp.int32, (U, 1), 0)
+        valid_row = nodevalid_ref[:]  # [1, N]
+        has_zone = has_zone_ref[:]  # [1, N]
+        ones_1n = jnp.ones((1, N), jnp.float32)
 
-    def body(i, _):
-        u = tmpl_ref[i]
-
-        static_row = static_ref[pl.ds(u, 1), :]  # [1, N] (valid folded in)
-
-        # --- NodeResourcesFit
-        fit = jnp.ones((1, N), jnp.float32)
-        for r in range(R):
-            req_r = req_ref[u, r]
-            over = (used_ref[pl.ds(r, 1), :] + req_r > alloc_ref[pl.ds(r, 1), :]).astype(jnp.float32)
-            fit = fit * jnp.where(req_r > 0, 1.0 - over, 1.0)
-
-        feasible = static_row * fit  # [1, N] f32 mask
-
-        # --- PodTopologySpread + scores that need per-constraint counts
-        aff_row = affm_ref[pl.ds(u, 1), :] * valid_row  # eligibility for min
-        soft_raw = jnp.zeros((1, N), jnp.float32)
-        ignored = jnp.zeros((1, N), jnp.float32)  # feasible nodes missing a soft topo label
-        any_soft = jnp.float32(0.0)
-        for c in range(Cs):
-            active = sa_ref[u, c]
-            is_host = sh_ref[u, c]
-            sel = ss_ref[u, c]
-            skew = sk_ref[u, c]
-            hard = shard_ref[u, c]
-            selfm = sself_ref[u, c]
-            weight = sw_ref[u, c]
-
+        def sel_cnt(sel, is_host):
+            """Count of bound pods matching selector `sel` in the candidate
+            node's domain, for a hostname-or-zone topology flag."""
             host_cnt = node_cnt_ref[pl.ds(sel, 1), :]  # [1, N]
             zrow = zone_cnt_ref[pl.ds(sel, 1), :]  # [1, Z]
-            zone_gather = jnp.dot(
-                zrow, zone_zn_ref[:], preferred_element_type=jnp.float32
-            )  # [1, N]
-            cnt = jnp.where(is_host == 1, host_cnt, zone_gather)
-            has_label = jnp.where(is_host == 1, jnp.ones((1, N), jnp.float32), has_zone_ref[:])
-
-            activef = (active == 1)
-            hardf = activef & (hard == 1)
-            softf = activef & (hard == 0)
-
-            # hard constraint: cnt + self - min(eligible) <= skew
-            elig = aff_row * has_label
-            masked = jnp.where(elig > 0, cnt, jnp.float32(1e30))
-            min_cnt = jnp.min(masked)
-            ok = (cnt + selfm - min_cnt <= skew) & (has_label > 0)
-            feasible = jnp.where(hardf, feasible * ok.astype(jnp.float32), feasible)
-
-            # soft constraint: raw score contribution
-            contrib = jnp.where(has_label > 0, cnt * weight + (skew - 1.0), 0.0)
-            soft_raw = soft_raw + jnp.where(softf, contrib, 0.0)
-            ignored = jnp.maximum(
-                ignored, jnp.where(softf, (1.0 - has_label), 0.0)
+            zone_gather = jnp.dot(zrow, zone_zn_ref[:], preferred_element_type=jnp.float32)
+            return jnp.where(is_host == 1, host_cnt, zone_gather), jnp.where(
+                is_host == 1, ones_1n, has_zone
             )
-            any_soft = jnp.maximum(any_soft, jnp.where(softf, 1.0, 0.0))
 
-        # --- scores
-        cpu_req = cpu_nz_ref[u]
-        mem_req = mem_nz_ref[u]
-        alloc_cpu = alloc_ref[pl.ds(V.RES_CPU, 1), :]
-        alloc_mem = alloc_ref[pl.ds(V.RES_MEMORY, 1), :]
-        used_cpu = used_ref[pl.ds(V.RES_CPU, 1), :] + cpu_req
-        used_mem = used_ref[pl.ds(V.RES_MEMORY, 1), :] + mem_req
-        l_cpu = jnp.where(
-            (alloc_cpu == 0) | (used_cpu > alloc_cpu),
-            0.0,
-            (alloc_cpu - used_cpu) * MAX_SCORE / jnp.maximum(alloc_cpu, 1.0),
-        )
-        l_mem = jnp.where(
-            (alloc_mem == 0) | (used_mem > alloc_mem),
-            0.0,
-            (alloc_mem - used_mem) * MAX_SCORE / jnp.maximum(alloc_mem, 1.0),
-        )
-        least = (l_cpu + l_mem) / 2.0
-        cpu_frac = used_cpu / jnp.maximum(alloc_cpu, 1.0)
-        mem_frac = used_mem / jnp.maximum(alloc_mem, 1.0)
-        balanced = jnp.where(
-            (cpu_frac >= 1.0) | (mem_frac >= 1.0),
-            0.0,
-            (1.0 - jnp.abs(cpu_frac - mem_frac)) * MAX_SCORE,
-        )
+        def body(i, _):
+            u = tmpl_ref[i]
+            static_row = static_ref[pl.ds(u, 1), :]  # [1, N] (valid folded in)
 
-        share_row = shraw_ref[pl.ds(u, 1), :]
-        share_row = jnp.where(share_const_ref[u] > 0, jnp.full((1, N), MAX_SCORE), share_row)
-        feas_b = feasible > 0
-        lo = jnp.min(jnp.where(feas_b, share_row, jnp.float32(1e30)))
-        hi = jnp.max(jnp.where(feas_b, share_row, jnp.float32(-1e30)))
-        rng = hi - lo
-        share_norm = jnp.where(rng > 0, (share_row - lo) * MAX_SCORE / rng, 0.0)
+            # --- NodeResourcesFit
+            fit = ones_1n
+            for r in range(R):
+                req_r = req_ref[u, r]
+                over = (used_ref[pl.ds(r, 1), :] + req_r > alloc_ref[pl.ds(r, 1), :]).astype(jnp.float32)
+                fit = fit * jnp.where(req_r > 0, 1.0 - over, 1.0)
+            feasible = static_row * fit
 
-        scored = feas_b & (ignored == 0)
-        smn = jnp.min(jnp.where(scored, soft_raw, jnp.float32(1e30)))
-        smx = jnp.max(jnp.where(scored, soft_raw, jnp.float32(-1e30)))
-        spread_norm = jnp.where(
-            smx <= 0, MAX_SCORE, MAX_SCORE * (smx + smn - soft_raw) / jnp.maximum(smx, 1.0)
-        )
-        spread_norm = jnp.where(ignored > 0, 0.0, spread_norm)
-        spread_norm = jnp.where(any_soft > 0, spread_norm, 0.0)
+            # --- PodTopologySpread
+            aff_row = affm_ref[pl.ds(u, 1), :] * valid_row
+            soft_raw = jnp.zeros((1, N), jnp.float32)
+            ignored = jnp.zeros((1, N), jnp.float32)
+            any_soft = jnp.float32(0.0)
+            for c in range(Cs):
+                active = sa_ref[u, c]
+                skew = sk_ref[u, c]
+                cnt, has_label = sel_cnt(ss_ref[u, c], sh_ref[u, c])
+                activef = active == 1
+                hardf = activef & (shard_ref[u, c] == 1)
+                softf = activef & (shard_ref[u, c] == 0)
 
-        score = least + balanced + 2.0 * share_norm + 2.0 * spread_norm
+                elig = aff_row * has_label
+                masked = jnp.where(elig > 0, cnt, jnp.float32(1e30))
+                min_cnt = jnp.min(masked)
+                ok = (cnt + sself_ref[u, c] - min_cnt <= skew) & (has_label > 0)
+                feasible = jnp.where(hardf, feasible * ok.astype(jnp.float32), feasible)
 
-        # --- selectHost: lowest index among maxima — Mosaic's argmax breaks
-        # ties by HIGHEST index, diverging from the XLA scan's first-max
-        masked_score = jnp.where(feas_b, score, jnp.float32(NEG))
-        mx_score = jnp.max(masked_score)
-        best = jnp.min(jnp.where(masked_score == mx_score, iota_n, N)).astype(jnp.int32)
-        any_feasible = jnp.max(feasible) > 0
-        sel_choice = jnp.where(any_feasible, best, jnp.int32(-1))
-        is_forced = forced_ref[i] == 1
-        pin_u = pin_ref[u]
-        choice = jnp.where(is_forced, jnp.where(pin_u >= 0, pin_u, -1), sel_choice)
-        do_bind = (valid_ref[i] == 1) & (choice >= 0)
-        choice_out = jnp.where(do_bind, choice, -1)
-        chosen_ref[i] = choice_out
+                contrib = jnp.where(has_label > 0, cnt * sw_ref[u, c] + (skew - 1.0), 0.0)
+                soft_raw = soft_raw + jnp.where(softf, contrib, 0.0)
+                ignored = jnp.maximum(ignored, jnp.where(softf, 1.0 - has_label, 0.0))
+                any_soft = jnp.maximum(any_soft, jnp.where(softf, 1.0, 0.0))
 
-        # --- bind update
-        @pl.when(do_bind)
-        def _bind():
-            c = jnp.maximum(choice, 0)
-            onehot = (iota_n == c).astype(jnp.float32)  # [1, N]
-            iota_r = jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0)
-            req_col = jnp.zeros((R, 1), jnp.float32)
-            for r in range(R):  # static unroll; .at[] would lower to scatter
-                req_col = jnp.where(iota_r == r, req_ref[u, r], req_col)
-            used_ref[:] = used_ref[:] + req_col * onehot
+            ip_raw = jnp.zeros((1, N), jnp.float32)
+            if has_interpod:
+                onehot_u_col = (iota_u == u).astype(jnp.float32)  # [U, 1]
+                # incoming required anti-affinity: no matching pod in domain
+                for t in range(Tn):
+                    cnt, has_label = sel_cnt(ans_ref[u, t], anh_ref[u, t])
+                    violated = (cnt > 0) & (has_label > 0)
+                    feasible = jnp.where(
+                        ana_ref[u, t] == 1, feasible * (1.0 - violated.astype(jnp.float32)), feasible
+                    )
+                # incoming required affinity (with the self-match bootstrap)
+                for t in range(Ti):
+                    cnt, has_label = sel_cnt(ats_ref[u, t], ath_ref[u, t])
+                    total_host = jnp.sum(node_cnt_ref[pl.ds(ats_ref[u, t], 1), :])
+                    total_zone = jnp.sum(zone_cnt_ref[pl.ds(ats_ref[u, t], 1), :])
+                    total = jnp.where(ath_ref[u, t] == 1, total_host, total_zone)
+                    bootstrap = (total == 0.0) & (atf_ref[u, t] > 0)
+                    ok = ((cnt > 0) & (has_label > 0)) | bootstrap
+                    feasible = jnp.where(
+                        ata_ref[u, t] == 1, feasible * ok.astype(jnp.float32), feasible
+                    )
+                # symmetric: existing pods' anti terms vs the incoming pod.
+                # counts are non-negative, so "any matching term has pods in
+                # my domain" == "match-weighted count sum > 0" — three dots
+                # instead of per-term loops. Host-key domains always have
+                # the label (applicable() enforces hostname-identity); zone
+                # gathers give 0 on label-less nodes via the one-hot.
+                my_gmatch = jnp.dot(gmatch_ref[:], onehot_u_col, preferred_element_type=jnp.float32)
+                g_host = jnp.zeros((1, n_anti), jnp.float32)
+                g_iota = jax.lax.broadcasted_iota(jnp.int32, (1, n_anti), 1)
+                for g in range(n_anti):  # SMEM flags → vector masks
+                    g_host = jnp.where(g_iota == g, jnp.float32(agh_ref[g]), g_host)
+                m_row = my_gmatch.reshape(1, n_anti)
+                m_host = m_row * g_host
+                m_zone = m_row * (1.0 - g_host)
+                sym_cnt = jnp.dot(m_host, anti_node_ref[:], preferred_element_type=jnp.float32)
+                sym_cnt = sym_cnt + jnp.dot(
+                    jnp.dot(m_zone, anti_zone_ref[:], preferred_element_type=jnp.float32),
+                    zone_zn_ref[:],
+                    preferred_element_type=jnp.float32,
+                )
+                feasible = feasible * (1.0 - (sym_cnt > 0).astype(jnp.float32))
+                # score: incoming preferred terms
+                for t in range(Tp):
+                    cnt, has_label = sel_cnt(pts_ref[u, t], pth_ref[u, t])
+                    ip_raw = ip_raw + jnp.where(
+                        pta_ref[u, t] == 1, cnt * ptw_ref[u, t] * has_label, 0.0
+                    )
+                # score: symmetric preferred/hard-affinity weights — same
+                # three-dot contraction over the term axis
+                my_pmatch = jnp.dot(pmatch_ref[:], onehot_u_col, preferred_element_type=jnp.float32)
+                p_host = jnp.zeros((1, n_pref), jnp.float32)
+                p_iota = jax.lax.broadcasted_iota(jnp.int32, (1, n_pref), 1)
+                for g in range(n_pref):
+                    p_host = jnp.where(p_iota == g, jnp.float32(pgh_ref[g]), p_host)
+                pm_row = my_pmatch.reshape(1, n_pref)
+                pm_host = pm_row * p_host
+                pm_zone = pm_row * (1.0 - p_host)
+                ip_raw = ip_raw + jnp.dot(pm_host, prefw_node_ref[:], preferred_element_type=jnp.float32)
+                ip_raw = ip_raw + jnp.dot(
+                    jnp.dot(pm_zone, prefw_zone_ref[:], preferred_element_type=jnp.float32),
+                    zone_zn_ref[:],
+                    preferred_element_type=jnp.float32,
+                )
 
-            # matches column u via one-hot matvec: [A, U] @ [U, 1]
-            onehot_u = (iota_u == u).astype(jnp.float32)  # [U, 1]
-            m_col = jnp.dot(matches_ref[:], onehot_u, preferred_element_type=jnp.float32)  # [A, 1]
-            node_cnt_ref[:] = node_cnt_ref[:] + m_col * onehot
-            zrow_c = zone_nz_ref[pl.ds(c, 1), :]  # [1, Z]
-            zone_cnt_ref[:] = zone_cnt_ref[:] + m_col * zrow_c
+            # --- scores
+            cpu_req = cpu_nz_ref[u]
+            mem_req = mem_nz_ref[u]
+            alloc_cpu = alloc_ref[pl.ds(V.RES_CPU, 1), :]
+            alloc_mem = alloc_ref[pl.ds(V.RES_MEMORY, 1), :]
+            used_cpu = used_ref[pl.ds(V.RES_CPU, 1), :] + cpu_req
+            used_mem = used_ref[pl.ds(V.RES_MEMORY, 1), :] + mem_req
+            l_cpu = jnp.where(
+                (alloc_cpu == 0) | (used_cpu > alloc_cpu),
+                0.0,
+                (alloc_cpu - used_cpu) * MAX_SCORE / jnp.maximum(alloc_cpu, 1.0),
+            )
+            l_mem = jnp.where(
+                (alloc_mem == 0) | (used_mem > alloc_mem),
+                0.0,
+                (alloc_mem - used_mem) * MAX_SCORE / jnp.maximum(alloc_mem, 1.0),
+            )
+            least = (l_cpu + l_mem) / 2.0
+            cpu_frac = used_cpu / jnp.maximum(alloc_cpu, 1.0)
+            mem_frac = used_mem / jnp.maximum(alloc_mem, 1.0)
+            balanced = jnp.where(
+                (cpu_frac >= 1.0) | (mem_frac >= 1.0),
+                0.0,
+                (1.0 - jnp.abs(cpu_frac - mem_frac)) * MAX_SCORE,
+            )
 
-        return 0
+            share_row = shraw_ref[pl.ds(u, 1), :]
+            feas_b = feasible > 0
+            lo = jnp.min(jnp.where(feas_b, share_row, jnp.float32(1e30)))
+            hi = jnp.max(jnp.where(feas_b, share_row, jnp.float32(-1e30)))
+            rng = hi - lo
+            share_norm = jnp.where(rng > 0, (share_row - lo) * MAX_SCORE / rng, 0.0)
 
-    jax.lax.fori_loop(0, tmpl_ref.shape[0], body, 0)
-    used_out_ref[:] = used_ref[:]
+            scored = feas_b & (ignored == 0)
+            smn = jnp.min(jnp.where(scored, soft_raw, jnp.float32(1e30)))
+            smx = jnp.max(jnp.where(scored, soft_raw, jnp.float32(-1e30)))
+            spread_norm = jnp.where(
+                smx <= 0, MAX_SCORE, MAX_SCORE * (smx + smn - soft_raw) / jnp.maximum(smx, 1.0)
+            )
+            spread_norm = jnp.where(ignored > 0, 0.0, spread_norm)
+            spread_norm = jnp.where(any_soft > 0, spread_norm, 0.0)
+
+            score = least + balanced + 2.0 * share_norm + 2.0 * spread_norm
+            if has_interpod:
+                # interpod_score normalization: min/max seeded with 0
+                ip_masked = jnp.where(feas_b, ip_raw, 0.0)
+                ip_hi = jnp.maximum(jnp.max(ip_masked), 0.0)
+                ip_lo = jnp.minimum(jnp.min(ip_masked), 0.0)
+                ip_rng = ip_hi - ip_lo
+                score = score + jnp.where(
+                    ip_rng > 0, MAX_SCORE * (ip_raw - ip_lo) / jnp.maximum(ip_rng, 1.0), 0.0
+                )
+
+            # --- selectHost: lowest index among maxima — Mosaic's argmax
+            # breaks ties by HIGHEST index, diverging from the XLA scan
+            masked_score = jnp.where(feas_b, score, jnp.float32(NEG))
+            mx_score = jnp.max(masked_score)
+            best = jnp.min(jnp.where(masked_score == mx_score, iota_n, N)).astype(jnp.int32)
+            any_feasible = jnp.max(feasible) > 0
+            sel_choice = jnp.where(any_feasible, best, jnp.int32(-1))
+            is_forced = forced_ref[i] == 1
+            pin_u = pin_ref[u]
+            choice = jnp.where(is_forced, jnp.where(pin_u >= 0, pin_u, -1), sel_choice)
+            do_bind = (valid_ref[i] == 1) & (choice >= 0)
+            chosen_ref[i] = jnp.where(do_bind, choice, -1)
+
+            # --- bind update
+            @pl.when(do_bind)
+            def _bind():
+                c = jnp.maximum(choice, 0)
+                onehot = (iota_n == c).astype(jnp.float32)  # [1, N]
+                iota_r = jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0)
+                req_col = jnp.zeros((R, 1), jnp.float32)
+                for r in range(R):  # static unroll; .at[] would lower to scatter
+                    req_col = jnp.where(iota_r == r, req_ref[u, r], req_col)
+                used_ref[:] = used_ref[:] + req_col * onehot
+
+                onehot_u = (iota_u == u).astype(jnp.float32)  # [U, 1]
+                m_col = jnp.dot(matches_ref[:], onehot_u, preferred_element_type=jnp.float32)
+                zrow_c = zone_nz_ref[pl.ds(c, 1), :]  # [1, Z]
+                node_cnt_ref[:] = node_cnt_ref[:] + m_col * onehot
+                zone_cnt_ref[:] = zone_cnt_ref[:] + m_col * zrow_c
+                if has_interpod:
+                    a_col = jnp.dot(antig_ref[:], onehot_u, preferred_element_type=jnp.float32)
+                    anti_node_ref[:] = anti_node_ref[:] + a_col * onehot
+                    anti_zone_ref[:] = anti_zone_ref[:] + a_col * zrow_c
+                    p_col = jnp.dot(prefg_ref[:], onehot_u, preferred_element_type=jnp.float32)
+                    prefw_node_ref[:] = prefw_node_ref[:] + p_col * onehot
+                    prefw_zone_ref[:] = prefw_zone_ref[:] + p_col * zrow_c
+
+            return 0
+
+        jax.lax.fori_loop(0, tmpl_ref.shape[0], body, 0)
+        used_out_ref[:] = used_ref[:]
+
+    return kernel
 
 
-def run_fast_scan(fi: FastInputs, tmpl_ids, pod_valid, forced, interpret: bool = False):
+def run_fast_scan(fi: FastInputs, tmpl_ids, pod_valid, forced, has_interpod: bool, interpret: bool = False):
     """Execute the megakernel. tmpl_ids/pod_valid/forced are [P] (P a
     multiple of CHUNK). Returns (chosen [P] i32, used_final [R, N])."""
     P = tmpl_ids.shape[0]
     assert P % CHUNK == 0, P
     R, N = fi.alloc_T.shape
+    A = fi.matches_AU.shape[0]
+    Z = fi.zone_NZ.shape[1]
+    G = fi.antig_GU.shape[0]
+    Gp = fi.prefg_GU.shape[0]
     grid = (P // CHUNK,)
 
     smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
     vmem = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
+    stream = lambda: pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM)
 
     out = pl.pallas_call(
-        _kernel,
+        _make_kernel(has_interpod, G, Gp),
         grid=grid,
         out_shape=(
             jax.ShapeDtypeStruct((P,), jnp.int32),
             jax.ShapeDtypeStruct((R, N), jnp.float32),
         ),
-        in_specs=[
-            pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM),  # tmpl
-            pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM),  # valid
-            pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM),  # forced
-            smem(),  # req
-            smem(),  # cpu_nz
-            smem(),  # mem_nz
-            smem(),  # pin
-            smem(), smem(), smem(), smem(), smem(), smem(), smem(),  # spread tables
-            smem(),  # share_const
-            vmem(),  # alloc
-            vmem(),  # used0
-            vmem(),  # static
-            vmem(),  # aff
-            vmem(),  # share_raw
-            vmem(),  # zone_NZ
-            vmem(),  # zone_ZN
-            vmem(),  # has_zone
-            vmem(),  # matches
-            vmem(),  # node_valid
-        ],
+        in_specs=(
+            [stream(), stream(), stream()]
+            + [smem()] * 4  # req, cpu_nz, mem_nz, pin
+            + [smem()] * 7  # spread tables
+            + [smem()] * 4  # at_*
+            + [smem()] * 3  # an_*
+            + [smem()] * 4  # pt_*
+            + [smem()] * 2  # anti_g_host, prefg_host
+            + [vmem()] * 14  # VMEM inputs
+        ),
         out_specs=(
             pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM),
             pl.BlockSpec((R, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ),
         scratch_shapes=[
             pltpu.VMEM((R, N), jnp.float32),
-            pltpu.VMEM(fi.matches_AU.shape[:1] + (N,), jnp.float32),
-            pltpu.VMEM(fi.matches_AU.shape[:1] + (fi.zone_NZ.shape[1],), jnp.float32),
+            pltpu.VMEM((A, N), jnp.float32),
+            pltpu.VMEM((A, Z), jnp.float32),
+            pltpu.VMEM((G, N), jnp.float32),
+            pltpu.VMEM((G, Z), jnp.float32),
+            pltpu.VMEM((Gp, N), jnp.float32),
+            pltpu.VMEM((Gp, Z), jnp.float32),
         ],
         interpret=interpret,
     )(
@@ -323,7 +405,19 @@ def run_fast_scan(fi: FastInputs, tmpl_ids, pod_valid, forced, interpret: bool =
         jnp.asarray(fi.spr_hard, jnp.int32),
         jnp.asarray(fi.spr_self, jnp.float32),
         jnp.asarray(fi.spr_weight, jnp.float32),
-        jnp.asarray(fi.share_const, jnp.float32),
+        jnp.asarray(fi.at_active, jnp.int32),
+        jnp.asarray(fi.at_host, jnp.int32),
+        jnp.asarray(fi.at_sel, jnp.int32),
+        jnp.asarray(fi.at_self, jnp.float32),
+        jnp.asarray(fi.an_active, jnp.int32),
+        jnp.asarray(fi.an_host, jnp.int32),
+        jnp.asarray(fi.an_sel, jnp.int32),
+        jnp.asarray(fi.pt_active, jnp.int32),
+        jnp.asarray(fi.pt_host, jnp.int32),
+        jnp.asarray(fi.pt_sel, jnp.int32),
+        jnp.asarray(fi.pt_w, jnp.float32),
+        jnp.asarray(fi.anti_g_host, jnp.int32),
+        jnp.asarray(fi.prefg_host, jnp.int32),
         jnp.asarray(fi.alloc_T, jnp.float32),
         jnp.asarray(fi.used0_T, jnp.float32),
         jnp.asarray(fi.static_pass, jnp.float32),
@@ -334,8 +428,9 @@ def run_fast_scan(fi: FastInputs, tmpl_ids, pod_valid, forced, interpret: bool =
         jnp.asarray(fi.has_zone, jnp.float32),
         jnp.asarray(fi.matches_AU, jnp.float32),
         jnp.asarray(fi.node_valid, jnp.float32),
+        jnp.asarray(fi.antig_GU, jnp.float32),
+        jnp.asarray(fi.gmatch_GU, jnp.float32),
+        jnp.asarray(fi.prefg_GU, jnp.float32),
+        jnp.asarray(fi.pmatch_GU, jnp.float32),
     )
     return out
-
-
-run_fast_scan_jit = jax.jit(run_fast_scan, static_argnames=("interpret",))

@@ -103,6 +103,67 @@ def test_fastpath_matches_xla(with_spread, with_zone):
     np.testing.assert_allclose(got_used, want_used, rtol=1e-5)
 
 
+def test_fastpath_matches_xla_interpod():
+    """Inter-pod affinity / anti-affinity / preferred terms through the
+    megakernel must match the XLA scan exactly."""
+    cluster = ResourceTypes()
+    for i in range(12):
+        labels = {"topology.kubernetes.io/zone": f"z{i % 3}"}
+        cluster.nodes.append(fx.make_fake_node(f"n{i:02d}", "16", "32Gi", "110", fx.with_labels(labels)))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("anchor", "100m", "128Mi", fx.with_labels({"role": "anchor"})))
+    app.deployments.append(
+        fx.make_fake_deployment(
+            "followers", 6, "200m", "256Mi",
+            fx.with_affinity(
+                {
+                    "podAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {"labelSelector": {"matchLabels": {"role": "anchor"}}, "topologyKey": "topology.kubernetes.io/zone"}
+                        ]
+                    }
+                }
+            ),
+        )
+    )
+    app.stateful_sets.append(
+        fx.make_fake_stateful_set(
+            "spread-db", 8, "500m", "1Gi",
+            fx.with_affinity(
+                {
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {"labelSelector": {"matchLabels": {"app": "spread-db"}}, "topologyKey": "kubernetes.io/hostname"}
+                        ],
+                        "preferredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "weight": 100,
+                                "podAffinityTerm": {
+                                    "labelSelector": {"matchLabels": {"app": "spread-db"}},
+                                    "topologyKey": "topology.kubernetes.io/zone",
+                                },
+                            }
+                        ],
+                    }
+                }
+            ),
+        )
+    )
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
+    assert prep.features.interpod and prep.features.prefg
+    assert fastpath.applicable(prep)
+    P = len(prep.ordered)
+    want_chosen, want_used = _xla_chosen(prep)
+    got_chosen, got_used, _ = fastpath.schedule(
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+    )
+    mism = np.nonzero(want_chosen != got_chosen)[0]
+    assert mism.size == 0, (
+        f"{mism.size} mismatches at {mism[:5]}: xla={want_chosen[mism[:5]]} fast={got_chosen[mism[:5]]}"
+    )
+    np.testing.assert_allclose(got_used, want_used, rtol=1e-5)
+
+
 def test_fastpath_engages_through_simulate(monkeypatch):
     """End-to-end: simulate() must take the fast branch (interpret mode on
     CPU via OPENSIM_FASTPATH) and produce the same placements as the XLA

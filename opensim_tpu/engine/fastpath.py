@@ -84,8 +84,10 @@ def applicable(prep, config=None) -> bool:
         Z = max(128, 128 * math.ceil(len(np.unique(nd)) / 128))
     else:
         Z = 128
-    G = 8 if (f.interpod or f.prefg) else 8  # padded term rows (scratch exists either way)
-    vmem = ((3 * U + 4 * R + A + 4 * G + 4) * N + (2 * N + A + 4 * G) * Z) * 4
+    # padded global-term rows: the ≤16 caps above pad to at most 16 rows for
+    # each of the anti/pref tables on both the N and Z axes
+    G = 16
+    vmem = ((3 * U + 4 * R + A + 2 * G + 4) * N + (2 * N + A + 2 * G) * Z) * 4
     if vmem > _VMEM_BUDGET:
         return False
     return True

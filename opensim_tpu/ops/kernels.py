@@ -342,21 +342,27 @@ def interpod_score(ec, st, u, feasible):
     preferred terms against existing pods + existing pods' symmetric
     preferred/hard-affinity terms against the incoming pod, min-max
     normalized over the feasible set (min/max seeded with 0 per k8s)."""
-    # incoming side: pt terms gather dom_sel counts
+    D_trash = ec.domain_topo.shape[0] - 1
+    # incoming side: pt terms gather dom_sel counts; nodes missing the
+    # topology label form no pair (k8s: no contribution, not trash-row reads)
     pt_sel = ec.pt_sel[u]  # [Tpp]
     pt_topo = ec.pt_topo[u]
     pt_w = ec.pt_w[u]
     dom = ec.node_domain[:, pt_topo]  # [N, Tpp]
+    has_label = dom < D_trash
     cnt = st.dom_sel[dom, jnp.maximum(pt_sel, 0)[None, :]]
-    incoming = jnp.sum(jnp.where(pt_sel[None, :] >= 0, cnt * pt_w[None, :], 0.0), axis=-1)
+    incoming = jnp.sum(
+        jnp.where((pt_sel[None, :] >= 0) & has_label, cnt * pt_w[None, :], 0.0), axis=-1
+    )
 
     # symmetric side: existing pods' terms whose selector matches the pod
     g_topo = ec.prefg_topo  # [Gp]
     g_sel = ec.prefg_sel
     dom_g = ec.node_domain[:, g_topo]  # [N, Gp]
+    has_label_g = dom_g < D_trash
     w_sum = st.dom_prefw[dom_g, jnp.arange(g_topo.shape[0])[None, :]]  # [N, Gp]
     matches = ec.matches_sel[u, g_sel].astype(jnp.float32)  # [Gp]
-    symmetric = jnp.sum(w_sum * matches[None, :], axis=-1)
+    symmetric = jnp.sum(jnp.where(has_label_g, w_sum * matches[None, :], 0.0), axis=-1)
 
     raw = incoming + symmetric
     masked = jnp.where(feasible, raw, 0.0)

@@ -134,6 +134,19 @@ def _make_kernel(has_interpod: bool, n_anti: int, n_pref: int):
         has_zone = has_zone_ref[:]  # [1, N]
         ones_1n = jnp.ones((1, N), jnp.float32)
 
+        def _flag_row(flag_ref, n_rows):
+            """Expand an SMEM int-flag table into a [1, n_rows] f32 vector
+            (loop-invariant: built once, outside the pod loop)."""
+            row = jnp.zeros((1, n_rows), jnp.float32)
+            r_iota = jax.lax.broadcasted_iota(jnp.int32, (1, n_rows), 1)
+            for g in range(n_rows):
+                row = jnp.where(r_iota == g, jnp.float32(flag_ref[g]), row)
+            return row
+
+        if has_interpod:
+            g_host_row = _flag_row(agh_ref, n_anti)
+            p_host_row = _flag_row(pgh_ref, n_pref)
+
         def sel_cnt(sel, is_host):
             """Count of bound pods matching selector `sel` in the candidate
             node's domain, for a hostname-or-zone topology flag."""
@@ -208,13 +221,9 @@ def _make_kernel(has_interpod: bool, n_anti: int, n_pref: int):
                 # the label (applicable() enforces hostname-identity); zone
                 # gathers give 0 on label-less nodes via the one-hot.
                 my_gmatch = jnp.dot(gmatch_ref[:], onehot_u_col, preferred_element_type=jnp.float32)
-                g_host = jnp.zeros((1, n_anti), jnp.float32)
-                g_iota = jax.lax.broadcasted_iota(jnp.int32, (1, n_anti), 1)
-                for g in range(n_anti):  # SMEM flags → vector masks
-                    g_host = jnp.where(g_iota == g, jnp.float32(agh_ref[g]), g_host)
                 m_row = my_gmatch.reshape(1, n_anti)
-                m_host = m_row * g_host
-                m_zone = m_row * (1.0 - g_host)
+                m_host = m_row * g_host_row
+                m_zone = m_row * (1.0 - g_host_row)
                 sym_cnt = jnp.dot(m_host, anti_node_ref[:], preferred_element_type=jnp.float32)
                 sym_cnt = sym_cnt + jnp.dot(
                     jnp.dot(m_zone, anti_zone_ref[:], preferred_element_type=jnp.float32),
@@ -231,13 +240,9 @@ def _make_kernel(has_interpod: bool, n_anti: int, n_pref: int):
                 # score: symmetric preferred/hard-affinity weights — same
                 # three-dot contraction over the term axis
                 my_pmatch = jnp.dot(pmatch_ref[:], onehot_u_col, preferred_element_type=jnp.float32)
-                p_host = jnp.zeros((1, n_pref), jnp.float32)
-                p_iota = jax.lax.broadcasted_iota(jnp.int32, (1, n_pref), 1)
-                for g in range(n_pref):
-                    p_host = jnp.where(p_iota == g, jnp.float32(pgh_ref[g]), p_host)
                 pm_row = my_pmatch.reshape(1, n_pref)
-                pm_host = pm_row * p_host
-                pm_zone = pm_row * (1.0 - p_host)
+                pm_host = pm_row * p_host_row
+                pm_zone = pm_row * (1.0 - p_host_row)
                 ip_raw = ip_raw + jnp.dot(pm_host, prefw_node_ref[:], preferred_element_type=jnp.float32)
                 ip_raw = ip_raw + jnp.dot(
                     jnp.dot(pm_zone, prefw_zone_ref[:], preferred_element_type=jnp.float32),

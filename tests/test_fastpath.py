@@ -108,7 +108,9 @@ def test_fastpath_matches_xla_interpod():
     megakernel must match the XLA scan exactly."""
     cluster = ResourceTypes()
     for i in range(12):
-        labels = {"topology.kubernetes.io/zone": f"z{i % 3}"}
+        # every 4th node lacks the zone label: k8s gives label-less nodes no
+        # topology contribution, and both paths must agree on that
+        labels = {} if i % 4 == 3 else {"topology.kubernetes.io/zone": f"z{i % 3}"}
         cluster.nodes.append(fx.make_fake_node(f"n{i:02d}", "16", "32Gi", "110", fx.with_labels(labels)))
     app = ResourceTypes()
     app.pods.append(fx.make_fake_pod("anchor", "100m", "128Mi", fx.with_labels({"role": "anchor"})))

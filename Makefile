@@ -1,0 +1,20 @@
+# parity with the reference's Makefile targets (build/test), TPU edition
+.PHONY: test bench bench-all docs all
+
+all: test
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+bench-all: bench
+	python bench.py --config example
+	python bench.py --config gpushare
+	python bench.py --pods 10000 --nodes 1000
+	python bench.py --config affinity --pods 5000 --nodes 500
+	python bench.py --config defrag --scenarios 64 --nodes 200 --pods 2000
+
+docs:
+	python -m opensim_tpu gen-doc --output-dir docs/commandline

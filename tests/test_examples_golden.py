@@ -1,0 +1,55 @@
+"""Golden regression tests over the reference repo's example inputs: the
+scheduled/unschedulable structure of each public example must stay stable
+across engine changes (placements may legally differ on ties, counts not)."""
+
+import pytest
+
+from opensim_tpu.engine.simulator import AppResource, simulate
+from opensim_tpu.models import expand
+
+REF = "/root/reference/example"
+
+
+def _app(name):
+    rt, _ = expand.resources_from_dicts(expand.load_yaml_objects(f"{REF}/application/{name}"))
+    return rt
+
+
+def test_demo1_simple():
+    cluster = expand.load_cluster_from_dir(f"{REF}/cluster/demo_1")
+    res = simulate(cluster, [AppResource("simple", _app("simple"))])
+    # 8-replica STS with hostname anti-affinity on a 4-node cluster: exactly
+    # 4 replicas cannot schedule, everything else fits
+    assert len(res.unscheduled_pods) == 4
+    assert all(u.pod.metadata.name.startswith("busybox-sts-new-") for u in res.unscheduled_pods)
+    assert all("inter-pod affinity" in u.reason for u in res.unscheduled_pods)
+    assert sum(len(ns.pods) for ns in res.node_status) == 33
+
+
+def test_demo1_open_local():
+    cluster = expand.load_cluster_from_dir(f"{REF}/cluster/demo_1")
+    res = simulate(cluster, [AppResource("open_local", _app("open_local"))])
+    # one worker with local storage: a single LVM+device pod fits, the other
+    # replicas run out of exclusive devices (masters are tainted/storage-less)
+    assert len(res.unscheduled_pods) == 3
+    assert all("local storage" in u.reason for u in res.unscheduled_pods)
+
+
+def test_gpushare_cluster():
+    cluster = expand.load_cluster_from_dir(f"{REF}/cluster/gpushare")
+    res = simulate(cluster, [AppResource("pai_gpu", _app("gpushare"))])
+    assert not res.unscheduled_pods
+    placed = {p.metadata.name: ns.node.metadata.name for ns in res.node_status for p in ns.pods}
+    assert len(placed) == 9
+    # the two annotated GPU pods must carry device assignments
+    by_name = {p.metadata.name: p for ns in res.node_status for p in ns.pods}
+    assert by_name["gpu-pod-00"].metadata.annotations.get("alibabacloud.com/gpu-index") is not None
+    assert by_name["gpu-pod-02"].metadata.annotations.get("alibabacloud.com/gpu-index") is not None
+
+
+@pytest.mark.parametrize("app_name,expect_pods", [("complicate", 45), ("more_pods", 200)])
+def test_app_expansion_counts(app_name, expect_pods):
+    cluster = expand.load_cluster_from_dir(f"{REF}/cluster/demo_1")
+    app = _app(app_name)
+    pods = expand.generate_pods_from_resources(app, cluster.nodes)
+    assert len(pods) == expect_pods

@@ -25,17 +25,23 @@ HOSTNAME = "kubernetes.io/hostname"
 _VMEM_BUDGET = 10 * 1024 * 1024
 
 
+def _pad8_static(n: int) -> int:
+    return max(8, 8 * math.ceil(n / 8))
+
+
 def applicable(prep, config=None) -> bool:
     """The megakernel covers: static filters + fit + least/balanced/share +
     topology spread, hostname plus at most one other topology key."""
     if config is not None and config != DEFAULT_CONFIG:
         return False
     f = prep.features
-    if f.ports or f.gpu or f.local:
+    ec = prep.ec_np if prep.ec_np is not None else prep.ec
+    if f.ports or f.local:
+        return False
+    if f.gpu and int(ec.node_gpu_mem.shape[1]) > 8:
         return False
     if f.pref_node_affinity or f.prefer_taints:
         return False
-    ec = prep.ec_np if prep.ec_np is not None else prep.ec
     # inter-pod terms are supported with bounded table sizes
     if f.interpod or f.prefg:
         if int(ec.anti_g_sel.shape[0]) > 16 or int(ec.prefg_sel.shape[0]) > 16:
@@ -85,9 +91,11 @@ def applicable(prep, config=None) -> bool:
     else:
         Z = 128
     # padded global-term rows: the ≤16 caps above pad to at most 16 rows for
-    # each of the anti/pref tables on both the N and Z axes
+    # each of the anti/pref tables on both the N and Z axes; GPU buffers are
+    # three [Gd_pad, N] arrays (input, scratch, output)
     G = 16
-    vmem = ((3 * U + 4 * R + A + 2 * G + 4) * N + (2 * N + A + 2 * G) * Z) * 4
+    Gd_pad = _pad8_static(int(ec.node_gpu_mem.shape[1]))
+    vmem = ((3 * U + 4 * R + A + 2 * G + 3 * Gd_pad + 4) * N + (2 * N + A + 2 * G) * Z) * 4
     if vmem > _VMEM_BUDGET:
         return False
     return True
@@ -154,6 +162,12 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
                 spr_self[u, c] = float(matches_sel[u, spr_sel[u, c]])
                 spr_weight[u, c] = float(spread_weight[spr_topo[u, c]])
 
+    # gpu device matrix, transposed to [Gd_pad, N] with sublane padding
+    gpu_free0 = np.asarray(jax.device_get(prep.st0.gpu_free))  # [N, Gd]
+    Gd_pad = _pad8_static(gpu_free0.shape[1])
+    gpu0_DN = np.zeros((Gd_pad, gpu_free0.shape[0]), np.float32)
+    gpu0_DN[: gpu_free0.shape[1]] = gpu_free0.T.astype(np.float32)
+
     req_np = np.asarray(ec.req).astype(np.float32)
     cpu_nz = np.where(req_np[:, V.RES_CPU] > 0, req_np[:, V.RES_CPU], 100.0).astype(np.float32)
     mem_nz = np.where(req_np[:, V.RES_MEMORY] > 0, req_np[:, V.RES_MEMORY], 200.0 * 1024 * 1024).astype(
@@ -177,13 +191,10 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
     )
     pt_w = np.asarray(ec.pt_w).astype(np.float32)
 
-    def _pad8(n: int) -> int:
-        return max(8, 8 * math.ceil(n / 8))
-
     g_sel = np.asarray(ec.anti_g_sel)
     g_topo = np.asarray(ec.anti_g_topo)
     G = g_sel.shape[0]
-    G_pad = _pad8(G)
+    G_pad = _pad8_static(G)
     anti_g_host = np.zeros((G_pad,), np.int32)
     antig_GU = np.zeros((G_pad, U), np.float32)
     gmatch_GU = np.zeros((G_pad, U), np.float32)
@@ -195,7 +206,7 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
     p_sel = np.asarray(ec.prefg_sel)
     p_topo = np.asarray(ec.prefg_topo)
     Gp = p_sel.shape[0]
-    Gp_pad = _pad8(Gp)
+    Gp_pad = _pad8_static(Gp)
     prefg_host = np.zeros((Gp_pad,), np.int32)
     prefg_GU = np.zeros((Gp_pad, U), np.float32)
     pmatch_GU = np.zeros((Gp_pad, U), np.float32)
@@ -244,6 +255,9 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
         gmatch_GU=gmatch_GU,
         prefg_GU=prefg_GU,
         pmatch_GU=pmatch_GU,
+        gpu_mem=np.asarray(ec.gpu_mem).astype(np.float32),
+        gpu_cnt=np.asarray(ec.gpu_count).astype(np.float32),
+        gpu0_DN=gpu0_DN,
     )
     meta = {"static_fail": np.asarray(stat.static_fail)}
     # device-resident copies so repeated runs (capacity loops, sweeps) skip
@@ -272,7 +286,16 @@ def schedule(prep, tmpl_ids, pod_valid, forced, interpret: Optional[bool] = None
         pod_valid = np.concatenate([pod_valid, np.zeros(pad, bool)])
         forced = np.concatenate([forced, np.zeros(pad, bool)])
     has_interpod = bool(prep.features.interpod or prep.features.prefg)
-    chosen, used_T = run_fast_scan(
-        fi, tmpl_ids, pod_valid, forced, has_interpod=has_interpod, interpret=interpret
+    has_gpu = bool(prep.features.gpu)
+    chosen, used_T, gpu_take, gpu_T = run_fast_scan(
+        fi, tmpl_ids, pod_valid, forced,
+        has_interpod=has_interpod, has_gpu=has_gpu, interpret=interpret,
     )
-    return np.asarray(chosen)[:P], np.asarray(used_T).T, meta["static_fail"]
+    Gd = int(prep.st0.gpu_free.shape[1])
+    return (
+        np.asarray(chosen)[:P],
+        np.asarray(used_T).T,
+        meta["static_fail"],
+        np.asarray(gpu_take)[:P, :Gd],
+        np.asarray(gpu_T)[:Gd].T,
+    )

@@ -74,24 +74,32 @@ class SimulateResult:
         return []
 
 
-def _fast_output(chosen: np.ndarray, used_final: np.ndarray, static_fail: np.ndarray, prep: "Prepared"):
-    """Adapt the megakernel's (chosen, used, static_fail) into the
-    ScheduleOutput shape the decode path consumes. Only reached when nothing
-    is unscheduled, so the dynamic failure details are zeros; extension
-    state equals its initial value (the fast path excludes gpu/local)."""
+def _fast_output(
+    chosen: np.ndarray,
+    used_final: np.ndarray,
+    static_fail: np.ndarray,
+    gpu_take: np.ndarray,
+    gpu_final: np.ndarray,
+    prep: "Prepared",
+):
+    """Adapt the megakernel's outputs into the ScheduleOutput shape the
+    decode path consumes. Only reached when nothing is unscheduled, so the
+    dynamic failure details are zeros; local-storage state equals its
+    initial value (the fast path excludes the local feature)."""
     from .scheduler import ScheduleOutput
 
     P = len(chosen)
     R = int(prep.ec.alloc.shape[1])
-    gd = int(prep.st0.gpu_free.shape[1])
     n_dynamic = kernels.NUM_FILTERS - kernels.F_PORTS
     return ScheduleOutput(
         chosen=chosen,
         fail_counts=np.zeros((P, n_dynamic), np.int32),
         insufficient=np.zeros((P, R), np.int32),
-        gpu_take=np.zeros((P, gd), np.float32),
+        gpu_take=gpu_take.astype(np.float32),
         static_fail=static_fail,
-        final_state=prep.st0._replace(used=used_final.astype(np.float32)),
+        final_state=prep.st0._replace(
+            used=used_final.astype(np.float32), gpu_free=gpu_final.astype(np.float32)
+        ),
     )
 
 
@@ -289,9 +297,11 @@ def simulate(
                 # Pallas megakernel fast path: identical placements, ~4×
                 # the XLA scan's step rate. Falls back below when pods fail
                 # (the full path produces the kube-style reason strings).
-                f_chosen, f_used, sf = fastpath.schedule(prep, tmpl_ids, pod_valid, forced)
+                f_chosen, f_used, sf, f_take, f_gpu = fastpath.schedule(
+                    prep, tmpl_ids, pod_valid, forced
+                )
                 if not np.any((f_chosen < 0) & pod_valid & ~forced):
-                    out = _fast_output(f_chosen, f_used, sf, prep)
+                    out = _fast_output(f_chosen, f_used, sf, f_take, f_gpu, prep)
         if out is None:
             tmpl_p, valid_p, forced_p = pad_pod_stream(tmpl_ids, pod_valid, forced)
             out = schedule_pods(

@@ -89,9 +89,13 @@ class FastInputs(NamedTuple):
     gmatch_GU: np.ndarray  # [G, U] f32 — template matches term g's selector
     prefg_GU: np.ndarray  # [Gp, U] f32 — carried symmetric weights
     pmatch_GU: np.ndarray  # [Gp, U] f32 — template matches pref term's selector
+    # gpu-share (zero-shaped semantics when has_gpu=False)
+    gpu_mem: np.ndarray  # [U] f32 per-GPU memory request
+    gpu_cnt: np.ndarray  # [U] f32 requested GPU count
+    gpu0_DN: np.ndarray  # [Gd, N] f32 initial per-device free memory
 
 
-def _make_kernel(has_interpod: bool, n_anti: int, n_pref: int):
+def _make_kernel(has_interpod: bool, has_gpu: bool, n_anti: int, n_pref: int, n_gpu: int):
     def kernel(
         # SMEM streams + tables
         tmpl_ref, valid_ref, forced_ref,
@@ -101,15 +105,17 @@ def _make_kernel(has_interpod: bool, n_anti: int, n_pref: int):
         ana_ref, anh_ref, ans_ref,
         pta_ref, pth_ref, pts_ref, ptw_ref,
         agh_ref, pgh_ref,
+        gmem_ref, gcnt_ref,
         # VMEM inputs
         alloc_ref, used0_ref, static_ref, affm_ref, shraw_ref,
         zone_nz_ref, zone_zn_ref, has_zone_ref, matches_ref, nodevalid_ref,
-        antig_ref, gmatch_ref, prefg_ref, pmatch_ref,
+        antig_ref, gmatch_ref, prefg_ref, pmatch_ref, gpu0_ref,
         # outputs
-        chosen_ref, used_out_ref,
+        chosen_ref, used_out_ref, gpu_take_ref, gpu_out_ref,
         # scratch
         used_ref, node_cnt_ref, zone_cnt_ref,
         anti_node_ref, anti_zone_ref, prefw_node_ref, prefw_zone_ref,
+        gpu_free_ref,
     ):
         R, N = alloc_ref.shape
         U = static_ref.shape[0]
@@ -127,6 +133,7 @@ def _make_kernel(has_interpod: bool, n_anti: int, n_pref: int):
             anti_zone_ref[:] = jnp.zeros_like(anti_zone_ref)
             prefw_node_ref[:] = jnp.zeros_like(prefw_node_ref)
             prefw_zone_ref[:] = jnp.zeros_like(prefw_zone_ref)
+            gpu_free_ref[:] = gpu0_ref[:]
 
         iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
         iota_u = jax.lax.broadcasted_iota(jnp.int32, (U, 1), 0)
@@ -160,6 +167,8 @@ def _make_kernel(has_interpod: bool, n_anti: int, n_pref: int):
         def body(i, _):
             u = tmpl_ref[i]
             static_row = static_ref[pl.ds(u, 1), :]  # [1, N] (valid folded in)
+            for d in range(n_gpu):  # SMEM outputs have no default value
+                gpu_take_ref[i, d] = jnp.float32(0.0)
 
             # --- NodeResourcesFit
             fit = ones_1n
@@ -168,6 +177,18 @@ def _make_kernel(has_interpod: bool, n_anti: int, n_pref: int):
                 over = (used_ref[pl.ds(r, 1), :] + req_r > alloc_ref[pl.ds(r, 1), :]).astype(jnp.float32)
                 fit = fit * jnp.where(req_r > 0, 1.0 - over, 1.0)
             feasible = static_row * fit
+
+            if has_gpu:
+                # Open-Gpu-Share filter: sum_d floor(free_d / mem) >= count
+                gmem = gmem_ref[u]
+                gcnt = gcnt_ref[u]
+                chunks_sum = jnp.zeros((1, N), jnp.float32)
+                for d in range(n_gpu):
+                    chunks_sum = chunks_sum + jnp.floor(
+                        gpu_free_ref[pl.ds(d, 1), :] / jnp.maximum(gmem, 1.0)
+                    )
+                gpu_ok = ((chunks_sum >= gcnt) & (gcnt > 0)).astype(jnp.float32)
+                feasible = jnp.where(gmem > 0, feasible * gpu_ok, feasible)
 
             # --- PodTopologySpread
             aff_row = affm_ref[pl.ds(u, 1), :] * valid_row
@@ -332,6 +353,30 @@ def _make_kernel(has_interpod: bool, n_anti: int, n_pref: int):
                 zrow_c = zone_nz_ref[pl.ds(c, 1), :]  # [1, Z]
                 node_cnt_ref[:] = node_cnt_ref[:] + m_col * onehot
                 zone_cnt_ref[:] = zone_cnt_ref[:] + m_col * zrow_c
+                if has_gpu:
+                    # device packing on the chosen node (computed for all
+                    # nodes, applied via the one-hot): single-GPU tightest
+                    # fit, multi-GPU greedy with reuse (gpunodeinfo.go)
+                    gmem = gmem_ref[u]
+                    gcnt = gcnt_ref[u]
+                    best_free = jnp.full((1, N), 1e30, jnp.float32)
+                    for d in range(n_gpu):
+                        free_d = gpu_free_ref[pl.ds(d, 1), :]
+                        best_free = jnp.where(free_d >= gmem, jnp.minimum(best_free, free_d), best_free)
+                    assigned = jnp.zeros((1, N), jnp.float32)
+                    cum = jnp.zeros((1, N), jnp.float32)
+                    for d in range(n_gpu):
+                        free_d = gpu_free_ref[pl.ds(d, 1), :]
+                        fits_d = (free_d >= gmem).astype(jnp.float32)
+                        take_tight = fits_d * (free_d == best_free).astype(jnp.float32) * (1.0 - jnp.minimum(assigned, 1.0))
+                        assigned = assigned + take_tight
+                        chunks_d = jnp.floor(free_d / jnp.maximum(gmem, 1.0))
+                        take_greedy = jnp.clip(gcnt - cum, 0.0, chunks_d)
+                        cum = cum + chunks_d
+                        take_d = jnp.where(gcnt == 1, take_tight, take_greedy)
+                        take_d = jnp.where(gmem > 0, take_d, 0.0)
+                        gpu_free_ref[pl.ds(d, 1), :] = free_d - take_d * gmem * onehot
+                        gpu_take_ref[i, d] = jnp.sum(take_d * onehot)
                 if has_interpod:
                     a_col = jnp.dot(antig_ref[:], onehot_u, preferred_element_type=jnp.float32)
                     anti_node_ref[:] = anti_node_ref[:] + a_col * onehot
@@ -344,13 +389,17 @@ def _make_kernel(has_interpod: bool, n_anti: int, n_pref: int):
 
         jax.lax.fori_loop(0, tmpl_ref.shape[0], body, 0)
         used_out_ref[:] = used_ref[:]
+        gpu_out_ref[:] = gpu_free_ref[:]
 
     return kernel
 
 
-def run_fast_scan(fi: FastInputs, tmpl_ids, pod_valid, forced, has_interpod: bool, interpret: bool = False):
+def run_fast_scan(
+    fi: FastInputs, tmpl_ids, pod_valid, forced, has_interpod: bool, has_gpu: bool, interpret: bool = False
+):
     """Execute the megakernel. tmpl_ids/pod_valid/forced are [P] (P a
-    multiple of CHUNK). Returns (chosen [P] i32, used_final [R, N])."""
+    multiple of CHUNK). Returns (chosen [P] i32, used_final [R, N],
+    gpu_take [P, Gd], gpu_final [Gd, N])."""
     P = tmpl_ids.shape[0]
     assert P % CHUNK == 0, P
     R, N = fi.alloc_T.shape
@@ -358,6 +407,7 @@ def run_fast_scan(fi: FastInputs, tmpl_ids, pod_valid, forced, has_interpod: boo
     Z = fi.zone_NZ.shape[1]
     G = fi.antig_GU.shape[0]
     Gp = fi.prefg_GU.shape[0]
+    Gd = fi.gpu0_DN.shape[0]
     grid = (P // CHUNK,)
 
     smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
@@ -365,11 +415,13 @@ def run_fast_scan(fi: FastInputs, tmpl_ids, pod_valid, forced, has_interpod: boo
     stream = lambda: pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM)
 
     out = pl.pallas_call(
-        _make_kernel(has_interpod, G, Gp),
+        _make_kernel(has_interpod, has_gpu, G, Gp, Gd),
         grid=grid,
         out_shape=(
             jax.ShapeDtypeStruct((P,), jnp.int32),
             jax.ShapeDtypeStruct((R, N), jnp.float32),
+            jax.ShapeDtypeStruct((P, Gd), jnp.float32),
+            jax.ShapeDtypeStruct((Gd, N), jnp.float32),
         ),
         in_specs=(
             [stream(), stream(), stream()]
@@ -379,11 +431,14 @@ def run_fast_scan(fi: FastInputs, tmpl_ids, pod_valid, forced, has_interpod: boo
             + [smem()] * 3  # an_*
             + [smem()] * 4  # pt_*
             + [smem()] * 2  # anti_g_host, prefg_host
-            + [vmem()] * 14  # VMEM inputs
+            + [smem()] * 2  # gpu_mem, gpu_cnt
+            + [vmem()] * 15  # VMEM inputs
         ),
         out_specs=(
             pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM),
             pl.BlockSpec((R, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((CHUNK, Gd), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((Gd, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ),
         scratch_shapes=[
             pltpu.VMEM((R, N), jnp.float32),
@@ -393,6 +448,7 @@ def run_fast_scan(fi: FastInputs, tmpl_ids, pod_valid, forced, has_interpod: boo
             pltpu.VMEM((G, Z), jnp.float32),
             pltpu.VMEM((Gp, N), jnp.float32),
             pltpu.VMEM((Gp, Z), jnp.float32),
+            pltpu.VMEM((Gd, N), jnp.float32),
         ],
         interpret=interpret,
     )(
@@ -423,6 +479,8 @@ def run_fast_scan(fi: FastInputs, tmpl_ids, pod_valid, forced, has_interpod: boo
         jnp.asarray(fi.pt_w, jnp.float32),
         jnp.asarray(fi.anti_g_host, jnp.int32),
         jnp.asarray(fi.prefg_host, jnp.int32),
+        jnp.asarray(fi.gpu_mem, jnp.float32),
+        jnp.asarray(fi.gpu_cnt, jnp.float32),
         jnp.asarray(fi.alloc_T, jnp.float32),
         jnp.asarray(fi.used0_T, jnp.float32),
         jnp.asarray(fi.static_pass, jnp.float32),
@@ -437,5 +495,6 @@ def run_fast_scan(fi: FastInputs, tmpl_ids, pod_valid, forced, has_interpod: boo
         jnp.asarray(fi.gmatch_GU, jnp.float32),
         jnp.asarray(fi.prefg_GU, jnp.float32),
         jnp.asarray(fi.pmatch_GU, jnp.float32),
+        jnp.asarray(fi.gpu0_DN, jnp.float32),
     )
     return out

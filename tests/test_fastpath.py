@@ -5,6 +5,9 @@ import numpy as np
 import pytest
 
 from opensim_tpu.engine import fastpath
+from opensim_tpu.engine.scheduler import pad_pod_stream, schedule_pods
+from opensim_tpu.engine.simulator import AppResource, prepare
+from opensim_tpu.models import ResourceTypes, fixtures as fx
 
 
 @pytest.fixture(autouse=True)
@@ -12,9 +15,6 @@ def _enable_interpret_fastpath(monkeypatch):
     """applicable() requires a TPU backend unless interpret mode is forced
     (the rest of the suite intentionally exercises the XLA path on CPU)."""
     monkeypatch.setenv("OPENSIM_FASTPATH", "interpret")
-from opensim_tpu.engine.scheduler import pad_pod_stream, schedule_pods
-from opensim_tpu.engine.simulator import AppResource, prepare
-from opensim_tpu.models import ResourceTypes, fixtures as fx
 
 
 def _prep(n_nodes=16, with_spread=True, with_zone=True, replicas=64):
@@ -74,16 +74,50 @@ def test_fastpath_applicable():
 
 
 def test_fastpath_rejects_feature_rich():
+    # host ports and open-local storage stay on the XLA path
     cluster = ResourceTypes()
     cluster.nodes.append(fx.make_fake_node("n0"))
     app = ResourceTypes()
-    app.pods.append(
-        fx.make_fake_pod(
-            "gpu-pod", "1", "1Gi", fx.with_annotations({"alibabacloud.com/gpu-mem": "1Gi", "alibabacloud.com/gpu-count": "1"})
-        )
-    )
+    app.pods.append(fx.make_fake_pod("ported", "1", "1Gi", fx.with_host_ports([8080])))
     prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
     assert not fastpath.applicable(prep)
+
+
+def test_fastpath_matches_xla_gpu():
+    """GPU device packing through the megakernel must match the XLA scan:
+    placements, device assignments (gpu_take), and final device state."""
+    cluster = ResourceTypes()
+    for i in range(6):
+        cluster.nodes.append(
+            fx.make_fake_node(
+                f"g{i}", "64", "128Gi", "110",
+                fx.with_allocatable({"alibabacloud.com/gpu-mem": "32Gi", "alibabacloud.com/gpu-count": "4"}),
+            )
+        )
+    app = ResourceTypes()
+    for j, (mem, cnt, n) in enumerate([("4Gi", "1", 10), ("10Gi", "1", 6), ("6Gi", "2", 4), ("8Gi", "3", 3)]):
+        for k in range(n):
+            app.pods.append(
+                fx.make_fake_pod(
+                    f"gpu-{j}-{k}", "1", "1Gi",
+                    fx.with_annotations({"alibabacloud.com/gpu-mem": mem, "alibabacloud.com/gpu-count": cnt}),
+                )
+            )
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
+    assert prep.features.gpu
+    assert fastpath.applicable(prep)
+    P = len(prep.ordered)
+    t, v, f = pad_pod_stream(prep.tmpl_ids, np.ones(P, bool), prep.forced)
+    out = schedule_pods(prep.ec, prep.st0, t, v, f, features=prep.features)
+    want_chosen = np.asarray(out.chosen)[:P]
+    want_take = np.asarray(out.gpu_take)[:P]
+    want_gpu = np.asarray(out.final_state.gpu_free)
+    got_chosen, got_used, _sf, got_take, got_gpu = fastpath.schedule(
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+    )
+    np.testing.assert_array_equal(got_chosen, want_chosen)
+    np.testing.assert_allclose(got_take, want_take, rtol=1e-6)
+    np.testing.assert_allclose(got_gpu, want_gpu, rtol=1e-6)
 
 
 @pytest.mark.parametrize("with_spread,with_zone", [(False, False), (True, True), (True, False)])
@@ -92,7 +126,7 @@ def test_fastpath_matches_xla(with_spread, with_zone):
     assert fastpath.applicable(prep)
     P = len(prep.ordered)
     want_chosen, want_used = _xla_chosen(prep)
-    got_chosen, got_used, _sf = fastpath.schedule(
+    got_chosen, got_used, _sf, _gt, _gf = fastpath.schedule(
         prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
     )
     mismatches = np.nonzero(want_chosen != got_chosen)[0]
@@ -156,7 +190,7 @@ def test_fastpath_matches_xla_interpod():
     assert fastpath.applicable(prep)
     P = len(prep.ordered)
     want_chosen, want_used = _xla_chosen(prep)
-    got_chosen, got_used, _ = fastpath.schedule(
+    got_chosen, got_used, _sf, _gt, _gf = fastpath.schedule(
         prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
     )
     mism = np.nonzero(want_chosen != got_chosen)[0]
@@ -217,7 +251,7 @@ def test_fastpath_forced_pods():
     assert fastpath.applicable(prep)
     P = len(prep.ordered)
     want_chosen, want_used = _xla_chosen(prep)
-    got_chosen, got_used, _ = fastpath.schedule(
+    got_chosen, got_used, _sf, _gt, _gf = fastpath.schedule(
         prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
     )
     np.testing.assert_array_equal(got_chosen, want_chosen)

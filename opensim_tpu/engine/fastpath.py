@@ -36,9 +36,13 @@ def applicable(prep, config=None) -> bool:
         return False
     f = prep.features
     ec = prep.ec_np if prep.ec_np is not None else prep.ec
-    if f.ports or f.local:
+    if f.ports:
         return False
     if f.gpu and int(ec.node_gpu_mem.shape[1]) > 8:
+        return False
+    if f.local and (
+        int(ec.node_vg_cap.shape[1]) > 8 or int(ec.node_dev_cap.shape[1]) > 8
+    ):
         return False
     if f.pref_node_affinity or f.prefer_taints:
         return False
@@ -95,7 +99,12 @@ def applicable(prep, config=None) -> bool:
     # three [Gd_pad, N] arrays (input, scratch, output)
     G = 16
     Gd_pad = _pad8_static(int(ec.node_gpu_mem.shape[1]))
-    vmem = ((3 * U + 4 * R + A + 2 * G + 3 * Gd_pad + 4) * N + (2 * N + A + 2 * G) * Z) * 4
+    Vg_pad = _pad8_static(int(ec.node_vg_cap.shape[1]))
+    Dv_pad = _pad8_static(int(ec.node_dev_cap.shape[1]))
+    # local buffers: VG cap/init/out/scratch + device cap/init/out/scratch
+    # + two media one-hot row blocks
+    local_rows = 4 * Vg_pad + 6 * Dv_pad
+    vmem = ((3 * U + 4 * R + A + 2 * G + 3 * Gd_pad + local_rows + 4) * N + (2 * N + A + 2 * G) * Z) * 4
     if vmem > _VMEM_BUDGET:
         return False
     return True
@@ -162,11 +171,30 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
                 spr_self[u, c] = float(matches_sel[u, spr_sel[u, c]])
                 spr_weight[u, c] = float(spread_weight[spr_topo[u, c]])
 
-    # gpu device matrix, transposed to [Gd_pad, N] with sublane padding
-    gpu_free0 = np.asarray(jax.device_get(prep.st0.gpu_free))  # [N, Gd]
-    Gd_pad = _pad8_static(gpu_free0.shape[1])
-    gpu0_DN = np.zeros((Gd_pad, gpu_free0.shape[0]), np.float32)
-    gpu0_DN[: gpu_free0.shape[1]] = gpu_free0.T.astype(np.float32)
+    # extension state, fetched in ONE batched device_get (per-array fetches
+    # cost a tunnel RPC each), then transposed with sublane padding
+    gpu_free0, vg_free0, dev_free0 = jax.device_get(
+        (prep.st0.gpu_free, prep.st0.vg_free, prep.st0.dev_free)
+    )
+
+    def _padT(mat):  # [N, K] -> [K_pad, N]
+        mat = np.asarray(mat)
+        Kp = _pad8_static(mat.shape[1])
+        out_m = np.zeros((Kp, mat.shape[0]), np.float32)
+        out_m[: mat.shape[1]] = mat.T.astype(np.float32)
+        return out_m
+
+    gpu0_DN = _padT(gpu_free0)
+    Gd_pad = gpu0_DN.shape[0]
+    vg_cap_VN = _padT(prep.meta.node_vg_cap)
+    vg0_VN = _padT(vg_free0)
+    dev_cap_DN = _padT(prep.meta.node_dev_cap)
+    dev0_DN = _padT(dev_free0)
+    media = np.asarray(prep.meta.node_dev_media)  # [N, Dv]
+    Dv_pad = dev_cap_DN.shape[0]
+    dev_media_DN = np.zeros((2 * Dv_pad, N), np.float32)
+    for m in range(2):
+        dev_media_DN[m * Dv_pad : m * Dv_pad + media.shape[1]] = (media.T == m).astype(np.float32)
 
     req_np = np.asarray(ec.req).astype(np.float32)
     cpu_nz = np.where(req_np[:, V.RES_CPU] > 0, req_np[:, V.RES_CPU], 100.0).astype(np.float32)
@@ -258,6 +286,14 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
         gpu_mem=np.asarray(ec.gpu_mem).astype(np.float32),
         gpu_cnt=np.asarray(ec.gpu_count).astype(np.float32),
         gpu0_DN=gpu0_DN,
+        lvm_req=np.asarray(ec.lvm_req).astype(np.float32),
+        dev_req=np.asarray(ec.dev_req).astype(np.float32),
+        dev_need=np.asarray(ec.dev_req_count).astype(np.float32),
+        vg_cap_VN=vg_cap_VN,
+        vg0_VN=vg0_VN,
+        dev_cap_DN=dev_cap_DN,
+        dev0_DN=dev0_DN,
+        dev_media_DN=dev_media_DN,
     )
     meta = {"static_fail": np.asarray(stat.static_fail)}
     # device-resident copies so repeated runs (capacity loops, sweeps) skip
@@ -287,15 +323,20 @@ def schedule(prep, tmpl_ids, pod_valid, forced, interpret: Optional[bool] = None
         forced = np.concatenate([forced, np.zeros(pad, bool)])
     has_interpod = bool(prep.features.interpod or prep.features.prefg)
     has_gpu = bool(prep.features.gpu)
-    chosen, used_T, gpu_take, gpu_T = run_fast_scan(
+    has_local = bool(prep.features.local)
+    chosen, used_T, gpu_take, gpu_T, vg_T, dev_T = run_fast_scan(
         fi, tmpl_ids, pod_valid, forced,
-        has_interpod=has_interpod, has_gpu=has_gpu, interpret=interpret,
+        has_interpod=has_interpod, has_gpu=has_gpu, has_local=has_local, interpret=interpret,
     )
     Gd = int(prep.st0.gpu_free.shape[1])
+    Vg = int(prep.st0.vg_free.shape[1])
+    Dv = int(prep.st0.dev_free.shape[1])
     return (
         np.asarray(chosen)[:P],
         np.asarray(used_T).T,
         meta["static_fail"],
         np.asarray(gpu_take)[:P, :Gd],
         np.asarray(gpu_T)[:Gd].T,
+        np.asarray(vg_T)[:Vg].T,
+        np.asarray(dev_T)[:Dv].T,
     )

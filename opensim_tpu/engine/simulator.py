@@ -80,12 +80,13 @@ def _fast_output(
     static_fail: np.ndarray,
     gpu_take: np.ndarray,
     gpu_final: np.ndarray,
+    vg_final: np.ndarray,
+    dev_final: np.ndarray,
     prep: "Prepared",
 ):
     """Adapt the megakernel's outputs into the ScheduleOutput shape the
     decode path consumes. Only reached when nothing is unscheduled, so the
-    dynamic failure details are zeros; local-storage state equals its
-    initial value (the fast path excludes the local feature)."""
+    dynamic failure details are zeros."""
     from .scheduler import ScheduleOutput
 
     P = len(chosen)
@@ -98,7 +99,10 @@ def _fast_output(
         gpu_take=gpu_take.astype(np.float32),
         static_fail=static_fail,
         final_state=prep.st0._replace(
-            used=used_final.astype(np.float32), gpu_free=gpu_final.astype(np.float32)
+            used=used_final.astype(np.float32),
+            gpu_free=gpu_final.astype(np.float32),
+            vg_free=vg_final.astype(np.float32),
+            dev_free=dev_final.astype(np.float32),
         ),
     )
 
@@ -297,11 +301,11 @@ def simulate(
                 # Pallas megakernel fast path: identical placements, ~4×
                 # the XLA scan's step rate. Falls back below when pods fail
                 # (the full path produces the kube-style reason strings).
-                f_chosen, f_used, sf, f_take, f_gpu = fastpath.schedule(
+                f_chosen, f_used, sf, f_take, f_gpu, f_vg, f_dev = fastpath.schedule(
                     prep, tmpl_ids, pod_valid, forced
                 )
                 if not np.any((f_chosen < 0) & pod_valid & ~forced):
-                    out = _fast_output(f_chosen, f_used, sf, f_take, f_gpu, prep)
+                    out = _fast_output(f_chosen, f_used, sf, f_take, f_gpu, f_vg, f_dev, prep)
         if out is None:
             tmpl_p, valid_p, forced_p = pad_pod_stream(tmpl_ids, pod_valid, forced)
             out = schedule_pods(

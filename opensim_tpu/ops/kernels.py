@@ -764,12 +764,14 @@ def bind_update(ec, st, u, node, apply, feat: Features = ALL_FEATURES):
     dev_free = st.dev_free
     if feat.local:
         # open-local LVM: tightest-fitting VG (ascending free-size first-fit,
-        # vendored common.go:111-116)
+        # vendored common.go:111-116); a force-bound pod that fits nowhere
+        # takes nothing rather than driving vg_free negative
         lvm = ec.lvm_req[u]
         vg_free_n = st.vg_free[node]
         big = jnp.float32(1e30)
-        vg_choice = jnp.argmin(jnp.where(vg_free_n >= lvm, vg_free_n, big))
-        vg_hot = (jnp.arange(st.vg_free.shape[1]) == vg_choice).astype(jnp.float32)
+        vg_fits = vg_free_n >= lvm
+        vg_choice = jnp.argmin(jnp.where(vg_fits, vg_free_n, big))
+        vg_hot = ((jnp.arange(st.vg_free.shape[1]) == vg_choice) & jnp.any(vg_fits)).astype(jnp.float32)
         vg_free = st.vg_free.at[node].add(-(vg_hot * jnp.maximum(lvm, 0.0)) * applyf)
 
         # open-local exclusive devices: first-fit by index per media type

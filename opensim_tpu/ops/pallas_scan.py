@@ -93,9 +93,20 @@ class FastInputs(NamedTuple):
     gpu_mem: np.ndarray  # [U] f32 per-GPU memory request
     gpu_cnt: np.ndarray  # [U] f32 requested GPU count
     gpu0_DN: np.ndarray  # [Gd, N] f32 initial per-device free memory
+    # open-local storage (inert when has_local=False)
+    lvm_req: np.ndarray  # [U] f32 total LVM bytes
+    dev_req: np.ndarray  # [U, 2] f32 exclusive-device size by media
+    dev_need: np.ndarray  # [U, 2] f32 device count by media
+    vg_cap_VN: np.ndarray  # [Vg, N] f32 VG capacities
+    vg0_VN: np.ndarray  # [Vg, N] f32 initial VG free
+    dev_cap_DN: np.ndarray  # [Dv, N] f32 device capacities
+    dev0_DN: np.ndarray  # [Dv, N] f32 initial device free
+    dev_media_DN: np.ndarray  # [2*Dv, N] f32 media one-hots (ssd rows then hdd rows)
 
 
-def _make_kernel(has_interpod: bool, has_gpu: bool, n_anti: int, n_pref: int, n_gpu: int):
+def _make_kernel(
+    has_interpod: bool, has_gpu: bool, has_local: bool, n_anti: int, n_pref: int, n_gpu: int, n_vg: int, n_dev: int
+):
     def kernel(
         # SMEM streams + tables
         tmpl_ref, valid_ref, forced_ref,
@@ -106,16 +117,18 @@ def _make_kernel(has_interpod: bool, has_gpu: bool, n_anti: int, n_pref: int, n_
         pta_ref, pth_ref, pts_ref, ptw_ref,
         agh_ref, pgh_ref,
         gmem_ref, gcnt_ref,
+        lvm_ref, dreq_ref, dneed_ref,
         # VMEM inputs
         alloc_ref, used0_ref, static_ref, affm_ref, shraw_ref,
         zone_nz_ref, zone_zn_ref, has_zone_ref, matches_ref, nodevalid_ref,
         antig_ref, gmatch_ref, prefg_ref, pmatch_ref, gpu0_ref,
+        vgcap_ref, vg0_ref, devcap_ref, dev0_ref, media_ref,
         # outputs
-        chosen_ref, used_out_ref, gpu_take_ref, gpu_out_ref,
+        chosen_ref, used_out_ref, gpu_take_ref, gpu_out_ref, vg_out_ref, dev_out_ref,
         # scratch
         used_ref, node_cnt_ref, zone_cnt_ref,
         anti_node_ref, anti_zone_ref, prefw_node_ref, prefw_zone_ref,
-        gpu_free_ref,
+        gpu_free_ref, vg_free_ref, dev_free_ref,
     ):
         R, N = alloc_ref.shape
         U = static_ref.shape[0]
@@ -134,6 +147,8 @@ def _make_kernel(has_interpod: bool, has_gpu: bool, n_anti: int, n_pref: int, n_
             prefw_node_ref[:] = jnp.zeros_like(prefw_node_ref)
             prefw_zone_ref[:] = jnp.zeros_like(prefw_zone_ref)
             gpu_free_ref[:] = gpu0_ref[:]
+            vg_free_ref[:] = vg0_ref[:]
+            dev_free_ref[:] = dev0_ref[:]
 
         iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
         iota_u = jax.lax.broadcasted_iota(jnp.int32, (U, 1), 0)
@@ -189,6 +204,28 @@ def _make_kernel(has_interpod: bool, has_gpu: bool, n_anti: int, n_pref: int, n_
                     )
                 gpu_ok = ((chunks_sum >= gcnt) & (gcnt > 0)).astype(jnp.float32)
                 feasible = jnp.where(gmem > 0, feasible * gpu_ok, feasible)
+
+            if has_local:
+                # Open-Local filter: LVM fits the best VG; enough exclusive
+                # devices of each media type
+                lvm = lvm_ref[u]
+                best_vg_free = jnp.full((1, N), -1e30, jnp.float32)
+                for v in range(n_vg):
+                    best_vg_free = jnp.maximum(best_vg_free, vg_free_ref[pl.ds(v, 1), :])
+                feasible = jnp.where(
+                    lvm > 0, feasible * (best_vg_free >= lvm).astype(jnp.float32), feasible
+                )
+                for m in range(2):
+                    size = dreq_ref[u, m]
+                    need = dneed_ref[u, m]
+                    cnt_fit = jnp.zeros((1, N), jnp.float32)
+                    for d in range(n_dev):
+                        free_d = dev_free_ref[pl.ds(d, 1), :]
+                        media_d = media_ref[pl.ds(m * n_dev + d, 1), :]
+                        cnt_fit = cnt_fit + media_d * ((free_d >= size) & (free_d > 0)).astype(jnp.float32)
+                    feasible = jnp.where(
+                        size > 0, feasible * (cnt_fit >= need).astype(jnp.float32), feasible
+                    )
 
             # --- PodTopologySpread
             aff_row = affm_ref[pl.ds(u, 1), :] * valid_row
@@ -314,6 +351,41 @@ def _make_kernel(has_interpod: bool, has_gpu: bool, n_anti: int, n_pref: int, n_
             spread_norm = jnp.where(any_soft > 0, spread_norm, 0.0)
 
             score = least + balanced + 2.0 * share_norm + 2.0 * spread_norm
+            if has_local:
+                # Open-Local binpack score (local_score in kernels.py):
+                # mean over units of used/capacity × 10, min-max normalized
+                lvm = lvm_ref[u]
+                big_f = jnp.float32(1e30)
+                best_free = jnp.full((1, N), big_f, jnp.float32)
+                best_cap = jnp.zeros((1, N), jnp.float32)
+                for v in range(n_vg):
+                    free_v = vg_free_ref[pl.ds(v, 1), :]
+                    fits_v = free_v >= lvm
+                    better = fits_v & (free_v < best_free)
+                    best_free = jnp.where(better, free_v, best_free)
+                    best_cap = jnp.where(better, vgcap_ref[pl.ds(v, 1), :], best_cap)
+                parts = jnp.where(
+                    (lvm > 0) & (best_free < big_f), lvm / jnp.maximum(best_cap, 1.0), 0.0
+                )
+                count = jnp.where(lvm > 0, 1.0, 0.0)
+                for m in range(2):
+                    size = dreq_ref[u, m]
+                    need = dneed_ref[u, m]
+                    first_cap = jnp.full((1, N), big_f, jnp.float32)
+                    for d in range(n_dev):
+                        free_d = dev_free_ref[pl.ds(d, 1), :]
+                        media_d = media_ref[pl.ds(m * n_dev + d, 1), :]
+                        fitting = (media_d > 0) & (free_d >= size) & (free_d > 0)
+                        first_cap = jnp.where(
+                            fitting, jnp.minimum(first_cap, devcap_ref[pl.ds(d, 1), :]), first_cap
+                        )
+                    parts = parts + jnp.where(size > 0, need * size / jnp.maximum(first_cap, 1.0), 0.0)
+                    count = count + jnp.where(size > 0, need, 0.0)
+                local_raw = jnp.where(count > 0, parts / jnp.maximum(count, 1.0) * 10.0, 0.0)
+                l_lo = jnp.min(jnp.where(feas_b, local_raw, big_f))
+                l_hi = jnp.max(jnp.where(feas_b, local_raw, -big_f))
+                l_rng = l_hi - l_lo
+                score = score + jnp.where(l_rng > 0, (local_raw - l_lo) * MAX_SCORE / l_rng, 0.0)
             if has_interpod:
                 # interpod_score normalization: min/max seeded with 0
                 ip_masked = jnp.where(feas_b, ip_raw, 0.0)
@@ -377,6 +449,34 @@ def _make_kernel(has_interpod: bool, has_gpu: bool, n_anti: int, n_pref: int, n_
                         take_d = jnp.where(gmem > 0, take_d, 0.0)
                         gpu_free_ref[pl.ds(d, 1), :] = free_d - take_d * gmem * onehot
                         gpu_take_ref[i, d] = jnp.sum(take_d * onehot)
+                if has_local:
+                    # LVM: tightest-fitting VG (first among equals)
+                    lvm = lvm_ref[u]
+                    big_f = jnp.float32(1e30)
+                    best_free = jnp.full((1, N), big_f, jnp.float32)
+                    for v in range(n_vg):
+                        free_v = vg_free_ref[pl.ds(v, 1), :]
+                        best_free = jnp.where(free_v >= lvm, jnp.minimum(best_free, free_v), best_free)
+                    taken_vg = jnp.zeros((1, N), jnp.float32)
+                    for v in range(n_vg):
+                        free_v = vg_free_ref[pl.ds(v, 1), :]
+                        take_v = (
+                            (free_v >= lvm) & (free_v == best_free)
+                        ).astype(jnp.float32) * (1.0 - jnp.minimum(taken_vg, 1.0))
+                        taken_vg = taken_vg + take_v
+                        vg_free_ref[pl.ds(v, 1), :] = free_v - jnp.maximum(lvm, 0.0) * take_v * onehot
+                    # exclusive devices: first-fit by index per media type
+                    for m in range(2):
+                        size = dreq_ref[u, m]
+                        need = dneed_ref[u, m]
+                        cnt_taken = jnp.zeros((1, N), jnp.float32)
+                        for d in range(n_dev):
+                            free_d = dev_free_ref[pl.ds(d, 1), :]
+                            media_d = media_ref[pl.ds(m * n_dev + d, 1), :]
+                            fitting = ((media_d > 0) & (free_d >= size) & (free_d > 0)).astype(jnp.float32)
+                            cnt_taken = cnt_taken + fitting
+                            take_d = fitting * (cnt_taken <= need).astype(jnp.float32) * jnp.where(size > 0, 1.0, 0.0)
+                            dev_free_ref[pl.ds(d, 1), :] = free_d * (1.0 - take_d * onehot)
                 if has_interpod:
                     a_col = jnp.dot(antig_ref[:], onehot_u, preferred_element_type=jnp.float32)
                     anti_node_ref[:] = anti_node_ref[:] + a_col * onehot
@@ -390,16 +490,25 @@ def _make_kernel(has_interpod: bool, has_gpu: bool, n_anti: int, n_pref: int, n_
         jax.lax.fori_loop(0, tmpl_ref.shape[0], body, 0)
         used_out_ref[:] = used_ref[:]
         gpu_out_ref[:] = gpu_free_ref[:]
+        vg_out_ref[:] = vg_free_ref[:]
+        dev_out_ref[:] = dev_free_ref[:]
 
     return kernel
 
 
 def run_fast_scan(
-    fi: FastInputs, tmpl_ids, pod_valid, forced, has_interpod: bool, has_gpu: bool, interpret: bool = False
+    fi: FastInputs,
+    tmpl_ids,
+    pod_valid,
+    forced,
+    has_interpod: bool,
+    has_gpu: bool,
+    has_local: bool = False,
+    interpret: bool = False,
 ):
     """Execute the megakernel. tmpl_ids/pod_valid/forced are [P] (P a
     multiple of CHUNK). Returns (chosen [P] i32, used_final [R, N],
-    gpu_take [P, Gd], gpu_final [Gd, N])."""
+    gpu_take [P, Gd], gpu_final [Gd, N], vg_final [Vg, N], dev_final [Dv, N])."""
     P = tmpl_ids.shape[0]
     assert P % CHUNK == 0, P
     R, N = fi.alloc_T.shape
@@ -408,6 +517,8 @@ def run_fast_scan(
     G = fi.antig_GU.shape[0]
     Gp = fi.prefg_GU.shape[0]
     Gd = fi.gpu0_DN.shape[0]
+    Vg = fi.vg0_VN.shape[0]
+    Dv = fi.dev0_DN.shape[0]
     grid = (P // CHUNK,)
 
     smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
@@ -415,13 +526,15 @@ def run_fast_scan(
     stream = lambda: pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM)
 
     out = pl.pallas_call(
-        _make_kernel(has_interpod, has_gpu, G, Gp, Gd),
+        _make_kernel(has_interpod, has_gpu, has_local, G, Gp, Gd, Vg, Dv),
         grid=grid,
         out_shape=(
             jax.ShapeDtypeStruct((P,), jnp.int32),
             jax.ShapeDtypeStruct((R, N), jnp.float32),
             jax.ShapeDtypeStruct((P, Gd), jnp.float32),
             jax.ShapeDtypeStruct((Gd, N), jnp.float32),
+            jax.ShapeDtypeStruct((Vg, N), jnp.float32),
+            jax.ShapeDtypeStruct((Dv, N), jnp.float32),
         ),
         in_specs=(
             [stream(), stream(), stream()]
@@ -432,13 +545,16 @@ def run_fast_scan(
             + [smem()] * 4  # pt_*
             + [smem()] * 2  # anti_g_host, prefg_host
             + [smem()] * 2  # gpu_mem, gpu_cnt
-            + [vmem()] * 15  # VMEM inputs
+            + [smem()] * 3  # lvm_req, dev_req, dev_need
+            + [vmem()] * 20  # VMEM inputs
         ),
         out_specs=(
             pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM),
             pl.BlockSpec((R, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((CHUNK, Gd), lambda i: (i, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec((Gd, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((Vg, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((Dv, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ),
         scratch_shapes=[
             pltpu.VMEM((R, N), jnp.float32),
@@ -449,6 +565,8 @@ def run_fast_scan(
             pltpu.VMEM((Gp, N), jnp.float32),
             pltpu.VMEM((Gp, Z), jnp.float32),
             pltpu.VMEM((Gd, N), jnp.float32),
+            pltpu.VMEM((Vg, N), jnp.float32),
+            pltpu.VMEM((Dv, N), jnp.float32),
         ],
         interpret=interpret,
     )(
@@ -481,6 +599,9 @@ def run_fast_scan(
         jnp.asarray(fi.prefg_host, jnp.int32),
         jnp.asarray(fi.gpu_mem, jnp.float32),
         jnp.asarray(fi.gpu_cnt, jnp.float32),
+        jnp.asarray(fi.lvm_req, jnp.float32),
+        jnp.asarray(fi.dev_req, jnp.float32),
+        jnp.asarray(fi.dev_need, jnp.float32),
         jnp.asarray(fi.alloc_T, jnp.float32),
         jnp.asarray(fi.used0_T, jnp.float32),
         jnp.asarray(fi.static_pass, jnp.float32),
@@ -496,5 +617,10 @@ def run_fast_scan(
         jnp.asarray(fi.prefg_GU, jnp.float32),
         jnp.asarray(fi.pmatch_GU, jnp.float32),
         jnp.asarray(fi.gpu0_DN, jnp.float32),
+        jnp.asarray(fi.vg_cap_VN, jnp.float32),
+        jnp.asarray(fi.vg0_VN, jnp.float32),
+        jnp.asarray(fi.dev_cap_DN, jnp.float32),
+        jnp.asarray(fi.dev0_DN, jnp.float32),
+        jnp.asarray(fi.dev_media_DN, jnp.float32),
     )
     return out

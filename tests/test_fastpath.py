@@ -112,12 +112,58 @@ def test_fastpath_matches_xla_gpu():
     want_chosen = np.asarray(out.chosen)[:P]
     want_take = np.asarray(out.gpu_take)[:P]
     want_gpu = np.asarray(out.final_state.gpu_free)
-    got_chosen, got_used, _sf, got_take, got_gpu = fastpath.schedule(
+    got_chosen, got_used, _sf, got_take, got_gpu, _vg, _dv = fastpath.schedule(
         prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
     )
     np.testing.assert_array_equal(got_chosen, want_chosen)
     np.testing.assert_allclose(got_take, want_take, rtol=1e-6)
     np.testing.assert_allclose(got_gpu, want_gpu, rtol=1e-6)
+
+
+def test_fastpath_matches_xla_local_storage():
+    """Open-local VG + exclusive-device packing through the megakernel must
+    match the XLA scan: placements, VG free, and device occupancy."""
+    cluster = ResourceTypes()
+    for i in range(4):
+        cluster.nodes.append(
+            fx.make_fake_node(
+                f"s{i}", "32", "64Gi", "110",
+                fx.with_node_local_storage(
+                    vgs=[
+                        {"name": "pool0", "capacity": 100 * 1024**3},
+                        {"name": "pool1", "capacity": 50 * 1024**3},
+                    ],
+                    devices=[
+                        {"device": "/dev/vdb", "capacity": 80 * 1024**3, "mediaType": "ssd"},
+                        {"device": "/dev/vdc", "capacity": 120 * 1024**3, "mediaType": "hdd"},
+                    ],
+                ),
+            )
+        )
+    app = ResourceTypes()
+    sts = fx.make_fake_stateful_set("db", 6, "500m", "1Gi")
+    sts.volume_claim_templates = [
+        {"metadata": {"name": "data"}, "spec": {"storageClassName": "open-local-lvm", "resources": {"requests": {"storage": "30Gi"}}}},
+    ]
+    app.stateful_sets.append(sts)
+    sts2 = fx.make_fake_stateful_set("disk", 3, "250m", "512Mi")
+    sts2.volume_claim_templates = [
+        {"metadata": {"name": "d"}, "spec": {"storageClassName": "open-local-device-hdd", "resources": {"requests": {"storage": "100Gi"}}}},
+    ]
+    app.stateful_sets.append(sts2)
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
+    assert prep.features.local
+    assert fastpath.applicable(prep)
+    P = len(prep.ordered)
+    t, v, f = pad_pod_stream(prep.tmpl_ids, np.ones(P, bool), prep.forced)
+    out = schedule_pods(prep.ec, prep.st0, t, v, f, features=prep.features)
+    want_chosen = np.asarray(out.chosen)[:P]
+    got_chosen, got_used, _sf, _gt, _gf, got_vg, got_dev = fastpath.schedule(
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+    )
+    np.testing.assert_array_equal(got_chosen, want_chosen)
+    np.testing.assert_allclose(got_vg, np.asarray(out.final_state.vg_free), rtol=1e-6)
+    np.testing.assert_allclose(got_dev, np.asarray(out.final_state.dev_free), rtol=1e-6)
 
 
 @pytest.mark.parametrize("with_spread,with_zone", [(False, False), (True, True), (True, False)])
@@ -126,7 +172,7 @@ def test_fastpath_matches_xla(with_spread, with_zone):
     assert fastpath.applicable(prep)
     P = len(prep.ordered)
     want_chosen, want_used = _xla_chosen(prep)
-    got_chosen, got_used, _sf, _gt, _gf = fastpath.schedule(
+    got_chosen, got_used, *_rest = fastpath.schedule(
         prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
     )
     mismatches = np.nonzero(want_chosen != got_chosen)[0]
@@ -190,7 +236,7 @@ def test_fastpath_matches_xla_interpod():
     assert fastpath.applicable(prep)
     P = len(prep.ordered)
     want_chosen, want_used = _xla_chosen(prep)
-    got_chosen, got_used, _sf, _gt, _gf = fastpath.schedule(
+    got_chosen, got_used, *_rest = fastpath.schedule(
         prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
     )
     mism = np.nonzero(want_chosen != got_chosen)[0]
@@ -251,7 +297,7 @@ def test_fastpath_forced_pods():
     assert fastpath.applicable(prep)
     P = len(prep.ordered)
     want_chosen, want_used = _xla_chosen(prep)
-    got_chosen, got_used, _sf, _gt, _gf = fastpath.schedule(
+    got_chosen, got_used, *_rest = fastpath.schedule(
         prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
     )
     np.testing.assert_array_equal(got_chosen, want_chosen)

@@ -36,15 +36,13 @@ def applicable(prep, config=None) -> bool:
         return False
     f = prep.features
     ec = prep.ec_np if prep.ec_np is not None else prep.ec
-    if f.ports:
-        return False
+    if f.ports and int(ec.ports.max() if ec.ports.size else -1) >= 64:
+        return False  # port-vocab ids ≥64 exceed the 64 padded rows budgeted
     if f.gpu and int(ec.node_gpu_mem.shape[1]) > 8:
         return False
     if f.local and (
         int(ec.node_vg_cap.shape[1]) > 8 or int(ec.node_dev_cap.shape[1]) > 8
     ):
-        return False
-    if f.pref_node_affinity or f.prefer_taints:
         return False
     # inter-pod terms are supported with bounded table sizes
     if f.interpod or f.prefg:
@@ -102,8 +100,8 @@ def applicable(prep, config=None) -> bool:
     Vg_pad = _pad8_static(int(ec.node_vg_cap.shape[1]))
     Dv_pad = _pad8_static(int(ec.node_dev_cap.shape[1]))
     # local buffers: VG cap/init/out/scratch + device cap/init/out/scratch
-    # + two media one-hot row blocks
-    local_rows = 4 * Vg_pad + 6 * Dv_pad
+    # + two media one-hot row blocks; ports [Hp, N] ×2; na/tt [U, N] each
+    local_rows = 4 * Vg_pad + 6 * Dv_pad + 2 * 64 + 2 * U
     vmem = ((3 * U + 4 * R + A + 2 * G + 3 * Gd_pad + local_rows + 4) * N + (2 * N + A + 2 * G) * Z) * 4
     if vmem > _VMEM_BUDGET:
         return False
@@ -211,6 +209,16 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
         host = (topo == host_tk).astype(np.int32)
         return active, host, np.maximum(sel, 0).astype(np.int32)
 
+    # host-port rows: [Hp_pad, U] template multi-hot
+    ports_u = np.asarray(ec.ports)  # [U, Hp_tmpl] port vocab ids, -1 pad
+    n_port_vocab = int(ports_u.max()) + 1 if ports_u.size and ports_u.max() >= 0 else 0
+    Hp_pad = _pad8_static(max(n_port_vocab, 1))
+    port_HU = np.zeros((Hp_pad, U), np.float32)
+    for u_i in range(ports_u.shape[0]):
+        for h in ports_u[u_i]:
+            if h >= 0:
+                port_HU[int(h), u_i] += 1.0
+
     at_active, at_host, at_sel = terms(ec.at_sel, ec.at_topo)
     an_active, an_host, an_sel = terms(ec.an_sel, ec.an_topo)
     pt_active, pt_host, pt_sel = terms(ec.pt_sel, ec.pt_topo)
@@ -294,6 +302,9 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
         dev_cap_DN=dev_cap_DN,
         dev0_DN=dev0_DN,
         dev_media_DN=dev_media_DN,
+        port_HU=port_HU,
+        na_raw=np.asarray(stat.na_raw).astype(np.float32),
+        tt_raw=np.asarray(stat.tt_raw).astype(np.float32),
     )
     meta = {"static_fail": np.asarray(stat.static_fail)}
     # device-resident copies so repeated runs (capacity loops, sweeps) skip
@@ -326,7 +337,11 @@ def schedule(prep, tmpl_ids, pod_valid, forced, interpret: Optional[bool] = None
     has_local = bool(prep.features.local)
     chosen, used_T, gpu_take, gpu_T, vg_T, dev_T = run_fast_scan(
         fi, tmpl_ids, pod_valid, forced,
-        has_interpod=has_interpod, has_gpu=has_gpu, has_local=has_local, interpret=interpret,
+        has_interpod=has_interpod, has_gpu=has_gpu, has_local=has_local,
+        has_ports=bool(prep.features.ports),
+        has_na=bool(prep.features.pref_node_affinity),
+        has_tt=bool(prep.features.prefer_taints),
+        interpret=interpret,
     )
     Gd = int(prep.st0.gpu_free.shape[1])
     Vg = int(prep.st0.vg_free.shape[1])

@@ -86,7 +86,9 @@ def _fast_output(
 ):
     """Adapt the megakernel's outputs into the ScheduleOutput shape the
     decode path consumes. Only reached when nothing is unscheduled, so the
-    dynamic failure details are zeros."""
+    dynamic failure details are zeros. NOTE: final_state.port_used and the
+    domain-count fields keep their initial values (the kernel tracks them
+    internally); no current consumer reads them from a finished run."""
     from .scheduler import ScheduleOutput
 
     P = len(chosen)

@@ -102,10 +102,25 @@ class FastInputs(NamedTuple):
     dev_cap_DN: np.ndarray  # [Dv, N] f32 device capacities
     dev0_DN: np.ndarray  # [Dv, N] f32 initial device free
     dev_media_DN: np.ndarray  # [2*Dv, N] f32 media one-hots (ssd rows then hdd rows)
+    # host ports (inert when has_ports=False)
+    port_HU: np.ndarray  # [Hp, U] f32 — template uses port row h
+    # static score tables (inert when the matching feature flag is off)
+    na_raw: np.ndarray  # [U, N] f32 preferred-node-affinity weights
+    tt_raw: np.ndarray  # [U, N] f32 intolerable PreferNoSchedule counts
 
 
 def _make_kernel(
-    has_interpod: bool, has_gpu: bool, has_local: bool, n_anti: int, n_pref: int, n_gpu: int, n_vg: int, n_dev: int
+    has_interpod: bool,
+    has_gpu: bool,
+    has_local: bool,
+    has_ports: bool,
+    has_na: bool,
+    has_tt: bool,
+    n_anti: int,
+    n_pref: int,
+    n_gpu: int,
+    n_vg: int,
+    n_dev: int,
 ):
     def kernel(
         # SMEM streams + tables
@@ -123,12 +138,13 @@ def _make_kernel(
         zone_nz_ref, zone_zn_ref, has_zone_ref, matches_ref, nodevalid_ref,
         antig_ref, gmatch_ref, prefg_ref, pmatch_ref, gpu0_ref,
         vgcap_ref, vg0_ref, devcap_ref, dev0_ref, media_ref,
+        port_hu_ref, na_ref, tt_ref,
         # outputs
         chosen_ref, used_out_ref, gpu_take_ref, gpu_out_ref, vg_out_ref, dev_out_ref,
         # scratch
         used_ref, node_cnt_ref, zone_cnt_ref,
         anti_node_ref, anti_zone_ref, prefw_node_ref, prefw_zone_ref,
-        gpu_free_ref, vg_free_ref, dev_free_ref,
+        gpu_free_ref, vg_free_ref, dev_free_ref, port_used_ref,
     ):
         R, N = alloc_ref.shape
         U = static_ref.shape[0]
@@ -149,6 +165,7 @@ def _make_kernel(
             gpu_free_ref[:] = gpu0_ref[:]
             vg_free_ref[:] = vg0_ref[:]
             dev_free_ref[:] = dev0_ref[:]
+            port_used_ref[:] = jnp.zeros_like(port_used_ref)
 
         iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
         iota_u = jax.lax.broadcasted_iota(jnp.int32, (U, 1), 0)
@@ -192,6 +209,18 @@ def _make_kernel(
                 over = (used_ref[pl.ds(r, 1), :] + req_r > alloc_ref[pl.ds(r, 1), :]).astype(jnp.float32)
                 fit = fit * jnp.where(req_r > 0, 1.0 - over, 1.0)
             feasible = static_row * fit
+
+            if has_ports:
+                # NodePorts: any requested port already used on the node
+                # (template port rows via one-hot matvec)
+                onehot_u_p = (iota_u == u).astype(jnp.float32)
+                my_ports = jnp.dot(port_hu_ref[:], onehot_u_p, preferred_element_type=jnp.float32)  # [Hp, 1]
+                conflicts = jnp.dot(
+                    my_ports.reshape(1, -1),
+                    (port_used_ref[:] > 0).astype(jnp.float32),
+                    preferred_element_type=jnp.float32,
+                )  # [1, N]
+                feasible = feasible * (conflicts == 0).astype(jnp.float32)
 
             if has_gpu:
                 # Open-Gpu-Share filter: sum_d floor(free_d / mem) >= count
@@ -351,6 +380,22 @@ def _make_kernel(
             spread_norm = jnp.where(any_soft > 0, spread_norm, 0.0)
 
             score = least + balanced + 2.0 * share_norm + 2.0 * spread_norm
+            if has_na:
+                # NodeAffinity preferred-term weights, max-normalized over
+                # the feasible set (DefaultNormalizeScore)
+                na_row = na_ref[pl.ds(u, 1), :]
+                na_max = jnp.max(jnp.where(feas_b, na_row, 0.0))
+                score = score + jnp.where(
+                    na_max > 0, na_row * MAX_SCORE / jnp.maximum(na_max, 1.0), na_row
+                )
+            if has_tt:
+                # TaintToleration: intolerable PreferNoSchedule counts,
+                # reverse-normalized
+                tt_row = tt_ref[pl.ds(u, 1), :]
+                tt_max = jnp.max(jnp.where(feas_b, tt_row, 0.0))
+                score = score + jnp.where(
+                    tt_max > 0, MAX_SCORE - tt_row * MAX_SCORE / jnp.maximum(tt_max, 1.0), MAX_SCORE
+                )
             if has_local:
                 # Open-Local binpack score (local_score in kernels.py):
                 # mean over units of used/capacity × 10, min-max normalized
@@ -425,6 +470,9 @@ def _make_kernel(
                 zrow_c = zone_nz_ref[pl.ds(c, 1), :]  # [1, Z]
                 node_cnt_ref[:] = node_cnt_ref[:] + m_col * onehot
                 zone_cnt_ref[:] = zone_cnt_ref[:] + m_col * zrow_c
+                if has_ports:
+                    p_col = jnp.dot(port_hu_ref[:], onehot_u, preferred_element_type=jnp.float32)
+                    port_used_ref[:] = port_used_ref[:] + p_col * onehot
                 if has_gpu:
                     # device packing on the chosen node (computed for all
                     # nodes, applied via the one-hot): single-GPU tightest
@@ -504,6 +552,9 @@ def run_fast_scan(
     has_interpod: bool,
     has_gpu: bool,
     has_local: bool = False,
+    has_ports: bool = False,
+    has_na: bool = False,
+    has_tt: bool = False,
     interpret: bool = False,
 ):
     """Execute the megakernel. tmpl_ids/pod_valid/forced are [P] (P a
@@ -519,6 +570,7 @@ def run_fast_scan(
     Gd = fi.gpu0_DN.shape[0]
     Vg = fi.vg0_VN.shape[0]
     Dv = fi.dev0_DN.shape[0]
+    Hp = fi.port_HU.shape[0]
     grid = (P // CHUNK,)
 
     smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
@@ -526,7 +578,7 @@ def run_fast_scan(
     stream = lambda: pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM)
 
     out = pl.pallas_call(
-        _make_kernel(has_interpod, has_gpu, has_local, G, Gp, Gd, Vg, Dv),
+        _make_kernel(has_interpod, has_gpu, has_local, has_ports, has_na, has_tt, G, Gp, Gd, Vg, Dv),
         grid=grid,
         out_shape=(
             jax.ShapeDtypeStruct((P,), jnp.int32),
@@ -546,7 +598,7 @@ def run_fast_scan(
             + [smem()] * 2  # anti_g_host, prefg_host
             + [smem()] * 2  # gpu_mem, gpu_cnt
             + [smem()] * 3  # lvm_req, dev_req, dev_need
-            + [vmem()] * 20  # VMEM inputs
+            + [vmem()] * 23  # VMEM inputs
         ),
         out_specs=(
             pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM),
@@ -567,6 +619,7 @@ def run_fast_scan(
             pltpu.VMEM((Gd, N), jnp.float32),
             pltpu.VMEM((Vg, N), jnp.float32),
             pltpu.VMEM((Dv, N), jnp.float32),
+            pltpu.VMEM((Hp, N), jnp.float32),
         ],
         interpret=interpret,
     )(
@@ -622,5 +675,8 @@ def run_fast_scan(
         jnp.asarray(fi.dev_cap_DN, jnp.float32),
         jnp.asarray(fi.dev0_DN, jnp.float32),
         jnp.asarray(fi.dev_media_DN, jnp.float32),
+        jnp.asarray(fi.port_HU, jnp.float32),
+        jnp.asarray(fi.na_raw, jnp.float32),
+        jnp.asarray(fi.tt_raw, jnp.float32),
     )
     return out

@@ -73,14 +73,39 @@ def test_fastpath_applicable():
     assert fastpath.applicable(prep)
 
 
-def test_fastpath_rejects_feature_rich():
-    # host ports and open-local storage stay on the XLA path
+def test_fastpath_rejects_unsupported():
+    from opensim_tpu.engine.schedconfig import DEFAULT_CONFIG
+
     cluster = ResourceTypes()
     cluster.nodes.append(fx.make_fake_node("n0"))
     app = ResourceTypes()
-    app.pods.append(fx.make_fake_pod("ported", "1", "1Gi", fx.with_host_ports([8080])))
+    app.pods.append(fx.make_fake_pod("p", "1", "1Gi"))
+
+    # non-default scheduler config stays on the XLA path
     prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
-    assert not fastpath.applicable(prep)
+    assert fastpath.applicable(prep)
+    assert not fastpath.applicable(prep, DEFAULT_CONFIG._replace(w_least=3.0))
+
+    # more than two topology keys stays on the XLA path
+    app2 = ResourceTypes()
+    app2.pods.append(
+        fx.make_fake_pod(
+            "spread3", "1", "1Gi",
+            fx.with_topology_spread(
+                [
+                    {"maxSkew": 1, "topologyKey": k, "whenUnsatisfiable": "ScheduleAnyway",
+                     "labelSelector": {"matchLabels": {"x": "y"}}}
+                    for k in ("topology.kubernetes.io/zone", "topology.kubernetes.io/region")
+                ]
+            ),
+        )
+    )
+    prep2 = prepare(cluster, [AppResource("a", app2)], node_pad=128)
+    assert not fastpath.applicable(prep2)
+
+    # non-128-multiple node padding stays on the XLA path
+    prep3 = prepare(cluster, [AppResource("a", app)], node_pad=8)
+    assert not fastpath.applicable(prep3)
 
 
 def test_fastpath_matches_xla_gpu():
@@ -118,6 +143,44 @@ def test_fastpath_matches_xla_gpu():
     np.testing.assert_array_equal(got_chosen, want_chosen)
     np.testing.assert_allclose(got_take, want_take, rtol=1e-6)
     np.testing.assert_allclose(got_gpu, want_gpu, rtol=1e-6)
+
+
+def test_fastpath_matches_xla_ports_na_tt():
+    """Host ports, preferred node affinity, and PreferNoSchedule scoring
+    through the megakernel must match the XLA scan."""
+    cluster = ResourceTypes()
+    for i in range(6):
+        opts = [fx.with_labels({"disk": "ssd" if i % 2 else "hdd"})]
+        if i < 2:
+            opts.append(fx.with_taints([{"key": "soft", "value": "x", "effect": "PreferNoSchedule"}]))
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "16", "32Gi", "110", *opts))
+    app = ResourceTypes()
+    for k in range(5):
+        app.pods.append(fx.make_fake_pod(f"web-{k}", "500m", "1Gi", fx.with_host_ports([8080])))
+    app.deployments.append(
+        fx.make_fake_deployment(
+            "pref", 6, "250m", "512Mi",
+            fx.with_affinity(
+                {
+                    "nodeAffinity": {
+                        "preferredDuringSchedulingIgnoredDuringExecution": [
+                            {"weight": 50, "preference": {"matchExpressions": [{"key": "disk", "operator": "In", "values": ["ssd"]}]}}
+                        ]
+                    }
+                }
+            ),
+        )
+    )
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
+    assert prep.features.ports and prep.features.pref_node_affinity and prep.features.prefer_taints
+    assert fastpath.applicable(prep)
+    P = len(prep.ordered)
+    want_chosen, want_used = _xla_chosen(prep)
+    got_chosen, got_used, *_rest = fastpath.schedule(
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+    )
+    np.testing.assert_array_equal(got_chosen, want_chosen)
+    np.testing.assert_allclose(got_used, want_used, rtol=1e-5)
 
 
 def test_fastpath_matches_xla_local_storage():

@@ -50,6 +50,16 @@ def build_parser() -> argparse.ArgumentParser:
     apply_p.add_argument("--max-new-nodes", type=int, default=128, help="upper bound for the node sweep")
     apply_p.add_argument("--report-pods", action="store_true", help="include the per-node Pod Info table")
 
+    defrag_p = sub.add_parser(
+        "defrag",
+        help="evaluate node-drain what-ifs (the README's Pods Migration feature, batch-evaluated)",
+    )
+    defrag_p.add_argument("-f", "--simon-config", required=True, help="path of simon config (Config CR yaml)")
+    defrag_p.add_argument(
+        "--candidates", default="", help="comma-separated node names to evaluate (default: all)"
+    )
+    defrag_p.add_argument("-o", "--output-file", default="", help="redirect the report to a file")
+
     server_p = sub.add_parser("server", help="start the simon REST server")
     server_p.add_argument("--kubeconfig", default="", help="kubeconfig of the real cluster")
     server_p.add_argument("--master", default="", help="apiserver address override")
@@ -92,6 +102,44 @@ def main(argv: Optional[List[str]] = None) -> int:
             return Applier(opts).run()
         except (OSError, ValueError) as e:
             print(f"simon apply: {e}", file=sys.stderr)
+            return 1
+    if args.command == "defrag":
+        from ..planner.apply import Applier, Options
+
+        try:
+            applier = Applier(Options(simon_config=args.simon_config))
+            cluster = applier.load_cluster()
+            apps = applier.load_apps()
+            from ..planner.defrag import plan_drains
+
+            candidates = [c.strip() for c in args.candidates.split(",") if c.strip()] or None
+            result = plan_drains(cluster, apps, candidates=candidates)
+            out = open(args.output_file, "w") if args.output_file else sys.stdout
+            try:
+                print("Drain Plan", file=out)
+                rows = [["Node", "Drainable", "Unscheduled", "Freed CPU", "Freed Memory"]]
+                from ..models.quantity import format_milli, format_quantity
+
+                for p in result.plans:
+                    rows.append(
+                        [
+                            p.node,
+                            "√" if p.feasible else "",
+                            str(p.unscheduled),
+                            format_milli(int(p.freed_cpu_milli)),
+                            format_quantity(p.freed_memory),
+                        ]
+                    )
+                widths = [max(len(r[c]) for r in rows) for c in range(len(rows[0]))]
+                for r in rows:
+                    print(" | ".join(v.ljust(w) for v, w in zip(r, widths)).rstrip(), file=out)
+                print(f"\n{len(result.drainable())}/{len(result.plans)} node(s) drainable", file=out)
+            finally:
+                if args.output_file:
+                    out.close()
+            return 0
+        except (OSError, ValueError) as e:
+            print(f"simon defrag: {e}", file=sys.stderr)
             return 1
     if args.command == "server":
         from ..server.rest import serve

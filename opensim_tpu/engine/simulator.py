@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import copy
 import json
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -21,6 +22,7 @@ import numpy as np
 from ..encoding.state import ClusterEncoder, ClusterMeta
 from ..models import expand
 from ..models.objects import (
+    ANNO_GPU_ASSUME_TIME,
     ANNO_GPU_INDEX,
     ANNO_NODE_GPU_SHARE,
     ANNO_NODE_LOCAL_STORAGE,
@@ -349,6 +351,9 @@ def simulate(
                 for d, cnt in enumerate(gpu_take[i]):
                     ids.extend([str(d)] * int(round(float(cnt))))
                 pod.metadata.annotations[ANNO_GPU_INDEX] = "-".join(ids)
+                # assume-time annotation (gpushare utils/pod.go:125): bind
+                # timestamp in nanoseconds
+                pod.metadata.annotations[ANNO_GPU_ASSUME_TIME] = str(time.time_ns())
             pod_lists[c].append(pod)
         else:
             unscheduled.append(

@@ -94,3 +94,29 @@ def test_gen_doc(tmp_path):
     text = (out_dir / "simon.md").read_text()
     for cmd in ("apply", "server", "version", "gen-doc"):
         assert f"simon {cmd}" in text
+
+
+def test_defrag_cli(tmp_path):
+    import yaml as _yaml
+
+    from opensim_tpu.cli.main import main
+
+    cluster_dir = tmp_path / "cluster"
+    app_dir = tmp_path / "app"
+    cluster_dir.mkdir()
+    app_dir.mkdir()
+    for i in range(3):
+        (cluster_dir / f"n{i}.yaml").write_text(_yaml.safe_dump(fx.make_fake_node(f"n{i}", "8", "16Gi").raw))
+    (app_dir / "d.yaml").write_text(_yaml.safe_dump(fx.make_fake_deployment("d", 3, "1", "1Gi").raw))
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        f"apiVersion: simon/v1alpha1\nkind: Config\nmetadata: {{name: t}}\n"
+        f"spec:\n  cluster: {{customConfig: {cluster_dir}}}\n  appList:\n    - name: a\n      path: {app_dir}\n"
+    )
+    out = tmp_path / "out.txt"
+    assert main(["defrag", "-f", str(cfg), "-o", str(out)]) == 0
+    text = out.read_text()
+    assert "Drain Plan" in text and "3/3 node(s) drainable" in text
+    # candidates filter
+    assert main(["defrag", "-f", str(cfg), "--candidates", "n0, n1", "-o", str(out)]) == 0
+    assert "2/2 node(s) drainable" in out.read_text()

@@ -113,13 +113,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             from ..planner.defrag import plan_drains
 
             candidates = [c.strip() for c in args.candidates.split(",") if c.strip()] or None
+            if candidates:
+                known = {n.metadata.name for n in cluster.nodes}
+                unknown = [c for c in candidates if c not in known]
+                if unknown:
+                    print(f"simon defrag: unknown node(s): {', '.join(unknown)}", file=sys.stderr)
+                    return 1
             result = plan_drains(cluster, apps, candidates=candidates)
             out = open(args.output_file, "w") if args.output_file else sys.stdout
             try:
                 print("Drain Plan", file=out)
-                rows = [["Node", "Drainable", "Unscheduled", "Freed CPU", "Freed Memory"]]
                 from ..models.quantity import format_milli, format_quantity
+                from ..planner.report import _table
 
+                rows = [["Node", "Drainable", "Unscheduled", "Freed CPU", "Freed Memory"]]
                 for p in result.plans:
                     rows.append(
                         [
@@ -130,9 +137,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                             format_quantity(p.freed_memory),
                         ]
                     )
-                widths = [max(len(r[c]) for r in rows) for c in range(len(rows[0]))]
-                for r in rows:
-                    print(" | ".join(v.ljust(w) for v, w in zip(r, widths)).rstrip(), file=out)
+                _table(rows, out)
                 print(f"\n{len(result.drainable())}/{len(result.plans)} node(s) drainable", file=out)
             finally:
                 if args.output_file:

@@ -92,7 +92,7 @@ def test_gen_doc(tmp_path):
     out_dir = tmp_path / "docs"
     assert gen_doc(build_parser(), str(out_dir)) == 0
     text = (out_dir / "simon.md").read_text()
-    for cmd in ("apply", "server", "version", "gen-doc"):
+    for cmd in ("apply", "defrag", "server", "version", "gen-doc"):
         assert f"simon {cmd}" in text
 
 
@@ -120,3 +120,5 @@ def test_defrag_cli(tmp_path):
     # candidates filter
     assert main(["defrag", "-f", str(cfg), "--candidates", "n0, n1", "-o", str(out)]) == 0
     assert "2/2 node(s) drainable" in out.read_text()
+    # unknown candidate -> explicit error, nonzero exit
+    assert main(["defrag", "-f", str(cfg), "--candidates", "n99"]) == 1

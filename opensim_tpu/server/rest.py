@@ -28,6 +28,50 @@ _deploy_lock = threading.Lock()
 _scale_lock = threading.Lock()
 
 
+class _Metrics:
+    """Process-local counters exposed at /metrics in Prometheus text format
+    (the reference's vendored scheduler metrics exist but are never exposed;
+    SURVEY.md §5 — this closes that gap)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.requests = {"deploy-apps": 0, "scale-apps": 0}
+        self.simulations = 0
+        self.pods_scheduled = 0
+        self.pods_unscheduled = 0
+        self.simulate_seconds_total = 0.0
+
+    def record(self, endpoint: str, result: SimulateResult, seconds: float) -> None:
+        with self.lock:
+            self.requests[endpoint] = self.requests.get(endpoint, 0) + 1
+            self.simulations += 1
+            self.pods_scheduled += sum(len(ns.pods) for ns in result.node_status)
+            self.pods_unscheduled += len(result.unscheduled_pods)
+            self.simulate_seconds_total += seconds
+
+    def render(self) -> str:
+        with self.lock:
+            lines = [
+                "# TYPE simon_requests_total counter",
+                *(
+                    f'simon_requests_total{{endpoint="{ep}"}} {n}'
+                    for ep, n in sorted(self.requests.items())
+                ),
+                "# TYPE simon_simulations_total counter",
+                f"simon_simulations_total {self.simulations}",
+                "# TYPE simon_pods_scheduled_total counter",
+                f"simon_pods_scheduled_total {self.pods_scheduled}",
+                "# TYPE simon_pods_unscheduled_total counter",
+                f"simon_pods_unscheduled_total {self.pods_unscheduled}",
+                "# TYPE simon_simulate_seconds_total counter",
+                f"simon_simulate_seconds_total {self.simulate_seconds_total:.6f}",
+            ]
+        return "\n".join(lines) + "\n"
+
+
+METRICS = _Metrics()
+
+
 def _decode_app(payload: dict) -> ResourceTypes:
     rt = ResourceTypes()
     kind_map = {
@@ -101,10 +145,14 @@ class SimonServer:
         if not _deploy_lock.acquire(blocking=False):
             return 503, {"error": "the server is busy now, please try again later"}
         try:
+            import time
+
             cluster = self.current_cluster()
             cluster = _with_new_nodes(cluster, _decode_new_nodes(payload))
             app = _decode_app(payload)
+            t0 = time.monotonic()
             result = simulate(cluster, [AppResource("deploy", app)])
+            METRICS.record("deploy-apps", result, time.monotonic() - t0)
             return 200, _response(result)
         except Exception as e:  # surface as 500 like gin's error handler
             return 500, {"error": str(e)}
@@ -129,7 +177,11 @@ class SimonServer:
                 for p in cluster.pods
                 if not _owned_by(p, scaled)
             ]
+            import time
+
+            t0 = time.monotonic()
             result = simulate(cluster, [AppResource("scale", app)])
+            METRICS.record("scale-apps", result, time.monotonic() - t0)
             return 200, _response(result)
         except Exception as e:
             return 500, {"error": str(e)}
@@ -175,6 +227,13 @@ def make_handler(server: SimonServer):
         def do_GET(self):
             if self.path == "/healthz":
                 self._send(200, {"status": "ok"})
+            elif self.path == "/metrics":
+                data = METRICS.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
             elif self.path.startswith("/debug/profiler"):
                 # pprof analogue (the reference registers pprof on gin,
                 # server.go:152): start the JAX profiler server and report

@@ -122,3 +122,54 @@ def test_defrag_cli(tmp_path):
     assert "2/2 node(s) drainable" in out.read_text()
     # unknown candidate -> explicit error, nonzero exit
     assert main(["defrag", "-f", str(cfg), "--candidates", "n99"]) == 1
+
+
+def test_metrics_endpoint():
+    from http.server import ThreadingHTTPServer
+
+    from opensim_tpu.server.rest import SimonServer, make_handler
+
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("m1", "8", "16Gi"))
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(SimonServer(base_cluster=cluster)))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        body = json.dumps({"deployments": [fx.make_fake_deployment("m", 2, "100m", "128Mi").raw]}).encode()
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/api/deploy-apps", data=body, method="POST")
+        urllib.request.urlopen(req).read()
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as r:
+            text = r.read().decode()
+        assert 'simon_requests_total{endpoint="deploy-apps"}' in text
+        assert "simon_pods_scheduled_total" in text
+        assert "simon_simulate_seconds_total" in text
+    finally:
+        httpd.shutdown()
+
+
+def test_interactive_apply_scripted(tmp_path, monkeypatch):
+    """The reference's interactive loop, driven with scripted answers."""
+    import yaml as _yaml
+
+    from opensim_tpu.planner.apply import Applier, Options
+
+    cluster_dir = tmp_path / "cluster"
+    app_dir = tmp_path / "app"
+    nn_dir = tmp_path / "newnode"
+    for d in (cluster_dir, app_dir, nn_dir):
+        d.mkdir()
+    (cluster_dir / "n.yaml").write_text(_yaml.safe_dump(fx.make_fake_node("n1", "2", "4Gi").raw))
+    (app_dir / "d.yaml").write_text(_yaml.safe_dump(fx.make_fake_deployment("d", 4, "1", "1Gi").raw))
+    (nn_dir / "n.yaml").write_text(_yaml.safe_dump(fx.make_fake_node("tmpl", "8", "16Gi").raw))
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        f"apiVersion: simon/v1alpha1\nkind: Config\nmetadata: {{name: t}}\n"
+        f"spec:\n  cluster: {{customConfig: {cluster_dir}}}\n"
+        f"  appList:\n    - name: a\n      path: {app_dir}\n  newNode: {nn_dir}\n"
+    )
+    answers = iter(["show", "add 1", "-"])
+    monkeypatch.setattr("builtins.input", lambda *a: next(answers))
+    out = tmp_path / "out.txt"
+    rc = Applier(Options(simon_config=str(cfg), interactive=True, output_file=str(out))).run()
+    assert rc == 0
+    assert "Simulation success!" in out.read_text()

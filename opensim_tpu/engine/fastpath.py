@@ -119,7 +119,17 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
     # tunnel RPC each, so use the retained numpy EncodedCluster and fetch the
     # static tables with one batched device_get
     ec = prep.ec_np if prep.ec_np is not None else jax.device_get(prep.ec)
-    stat = jax.device_get(_precompute_jit(prep.ec))
+    # static tables computed with ALL nodes valid: validity is applied as a
+    # runtime row inside the kernel so scenario sweeps can mask nodes without
+    # re-marshalling (static filters are per-node, so this is equivalent)
+    import jax.numpy as jnp
+
+    ec_all_valid = prep.ec._replace(node_valid=jnp.ones_like(prep.ec.node_valid))
+    stat = jax.device_get(_precompute_jit(ec_all_valid))
+    # static_fail diagnostics must count over the REAL valid set (the
+    # all-valid tables would count padding nodes); one extra cached
+    # precompute fetches just that small array
+    static_fail_real = np.asarray(jax.device_get(_precompute_jit(prep.ec).static_fail))
     N = int(ec.node_valid.shape[0])
     U = int(ec.req.shape[0])
     A = int(ec.matches_sel.shape[1])
@@ -306,7 +316,7 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
         na_raw=np.asarray(stat.na_raw).astype(np.float32),
         tt_raw=np.asarray(stat.tt_raw).astype(np.float32),
     )
-    meta = {"static_fail": np.asarray(stat.static_fail)}
+    meta = {"static_fail": static_fail_real}
     # device-resident copies so repeated runs (capacity loops, sweeps) skip
     # the host→device transfer of ~25 arrays
     fi = FastInputs(*[jax.numpy.asarray(a) for a in fi])
@@ -315,6 +325,87 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
     except AttributeError:
         pass
     return fi, meta
+
+
+class _SweepContext:
+    """Host-side tables hoisted out of the per-scenario loop."""
+
+    def __init__(self, prep) -> None:
+        ec = prep.ec_np if prep.ec_np is not None else jax.device_get(prep.ec)
+        self.node_domain = np.asarray(ec.node_domain)
+        self.trash = np.asarray(ec.domain_topo).shape[0] - 1
+        self.spr_topo = np.asarray(ec.spr_topo)
+
+    def spread_weights(self, node_valid: np.ndarray) -> np.ndarray:
+        """[U, Cs] log(size+2) table for a scenario's valid-node subset
+        (domain counts are valid-set dependent)."""
+        Tk = self.node_domain.shape[1]
+        sizes = np.zeros((Tk,), np.float64)
+        for tk in range(Tk):
+            doms = self.node_domain[node_valid, tk]
+            sizes[tk] = len(np.unique(doms[doms != self.trash]))
+        weights = np.log(sizes + 2.0).astype(np.float32)
+        return np.where(
+            self.spr_topo >= 0, weights[np.maximum(self.spr_topo, 0)], 0.0
+        ).astype(np.float32)
+
+
+def sweep(prep, node_valid_masks, pod_valid_masks, forced_masks, interpret: Optional[bool] = None):
+    """Scenario sweep on the megakernel: one dispatch per scenario, queued
+    asynchronously on the device. Returns (unscheduled [S], used [S, N, R],
+    chosen [S, P], vg_used [S]) matching parallel.scenarios.SweepResult."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    fi, meta = build_inputs(prep)
+    S = node_valid_masks.shape[0]
+    P = pod_valid_masks.shape[1]
+    pad = (-P) % CHUNK
+    tmpl = np.asarray(prep.tmpl_ids)
+    if pad:
+        tmpl = np.concatenate([tmpl, np.zeros(pad, tmpl.dtype)])
+    has_interpod = bool(prep.features.interpod or prep.features.prefg)
+    has_gpu = bool(prep.features.gpu)
+    has_local = bool(prep.features.local)
+    ctx = _SweepContext(prep)
+    vg0 = np.asarray(fi.vg0_VN)
+
+    pending = []
+    for s in range(S):
+        nv = np.asarray(node_valid_masks[s], dtype=bool)
+        pv = np.asarray(pod_valid_masks[s], dtype=bool)
+        fm = np.asarray(forced_masks[s], dtype=bool)
+        if pad:
+            pv = np.concatenate([pv, np.zeros(pad, bool)])
+            fm = np.concatenate([fm, np.zeros(pad, bool)])
+        fi_s = fi._replace(
+            node_valid=nv.astype(np.float32)[None, :],
+            spr_weight=ctx.spread_weights(nv),
+        )
+        pending.append(
+            run_fast_scan(
+                fi_s, tmpl, pv, fm,
+                has_interpod=has_interpod, has_gpu=has_gpu, has_local=has_local,
+                has_ports=bool(prep.features.ports),
+                has_na=bool(prep.features.pref_node_affinity),
+                has_tt=bool(prep.features.prefer_taints),
+                interpret=interpret,
+            )
+        )
+
+    unscheduled = np.zeros((S,), np.int32)
+    used = []
+    chosen_all = []
+    vg_used = np.zeros((S,), np.float32)
+    for s, (chosen, used_T, _gt, _gf, vg_T, _dev) in enumerate(pending):
+        c = np.asarray(chosen)[:P]
+        chosen_all.append(c)
+        pv = np.asarray(pod_valid_masks[s], dtype=bool)
+        unscheduled[s] = int(((c < 0) & pv).sum())
+        used.append(np.asarray(used_T).T)
+        # per the XLA sweep, VG usage counts only scenario-valid nodes
+        nv = np.asarray(node_valid_masks[s], dtype=bool)
+        vg_used[s] = float(((vg0 - np.asarray(vg_T)) * nv[None, :]).sum())
+    return unscheduled, np.stack(used), np.stack(chosen_all), vg_used
 
 
 def schedule(prep, tmpl_ids, pod_valid, forced, interpret: Optional[bool] = None):

@@ -8,12 +8,15 @@ symmetric), selectHost, and the bind state update — into ONE Pallas program
 whose cluster state lives in VMEM for the whole scan: a bind costs
 VMEM-bandwidth, not kernel launches.
 
-Scope: everything except GPU-share devices, open-local storage, host ports
-and preferred-node-affinity/PreferNoSchedule scoring, with at most two
-topology keys (hostname + one zone-like key). `engine/fastpath.py` gates
-applicability and guarantees identical placements to the XLA scan (tests
-assert equality). The kernel is generated per `has_interpod` so workloads
-without inter-pod terms pay nothing for them.
+Scope: every scheduler feature — resource fit, topology spread, inter-pod
+affinity, GPU-share devices, open-local storage, host ports, preferred node
+affinity and PreferNoSchedule scoring — bounded by table-size caps and at
+most two topology keys (hostname + one zone-like key); `engine/fastpath.py`
+gates applicability and guarantees identical placements to the XLA scan
+(tests + randomized differential fuzzing assert equality). The kernel is
+generated per feature-flag combination so absent features cost nothing, and
+node validity is a runtime row so scenario sweeps re-dispatch with nothing
+but a new mask and spread-weight table.
 
 Layouts (N = padded node axis, lanes; rows padded to sublane multiples):
   alloc_T     [R, N]    f32  allocatable per resource row
@@ -198,7 +201,7 @@ def _make_kernel(
 
         def body(i, _):
             u = tmpl_ref[i]
-            static_row = static_ref[pl.ds(u, 1), :]  # [1, N] (valid folded in)
+            static_row = static_ref[pl.ds(u, 1), :]  # [1, N] (validity applied separately)
             for d in range(n_gpu):  # SMEM outputs have no default value
                 gpu_take_ref[i, d] = jnp.float32(0.0)
 
@@ -208,7 +211,9 @@ def _make_kernel(
                 req_r = req_ref[u, r]
                 over = (used_ref[pl.ds(r, 1), :] + req_r > alloc_ref[pl.ds(r, 1), :]).astype(jnp.float32)
                 fit = fit * jnp.where(req_r > 0, 1.0 - over, 1.0)
-            feasible = static_row * fit
+            # node validity is a runtime row (NOT folded into static_pass) so
+            # scenario sweeps can vary it without re-marshalling the tables
+            feasible = static_row * fit * valid_row
 
             if has_ports:
                 # NodePorts: any requested port already used on the node

@@ -57,6 +57,43 @@ def _sweep_impl(ec, st0, tmpl_ids, node_valid_masks, pod_valid_masks, forced_mas
     )(node_valid_masks, pod_valid_masks, forced_masks)
 
 
+def sweep_auto(
+    prep,
+    node_valid_masks: np.ndarray,
+    pod_valid_masks: np.ndarray,
+    forced_masks: Optional[np.ndarray] = None,
+    config=None,
+) -> SweepResult:
+    """Route a scenario sweep: on a single device, dispatch the Pallas
+    megakernel once per scenario (queued asynchronously — each scan runs at
+    the kernel's step rate); on a multi-device mesh, shard the vmapped XLA
+    scan across devices instead."""
+    S = node_valid_masks.shape[0]
+    if forced_masks is None:
+        forced_masks = np.broadcast_to(prep.forced, (S, len(prep.forced)))
+    from ..engine import fastpath
+
+    if len(jax.devices()) == 1 and config is None and fastpath.applicable(prep):
+        unscheduled, used, chosen, vg_used = fastpath.sweep(
+            prep, node_valid_masks, pod_valid_masks, forced_masks
+        )
+        return SweepResult(
+            unscheduled=unscheduled, used=used, chosen=chosen, vg_used=vg_used
+        )
+    return sweep(
+        prep.ec,
+        prep.st0,
+        prep.tmpl_ids,
+        prep.forced,
+        node_valid_masks,
+        pod_valid_masks,
+        mesh=default_mesh(),
+        features=prep.features,
+        forced_masks=np.asarray(forced_masks),
+        config=config,
+    )
+
+
 def sweep(
     ec: EncodedCluster,
     st0: ScanState,

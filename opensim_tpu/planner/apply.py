@@ -241,16 +241,8 @@ class Applier:
             if target >= n_real:  # DaemonSet pod pinned to a candidate node
                 pod_valid[:, p] = node_valid[:, target]
 
-        res = scenarios.sweep(
-            prep.ec,
-            prep.st0,
-            prep.tmpl_ids,
-            prep.forced,
-            node_valid,
-            pod_valid,
-            mesh=scenarios.default_mesh(),
-            features=prep.features,
-            config=self.sched_config,
+        res = scenarios.sweep_auto(
+            prep, node_valid, pod_valid, config=self.sched_config
         )
         unscheduled = np.asarray(res.unscheduled)
         used = np.asarray(res.used)  # [S, N, R]

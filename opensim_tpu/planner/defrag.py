@@ -79,17 +79,7 @@ def plan_drains(
             elif prep.forced[p] and pod.spec.node_name == names[d]:
                 forced[s, p] = False  # reschedule the drained node's pods
 
-    res = scenarios.sweep(
-        prep.ec,
-        prep.st0,
-        prep.tmpl_ids,
-        prep.forced,
-        node_valid,
-        pod_valid,
-        mesh=scenarios.default_mesh(),
-        features=prep.features,
-        forced_masks=forced,
-    )
+    res = scenarios.sweep_auto(prep, node_valid, pod_valid, forced_masks=forced)
     unscheduled = np.asarray(res.unscheduled)
 
     plans = []

@@ -98,10 +98,9 @@ def random_app(rng: random.Random, n_workloads: int) -> ResourceTypes:
             *opts,
         )
         if rng.random() < 0.2:
-            for anno_target in (deploy.template_metadata.annotations,):
-                anno_target.update(
-                    {"alibabacloud.com/gpu-mem": "2Gi", "alibabacloud.com/gpu-count": "1"}
-                )
+            deploy.template_metadata.annotations.update(
+                {"alibabacloud.com/gpu-mem": "2Gi", "alibabacloud.com/gpu-count": "1"}
+            )
             deploy.template_raw.setdefault("metadata", {}).setdefault("annotations", {}).update(
                 {"alibabacloud.com/gpu-mem": "2Gi", "alibabacloud.com/gpu-count": "1"}
             )

@@ -61,3 +61,34 @@ def test_defrag_reschedules_prebound_pods():
     result = plan_drains(cluster, apps)
     by_node = {p.node: p for p in result.plans}
     assert by_node["n0"].feasible  # pod fits elsewhere
+
+
+def test_fastpath_sweep_matches_xla_sweep(monkeypatch):
+    """The megakernel-backed sweep must agree with the vmapped XLA sweep on
+    unscheduled counts, placements, and final usage."""
+    monkeypatch.setenv("OPENSIM_FASTPATH", "interpret")
+    from opensim_tpu.engine import fastpath
+
+    cluster, apps = _setup(n_nodes=6, replicas=16)
+    prep = prepare(cluster, apps, node_pad=128)
+    assert fastpath.applicable(prep)
+    N = prep.ec.node_valid.shape[0]
+    P = len(prep.ordered)
+    S = 6
+    node_valid = np.zeros((S, N), dtype=bool)
+    for s in range(S):
+        node_valid[s, : s + 1] = True
+    pod_valid = np.ones((S, P), dtype=bool)
+    forced = np.broadcast_to(prep.forced, (S, P)).copy()
+
+    want = scenarios.sweep(
+        prep.ec, prep.st0, prep.tmpl_ids, prep.forced, node_valid, pod_valid,
+        features=prep.features,
+    )
+    got_unsched, got_used, got_chosen, got_vg = fastpath.sweep(
+        prep, node_valid, pod_valid, forced, interpret=True
+    )
+    np.testing.assert_array_equal(got_unsched, np.asarray(want.unscheduled))
+    np.testing.assert_array_equal(got_chosen, np.asarray(want.chosen)[:, :P])
+    np.testing.assert_allclose(got_used, np.asarray(want.used), rtol=1e-5)
+    np.testing.assert_allclose(got_vg, np.asarray(want.vg_used), rtol=1e-5)

@@ -226,6 +226,7 @@ def prepare(
     apps: List[AppResource],
     use_greed: bool = False,
     node_pad: int = 128,
+    patch_pods_fn=None,
 ) -> Optional[Prepared]:
     """Expand cluster + app workloads into an ordered pod stream and encode
     everything into device tensors. Returns None when there are no pods."""
@@ -247,6 +248,8 @@ def prepare(
         app_pods = queues.toleration_sort(queues.affinity_sort(app_pods))
         if use_greed:
             app_pods = queues.greed_sort(cluster.nodes, app_pods)
+        if patch_pods_fn is not None:
+            patch_pods_fn(app.name, app_pods)
         for p in app_pods:
             ordered.append(p)
             forced.append(bool(p.spec.node_name))
@@ -281,13 +284,19 @@ def simulate(
     use_greed: bool = False,
     node_pad: int = 128,
     sched_config=None,
+    patch_pods_fn=None,
 ) -> SimulateResult:
     """One full simulation: cluster pods then apps in order. `sched_config`
-    is an optional SchedulerConfig (the --default-scheduler-config merge)."""
+    is an optional SchedulerConfig (the --default-scheduler-config merge);
+    `patch_pods_fn(app_name, pods)` mirrors WithPatchPodsFuncMap
+    (pkg/simulator/simulator.go:243-249, :471-500) — a caller hook that may
+    mutate each app's expanded pods before they are scheduled."""
     from ..utils.trace import Trace
 
     with Trace("Simulate", threshold_s=1.0) as tr:
-        prep = prepare(cluster, apps, use_greed=use_greed, node_pad=node_pad)
+        prep = prepare(
+            cluster, apps, use_greed=use_greed, node_pad=node_pad, patch_pods_fn=patch_pods_fn
+        )
         tr.step("expand and encode")
         if prep is None:
             return SimulateResult(

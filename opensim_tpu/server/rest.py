@@ -99,11 +99,16 @@ def _decode_app(payload: dict) -> ResourceTypes:
 
 
 def _decode_new_nodes(payload: dict) -> List[Node]:
+    """Requested nodes become fake nodes exactly like the apply path
+    (server.go:187-194 → NewFakeNode): fresh simon-<rand> name, hostname
+    label rewritten, simon/new-node marker."""
+    from ..models.expand import new_fake_nodes
+
     nodes = []
     for obj in payload.get("newnodes") or payload.get("NewNodes") or []:
         obj = dict(obj)
         obj.setdefault("kind", "Node")
-        nodes.append(Node.from_dict(obj))
+        nodes.extend(new_fake_nodes(Node.from_dict(obj), 1))
     return nodes
 
 

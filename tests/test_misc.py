@@ -173,3 +173,47 @@ def test_interactive_apply_scripted(tmp_path, monkeypatch):
     rc = Applier(Options(simon_config=str(cfg), interactive=True, output_file=str(out))).run()
     assert rc == 0
     assert "Simulation success!" in out.read_text()
+
+
+def test_patch_pods_fn_hook():
+    """WithPatchPodsFuncMap parity: the hook can mutate app pods before
+    scheduling (simulator.go:243-249)."""
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n1", "8", "16Gi"))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("p", "100m", "128Mi"))
+    seen = []
+
+    def patch(app_name, pods):
+        seen.append(app_name)
+        for p in pods:
+            p.metadata.annotations["patched"] = "yes"
+
+    res = simulate(cluster, [AppResource("a", app)], patch_pods_fn=patch)
+    assert seen == ["a"]
+    assert all(p.metadata.annotations.get("patched") == "yes" for ns in res.node_status for p in ns.pods)
+
+
+def test_server_newnodes_become_fake_nodes():
+    from http.server import ThreadingHTTPServer
+
+    from opensim_tpu.server.rest import SimonServer, make_handler
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(SimonServer(base_cluster=ResourceTypes())))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        body = json.dumps(
+            {
+                "newnodes": [fx.make_fake_node("template", "8", "16Gi").raw],
+                "deployments": [fx.make_fake_deployment("w", 2, "100m", "128Mi").raw],
+            }
+        ).encode()
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/api/deploy-apps", data=body, method="POST")
+        with urllib.request.urlopen(req) as r:
+            resp = json.load(r)
+        assert resp["unscheduledPods"] == []
+        # the requested node was renamed to a fake simon-<rand> node
+        assert resp["nodeStatus"][0]["node"].startswith("simon-")
+    finally:
+        httpd.shutdown()

@@ -27,16 +27,16 @@ from ..ops import kernels
 
 class ScheduleOutput(NamedTuple):
     chosen: jnp.ndarray  # [P] i32 node index, -1 unscheduled
-    fail_counts: jnp.ndarray  # [P, 6] i32 — dynamic filters (ports..local)
+    fail_counts: jnp.ndarray  # [P, NUM_FILTERS-4] i32 — dynamic filters (ports..extra)
     insufficient: jnp.ndarray  # [P, R] i32 nodes short per resource
     gpu_take: jnp.ndarray  # [P, Gd] f32 GPU slots packed per device
     static_fail: jnp.ndarray  # [U, 4] i32 — static filters (pin/unsched/taint/affinity)
     final_state: ScanState
 
 
-def _step(ec: EncodedCluster, stat, feat, cfg, st: ScanState, x):
+def _step(ec: EncodedCluster, stat, feat, cfg, extra, st: ScanState, x):
     u, pod_valid, forced = x
-    res = kernels.pod_step(ec, stat, st, u, feat, cfg)
+    res = kernels.pod_step(ec, stat, st, u, feat, cfg, extra)
     # Pre-bound pods (spec.nodeName set) bypass the scheduler in the
     # reference (simulator.go:329-331 only waits for unbound pods): they
     # always land on their node and still consume its resources.
@@ -49,7 +49,7 @@ def _step(ec: EncodedCluster, stat, feat, cfg, st: ScanState, x):
     return st_next, (chosen, res.fail_counts, res.insufficient, gpu_take)
 
 
-@functools.partial(jax.jit, static_argnames=("features", "config", "unroll"))
+@functools.partial(jax.jit, static_argnames=("features", "config", "extra_plugins", "unroll"))
 def schedule_pods(
     ec: EncodedCluster,
     st0: ScanState,
@@ -58,6 +58,7 @@ def schedule_pods(
     forced,
     features: kernels.Features = kernels.ALL_FEATURES,
     config=None,
+    extra_plugins: tuple = (),
     unroll: int = 1,
 ):
     """Run the bind scan. tmpl_ids [P] i32, pod_valid/forced [P] bool.
@@ -69,7 +70,7 @@ def schedule_pods(
 
     config = config or DEFAULT_CONFIG
     stat = kernels.precompute_static(ec, config)
-    step = functools.partial(_step, ec, stat, features, config)
+    step = functools.partial(_step, ec, stat, features, config, extra_plugins)
     final_state, (chosen, fail_counts, insufficient, gpu_take) = jax.lax.scan(
         step, st0, (tmpl_ids, pod_valid, forced), unroll=unroll
     )

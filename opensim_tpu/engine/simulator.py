@@ -76,6 +76,18 @@ class SimulateResult:
         return []
 
 
+def _validate_extra_plugins(extra_plugins) -> None:
+    if not isinstance(extra_plugins, tuple):
+        raise ValueError("extra_plugins must be a tuple (jit requires a hashable static argument)")
+    for entry in extra_plugins:
+        if not isinstance(entry, tuple) or not entry or entry[0] not in ("filter", "score"):
+            raise ValueError(f'extra plugin entries are ("filter", fn) or ("score", fn, weight); got {entry!r}')
+        if entry[0] == "filter" and len(entry) != 2:
+            raise ValueError(f'filter plugin entries are ("filter", fn); got {entry!r}')
+        if entry[0] == "score" and len(entry) != 3:
+            raise ValueError(f'score plugin entries are ("score", fn, weight); got {entry!r}')
+
+
 def _fast_output(
     chosen: np.ndarray,
     used_final: np.ndarray,
@@ -285,14 +297,18 @@ def simulate(
     node_pad: int = 128,
     sched_config=None,
     patch_pods_fn=None,
+    extra_plugins: tuple = (),
 ) -> SimulateResult:
     """One full simulation: cluster pods then apps in order. `sched_config`
     is an optional SchedulerConfig (the --default-scheduler-config merge);
     `patch_pods_fn(app_name, pods)` mirrors WithPatchPodsFuncMap
     (pkg/simulator/simulator.go:243-249, :471-500) — a caller hook that may
-    mutate each app's expanded pods before they are scheduled."""
+    mutate each app's expanded pods before they are scheduled.
+    `extra_plugins` is the WithExtraRegistry equivalent: out-of-tree
+    jittable filter/score plugins (see kernels.pod_step)."""
     from ..utils.trace import Trace
 
+    _validate_extra_plugins(extra_plugins)
     with Trace("Simulate", threshold_s=1.0) as tr:
         prep = prepare(
             cluster, apps, use_greed=use_greed, node_pad=node_pad, patch_pods_fn=patch_pods_fn
@@ -307,7 +323,7 @@ def simulate(
 
         pod_valid = np.ones((len(ordered),), dtype=bool)
         out = None
-        if sched_config is None:
+        if sched_config is None and not extra_plugins:
             from . import fastpath
 
             if fastpath.applicable(prep):
@@ -322,7 +338,8 @@ def simulate(
         if out is None:
             tmpl_p, valid_p, forced_p = pad_pod_stream(tmpl_ids, pod_valid, forced)
             out = schedule_pods(
-                ec, st0, tmpl_p, valid_p, forced_p, features=prep.features, config=sched_config
+                ec, st0, tmpl_p, valid_p, forced_p,
+                features=prep.features, config=sched_config, extra_plugins=extra_plugins,
             )
             jax.block_until_ready(out.chosen)  # dispatch is async; trace real device time
         tr.step(f"schedule {len(ordered)} pods")

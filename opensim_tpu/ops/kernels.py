@@ -46,7 +46,8 @@ F_SPREAD = 6
 F_INTERPOD = 7
 F_GPU = 8
 F_LOCAL = 9
-NUM_FILTERS = 10
+F_EXTRA = 10  # out-of-tree plugins registered via extra_plugins
+NUM_FILTERS = 11
 
 FILTER_REASONS = [
     "node(s) didn't match the requested hostname",
@@ -59,6 +60,7 @@ FILTER_REASONS = [
     "node(s) didn't satisfy inter-pod affinity rules",
     "Insufficient GPU memory in 1 GPU device",
     "node(s) didn't have enough local storage",
+    "node(s) were rejected by an out-of-tree plugin",
 ]
 
 
@@ -583,13 +585,20 @@ class StepResult(NamedTuple):
     insufficient: jnp.ndarray  # [R] i32 nodes short of each resource
 
 
-def pod_step(ec, stat: StaticTables, st, u, feat: Features = ALL_FEATURES, cfg=None) -> StepResult:
+def pod_step(
+    ec, stat: StaticTables, st, u, feat: Features = ALL_FEATURES, cfg=None, extra: tuple = ()
+) -> StepResult:
     """One pod through the full pipeline. Mirrors scheduleOne
     (vendor/.../scheduler/scheduler.go:441) minus the bind goroutine.
     The four static filters are a single precomputed-row gather; only
     usage-dependent kernels the workload actually exercises evaluate per
     step (see Features). `cfg` (SchedulerConfig) adjusts plugin weights and
-    disables, mirroring --default-scheduler-config."""
+    disables, mirroring --default-scheduler-config.
+
+    `extra` is the WithExtraRegistry equivalent (simulator.go:190-200,
+    :471-500): out-of-tree plugins as jittable callables. Each entry is
+    ("filter", fn) where fn(ec, st, u) -> bool [N], or ("score", fn, weight)
+    where fn(ec, st, u, feasible) -> f32 [N] (already 0-100 scaled)."""
     from ..engine.schedconfig import DEFAULT_CONFIG
 
     cfg = cfg or DEFAULT_CONFIG
@@ -611,6 +620,11 @@ def pod_step(ec, stat: StaticTables, st, u, feat: Features = ALL_FEATURES, cfg=N
     masks.append(interpod_filter(ec, st, u) if feat.interpod and cfg.f_interpod else true_mask)
     masks.append(gpu_filter(ec, st, u) if feat.gpu and cfg.f_gpu else true_mask)
     masks.append(local_filter(ec, st, u) if feat.local and cfg.f_local else true_mask)
+    extra_filter = true_mask
+    for entry in extra:
+        if entry[0] == "filter":
+            extra_filter = extra_filter & entry[1](ec, st, u)
+    masks.append(extra_filter)  # dedicated F_EXTRA reason slot
 
     passed_list = []
     passed_so_far = static_pass
@@ -673,6 +687,9 @@ def pod_step(ec, stat: StaticTables, st, u, feat: Features = ALL_FEATURES, cfg=N
         )
     if feat.local and cfg.w_local:
         score = score + cfg.w_local * _minmax_normalize(local_score(ec, st, u), feasible)
+    for entry in extra:
+        if entry[0] == "score":
+            score = score + float(entry[2]) * entry[1](ec, st, u, feasible)
     # ImageLocality: 0 (no images in sim); NodePreferAvoidPods: constant
 
     neg = jnp.float32(-1e30)

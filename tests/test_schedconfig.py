@@ -56,3 +56,56 @@ def test_default_config_file_is_identity(tmp_path):
     p = tmp_path / "empty.yaml"
     p.write_text("apiVersion: kubescheduler.config.k8s.io/v1beta1\nkind: KubeSchedulerConfiguration\n")
     assert load_scheduler_config(str(p)) == DEFAULT_CONFIG
+
+
+def test_extra_plugins_registry():
+    """WithExtraRegistry parity: out-of-tree jittable filter and score
+    plugins compose into the pipeline."""
+    import jax.numpy as jnp
+
+    cluster = ResourceTypes()
+    for i in range(3):
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("w", 4, "100m", "128Mi"))
+
+    def ban_node_zero(ec, st, u):
+        return jnp.arange(ec.node_valid.shape[0]) != 0
+
+    def prefer_node_two(ec, st, u, feasible):
+        return jnp.where(jnp.arange(ec.node_valid.shape[0]) == 2, 100.0, 0.0)
+
+    res = simulate(
+        cluster,
+        [AppResource("a", app)],
+        extra_plugins=(("filter", ban_node_zero), ("score", prefer_node_two, 1000.0)),
+    )
+    assert not res.unscheduled_pods
+    placed = {ns.node.metadata.name: len(ns.pods) for ns in res.node_status}
+    assert placed.get("n0", 0) == 0  # custom filter banned it
+    assert placed["n2"] == 4  # heavy custom score wins every bind
+
+
+def test_extra_plugins_validation_and_reason():
+    import pytest as _pytest
+
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n0", "8", "16Gi"))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("p", "100m", "128Mi"))
+
+    with _pytest.raises(ValueError):
+        simulate(cluster, [AppResource("a", app)], extra_plugins=[("filter", lambda *a: None)])
+    with _pytest.raises(ValueError):
+        simulate(cluster, [AppResource("a", app)], extra_plugins=(("prefilter", lambda *a: None),))
+    with _pytest.raises(ValueError):
+        simulate(cluster, [AppResource("a", app)], extra_plugins=(("score", lambda *a: None),))
+
+    import jax.numpy as jnp
+
+    def ban_all(ec, st, u):
+        return jnp.zeros(ec.node_valid.shape[0], bool)
+
+    res = simulate(cluster, [AppResource("a", app)], extra_plugins=(("filter", ban_all),))
+    assert len(res.unscheduled_pods) == 1
+    assert "out-of-tree plugin" in res.unscheduled_pods[0].reason

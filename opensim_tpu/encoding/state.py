@@ -21,6 +21,7 @@ Shape conventions (all static, padded):
 
 from __future__ import annotations
 
+import json
 import math
 from dataclasses import dataclass, field
 from typing import Dict, List, NamedTuple, Optional, Tuple
@@ -94,6 +95,7 @@ class EncodedCluster(NamedTuple):
     gpu_count: np.ndarray  # [U] i32
     node_gpu_mem: np.ndarray  # [N, Gd] f32 per-device total memory
     # open-local extension
+    avoid_score: np.ndarray  # [U, N] f32 NodePreferAvoidPods raw score (0 or 100)
     lvm_req: np.ndarray  # [U] f32 total LVM bytes requested
     dev_req: np.ndarray  # [U, 2] f32 exclusive-device bytes by media (ssd, hdd) — one device each
     dev_req_count: np.ndarray  # [U, 2] i32 number of exclusive devices by media
@@ -480,6 +482,29 @@ class ClusterEncoder:
         if mm.size:
             matches_sel[: mm.shape[0], : mm.shape[1]] = mm
 
+        # ---- NodePreferAvoidPods (node_prefer_avoid_pods.go:47-82): pods
+        # controlled by an RS/RC listed in the node's preferAvoidPods
+        # annotation score 0 there, 100 elsewhere
+        avoid_score = np.full((U, N), 100.0, dtype=np.float32)
+        for i, n in enumerate(self.nodes):
+            anno = n.metadata.annotations.get("scheduler.alpha.kubernetes.io/preferAvoidPods")
+            if not anno:
+                continue
+            try:
+                entries = json.loads(anno).get("preferAvoidPods") or []
+            except (ValueError, AttributeError):
+                continue
+            avoided = {
+                (
+                    str(((e.get("podSignature") or {}).get("podController") or {}).get("kind", "")),
+                    str(((e.get("podSignature") or {}).get("podController") or {}).get("uid", "")),
+                )
+                for e in entries
+            }
+            for u, t in enumerate(templates):
+                if t.controller[0] and tuple(t.controller) in avoided:
+                    avoid_score[u, i] = 0.0
+
         # ---- extensions: encoded by their dedicated modules (task: gpu/local)
         from .extensions import encode_gpu_nodes, encode_local_storage, encode_local_requests
 
@@ -533,6 +558,7 @@ class ClusterEncoder:
             anti_g=anti_g,
             prefg_w=prefg_w,
             pin=pin,
+            avoid_score=avoid_score,
             anti_g_sel=anti_g_sel,
             anti_g_topo=anti_g_topo,
             prefg_sel=prefg_sel,

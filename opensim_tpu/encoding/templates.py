@@ -107,6 +107,9 @@ class SchedTemplate:
     gpu_mem: float = 0.0  # per-GPU memory request (gpu-share extension)
     gpu_count: int = 0
     local_volumes: tuple = ()  # ((kind, size, scName), ...) open-local extension
+    controller: tuple = ("", "")  # (kind, uid) when owned by a ReplicaSet/RC
+    #   (NodePreferAvoidPods matches on controller kind+uid,
+    #    node_prefer_avoid_pods.go:58-80)
 
 
 class TemplateSet:
@@ -212,6 +215,10 @@ class TemplateSet:
         # -- extensions (gpu-share, open-local)
         t.gpu_mem = pod.gpu_mem_request()
         t.gpu_count = pod.gpu_count_request()
+        for ref in pod.metadata.owner_references:
+            if ref.controller and ref.kind in ("ReplicaSet", "ReplicationController"):
+                t.controller = (ref.kind, ref.uid)
+                break
         t.local_volumes = tuple(
             (str(v.get("kind", "")), int(v.get("size", 0)), str(v.get("scName", "")))
             for v in pod.local_volumes()
@@ -248,6 +255,7 @@ class TemplateSet:
                 "pt": [(x.sel_id, x.topo_key, x.weight) for x in t.pref_terms],
                 "gpu": [t.gpu_mem, t.gpu_count],
                 "lv": list(t.local_volumes),
+                "ctl": list(t.controller),
             },
             sort_keys=True,
             default=str,

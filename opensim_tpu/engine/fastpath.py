@@ -44,6 +44,8 @@ def applicable(prep, config=None) -> bool:
         int(ec.node_vg_cap.shape[1]) > 8 or int(ec.node_dev_cap.shape[1]) > 8
     ):
         return False
+    if f.prefer_avoid:
+        return False  # preferAvoidPods annotations are rare; XLA path handles them
     # inter-pod terms are supported with bounded table sizes
     if f.interpod or f.prefg:
         if int(ec.anti_g_sel.shape[0]) > 16 or int(ec.prefg_sel.shape[0]) > 16:

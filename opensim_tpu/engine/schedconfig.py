@@ -23,10 +23,10 @@ SCORE_PLUGINS = {
     "Simon": "simon",
     "Open-Gpu-Share": "gpu_share",
     "Open-Local": "local",
-    # present in the default profile but structurally zero/constant in a
-    # simulation (no images, no preferAvoidPods annotations)
+    "NodePreferAvoidPods": "prefer_avoid",
+    # present in the default profile but structurally zero in a simulation
+    # (nodes carry no images)
     "ImageLocality": None,
-    "NodePreferAvoidPods": None,
 }
 
 FILTER_PLUGINS = {
@@ -54,6 +54,7 @@ class SchedulerConfig(NamedTuple):
     w_taint_toleration: float = 1.0
     w_interpod: float = 1.0
     w_spread: float = 2.0
+    w_prefer_avoid: float = 10000.0
     w_simon: float = 1.0
     w_gpu_share: float = 1.0
     w_local: float = 1.0
@@ -104,6 +105,7 @@ def load_scheduler_config(path: str) -> SchedulerConfig:
         slot = SCORE_PLUGINS.get(str(entry.get("name", "")))
         if slot:
             cfg[f"w_{slot}"] = float(entry.get("weight", 1) or 1)
+
 
     filt = plugins.get("filter") or {}
     for entry in filt.get("disabled") or []:

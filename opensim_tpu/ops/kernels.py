@@ -541,13 +541,14 @@ class Features(NamedTuple):
     spread_soft: bool
     pref_node_affinity: bool
     prefer_taints: bool
+    prefer_avoid: bool
 
     @property
     def sel_counts(self) -> bool:
         return self.interpod or self.spread_hard or self.spread_soft
 
 
-ALL_FEATURES = Features(*([True] * 9))
+ALL_FEATURES = Features(*([True] * 10))
 
 
 def features_of(ec_np) -> Features:
@@ -574,6 +575,7 @@ def features_of(ec_np) -> Features:
         prefer_taints=bool(
             (np.asarray(ec_np.taint_effect) == V.EFFECT_PREFER_NO_SCHEDULE).any()
         ),
+        prefer_avoid=bool((np.asarray(ec_np.avoid_score) < 100.0).any()),
     )
 
 
@@ -687,10 +689,13 @@ def pod_step(
         )
     if feat.local and cfg.w_local:
         score = score + cfg.w_local * _minmax_normalize(local_score(ec, st, u), feasible)
+    if feat.prefer_avoid and cfg.w_prefer_avoid:
+        # NodePreferAvoidPods (w=10000, no NormalizeScore): raw 0/100 table
+        score = score + cfg.w_prefer_avoid * ec.avoid_score[u]
     for entry in extra:
         if entry[0] == "score":
             score = score + float(entry[2]) * entry[1](ec, st, u, feasible)
-    # ImageLocality: 0 (no images in sim); NodePreferAvoidPods: constant
+    # ImageLocality: 0 (no images in sim)
 
     neg = jnp.float32(-1e30)
     best = jnp.argmax(jnp.where(feasible, score, neg))

@@ -109,3 +109,29 @@ def test_extra_plugins_validation_and_reason():
     res = simulate(cluster, [AppResource("a", app)], extra_plugins=(("filter", ban_all),))
     assert len(res.unscheduled_pods) == 1
     assert "out-of-tree plugin" in res.unscheduled_pods[0].reason
+
+
+def test_node_prefer_avoid_pods():
+    """NodePreferAvoidPods (node_prefer_avoid_pods.go:47-82): an RS-owned
+    pod avoids the annotated node when its controller uid matches."""
+    import json as _json
+
+    from opensim_tpu.models import expand as _expand
+
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("avoided", "8", "16Gi"))
+    cluster.nodes.append(fx.make_fake_node("ok", "8", "16Gi"))
+    rs = fx.make_fake_replica_set("web", 2, "100m", "128Mi")
+    pods = _expand.pods_from_replica_set(rs)
+    rs_uid = pods[0].metadata.owner_references[0].uid
+    cluster.nodes[0].metadata.annotations["scheduler.alpha.kubernetes.io/preferAvoidPods"] = _json.dumps(
+        {"preferAvoidPods": [{"podSignature": {"podController": {"kind": "ReplicaSet", "uid": rs_uid}}}]}
+    )
+    app = ResourceTypes()
+    app.pods.extend(pods)  # pre-expanded pods keep the known controller uid
+    res = simulate(cluster, [AppResource("a", app)])
+    assert not res.unscheduled_pods
+    placed = {ns.node.metadata.name: len(ns.pods) for ns in res.node_status}
+    # the 10000-weight avoidance dominates: both replicas land on 'ok'
+    assert placed.get("avoided", 0) == 0
+    assert placed["ok"] == 2

@@ -15,7 +15,7 @@ Kernel ↔ reference-plugin parity map (score weights from
           GpuShare (open-gpu-share.go:51-81), OpenLocal (open-local.go:51-92)
   score:  BalancedAllocation (w1), ImageLocality (w1, 0 — no images in sim),
           InterPodAffinity (w1), LeastAllocated (w1), NodeAffinity (w1),
-          NodePreferAvoidPods (w10000, constant), PodTopologySpread (w2),
+          NodePreferAvoidPods (w10000, annotation table), PodTopologySpread (w2),
           TaintToleration (w1), Simon share (w1, plugin/simon.go:45-101),
           GpuShare share (w1), OpenLocal (w1)
 

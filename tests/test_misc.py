@@ -217,3 +217,36 @@ def test_server_newnodes_become_fake_nodes():
         assert resp["nodeStatus"][0]["node"].startswith("simon-")
     finally:
         httpd.shutdown()
+
+
+def test_server_busy_rejection():
+    """TryLock 503 parity (server.go:167,:234): concurrent deploy requests
+    are rejected while one is in flight."""
+    import time as _time
+    from http.server import ThreadingHTTPServer
+
+    from opensim_tpu.server import rest as rest_mod
+    from opensim_tpu.server.rest import SimonServer, make_handler
+
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("b1", "8", "16Gi"))
+    server = SimonServer(base_cluster=cluster)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(server))
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        # hold the deploy lock like an in-flight simulation would
+        assert rest_mod._deploy_lock.acquire(blocking=False)
+        try:
+            body = json.dumps({"deployments": [fx.make_fake_deployment("x", 1, "100m", "128Mi").raw]}).encode()
+            req = urllib.request.Request(f"http://127.0.0.1:{port}/api/deploy-apps", data=body, method="POST")
+            try:
+                urllib.request.urlopen(req)
+                assert False, "expected 503"
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert "busy" in json.load(e).get("error", "")
+        finally:
+            rest_mod._deploy_lock.release()
+    finally:
+        httpd.shutdown()

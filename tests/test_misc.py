@@ -13,6 +13,24 @@ from opensim_tpu.models import ResourceTypes
 from opensim_tpu.models import fixtures as fx
 
 
+from contextlib import contextmanager
+
+
+@contextmanager
+def _serve(server):
+    """Boot a SimonServer on an ephemeral port; yields the port."""
+    from http.server import ThreadingHTTPServer
+
+    from opensim_tpu.server.rest import make_handler
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(server))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        yield httpd.server_address[1]
+    finally:
+        httpd.shutdown()
+
+
 def test_greed_sort_order():
     nodes = [fx.make_fake_node("n0", "10", "100Gi")]
     pods = [
@@ -51,11 +69,7 @@ def test_scale_apps_endpoint():
     for ns in res.node_status:
         cluster.pods.extend(ns.pods)
 
-    server = SimonServer(base_cluster=cluster)
-    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(server))
-    port = httpd.server_address[1]
-    threading.Thread(target=httpd.serve_forever, daemon=True).start()
-    try:
+    with _serve(SimonServer(base_cluster=cluster)) as port:
         scaled = fx.make_fake_deployment("web", 5, "1", "1Gi")
         body = json.dumps({"deployments": [scaled.raw]}).encode()
         req = urllib.request.Request(
@@ -66,8 +80,6 @@ def test_scale_apps_endpoint():
         assert resp["unscheduledPods"] == []
         # old replicas removed, 5 new ones placed
         assert sum(len(ns["pods"]) for ns in resp["nodeStatus"]) == 5
-    finally:
-        httpd.shutdown()
 
 
 def test_report_pod_table(tmp_path):
@@ -131,10 +143,7 @@ def test_metrics_endpoint():
 
     cluster = ResourceTypes()
     cluster.nodes.append(fx.make_fake_node("m1", "8", "16Gi"))
-    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(SimonServer(base_cluster=cluster)))
-    port = httpd.server_address[1]
-    threading.Thread(target=httpd.serve_forever, daemon=True).start()
-    try:
+    with _serve(SimonServer(base_cluster=cluster)) as port:
         body = json.dumps({"deployments": [fx.make_fake_deployment("m", 2, "100m", "128Mi").raw]}).encode()
         req = urllib.request.Request(f"http://127.0.0.1:{port}/api/deploy-apps", data=body, method="POST")
         urllib.request.urlopen(req).read()
@@ -143,8 +152,6 @@ def test_metrics_endpoint():
         assert 'simon_requests_total{endpoint="deploy-apps"}' in text
         assert "simon_pods_scheduled_total" in text
         assert "simon_simulate_seconds_total" in text
-    finally:
-        httpd.shutdown()
 
 
 def test_interactive_apply_scripted(tmp_path, monkeypatch):
@@ -199,10 +206,7 @@ def test_server_newnodes_become_fake_nodes():
 
     from opensim_tpu.server.rest import SimonServer, make_handler
 
-    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(SimonServer(base_cluster=ResourceTypes())))
-    port = httpd.server_address[1]
-    threading.Thread(target=httpd.serve_forever, daemon=True).start()
-    try:
+    with _serve(SimonServer(base_cluster=ResourceTypes())) as port:
         body = json.dumps(
             {
                 "newnodes": [fx.make_fake_node("template", "8", "16Gi").raw],
@@ -215,38 +219,29 @@ def test_server_newnodes_become_fake_nodes():
         assert resp["unscheduledPods"] == []
         # the requested node was renamed to a fake simon-<rand> node
         assert resp["nodeStatus"][0]["node"].startswith("simon-")
-    finally:
-        httpd.shutdown()
 
 
 def test_server_busy_rejection():
     """TryLock 503 parity (server.go:167,:234): concurrent deploy requests
-    are rejected while one is in flight."""
-    import time as _time
+    are rejected while one is in flight (rejection happens before the
+    payload is read, so a minimal body suffices)."""
     from http.server import ThreadingHTTPServer
 
     from opensim_tpu.server import rest as rest_mod
     from opensim_tpu.server.rest import SimonServer, make_handler
 
-    cluster = ResourceTypes()
-    cluster.nodes.append(fx.make_fake_node("b1", "8", "16Gi"))
-    server = SimonServer(base_cluster=cluster)
-    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(server))
-    port = httpd.server_address[1]
-    threading.Thread(target=httpd.serve_forever, daemon=True).start()
-    try:
+    with _serve(SimonServer(base_cluster=ResourceTypes())) as port:
         # hold the deploy lock like an in-flight simulation would
         assert rest_mod._deploy_lock.acquire(blocking=False)
         try:
-            body = json.dumps({"deployments": [fx.make_fake_deployment("x", 1, "100m", "128Mi").raw]}).encode()
-            req = urllib.request.Request(f"http://127.0.0.1:{port}/api/deploy-apps", data=body, method="POST")
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/deploy-apps", data=b"{}", method="POST"
+            )
             try:
-                urllib.request.urlopen(req)
+                urllib.request.urlopen(req, timeout=5)
                 assert False, "expected 503"
             except urllib.error.HTTPError as e:
                 assert e.code == 503
                 assert "busy" in json.load(e).get("error", "")
         finally:
             rest_mod._deploy_lock.release()
-    finally:
-        httpd.shutdown()

@@ -37,6 +37,19 @@ def random_cluster(rng: random.Random, n_nodes: int) -> ResourceTypes:
                     {"alibabacloud.com/gpu-mem": "16Gi", "alibabacloud.com/gpu-count": "2"}
                 )
             )
+        if rng.random() < 0.25:
+            opts.append(
+                fx.with_node_local_storage(
+                    vgs=[{"name": "pool0", "capacity": rng.choice([50, 100]) * 1024**3}],
+                    devices=[
+                        {
+                            "device": "/dev/vdb",
+                            "capacity": 100 * 1024**3,
+                            "mediaType": rng.choice(["ssd", "hdd"]),
+                        }
+                    ],
+                )
+            )
         rt.nodes.append(
             fx.make_fake_node(f"n{i:03d}", str(rng.choice([8, 16, 32])), "64Gi", "110", *opts)
         )
@@ -105,6 +118,31 @@ def random_app(rng: random.Random, n_workloads: int) -> ResourceTypes:
                 {"alibabacloud.com/gpu-mem": "2Gi", "alibabacloud.com/gpu-count": "1"}
             )
         rt.deployments.append(deploy)
+    # occasionally: a stateful set with local storage + anti-affinity, and a
+    # bare pre-bound pod (forced-bind path)
+    if rng.random() < 0.4:
+        sts = fx.make_fake_stateful_set(
+            "db", rng.randrange(2, 5), "250m", "512Mi",
+            fx.with_affinity(
+                {
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {"labelSelector": {"matchLabels": {"app": "db"}}, "topologyKey": "kubernetes.io/hostname"}
+                        ]
+                    }
+                }
+            ),
+        )
+        if rng.random() < 0.5:
+            sts.volume_claim_templates = [
+                {
+                    "metadata": {"name": "data"},
+                    "spec": {"storageClassName": "open-local-lvm", "resources": {"requests": {"storage": "10Gi"}}},
+                }
+            ]
+        rt.stateful_sets.append(sts)
+    if rng.random() < 0.3:
+        rt.pods.append(fx.make_fake_pod("pinned", "100m", "128Mi", fx.with_node_name("n000")))
     return rt
 
 

@@ -14,14 +14,40 @@ Usage: python bench.py [--pods N] [--nodes N] [--profile small|full]
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/opensim-jit-cache")
 
-import numpy as np  # noqa: E402
-
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BACKEND_NOTE = None
+
+
+def _probe_accelerator(timeout_s: int = 150) -> bool:
+    """Run a trivial device op in a subprocess: the axon tunnel can die in a
+    way that hangs any jax call forever, which would hang this benchmark.
+    On failure we fall back to CPU and say so in the output."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax, jax.numpy as jnp; jnp.ones((8,8)).sum().block_until_ready(); import numpy; numpy.asarray(jnp.ones((8,8)).sum()); print('ok')"],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        return r.returncode == 0 and "ok" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+if not _probe_accelerator():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    BACKEND_NOTE = "cpu fallback: accelerator unreachable (axon tunnel down)"
+
+import numpy as np  # noqa: E402
 
 from opensim_tpu.engine.simulator import AppResource, simulate  # noqa: E402
 from opensim_tpu.models import ResourceTypes, fixtures as fx  # noqa: E402
@@ -270,6 +296,8 @@ def main() -> int:
     }
     if cold_s is not None:
         record["cold_s"] = cold_s  # includes first-compile (cached across runs)
+    if BACKEND_NOTE:
+        record["backend"] = BACKEND_NOTE
     print(json.dumps(record))
     return 0
 

@@ -1,0 +1,12 @@
+# Container parity with the reference's Dockerfile (build + test in-image).
+# Base image must provide jax for the target accelerator; for CPU-only use:
+FROM python:3.12-slim
+
+WORKDIR /opensim-tpu
+COPY . .
+RUN pip install --no-cache-dir jax numpy PyYAML pytest \
+    && pip install --no-build-isolation --no-deps -e . \
+    && python -m pytest tests/ -q
+
+ENTRYPOINT ["simon"]
+CMD ["--help"]

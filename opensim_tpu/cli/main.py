@@ -49,6 +49,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     apply_p.add_argument("--max-new-nodes", type=int, default=128, help="upper bound for the node sweep")
     apply_p.add_argument("--report-pods", action="store_true", help="include the per-node Pod Info table")
+    apply_p.add_argument(
+        "--backend",
+        default="auto",
+        choices=["auto", "tpu", "cpu", "xla"],
+        help=(
+            "auto = accelerator if reachable (Pallas fast path on TPU); "
+            "tpu = require the accelerator; cpu = force host CPU; "
+            "xla = accelerator but disable the Pallas fast path"
+        ),
+    )
 
     defrag_p = sub.add_parser(
         "defrag",
@@ -81,6 +91,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     parser = build_parser()
     args = parser.parse_args(argv)
+
+    if getattr(args, "backend", "auto") != "auto":
+        _select_backend(args.backend)
 
     if args.command == "version":
         print(f"simon version: {VERSION}, commit: {COMMIT_ID}")
@@ -154,6 +167,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         return gen_doc(parser, args.output_dir)
     parser.print_help()
     return 2
+
+
+def _select_backend(backend: str) -> None:
+    """--backend plumbing (the BASELINE north star's `--backend=tpu` knob):
+    the TPU engine is the default; cpu forces the host platform, xla keeps
+    the accelerator but disables the Pallas fast path."""
+    import jax
+
+    if backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    elif backend == "xla":
+        os.environ["OPENSIM_DISABLE_FASTPATH"] = "1"
+    elif backend == "tpu":
+        if jax.default_backend() != "tpu":
+            print("simon: --backend tpu requested but no TPU backend is available", file=sys.stderr)
+            raise SystemExit(1)
 
 
 def gen_doc(parser: argparse.ArgumentParser, output_dir: str) -> int:

@@ -84,6 +84,8 @@ def applicable(prep, config=None) -> bool:
     # slower than the XLA scan (tests force it via OPENSIM_FASTPATH=interpret)
     import os
 
+    if os.environ.get("OPENSIM_DISABLE_FASTPATH"):
+        return False  # --backend xla
     if jax.default_backend() != "tpu" and os.environ.get("OPENSIM_FASTPATH") != "interpret":
         return False
     # VMEM budget: three [U, N] tables, used/used_out [R, N] ×2, node_cnt

@@ -4,7 +4,7 @@ FROM python:3.12-slim
 
 WORKDIR /opensim-tpu
 COPY . .
-RUN pip install --no-cache-dir jax numpy PyYAML pytest \
+RUN pip install --no-cache-dir setuptools jax numpy PyYAML pytest \
     && pip install --no-build-isolation --no-deps -e . \
     && python -m pytest tests/ -q
 

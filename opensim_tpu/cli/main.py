@@ -33,7 +33,19 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sub = parser.add_subparsers(dest="command")
 
-    apply_p = sub.add_parser("apply", help="run a capacity-planning simulation")
+    backend_parent = argparse.ArgumentParser(add_help=False)
+    backend_parent.add_argument(
+        "--backend",
+        default="auto",
+        choices=["auto", "tpu", "cpu", "xla"],
+        help=(
+            "auto = accelerator if reachable (Pallas fast path on TPU); "
+            "tpu = require the accelerator; cpu = force host CPU; "
+            "xla = accelerator but disable the Pallas fast path"
+        ),
+    )
+
+    apply_p = sub.add_parser("apply", parents=[backend_parent], help="run a capacity-planning simulation")
     apply_p.add_argument("-f", "--simon-config", required=True, help="path of simon config (Config CR yaml)")
     apply_p.add_argument(
         "-d", "--default-scheduler-config", default="", help="path of kube-scheduler config overrides"
@@ -49,19 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     apply_p.add_argument("--max-new-nodes", type=int, default=128, help="upper bound for the node sweep")
     apply_p.add_argument("--report-pods", action="store_true", help="include the per-node Pod Info table")
-    apply_p.add_argument(
-        "--backend",
-        default="auto",
-        choices=["auto", "tpu", "cpu", "xla"],
-        help=(
-            "auto = accelerator if reachable (Pallas fast path on TPU); "
-            "tpu = require the accelerator; cpu = force host CPU; "
-            "xla = accelerator but disable the Pallas fast path"
-        ),
-    )
 
     defrag_p = sub.add_parser(
         "defrag",
+        parents=[backend_parent],
         help="evaluate node-drain what-ifs (the README's Pods Migration feature, batch-evaluated)",
     )
     defrag_p.add_argument("-f", "--simon-config", required=True, help="path of simon config (Config CR yaml)")
@@ -70,7 +73,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     defrag_p.add_argument("-o", "--output-file", default="", help="redirect the report to a file")
 
-    server_p = sub.add_parser("server", help="start the simon REST server")
+    server_p = sub.add_parser("server", parents=[backend_parent], help="start the simon REST server")
     server_p.add_argument("--kubeconfig", default="", help="kubeconfig of the real cluster")
     server_p.add_argument("--master", default="", help="apiserver address override")
     server_p.add_argument("--port", type=int, default=8080, help="listen port")

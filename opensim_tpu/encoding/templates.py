@@ -32,11 +32,13 @@ SYSTEM_DEFAULT_SPREAD = (
 )
 
 
-def canon_selector(ns: str, selector: Optional[dict]) -> Optional[tuple]:
-    """(namespace, matchLabels, matchExpressions) canonical form; None for a
-    nil selector (matches nothing)."""
+def canon_selector(ns, selector: Optional[dict]) -> Optional[tuple]:
+    """(namespaces, matchLabels, matchExpressions) canonical form; `ns` is a
+    namespace or tuple of namespaces (pod-affinity terms may list several);
+    None for a nil selector (matches nothing)."""
     if selector is None:
         return None
+    ns_t = tuple(sorted(ns)) if isinstance(ns, (tuple, list, set)) else (ns,)
     ml = tuple(sorted((str(k), str(v)) for k, v in (selector.get("matchLabels") or {}).items()))
     exprs = tuple(
         sorted(
@@ -48,7 +50,7 @@ def canon_selector(ns: str, selector: Optional[dict]) -> Optional[tuple]:
             for e in (selector.get("matchExpressions") or [])
         )
     )
-    return (ns, ml, exprs)
+    return (ns_t, ml, exprs)
 
 
 def selector_matches(canon: Optional[tuple], ns: str, labels: Dict[str, str]) -> bool:
@@ -57,7 +59,7 @@ def selector_matches(canon: Optional[tuple], ns: str, labels: Dict[str, str]) ->
     if canon is None:
         return False
     sel_ns, ml, exprs = canon
-    if ns != sel_ns:
+    if ns not in sel_ns:
         return False
     sel = {
         "matchLabels": dict(ml),
@@ -226,12 +228,11 @@ class TemplateSet:
         return t
 
     def _pod_term(self, ns: str, term: dict) -> PodAffinityTerm:
-        namespaces = [str(n) for n in (term.get("namespaces") or [])]
-        # A term with explicit namespaces gets one selector id per namespace;
-        # multi-namespace terms are rare — we take the common single-ns case
-        # and fall back to the pod's own namespace per k8s default.
-        sel_ns = namespaces[0] if namespaces else ns
-        sel_id = self.selector_id(sel_ns, term.get("labelSelector"))
+        # a term's selector applies within its explicit namespaces, or the
+        # owning pod's namespace by default; the canonical selector carries
+        # the whole namespace set so multi-namespace terms match exactly
+        namespaces = tuple(str(n) for n in (term.get("namespaces") or [])) or (ns,)
+        sel_id = self.selector_id(namespaces, term.get("labelSelector"))
         return PodAffinityTerm(sel_id=sel_id, topo_key=str(term.get("topologyKey", "")))
 
     # -- canonical dedupe key ----------------------------------------------

@@ -337,3 +337,41 @@ def test_pod_affinity_colocates():
         for p in ns.pods:
             nodes[p.metadata.name] = ns.node.metadata.name
     assert nodes["anchor"] == nodes["follower"]
+
+
+def test_multi_namespace_anti_affinity():
+    """A pod-anti-affinity term listing several namespaces must match pods
+    in any of them (previously only the first namespace counted)."""
+    cluster = ResourceTypes()
+    for i in range(2):
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+    app = ResourceTypes()
+    app.pods.append(
+        fx.make_fake_pod("occupant", "100m", "128Mi", fx.with_namespace("ns-b"), fx.with_labels({"role": "x"}))
+    )
+    app.pods.append(
+        fx.make_fake_pod(
+            "avoider",
+            "100m",
+            "128Mi",
+            fx.with_namespace("ns-a"),
+            fx.with_affinity(
+                {
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "labelSelector": {"matchLabels": {"role": "x"}},
+                                "namespaces": ["ns-c", "ns-b"],
+                                "topologyKey": "kubernetes.io/hostname",
+                            }
+                        ]
+                    }
+                }
+            ),
+        )
+    )
+    res = simulate(cluster, [AppResource("a", app)])
+    assert not res.unscheduled_pods
+    nodes = {p.metadata.name: ns.node.metadata.name for ns in res.node_status for p in ns.pods}
+    # ns-b is the SECOND listed namespace; the avoider must still dodge it
+    assert nodes["avoider"] != nodes["occupant"]

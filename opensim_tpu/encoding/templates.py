@@ -7,9 +7,10 @@ inside the scan — this is the shape-dedup that keeps the encoded cluster
 small and the jit cache warm.
 
 Canonical selectors: inter-pod affinity terms and topology-spread constraints
-reference label selectors; each distinct (namespace, selector) pair becomes a
-selector id, and per-template match bits (does a pod of template u match
-selector a?) are precomputed on host — the device never does string matching.
+reference label selectors; each distinct (namespace-set, selector) pair
+becomes a selector id, and per-template match bits (does a pod of template u
+match selector a?) are precomputed on host — the device never does string
+matching.
 """
 
 from __future__ import annotations
@@ -124,7 +125,7 @@ class TemplateSet:
         self.selectors: List[Optional[tuple]] = []
         self._sel_index: Dict[Optional[tuple], int] = {}
 
-    def selector_id(self, ns: str, selector: Optional[dict]) -> int:
+    def selector_id(self, ns: "str | tuple", selector: Optional[dict]) -> int:
         canon = canon_selector(ns, selector)
         idx = self._sel_index.get(canon)
         if idx is None:

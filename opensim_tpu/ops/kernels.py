@@ -111,13 +111,6 @@ def _minmax_normalize(scores, feasible):
 # filter kernels
 # ---------------------------------------------------------------------------
 
-def unschedulable_filter(ec, u):
-    """NodeUnschedulable plugin: spec.unschedulable blocks unless tolerated
-    via the node.kubernetes.io/unschedulable:NoSchedule taint (we take the
-    common path: unschedulable nodes are excluded)."""
-    return ~ec.unschedulable
-
-
 def taint_filter(ec, u):
     """TaintToleration: every NoSchedule/NoExecute taint must be tolerated."""
     t_key = ec.taint_key  # [N, Tt]

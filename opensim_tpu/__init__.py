@@ -1,0 +1,35 @@
+"""opensim-tpu: a TPU-native Kubernetes cluster simulator and capacity
+planner with the capabilities of alibaba/open-simulator.
+
+Public API:
+
+    from opensim_tpu import AppResource, ResourceTypes, simulate
+    from opensim_tpu import load_cluster_from_dir, load_yaml_objects
+"""
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    """Lazy re-exports: importing opensim_tpu must not initialize jax."""
+    if name in ("simulate", "prepare", "AppResource", "SimulateResult", "UnscheduledPod", "NodeStatus"):
+        from .engine import simulator
+
+        return getattr(simulator, name)
+    if name in ("ResourceTypes", "Pod", "Node", "Workload"):
+        from .models import objects
+
+        return getattr(objects, name)
+    if name in ("load_cluster_from_dir", "load_yaml_objects", "resources_from_dicts", "generate_pods_from_resources"):
+        from .models import expand
+
+        return getattr(expand, name)
+    if name == "SchedulerConfig":
+        from .engine.schedconfig import SchedulerConfig
+
+        return SchedulerConfig
+    if name == "plan_drains":
+        from .planner.defrag import plan_drains
+
+        return plan_drains
+    raise AttributeError(f"module 'opensim_tpu' has no attribute {name!r}")

@@ -245,3 +245,17 @@ def test_server_busy_rejection():
                 assert "busy" in json.load(e).get("error", "")
         finally:
             rest_mod._deploy_lock.release()
+
+
+def test_package_root_api():
+    """The lazy top-level API re-exports work and importing opensim_tpu
+    alone must not be what initializes jax elsewhere."""
+    import opensim_tpu as ot
+
+    assert ot.__version__
+    assert callable(ot.simulate) and callable(ot.plan_drains)
+    assert ot.ResourceTypes is ResourceTypes
+    import pytest as _pytest
+
+    with _pytest.raises(AttributeError):
+        ot.nonexistent_symbol

@@ -146,7 +146,17 @@ def random_app(rng: random.Random, n_workloads: int) -> ResourceTypes:
     return rt
 
 
-@pytest.mark.parametrize("seed", [1, 7, 23, 99])
+def _seeds():
+    """Default CI seeds; OPENSIM_FUZZ_SEEDS=<n> widens the sweep (e.g. a
+    nightly run with hundreds of seeds)."""
+    import os
+
+    extra = int(os.environ.get("OPENSIM_FUZZ_SEEDS", "0"))
+    base = [1, 7, 23, 99]
+    return base + list(range(1000, 1000 + extra))
+
+
+@pytest.mark.parametrize("seed", _seeds())
 def test_fuzz_fastpath_vs_xla(seed):
     rng = random.Random(seed)
     cluster = random_cluster(rng, rng.randrange(8, 20))

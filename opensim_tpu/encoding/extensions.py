@@ -94,20 +94,28 @@ def encode_local_storage(nodes: List[Node], n_pad: int):
 
 def encode_local_requests(templates: List[SchedTemplate]):
     """Per-template storage requests: total LVM bytes; exclusive-device
-    requests by media (size uses the max when several devices of one media
-    are requested — reference allocates one device per volume)."""
+    volumes by media. `dev_req_sizes[u, media]` carries each volume's size
+    sorted DESCENDING (the reference allocates one device per volume,
+    smallest-volume → smallest fitting device, common.go:290-349); the
+    max-size `dev_req` and `dev_req_count` remain for the score proxy."""
     U = len(templates)
     lvm_req = np.zeros((U,), dtype=np.float32)
     dev_req = np.zeros((U, 2), dtype=np.float32)
     dev_req_count = np.zeros((U, 2), dtype=np.int32)
+    per_media: List[List[List[float]]] = [[[], []] for _ in range(U)]
     for u, t in enumerate(templates):
         for kind, size, _sc in t.local_volumes:
             if kind == "LVM":
                 lvm_req[u] += size
-            elif kind == "SSD":
-                dev_req[u, MEDIA_SSD] = max(dev_req[u, MEDIA_SSD], size)
-                dev_req_count[u, MEDIA_SSD] += 1
-            elif kind == "HDD":
-                dev_req[u, MEDIA_HDD] = max(dev_req[u, MEDIA_HDD], size)
-                dev_req_count[u, MEDIA_HDD] += 1
-    return lvm_req, dev_req, dev_req_count
+            elif kind in ("SSD", "HDD"):
+                media = MEDIA_SSD if kind == "SSD" else MEDIA_HDD
+                dev_req[u, media] = max(dev_req[u, media], size)
+                dev_req_count[u, media] += 1
+                per_media[u][media].append(float(size))
+    Mv = max([len(v) for row in per_media for v in row] + [1])
+    dev_req_sizes = np.zeros((U, 2, Mv), dtype=np.float32)
+    for u in range(U):
+        for media in (0, 1):
+            for i, size in enumerate(sorted(per_media[u][media], reverse=True)):
+                dev_req_sizes[u, media, i] = size
+    return lvm_req, dev_req, dev_req_count, dev_req_sizes

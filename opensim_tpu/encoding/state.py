@@ -70,6 +70,7 @@ class EncodedCluster(NamedTuple):
     pna_val: np.ndarray  # [U, Pp, Q, Vv] i32
     pna_num: np.ndarray  # [U, Pp, Q] f32
     ports: np.ndarray  # [U, Hp] i32 (-1 pad)
+    port_conflict: np.ndarray  # [Hports, Hports] bool — wildcard-aware overlap
     spr_topo: np.ndarray  # [U, Cs] i32 topo-key index (-1 pad)
     spr_sel: np.ndarray  # [U, Cs] i32 selector id
     spr_skew: np.ndarray  # [U, Cs] i32
@@ -97,8 +98,9 @@ class EncodedCluster(NamedTuple):
     # open-local extension
     avoid_score: np.ndarray  # [U, N] f32 NodePreferAvoidPods raw score (0 or 100)
     lvm_req: np.ndarray  # [U] f32 total LVM bytes requested
-    dev_req: np.ndarray  # [U, 2] f32 exclusive-device bytes by media (ssd, hdd) — one device each
+    dev_req: np.ndarray  # [U, 2] f32 max exclusive-device bytes by media (score proxy)
     dev_req_count: np.ndarray  # [U, 2] i32 number of exclusive devices by media
+    dev_req_sizes: np.ndarray  # [U, 2, Mv] f32 per-volume sizes, sorted descending
     node_vg_cap: np.ndarray  # [N, Vg] f32 volume-group capacities
     node_dev_cap: np.ndarray  # [N, Dv] f32 device capacities
     node_dev_media: np.ndarray  # [N, Dv] i32 0=ssd 1=hdd (-1 pad)
@@ -460,7 +462,9 @@ class ClusterEncoder:
                 spr_skew[u, j] = c.max_skew
                 spr_hard[u, j] = c.hard
             for j, term in enumerate(t.aff_terms[:Ti]):
-                at_sel[u, j] = term.sel_id
+                # filter counts pods matching ALL terms — use the conjunction
+                # selector when the template has several (templates.py)
+                at_sel[u, j] = t.aff_conj if t.aff_conj >= 0 else term.sel_id
                 at_topo[u, j] = max(topo_idx.get(term.topo_key, -1), 0)
             for j, term in enumerate(t.anti_terms[:Tn]):
                 an_sel[u, j] = term.sel_id
@@ -510,7 +514,7 @@ class ClusterEncoder:
 
         node_gpu_mem, node_gpu_count = encode_gpu_nodes(self.nodes, N)
         node_vg_cap, node_dev_cap, node_dev_media, vg_names, dev_names = encode_local_storage(self.nodes, N)
-        lvm_req, dev_req, dev_req_count = encode_local_requests(templates)
+        lvm_req, dev_req, dev_req_count, dev_req_sizes = encode_local_requests(templates)
 
         cluster = EncodedCluster(
             node_valid=node_valid,
@@ -543,6 +547,7 @@ class ClusterEncoder:
             pna_val=pna_val,
             pna_num=pna_num,
             ports=ports,
+            port_conflict=vb.port_conflict_matrix(),
             spr_topo=spr_topo,
             spr_sel=spr_sel,
             spr_skew=spr_skew,
@@ -569,6 +574,7 @@ class ClusterEncoder:
             lvm_req=lvm_req,
             dev_req=dev_req,
             dev_req_count=dev_req_count,
+            dev_req_sizes=dev_req_sizes,
             node_vg_cap=node_vg_cap,
             node_dev_cap=node_dev_cap,
             node_dev_media=node_dev_media,

@@ -59,6 +59,10 @@ def selector_matches(canon: Optional[tuple], ns: str, labels: Dict[str, str]) ->
     namespace + labels (the golden form used to precompute match bits)."""
     if canon is None:
         return False
+    if canon[0] == "AND":
+        # conjunction selector: a pod matches iff it matches every member
+        # (podMatchesAllAffinityTerms, interpodaffinity/filtering.go:150-161)
+        return all(selector_matches(sub, ns, labels) for sub in canon[1])
     sel_ns, ml, exprs = canon
     if ns not in sel_ns:
         return False
@@ -105,6 +109,7 @@ class SchedTemplate:
     host_ports: List[Tuple[str, int, str]] = field(default_factory=list)
     spread: List[SpreadConstraint] = field(default_factory=list)
     aff_terms: List[PodAffinityTerm] = field(default_factory=list)  # required pod affinity
+    aff_conj: int = -1  # conjunction selector id when len(aff_terms) > 1
     anti_terms: List[PodAffinityTerm] = field(default_factory=list)  # required pod anti-affinity
     pref_terms: List[PrefPodAffinityTerm] = field(default_factory=list)  # preferred, signed weights
     gpu_mem: float = 0.0  # per-GPU memory request (gpu-share extension)
@@ -127,6 +132,22 @@ class TemplateSet:
 
     def selector_id(self, ns: "str | tuple", selector: Optional[dict]) -> int:
         canon = canon_selector(ns, selector)
+        idx = self._sel_index.get(canon)
+        if idx is None:
+            idx = len(self.selectors)
+            self._sel_index[canon] = idx
+            self.selectors.append(canon)
+        return idx
+
+    def conjunction_id(self, sel_ids: List[int]) -> int:
+        """Selector id matching pods that match ALL of `sel_ids` — the
+        counting basis k8s uses for a pod's required affinity terms
+        (updateWithAffinityTerms → podMatchesAllAffinityTerms,
+        interpodaffinity/filtering.go:113-127)."""
+        subs = tuple(sorted({self.selectors[i] for i in sel_ids}, key=repr))
+        if len(subs) == 1:
+            return self._sel_index[subs[0]]
+        canon = ("AND", subs)
         idx = self._sel_index.get(canon)
         if idx is None:
             idx = len(self.selectors)
@@ -185,6 +206,13 @@ class TemplateSet:
         pod_anti = aff.get("podAntiAffinity") or {}
         for term in pod_aff.get("requiredDuringSchedulingIgnoredDuringExecution") or []:
             t.aff_terms.append(self._pod_term(ns, term))
+        if len(t.aff_terms) > 1:
+            # k8s counts only existing pods matching ALL required affinity
+            # terms (filtering.go:113-127): the FILTER uses this interned
+            # conjunction as its counting basis, while the symmetric
+            # hard-affinity SCORE keeps the per-term selectors
+            # (scoring.go processExistingPod matches terms individually).
+            t.aff_conj = self.conjunction_id([x.sel_id for x in t.aff_terms])
         for term in pod_anti.get("requiredDuringSchedulingIgnoredDuringExecution") or []:
             t.anti_terms.append(self._pod_term(ns, term))
         for pref in pod_aff.get("preferredDuringSchedulingIgnoredDuringExecution") or []:

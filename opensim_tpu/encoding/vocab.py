@@ -135,6 +135,22 @@ class Vocab:
         ip = "" if host_ip in ("", "0.0.0.0") else host_ip
         return self.ports.intern((protocol or "TCP", int(port), ip))
 
+    def port_conflict_matrix(self):
+        """[Hports, Hports] bool: interned triples i and j conflict when
+        protocol+port match and either hostIP is the wildcard or they are
+        equal (nodeports.go ckConflict semantics — 0.0.0.0 overlaps every
+        specific address on the same port)."""
+        import numpy as np
+
+        triples = self.ports.items()
+        n = max(len(triples), 1)
+        m = np.zeros((n, n), dtype=bool)
+        for i, (proto_i, port_i, ip_i) in enumerate(triples):
+            for j, (proto_j, port_j, ip_j) in enumerate(triples):
+                if proto_i == proto_j and port_i == port_j:
+                    m[i, j] = ip_i == ip_j or ip_i == "" or ip_j == ""
+        return m
+
     @property
     def n_resources(self) -> int:
         return len(self.resources)

@@ -41,7 +41,9 @@ def applicable(prep, config=None) -> bool:
     if f.gpu and int(ec.node_gpu_mem.shape[1]) > 8:
         return False
     if f.local and (
-        int(ec.node_vg_cap.shape[1]) > 8 or int(ec.node_dev_cap.shape[1]) > 8
+        int(ec.node_vg_cap.shape[1]) > 8
+        or int(ec.node_dev_cap.shape[1]) > 8
+        or int(ec.dev_req_sizes.shape[2]) > 8
     ):
         return False
     if f.prefer_avoid:
@@ -232,6 +234,15 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
         for h in ports_u[u_i]:
             if h >= 0:
                 port_HU[int(h), u_i] += 1.0
+    # filter-side rows expand each template's ports to every CONFLICTING
+    # vocab id (wildcard hostIP overlaps specific ones — nodeports.go);
+    # the bind update keeps port_HU so only the pod's own triples are marked
+    conf = np.asarray(ec.port_conflict).astype(np.float32)  # [Hv, Hv]
+    port_conf_HU = np.zeros_like(port_HU)
+    if n_port_vocab:
+        port_conf_HU[:n_port_vocab] = (
+            conf[:n_port_vocab, :n_port_vocab] @ port_HU[:n_port_vocab] > 0
+        ).astype(np.float32)
 
     at_active, at_host, at_sel = terms(ec.at_sel, ec.at_topo)
     an_active, an_host, an_sel = terms(ec.an_sel, ec.an_topo)
@@ -311,12 +322,14 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
         lvm_req=np.asarray(ec.lvm_req).astype(np.float32),
         dev_req=np.asarray(ec.dev_req).astype(np.float32),
         dev_need=np.asarray(ec.dev_req_count).astype(np.float32),
+        dev_sizes=np.asarray(ec.dev_req_sizes).reshape(ec.dev_req_sizes.shape[0], -1).astype(np.float32),
         vg_cap_VN=vg_cap_VN,
         vg0_VN=vg0_VN,
         dev_cap_DN=dev_cap_DN,
         dev0_DN=dev0_DN,
         dev_media_DN=dev_media_DN,
         port_HU=port_HU,
+        port_conf_HU=port_conf_HU,
         na_raw=np.asarray(stat.na_raw).astype(np.float32),
         tt_raw=np.asarray(stat.tt_raw).astype(np.float32),
     )

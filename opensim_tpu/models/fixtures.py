@@ -116,6 +116,17 @@ def with_host_ports(ports: List[int]) -> Option:
     return apply
 
 
+def with_host_port_specs(specs: List[dict]) -> Option:
+    """Full container-port dicts (hostPort/protocol/hostIP)."""
+
+    def apply(d: dict) -> None:
+        spec = _pod_spec(d)
+        for c in spec.setdefault("containers", []):
+            c.setdefault("ports", []).extend(dict(p) for p in specs)
+
+    return apply
+
+
 def with_topology_spread(constraints: List[dict]) -> Option:
     def apply(d: dict) -> None:
         _pod_spec(d)["topologySpreadConstraints"] = constraints

@@ -154,11 +154,15 @@ def node_affinity_filter(ec, u):
 
 
 def ports_filter(ec, st, u):
-    """NodePorts: requested host ports must be free on the node."""
+    """NodePorts: requested host ports must be free on the node. A request
+    conflicts with any in-use port its conflict row overlaps — wildcard
+    0.0.0.0 overlaps every specific hostIP on the same port/protocol
+    (nodeports.go ckConflict)."""
     ports = ec.ports[u]  # [Hp]
     safe = jnp.maximum(ports, 0)
-    used = st.port_used[:, safe]  # [N, Hp]
-    conflict = (ports[None, :] >= 0) & (used > 0)
+    conf = ec.port_conflict[safe].astype(jnp.float32)  # [Hp, Hports]
+    hits = st.port_used @ conf.T  # [N, Hp] — weighted count of conflicting uses
+    conflict = (ports[None, :] >= 0) & (hits > 0)
     return ~jnp.any(conflict, axis=-1)
 
 
@@ -225,22 +229,27 @@ def interpod_filter(ec, st, u):
     incoming_matches = ec.matches_sel[u, g_sel]  # [G]
     sym_ok = jnp.all(~(has_label_g & (exist_cnt > 0) & incoming_matches[None, :]), axis=-1)
 
-    # (3) incoming required affinity terms
+    # (3) incoming required affinity terms. All of a template's terms share
+    # one conjunction selector id (templates.py), so `aff_cnt` counts pods
+    # matching ALL terms — k8s's topologyToMatchedAffinityTerms basis
+    # (filtering.go:113-127). satisfyPodAffinity (filtering.go:347-374):
+    # every term's topology label must exist on the node; the first-pod
+    # bootstrap needs the GLOBAL count map empty AND a full self-match, and
+    # still requires the labels.
     at_sel = ec.at_sel[u]  # [Ti]
     at_topo = ec.at_topo[u]
     at_active = at_sel >= 0
     dom_a = ec.node_domain[:, at_topo]  # [N, Ti]
     aff_cnt = st.dom_sel[dom_a, jnp.maximum(at_sel, 0)[None, :]]  # [N, Ti]
     has_label_a = dom_a < D_trash
-    # bootstrap: no pod matches the term anywhere AND the incoming pod
-    # matches its own term selector → term satisfiable on any node
     dom_is_key = ec.domain_topo[None, :] == at_topo[:, None]  # [Ti, D+1]
     total = jnp.sum(jnp.where(dom_is_key, st.dom_sel[:, jnp.maximum(at_sel, 0)].T, 0.0), axis=-1)  # [Ti]
+    map_empty = jnp.sum(jnp.where(at_active, total, 0.0)) == 0
     self_match = ec.matches_sel[u, jnp.maximum(at_sel, 0)]  # [Ti]
-    bootstrap = (total == 0) & self_match
-    aff_ok = jnp.all(
-        ~at_active[None, :] | bootstrap[None, :] | (has_label_a & (aff_cnt > 0)), axis=-1
-    )
+    bootstrap = map_empty & jnp.all(~at_active | self_match) & jnp.any(at_active)
+    per_term_ok = ~at_active[None, :] | (has_label_a & (aff_cnt > 0))
+    labels_ok = ~at_active[None, :] | has_label_a
+    aff_ok = jnp.all(per_term_ok, axis=-1) | (jnp.all(labels_ok, axis=-1) & bootstrap)
 
     return anti_ok & sym_ok & aff_ok
 
@@ -259,16 +268,25 @@ def gpu_filter(ec, st, u):
 
 def local_filter(ec, st, u):
     """Open-Local filter (open-local.go:51-92): LVM request fits the best
-    VG; exclusive-device requests find enough free devices of the media
-    type with sufficient capacity."""
+    VG; exclusive-device volumes must admit a one-device-per-volume
+    matching (CheckExclusiveResourceMeetsPVCSize, common.go:290-349).
+    With volume sizes sorted descending, a matching exists iff the i-th
+    largest volume has at least i free fitting devices (Hall's condition
+    on the nested fit sets)."""
     lvm = ec.lvm_req[u]
     lvm_ok = jnp.max(st.vg_free, axis=-1) >= lvm
     ok = jnp.where(lvm > 0, lvm_ok, True)
     for media in (0, 1):
-        size = ec.dev_req[u, media]
-        need = ec.dev_req_count[u, media].astype(jnp.int32)
-        fitting = (ec.node_dev_media == media) & (st.dev_free >= size) & (st.dev_free > 0)
-        ok = ok & jnp.where(size > 0, jnp.sum(fitting, axis=-1) >= need, True)
+        sizes = ec.dev_req_sizes[u, media]  # [Mv] descending, 0 pad
+        free = st.dev_free  # [N, Dv]
+        fitting = (
+            (ec.node_dev_media[:, None, :] == media)
+            & (free[:, None, :] >= sizes[None, :, None])
+            & (free[:, None, :] > 0)
+        )  # [N, Mv, Dv]
+        fit_cnt = jnp.sum(fitting, axis=-1)  # [N, Mv]
+        rank = jnp.arange(sizes.shape[0]) + 1  # [Mv]
+        ok = ok & jnp.all((sizes[None, :] <= 0) | (fit_cnt >= rank[None, :]), axis=-1)
     return ok
 
 
@@ -789,17 +807,27 @@ def bind_update(ec, st, u, node, apply, feat: Features = ALL_FEATURES):
         vg_hot = ((jnp.arange(st.vg_free.shape[1]) == vg_choice) & jnp.any(vg_fits)).astype(jnp.float32)
         vg_free = st.vg_free.at[node].add(-(vg_hot * jnp.maximum(lvm, 0.0)) * applyf)
 
-        # open-local exclusive devices: first-fit by index per media type
+        # open-local exclusive devices: one device per volume, smallest
+        # volume first onto the smallest-capacity fitting free device
+        # (CheckExclusiveResourceMeetsPVCSize, common.go:290-349; ties by
+        # lowest device index)
         dev_free_n = st.dev_free[node]  # [Dv]
+        dev_cap_n = ec.node_dev_cap[node]
         dev_taken = jnp.zeros_like(dev_free_n)
+        big = jnp.float32(1e30)
+        Mv = ec.dev_req_sizes.shape[2]
         for media in (0, 1):
-            size = ec.dev_req[u, media]
-            need = ec.dev_req_count[u, media].astype(jnp.float32)
-            fitting = (ec.node_dev_media[node] == media) & (dev_free_n >= size) & (dev_free_n > 0)
-            fit_f = fitting.astype(jnp.float32)
-            cum_f = jnp.cumsum(fit_f)
-            take_d = jnp.where((cum_f <= need) & fitting & (size > 0), 1.0, 0.0)
-            dev_taken = jnp.maximum(dev_taken, take_d)
+            for i in reversed(range(Mv)):  # ascending sizes; 0-pads skipped
+                size = ec.dev_req_sizes[u, media, i]
+                cand = (
+                    (ec.node_dev_media[node] == media)
+                    & (dev_free_n >= size)
+                    & (dev_free_n > 0)
+                    & (dev_taken == 0)
+                )
+                choice = jnp.argmin(jnp.where(cand, dev_cap_n, big))
+                hot = (jnp.arange(dev_free_n.shape[0]) == choice) & jnp.any(cand) & (size > 0)
+                dev_taken = jnp.maximum(dev_taken, hot.astype(jnp.float32))
         dev_free = st.dev_free.at[node].set(
             jnp.where((dev_taken > 0) & apply, 0.0, dev_free_n)
         )

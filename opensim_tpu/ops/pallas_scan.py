@@ -98,15 +98,17 @@ class FastInputs(NamedTuple):
     gpu0_DN: np.ndarray  # [Gd, N] f32 initial per-device free memory
     # open-local storage (inert when has_local=False)
     lvm_req: np.ndarray  # [U] f32 total LVM bytes
-    dev_req: np.ndarray  # [U, 2] f32 exclusive-device size by media
+    dev_req: np.ndarray  # [U, 2] f32 exclusive-device max size by media (score)
     dev_need: np.ndarray  # [U, 2] f32 device count by media
+    dev_sizes: np.ndarray  # [U, 2*Mv] f32 per-volume sizes desc (ssd rows then hdd)
     vg_cap_VN: np.ndarray  # [Vg, N] f32 VG capacities
     vg0_VN: np.ndarray  # [Vg, N] f32 initial VG free
     dev_cap_DN: np.ndarray  # [Dv, N] f32 device capacities
     dev0_DN: np.ndarray  # [Dv, N] f32 initial device free
     dev_media_DN: np.ndarray  # [2*Dv, N] f32 media one-hots (ssd rows then hdd rows)
     # host ports (inert when has_ports=False)
-    port_HU: np.ndarray  # [Hp, U] f32 — template uses port row h
+    port_HU: np.ndarray  # [Hp, U] f32 — template uses port row h (bind marks)
+    port_conf_HU: np.ndarray  # [Hp, U] f32 — template conflicts with row h (filter)
     # static score tables (inert when the matching feature flag is off)
     na_raw: np.ndarray  # [U, N] f32 preferred-node-affinity weights
     tt_raw: np.ndarray  # [U, N] f32 intolerable PreferNoSchedule counts
@@ -124,6 +126,7 @@ def _make_kernel(
     n_gpu: int,
     n_vg: int,
     n_dev: int,
+    n_dvol: int,
 ):
     def kernel(
         # SMEM streams + tables
@@ -135,13 +138,13 @@ def _make_kernel(
         pta_ref, pth_ref, pts_ref, ptw_ref,
         agh_ref, pgh_ref,
         gmem_ref, gcnt_ref,
-        lvm_ref, dreq_ref, dneed_ref,
+        lvm_ref, dreq_ref, dneed_ref, dsz_ref,
         # VMEM inputs
         alloc_ref, used0_ref, static_ref, affm_ref, shraw_ref,
         zone_nz_ref, zone_zn_ref, has_zone_ref, matches_ref, nodevalid_ref,
         antig_ref, gmatch_ref, prefg_ref, pmatch_ref, gpu0_ref,
         vgcap_ref, vg0_ref, devcap_ref, dev0_ref, media_ref,
-        port_hu_ref, na_ref, tt_ref,
+        port_hu_ref, port_conf_hu_ref, na_ref, tt_ref,
         # outputs
         chosen_ref, used_out_ref, gpu_take_ref, gpu_out_ref, vg_out_ref, dev_out_ref,
         # scratch
@@ -216,10 +219,12 @@ def _make_kernel(
             feasible = static_row * fit * valid_row
 
             if has_ports:
-                # NodePorts: any requested port already used on the node
-                # (template port rows via one-hot matvec)
+                # NodePorts: any CONFLICTING port already used on the node
+                # (wildcard-expanded template rows via one-hot matvec)
                 onehot_u_p = (iota_u == u).astype(jnp.float32)
-                my_ports = jnp.dot(port_hu_ref[:], onehot_u_p, preferred_element_type=jnp.float32)  # [Hp, 1]
+                my_ports = jnp.dot(
+                    port_conf_hu_ref[:], onehot_u_p, preferred_element_type=jnp.float32
+                )  # [Hp, 1]
                 conflicts = jnp.dot(
                     my_ports.reshape(1, -1),
                     (port_used_ref[:] > 0).astype(jnp.float32),
@@ -249,17 +254,19 @@ def _make_kernel(
                 feasible = jnp.where(
                     lvm > 0, feasible * (best_vg_free >= lvm).astype(jnp.float32), feasible
                 )
+                # one-device-per-volume matching: the i-th largest volume
+                # needs ≥ i+1 free fitting devices (common.go:290-349)
                 for m in range(2):
-                    size = dreq_ref[u, m]
-                    need = dneed_ref[u, m]
-                    cnt_fit = jnp.zeros((1, N), jnp.float32)
-                    for d in range(n_dev):
-                        free_d = dev_free_ref[pl.ds(d, 1), :]
-                        media_d = media_ref[pl.ds(m * n_dev + d, 1), :]
-                        cnt_fit = cnt_fit + media_d * ((free_d >= size) & (free_d > 0)).astype(jnp.float32)
-                    feasible = jnp.where(
-                        size > 0, feasible * (cnt_fit >= need).astype(jnp.float32), feasible
-                    )
+                    for vi in range(n_dvol):
+                        size = dsz_ref[u, m * n_dvol + vi]
+                        cnt_fit = jnp.zeros((1, N), jnp.float32)
+                        for d in range(n_dev):
+                            free_d = dev_free_ref[pl.ds(d, 1), :]
+                            media_d = media_ref[pl.ds(m * n_dev + d, 1), :]
+                            cnt_fit = cnt_fit + media_d * ((free_d >= size) & (free_d > 0)).astype(jnp.float32)
+                        feasible = jnp.where(
+                            size > 0, feasible * (cnt_fit >= (vi + 1)).astype(jnp.float32), feasible
+                        )
 
             # --- PodTopologySpread
             aff_row = affm_ref[pl.ds(u, 1), :] * valid_row
@@ -295,17 +302,33 @@ def _make_kernel(
                     feasible = jnp.where(
                         ana_ref[u, t] == 1, feasible * (1.0 - violated.astype(jnp.float32)), feasible
                     )
-                # incoming required affinity (with the self-match bootstrap)
+                # incoming required affinity: counts use the all-terms
+                # conjunction selector (filtering.go:113-127). A node passes
+                # when every term's topology label exists and every term's
+                # domain count is positive, or via the bootstrap — global
+                # count map empty AND full self-match AND labels present
+                # (satisfyPodAffinity, filtering.go:347-374).
+                at_all_ok = jnp.ones((1, N), jnp.float32)
+                at_labels_ok = jnp.ones((1, N), jnp.float32)
+                at_map_total = jnp.float32(0.0)
+                at_self_all = jnp.float32(1.0)
                 for t in range(Ti):
                     cnt, has_label = sel_cnt(ats_ref[u, t], ath_ref[u, t])
                     total_host = jnp.sum(node_cnt_ref[pl.ds(ats_ref[u, t], 1), :])
                     total_zone = jnp.sum(zone_cnt_ref[pl.ds(ats_ref[u, t], 1), :])
                     total = jnp.where(ath_ref[u, t] == 1, total_host, total_zone)
-                    bootstrap = (total == 0.0) & (atf_ref[u, t] > 0)
-                    ok = ((cnt > 0) & (has_label > 0)) | bootstrap
-                    feasible = jnp.where(
-                        ata_ref[u, t] == 1, feasible * ok.astype(jnp.float32), feasible
+                    activef = ata_ref[u, t] == 1
+                    term_ok = ((cnt > 0) & (has_label > 0)).astype(jnp.float32)
+                    at_all_ok = jnp.where(activef, at_all_ok * term_ok, at_all_ok)
+                    at_labels_ok = jnp.where(
+                        activef, at_labels_ok * (has_label > 0).astype(jnp.float32), at_labels_ok
                     )
+                    at_map_total = at_map_total + jnp.where(activef, total, 0.0)
+                    at_self_all = at_self_all * jnp.where(
+                        activef, (atf_ref[u, t] > 0).astype(jnp.float32), 1.0
+                    )
+                at_bootstrap = ((at_map_total == 0.0) & (at_self_all > 0)).astype(jnp.float32)
+                feasible = feasible * jnp.maximum(at_all_ok, at_labels_ok * at_bootstrap)
                 # symmetric: existing pods' anti terms vs the incoming pod.
                 # counts are non-negative, so "any matching term has pods in
                 # my domain" == "match-weighted count sum > 0" — three dots
@@ -518,18 +541,43 @@ def _make_kernel(
                         ).astype(jnp.float32) * (1.0 - jnp.minimum(taken_vg, 1.0))
                         taken_vg = taken_vg + take_v
                         vg_free_ref[pl.ds(v, 1), :] = free_v - jnp.maximum(lvm, 0.0) * take_v * onehot
-                    # exclusive devices: first-fit by index per media type
+                    # exclusive devices: one device per volume, smallest
+                    # volume onto the smallest-capacity fitting free device
+                    # (common.go:290-349; ties by lowest device index) —
+                    # must mirror the XLA bind exactly
+                    big_cap = jnp.float32(1e30)
+                    taken_rows = [jnp.zeros((1, N), jnp.float32) for _ in range(n_dev)]
                     for m in range(2):
-                        size = dreq_ref[u, m]
-                        need = dneed_ref[u, m]
-                        cnt_taken = jnp.zeros((1, N), jnp.float32)
-                        for d in range(n_dev):
-                            free_d = dev_free_ref[pl.ds(d, 1), :]
-                            media_d = media_ref[pl.ds(m * n_dev + d, 1), :]
-                            fitting = ((media_d > 0) & (free_d >= size) & (free_d > 0)).astype(jnp.float32)
-                            cnt_taken = cnt_taken + fitting
-                            take_d = fitting * (cnt_taken <= need).astype(jnp.float32) * jnp.where(size > 0, 1.0, 0.0)
-                            dev_free_ref[pl.ds(d, 1), :] = free_d * (1.0 - take_d * onehot)
+                        for vi in reversed(range(n_dvol)):  # ascending sizes
+                            size = dsz_ref[u, m * n_dvol + vi]
+                            best_cap = jnp.full((1, N), big_cap, jnp.float32)
+                            for d in range(n_dev):
+                                free_d = dev_free_ref[pl.ds(d, 1), :]
+                                media_d = media_ref[pl.ds(m * n_dev + d, 1), :]
+                                cand_d = (
+                                    (media_d > 0) & (free_d >= size) & (free_d > 0)
+                                    & (taken_rows[d] == 0)
+                                )
+                                best_cap = jnp.where(
+                                    cand_d,
+                                    jnp.minimum(best_cap, devcap_ref[pl.ds(d, 1), :]),
+                                    best_cap,
+                                )
+                            assigned = jnp.zeros((1, N), jnp.float32)
+                            for d in range(n_dev):
+                                free_d = dev_free_ref[pl.ds(d, 1), :]
+                                media_d = media_ref[pl.ds(m * n_dev + d, 1), :]
+                                cand_d = (
+                                    (media_d > 0) & (free_d >= size) & (free_d > 0)
+                                    & (taken_rows[d] == 0)
+                                )
+                                take_d = (
+                                    cand_d & (devcap_ref[pl.ds(d, 1), :] == best_cap)
+                                ).astype(jnp.float32) * (1.0 - jnp.minimum(assigned, 1.0))
+                                take_d = take_d * jnp.where(size > 0, 1.0, 0.0)
+                                assigned = assigned + take_d
+                                taken_rows[d] = jnp.maximum(taken_rows[d], take_d)
+                                dev_free_ref[pl.ds(d, 1), :] = free_d * (1.0 - take_d * onehot)
                 if has_interpod:
                     a_col = jnp.dot(antig_ref[:], onehot_u, preferred_element_type=jnp.float32)
                     anti_node_ref[:] = anti_node_ref[:] + a_col * onehot
@@ -583,7 +631,10 @@ def run_fast_scan(
     stream = lambda: pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM)
 
     out = pl.pallas_call(
-        _make_kernel(has_interpod, has_gpu, has_local, has_ports, has_na, has_tt, G, Gp, Gd, Vg, Dv),
+        _make_kernel(
+            has_interpod, has_gpu, has_local, has_ports, has_na, has_tt,
+            G, Gp, Gd, Vg, Dv, fi.dev_sizes.shape[1] // 2,
+        ),
         grid=grid,
         out_shape=(
             jax.ShapeDtypeStruct((P,), jnp.int32),
@@ -602,8 +653,8 @@ def run_fast_scan(
             + [smem()] * 4  # pt_*
             + [smem()] * 2  # anti_g_host, prefg_host
             + [smem()] * 2  # gpu_mem, gpu_cnt
-            + [smem()] * 3  # lvm_req, dev_req, dev_need
-            + [vmem()] * 23  # VMEM inputs
+            + [smem()] * 4  # lvm_req, dev_req, dev_need, dev_sizes
+            + [vmem()] * 24  # VMEM inputs
         ),
         out_specs=(
             pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM),
@@ -660,6 +711,7 @@ def run_fast_scan(
         jnp.asarray(fi.lvm_req, jnp.float32),
         jnp.asarray(fi.dev_req, jnp.float32),
         jnp.asarray(fi.dev_need, jnp.float32),
+        jnp.asarray(fi.dev_sizes, jnp.float32),
         jnp.asarray(fi.alloc_T, jnp.float32),
         jnp.asarray(fi.used0_T, jnp.float32),
         jnp.asarray(fi.static_pass, jnp.float32),
@@ -681,6 +733,7 @@ def run_fast_scan(
         jnp.asarray(fi.dev0_DN, jnp.float32),
         jnp.asarray(fi.dev_media_DN, jnp.float32),
         jnp.asarray(fi.port_HU, jnp.float32),
+        jnp.asarray(fi.port_conf_HU, jnp.float32),
         jnp.asarray(fi.na_raw, jnp.float32),
         jnp.asarray(fi.tt_raw, jnp.float32),
     )

@@ -198,6 +198,7 @@ def test_fastpath_matches_xla_local_storage():
                     ],
                     devices=[
                         {"device": "/dev/vdb", "capacity": 80 * 1024**3, "mediaType": "ssd"},
+                        {"device": "/dev/vdd", "capacity": 30 * 1024**3, "mediaType": "ssd"},
                         {"device": "/dev/vdc", "capacity": 120 * 1024**3, "mediaType": "hdd"},
                     ],
                 ),
@@ -214,6 +215,14 @@ def test_fastpath_matches_xla_local_storage():
         {"metadata": {"name": "d"}, "spec": {"storageClassName": "open-local-device-hdd", "resources": {"requests": {"storage": "100Gi"}}}},
     ]
     app.stateful_sets.append(sts2)
+    # mixed-size device volumes of one media: per-volume matching, not
+    # count × max-size (common.go:290-349)
+    sts3 = fx.make_fake_stateful_set("mixed", 2, "250m", "512Mi")
+    sts3.volume_claim_templates = [
+        {"metadata": {"name": "small"}, "spec": {"storageClassName": "open-local-device-ssd", "resources": {"requests": {"storage": "10Gi"}}}},
+        {"metadata": {"name": "big"}, "spec": {"storageClassName": "open-local-device-ssd", "resources": {"requests": {"storage": "60Gi"}}}},
+    ]
+    app.stateful_sets.append(sts3)
     prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
     assert prep.features.local
     assert fastpath.applicable(prep)
@@ -257,6 +266,9 @@ def test_fastpath_matches_xla_interpod():
         cluster.nodes.append(fx.make_fake_node(f"n{i:02d}", "16", "32Gi", "110", fx.with_labels(labels)))
     app = ResourceTypes()
     app.pods.append(fx.make_fake_pod("anchor", "100m", "128Mi", fx.with_labels({"role": "anchor"})))
+    app.pods.append(
+        fx.make_fake_pod("anchor-b", "100m", "128Mi", fx.with_labels({"role": "anchor", "grade": "gold"}))
+    )
     app.deployments.append(
         fx.make_fake_deployment(
             "followers", 6, "200m", "256Mi",
@@ -265,6 +277,23 @@ def test_fastpath_matches_xla_interpod():
                     "podAffinity": {
                         "requiredDuringSchedulingIgnoredDuringExecution": [
                             {"labelSelector": {"matchLabels": {"role": "anchor"}}, "topologyKey": "topology.kubernetes.io/zone"}
+                        ]
+                    }
+                }
+            ),
+        )
+    )
+    # multi-term required affinity: only a pod matching BOTH terms counts
+    # (filtering.go:113-127) — anchor-b satisfies, anchor alone must not
+    app.deployments.append(
+        fx.make_fake_deployment(
+            "picky", 4, "200m", "256Mi",
+            fx.with_affinity(
+                {
+                    "podAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {"labelSelector": {"matchLabels": {"role": "anchor"}}, "topologyKey": "topology.kubernetes.io/zone"},
+                            {"labelSelector": {"matchLabels": {"grade": "gold"}}, "topologyKey": "kubernetes.io/hostname"},
                         ]
                     }
                 }
@@ -307,6 +336,42 @@ def test_fastpath_matches_xla_interpod():
         f"{mism.size} mismatches at {mism[:5]}: xla={want_chosen[mism[:5]]} fast={got_chosen[mism[:5]]}"
     )
     np.testing.assert_allclose(got_used, want_used, rtol=1e-5)
+
+
+def test_fastpath_failure_reasons_without_rescan(monkeypatch):
+    """Unschedulable pods through the fast path get kube-style reasons from
+    a per-template evaluation against the final carry — NOT a second full
+    XLA scan — and the reasons match the XLA path exactly (exactness holds
+    because nothing binds after the first failure)."""
+    from opensim_tpu.engine import fastpath as fp
+    from opensim_tpu.engine import simulator as sim_mod
+    from opensim_tpu.engine.simulator import simulate
+
+    monkeypatch.setenv("OPENSIM_FASTPATH", "interpret")
+    scans = []
+    orig_scan = sim_mod.schedule_pods
+
+    def spy_scan(*args, **kwargs):
+        scans.append(1)
+        return orig_scan(*args, **kwargs)
+
+    monkeypatch.setattr(sim_mod, "schedule_pods", spy_scan)
+
+    cluster = ResourceTypes()
+    for i in range(4):
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+    app = ResourceTypes()
+    # 12 × 3cpu on 4 × 8cpu nodes: 8 bind (2/node), 4 fail on cpu
+    app.deployments.append(fx.make_fake_deployment("web", 12, "3", "1Gi"))
+    res = simulate(cluster, [AppResource("a", app)])
+    assert not scans, "fast path fell back to a full XLA re-scan"
+    assert len(res.unscheduled_pods) == 4
+    fast_reasons = sorted(u.reason for u in res.unscheduled_pods)
+
+    monkeypatch.delenv("OPENSIM_FASTPATH")
+    res2 = simulate(cluster, [AppResource("a", app)])
+    assert sorted(u.reason for u in res2.unscheduled_pods) == fast_reasons
+    assert "Insufficient cpu" in fast_reasons[0]
 
 
 def test_fastpath_engages_through_simulate(monkeypatch):

@@ -339,6 +339,162 @@ def test_pod_affinity_colocates():
     assert nodes["anchor"] == nodes["follower"]
 
 
+def test_local_device_volumes_match_per_volume():
+    """Open-local exclusive devices: a 10Gi + 100Gi SSD pair fits devices of
+    20Gi + 120Gi (one device per volume, common.go:290-349) — the old
+    count × max-size encoding wrongly demanded two ≥100Gi devices. Two
+    100Gi volumes still fail on that node."""
+    G = 1024 ** 3
+
+    def node():
+        return fx.make_fake_node(
+            "s1", "16", "32Gi", "110",
+            fx.with_node_local_storage(
+                devices=[
+                    {"device": "/dev/vdb", "capacity": 20 * G, "mediaType": "ssd"},
+                    {"device": "/dev/vdc", "capacity": 120 * G, "mediaType": "ssd"},
+                ]
+            ),
+        )
+
+    def run(sizes):
+        cluster = ResourceTypes()
+        cluster.nodes.append(node())
+        sts = fx.make_fake_stateful_set("db", 1, "500m", "1Gi")
+        sts.volume_claim_templates = [
+            {"metadata": {"name": f"v{i}"},
+             "spec": {"storageClassName": "open-local-device-ssd",
+                      "resources": {"requests": {"storage": s}}}}
+            for i, s in enumerate(sizes)
+        ]
+        app = ResourceTypes()
+        app.stateful_sets.append(sts)
+        return simulate(cluster, [AppResource("a", app)])
+
+    assert not run(["10Gi", "100Gi"]).unscheduled_pods
+    res = run(["100Gi", "100Gi"])
+    assert len(res.unscheduled_pods) == 1
+    assert "local storage" in res.unscheduled_pods[0].reason
+
+
+def test_host_port_wildcard_overlaps_specific_ip():
+    """NodePorts: hostIP 0.0.0.0/"" overlaps every specific address on the
+    same port/protocol (nodeports.go ckConflict), while two distinct
+    specific addresses coexist."""
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n1", "8", "16Gi"))
+    cluster.pods.append(
+        fx.make_fake_pod(
+            "holder", "100m", "128Mi", fx.with_node_name("n1"),
+            fx.with_host_port_specs([{"hostPort": 8080, "containerPort": 8080, "protocol": "TCP", "hostIP": "10.0.0.1"}]),
+        )
+    )
+    app = ResourceTypes()
+    app.pods.append(
+        fx.make_fake_pod(
+            "wild", "100m", "128Mi",
+            fx.with_host_port_specs([{"hostPort": 8080, "containerPort": 8080, "protocol": "TCP"}]),
+        )
+    )
+    app.pods.append(
+        fx.make_fake_pod(
+            "other-ip", "100m", "128Mi",
+            fx.with_host_port_specs([{"hostPort": 8080, "containerPort": 8080, "protocol": "TCP", "hostIP": "10.0.0.2"}]),
+        )
+    )
+    res = simulate(cluster, [AppResource("a", app)])
+    names = {u.pod.metadata.name for u in res.unscheduled_pods}
+    # wildcard conflicts with the specific-IP holder; a different specific IP does not
+    assert names == {"wild"}
+    assert "free ports" in res.unscheduled_pods[0].reason
+
+
+def test_host_port_specific_conflicts_with_wildcard_holder():
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n1", "8", "16Gi"))
+    cluster.pods.append(
+        fx.make_fake_pod(
+            "holder", "100m", "128Mi", fx.with_node_name("n1"),
+            fx.with_host_port_specs([{"hostPort": 9090, "containerPort": 9090, "protocol": "TCP", "hostIP": "0.0.0.0"}]),
+        )
+    )
+    app = ResourceTypes()
+    app.pods.append(
+        fx.make_fake_pod(
+            "specific", "100m", "128Mi",
+            fx.with_host_port_specs([{"hostPort": 9090, "containerPort": 9090, "protocol": "TCP", "hostIP": "10.0.0.9"}]),
+        )
+    )
+    res = simulate(cluster, [AppResource("a", app)])
+    assert len(res.unscheduled_pods) == 1
+
+
+def _two_term_affinity():
+    return {
+        "podAffinity": {
+            "requiredDuringSchedulingIgnoredDuringExecution": [
+                {"labelSelector": {"matchLabels": {"role": "db"}}, "topologyKey": "kubernetes.io/hostname"},
+                {"labelSelector": {"matchLabels": {"tier": "hot"}}, "topologyKey": "kubernetes.io/hostname"},
+            ]
+        }
+    }
+
+
+def test_multi_term_affinity_needs_one_pod_matching_all_terms():
+    """k8s counts only existing pods that match ALL of the incoming pod's
+    required affinity terms (filtering.go:113-127): two pods each satisfying
+    one term do NOT make a node feasible."""
+    cluster = ResourceTypes()
+    cluster.nodes += [fx.make_fake_node("n1", "8", "16Gi"), fx.make_fake_node("n2", "8", "16Gi")]
+    cluster.pods += [
+        fx.make_fake_pod("db-1", "100m", "128Mi", fx.with_labels({"role": "db"}), fx.with_node_name("n1")),
+        fx.make_fake_pod("hot-1", "100m", "128Mi", fx.with_labels({"tier": "hot"}), fx.with_node_name("n1")),
+    ]
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("seeker", "100m", "128Mi", fx.with_affinity(_two_term_affinity())))
+    res = simulate(cluster, [AppResource("a", app)])
+    assert len(res.unscheduled_pods) == 1
+    assert res.unscheduled_pods[0].pod.metadata.name == "seeker"
+
+
+def test_multi_term_affinity_one_pod_matches_all():
+    """A single existing pod carrying every term's labels makes its node
+    (and only its node) feasible."""
+    cluster = ResourceTypes()
+    cluster.nodes += [fx.make_fake_node("n1", "8", "16Gi"), fx.make_fake_node("n2", "8", "16Gi")]
+    cluster.pods.append(
+        fx.make_fake_pod(
+            "both-1", "100m", "128Mi", fx.with_labels({"role": "db", "tier": "hot"}), fx.with_node_name("n2")
+        )
+    )
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("seeker", "100m", "128Mi", fx.with_affinity(_two_term_affinity())))
+    res = simulate(cluster, [AppResource("a", app)])
+    assert not res.unscheduled_pods
+    placed = {p.metadata.name: ns.node.metadata.name for ns in res.node_status for p in ns.pods}
+    assert placed["seeker"] == "n2"
+
+
+def test_multi_term_affinity_bootstrap_requires_full_self_match():
+    """First-pod bootstrap (filtering.go:361-369): the global count map must
+    be empty AND the pod must match ALL its own terms."""
+    def run(labels):
+        cluster = ResourceTypes()
+        cluster.nodes += [fx.make_fake_node("n1", "8", "16Gi")]
+        app = ResourceTypes()
+        app.pods.append(
+            fx.make_fake_pod(
+                "self", "100m", "128Mi", fx.with_labels(labels), fx.with_affinity(_two_term_affinity())
+            )
+        )
+        return simulate(cluster, [AppResource("a", app)])
+
+    # matches both of its own terms → bootstraps onto any labeled node
+    assert not run({"role": "db", "tier": "hot"}).unscheduled_pods
+    # matches only one of its own terms → no bootstrap, unschedulable
+    assert len(run({"role": "db"}).unscheduled_pods) == 1
+
+
 def test_multi_namespace_anti_affinity():
     """A pod-anti-affinity term listing several namespaces must match pods
     in any of them (previously only the first namespace counted)."""

@@ -47,11 +47,11 @@ def test_greed_sort_order():
 def test_chart_tarball(tmp_path):
     from opensim_tpu.chart.render import process_chart
 
-    src = "/root/reference/example/application/charts/yoda"
-    tgz = tmp_path / "yoda.tgz"
+    src = "example/application/charts/obs-stack"
+    tgz = tmp_path / "obs.tgz"
     with tarfile.open(tgz, "w:gz") as tf:
-        tf.add(src, arcname="yoda")
-    docs = process_chart("yoda", str(tgz))
+        tf.add(src, arcname="obs-stack")
+    docs = process_chart("obs", str(tgz))
     assert len(docs) >= 10
     assert "{{" not in "\n".join(docs)
 

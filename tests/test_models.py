@@ -2,6 +2,7 @@
 (SURVEY.md §4: only one integration test exists upstream)."""
 
 import json
+import os
 
 import pytest
 
@@ -166,6 +167,20 @@ def test_taint_toleration():
     assert selectors.find_untolerated_taint([taint], [Toleration(operator="Exists")]) is None
 
 
+def test_load_repo_examples():
+    rt = expand.load_cluster_from_dir("example/cluster/demo")
+    assert len(rt.nodes) == 4
+    assert any("simon/node-local-storage" in n.metadata.annotations for n in rt.nodes)
+    app, skipped = expand.resources_from_dicts(expand.load_yaml_objects("example/application/simple"))
+    pods = expand.generate_pods_from_resources(app, rt.nodes)
+    # 1 bare pod + 3 deployment + 2 replicaset + 2 job + 6 sts + 2 daemonset
+    # (the exporter DS tolerates no control-plane taint → workers only)
+    assert len(pods) == 16
+
+
+@pytest.mark.skipif(
+    not os.path.isdir("/root/reference/example"), reason="reference checkout not mounted"
+)
 def test_load_reference_examples():
     rt = expand.load_cluster_from_dir("/root/reference/example/cluster/demo_1")
     assert len(rt.nodes) == 4

@@ -103,9 +103,9 @@ def test_satisfy_resource_setting_caps(monkeypatch):
 def test_report_renders_gpu_and_storage(tmp_path):
     from opensim_tpu.models import expand
 
-    cluster = expand.load_cluster_from_dir("/root/reference/example/cluster/gpushare")
+    cluster = expand.load_cluster_from_dir("example/cluster/gpushare")
     app, _ = expand.resources_from_dicts(
-        expand.load_yaml_objects("/root/reference/example/application/gpushare")
+        expand.load_yaml_objects("example/application/gpushare")
     )
     res = simulate(cluster, [AppResource("pai_gpu", app)])
     import io
@@ -115,11 +115,11 @@ def test_report_renders_gpu_and_storage(tmp_path):
     text = buf.getvalue()
     assert "GPU Node Resource" in text
     assert "Pod -> Node Map" in text
-    assert "pai-node-00" in text
+    assert "gpu-a-1" in text
 
 
-def test_chart_render_yoda():
-    docs = process_chart("yoda", "/root/reference/example/application/charts/yoda")
+def test_chart_render_obs_stack():
+    docs = process_chart("obs", "example/application/charts/obs-stack")
     import yaml
 
     objs = [yaml.safe_load(d) for d in docs]

@@ -1,0 +1,568 @@
+#!/usr/bin/env python
+"""Generate the repo's self-contained example tree under example/.
+
+The reference ships demo inputs under its own example/ dir; this repo authors
+an ORIGINAL equivalent set (different clusters, workloads, sizes and names)
+covering the same feature surface: tainted control-plane nodes, a local-storage
+worker (simon/node-local-storage sibling JSON), GPU-share nodes, an
+anti-affinity StatefulSet that cannot fully fit, daemonsets with and without
+tolerations, storage-class-driven PVC synthesis, a Helm chart, and newnode
+capacity templates.  Run `python tools/gen_examples.py` from the repo root to
+regenerate; the output is checked in so users (and tests) never need the
+reference checkout.
+"""
+
+import json
+import os
+import sys
+
+import yaml
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "example")
+
+GiB = 1024 ** 3
+
+CP_TAINT = {"key": "node-role.kubernetes.io/control-plane", "effect": "NoSchedule"}
+CP_TOLERATION = {"key": "node-role.kubernetes.io/control-plane", "operator": "Exists", "effect": "NoSchedule"}
+
+
+def write(relpath, content):
+    path = os.path.join(ROOT, relpath)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        if isinstance(content, str):
+            f.write(content)
+        else:
+            yaml.safe_dump(content, f, sort_keys=False)
+
+
+def node(name, cpu, memory, labels=None, taints=None, zone=None):
+    lab = {
+        "kubernetes.io/arch": "amd64",
+        "kubernetes.io/os": "linux",
+        "kubernetes.io/hostname": name,
+    }
+    if zone:
+        lab["topology.kubernetes.io/zone"] = zone
+    lab.update(labels or {})
+    alloc = {"cpu": str(cpu), "memory": memory, "pods": "110",
+             "ephemeral-storage": "100Gi"}
+    d = {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "labels": lab},
+        "status": {
+            "allocatable": dict(alloc),
+            "capacity": dict(alloc),
+            "conditions": [{"type": "Ready", "status": "True",
+                            "reason": "KubeletReady",
+                            "message": "kubelet is posting ready status"}],
+        },
+    }
+    if taints:
+        d["spec"] = {"taints": taints}
+    return d
+
+
+def container(name="app", image="registry.example.com/app:1.0", cpu="100m", memory="128Mi",
+              gpu_mem=None, ports=None):
+    c = {"name": name, "image": image,
+         "resources": {"requests": {"cpu": cpu, "memory": memory},
+                       "limits": {"cpu": cpu, "memory": memory}}}
+    if ports:
+        c["ports"] = [{"containerPort": p, "hostPort": p} for p in ports]
+    return c
+
+
+def workload(kind, name, namespace, replicas, pod_labels, containers, *,
+             tolerations=None, affinity=None, node_selector=None,
+             volume_claims=None, spread=None, api="apps/v1"):
+    tmpl = {"metadata": {"labels": dict(pod_labels)},
+            "spec": {"containers": containers}}
+    if tolerations:
+        tmpl["spec"]["tolerations"] = tolerations
+    if affinity:
+        tmpl["spec"]["affinity"] = affinity
+    if node_selector:
+        tmpl["spec"]["nodeSelector"] = node_selector
+    if spread:
+        tmpl["spec"]["topologySpreadConstraints"] = spread
+    spec = {"selector": {"matchLabels": dict(pod_labels)}, "template": tmpl}
+    if kind not in ("DaemonSet",):
+        spec["replicas"] = replicas
+    if kind == "StatefulSet":
+        spec["serviceName"] = name
+        spec["podManagementPolicy"] = "Parallel"
+        if volume_claims:
+            spec["volumeClaimTemplates"] = volume_claims
+    if kind == "Job":
+        spec = {"completions": replicas, "parallelism": replicas, "template": tmpl}
+        tmpl["spec"]["restartPolicy"] = "Never"
+    return {"apiVersion": api, "kind": kind,
+            "metadata": {"name": name, "namespace": namespace}, "spec": spec}
+
+
+def anti_affinity(label_key, label_value, namespace, topology="kubernetes.io/hostname"):
+    return {"podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+        {"labelSelector": {"matchExpressions": [
+            {"key": label_key, "operator": "In", "values": [label_value]}]},
+         "topologyKey": topology, "namespaces": [namespace]}]}}
+
+
+def vct(name, sc, size):
+    return {"metadata": {"name": name},
+            "spec": {"accessModes": ["ReadWriteOnce"], "storageClassName": sc,
+                     "resources": {"requests": {"storage": size}}}}
+
+
+# ---------------------------------------------------------------------------
+# cluster/demo — 2 tainted control-plane nodes + 2 workers (one with storage)
+# ---------------------------------------------------------------------------
+
+def gen_cluster_demo():
+    cp_labels = {"node-role.kubernetes.io/control-plane": ""}
+    wk_labels = {"node-role.kubernetes.io/worker": ""}
+    write("cluster/demo/nodes/cp-1.yaml", node("cp-1", 8, "16Gi", cp_labels, [CP_TAINT], zone="zone-a"))
+    write("cluster/demo/nodes/cp-2.yaml", node("cp-2", 8, "16Gi", cp_labels, [CP_TAINT], zone="zone-b"))
+    write("cluster/demo/nodes/worker-1.yaml", node("worker-1", 16, "32Gi", wk_labels, zone="zone-a"))
+    write("cluster/demo/nodes/worker-2.yaml", node("worker-2", 16, "32Gi", wk_labels, zone="zone-b"))
+    # open-local storage sidecar for worker-1 (simon/node-local-storage JSON)
+    write("cluster/demo/nodes/worker-1.json", json.dumps({
+        "vgs": [
+            {"name": "pool-a", "capacity": str(200 * GiB), "requested": "0"},
+            {"name": "pool-b", "capacity": str(100 * GiB), "requested": "0"},
+        ],
+        "devices": [
+            {"name": "/dev/sdb", "device": "/dev/sdb", "capacity": str(128 * GiB),
+             "mediaType": "ssd", "isAllocated": "false"},
+            {"name": "/dev/sdc", "device": "/dev/sdc", "capacity": str(256 * GiB),
+             "mediaType": "hdd", "isAllocated": "false"},
+            {"name": "/dev/sdd", "device": "/dev/sdd", "capacity": str(256 * GiB),
+             "mediaType": "hdd", "isAllocated": "false"},
+        ],
+    }, indent=2) + "\n")
+
+    # base cluster workloads
+    write("cluster/demo/deploy-cluster-dns.yaml", workload(
+        "Deployment", "cluster-dns", "kube-system", 2, {"k8s-app": "cluster-dns"},
+        [container("dns", "registry.example.com/dns:1.9", "250m", "128Mi")]))
+    write("cluster/demo/ds-node-agent.yaml", workload(
+        "DaemonSet", "node-agent", "kube-system", 0, {"k8s-app": "node-agent"},
+        [container("agent", "registry.example.com/agent:0.4", "100m", "64Mi")],
+        tolerations=[{"operator": "Exists"}]))
+    write("cluster/demo/ds-ingress.yaml", workload(
+        "DaemonSet", "ingress-edge", "kube-system", 0, {"k8s-app": "ingress-edge"},
+        [container("envoy", "registry.example.com/edge:2.1", "200m", "256Mi")],
+        node_selector={"node-role.kubernetes.io/worker": ""}))
+    for sc, prov in [("open-local-lvm", "local.csi.aliyun.com"),
+                     ("open-local-device-ssd", "local.csi.aliyun.com"),
+                     ("open-local-device-hdd", "local.csi.aliyun.com")]:
+        write(f"cluster/demo/sc-{sc.replace('open-local-', '')}.yaml", {
+            "apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+            "metadata": {"name": sc}, "provisioner": prov,
+            "volumeBindingMode": "WaitForFirstConsumer",
+        })
+
+
+# ---------------------------------------------------------------------------
+# cluster/gpushare — two 4-GPU nodes
+# ---------------------------------------------------------------------------
+
+def gen_cluster_gpushare():
+    for i in (1, 2):
+        n = node(f"gpu-a-{i}", 48, "192000Mi",
+                 {"alibabacloud.com/gpu-card-model": "A10",
+                  "node-role.kubernetes.io/worker": ""})
+        for sec in ("allocatable", "capacity"):
+            n["status"][sec]["alibabacloud.com/gpu-count"] = "4"
+            n["status"][sec]["alibabacloud.com/gpu-mem"] = "61440Mi"  # 4 x 15360Mi
+        write(f"cluster/gpushare/nodes/gpu-a-{i}.yaml", n)
+
+
+# ---------------------------------------------------------------------------
+# applications
+# ---------------------------------------------------------------------------
+
+def gen_app_simple():
+    ns = "demo-app"
+    write("application/simple/deploy-web.yaml", workload(
+        "Deployment", "web", ns, 3, {"app": "web"},
+        [container("web", "registry.example.com/web:3.2", "500m", "512Mi")]))
+    write("application/simple/rs-cache.yaml", workload(
+        "ReplicaSet", "cache", ns, 2, {"app": "cache"},
+        [container("cache", "registry.example.com/cache:7", "250m", "1Gi")]))
+    write("application/simple/job-migrate.yaml", workload(
+        "Job", "schema-migrate", ns, 2, {"app": "schema-migrate"},
+        [container("migrate", "registry.example.com/migrate:1.0", "200m", "256Mi")],
+        api="batch/v1"))
+    write("application/simple/pod-probe.yaml", {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "probe", "namespace": ns, "labels": {"app": "probe"}},
+        "spec": {"containers": [container("probe", "registry.example.com/probe:0.1", "50m", "64Mi")]}})
+    write("application/simple/ds-exporter.yaml", workload(
+        "DaemonSet", "metrics-exporter", ns, 0, {"app": "metrics-exporter"},
+        [container("exporter", "registry.example.com/exporter:1.5", "100m", "96Mi")]))
+    # 6 replicas, hostname anti-affinity, tolerates the CP taint: exactly one
+    # replica lands per node (4 nodes) and 2 stay unschedulable.
+    write("application/simple/sts-kv.yaml", workload(
+        "StatefulSet", "kv-store", ns, 6, {"app": "kv-store"},
+        [container("kv", "registry.example.com/kv:5.4", "500m", "1Gi")],
+        tolerations=[CP_TOLERATION],
+        affinity=anti_affinity("app", "kv-store", ns)))
+
+
+def gen_app_local():
+    # only worker-1 has VGs/devices; the hdd claim needs an exclusive device,
+    # so replicas beyond the device count stay pending.
+    write("application/local/sts-db.yaml", workload(
+        "StatefulSet", "db", "data", 4, {"app": "db"},
+        [container("db", "registry.example.com/db:14", "1", "2Gi")],
+        volume_claims=[
+            vct("wal", "open-local-lvm", "20Gi"),
+            vct("data", "open-local-lvm", "50Gi"),
+            vct("cold", "open-local-device-hdd", "150Gi"),
+        ]))
+
+
+def gen_app_gpushare():
+    ns = "ml"
+
+    def gpu_pod(name, mem, count, cpu="4", memory="8192Mi"):
+        return {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": name, "namespace": ns,
+                         "annotations": {"alibabacloud.com/gpu-mem": mem,
+                                         "alibabacloud.com/gpu-count": str(count)}},
+            "spec": {"containers": [container("cuda", "registry.example.com/cuda:12", cpu, memory)]}}
+
+    write("application/gpushare/pod-infer-small.yaml", gpu_pod("infer-small", "4096Mi", 1))
+    write("application/gpushare/pod-infer-full.yaml", gpu_pod("infer-full", "15360Mi", 1))
+    write("application/gpushare/pod-train.yaml", gpu_pod("train-dual", "12288Mi", 2, cpu="8", memory="32768Mi"))
+    rs = workload("ReplicaSet", "serving", ns, 4, {"app": "serving"},
+                  [container("srv", "registry.example.com/serving:2", "2", "4096Mi")])
+    rs["spec"]["template"]["metadata"]["annotations"] = {
+        "alibabacloud.com/gpu-mem": "2048Mi", "alibabacloud.com/gpu-count": "1"}
+    write("application/gpushare/rs-serving.yaml", rs)
+
+
+def gen_app_scale():
+    ns = "load"
+    write("application/scale/deploy-api.yaml", workload(
+        "Deployment", "api", ns, 40, {"app": "api"},
+        [container("api", "registry.example.com/api:9", "250m", "512Mi")]))
+    write("application/scale/deploy-frontend.yaml", workload(
+        "Deployment", "frontend", ns, 60, {"app": "frontend"},
+        [container("fe", "registry.example.com/fe:9", "100m", "256Mi")]))
+    write("application/scale/sts-queue.yaml", workload(
+        "StatefulSet", "queue", ns, 30, {"app": "queue"},
+        [container("mq", "registry.example.com/mq:3", "200m", "512Mi")]))
+    write("application/scale/rs-worker.yaml", workload(
+        "ReplicaSet", "worker", ns, 20, {"app": "worker"},
+        [container("wk", "registry.example.com/worker:9", "150m", "256Mi")]))
+    write("application/scale/job-batch.yaml", workload(
+        "Job", "batch", ns, 10, {"app": "batch"},
+        [container("batch", "registry.example.com/batch:9", "500m", "1Gi")],
+        api="batch/v1"))
+
+
+def gen_app_mixed():
+    """Kernel-stress app: node affinity, zone spread, pod affinity, host ports."""
+    ns = "mixed"
+    write("application/mixed/deploy-zonal.yaml", workload(
+        "Deployment", "zonal", ns, 4, {"app": "zonal"},
+        [container("z", "registry.example.com/zonal:1", "200m", "256Mi")],
+        spread=[{"maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+                 "whenUnsatisfiable": "DoNotSchedule",
+                 "labelSelector": {"matchLabels": {"app": "zonal"}}}]))
+    write("application/mixed/deploy-pinned.yaml", workload(
+        "Deployment", "pinned", ns, 2, {"app": "pinned"},
+        [container("p", "registry.example.com/pinned:1", "100m", "128Mi")],
+        affinity={"nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {
+            "nodeSelectorTerms": [{"matchExpressions": [
+                {"key": "node-role.kubernetes.io/worker", "operator": "Exists"}]}]}}}))
+    write("application/mixed/deploy-sidecar.yaml", workload(
+        "Deployment", "sidecar", ns, 2, {"app": "sidecar"},
+        [container("s", "registry.example.com/sidecar:1", "100m", "128Mi")],
+        affinity={"podAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+            {"labelSelector": {"matchLabels": {"app": "pinned"}},
+             "topologyKey": "kubernetes.io/hostname", "namespaces": [ns]}]}}))
+    write("application/mixed/sts-gateway.yaml", workload(
+        "StatefulSet", "gateway", ns, 2, {"app": "gateway"},
+        [container("gw", "registry.example.com/gw:1", "250m", "256Mi", ports=[30443])],
+        affinity=anti_affinity("app", "gateway", ns)))
+    write("application/mixed/pod-edge.yaml", {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "edge-probe", "namespace": ns, "labels": {"app": "edge-probe"}},
+        "spec": {"nodeSelector": {"node-role.kubernetes.io/worker": ""},
+                 "containers": [container("e", "registry.example.com/edge:1", "50m", "64Mi")]}})
+
+
+# ---------------------------------------------------------------------------
+# chart: obs-stack — exercises the renderer's Go-template subset
+# ---------------------------------------------------------------------------
+
+CHART_FILES = {
+    "Chart.yaml": """\
+apiVersion: v2
+name: obs-stack
+description: Observability stack demo chart (agent + server + retention jobs)
+version: 0.2.0
+appVersion: "1.8"
+""",
+    "values.yaml": """\
+namespace: obs
+images:
+  agent: registry.example.com/obs-agent:1.8
+  server: registry.example.com/obs-server:1.8
+  tools: registry.example.com/obs-tools:1.8
+server:
+  replicas: 2
+  cpu: 500m
+  memory: 1Gi
+agent:
+  cpu: 100m
+  memory: 128Mi
+retention:
+  enabled: true
+  schedule: "0 3 * * *"
+storage:
+  className: open-local-lvm
+  size: 30Gi
+""",
+    "templates/configmap.yaml": """\
+apiVersion: v1
+kind: ConfigMap
+metadata:
+  name: {{ .Release.Name }}-config
+  namespace: {{ .Values.namespace }}
+data:
+  chart: {{ .Chart.Name | quote }}
+  version: {{ .Chart.Version | quote }}
+  retention: {{ .Values.retention.enabled | toString | quote }}
+""",
+    "templates/service.yaml": """\
+apiVersion: v1
+kind: Service
+metadata:
+  name: {{ .Release.Name }}-server
+  namespace: {{ .Values.namespace }}
+spec:
+  selector:
+    app: {{ .Release.Name }}-server
+  ports:
+    - port: 9090
+      targetPort: 9090
+""",
+    "templates/storage-class.yaml": """\
+apiVersion: storage.k8s.io/v1
+kind: StorageClass
+metadata:
+  name: {{ .Values.storage.className }}
+provisioner: local.csi.aliyun.com
+volumeBindingMode: WaitForFirstConsumer
+""",
+    "templates/agent-daemonset.yaml": """\
+apiVersion: apps/v1
+kind: DaemonSet
+metadata:
+  name: {{ .Release.Name }}-agent
+  namespace: {{ .Values.namespace }}
+spec:
+  selector:
+    matchLabels:
+      app: {{ .Release.Name }}-agent
+  template:
+    metadata:
+      labels:
+        app: {{ .Release.Name }}-agent
+    spec:
+      tolerations:
+        - operator: Exists
+      containers:
+        - name: agent
+          image: {{ .Values.images.agent }}
+          resources:
+            requests:
+              cpu: {{ .Values.agent.cpu }}
+              memory: {{ .Values.agent.memory }}
+""",
+    "templates/server-deployment.yaml": """\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: {{ .Release.Name }}-server
+  namespace: {{ .Values.namespace }}
+spec:
+  replicas: {{ .Values.server.replicas | int }}
+  selector:
+    matchLabels:
+      app: {{ .Release.Name }}-server
+  template:
+    metadata:
+      labels:
+        app: {{ .Release.Name }}-server
+    spec:
+      containers:
+        - name: server
+          image: {{ .Values.images.server }}
+          resources:
+            requests:
+              cpu: {{ .Values.server.cpu }}
+              memory: {{ .Values.server.memory }}
+          volumeMounts:
+            - name: tsdb
+              mountPath: /var/lib/obs
+      volumes:
+        - name: tsdb
+          persistentVolumeClaim:
+            claimName: {{ .Release.Name }}-tsdb
+""",
+    "templates/pvc.yaml": """\
+apiVersion: v1
+kind: PersistentVolumeClaim
+metadata:
+  name: {{ .Release.Name }}-tsdb
+  namespace: {{ .Values.namespace }}
+spec:
+  accessModes:
+    - ReadWriteOnce
+  storageClassName: {{ .Values.storage.className }}
+  resources:
+    requests:
+      storage: {{ .Values.storage.size | default "10Gi" }}
+""",
+    "templates/retention-cronjob.yaml": """\
+{{- if .Values.retention.enabled }}
+apiVersion: batch/v1
+kind: CronJob
+metadata:
+  name: {{ .Release.Name }}-retention
+  namespace: {{ .Values.namespace }}
+spec:
+  schedule: {{ .Values.retention.schedule | quote }}
+  jobTemplate:
+    spec:
+      template:
+        spec:
+          restartPolicy: Never
+          containers:
+            - name: prune
+              image: {{ .Values.images.tools }}
+              resources:
+                requests:
+                  cpu: 100m
+                  memory: 128Mi
+{{- end }}
+""",
+    "templates/init-job.yaml": """\
+apiVersion: batch/v1
+kind: Job
+metadata:
+  name: {{ .Release.Name }}-init
+  namespace: {{ .Values.namespace }}
+spec:
+  completions: 1
+  template:
+    spec:
+      restartPolicy: Never
+      containers:
+        - name: init
+          image: {{ .Values.images.tools }}
+          resources:
+            requests:
+              cpu: 100m
+              memory: 128Mi
+""",
+    "templates/namespace.yaml": """\
+apiVersion: v1
+kind: Namespace
+metadata:
+  name: {{ .Values.namespace }}
+""",
+    "templates/serviceaccount.yaml": """\
+apiVersion: v1
+kind: ServiceAccount
+metadata:
+  name: {{ .Release.Name }}-agent
+  namespace: {{ .Values.namespace }}
+""",
+}
+
+
+def gen_chart():
+    for rel, content in CHART_FILES.items():
+        write(f"application/charts/obs-stack/{rel}", content)
+
+
+# ---------------------------------------------------------------------------
+# newnode templates + configs
+# ---------------------------------------------------------------------------
+
+def gen_newnode():
+    write("newnode/demo/extra-worker.yaml", node(
+        "extra-worker", 32, "64Gi", {"node-role.kubernetes.io/worker": ""}, zone="zone-a"))
+    write("newnode/demo/extra-worker.json", json.dumps({
+        "vgs": [{"name": "pool-a", "capacity": str(500 * GiB), "requested": "0"}],
+        "devices": [
+            {"name": "/dev/sdb", "device": "/dev/sdb", "capacity": str(256 * GiB),
+             "mediaType": "hdd", "isAllocated": "false"},
+        ],
+    }, indent=2) + "\n")
+    gpu = node("extra-gpu", 48, "192000Mi",
+               {"alibabacloud.com/gpu-card-model": "A10",
+                "node-role.kubernetes.io/worker": ""})
+    for sec in ("allocatable", "capacity"):
+        gpu["status"][sec]["alibabacloud.com/gpu-count"] = "4"
+        gpu["status"][sec]["alibabacloud.com/gpu-mem"] = "61440Mi"
+    write("newnode/gpushare/extra-gpu.yaml", gpu)
+
+
+def gen_configs():
+    write("simon-config.yaml", {
+        "apiVersion": "simon/v1alpha1", "kind": "Config",
+        "metadata": {"name": "simon-config"},
+        "spec": {
+            "cluster": {"customConfig": "cluster/demo"},
+            "appList": [
+                {"name": "obs", "path": "application/charts/obs-stack", "chart": True},
+                {"name": "simple", "path": "application/simple"},
+            ],
+            "newNode": "newnode/demo",
+        },
+    })
+    write("simon-gpushare-config.yaml", {
+        "apiVersion": "simon/v1alpha1", "kind": "Config",
+        "metadata": {"name": "simon-gpushare-config"},
+        "spec": {
+            "cluster": {"customConfig": "cluster/gpushare"},
+            "appList": [{"name": "ml", "path": "application/gpushare"}],
+            "newNode": "newnode/gpushare",
+        },
+    })
+    write("simon-local-config.yaml", {
+        "apiVersion": "simon/v1alpha1", "kind": "Config",
+        "metadata": {"name": "simon-local-config"},
+        "spec": {
+            "cluster": {"customConfig": "cluster/demo"},
+            "appList": [{"name": "data", "path": "application/local"}],
+            "newNode": "newnode/demo",
+        },
+    })
+
+
+def main():
+    gen_cluster_demo()
+    gen_cluster_gpushare()
+    gen_app_simple()
+    gen_app_local()
+    gen_app_gpushare()
+    gen_app_scale()
+    gen_app_mixed()
+    gen_chart()
+    gen_newnode()
+    gen_configs()
+    print(f"example tree regenerated under {os.path.abspath(ROOT)}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,15 +11,17 @@ one encoded tensor state and one jitted scan.
 from __future__ import annotations
 
 import copy
+import functools
 import json
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
-from ..encoding.state import ClusterEncoder, ClusterMeta
+from ..encoding.state import ClusterEncoder, ClusterMeta, ScanState
 from ..models import expand
 from ..models.objects import (
     ANNO_GPU_ASSUME_TIME,
@@ -88,6 +90,45 @@ def _validate_extra_plugins(extra_plugins) -> None:
             raise ValueError(f'score plugin entries are ("score", fn, weight); got {entry!r}')
 
 
+def _rebuilt_counts(prep: "Prepared", chosen: np.ndarray):
+    """Host-side reconstruction of the ScanState count tensors the
+    megakernel tracks internally (port_used, dom_sel, dom_anti, dom_prefw)
+    from the final placements — the numpy mirror of ``kernels.bind_update``.
+    Keeps ``final_state`` fully populated after a fast-path run."""
+    ec = prep.ec_np
+    st0 = prep.st0
+    bound = chosen >= 0
+    us = prep.tmpl_ids[bound]
+    cs = chosen[bound].astype(np.int64)
+
+    port_used = np.array(st0.port_used, dtype=np.float32, copy=True)
+    ports = np.asarray(ec.ports)[us]  # [B, Hp]
+    pv = ports >= 0
+    if pv.any():
+        rows = np.repeat(cs, ports.shape[1])[pv.ravel()]
+        np.add.at(port_used, (rows, ports.ravel()[pv.ravel()]), 1.0)
+
+    dom_sel = np.array(st0.dom_sel, dtype=np.float32, copy=True)
+    matches = np.asarray(ec.matches_sel)[us].astype(np.float32)  # [B, A]
+    node_domain = np.asarray(ec.node_domain)
+    for tk in range(node_domain.shape[1]):
+        np.add.at(dom_sel, node_domain[cs, tk], matches)
+
+    dom_anti = np.array(st0.dom_anti, dtype=np.float32, copy=True)
+    anti_g_topo = np.asarray(ec.anti_g_topo)
+    anti_g = np.asarray(ec.anti_g)[us].astype(np.float32)
+    for g in range(anti_g_topo.shape[0]):
+        np.add.at(dom_anti[:, g], node_domain[cs, anti_g_topo[g]], anti_g[:, g])
+
+    dom_prefw = np.array(st0.dom_prefw, dtype=np.float32, copy=True)
+    prefg_topo = np.asarray(ec.prefg_topo)
+    prefg_w = np.asarray(ec.prefg_w)[us]
+    for g in range(prefg_topo.shape[0]):
+        np.add.at(dom_prefw[:, g], node_domain[cs, prefg_topo[g]], prefg_w[:, g])
+
+    return port_used, dom_sel, dom_anti, dom_prefw
+
+
 def _fast_output(
     chosen: np.ndarray,
     used_final: np.ndarray,
@@ -99,10 +140,11 @@ def _fast_output(
     prep: "Prepared",
 ):
     """Adapt the megakernel's outputs into the ScheduleOutput shape the
-    decode path consumes. Only reached when nothing is unscheduled, so the
-    dynamic failure details are zeros. NOTE: final_state.port_used and the
-    domain-count fields keep their initial values (the kernel tracks them
-    internally); no current consumer reads them from a finished run."""
+    decode path consumes. NOTE: final_state's count tensors (port_used,
+    dom_sel, dom_anti, dom_prefw) keep their initial values here — no
+    success-path consumer reads them; ``_fast_failure_details`` rebuilds
+    them host-side (``_rebuilt_counts``) on the failure path, where the
+    reason evaluation needs the complete carry."""
     from .scheduler import ScheduleOutput
 
     P = len(chosen)
@@ -121,6 +163,43 @@ def _fast_output(
             dev_free=dev_final.astype(np.float32),
         ),
     )
+
+
+@functools.partial(jax.jit, static_argnames=("feat",))
+def _failure_eval(ec, stat, st, us, feat):
+    """One compiled dispatch: pod_step over the batch of distinct failed
+    templates against the (final) carry."""
+    step = lambda u: kernels.pod_step(ec, stat, st, u, feat)
+    res = jax.vmap(step)(us)
+    return res.fail_counts, res.insufficient
+
+
+def _fast_failure_details(out, prep: "Prepared", failed_idx: np.ndarray):
+    """Per-pod failure attribution without re-scanning the whole stream:
+    evaluate ``pod_step`` once per distinct failed template against the
+    final carry. Exact when no bind landed after the first failure (the
+    caller checks) — the state a failed pod saw is then the final state,
+    since failed pods mutate nothing (simulator.go:333-342 deletes them)."""
+    from . import fastpath
+
+    port_used, dom_sel, dom_anti, dom_prefw = _rebuilt_counts(prep, np.asarray(out.chosen))
+    st = out.final_state._replace(
+        port_used=port_used, dom_sel=dom_sel, dom_anti=dom_anti, dom_prefw=dom_prefw
+    )
+    out = out._replace(final_state=st)
+    st = ScanState(*[jnp.asarray(a) for a in st])
+    stat = fastpath._precompute_jit(prep.ec)  # jit-cached for this ec
+    us = np.unique(prep.tmpl_ids[failed_idx])
+    fc_u, ins_u = _failure_eval(prep.ec, stat, st, jnp.asarray(us), prep.features)
+    fc_u, ins_u = np.asarray(fc_u), np.asarray(ins_u)
+    pos = {int(u): k for k, u in enumerate(us)}
+    fail_counts = np.array(out.fail_counts, copy=True)
+    insufficient = np.array(out.insufficient, copy=True)
+    for i in failed_idx:
+        k = pos[int(prep.tmpl_ids[i])]
+        fail_counts[i] = fc_u[k]
+        insufficient[i] = ins_u[k]
+    return out._replace(fail_counts=fail_counts, insufficient=insufficient)
 
 
 def _tmpl_hint(pod: Pod) -> Optional[tuple]:
@@ -328,13 +407,25 @@ def simulate(
 
             if fastpath.applicable(prep):
                 # Pallas megakernel fast path: identical placements, ~4×
-                # the XLA scan's step rate. Falls back below when pods fail
-                # (the full path produces the kube-style reason strings).
+                # the XLA scan's step rate.
                 f_chosen, f_used, sf, f_take, f_gpu, f_vg, f_dev = fastpath.schedule(
                     prep, tmpl_ids, pod_valid, forced
                 )
-                if not np.any((f_chosen < 0) & pod_valid & ~forced):
+                failed = (f_chosen < 0) & pod_valid & ~forced
+                if not failed.any():
                     out = _fast_output(f_chosen, f_used, sf, f_take, f_gpu, f_vg, f_dev, prep)
+                else:
+                    # Failure reasons without a second full scan: exact
+                    # whenever nothing bound after the first failure (the
+                    # state a failed pod saw is then the final carry —
+                    # failed pods mutate nothing). Otherwise fall through
+                    # to the XLA scan for exact mid-stream attribution.
+                    first_fail = int(np.argmax(failed))
+                    if not (f_chosen[first_fail + 1 :] >= 0).any():
+                        out = _fast_output(
+                            f_chosen, f_used, sf, f_take, f_gpu, f_vg, f_dev, prep
+                        )
+                        out = _fast_failure_details(out, prep, np.nonzero(failed)[0])
         if out is None:
             tmpl_p, valid_p, forced_p = pad_pod_stream(tmpl_ids, pod_valid, forced)
             out = schedule_pods(

@@ -132,16 +132,41 @@ def _response(result: SimulateResult) -> dict:
 
 
 class SimonServer:
-    def __init__(self, kubeconfig: str = "", master: str = "", base_cluster: Optional[ResourceTypes] = None):
+    def __init__(
+        self,
+        kubeconfig: str = "",
+        master: str = "",
+        base_cluster: Optional[ResourceTypes] = None,
+        snapshot_ttl_s: float = 30.0,
+    ):
         self.kubeconfig = kubeconfig
         self.master = master
         self.base_cluster = base_cluster
+        # live-cluster snapshots are cached between requests (the reference
+        # serves every request from its always-warm informer cache,
+        # pkg/server/server.go:97-137, instead of re-listing the cluster);
+        # snapshot_ttl_s bounds staleness, ≤0 disables caching
+        self.snapshot_ttl_s = snapshot_ttl_s
+        self._snapshot: Optional[ResourceTypes] = None
+        self._snapshot_at = 0.0
 
     def current_cluster(self) -> ResourceTypes:
         if self.base_cluster is not None:
             return self.base_cluster
         if self.kubeconfig:
-            return cluster_from_kubeconfig(self.kubeconfig, self.master)
+            import copy as _copy
+            import time as _time
+
+            now = _time.monotonic()
+            if self._snapshot is None or (
+                self.snapshot_ttl_s <= 0 or now - self._snapshot_at > self.snapshot_ttl_s
+            ):
+                self._snapshot = cluster_from_kubeconfig(self.kubeconfig, self.master)
+                self._snapshot_at = now
+            # hand each request its own copy: simulate() mutates pods/nodes
+            # in place (bind writes nodeName/phase/annotations), and the
+            # cached snapshot must stay pristine across requests
+            return _copy.deepcopy(self._snapshot)
         return ResourceTypes()
 
     # -- handlers -----------------------------------------------------------

@@ -1,0 +1,189 @@
+"""Live-cluster snapshot coverage with a stub `kubernetes` client module —
+the filtering rules of CreateClusterResourceFromClient
+(pkg/simulator/simulator.go:503-601) and the server's informer-style
+snapshot caching (pkg/server/server.go:97-137), testable without a real
+cluster or the kubernetes package."""
+
+import sys
+import types
+
+import pytest
+
+from opensim_tpu.models import ResourceTypes
+from opensim_tpu.models import fixtures as fx
+
+
+def _pod(name, phase="Running", owners=None, deleting=False, node=""):
+    d = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "100m"}}}]},
+        "status": {"phase": phase},
+    }
+    if node:
+        d["spec"]["nodeName"] = node
+    if owners:
+        d["metadata"]["ownerReferences"] = owners
+    if deleting:
+        d["metadata"]["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+    return d
+
+
+class _L:
+    def __init__(self, items):
+        self.items = items
+
+
+def _install_fake_kubernetes(monkeypatch, store, calls):
+    class CoreV1Api:
+        def list_node(self):
+            return _L(store.get("nodes", []))
+
+        def list_pod_for_all_namespaces(self, resource_version=None):
+            calls["resource_version"] = resource_version
+            return _L(store.get("pods", []))
+
+        def list_service_for_all_namespaces(self):
+            return _L(store.get("services", []))
+
+        def list_persistent_volume_claim_for_all_namespaces(self):
+            return _L(store.get("pvcs", []))
+
+        def list_config_map_for_all_namespaces(self):
+            return _L(store.get("config_maps", []))
+
+    class AppsV1Api:
+        def list_daemon_set_for_all_namespaces(self):
+            return _L(store.get("daemon_sets", []))
+
+    class PolicyV1Api:
+        def list_pod_disruption_budget_for_all_namespaces(self):
+            calls["policy_api"] = "v1"
+            return _L(store.get("pdbs", []))
+
+    class StorageV1Api:
+        def list_storage_class(self):
+            return _L(store.get("storage_classes", []))
+
+    class ApiClient:
+        def sanitize_for_serialization(self, obj):
+            return obj
+
+    client = types.ModuleType("kubernetes.client")
+    client.CoreV1Api = CoreV1Api
+    client.AppsV1Api = AppsV1Api
+    client.PolicyV1Api = PolicyV1Api
+    client.StorageV1Api = StorageV1Api
+    client.ApiClient = ApiClient
+
+    config = types.ModuleType("kubernetes.config")
+
+    def load_kube_config(config_file=None):
+        calls["kubeconfig"] = config_file
+
+    config.load_kube_config = load_kube_config
+
+    kubernetes = types.ModuleType("kubernetes")
+    kubernetes.client = client
+    kubernetes.config = config
+    monkeypatch.setitem(sys.modules, "kubernetes", kubernetes)
+    monkeypatch.setitem(sys.modules, "kubernetes.client", client)
+    monkeypatch.setitem(sys.modules, "kubernetes.config", config)
+
+
+def test_snapshot_filters_match_reference(monkeypatch):
+    """Running + Pending pods only; skip DaemonSet-owned and deleting pods;
+    pods listed with ResourceVersion=0 (simulator.go:524-540)."""
+    store = {
+        "nodes": [
+            {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n1"},
+             "status": {"allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}}},
+        ],
+        "pods": [
+            _pod("keep-running", "Running", node="n1"),
+            _pod("keep-pending", "Pending"),
+            _pod("skip-succeeded", "Succeeded"),
+            _pod("skip-failed", "Failed"),
+            _pod("skip-ds-owned", "Running",
+                 owners=[{"kind": "DaemonSet", "name": "agent", "controller": True}]),
+            _pod("keep-rs-owned", "Running",
+                 owners=[{"kind": "ReplicaSet", "name": "web-abc", "controller": True}]),
+            _pod("skip-deleting", "Running", deleting=True),
+        ],
+        "daemon_sets": [
+            {"apiVersion": "apps/v1", "kind": "DaemonSet",
+             "metadata": {"name": "agent", "namespace": "default"},
+             "spec": {"selector": {"matchLabels": {"a": "b"}},
+                      "template": {"metadata": {"labels": {"a": "b"}},
+                                   "spec": {"containers": [{"name": "c"}]}}}},
+        ],
+        "services": [{"kind": "Service", "metadata": {"name": "svc"}}],
+        "storage_classes": [{"kind": "StorageClass", "metadata": {"name": "open-local-lvm"}}],
+        "pvcs": [{"kind": "PersistentVolumeClaim", "metadata": {"name": "pvc-1"}}],
+        "config_maps": [{"kind": "ConfigMap", "metadata": {"name": "cm-1"}}],
+        "pdbs": [{"kind": "PodDisruptionBudget", "metadata": {"name": "pdb-1"}}],
+    }
+    calls = {}
+    _install_fake_kubernetes(monkeypatch, store, calls)
+    from opensim_tpu.server.snapshot import cluster_from_kubeconfig
+
+    rt = cluster_from_kubeconfig("/tmp/kubeconfig")
+    assert calls["kubeconfig"] == "/tmp/kubeconfig"
+    assert calls["resource_version"] == "0"
+    assert calls["policy_api"] == "v1"
+    assert [n.metadata.name for n in rt.nodes] == ["n1"]
+    assert sorted(p.metadata.name for p in rt.pods) == [
+        "keep-pending", "keep-rs-owned", "keep-running",
+    ]
+    assert rt.pods[0].phase in ("Running", "Pending")
+    assert [d.metadata.name for d in rt.daemon_sets] == ["agent"]
+    assert len(rt.services) == 1 and len(rt.storage_classes) == 1
+    assert len(rt.pvcs) == 1 and len(rt.config_maps) == 1 and len(rt.pdbs) == 1
+
+
+def test_snapshot_missing_client_raises(monkeypatch):
+    for mod in ("kubernetes", "kubernetes.client", "kubernetes.config"):
+        monkeypatch.delitem(sys.modules, mod, raising=False)
+    monkeypatch.setitem(sys.modules, "kubernetes", None)  # force ImportError
+    from opensim_tpu.server.snapshot import cluster_from_kubeconfig
+
+    with pytest.raises(RuntimeError, match="customConfig"):
+        cluster_from_kubeconfig("/tmp/kubeconfig")
+
+
+def test_server_caches_snapshot_between_requests(monkeypatch):
+    """The reference serves requests from its warm informer cache
+    (server.go:97-137); SimonServer caches the snapshot with a TTL instead
+    of re-listing the cluster per request."""
+    from opensim_tpu.server import rest
+
+    fetches = []
+
+    def fake_fetch(kubeconfig, master=None):
+        fetches.append(kubeconfig)
+        rt = ResourceTypes()
+        rt.nodes.append(fx.make_fake_node("n1", "8", "16Gi"))
+        return rt
+
+    monkeypatch.setattr(rest, "cluster_from_kubeconfig", fake_fetch)
+    srv = rest.SimonServer(kubeconfig="/tmp/kc", snapshot_ttl_s=3600.0)
+    a = srv.current_cluster()
+    b = srv.current_cluster()
+    # one cluster list serves both requests, but each request gets its OWN
+    # copy — simulate() mutates pods in place and must not taint the cache
+    assert fetches == ["/tmp/kc"]
+    assert a is not b
+    a.nodes[0].metadata.labels["tainted-by-request"] = "yes"
+    assert "tainted-by-request" not in srv.current_cluster().nodes[0].metadata.labels
+
+    # TTL expiry forces a refresh
+    srv._snapshot_at -= 7200.0
+    srv.current_cluster()
+    assert len(fetches) == 2
+
+    # ttl<=0 disables caching: every call re-lists
+    srv2 = rest.SimonServer(kubeconfig="/tmp/kc", snapshot_ttl_s=0.0)
+    srv2.current_cluster()
+    srv2.current_cluster()
+    assert len(fetches) == 4

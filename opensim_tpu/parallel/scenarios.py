@@ -123,8 +123,40 @@ def sweep(
         if pad:
             arrays = tuple(np.concatenate([a, a[-1:].repeat(pad, 0)]) for a in arrays)
         shard = NamedSharding(mesh, P(mesh.axis_names[0]))
-        arrays = tuple(jax.device_put(jnp.asarray(a), shard) for a in arrays)
-        out = _sweep_impl(ec, st0, jnp.asarray(tmpl_ids), *arrays, features=features, config=config)
+        if jax.process_count() > 1:
+            # DCN path: the mesh spans processes, so scenario shards must be
+            # assembled from each host's addressable slice (every host holds
+            # the same full mask arrays — the planner builds them
+            # deterministically) and the small per-scenario summaries are
+            # gathered back to every host afterwards.
+            arrays = tuple(
+                jax.make_array_from_callback(
+                    a.shape, shard, lambda idx, a=a: np.asarray(a)[idx]
+                )
+                for a in arrays
+            )
+            rep = NamedSharding(mesh, P())
+
+            def _replicate(a):
+                a = np.asarray(a)
+                return jax.make_array_from_callback(a.shape, rep, lambda idx, a=a: a[idx])
+
+            out = _sweep_impl(
+                type(ec)(*[_replicate(x) for x in ec]),
+                type(st0)(*[_replicate(x) for x in st0]),
+                _replicate(np.asarray(tmpl_ids)),
+                *arrays,
+                features=features,
+                config=config,
+            )
+            from jax.experimental import multihost_utils
+
+            out = multihost_utils.process_allgather(out, tiled=True)
+        else:
+            arrays = tuple(jax.device_put(jnp.asarray(a), shard) for a in arrays)
+            out = _sweep_impl(
+                ec, st0, jnp.asarray(tmpl_ids), *arrays, features=features, config=config
+            )
         out = jax.tree_util.tree_map(lambda a: a[:S], out)
     else:
         out = _sweep_impl(

@@ -45,13 +45,20 @@ def build_parser() -> argparse.ArgumentParser:
         ),
     )
 
-    apply_p = sub.add_parser("apply", parents=[backend_parent], help="run a capacity-planning simulation")
+    apply_p = sub.add_parser(
+        "apply", parents=[backend_parent], help="run a capacity-planning simulation",
+        description="run a capacity-planning simulation (the reference's `simon apply`)",
+    )
     apply_p.add_argument("-f", "--simon-config", required=True, help="path of simon config (Config CR yaml)")
     apply_p.add_argument(
         "-d", "--default-scheduler-config", default="", help="path of kube-scheduler config overrides"
     )
     apply_p.add_argument("-o", "--output-file", default="", help="redirect the report to a file")
     apply_p.add_argument("--use-greed", action="store_true", help="use greed algorithm to sort pods")
+    apply_p.add_argument(
+        "--enable-preemption", action="store_true",
+        help="let unschedulable high-priority pods evict lower-priority ones (beyond-reference)",
+    )
     apply_p.add_argument("-i", "--interactive", action="store_true", help="interactive add-node mode")
     apply_p.add_argument(
         "-e",
@@ -66,6 +73,7 @@ def build_parser() -> argparse.ArgumentParser:
         "defrag",
         parents=[backend_parent],
         help="evaluate node-drain what-ifs (the README's Pods Migration feature, batch-evaluated)",
+        description="evaluate node-drain what-ifs (Pods Migration), batch-evaluated as scenarios",
     )
     defrag_p.add_argument("-f", "--simon-config", required=True, help="path of simon config (Config CR yaml)")
     defrag_p.add_argument(
@@ -73,14 +81,20 @@ def build_parser() -> argparse.ArgumentParser:
     )
     defrag_p.add_argument("-o", "--output-file", default="", help="redirect the report to a file")
 
-    server_p = sub.add_parser("server", parents=[backend_parent], help="start the simon REST server")
+    server_p = sub.add_parser(
+        "server", parents=[backend_parent], help="start the simon REST server",
+        description="start the simon REST server (deploy-apps / scale-apps / healthz / metrics)",
+    )
     server_p.add_argument("--kubeconfig", default="", help="kubeconfig of the real cluster")
     server_p.add_argument("--master", default="", help="apiserver address override")
     server_p.add_argument("--port", type=int, default=8080, help="listen port")
 
-    sub.add_parser("version", help="print version")
+    sub.add_parser("version", help="print version", description="print version and commit id")
 
-    doc_p = sub.add_parser("gen-doc", help="generate markdown docs for the CLI")
+    doc_p = sub.add_parser(
+        "gen-doc", help="generate markdown docs for the CLI",
+        description="generate one markdown doc per subcommand plus an index",
+    )
     doc_p.add_argument("--output-dir", default="docs/commandline", help="where to write the docs")
     return parser
 
@@ -109,6 +123,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             default_scheduler_config=args.default_scheduler_config,
             output_file=args.output_file,
             use_greed=args.use_greed,
+            enable_preemption=args.enable_preemption,
             interactive=args.interactive,
             extended_resources=[r for r in args.extended_resources.split(",") if r],
             report_pods=args.report_pods,
@@ -189,15 +204,26 @@ def _select_backend(backend: str) -> None:
 
 
 def gen_doc(parser: argparse.ArgumentParser, output_dir: str) -> int:
-    """Markdown CLI docs (cmd/doc/generate_markdown.go)."""
+    """Markdown CLI docs — one file per subcommand plus a root index, the
+    same tree cobra/doc emits for the reference
+    (cmd/doc/generate_markdown.go:33 → docs/commandline/simon_apply.md …)."""
     os.makedirs(output_dir, exist_ok=True)
     sub_actions = [a for a in parser._actions if isinstance(a, argparse._SubParsersAction)]
+    commands = [(name, sp) for action in sub_actions for name, sp in action.choices.items()]
+    written = []
     with open(os.path.join(output_dir, "simon.md"), "w") as f:
-        f.write(f"# simon\n\n{parser.description}\n\n## Commands\n\n")
-        for action in sub_actions:
-            for name, sp in action.choices.items():
-                f.write(f"### simon {name}\n\n{sp.description or sp.prog}\n\n```\n{sp.format_help()}```\n\n")
-    print(f"docs written to {output_dir}/simon.md")
+        f.write(f"# simon\n\n{parser.description}\n\n```\n{parser.format_help()}```\n\n")
+        f.write("## Commands\n\n")
+        for name, sp in commands:
+            f.write(f"- [simon {name}](simon_{name.replace('-', '_')}.md) — {sp.description or ''}\n")
+    written.append("simon.md")
+    for name, sp in commands:
+        fname = f"simon_{name.replace('-', '_')}.md"
+        with open(os.path.join(output_dir, fname), "w") as f:
+            f.write(f"# simon {name}\n\n{sp.description or sp.prog}\n\n")
+            f.write(f"```\n{sp.format_help()}```\n\n[simon](simon.md)\n")
+        written.append(fname)
+    print(f"docs written to {output_dir}: {', '.join(written)}")
     return 0
 
 

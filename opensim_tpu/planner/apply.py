@@ -68,6 +68,7 @@ class Options:
     default_scheduler_config: str = ""
     output_file: str = ""
     use_greed: bool = False
+    enable_preemption: bool = False
     interactive: bool = False
     extended_resources: List[str] = field(default_factory=list)
     report_pods: bool = False  # include the per-node Pod Info table
@@ -210,12 +211,21 @@ class Applier:
         ok = self._feasible_counts(prep, n_real, coarse)
         feasible_ks = [k for k, good in zip(coarse, ok) if good]
         if not feasible_ks:
+            # non-monotone corner (DaemonSet load × occupancy caps): probe the
+            # remaining counts in ascending chunks and stop at the first chunk
+            # holding a feasible point — bounds the worst case at one extra
+            # chunk instead of a full 0..kmax sweep
             rest = [k for k in range(kmax + 1) if k not in set(coarse)]
             if not rest:
                 return None
-            ok = self._feasible_counts(prep, n_real, rest)
-            feasible_rest = [k for k, good in zip(rest, ok) if good]
-            return min(feasible_rest) if feasible_rest else None
+            chunk = 32
+            for lo in range(0, len(rest), chunk):
+                batch = rest[lo : lo + chunk]
+                ok = self._feasible_counts(prep, n_real, batch)
+                feasible_rest = [k for k, good in zip(batch, ok) if good]
+                if feasible_rest:
+                    return min(feasible_rest)
+            return None
         hi = min(feasible_ks)
         lo = max([k for k in coarse if k < hi], default=0)
         if hi == 0 or hi == lo + 1:
@@ -283,17 +293,24 @@ class Applier:
 
     def _run_inner(self) -> int:
         from ..parallel.multihost import initialize
+        from ..utils.progress import Spinner
 
         initialize()  # no-op unless JAX_COORDINATOR is set (DCN scale-out)
-        cluster = self.load_cluster()
-        apps = self.load_apps()
+        with Spinner("load cluster"):
+            cluster = self.load_cluster()
+        with Spinner(f"render {len(self.config.app_list)} app(s)"):
+            apps = self.load_apps()
         template = self.load_new_node()
 
         if self.opts.interactive:
             return self._run_interactive(cluster, apps, template)
 
         # auto mode: batched capacity search
-        result = simulate(cluster, apps, use_greed=self.opts.use_greed, sched_config=self.sched_config)
+        with Spinner("schedule pods"):
+            result = simulate(
+                cluster, apps, use_greed=self.opts.use_greed, sched_config=self.sched_config,
+                enable_preemption=self.opts.enable_preemption,
+            )
         n_new = 0
         if result.unscheduled_pods or not satisfy_resource_setting(result)[0]:
             if template is None:
@@ -301,19 +318,22 @@ class Applier:
                 for i, up in enumerate(result.unscheduled_pods):
                     print(f"{i:4d} {up.pod.metadata.namespace}/{up.pod.metadata.name}: {up.reason}", file=self.out)
                 return 1
-            n_new = self.find_min_nodes_batched(cluster, apps, template)
+            with Spinner(f"capacity sweep (0..{self.opts.max_new_nodes} new nodes)"):
+                n_new = self.find_min_nodes_batched(cluster, apps, template)
             if n_new is None:
                 print(
                     f"Simulation failed: still unschedulable after adding {self.opts.max_new_nodes} node(s)",
                     file=self.out,
                 )
                 return 1
-            result = simulate(
-                self._cluster_with_new_nodes(cluster, template, n_new),
-                apps,
-                use_greed=self.opts.use_greed,
-                sched_config=self.sched_config,
-            )
+            with Spinner(f"re-simulate with {n_new} new node(s)"):
+                result = simulate(
+                    self._cluster_with_new_nodes(cluster, template, n_new),
+                    apps,
+                    use_greed=self.opts.use_greed,
+                    sched_config=self.sched_config,
+                    enable_preemption=self.opts.enable_preemption,
+                )
         print("Simulation success!", file=self.out)
         if n_new:
             print(f"(added {n_new} new node(s))", file=self.out)
@@ -328,15 +348,19 @@ class Applier:
 
     def _run_interactive(self, cluster, apps, template) -> int:
         """The reference's prompt loop (apply.go:203-259)."""
+        from ..utils.progress import Spinner
+
         n_new = 0
         result = None
         while True:
-            result = simulate(
-                self._cluster_with_new_nodes(cluster, template, n_new) if template else cluster,
-                apps,
-                use_greed=self.opts.use_greed,
-                sched_config=self.sched_config,
-            )
+            with Spinner(f"schedule pods ({n_new} new node(s))"):
+                result = simulate(
+                    self._cluster_with_new_nodes(cluster, template, n_new) if template else cluster,
+                    apps,
+                    use_greed=self.opts.use_greed,
+                    sched_config=self.sched_config,
+                    enable_preemption=self.opts.enable_preemption,
+                )
             if result.unscheduled_pods:
                 print(
                     f"there are still {len(result.unscheduled_pods)} pod(s) that can not be "

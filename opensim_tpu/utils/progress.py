@@ -1,0 +1,81 @@
+"""Terminal progress — the pterm parity layer.
+
+The reference animates a per-pod progress bar while its scheduler goroutine
+works through the queue (``pkg/simulator/simulator.go:311-321``) and shows
+spinners around cluster snapshots (``:506-509``). Here the whole bind scan is
+ONE fused device op, so per-pod increments don't exist; instead each host
+phase gets a live spinner with an elapsed-time readout and a final tally
+(``✓ schedule 50000 pods (2.4s)``), and host-side loops can render a plain
+bar. Output is TTY-gated (the ``DisablePTerm`` equivalent) and goes to
+stderr so piped reports stay clean; ``OPENSIM_NO_PROGRESS=1`` force-disables.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Optional, TextIO
+
+_FRAMES = "⠋⠙⠹⠸⠼⠴⠦⠧⠇⠏"
+
+
+def enabled_by_default(stream: TextIO) -> bool:
+    if os.environ.get("OPENSIM_NO_PROGRESS"):
+        return False
+    try:
+        return bool(stream.isatty())
+    except (AttributeError, ValueError):
+        return False
+
+
+class Spinner:
+    """Context manager: ``with Spinner("schedule 50000 pods"): ...`` animates
+    while the body runs and leaves one ``✓ label (1.2s)`` line behind."""
+
+    def __init__(self, label: str, stream: Optional[TextIO] = None, enabled: Optional[bool] = None):
+        self.label = label
+        self.stream = stream if stream is not None else sys.stderr
+        self.enabled = enabled_by_default(self.stream) if enabled is None else enabled
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = 0.0
+
+    def __enter__(self) -> "Spinner":
+        self._t0 = time.monotonic()
+        if self.enabled:
+            self._thread = threading.Thread(target=self._spin, daemon=True)
+            self._thread.start()
+        return self
+
+    def _spin(self) -> None:
+        i = 0
+        while not self._stop.wait(0.1):
+            dt = time.monotonic() - self._t0
+            self.stream.write(f"\r{_FRAMES[i % len(_FRAMES)]} {self.label}… {dt:.1f}s ")
+            self.stream.flush()
+            i += 1
+
+    def __exit__(self, exc_type, *_exc) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+        if self.enabled:
+            dt = time.monotonic() - self._t0
+            mark = "✓" if exc_type is None else "✗"
+            self.stream.write(f"\r{mark} {self.label} ({dt:.1f}s)\n")
+            self.stream.flush()
+
+
+def bar(done: int, total: int, label: str, stream: Optional[TextIO] = None, width: int = 24) -> None:
+    """One-line in-place progress bar for host-side loops."""
+    stream = stream if stream is not None else sys.stderr
+    if not enabled_by_default(stream):
+        return
+    total = max(total, 1)
+    filled = int(width * min(done, total) / total)
+    stream.write(f"\r{label} [{'█' * filled}{'░' * (width - filled)}] {done}/{total}")
+    if done >= total:
+        stream.write("\n")
+    stream.flush()

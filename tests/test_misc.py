@@ -104,8 +104,13 @@ def test_gen_doc(tmp_path):
     out_dir = tmp_path / "docs"
     assert gen_doc(build_parser(), str(out_dir)) == 0
     text = (out_dir / "simon.md").read_text()
+    # one markdown per subcommand, like cobra/doc's GenMarkdownTree
+    # (cmd/doc/generate_markdown.go:33)
     for cmd in ("apply", "defrag", "server", "version", "gen-doc"):
         assert f"simon {cmd}" in text
+        per_cmd = (out_dir / f"simon_{cmd.replace('-', '_')}.md").read_text()
+        assert f"# simon {cmd}" in per_cmd
+    assert "--use-greed" in (out_dir / "simon_apply.md").read_text()
 
 
 def test_defrag_cli(tmp_path):

@@ -45,3 +45,39 @@ def test_checkpoint_roundtrip(tmp_path):
     out1 = schedule_pods(ecd, std, tmpl, valid, forced)
     out2 = schedule_pods(ecd2, std2, tmpl, valid, forced)
     np.testing.assert_array_equal(np.asarray(out1.chosen), np.asarray(out2.chosen))
+
+
+def test_progress_spinner_and_bar(monkeypatch):
+    """pterm-parity progress (simulator.go:311-321): spinner leaves a final
+    tally line; bar renders in place; both stay silent when disabled."""
+    import io
+    import time as _time
+
+    from opensim_tpu.utils import progress
+
+    monkeypatch.delenv("OPENSIM_NO_PROGRESS", raising=False)
+
+    buf = io.StringIO()
+    with progress.Spinner("work", stream=buf, enabled=True):
+        _time.sleep(0.25)
+    text = buf.getvalue()
+    assert "work" in text and "✓" in text
+
+    silent = io.StringIO()
+    with progress.Spinner("quiet", stream=silent, enabled=False):
+        pass
+    assert silent.getvalue() == ""
+
+    class Tty(io.StringIO):
+        def isatty(self):
+            return True
+
+    bar_buf = Tty()
+    progress.bar(2, 4, "pods", stream=bar_buf)
+    progress.bar(4, 4, "pods", stream=bar_buf)
+    out = bar_buf.getvalue()
+    assert "2/4" in out and "4/4" in out and out.endswith("\n")
+
+    nontty = io.StringIO()
+    progress.bar(1, 2, "pods", stream=nontty)
+    assert nontty.getvalue() == ""

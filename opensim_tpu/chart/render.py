@@ -101,7 +101,16 @@ def _render_chart_dir(release_name: str, path: str) -> List[str]:
             if not fname.endswith((".yaml", ".yml", ".tpl")):
                 continue
             with open(os.path.join(root, fname)) as f:
-                rendered = render_template(f.read(), ctx)
+                text = f.read()
+            try:
+                rendered = render_template(text, ctx)
+            except ChartError as e:
+                # fail the whole chart with the offending template named,
+                # before any partial output escapes
+                raise ChartError(
+                    f"{chart_meta.get('name', path)}/templates/{fname}: {e}; "
+                    "install a `helm` binary on PATH for full template support"
+                ) from None
             docs.extend(_split_docs(rendered))
     return docs
 
@@ -165,6 +174,10 @@ def _render_block(tokens, pos, ctx, stop) -> tuple:
         word = action.split()[0] if action.split() else ""
         if word in stop:
             return "".join(parts), i
+        if word in ("define", "template", "include", "with", "block"):
+            # recognized Go-template constructs outside the supported subset:
+            # fail loudly rather than silently rendering an empty string
+            raise ChartError(f"unsupported template construct: {{{{ {word} }}}}")
         if word == "if":
             cond = _eval_expr(action[2:].strip(), ctx)
             body, j = _render_block(tokens, i + 1, ctx, stop={"else", "end"})
@@ -236,14 +249,16 @@ def _eval_expr(expr: str, ctx: dict) -> Any:
 
 def _eval_atom(atom: str, ctx: dict) -> Any:
     atom = atom.strip()
+    if atom.startswith('"') and atom.endswith('"'):
+        return atom[1:-1]
     parts = atom.split()
     if len(parts) > 1:
         fn = parts[0]
         if fn in ("int", "quote", "default", "toString", "upper", "lower", "not", "toYaml"):
             args = [_eval_atom(a, ctx) for a in parts[1:]]
             return _apply_fn(fn, args)
-    if atom.startswith('"') and atom.endswith('"'):
-        return atom[1:-1]
+        # a call to anything else would silently render as empty — refuse
+        raise ChartError(f"unsupported template function: {fn}")
     if re.fullmatch(r"-?\d+", atom):
         return int(atom)
     if re.fullmatch(r"-?\d+\.\d+", atom):

@@ -1,0 +1,155 @@
+"""Opt-in preemption — the PostFilter the reference registers but never
+exercises.
+
+The reference's scheduler profile includes ``DefaultPreemption``
+(vendored ``algorithmprovider/registry.go:104``), but its driver deletes every
+unschedulable pod before a retry could run the nominated placement
+(``pkg/simulator/simulator.go:333-342``), so the PostFilter is vacuous there
+(PARITY.md, divergence 6). This module implements the intent as a
+what-if-capable pass: after the bind scan, each unschedulable pod with a
+positive ``spec.priority`` searches nodes where evicting strictly
+lower-priority pods frees enough resources, mirroring the shape of
+``dryRunPreemption`` → ``SelectVictimsOnNode`` → ``pickOneNodeForPreemption``
+(vendored ``defaultpreemption/default_preemption.go``).
+
+Scope (documented simplifications):
+- victims are selected ascending by priority until the preemptor's resource
+  request fits (no PDB accounting — the simulator has no eviction API);
+- candidate nodes are ranked by (fewest victims, lowest summed victim
+  priority, lowest node index) — a deterministic stand-in for
+  ``pickOneNodeForPreemption``'s tie-break ladder;
+- eligibility uses the static filters (unschedulable/taints/affinity/
+  nodeName) plus resource fit; feature filters that depend on *other* pods
+  (anti-affinity, spread) are re-checked conservatively by requiring the
+  preemptor to have none of those constraints when they are active;
+- victims are restricted to plain resource consumers: pods holding GPU
+  devices, host ports, or local storage are skipped (their release is not
+  re-packed), as are pods matched by any inter-pod/spread selector (another
+  placement may depend on them as an affinity anchor or domain count);
+- force-bound (pre-existing) pods are never victims.
+
+Off by default: ``simulate(..., enable_preemption=True)`` or
+``simon apply --enable-preemption``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from ..models import selectors
+from ..models.objects import Node, Pod
+
+
+def _static_ok(pod: Pod, node: Node) -> bool:
+    if pod.spec.node_name and pod.spec.node_name != node.metadata.name:
+        return False
+    if node.unschedulable:
+        return False
+    if not selectors.pod_matches_node_selector_and_affinity(pod, node):
+        return False
+    taints = [t for t in node.taints if t.effect in ("NoSchedule", "NoExecute")]
+    return selectors.find_untolerated_taint(taints, pod.spec.tolerations) is None
+
+
+def preempt_pass(
+    prep,
+    chosen: np.ndarray,
+    nodes: List[Node],
+    used: np.ndarray,
+    alloc: np.ndarray,
+) -> Tuple[np.ndarray, Dict[int, int]]:
+    """Attempt preemption for every unscheduled, positive-priority pod in
+    stream order. Returns the updated ``chosen`` and a map of
+    victim-stream-index → preemptor-stream-index. ``used``/``alloc`` are the
+    encoded ``[N, R]`` resource tensors (mutated in place on success)."""
+    ec = prep.ec_np
+    tmpl = prep.tmpl_ids
+    forced = prep.forced
+    ordered = prep.ordered
+    req = np.asarray(ec.req)  # [U, R]
+    prio = np.array([p.spec.priority for p in ordered], dtype=np.int64)
+    n_real = len(nodes)
+    victims_of: Dict[int, int] = {}
+
+    # pods with inter-pod/spread constraints interact with evictions in ways
+    # this pass does not model — skip preemption for those preemptors
+    at_sel = np.asarray(ec.at_sel)
+    an_sel = np.asarray(ec.an_sel)
+    spr_topo = np.asarray(ec.spr_topo)
+    spr_hard = np.asarray(ec.spr_hard)
+    gpu_mem = np.asarray(ec.gpu_mem)
+    lvm_req = np.asarray(ec.lvm_req)
+    dev_req = np.asarray(ec.dev_req)
+    ports = np.asarray(ec.ports)
+
+    def constrained(u: int) -> bool:
+        # constraints whose post-eviction state this pass does not model:
+        # inter-pod terms, hard spread, host ports, GPU devices, local storage
+        return bool(
+            (at_sel[u] >= 0).any()
+            or (an_sel[u] >= 0).any()
+            or ((spr_topo[u] >= 0) & spr_hard[u]).any()
+            or (ports[u] >= 0).any()
+            or gpu_mem[u] > 0
+            or lvm_req[u] > 0
+            or (dev_req[u] > 0).any()
+        )
+
+    matches_sel = np.asarray(ec.matches_sel)
+    sel_features = bool(prep.features.sel_counts)
+
+    def victim_ok(u: int) -> bool:
+        # only plain resource consumers release cleanly: no device/port/
+        # storage holdings, and — when inter-pod/spread constraints exist
+        # anywhere in the workload — no selector matches this pod (another
+        # placement may depend on it as an anchor or domain count)
+        if gpu_mem[u] > 0 or lvm_req[u] > 0 or (dev_req[u] > 0).any() or (ports[u] >= 0).any():
+            return False
+        return not (sel_features and matches_sel[u].any())
+
+    chosen = chosen.copy()
+    for i in range(len(ordered)):
+        if chosen[i] >= 0 or forced[i] or prio[i] <= 0:
+            continue
+        u = int(tmpl[i])
+        if constrained(u):
+            continue
+        best = None  # (n_victims, sum_prio, node, victim_indices)
+        for n in range(n_real):
+            if not _static_ok(ordered[i], nodes[n]):
+                continue
+            cand = [
+                j
+                for j in range(len(ordered))
+                if chosen[j] == n
+                and not forced[j]
+                and prio[j] < prio[i]
+                and j not in victims_of
+                and victim_ok(int(tmpl[j]))
+            ]
+            cand.sort(key=lambda j: (prio[j], j))
+            free = alloc[n] - used[n]
+            taken: List[int] = []
+            freed = np.zeros_like(free)
+            for j in cand:
+                if np.all(req[u] <= free + freed):
+                    break
+                freed = freed + req[int(tmpl[j])]
+                taken.append(j)
+            if not np.all(req[u] <= free + freed):
+                continue  # even evicting every candidate is not enough
+            key = (len(taken), int(sum(prio[j] for j in taken)), n)
+            if best is None or key < best[:3]:
+                best = (*key, taken)
+        if best is None:
+            continue
+        _, _, n, taken = best
+        for j in taken:
+            victims_of[j] = i
+            used[n] -= req[int(tmpl[j])]
+            chosen[j] = -1
+        used[n] += req[u]
+        chosen[i] = n
+    return chosen, victims_of

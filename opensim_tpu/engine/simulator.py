@@ -377,6 +377,7 @@ def simulate(
     sched_config=None,
     patch_pods_fn=None,
     extra_plugins: tuple = (),
+    enable_preemption: bool = False,
 ) -> SimulateResult:
     """One full simulation: cluster pods then apps in order. `sched_config`
     is an optional SchedulerConfig (the --default-scheduler-config merge);
@@ -446,6 +447,17 @@ def simulate(
     gpu_take = np.asarray(out.gpu_take)
     static_fail = np.asarray(out.static_fail)
 
+    victims_of: Dict[int, int] = {}
+    if enable_preemption and (chosen[~forced] < 0).any():
+        from . import preemption
+
+        used = np.array(np.asarray(out.final_state.used), copy=True)
+        chosen, victims_of = preemption.preempt_pass(
+            prep, chosen, cluster.nodes, used, np.asarray(prep.ec_np.alloc)
+        )
+        if victims_of:
+            out = out._replace(final_state=out.final_state._replace(used=used))
+
     node_pods: Dict[str, List[Pod]] = {n.metadata.name: [] for n in cluster.nodes}
     unscheduled: List[UnscheduledPod] = []
     n_nodes = meta.n_real_nodes
@@ -472,6 +484,15 @@ def simulate(
                 # timestamp in nanoseconds
                 pod.metadata.annotations[ANNO_GPU_ASSUME_TIME] = str(time.time_ns())
             pod_lists[c].append(pod)
+        elif i in victims_of:
+            preemptor = ordered[victims_of[i]]
+            unscheduled.append(
+                UnscheduledPod(
+                    pod,
+                    "preempted by higher-priority pod "
+                    f"{preemptor.metadata.namespace}/{preemptor.metadata.name}",
+                )
+            )
         else:
             unscheduled.append(
                 UnscheduledPod(

@@ -116,6 +116,13 @@ def with_host_ports(ports: List[int]) -> Option:
     return apply
 
 
+def with_priority(priority: int) -> Option:
+    def apply(d: dict) -> None:
+        _pod_spec(d)["priority"] = int(priority)
+
+    return apply
+
+
 def with_host_port_specs(specs: List[dict]) -> Option:
     """Full container-port dicts (hostPort/protocol/hostIP)."""
 

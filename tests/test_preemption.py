@@ -1,0 +1,76 @@
+"""Opt-in preemption pass — the DefaultPreemption PostFilter the reference
+registers but can never exercise (its driver deletes unschedulable pods,
+simulator.go:333-342). See opensim_tpu/engine/preemption.py."""
+
+from opensim_tpu.engine.simulator import AppResource, simulate
+from opensim_tpu.models import ResourceTypes
+from opensim_tpu.models import fixtures as fx
+
+
+def _cluster(n=2, cpu="4", mem="8Gi"):
+    rt = ResourceTypes()
+    for i in range(n):
+        rt.nodes.append(fx.make_fake_node(f"n{i}", cpu, mem))
+    return rt
+
+
+def test_high_priority_pod_lands_via_eviction():
+    cluster = _cluster(n=1)
+    app = ResourceTypes()
+    # two low-priority pods fill the node; the late high-priority pod evicts one
+    app.pods.append(fx.make_fake_pod("low-a", "2", "2Gi", fx.with_priority(10)))
+    app.pods.append(fx.make_fake_pod("low-b", "2", "2Gi", fx.with_priority(20)))
+    app.pods.append(fx.make_fake_pod("vip", "2", "2Gi", fx.with_priority(1000)))
+
+    res_off = simulate(cluster, [AppResource("a", app)])
+    assert {u.pod.metadata.name for u in res_off.unscheduled_pods} == {"vip"}
+
+    res_on = simulate(cluster, [AppResource("a", app)], enable_preemption=True)
+    placed = {p.metadata.name for ns in res_on.node_status for p in ns.pods}
+    assert "vip" in placed
+    # the LOWEST-priority victim is chosen
+    assert {u.pod.metadata.name for u in res_on.unscheduled_pods} == {"low-a"}
+    assert "preempted by higher-priority pod" in res_on.unscheduled_pods[0].reason
+    assert "vip" in res_on.unscheduled_pods[0].reason
+
+
+def test_preemption_respects_priority_order_and_caps():
+    cluster = _cluster(n=1)
+    app = ResourceTypes()
+    # equal-priority pod cannot preempt (victims must be strictly lower)
+    app.pods.append(fx.make_fake_pod("peer-a", "3", "2Gi", fx.with_priority(50)))
+    app.pods.append(fx.make_fake_pod("peer-b", "3", "2Gi", fx.with_priority(50)))
+    res = simulate(cluster, [AppResource("a", app)], enable_preemption=True)
+    assert len(res.unscheduled_pods) == 1  # no eviction among equals
+
+    # zero-priority unschedulable pods never preempt
+    app2 = ResourceTypes()
+    app2.pods.append(fx.make_fake_pod("filler", "3", "2Gi", fx.with_priority(5)))
+    app2.pods.append(fx.make_fake_pod("plain", "3", "2Gi"))
+    res2 = simulate(cluster, [AppResource("a", app2)], enable_preemption=True)
+    assert {u.pod.metadata.name for u in res2.unscheduled_pods} == {"plain"}
+
+
+def test_preemption_takes_lowest_priority_victims_first():
+    cluster = _cluster(n=1, cpu="6")
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("low-a", "2", "1Gi", fx.with_priority(10)))
+    app.pods.append(fx.make_fake_pod("low-b", "2", "1Gi", fx.with_priority(20)))
+    app.pods.append(fx.make_fake_pod("mid", "2", "1Gi", fx.with_priority(50)))
+    app.pods.append(fx.make_fake_pod("vip", "4", "2Gi", fx.with_priority(100)))
+    res = simulate(cluster, [AppResource("a", app)], enable_preemption=True)
+    placed = {p.metadata.name for ns in res.node_status for p in ns.pods}
+    # vip frees 4 cpu by evicting the two LOWEST-priority pods; mid survives
+    assert "vip" in placed and "mid" in placed
+    assert {u.pod.metadata.name for u in res.unscheduled_pods} == {"low-a", "low-b"}
+
+
+def test_forced_pods_are_never_victims():
+    cluster = _cluster(n=1)
+    cluster.pods.append(fx.make_fake_pod("resident", "3", "4Gi", fx.with_priority(1), fx.with_node_name("n0")))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("vip", "3", "4Gi", fx.with_priority(100)))
+    res = simulate(cluster, [AppResource("a", app)], enable_preemption=True)
+    # the pre-bound resident stays; vip remains unscheduled with a kube reason
+    assert {u.pod.metadata.name for u in res.unscheduled_pods} == {"vip"}
+    assert "Insufficient" in res.unscheduled_pods[0].reason

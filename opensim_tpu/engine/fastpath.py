@@ -64,7 +64,10 @@ def applicable(prep, config=None) -> bool:
     U = int(ec.req.shape[0])
     A = int(ec.matches_sel.shape[1])
     R = int(ec.alloc.shape[1])
-    if R > 8 or U > 512 or A > 64:
+    # beyond 512 templates the kernel switches to big-U mode (template
+    # tables in HBM, one DMA per step — see use_big_u/run_fast_scan);
+    # 2048 bounds the SMEM scalar tables
+    if R > 8 or U > 2048 or A > 64:
         return False
     vocab = prep.meta.vocab
     topo_keys = vocab.topo_keys.items()
@@ -106,12 +109,23 @@ def applicable(prep, config=None) -> bool:
     Vg_pad = _pad8_static(int(ec.node_vg_cap.shape[1]))
     Dv_pad = _pad8_static(int(ec.node_dev_cap.shape[1]))
     # local buffers: VG cap/init/out/scratch + device cap/init/out/scratch
-    # + two media one-hot row blocks; ports [Hp, N] ×2; na/tt [U, N] each
-    local_rows = 4 * Vg_pad + 6 * Dv_pad + 2 * 64 + 2 * U
-    vmem = ((3 * U + 4 * R + A + 2 * G + 3 * Gd_pad + local_rows + 4) * N + (2 * N + A + 2 * G) * Z) * 4
+    # + two media one-hot row blocks; ports [Hp, N] ×2; na/tt [U, N] each.
+    # In big-U mode the U-dimensioned tables live in HBM, so U drops out.
+    U_resident = 0 if use_big_u(U) else U
+    local_rows = 4 * Vg_pad + 6 * Dv_pad + 2 * 64 + 2 * U_resident
+    vmem = (
+        (3 * U_resident + 4 * R + A + 2 * G + 3 * Gd_pad + local_rows + 4) * N
+        + (2 * N + A + 2 * G) * Z
+    ) * 4
     if vmem > _VMEM_BUDGET:
         return False
     return True
+
+
+def use_big_u(U: int) -> bool:
+    """Template tables move to HBM (per-step DMA) beyond this VMEM-resident
+    cap; below it the fully-resident kernel is faster."""
+    return U > 512
 
 
 _precompute_jit = jax.jit(kernels.precompute_static)
@@ -406,6 +420,7 @@ def sweep(prep, node_valid_masks, pod_valid_masks, forced_masks, interpret: Opti
                 has_na=bool(prep.features.pref_node_affinity),
                 has_tt=bool(prep.features.prefer_taints),
                 interpret=interpret,
+                big_u=use_big_u(fi.static_pass.shape[0]),
             )
         )
 
@@ -450,6 +465,7 @@ def schedule(prep, tmpl_ids, pod_valid, forced, interpret: Optional[bool] = None
         has_na=bool(prep.features.pref_node_affinity),
         has_tt=bool(prep.features.prefer_taints),
         interpret=interpret,
+        big_u=use_big_u(fi.static_pass.shape[0]),
     )
     Gd = int(prep.st0.gpu_free.shape[1])
     Vg = int(prep.st0.vg_free.shape[1])

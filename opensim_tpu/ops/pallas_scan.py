@@ -13,7 +13,10 @@ affinity, GPU-share devices, open-local storage, host ports, preferred node
 affinity and PreferNoSchedule scoring — bounded by table-size caps and at
 most two topology keys (hostname + one zone-like key); `engine/fastpath.py`
 gates applicability and guarantees identical placements to the XLA scan
-(tests + randomized differential fuzzing assert equality). The kernel is
+(tests + randomized differential fuzzing assert equality). Past 512
+templates the kernel switches to big-U mode: the [U, N]/[X, U] template
+tables stay in HBM and each pod step DMAs its row/column into VMEM scratch,
+so VMEM no longer scales with U (cap 2048, bounded by SMEM scalars). The kernel is
 generated per feature-flag combination so absent features cost nothing, and
 node validity is a runtime row so scenario sweeps re-dispatch with nothing
 but a new mask and spread-weight table.
@@ -127,6 +130,7 @@ def _make_kernel(
     n_vg: int,
     n_dev: int,
     n_dvol: int,
+    big_u: bool = False,
 ):
     def kernel(
         # SMEM streams + tables
@@ -151,6 +155,8 @@ def _make_kernel(
         used_ref, node_cnt_ref, zone_cnt_ref,
         anti_node_ref, anti_zone_ref, prefw_node_ref, prefw_zone_ref,
         gpu_free_ref, vg_free_ref, dev_free_ref, port_used_ref,
+        # big-U mode appends per-step row/column scratches + DMA semaphores
+        *u_scratch,
     ):
         R, N = alloc_ref.shape
         U = static_ref.shape[0]
@@ -204,7 +210,45 @@ def _make_kernel(
 
         def body(i, _):
             u = tmpl_ref[i]
-            static_row = static_ref[pl.ds(u, 1), :]  # [1, N] (validity applied separately)
+            if big_u:
+                # template tables live in HBM (ANY space): DMA this step's
+                # row (for [U, N] tables) / column (for [X, U] tables) into
+                # VMEM scratch — all copies in flight together, one wait.
+                # VMEM stays independent of U; only SMEM scalars scale.
+                sems = u_scratch[-1]
+                bufs = list(u_scratch[:-1])
+                dma_state = {"k": 0}
+                copies = []
+
+                def _dma(ref, col):
+                    k = dma_state["k"]
+                    dma_state["k"] = k + 1
+                    scratch = bufs[k]
+                    src = ref.at[:, pl.ds(u, 1)] if col else ref.at[pl.ds(u, 1)]
+                    cp = pltpu.make_async_copy(src, scratch, sems.at[k])
+                    cp.start()
+                    copies.append(cp)
+                    return scratch
+
+                s_static = _dma(static_ref, False)
+                s_aff = _dma(affm_ref, False)
+                s_share = _dma(shraw_ref, False)
+                s_match = _dma(matches_ref, True)
+                s_na = _dma(na_ref, False) if has_na else None
+                s_tt = _dma(tt_ref, False) if has_tt else None
+                if has_ports:
+                    s_port = _dma(port_hu_ref, True)
+                    s_portc = _dma(port_conf_hu_ref, True)
+                if has_interpod:
+                    s_antig = _dma(antig_ref, True)
+                    s_gmatch = _dma(gmatch_ref, True)
+                    s_prefg = _dma(prefg_ref, True)
+                    s_pmatch = _dma(pmatch_ref, True)
+                for cp in copies:
+                    cp.wait()
+                static_row = s_static[:]
+            else:
+                static_row = static_ref[pl.ds(u, 1), :]  # [1, N] (validity applied separately)
             for d in range(n_gpu):  # SMEM outputs have no default value
                 gpu_take_ref[i, d] = jnp.float32(0.0)
 
@@ -220,11 +264,15 @@ def _make_kernel(
 
             if has_ports:
                 # NodePorts: any CONFLICTING port already used on the node
-                # (wildcard-expanded template rows via one-hot matvec)
-                onehot_u_p = (iota_u == u).astype(jnp.float32)
-                my_ports = jnp.dot(
-                    port_conf_hu_ref[:], onehot_u_p, preferred_element_type=jnp.float32
-                )  # [Hp, 1]
+                # (wildcard-expanded template rows via one-hot matvec, or the
+                # DMA'd column in big-U mode)
+                if big_u:
+                    my_ports = s_portc[:]  # [Hp, 1]
+                else:
+                    onehot_u_p = (iota_u == u).astype(jnp.float32)
+                    my_ports = jnp.dot(
+                        port_conf_hu_ref[:], onehot_u_p, preferred_element_type=jnp.float32
+                    )  # [Hp, 1]
                 conflicts = jnp.dot(
                     my_ports.reshape(1, -1),
                     (port_used_ref[:] > 0).astype(jnp.float32),
@@ -269,7 +317,7 @@ def _make_kernel(
                         )
 
             # --- PodTopologySpread
-            aff_row = affm_ref[pl.ds(u, 1), :] * valid_row
+            aff_row = (s_aff[:] if big_u else affm_ref[pl.ds(u, 1), :]) * valid_row
             soft_raw = jnp.zeros((1, N), jnp.float32)
             ignored = jnp.zeros((1, N), jnp.float32)
             any_soft = jnp.float32(0.0)
@@ -294,7 +342,8 @@ def _make_kernel(
 
             ip_raw = jnp.zeros((1, N), jnp.float32)
             if has_interpod:
-                onehot_u_col = (iota_u == u).astype(jnp.float32)  # [U, 1]
+                if not big_u:
+                    onehot_u_col = (iota_u == u).astype(jnp.float32)  # [U, 1]
                 # incoming required anti-affinity: no matching pod in domain
                 for t in range(Tn):
                     cnt, has_label = sel_cnt(ans_ref[u, t], anh_ref[u, t])
@@ -335,7 +384,10 @@ def _make_kernel(
                 # instead of per-term loops. Host-key domains always have
                 # the label (applicable() enforces hostname-identity); zone
                 # gathers give 0 on label-less nodes via the one-hot.
-                my_gmatch = jnp.dot(gmatch_ref[:], onehot_u_col, preferred_element_type=jnp.float32)
+                if big_u:
+                    my_gmatch = s_gmatch[:]
+                else:
+                    my_gmatch = jnp.dot(gmatch_ref[:], onehot_u_col, preferred_element_type=jnp.float32)
                 m_row = my_gmatch.reshape(1, n_anti)
                 m_host = m_row * g_host_row
                 m_zone = m_row * (1.0 - g_host_row)
@@ -354,7 +406,10 @@ def _make_kernel(
                     )
                 # score: symmetric preferred/hard-affinity weights — same
                 # three-dot contraction over the term axis
-                my_pmatch = jnp.dot(pmatch_ref[:], onehot_u_col, preferred_element_type=jnp.float32)
+                if big_u:
+                    my_pmatch = s_pmatch[:]
+                else:
+                    my_pmatch = jnp.dot(pmatch_ref[:], onehot_u_col, preferred_element_type=jnp.float32)
                 pm_row = my_pmatch.reshape(1, n_pref)
                 pm_host = pm_row * p_host_row
                 pm_zone = pm_row * (1.0 - p_host_row)
@@ -391,7 +446,7 @@ def _make_kernel(
                 (1.0 - jnp.abs(cpu_frac - mem_frac)) * MAX_SCORE,
             )
 
-            share_row = shraw_ref[pl.ds(u, 1), :]
+            share_row = s_share[:] if big_u else shraw_ref[pl.ds(u, 1), :]
             feas_b = feasible > 0
             lo = jnp.min(jnp.where(feas_b, share_row, jnp.float32(1e30)))
             hi = jnp.max(jnp.where(feas_b, share_row, jnp.float32(-1e30)))
@@ -411,7 +466,7 @@ def _make_kernel(
             if has_na:
                 # NodeAffinity preferred-term weights, max-normalized over
                 # the feasible set (DefaultNormalizeScore)
-                na_row = na_ref[pl.ds(u, 1), :]
+                na_row = s_na[:] if big_u else na_ref[pl.ds(u, 1), :]
                 na_max = jnp.max(jnp.where(feas_b, na_row, 0.0))
                 score = score + jnp.where(
                     na_max > 0, na_row * MAX_SCORE / jnp.maximum(na_max, 1.0), na_row
@@ -419,7 +474,7 @@ def _make_kernel(
             if has_tt:
                 # TaintToleration: intolerable PreferNoSchedule counts,
                 # reverse-normalized
-                tt_row = tt_ref[pl.ds(u, 1), :]
+                tt_row = s_tt[:] if big_u else tt_ref[pl.ds(u, 1), :]
                 tt_max = jnp.max(jnp.where(feas_b, tt_row, 0.0))
                 score = score + jnp.where(
                     tt_max > 0, MAX_SCORE - tt_row * MAX_SCORE / jnp.maximum(tt_max, 1.0), MAX_SCORE
@@ -493,13 +548,18 @@ def _make_kernel(
                     req_col = jnp.where(iota_r == r, req_ref[u, r], req_col)
                 used_ref[:] = used_ref[:] + req_col * onehot
 
-                onehot_u = (iota_u == u).astype(jnp.float32)  # [U, 1]
-                m_col = jnp.dot(matches_ref[:], onehot_u, preferred_element_type=jnp.float32)
+                if big_u:
+                    m_col = s_match[:]  # [A, 1]
+                else:
+                    onehot_u = (iota_u == u).astype(jnp.float32)  # [U, 1]
+                    m_col = jnp.dot(matches_ref[:], onehot_u, preferred_element_type=jnp.float32)
                 zrow_c = zone_nz_ref[pl.ds(c, 1), :]  # [1, Z]
                 node_cnt_ref[:] = node_cnt_ref[:] + m_col * onehot
                 zone_cnt_ref[:] = zone_cnt_ref[:] + m_col * zrow_c
                 if has_ports:
-                    p_col = jnp.dot(port_hu_ref[:], onehot_u, preferred_element_type=jnp.float32)
+                    p_col = s_port[:] if big_u else jnp.dot(
+                        port_hu_ref[:], onehot_u, preferred_element_type=jnp.float32
+                    )
                     port_used_ref[:] = port_used_ref[:] + p_col * onehot
                 if has_gpu:
                     # device packing on the chosen node (computed for all
@@ -579,10 +639,14 @@ def _make_kernel(
                                 taken_rows[d] = jnp.maximum(taken_rows[d], take_d)
                                 dev_free_ref[pl.ds(d, 1), :] = free_d * (1.0 - take_d * onehot)
                 if has_interpod:
-                    a_col = jnp.dot(antig_ref[:], onehot_u, preferred_element_type=jnp.float32)
+                    a_col = s_antig[:] if big_u else jnp.dot(
+                        antig_ref[:], onehot_u, preferred_element_type=jnp.float32
+                    )
                     anti_node_ref[:] = anti_node_ref[:] + a_col * onehot
                     anti_zone_ref[:] = anti_zone_ref[:] + a_col * zrow_c
-                    p_col = jnp.dot(prefg_ref[:], onehot_u, preferred_element_type=jnp.float32)
+                    p_col = s_prefg[:] if big_u else jnp.dot(
+                        prefg_ref[:], onehot_u, preferred_element_type=jnp.float32
+                    )
                     prefw_node_ref[:] = prefw_node_ref[:] + p_col * onehot
                     prefw_zone_ref[:] = prefw_zone_ref[:] + p_col * zrow_c
 
@@ -609,10 +673,15 @@ def run_fast_scan(
     has_na: bool = False,
     has_tt: bool = False,
     interpret: bool = False,
+    big_u: bool = False,
 ):
     """Execute the megakernel. tmpl_ids/pod_valid/forced are [P] (P a
     multiple of CHUNK). Returns (chosen [P] i32, used_final [R, N],
-    gpu_take [P, Gd], gpu_final [Gd, N], vg_final [Vg, N], dev_final [Dv, N])."""
+    gpu_take [P, Gd], gpu_final [Gd, N], vg_final [Vg, N], dev_final [Dv, N]).
+
+    `big_u` keeps the [U, N] / [X, U] template tables in HBM and DMAs one
+    row/column per pod step into VMEM scratch — VMEM use then no longer
+    scales with U, lifting the template cap (fastpath.applicable)."""
     P = tmpl_ids.shape[0]
     assert P % CHUNK == 0, P
     R, N = fi.alloc_T.shape
@@ -630,10 +699,40 @@ def run_fast_scan(
     vmem = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
     stream = lambda: pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM)
 
+    # which of the 24 VMEM inputs move to HBM (ANY) in big-U mode: the
+    # U-dimensioned tables, in kernel parameter order
+    _U_TABLE_POS = {2, 3, 4, 8, 10, 11, 12, 13, 20, 21, 22, 23}
+    if big_u:
+        vmem_specs = [
+            pl.BlockSpec(memory_space=pl.ANY) if k in _U_TABLE_POS else vmem()
+            for k in range(24)
+        ]
+        # per-step scratch: rows [1, N] for the [U, N] tables, columns [X, 1]
+        # for the [X, U] tables — order must match the kernel's _dma calls
+        u_scratch = [pltpu.VMEM((1, N), jnp.float32)] * 3  # static, affm, shraw
+        u_scratch.append(pltpu.VMEM((A, 1), jnp.float32))  # matches column
+        if has_na:
+            u_scratch.append(pltpu.VMEM((1, N), jnp.float32))
+        if has_tt:
+            u_scratch.append(pltpu.VMEM((1, N), jnp.float32))
+        if has_ports:
+            u_scratch += [pltpu.VMEM((Hp, 1), jnp.float32)] * 2
+        if has_interpod:
+            u_scratch += [
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((G, 1), jnp.float32),
+                pltpu.VMEM((Gp, 1), jnp.float32),
+                pltpu.VMEM((Gp, 1), jnp.float32),
+            ]
+        u_scratch.append(pltpu.SemaphoreType.DMA((len(u_scratch),)))
+    else:
+        vmem_specs = [vmem()] * 24
+        u_scratch = []
+
     out = pl.pallas_call(
         _make_kernel(
             has_interpod, has_gpu, has_local, has_ports, has_na, has_tt,
-            G, Gp, Gd, Vg, Dv, fi.dev_sizes.shape[1] // 2,
+            G, Gp, Gd, Vg, Dv, fi.dev_sizes.shape[1] // 2, big_u,
         ),
         grid=grid,
         out_shape=(
@@ -654,7 +753,7 @@ def run_fast_scan(
             + [smem()] * 2  # anti_g_host, prefg_host
             + [smem()] * 2  # gpu_mem, gpu_cnt
             + [smem()] * 4  # lvm_req, dev_req, dev_need, dev_sizes
-            + [vmem()] * 24  # VMEM inputs
+            + vmem_specs  # VMEM (or ANY, big-U mode) inputs
         ),
         out_specs=(
             pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM),
@@ -676,7 +775,8 @@ def run_fast_scan(
             pltpu.VMEM((Vg, N), jnp.float32),
             pltpu.VMEM((Dv, N), jnp.float32),
             pltpu.VMEM((Hp, N), jnp.float32),
-        ],
+        ]
+        + u_scratch,
         interpret=interpret,
     )(
         jnp.asarray(tmpl_ids, jnp.int32),

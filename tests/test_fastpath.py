@@ -338,6 +338,54 @@ def test_fastpath_matches_xla_interpod():
     np.testing.assert_allclose(got_used, want_used, rtol=1e-5)
 
 
+def test_fastpath_big_u_matches_xla():
+    """>512 distinct templates switch the kernel to big-U mode (template
+    tables in HBM, per-step DMA); placements must still match the XLA scan
+    exactly — including inter-pod and port features whose tables move."""
+    cluster = ResourceTypes()
+    for i in range(8):
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "64", "128Gi", "110"))
+    app = ResourceTypes()
+    # 515 unique specs (distinct cpu requests) → >512 templates
+    for i in range(515):
+        app.pods.append(fx.make_fake_pod(f"p{i:04d}", f"{100 + i}m", "64Mi"))
+    app.pods.append(fx.make_fake_pod("anchor", "100m", "64Mi", fx.with_labels({"role": "anchor"})))
+    app.deployments.append(
+        fx.make_fake_deployment(
+            "followers", 4, "200m", "128Mi",
+            fx.with_affinity(
+                {
+                    "podAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {"labelSelector": {"matchLabels": {"role": "anchor"}}, "topologyKey": "kubernetes.io/hostname"}
+                        ]
+                    }
+                }
+            ),
+        )
+    )
+    app.pods.append(
+        fx.make_fake_pod("gateway", "100m", "64Mi", fx.with_host_ports([31080]))
+    )
+    app.pods.append(
+        fx.make_fake_pod("gateway-2", "100m", "64Mi", fx.with_host_ports([31080]))
+    )
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
+    assert int(prep.ec_np.req.shape[0]) > 512
+    assert fastpath.use_big_u(int(prep.ec_np.req.shape[0]))
+    assert fastpath.applicable(prep)
+    P = len(prep.ordered)
+    want_chosen, want_used = _xla_chosen(prep)
+    got_chosen, got_used, *_rest = fastpath.schedule(
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+    )
+    mism = np.nonzero(want_chosen != got_chosen)[0]
+    assert mism.size == 0, (
+        f"{mism.size} mismatches at {mism[:5]}: xla={want_chosen[mism[:5]]} fast={got_chosen[mism[:5]]}"
+    )
+    np.testing.assert_allclose(got_used, want_used, rtol=1e-5)
+
+
 def test_fastpath_failure_reasons_without_rescan(monkeypatch):
     """Unschedulable pods through the fast path get kube-style reasons from
     a per-template evaluation against the final carry — NOT a second full

@@ -177,3 +177,32 @@ def test_fuzz_fastpath_vs_xla(seed):
         f"xla={want[mism[:10]]} fast={got[mism[:10]]}"
     )
     np.testing.assert_allclose(got_used, np.asarray(out.final_state.used), rtol=1e-5)
+
+
+@pytest.mark.parametrize("seed", [5, 42])
+def test_fuzz_big_u_fastpath_vs_xla(seed):
+    """Same differential check with the template space inflated past the
+    VMEM-resident cap, forcing the kernel's big-U (HBM tables + per-step
+    DMA) mode."""
+    rng = random.Random(seed)
+    cluster = random_cluster(rng, rng.randrange(6, 12))
+    app = random_app(rng, rng.randrange(2, 5))
+    for i in range(520):
+        app.pods.append(fx.make_fake_pod(f"u{i:04d}", f"{50 + i}m", f"{64 + (i % 7)}Mi"))
+    prep = prepare(cluster, [AppResource("fuzz", app)], node_pad=128)
+    if prep is None or not fastpath.applicable(prep):
+        pytest.skip("generated workload outside fast-path bounds")
+    assert fastpath.use_big_u(int(prep.ec_np.req.shape[0]))
+    P = len(prep.ordered)
+    t, v, f = pad_pod_stream(prep.tmpl_ids, np.ones(P, bool), prep.forced)
+    out = schedule_pods(prep.ec, prep.st0, t, v, f, features=prep.features)
+    want = np.asarray(out.chosen)[:P]
+    got, got_used, *_rest = fastpath.schedule(
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+    )
+    mism = np.nonzero(want != got)[0]
+    assert mism.size == 0, (
+        f"seed={seed}: {mism.size}/{P} mismatches at {mism[:10]}; "
+        f"xla={want[mism[:10]]} fast={got[mism[:10]]}"
+    )
+    np.testing.assert_allclose(got_used, np.asarray(out.final_state.used), rtol=1e-5)

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Best-effort Go-baseline proxy — used while no Go toolchain exists in this
+environment (BASELINE.md requires the reference be *measured*; this stays an
+estimate and is labeled as such everywhere it is quoted).
+
+Model
+-----
+The reference schedules strictly serially: one pod in flight at a time
+(`pkg/simulator/simulator.go:309-348` blocks on a channel per pod), each pod
+running the vendored kube-scheduler pipeline over EVERY node
+(`PercentageOfNodesToScore=100`, `pkg/simulator/utils.go:370`) with
+16-goroutine fan-out (`vendor/.../parallelize/parallelism.go:26-41`).
+
+    t_pod(N) = t_fixed + N * (c_filter * n_filter + c_score * n_score) / W
+
+- n_filter = 10 filter plugins, n_score = 8 score plugins in the active
+  profile (`algorithmprovider/registry.go:71-149`)
+- W = 16 workers
+- t_fixed = per-pod driver overhead: pod Create through the fake client,
+  informer dispatch, scheduling-queue pop, bind Update, rendezvous channel
+  round-trip (`simulator.go:323-346`, `scheduler.go:441-614`)
+
+Three cost models bracket the plausible range:
+
+  optimistic   c = 100 ns/plugin·node, t_fixed = 50 µs   (branch-predictable
+               predicates, warm caches — a floor, not an expectation)
+  realistic    c = 500 ns/plugin·node, t_fixed = 200 µs  (label-map lookups,
+               selector matching, string ops dominate the Go plugins)
+  SLO-anchored derived from the kube-scheduler scalability SLO of
+               100 pods/s on a 5k-node cluster (k8s sig-scalability SLO;
+               note that figure is measured WITH 50 % node sampling —
+               simon forces 100 %, so this still flatters the baseline):
+               t_pod(5000) = 10 ms, split per the formula above.
+
+Run: python tools/go_baseline_proxy.py
+"""
+
+N_FILTER = 10
+N_SCORE = 8
+WORKERS = 16
+
+MODELS = {
+    "optimistic": dict(c=100e-9, fixed=50e-6),
+    "realistic": dict(c=500e-9, fixed=200e-6),
+    # solve c for t_pod(5000) = 10 ms with the realistic fixed cost
+    "slo-anchored": dict(
+        c=(10e-3 - 200e-6) * WORKERS / (5000 * (N_FILTER + N_SCORE)), fixed=200e-6
+    ),
+}
+
+# (name, pods, nodes, measured TPU seconds from BENCH.md)
+CONFIGS = [
+    ("50k/5k headline", 50_000, 5_000, 2.4),
+    ("10k/1k (config 3)", 10_000, 1_000, 1.0),
+    ("affinity 5k/500 (config 4)", 5_000, 500, 1.4),
+]
+
+
+def t_pod(n_nodes: int, c: float, fixed: float) -> float:
+    return fixed + n_nodes * c * (N_FILTER + N_SCORE) / WORKERS
+
+
+def main() -> None:
+    print(f"{'config':28s} {'model':14s} {'est. Go wall':>12s} {'TPU':>6s} {'est. speedup':>12s}")
+    for name, pods, nodes, tpu_s in CONFIGS:
+        for model, p in MODELS.items():
+            go_s = pods * t_pod(nodes, p["c"], p["fixed"])
+            print(f"{name:28s} {model:14s} {go_s:10.1f} s {tpu_s:5.1f}s {go_s / tpu_s:11.0f}×")
+    print(
+        "\nAll figures are MODELED, not measured — the environment ships no Go\n"
+        "toolchain. The SLO-anchored model is the most defensible: it starts\n"
+        "from the kube-scheduler's own 100 pods/s scalability SLO at 5k nodes\n"
+        "and still understates simon's cost (simon scores 100% of nodes and\n"
+        "adds a serial channel rendezvous per pod)."
+    )
+
+
+if __name__ == "__main__":
+    main()

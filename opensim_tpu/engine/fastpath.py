@@ -31,7 +31,8 @@ def _pad8_static(n: int) -> int:
 
 def applicable(prep, config=None) -> bool:
     """The megakernel covers: static filters + fit + least/balanced/share +
-    topology spread, hostname plus at most one other topology key."""
+    topology spread + inter-pod terms, hostname plus at most two other
+    topology keys (stacked per-key count blocks)."""
     if config is not None and config != DEFAULT_CONFIG:
         return False
     f = prep.features
@@ -72,8 +73,8 @@ def applicable(prep, config=None) -> bool:
     vocab = prep.meta.vocab
     topo_keys = vocab.topo_keys.items()
     non_host = [k for k in topo_keys if k != HOSTNAME]
-    if len(non_host) > 1:
-        return False
+    if len(non_host) > 2:
+        return False  # hostname + up to two zone-like keys
     # hostname domains must be node-identity (each valid node carries its
     # own hostname label) for the per-node count layout to be exact
     if HOSTNAME in topo_keys:
@@ -94,13 +95,17 @@ def applicable(prep, config=None) -> bool:
     if jax.default_backend() != "tpu" and os.environ.get("OPENSIM_FASTPATH") != "interpret":
         return False
     # VMEM budget: three [U, N] tables, used/used_out [R, N] ×2, node_cnt
-    # [A, N], zone tables [N, Z] ×2 + [A, Z], masks/misc
+    # [A, N], per-key zone tables [N, K*Z] ×2 + [K*A, Z] + has_zone [K, N],
+    # masks/misc
     if non_host:
-        tk = topo_keys.index(non_host[0])
-        nd = np.asarray(ec.node_domain)[:, tk]
-        Z = max(128, 128 * math.ceil(len(np.unique(nd)) / 128))
+        counts = []
+        for key in non_host:
+            nd = np.asarray(ec.node_domain)[:, topo_keys.index(key)]
+            counts.append(len(np.unique(nd)))
+        Z = max(128, 128 * math.ceil(max(counts) / 128))
     else:
         Z = 128
+    K = max(len(non_host), 1)
     # padded global-term rows: the ≤16 caps above pad to at most 16 rows for
     # each of the anti/pref tables on both the N and Z axes; GPU buffers are
     # three [Gd_pad, N] arrays (input, scratch, output)
@@ -114,8 +119,8 @@ def applicable(prep, config=None) -> bool:
     U_resident = 0 if use_big_u(U) else U
     local_rows = 4 * Vg_pad + 6 * Dv_pad + 2 * 64 + 2 * U_resident
     vmem = (
-        (3 * U_resident + 4 * R + A + 2 * G + 3 * Gd_pad + local_rows + 4) * N
-        + (2 * N + A + 2 * G) * Z
+        (3 * U_resident + 4 * R + A + 2 * G + 3 * Gd_pad + local_rows + 4 + K) * N
+        + (2 * K * N + K * A + 2 * G) * Z
     ) * 4
     if vmem > _VMEM_BUDGET:
         return False
@@ -158,25 +163,34 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
     topo_keys = vocab.topo_keys.items()
     host_tk = topo_keys.index(HOSTNAME) if HOSTNAME in topo_keys else -1
     zone_tks = [i for i, k in enumerate(topo_keys) if k != HOSTNAME]
-    zone_tk = zone_tks[0] if zone_tks else -1
 
     node_domain = np.asarray(ec.node_domain)
     trash = np.asarray(ec.domain_topo).shape[0] - 1
 
-    # zone one-hots (dense, padded to 128 lanes)
-    if zone_tk >= 0:
-        zd = node_domain[:, zone_tk]
-        zone_ids, zone_inv = np.unique(zd, return_inverse=True)
-        Z = max(128, 128 * math.ceil(max(len(zone_ids), 1) / 128))
-        zone_NZ = np.zeros((N, Z), np.float32)
-        present = zd != trash
-        zone_NZ[np.arange(N)[present], zone_inv[present]] = 1.0
-        has_zone = present.astype(np.float32)[None, :]
+    # per-key zone one-hot blocks (dense, shared Z padded to 128 lanes);
+    # topo-idx → key-index map: 0 = hostname, 1..K = zone keys in vocab order
+    K = max(len(zone_tks), 1)
+    if zone_tks:
+        Z = max(
+            128,
+            128 * math.ceil(
+                max(len(np.unique(node_domain[:, tk])) for tk in zone_tks) / 128
+            ),
+        )
     else:
         Z = 128
-        zone_NZ = np.zeros((N, Z), np.float32)
-        has_zone = np.zeros((1, N), np.float32)
+    zone_NZ = np.zeros((N, K * Z), np.float32)
+    has_zone = np.zeros((K, N), np.float32)
+    for ki, tk in enumerate(zone_tks):
+        zd = node_domain[:, tk]
+        _ids, zone_inv = np.unique(zd, return_inverse=True)
+        present = zd != trash
+        zone_NZ[np.arange(N)[present], ki * Z + zone_inv[present]] = 1.0
+        has_zone[ki] = present.astype(np.float32)
     zone_ZN = np.ascontiguousarray(zone_NZ.T)
+    key_of_tk = {host_tk: 0}
+    for ki, tk in enumerate(zone_tks):
+        key_of_tk[tk] = ki + 1
 
     A_pad = max(8, 8 * math.ceil(A / 8))
     matches_AU = np.zeros((A_pad, U), np.float32)
@@ -185,7 +199,11 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
     spr_topo = np.asarray(ec.spr_topo)
     Cs = spr_topo.shape[1]
     spr_active = (spr_topo >= 0).astype(np.int32)
-    spr_hostname = (spr_topo == host_tk).astype(np.int32)
+    _key_lut = np.zeros((max(len(topo_keys), 1) + 1,), np.int32)
+    for tk, ki in key_of_tk.items():
+        if tk >= 0:
+            _key_lut[tk] = ki
+    spr_key = _key_lut[np.maximum(spr_topo, 0)].astype(np.int32)
     spr_sel = np.maximum(np.asarray(ec.spr_sel), 0).astype(np.int32)
     spr_skew = np.asarray(ec.spr_skew).astype(np.float32)
     spr_hard = np.asarray(ec.spr_hard).astype(np.int32)
@@ -236,8 +254,8 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
         sel = np.asarray(sel_arr)
         topo = np.asarray(topo_arr)
         active = (sel >= 0).astype(np.int32)
-        host = (topo == host_tk).astype(np.int32)
-        return active, host, np.maximum(sel, 0).astype(np.int32)
+        key = _key_lut[np.maximum(np.asarray(topo), 0)].astype(np.int32)
+        return active, key, np.maximum(sel, 0).astype(np.int32)
 
     # host-port rows: [Hp_pad, U] template multi-hot
     ports_u = np.asarray(ec.ports)  # [U, Hp_tmpl] port vocab ids, -1 pad
@@ -258,9 +276,9 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
             conf[:n_port_vocab, :n_port_vocab] @ port_HU[:n_port_vocab] > 0
         ).astype(np.float32)
 
-    at_active, at_host, at_sel = terms(ec.at_sel, ec.at_topo)
-    an_active, an_host, an_sel = terms(ec.an_sel, ec.an_topo)
-    pt_active, pt_host, pt_sel = terms(ec.pt_sel, ec.pt_topo)
+    at_active, at_key, at_sel = terms(ec.at_sel, ec.at_topo)
+    an_active, an_key, an_sel = terms(ec.an_sel, ec.an_topo)
+    pt_active, pt_key, pt_sel = terms(ec.pt_sel, ec.pt_topo)
     at_self = np.where(at_active == 1, np.take_along_axis(matches_sel, at_sel, axis=1), 0.0).astype(
         np.float32
     )
@@ -270,24 +288,24 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
     g_topo = np.asarray(ec.anti_g_topo)
     G = g_sel.shape[0]
     G_pad = _pad8_static(G)
-    anti_g_host = np.zeros((G_pad,), np.int32)
+    anti_g_key = np.zeros((G_pad,), np.int32)
     antig_GU = np.zeros((G_pad, U), np.float32)
     gmatch_GU = np.zeros((G_pad, U), np.float32)
     anti_carry = np.asarray(ec.anti_g).astype(np.float32)  # [U, G]
     for g in range(G):
-        anti_g_host[g] = 1 if g_topo[g] == host_tk else 0
+        anti_g_key[g] = int(_key_lut[max(int(g_topo[g]), 0)])
         antig_GU[g] = anti_carry[:, g]
         gmatch_GU[g] = matches_sel[:, g_sel[g]].astype(np.float32)
     p_sel = np.asarray(ec.prefg_sel)
     p_topo = np.asarray(ec.prefg_topo)
     Gp = p_sel.shape[0]
     Gp_pad = _pad8_static(Gp)
-    prefg_host = np.zeros((Gp_pad,), np.int32)
+    prefg_key = np.zeros((Gp_pad,), np.int32)
     prefg_GU = np.zeros((Gp_pad, U), np.float32)
     pmatch_GU = np.zeros((Gp_pad, U), np.float32)
     pref_carry = np.asarray(ec.prefg_w).astype(np.float32)  # [U, Gp]
     for g in range(Gp):
-        prefg_host[g] = 1 if p_topo[g] == host_tk else 0
+        prefg_key[g] = int(_key_lut[max(int(p_topo[g]), 0)])
         prefg_GU[g] = pref_carry[:, g]
         pmatch_GU[g] = matches_sel[:, p_sel[g]].astype(np.float32)
 
@@ -307,25 +325,25 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
         mem_nz=mem_nz,
         pin=np.asarray(ec.pin).astype(np.int32),
         spr_active=spr_active,
-        spr_hostname=spr_hostname,
+        spr_key=spr_key,
         spr_sel=spr_sel,
         spr_skew=spr_skew,
         spr_hard=spr_hard,
         spr_self=spr_self,
         spr_weight=spr_weight,
         at_active=at_active,
-        at_host=at_host,
+        at_key=at_key,
         at_sel=at_sel,
         at_self=at_self,
         an_active=an_active,
-        an_host=an_host,
+        an_key=an_key,
         an_sel=an_sel,
         pt_active=pt_active,
-        pt_host=pt_host,
+        pt_key=pt_key,
         pt_sel=pt_sel,
         pt_w=pt_w,
-        anti_g_host=anti_g_host,
-        prefg_host=prefg_host,
+        anti_g_key=anti_g_key,
+        prefg_key=prefg_key,
         antig_GU=antig_GU,
         gmatch_GU=gmatch_GU,
         prefg_GU=prefg_GU,

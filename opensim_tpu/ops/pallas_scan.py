@@ -11,7 +11,8 @@ VMEM-bandwidth, not kernel launches.
 Scope: every scheduler feature — resource fit, topology spread, inter-pod
 affinity, GPU-share devices, open-local storage, host ports, preferred node
 affinity and PreferNoSchedule scoring — bounded by table-size caps and at
-most two topology keys (hostname + one zone-like key); `engine/fastpath.py`
+most three topology keys (hostname + two zone-like keys, stacked per-key
+count blocks); `engine/fastpath.py`
 gates applicability and guarantees identical placements to the XLA scan
 (tests + randomized differential fuzzing assert equality). Past 512
 templates the kernel switches to big-U mode: the [U, N]/[X, U] template
@@ -26,7 +27,7 @@ Layouts (N = padded node axis, lanes; rows padded to sublane multiples):
   used        [R, N]    f32  scratch, persistent across the grid
   static_pass [U, N]    f32  0/1 from kernels.precompute_static
   node_cnt    [A, N]    f32  scratch — per-hostname-domain selector counts
-  zone_cnt    [A, Z]    f32  scratch — per-zone selector counts
+  zone_cnt    [K*A, Z]  f32  scratch — per-(zone-key, selector) counts
   anti_node   [G, N]    f32  scratch — existing-pod anti-affinity terms
   prefw_node  [Gp, N]   f32  scratch — symmetric preferred-term weights
   matches_AU  [A, U]    f32  selector-match matrix (column = template)
@@ -59,9 +60,9 @@ class FastInputs(NamedTuple):
     static_pass: np.ndarray  # [U, N]
     aff_mask: np.ndarray  # [U, N]
     share_raw: np.ndarray  # [U, N]
-    zone_NZ: np.ndarray  # [N, Z]
-    zone_ZN: np.ndarray  # [Z, N]
-    has_zone: np.ndarray  # [1, N] f32
+    zone_NZ: np.ndarray  # [N, K*Z] — per-zone-key one-hot blocks
+    zone_ZN: np.ndarray  # [K*Z, N]
+    has_zone: np.ndarray  # [K, N] f32 — node has key k's label
     matches_AU: np.ndarray  # [A, U]
     node_valid: np.ndarray  # [1, N] f32
     # SMEM scalar tables
@@ -71,7 +72,7 @@ class FastInputs(NamedTuple):
     pin: np.ndarray  # [U] i32
     # spread constraints, [U, Cs] each
     spr_active: np.ndarray  # i32 0/1
-    spr_hostname: np.ndarray  # i32 1 = hostname topology
+    spr_key: np.ndarray  # i32 topology key index: 0 = hostname, 1..K = zone keys
     spr_sel: np.ndarray  # i32 selector id
     spr_skew: np.ndarray  # f32
     spr_hard: np.ndarray  # i32 0/1
@@ -79,18 +80,18 @@ class FastInputs(NamedTuple):
     spr_weight: np.ndarray  # f32 log(size+2)
     # inter-pod affinity (all zero-shaped semantics when has_interpod=False)
     at_active: np.ndarray  # [U, Ti] i32 — incoming required affinity terms
-    at_host: np.ndarray  # [U, Ti] i32
+    at_key: np.ndarray  # [U, Ti] i32 key index (0 = hostname, 1..K = zone)
     at_sel: np.ndarray  # [U, Ti] i32
     at_self: np.ndarray  # [U, Ti] f32 — bootstrap self-match
     an_active: np.ndarray  # [U, Tn] i32 — incoming anti terms
-    an_host: np.ndarray  # [U, Tn] i32
+    an_key: np.ndarray  # [U, Tn] i32
     an_sel: np.ndarray  # [U, Tn] i32
     pt_active: np.ndarray  # [U, Tp] i32 — incoming preferred terms
-    pt_host: np.ndarray  # [U, Tp] i32
+    pt_key: np.ndarray  # [U, Tp] i32
     pt_sel: np.ndarray  # [U, Tp] i32
     pt_w: np.ndarray  # [U, Tp] f32 signed weights
-    anti_g_host: np.ndarray  # [G] i32 — global existing-anti terms
-    prefg_host: np.ndarray  # [Gp] i32 — global symmetric-preferred terms
+    anti_g_key: np.ndarray  # [G] i32 — global existing-anti term key indices
+    prefg_key: np.ndarray  # [Gp] i32 — global symmetric-preferred term key indices
     antig_GU: np.ndarray  # [G, U] f32 — template carries term g
     gmatch_GU: np.ndarray  # [G, U] f32 — template matches term g's selector
     prefg_GU: np.ndarray  # [Gp, U] f32 — carried symmetric weights
@@ -131,6 +132,7 @@ def _make_kernel(
     n_dev: int,
     n_dvol: int,
     big_u: bool = False,
+    n_zkeys: int = 1,
 ):
     def kernel(
         # SMEM streams + tables
@@ -182,8 +184,10 @@ def _make_kernel(
         iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
         iota_u = jax.lax.broadcasted_iota(jnp.int32, (U, 1), 0)
         valid_row = nodevalid_ref[:]  # [1, N]
-        has_zone = has_zone_ref[:]  # [1, N]
         ones_1n = jnp.ones((1, N), jnp.float32)
+
+        A_rows = node_cnt_ref.shape[0]
+        Zk = zone_zn_ref.shape[0] // n_zkeys
 
         def _flag_row(flag_ref, n_rows):
             """Expand an SMEM int-flag table into a [1, n_rows] f32 vector
@@ -194,18 +198,32 @@ def _make_kernel(
                 row = jnp.where(r_iota == g, jnp.float32(flag_ref[g]), row)
             return row
 
-        if has_interpod:
-            g_host_row = _flag_row(agh_ref, n_anti)
-            p_host_row = _flag_row(pgh_ref, n_pref)
+        def _flag_col(flag_ref, n_rows):
+            col = jnp.zeros((n_rows, 1), jnp.float32)
+            c_iota = jax.lax.broadcasted_iota(jnp.int32, (n_rows, 1), 0)
+            for g in range(n_rows):
+                col = jnp.where(c_iota == g, jnp.float32(flag_ref[g]), col)
+            return col
 
-        def sel_cnt(sel, is_host):
+        if has_interpod:
+            g_key_row = _flag_row(agh_ref, n_anti)
+            p_key_row = _flag_row(pgh_ref, n_pref)
+            g_key_col = _flag_col(agh_ref, n_anti)
+            p_key_col = _flag_col(pgh_ref, n_pref)
+
+        def sel_cnt(sel, key):
             """Count of bound pods matching selector `sel` in the candidate
-            node's domain, for a hostname-or-zone topology flag."""
+            node's domain under topology key index `key` (0 = hostname,
+            1..K = zone keys; zone counts live in per-key row blocks)."""
             host_cnt = node_cnt_ref[pl.ds(sel, 1), :]  # [1, N]
-            zrow = zone_cnt_ref[pl.ds(sel, 1), :]  # [1, Z]
-            zone_gather = jnp.dot(zrow, zone_zn_ref[:], preferred_element_type=jnp.float32)
-            return jnp.where(is_host == 1, host_cnt, zone_gather), jnp.where(
-                is_host == 1, ones_1n, has_zone
+            k = jnp.maximum(key - 1, 0)
+            zrow = zone_cnt_ref[pl.ds(k * A_rows + sel, 1), :]  # [1, Zk]
+            zone_gather = jnp.dot(
+                zrow, zone_zn_ref[pl.ds(k * Zk, Zk), :], preferred_element_type=jnp.float32
+            )
+            has = has_zone_ref[pl.ds(k, 1), :]
+            return jnp.where(key == 0, host_cnt, zone_gather), jnp.where(
+                key == 0, ones_1n, has
             )
 
         def body(i, _):
@@ -364,8 +382,11 @@ def _make_kernel(
                 for t in range(Ti):
                     cnt, has_label = sel_cnt(ats_ref[u, t], ath_ref[u, t])
                     total_host = jnp.sum(node_cnt_ref[pl.ds(ats_ref[u, t], 1), :])
-                    total_zone = jnp.sum(zone_cnt_ref[pl.ds(ats_ref[u, t], 1), :])
-                    total = jnp.where(ath_ref[u, t] == 1, total_host, total_zone)
+                    at_k = jnp.maximum(ath_ref[u, t] - 1, 0)
+                    total_zone = jnp.sum(
+                        zone_cnt_ref[pl.ds(at_k * A_rows + ats_ref[u, t], 1), :]
+                    )
+                    total = jnp.where(ath_ref[u, t] == 0, total_host, total_zone)
                     activef = ata_ref[u, t] == 1
                     term_ok = ((cnt > 0) & (has_label > 0)).astype(jnp.float32)
                     at_all_ok = jnp.where(activef, at_all_ok * term_ok, at_all_ok)
@@ -389,14 +410,15 @@ def _make_kernel(
                 else:
                     my_gmatch = jnp.dot(gmatch_ref[:], onehot_u_col, preferred_element_type=jnp.float32)
                 m_row = my_gmatch.reshape(1, n_anti)
-                m_host = m_row * g_host_row
-                m_zone = m_row * (1.0 - g_host_row)
+                m_host = m_row * (g_key_row == 0).astype(jnp.float32)
                 sym_cnt = jnp.dot(m_host, anti_node_ref[:], preferred_element_type=jnp.float32)
-                sym_cnt = sym_cnt + jnp.dot(
-                    jnp.dot(m_zone, anti_zone_ref[:], preferred_element_type=jnp.float32),
-                    zone_zn_ref[:],
-                    preferred_element_type=jnp.float32,
-                )
+                for zk in range(n_zkeys):
+                    m_k = m_row * (g_key_row == zk + 1).astype(jnp.float32)
+                    sym_cnt = sym_cnt + jnp.dot(
+                        jnp.dot(m_k, anti_zone_ref[:], preferred_element_type=jnp.float32),
+                        zone_zn_ref[pl.ds(zk * Zk, Zk), :],
+                        preferred_element_type=jnp.float32,
+                    )
                 feasible = feasible * (1.0 - (sym_cnt > 0).astype(jnp.float32))
                 # score: incoming preferred terms
                 for t in range(Tp):
@@ -411,14 +433,15 @@ def _make_kernel(
                 else:
                     my_pmatch = jnp.dot(pmatch_ref[:], onehot_u_col, preferred_element_type=jnp.float32)
                 pm_row = my_pmatch.reshape(1, n_pref)
-                pm_host = pm_row * p_host_row
-                pm_zone = pm_row * (1.0 - p_host_row)
+                pm_host = pm_row * (p_key_row == 0).astype(jnp.float32)
                 ip_raw = ip_raw + jnp.dot(pm_host, prefw_node_ref[:], preferred_element_type=jnp.float32)
-                ip_raw = ip_raw + jnp.dot(
-                    jnp.dot(pm_zone, prefw_zone_ref[:], preferred_element_type=jnp.float32),
-                    zone_zn_ref[:],
-                    preferred_element_type=jnp.float32,
-                )
+                for zk in range(n_zkeys):
+                    pm_k = pm_row * (p_key_row == zk + 1).astype(jnp.float32)
+                    ip_raw = ip_raw + jnp.dot(
+                        jnp.dot(pm_k, prefw_zone_ref[:], preferred_element_type=jnp.float32),
+                        zone_zn_ref[pl.ds(zk * Zk, Zk), :],
+                        preferred_element_type=jnp.float32,
+                    )
 
             # --- scores
             cpu_req = cpu_nz_ref[u]
@@ -553,9 +576,13 @@ def _make_kernel(
                 else:
                     onehot_u = (iota_u == u).astype(jnp.float32)  # [U, 1]
                     m_col = jnp.dot(matches_ref[:], onehot_u, preferred_element_type=jnp.float32)
-                zrow_c = zone_nz_ref[pl.ds(c, 1), :]  # [1, Z]
+                zrow_c_full = zone_nz_ref[pl.ds(c, 1), :]  # [1, K*Zk]
                 node_cnt_ref[:] = node_cnt_ref[:] + m_col * onehot
-                zone_cnt_ref[:] = zone_cnt_ref[:] + m_col * zrow_c
+                for zk in range(n_zkeys):
+                    zone_cnt_ref[pl.ds(zk * A_rows, A_rows), :] = (
+                        zone_cnt_ref[pl.ds(zk * A_rows, A_rows), :]
+                        + m_col * zrow_c_full[:, zk * Zk : (zk + 1) * Zk]
+                    )
                 if has_ports:
                     p_col = s_port[:] if big_u else jnp.dot(
                         port_hu_ref[:], onehot_u, preferred_element_type=jnp.float32
@@ -643,12 +670,20 @@ def _make_kernel(
                         antig_ref[:], onehot_u, preferred_element_type=jnp.float32
                     )
                     anti_node_ref[:] = anti_node_ref[:] + a_col * onehot
-                    anti_zone_ref[:] = anti_zone_ref[:] + a_col * zrow_c
+                    for zk in range(n_zkeys):
+                        key_mask = (g_key_col == zk + 1).astype(jnp.float32)
+                        anti_zone_ref[:] = anti_zone_ref[:] + a_col * key_mask * zrow_c_full[
+                            :, zk * Zk : (zk + 1) * Zk
+                        ]
                     p_col = s_prefg[:] if big_u else jnp.dot(
                         prefg_ref[:], onehot_u, preferred_element_type=jnp.float32
                     )
                     prefw_node_ref[:] = prefw_node_ref[:] + p_col * onehot
-                    prefw_zone_ref[:] = prefw_zone_ref[:] + p_col * zrow_c
+                    for zk in range(n_zkeys):
+                        key_mask = (p_key_col == zk + 1).astype(jnp.float32)
+                        prefw_zone_ref[:] = prefw_zone_ref[:] + p_col * key_mask * zrow_c_full[
+                            :, zk * Zk : (zk + 1) * Zk
+                        ]
 
             return 0
 
@@ -686,7 +721,8 @@ def run_fast_scan(
     assert P % CHUNK == 0, P
     R, N = fi.alloc_T.shape
     A = fi.matches_AU.shape[0]
-    Z = fi.zone_NZ.shape[1]
+    K = fi.has_zone.shape[0]  # number of non-hostname topology keys (>= 1)
+    Z = fi.zone_NZ.shape[1] // K
     G = fi.antig_GU.shape[0]
     Gp = fi.prefg_GU.shape[0]
     Gd = fi.gpu0_DN.shape[0]
@@ -732,7 +768,7 @@ def run_fast_scan(
     out = pl.pallas_call(
         _make_kernel(
             has_interpod, has_gpu, has_local, has_ports, has_na, has_tt,
-            G, Gp, Gd, Vg, Dv, fi.dev_sizes.shape[1] // 2, big_u,
+            G, Gp, Gd, Vg, Dv, fi.dev_sizes.shape[1] // 2, big_u, K,
         ),
         grid=grid,
         out_shape=(
@@ -750,7 +786,7 @@ def run_fast_scan(
             + [smem()] * 4  # at_*
             + [smem()] * 3  # an_*
             + [smem()] * 4  # pt_*
-            + [smem()] * 2  # anti_g_host, prefg_host
+            + [smem()] * 2  # anti_g_key, prefg_key
             + [smem()] * 2  # gpu_mem, gpu_cnt
             + [smem()] * 4  # lvm_req, dev_req, dev_need, dev_sizes
             + vmem_specs  # VMEM (or ANY, big-U mode) inputs
@@ -766,7 +802,7 @@ def run_fast_scan(
         scratch_shapes=[
             pltpu.VMEM((R, N), jnp.float32),
             pltpu.VMEM((A, N), jnp.float32),
-            pltpu.VMEM((A, Z), jnp.float32),
+            pltpu.VMEM((K * A, Z), jnp.float32),
             pltpu.VMEM((G, N), jnp.float32),
             pltpu.VMEM((G, Z), jnp.float32),
             pltpu.VMEM((Gp, N), jnp.float32),
@@ -787,25 +823,25 @@ def run_fast_scan(
         jnp.asarray(fi.mem_nz, jnp.float32),
         jnp.asarray(fi.pin, jnp.int32),
         jnp.asarray(fi.spr_active, jnp.int32),
-        jnp.asarray(fi.spr_hostname, jnp.int32),
+        jnp.asarray(fi.spr_key, jnp.int32),
         jnp.asarray(fi.spr_sel, jnp.int32),
         jnp.asarray(fi.spr_skew, jnp.float32),
         jnp.asarray(fi.spr_hard, jnp.int32),
         jnp.asarray(fi.spr_self, jnp.float32),
         jnp.asarray(fi.spr_weight, jnp.float32),
         jnp.asarray(fi.at_active, jnp.int32),
-        jnp.asarray(fi.at_host, jnp.int32),
+        jnp.asarray(fi.at_key, jnp.int32),
         jnp.asarray(fi.at_sel, jnp.int32),
         jnp.asarray(fi.at_self, jnp.float32),
         jnp.asarray(fi.an_active, jnp.int32),
-        jnp.asarray(fi.an_host, jnp.int32),
+        jnp.asarray(fi.an_key, jnp.int32),
         jnp.asarray(fi.an_sel, jnp.int32),
         jnp.asarray(fi.pt_active, jnp.int32),
-        jnp.asarray(fi.pt_host, jnp.int32),
+        jnp.asarray(fi.pt_key, jnp.int32),
         jnp.asarray(fi.pt_sel, jnp.int32),
         jnp.asarray(fi.pt_w, jnp.float32),
-        jnp.asarray(fi.anti_g_host, jnp.int32),
-        jnp.asarray(fi.prefg_host, jnp.int32),
+        jnp.asarray(fi.anti_g_key, jnp.int32),
+        jnp.asarray(fi.prefg_key, jnp.int32),
         jnp.asarray(fi.gpu_mem, jnp.float32),
         jnp.asarray(fi.gpu_cnt, jnp.float32),
         jnp.asarray(fi.lvm_req, jnp.float32),

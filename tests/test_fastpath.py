@@ -86,22 +86,37 @@ def test_fastpath_rejects_unsupported():
     assert fastpath.applicable(prep)
     assert not fastpath.applicable(prep, DEFAULT_CONFIG._replace(w_least=3.0))
 
-    # more than two topology keys stays on the XLA path
-    app2 = ResourceTypes()
-    app2.pods.append(
-        fx.make_fake_pod(
-            "spread3", "1", "1Gi",
-            fx.with_topology_spread(
-                [
-                    {"maxSkew": 1, "topologyKey": k, "whenUnsatisfiable": "ScheduleAnyway",
-                     "labelSelector": {"matchLabels": {"x": "y"}}}
-                    for k in ("topology.kubernetes.io/zone", "topology.kubernetes.io/region")
-                ]
-            ),
+    # two non-hostname topology keys are in scope; a third is not
+    def spread_app(keys):
+        rt = ResourceTypes()
+        rt.pods.append(
+            fx.make_fake_pod(
+                "spread", "1", "1Gi",
+                fx.with_topology_spread(
+                    [
+                        {"maxSkew": 1, "topologyKey": k, "whenUnsatisfiable": "ScheduleAnyway",
+                         "labelSelector": {"matchLabels": {"x": "y"}}}
+                        for k in keys
+                    ]
+                ),
+            )
         )
+        return rt
+
+    prep2 = prepare(
+        cluster,
+        [AppResource("a", spread_app(["topology.kubernetes.io/zone", "topology.kubernetes.io/region"]))],
+        node_pad=128,
     )
-    prep2 = prepare(cluster, [AppResource("a", app2)], node_pad=128)
-    assert not fastpath.applicable(prep2)
+    assert fastpath.applicable(prep2)
+    prep2b = prepare(
+        cluster,
+        [AppResource("a", spread_app([
+            "topology.kubernetes.io/zone", "topology.kubernetes.io/region", "topology.rack",
+        ]))],
+        node_pad=128,
+    )
+    assert not fastpath.applicable(prep2b)
 
     # non-128-multiple node padding stays on the XLA path
     prep3 = prepare(cluster, [AppResource("a", app)], node_pad=8)
@@ -325,6 +340,85 @@ def test_fastpath_matches_xla_interpod():
     )
     prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
     assert prep.features.interpod and prep.features.prefg
+    assert fastpath.applicable(prep)
+    P = len(prep.ordered)
+    want_chosen, want_used = _xla_chosen(prep)
+    got_chosen, got_used, *_rest = fastpath.schedule(
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+    )
+    mism = np.nonzero(want_chosen != got_chosen)[0]
+    assert mism.size == 0, (
+        f"{mism.size} mismatches at {mism[:5]}: xla={want_chosen[mism[:5]]} fast={got_chosen[mism[:5]]}"
+    )
+    np.testing.assert_allclose(got_used, want_used, rtol=1e-5)
+
+
+def test_fastpath_two_zone_keys_matches_xla():
+    """Workloads spanning hostname + TWO zone-like topology keys (zone and
+    region) run on the megakernel's stacked per-key count blocks; placements
+    must match the XLA scan exactly across spread and inter-pod terms on
+    either key."""
+    cluster = ResourceTypes()
+    for i in range(12):
+        labels = {}
+        if i % 4 != 3:  # some nodes lack the zone label
+            labels["topology.kubernetes.io/zone"] = f"z{i % 3}"
+        if i % 5 != 4:  # and some lack the region label — independently
+            labels["topology.kubernetes.io/region"] = f"r{i % 2}"
+        cluster.nodes.append(fx.make_fake_node(f"n{i:02d}", "16", "32Gi", "110", fx.with_labels(labels)))
+    app = ResourceTypes()
+    app.deployments.append(
+        fx.make_fake_deployment(
+            "zonal", 9, "250m", "512Mi",
+            fx.with_topology_spread(
+                [
+                    {"maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+                     "whenUnsatisfiable": "DoNotSchedule",
+                     "labelSelector": {"matchLabels": {"app": "zonal"}}},
+                    {"maxSkew": 2, "topologyKey": "topology.kubernetes.io/region",
+                     "whenUnsatisfiable": "ScheduleAnyway",
+                     "labelSelector": {"matchLabels": {"app": "zonal"}}},
+                ]
+            ),
+        )
+    )
+    app.pods.append(fx.make_fake_pod("anchor", "100m", "128Mi", fx.with_labels({"role": "anchor"})))
+    app.deployments.append(
+        fx.make_fake_deployment(
+            "regional", 4, "200m", "256Mi",
+            fx.with_affinity(
+                {
+                    "podAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {"labelSelector": {"matchLabels": {"role": "anchor"}},
+                             "topologyKey": "topology.kubernetes.io/region"}
+                        ]
+                    }
+                }
+            ),
+        )
+    )
+    app.stateful_sets.append(
+        fx.make_fake_stateful_set(
+            "iso", 4, "500m", "1Gi",
+            fx.with_affinity(
+                {
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {"labelSelector": {"matchLabels": {"app": "iso"}},
+                             "topologyKey": "topology.kubernetes.io/zone"}
+                        ],
+                        "preferredDuringSchedulingIgnoredDuringExecution": [
+                            {"weight": 50, "podAffinityTerm": {
+                                "labelSelector": {"matchLabels": {"app": "iso"}},
+                                "topologyKey": "topology.kubernetes.io/region"}},
+                        ],
+                    }
+                }
+            ),
+        )
+    )
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
     assert fastpath.applicable(prep)
     P = len(prep.ordered)
     want_chosen, want_used = _xla_chosen(prep)

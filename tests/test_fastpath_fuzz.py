@@ -26,6 +26,8 @@ def random_cluster(rng: random.Random, n_nodes: int) -> ResourceTypes:
         if rng.random() < 0.8:
             labels["topology.kubernetes.io/zone"] = f"z{rng.randrange(3)}"
         if rng.random() < 0.5:
+            labels["topology.kubernetes.io/region"] = f"r{rng.randrange(2)}"
+        if rng.random() < 0.5:
             labels["disk"] = rng.choice(["ssd", "hdd"])
         opts.append(fx.with_labels(labels))
         if rng.random() < 0.25:
@@ -75,7 +77,8 @@ def random_app(rng: random.Random, n_workloads: int) -> ResourceTypes:
                         {
                             "maxSkew": rng.choice([1, 2, 5]),
                             "topologyKey": rng.choice(
-                                ["kubernetes.io/hostname", "topology.kubernetes.io/zone"]
+                                ["kubernetes.io/hostname", "topology.kubernetes.io/zone",
+                                 "topology.kubernetes.io/region"]
                             ),
                             "whenUnsatisfiable": rng.choice(["DoNotSchedule", "ScheduleAnyway"]),
                             "labelSelector": {"matchLabels": {"app": f"w{w}"}},
@@ -88,7 +91,10 @@ def random_app(rng: random.Random, n_workloads: int) -> ResourceTypes:
             mode = rng.choice(["required", "preferred"])
             term = {
                 "labelSelector": {"matchLabels": {"app": f"w{max(w - 1, 0)}"}},
-                "topologyKey": rng.choice(["kubernetes.io/hostname", "topology.kubernetes.io/zone"]),
+                "topologyKey": rng.choice(
+                    ["kubernetes.io/hostname", "topology.kubernetes.io/zone",
+                     "topology.kubernetes.io/region"]
+                ),
             }
             if mode == "required":
                 aff = {kind: {"requiredDuringSchedulingIgnoredDuringExecution": [term]}}

@@ -36,17 +36,33 @@ class ScheduleOutput(NamedTuple):
 
 def _step(ec: EncodedCluster, stat, feat, cfg, extra, st: ScanState, x):
     u, pod_valid, forced = x
-    res = kernels.pod_step(ec, stat, st, u, feat, cfg, extra)
     # Pre-bound pods (spec.nodeName set) bypass the scheduler in the
     # reference (simulator.go:329-331 only waits for unbound pods): they
-    # always land on their node and still consume its resources.
+    # always land on their node and still consume its resources — so the
+    # whole filter/score pipeline is skipped via lax.cond (live-cluster
+    # snapshots replay thousands of forced binds per request).
+    n_dyn = kernels.NUM_FILTERS - kernels.F_PORTS
+    R = ec.alloc.shape[1]
+
+    def run_pipeline(_):
+        res = kernels.pod_step(ec, stat, st, u, feat, cfg, extra)
+        return res.chosen, res.fail_counts, res.insufficient
+
+    def skip_pipeline(_):
+        return (
+            jnp.int32(-1),
+            jnp.zeros((n_dyn,), jnp.int32),
+            jnp.zeros((R,), jnp.int32),
+        )
+
+    picked, fail_counts, insufficient = jax.lax.cond(forced, skip_pipeline, run_pipeline, None)
     pin = ec.pin[u]
-    chosen = jnp.where(forced, jnp.where(pin >= 0, pin, -1), res.chosen)
+    chosen = jnp.where(forced, jnp.where(pin >= 0, pin, -1), picked)
     do_bind = pod_valid & (chosen >= 0)
     node = jnp.maximum(chosen, 0)
     st_next, gpu_take = kernels.bind_update(ec, st, u, node, do_bind, feat)
     chosen = jnp.where(do_bind, chosen, -1)
-    return st_next, (chosen, res.fail_counts, res.insufficient, gpu_take)
+    return st_next, (chosen, fail_counts, insufficient, gpu_take)
 
 
 @functools.partial(jax.jit, static_argnames=("features", "config", "extra_plugins", "unroll"))

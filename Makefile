@@ -15,6 +15,8 @@ bench-all: bench
 	python bench.py --pods 10000 --nodes 1000
 	python bench.py --config affinity --pods 5000 --nodes 500
 	python bench.py --config defrag --scenarios 64 --nodes 200 --pods 2000
+	python bench.py --config bigu --pods 50000 --nodes 5000
+	python bench.py --config forced --pods 50000 --nodes 5000
 
 docs:
 	python -m opensim_tpu gen-doc --output-dir docs/commandline

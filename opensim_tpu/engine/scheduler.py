@@ -16,6 +16,7 @@ lowest node index. Structural results (counts, feasibility) are identical.
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple
 
 import jax
@@ -23,6 +24,23 @@ import jax.numpy as jnp
 
 from ..encoding.state import EncodedCluster, ScanState
 from ..ops import kernels
+
+
+def scan_unroll() -> int:
+    """The OPENSIM_SCAN_UNROLL tuning knob (accelerator runs: amortizes
+    per-iteration dispatch; neutral-to-negative on CPU). Positive integer,
+    default 1. Resolved OUTSIDE jit by every scan entry point so the value
+    participates in the jit cache key."""
+    raw = os.environ.get("OPENSIM_SCAN_UNROLL", "1")
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"OPENSIM_SCAN_UNROLL must be a positive integer, got {raw!r}"
+        ) from None
+    if val < 1:
+        raise ValueError(f"OPENSIM_SCAN_UNROLL must be >= 1, got {raw!r}")
+    return val
 
 
 class ScheduleOutput(NamedTuple):

@@ -37,7 +37,7 @@ from ..models.objects import (
 )
 from ..ops import kernels
 from . import queues
-from .scheduler import pad_pod_stream, schedule_pods, to_device
+from .scheduler import pad_pod_stream, scan_unroll, schedule_pods, to_device
 
 
 @dataclass
@@ -432,6 +432,7 @@ def simulate(
             out = schedule_pods(
                 ec, st0, tmpl_p, valid_p, forced_p,
                 features=prep.features, config=sched_config, extra_plugins=extra_plugins,
+                unroll=scan_unroll(),
             )
             jax.block_until_ready(out.chosen)  # dispatch is async; trace real device time
         tr.step(f"schedule {len(ordered)} pods")

@@ -21,7 +21,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..encoding.state import EncodedCluster, ScanState
-from ..engine.scheduler import schedule_pods
+from ..engine.scheduler import scan_unroll, schedule_pods
 
 
 class SweepResult(NamedTuple):
@@ -31,7 +31,7 @@ class SweepResult(NamedTuple):
     vg_used: jnp.ndarray  # [S] f32 — total VG bytes allocated
 
 
-def _one_scenario(ec: EncodedCluster, st0: ScanState, tmpl_ids, forced, node_valid, pod_valid, features, config):
+def _one_scenario(ec: EncodedCluster, st0: ScanState, tmpl_ids, forced, node_valid, pod_valid, features, config, unroll):
     out = schedule_pods(
         ec._replace(node_valid=node_valid),
         st0,
@@ -40,6 +40,7 @@ def _one_scenario(ec: EncodedCluster, st0: ScanState, tmpl_ids, forced, node_val
         forced,
         features=features,
         config=config,
+        unroll=unroll,
     )
     unscheduled = jnp.sum(pod_valid & (out.chosen < 0))
     vg_used = jnp.sum(
@@ -48,12 +49,14 @@ def _one_scenario(ec: EncodedCluster, st0: ScanState, tmpl_ids, forced, node_val
     return unscheduled.astype(jnp.int32), out.final_state.used, out.chosen, vg_used
 
 
-@functools.partial(jax.jit, static_argnames=("features", "config"))
-def _sweep_impl(ec, st0, tmpl_ids, node_valid_masks, pod_valid_masks, forced_masks, features, config=None):
+@functools.partial(jax.jit, static_argnames=("features", "config", "unroll"))
+def _sweep_impl(
+    ec, st0, tmpl_ids, node_valid_masks, pod_valid_masks, forced_masks, features, config=None, unroll=1
+):
     """Module-level jitted sweep so repeat invocations hit the jit cache
     (a fresh closure per call would retrace every time)."""
     return jax.vmap(
-        lambda nv, pv, fm: _one_scenario(ec, st0, tmpl_ids, fm, nv, pv, features, config)
+        lambda nv, pv, fm: _one_scenario(ec, st0, tmpl_ids, fm, nv, pv, features, config, unroll)
     )(node_valid_masks, pod_valid_masks, forced_masks)
 
 
@@ -148,6 +151,7 @@ def sweep(
                 *arrays,
                 features=features,
                 config=config,
+                unroll=scan_unroll(),
             )
             from jax.experimental import multihost_utils
 
@@ -155,7 +159,8 @@ def sweep(
         else:
             arrays = tuple(jax.device_put(jnp.asarray(a), shard) for a in arrays)
             out = _sweep_impl(
-                ec, st0, jnp.asarray(tmpl_ids), *arrays, features=features, config=config
+                ec, st0, jnp.asarray(tmpl_ids), *arrays,
+                features=features, config=config, unroll=scan_unroll(),
             )
         out = jax.tree_util.tree_map(lambda a: a[:S], out)
     else:
@@ -166,6 +171,7 @@ def sweep(
             *(jnp.asarray(a) for a in arrays),
             features=features,
             config=config,
+            unroll=scan_unroll(),
         )
     return SweepResult(*out)
 

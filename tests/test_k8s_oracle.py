@@ -1,0 +1,291 @@
+"""Kube-semantics oracle: an INDEPENDENT pure-Python implementation of the
+vendored kube-scheduler filter semantics (noderesources/fit.go, nodeports.go,
+interpodaffinity/filtering.go, podtopologyspread/filtering.go) replays the
+engine's placements pod by pod and checks every decision:
+
+- a pod the engine bound to node n must be feasible on n per the oracle;
+- a pod the engine left unscheduled must be infeasible on EVERY node.
+
+Engine-vs-engine fuzzing (test_fastpath_fuzz.py) cannot catch a semantics
+bug both engines share; this oracle can — it derives feasibility from the
+Go sources directly, not from the tensor encodings."""
+
+import random
+
+import numpy as np
+import pytest
+
+from opensim_tpu.engine.scheduler import pad_pod_stream, schedule_pods
+from opensim_tpu.engine.simulator import AppResource, prepare
+from opensim_tpu.models import ResourceTypes, fixtures as fx, selectors
+from opensim_tpu.models.objects import Node, Pod
+
+HOSTNAME = "kubernetes.io/hostname"
+
+
+# ---------------------------------------------------------------------------
+# the oracle
+# ---------------------------------------------------------------------------
+
+def _match_term(term: dict, ns: str, pod: Pod) -> bool:
+    """PodMatchesTermsNamespaceAndSelector: pod's namespace must be in the
+    term's namespace set (default: the incoming pod's ns) and its labels
+    must match the term's labelSelector (nil selector matches nothing)."""
+    namespaces = term.get("namespaces") or [ns]
+    if pod.metadata.namespace not in namespaces:
+        return False
+    sel = term.get("labelSelector")
+    if sel is None:
+        return False
+    return selectors.match_label_selector(sel, pod.metadata.labels)
+
+
+def _terms(pod: Pod, kind: str, mode: str):
+    aff = (pod.spec.affinity or {}).get(kind) or {}
+    return aff.get(f"{mode}DuringSchedulingIgnoredDuringExecution") or []
+
+
+class Oracle:
+    """Tracks bound pods and answers feasibility per the vendored sources."""
+
+    def __init__(self, nodes):
+        self.nodes = list(nodes)
+        self.by_name = {n.metadata.name: n for n in nodes}
+        self.bound = []  # (pod, node)
+
+    def bind(self, pod: Pod, node: Node):
+        self.bound.append((pod, node))
+
+    # -- individual filters --------------------------------------------------
+
+    def static_ok(self, pod: Pod, node: Node) -> bool:
+        if node.unschedulable:
+            return False
+        if pod.spec.node_name and pod.spec.node_name != node.metadata.name:
+            return False
+        if not selectors.pod_matches_node_selector_and_affinity(pod, node):
+            return False
+        taints = [t for t in node.taints if t.effect in ("NoSchedule", "NoExecute")]
+        return selectors.find_untolerated_taint(taints, pod.spec.tolerations) is None
+
+    def fit_ok(self, pod: Pod, node: Node) -> bool:
+        used = {"pods": 0.0}
+        for p, n in self.bound:
+            if n is node:
+                used["pods"] += 1
+                for k, v in p.resource_requests().items():
+                    used[k] = used.get(k, 0.0) + v
+        req = dict(pod.resource_requests())
+        req["pods"] = req.get("pods", 0.0) + 1
+        for k, v in req.items():
+            if v > 0 and used.get(k, 0.0) + v > node.allocatable.get(k, 0.0):
+                return False
+        return True
+
+    def ports_ok(self, pod: Pod, node: Node) -> bool:
+        def conflict(a, b):
+            if a.protocol != b.protocol or a.host_port != b.host_port:
+                return False
+            ia = "" if a.host_ip in ("", "0.0.0.0") else a.host_ip
+            ib = "" if b.host_ip in ("", "0.0.0.0") else b.host_ip
+            return ia == ib or ia == "" or ib == ""
+
+        mine = pod.host_ports()
+        for p, n in self.bound:
+            if n is not node:
+                continue
+            for theirs in p.host_ports():
+                if any(conflict(m, theirs) for m in mine):
+                    return False
+        return True
+
+    def interpod_ok(self, pod: Pod, node: Node) -> bool:
+        ns = pod.metadata.namespace
+        # (1) existing pods' required anti-affinity vs the incoming pod
+        # (satisfyExistingPodsAntiAffinity): violating when an existing pod
+        # has a required anti term matching the incoming pod AND the
+        # candidate node shares that term's topology (key, value) with the
+        # existing pod's node
+        for p, n in self.bound:
+            for term in _terms(p, "podAntiAffinity", "required"):
+                if not _match_term(term, p.metadata.namespace, pod):
+                    continue
+                key = term.get("topologyKey", "")
+                val = n.metadata.labels.get(key)
+                if val is not None and node.metadata.labels.get(key) == val:
+                    return False
+        # (2) incoming pod's required anti-affinity (satisfyPodAntiAffinity):
+        # node missing the key → vacuously fine
+        for term in _terms(pod, "podAntiAffinity", "required"):
+            key = term.get("topologyKey", "")
+            my_val = node.metadata.labels.get(key)
+            if my_val is None:
+                continue
+            for p, n in self.bound:
+                if n.metadata.labels.get(key) == my_val and _match_term(term, ns, p):
+                    return False
+        # (3) incoming pod's required affinity (satisfyPodAffinity): counts
+        # come from pods matching ALL terms; every term needs its key on the
+        # node and a positive count; bootstrap when the global map is empty
+        # and the pod matches all its own terms
+        terms = _terms(pod, "podAffinity", "required")
+        if terms:
+            all_matching = [
+                (p, n) for p, n in self.bound if all(_match_term(t, ns, p) for t in terms)
+            ]
+            labels_ok = all(node.metadata.labels.get(t.get("topologyKey", "")) is not None for t in terms)
+            per_term_ok = labels_ok and all(
+                any(
+                    n.metadata.labels.get(t.get("topologyKey", ""))
+                    == node.metadata.labels.get(t.get("topologyKey", ""))
+                    for _p, n in all_matching
+                    if n.metadata.labels.get(t.get("topologyKey", "")) is not None
+                )
+                for t in terms
+            )
+            if not per_term_ok:
+                map_empty = not any(
+                    n.metadata.labels.get(t.get("topologyKey", "")) is not None
+                    for _p, n in all_matching
+                    for t in terms
+                )
+                self_match = all(_match_term(t, ns, pod) for t in terms)
+                if not (labels_ok and map_empty and self_match):
+                    return False
+        return True
+
+    def spread_ok(self, pod: Pod, node: Node) -> bool:
+        ns = pod.metadata.namespace
+        for c in pod.spec.topology_spread_constraints:
+            if c.get("whenUnsatisfiable", "DoNotSchedule") != "DoNotSchedule":
+                continue
+            key = c.get("topologyKey", "")
+            skew = int(c.get("maxSkew", 1))
+            sel = c.get("labelSelector")
+            my_val = node.metadata.labels.get(key)
+            if my_val is None:
+                return False  # node missing the label fails the constraint
+            def matches(p):
+                return p.metadata.namespace == ns and sel is not None and selectors.match_label_selector(
+                    sel, p.metadata.labels
+                )
+            counts = {}
+            for p, n in self.bound:
+                val = n.metadata.labels.get(key)
+                if val is not None and matches(p):
+                    counts[val] = counts.get(val, 0) + 1
+            # min over eligible domains: nodes passing the incoming pod's
+            # node affinity/selector that carry the label
+            eligible_vals = {
+                n.metadata.labels.get(key)
+                for n in self.nodes
+                if n.metadata.labels.get(key) is not None
+                and selectors.pod_matches_node_selector_and_affinity(pod, n)
+            }
+            if not eligible_vals:
+                return False
+            min_cnt = min(counts.get(v, 0) for v in eligible_vals)
+            self_match = 1 if matches(pod) else 0
+            if counts.get(my_val, 0) + self_match - min_cnt > skew:
+                return False
+        return True
+
+    def feasible(self, pod: Pod, node: Node) -> bool:
+        return (
+            self.static_ok(pod, node)
+            and self.fit_ok(pod, node)
+            and self.ports_ok(pod, node)
+            and self.interpod_ok(pod, node)
+            and self.spread_ok(pod, node)
+        )
+
+
+# ---------------------------------------------------------------------------
+# generators (no GPU/local storage — out of the oracle's scope)
+# ---------------------------------------------------------------------------
+
+def random_cluster(rng, n):
+    rt = ResourceTypes()
+    for i in range(n):
+        labels = {}
+        if rng.random() < 0.8:
+            labels["topology.kubernetes.io/zone"] = f"z{rng.randrange(3)}"
+        if rng.random() < 0.5:
+            labels["topology.kubernetes.io/region"] = f"r{rng.randrange(2)}"
+        if rng.random() < 0.4:
+            labels["disk"] = rng.choice(["ssd", "hdd"])
+        opts = [fx.with_labels(labels)]
+        if rng.random() < 0.25:
+            opts.append(fx.with_taints([{"key": "dedicated", "value": "x",
+                                         "effect": rng.choice(["NoSchedule", "PreferNoSchedule"])}]))
+        rt.nodes.append(fx.make_fake_node(f"n{i:03d}", str(rng.choice([4, 8])), "16Gi", "20", *opts))
+    return rt
+
+
+def random_app(rng, n_workloads):
+    rt = ResourceTypes()
+    for w in range(n_workloads):
+        opts = []
+        if rng.random() < 0.3:
+            opts.append(fx.with_node_selector({"disk": rng.choice(["ssd", "hdd"])}))
+        if rng.random() < 0.3:
+            opts.append(fx.with_tolerations(
+                [{"key": "dedicated", "operator": "Equal", "value": "x", "effect": "NoSchedule"}]))
+        if rng.random() < 0.35:
+            opts.append(fx.with_topology_spread([{
+                "maxSkew": rng.choice([1, 2]),
+                "topologyKey": rng.choice(
+                    [HOSTNAME, "topology.kubernetes.io/zone", "topology.kubernetes.io/region"]),
+                "whenUnsatisfiable": rng.choice(["DoNotSchedule", "ScheduleAnyway"]),
+                "labelSelector": {"matchLabels": {"app": f"w{w}"}},
+            }]))
+        if rng.random() < 0.35:
+            kind = rng.choice(["podAffinity", "podAntiAffinity"])
+            n_terms = rng.randrange(1, 3) if kind == "podAffinity" else 1
+            terms = [{
+                "labelSelector": {"matchLabels": {"app": f"w{rng.randrange(max(w, 1))}" if w else f"w{w}"}},
+                "topologyKey": rng.choice(
+                    [HOSTNAME, "topology.kubernetes.io/zone", "topology.kubernetes.io/region"]),
+            } for _ in range(n_terms)]
+            opts.append(fx.with_affinity(
+                {kind: {"requiredDuringSchedulingIgnoredDuringExecution": terms}}))
+        if rng.random() < 0.25:
+            opts.append(fx.with_host_ports([rng.choice([8080, 9090])]))
+        rt.deployments.append(fx.make_fake_deployment(
+            f"w{w}", rng.randrange(2, 7),
+            f"{rng.choice([250, 500, 1000, 2000])}m", f"{rng.choice([256, 512, 2048])}Mi", *opts))
+    return rt
+
+
+@pytest.mark.parametrize("seed", [3, 17, 29, 61, 97])
+def test_engine_matches_k8s_oracle(seed):
+    rng = random.Random(seed)
+    cluster = random_cluster(rng, rng.randrange(4, 10))
+    app = random_app(rng, rng.randrange(3, 7))
+    prep = prepare(cluster, [AppResource("oracle", app)], node_pad=8)
+    if prep is None:
+        pytest.skip("empty workload")
+    P = len(prep.ordered)
+    t, v, f = pad_pod_stream(prep.tmpl_ids, np.ones(P, bool), prep.forced)
+    out = schedule_pods(prep.ec, prep.st0, t, v, f, features=prep.features)
+    chosen = np.asarray(out.chosen)[:P]
+
+    oracle = Oracle(cluster.nodes)
+    node_names = prep.meta.node_names
+    for i, pod in enumerate(prep.ordered):
+        c = int(chosen[i])
+        if c >= 0:
+            node = oracle.by_name[node_names[c]]
+            assert oracle.feasible(pod, node), (
+                f"seed={seed}: engine bound {pod.metadata.name} to {node.metadata.name}, "
+                f"oracle says infeasible (static={oracle.static_ok(pod, node)} "
+                f"fit={oracle.fit_ok(pod, node)} ports={oracle.ports_ok(pod, node)} "
+                f"interpod={oracle.interpod_ok(pod, node)} spread={oracle.spread_ok(pod, node)})"
+            )
+            oracle.bind(pod, node)
+        else:
+            feasible_nodes = [n.metadata.name for n in cluster.nodes if oracle.feasible(pod, n)]
+            assert not feasible_nodes, (
+                f"seed={seed}: engine left {pod.metadata.name} unscheduled but the oracle "
+                f"finds feasible nodes {feasible_nodes}"
+            )

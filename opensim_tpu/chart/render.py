@@ -254,7 +254,7 @@ def _eval_atom(atom: str, ctx: dict) -> Any:
     parts = atom.split()
     if len(parts) > 1:
         fn = parts[0]
-        if fn in ("int", "quote", "default", "toString", "upper", "lower", "not", "toYaml"):
+        if fn in ("int", "quote", "default", "toString", "upper", "lower", "not", "toYaml", "trunc"):
             args = [_eval_atom(a, ctx) for a in parts[1:]]
             return _apply_fn(fn, args)
         # a call to anything else would silently render as empty — refuse

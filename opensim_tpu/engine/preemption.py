@@ -110,6 +110,13 @@ def preempt_pass(
         return not (sel_features and matches_sel[u].any())
 
     chosen = chosen.copy()
+    # node → evictable bound-pod indices, built once and maintained
+    # incrementally (a full per-node rescan would be O(pods × nodes) per
+    # unschedulable pod)
+    by_node: Dict[int, List[int]] = {}
+    for j in range(len(ordered)):
+        if chosen[j] >= 0 and not forced[j] and victim_ok(int(tmpl[j])):
+            by_node.setdefault(int(chosen[j]), []).append(j)
     for i in range(len(ordered)):
         if chosen[i] >= 0 or forced[i] or prio[i] <= 0:
             continue
@@ -120,15 +127,7 @@ def preempt_pass(
         for n in range(n_real):
             if not _static_ok(ordered[i], nodes[n]):
                 continue
-            cand = [
-                j
-                for j in range(len(ordered))
-                if chosen[j] == n
-                and not forced[j]
-                and prio[j] < prio[i]
-                and j not in victims_of
-                and victim_ok(int(tmpl[j]))
-            ]
+            cand = [j for j in by_node.get(n, []) if prio[j] < prio[i]]
             cand.sort(key=lambda j: (prio[j], j))
             free = alloc[n] - used[n]
             taken: List[int] = []
@@ -150,6 +149,10 @@ def preempt_pass(
             victims_of[j] = i
             used[n] -= req[int(tmpl[j])]
             chosen[j] = -1
+        taken_set = set(taken)
+        by_node[n] = [j for j in by_node.get(n, []) if j not in taken_set]
         used[n] += req[u]
         chosen[i] = n
+        if victim_ok(u):
+            by_node[n].append(i)  # the preemptor may itself be preempted later
     return chosen, victims_of

@@ -5,8 +5,7 @@ works through the queue (``pkg/simulator/simulator.go:311-321``) and shows
 spinners around cluster snapshots (``:506-509``). Here the whole bind scan is
 ONE fused device op, so per-pod increments don't exist; instead each host
 phase gets a live spinner with an elapsed-time readout and a final tally
-(``✓ schedule 50000 pods (2.4s)``), and host-side loops can render a plain
-bar. Output is TTY-gated (the ``DisablePTerm`` equivalent) and goes to
+(``✓ schedule 50000 pods (2.4s)``). Output is TTY-gated (the ``DisablePTerm`` equivalent) and goes to
 stderr so piped reports stay clean; ``OPENSIM_NO_PROGRESS=1`` force-disables.
 """
 
@@ -67,15 +66,3 @@ class Spinner:
             self.stream.write(f"\r{mark} {self.label} ({dt:.1f}s)\n")
             self.stream.flush()
 
-
-def bar(done: int, total: int, label: str, stream: Optional[TextIO] = None, width: int = 24) -> None:
-    """One-line in-place progress bar for host-side loops."""
-    stream = stream if stream is not None else sys.stderr
-    if not enabled_by_default(stream):
-        return
-    total = max(total, 1)
-    filled = int(width * min(done, total) / total)
-    stream.write(f"\r{label} [{'█' * filled}{'░' * (width - filled)}] {done}/{total}")
-    if done >= total:
-        stream.write("\n")
-    stream.flush()

@@ -96,6 +96,8 @@ def random_app(rng: random.Random, n_workloads: int) -> ResourceTypes:
                      "topology.kubernetes.io/region"]
                 ),
             }
+            if rng.random() < 0.3:
+                term["namespaces"] = rng.sample(["ns-a", "ns-b", "default"], rng.randrange(1, 3))
             if mode == "required":
                 aff = {kind: {"requiredDuringSchedulingIgnoredDuringExecution": [term]}}
             else:
@@ -109,6 +111,8 @@ def random_app(rng: random.Random, n_workloads: int) -> ResourceTypes:
             opts.append(fx.with_affinity(aff))
         if rng.random() < 0.2:
             opts.append(fx.with_host_ports([rng.choice([8080, 9090, 9443])]))
+        if rng.random() < 0.4:
+            opts.append(fx.with_namespace(rng.choice(["ns-a", "ns-b"])))
         deploy = fx.make_fake_deployment(
             f"w{w}",
             rng.randrange(2, 10),

@@ -242,15 +242,22 @@ def random_app(rng, n_workloads):
         if rng.random() < 0.35:
             kind = rng.choice(["podAffinity", "podAntiAffinity"])
             n_terms = rng.randrange(1, 3) if kind == "podAffinity" else 1
-            terms = [{
-                "labelSelector": {"matchLabels": {"app": f"w{rng.randrange(max(w, 1))}" if w else f"w{w}"}},
-                "topologyKey": rng.choice(
-                    [HOSTNAME, "topology.kubernetes.io/zone", "topology.kubernetes.io/region"]),
-            } for _ in range(n_terms)]
+            terms = []
+            for _ in range(n_terms):
+                term = {
+                    "labelSelector": {"matchLabels": {"app": f"w{rng.randrange(max(w, 1))}" if w else f"w{w}"}},
+                    "topologyKey": rng.choice(
+                        [HOSTNAME, "topology.kubernetes.io/zone", "topology.kubernetes.io/region"]),
+                }
+                if rng.random() < 0.4:  # explicit multi-namespace scoping
+                    term["namespaces"] = rng.sample(["ns-a", "ns-b", "default"], rng.randrange(1, 3))
+                terms.append(term)
             opts.append(fx.with_affinity(
                 {kind: {"requiredDuringSchedulingIgnoredDuringExecution": terms}}))
         if rng.random() < 0.25:
             opts.append(fx.with_host_ports([rng.choice([8080, 9090])]))
+        if rng.random() < 0.5:
+            opts.append(fx.with_namespace(rng.choice(["ns-a", "ns-b"])))
         rt.deployments.append(fx.make_fake_deployment(
             f"w{w}", rng.randrange(2, 7),
             f"{rng.choice([250, 500, 1000, 2000])}m", f"{rng.choice([256, 512, 2048])}Mi", *opts))

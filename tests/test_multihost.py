@@ -11,6 +11,8 @@ import sys
 import numpy as np
 import pytest
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 _CHILD = r"""
 import os, sys
 import jax
@@ -67,13 +69,16 @@ def test_two_process_dcn_sweep(tmp_path):
             JAX_NUM_PROCESSES="2",
             JAX_PROCESS_ID=str(pid),
             XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            PYTHONPATH=REPO + (
+                os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+            ),
         )
         env.pop("JAX_PLATFORMS", None)
         procs.append(
             subprocess.Popen(
                 [sys.executable, str(script)],
                 env=env,
-                cwd="/root/repo",
+                cwd=REPO,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,
                 text=True,

@@ -94,9 +94,19 @@ def applicable(prep, config=None) -> bool:
         return False  # --backend xla
     if jax.default_backend() != "tpu" and os.environ.get("OPENSIM_FASTPATH") != "interpret":
         return False
-    # VMEM budget: three [U, N] tables, used/used_out [R, N] ×2, node_cnt
-    # [A, N], per-key zone tables [N, K*Z] ×2 + [K*A, Z] + has_zone [K, N],
-    # masks/misc
+    # VMEM budget. The pallas_call signature is generated per feature-flag
+    # combination (_input_layout): a feature that is off contributes ZERO
+    # rows — its buffers don't exist in the program. Resident rows ([x, N]):
+    #   always: alloc/used0/used/used_out (4R), template tables (3U unless
+    #   big-U), node_cnt (A), has_zone (K), node_valid (1)
+    #   +interpod: anti_node + prefw_node (2G)
+    #   +gpu: gpu0/gpu_free/gpu_out (3Gd)
+    #   +local: vg cap/init/free/out (4Vg) + dev cap/init/free/out + media
+    #   one-hots (6Dv)
+    #   +ports: port_used (Hp)
+    #   +na/tt: one [U, N] table each
+    # plus the zone blocks: zone_NZ + zone_ZN (2·K·N·Z) and the [*, Z]
+    # scratch counts.
     if non_host:
         counts = []
         for key in non_host:
@@ -106,31 +116,49 @@ def applicable(prep, config=None) -> bool:
     else:
         Z = 128
     K = max(len(non_host), 1)
-    # padded global-term rows: the ≤16 caps above pad to at most 16 rows for
-    # each of the anti/pref tables on both the N and Z axes; GPU buffers are
-    # three [Gd_pad, N] arrays (input, scratch, output)
-    G = 16
+    G = 16  # padded global-term row cap (≤16 enforced above)
     Gd_pad = _pad8_static(int(ec.node_gpu_mem.shape[1]))
     Vg_pad = _pad8_static(int(ec.node_vg_cap.shape[1]))
     Dv_pad = _pad8_static(int(ec.node_dev_cap.shape[1]))
-    # local buffers: VG cap/init/out/scratch + device cap/init/out/scratch
-    # + two media one-hot row blocks; ports [Hp, N] ×2; na/tt [U, N] each.
-    # In big-U mode the U-dimensioned tables live in HBM, so U drops out.
-    U_resident = 0 if use_big_u(U) else U
-    local_rows = 4 * Vg_pad + 6 * Dv_pad + 2 * 64 + 2 * U_resident
-    vmem = (
-        (3 * U_resident + 4 * R + A + 2 * G + 3 * Gd_pad + local_rows + 4 + K) * N
-        + (2 * K * N + K * A + 2 * G) * Z
-    ) * 4
+    ports_np = np.asarray(ec.ports)
+    Hp_pad = _pad8_static(
+        int(ports_np.max()) + 1 if ports_np.size and ports_np.max() >= 0 else 1
+    )
+    U_resident = 0 if use_big_u(U, N) else U
+    rows = 4 * R + 3 * U_resident + A + K + 1
+    zone_z_rows = K * A
+    # [X, U] tables resident in non-big-U mode ([X, U_pad128] in big-U they
+    # move to HBM): matches + ports + interpod term tables
+    u_cols = 0 if use_big_u(U, N) else max(U, 128)
+    u_rows = A  # matches_AU
+    if f.interpod or f.prefg:
+        rows += 2 * G
+        zone_z_rows += 2 * G
+        u_rows += 4 * G  # antig/gmatch/prefg/pmatch
+    if f.gpu:
+        rows += 3 * Gd_pad
+    if f.local:
+        rows += 4 * Vg_pad + 6 * Dv_pad
+    if f.ports:
+        rows += Hp_pad
+        u_rows += 2 * Hp_pad  # port_HU + port_conf_HU
+    if f.pref_node_affinity:
+        rows += U_resident
+    if f.prefer_taints:
+        rows += U_resident
+    vmem = (rows * N + (2 * K * N + zone_z_rows) * Z + u_rows * u_cols) * 4
     if vmem > _VMEM_BUDGET:
         return False
     return True
 
 
-def use_big_u(U: int) -> bool:
-    """Template tables move to HBM (per-step DMA) beyond this VMEM-resident
-    cap; below it the fully-resident kernel is faster."""
-    return U > 512
+def use_big_u(U: int, N: int) -> bool:
+    """Template tables move to HBM (per-step DMA) once the three resident
+    [U, N] tables would crowd VMEM; below that the fully-resident kernel is
+    faster. VMEM-aware: a 1000-template workload on a small cluster stays
+    resident (536×256 is 1.6 MB), while 513 templates × 5120 nodes (31 MB)
+    goes to HBM — matching the historical U>512 envelope at headline N."""
+    return 3 * U * N * 4 > 4 * 1024 * 1024
 
 
 _precompute_jit = jax.jit(kernels.precompute_static)
@@ -179,15 +207,20 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
         )
     else:
         Z = 128
-    zone_NZ = np.zeros((N, K * Z), np.float32)
+    # zone_NZ is [K, N, Z] (not [N, K*Z]): per-key blocks must start at lane
+    # offset 0 — Mosaic cannot broadcast a vector sliced out of the flat
+    # layout at lane offset k·Z
+    zone_NZ = np.zeros((K, N, Z), np.float32)
     has_zone = np.zeros((K, N), np.float32)
     for ki, tk in enumerate(zone_tks):
         zd = node_domain[:, tk]
         _ids, zone_inv = np.unique(zd, return_inverse=True)
         present = zd != trash
-        zone_NZ[np.arange(N)[present], ki * Z + zone_inv[present]] = 1.0
+        zone_NZ[ki, np.arange(N)[present], zone_inv[present]] = 1.0
         has_zone[ki] = present.astype(np.float32)
-    zone_ZN = np.ascontiguousarray(zone_NZ.T)
+    zone_ZN = np.ascontiguousarray(
+        zone_NZ.transpose(0, 2, 1).reshape(K * Z, N)
+    )
     key_of_tk = {host_tk: 0}
     for ki, tk in enumerate(zone_tks):
         key_of_tk[tk] = ki + 1
@@ -399,13 +432,20 @@ class _SweepContext:
         ).astype(np.float32)
 
 
-def sweep(prep, node_valid_masks, pod_valid_masks, forced_masks, interpret: Optional[bool] = None):
+def sweep(
+    prep, node_valid_masks, pod_valid_masks, forced_masks,
+    interpret: Optional[bool] = None, big_u: Optional[bool] = None,
+):
     """Scenario sweep on the megakernel: one dispatch per scenario, queued
     asynchronously on the device. Returns (unscheduled [S], used [S, N, R],
-    chosen [S, P], vg_used [S]) matching parallel.scenarios.SweepResult."""
+    chosen [S, P], vg_used [S]) matching parallel.scenarios.SweepResult.
+    `big_u=None` defers to the use_big_u heuristic (tests override it to
+    exercise the HBM-DMA path on small shapes)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     fi, meta = build_inputs(prep)
+    if big_u is None:
+        big_u = use_big_u(*fi.static_pass.shape)
     S = node_valid_masks.shape[0]
     P = pod_valid_masks.shape[1]
     pad = (-P) % CHUNK
@@ -438,7 +478,7 @@ def sweep(prep, node_valid_masks, pod_valid_masks, forced_masks, interpret: Opti
                 has_na=bool(prep.features.pref_node_affinity),
                 has_tt=bool(prep.features.prefer_taints),
                 interpret=interpret,
-                big_u=use_big_u(fi.static_pass.shape[0]),
+                big_u=big_u,
             )
         )
 
@@ -458,12 +498,19 @@ def sweep(prep, node_valid_masks, pod_valid_masks, forced_masks, interpret: Opti
     return unscheduled, np.stack(used), np.stack(chosen_all), vg_used
 
 
-def schedule(prep, tmpl_ids, pod_valid, forced, interpret: Optional[bool] = None):
+def schedule(
+    prep, tmpl_ids, pod_valid, forced,
+    interpret: Optional[bool] = None, big_u: Optional[bool] = None,
+):
     """Run the megakernel on a padded pod stream (P % CHUNK == 0).
-    Returns (chosen [P] i32, used_final [N, R], static_fail [U, 4])."""
+    Returns (chosen [P] i32, used_final [N, R], static_fail [U, 4],
+    gpu_take [P, Gd], gpu_free [N, Gd], vg_free [N, Vg], dev_free [N, Dv]).
+    `big_u=None` defers to the use_big_u heuristic."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     fi, meta = build_inputs(prep)
+    if big_u is None:
+        big_u = use_big_u(*fi.static_pass.shape)
     tmpl_ids = np.asarray(tmpl_ids)
     pod_valid = np.asarray(pod_valid)
     forced = np.asarray(forced)
@@ -483,7 +530,7 @@ def schedule(prep, tmpl_ids, pod_valid, forced, interpret: Optional[bool] = None
         has_na=bool(prep.features.pref_node_affinity),
         has_tt=bool(prep.features.prefer_taints),
         interpret=interpret,
-        big_u=use_big_u(fi.static_pass.shape[0]),
+        big_u=big_u,
     )
     Gd = int(prep.st0.gpu_free.shape[1])
     Vg = int(prep.st0.vg_free.shape[1])

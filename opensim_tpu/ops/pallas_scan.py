@@ -60,7 +60,7 @@ class FastInputs(NamedTuple):
     static_pass: np.ndarray  # [U, N]
     aff_mask: np.ndarray  # [U, N]
     share_raw: np.ndarray  # [U, N]
-    zone_NZ: np.ndarray  # [N, K*Z] — per-zone-key one-hot blocks
+    zone_NZ: np.ndarray  # [K, N, Z] — per-zone-key one-hot blocks (lane offset 0 per key)
     zone_ZN: np.ndarray  # [K*Z, N]
     has_zone: np.ndarray  # [K, N] f32 — node has key k's label
     matches_AU: np.ndarray  # [A, U]
@@ -118,6 +118,76 @@ class FastInputs(NamedTuple):
     tt_raw: np.ndarray  # [U, N] f32 intolerable PreferNoSchedule counts
 
 
+def _input_layout(
+    has_interpod: bool,
+    has_gpu: bool,
+    has_local: bool,
+    has_ports: bool,
+    has_na: bool,
+    has_tt: bool,
+    big_u: bool,
+):
+    """Ordered (name, kind) list of kernel inputs for one feature-flag
+    combination; kind ∈ {stream, smem, vmem, any}. The pallas_call signature
+    is generated from this, so a workload with a feature off pays ZERO
+    VMEM/SMEM for that feature's tables — the buffers don't exist."""
+    ut = "any" if big_u else "vmem"  # U-scaled tables move to HBM in big-U mode
+    L = [
+        ("tmpl", "stream"), ("valid", "stream"), ("forced", "stream"),
+        ("req", "smem"), ("cpu_nz", "smem"), ("mem_nz", "smem"), ("pin", "smem"),
+        ("spr_active", "smem"), ("spr_key", "smem"), ("spr_sel", "smem"),
+        ("spr_skew", "smem"), ("spr_hard", "smem"), ("spr_self", "smem"),
+        ("spr_weight", "smem"),
+    ]
+    if has_interpod:
+        L += [
+            ("at_active", "smem"), ("at_key", "smem"), ("at_sel", "smem"),
+            ("at_self", "smem"),
+            ("an_active", "smem"), ("an_key", "smem"), ("an_sel", "smem"),
+            ("pt_active", "smem"), ("pt_key", "smem"), ("pt_sel", "smem"),
+            ("pt_w", "smem"),
+            ("anti_g_key", "smem"), ("prefg_key", "smem"),
+        ]
+    if has_gpu:
+        L += [("gpu_mem", "smem"), ("gpu_cnt", "smem")]
+    if has_local:
+        L += [("lvm_req", "smem"), ("dev_req", "smem"), ("dev_need", "smem"),
+              ("dev_sizes", "smem")]
+    L += [
+        ("alloc_T", "vmem"), ("used0_T", "vmem"),
+        ("static_pass", ut), ("aff_mask", ut), ("share_raw", ut),
+        ("zone_NZ", "vmem"), ("zone_ZN", "vmem"), ("has_zone", "vmem"),
+        ("matches_AU", ut), ("node_valid", "vmem"),
+    ]
+    if has_interpod:
+        L += [("antig_GU", ut), ("gmatch_GU", ut), ("prefg_GU", ut), ("pmatch_GU", ut)]
+    if has_gpu:
+        L += [("gpu0_DN", "vmem")]
+    if has_local:
+        L += [("vg_cap_VN", "vmem"), ("vg0_VN", "vmem"), ("dev_cap_DN", "vmem"),
+              ("dev0_DN", "vmem"), ("dev_media_DN", "vmem")]
+    if has_ports:
+        L += [("port_HU", ut), ("port_conf_HU", ut)]
+    if has_na:
+        L += [("na_raw", ut)]
+    if has_tt:
+        L += [("tt_raw", ut)]
+    return L
+
+
+def _scratch_names(has_interpod, has_gpu, has_local, has_ports):
+    names = ["used", "node_cnt", "zone_cnt"]
+    if has_interpod:
+        names += ["anti_node", "anti_zone", "prefw_node", "prefw_zone"]
+    if has_gpu:
+        names += ["gpu_free"]
+    if has_local:
+        names += ["vg_free", "dev_free"]
+    if has_ports:
+        names += ["port_used"]
+    return names
+
+
 def _make_kernel(
     has_interpod: bool,
     has_gpu: bool,
@@ -134,52 +204,89 @@ def _make_kernel(
     big_u: bool = False,
     n_zkeys: int = 1,
 ):
-    def kernel(
+    layout = _input_layout(has_interpod, has_gpu, has_local, has_ports, has_na, has_tt, big_u)
+    in_names = [n for n, _ in layout]
+    out_names = ["chosen", "used_out"]
+    if has_gpu:
+        out_names += ["gpu_take", "gpu_out"]
+    if has_local:
+        out_names += ["vg_out", "dev_out"]
+    scratch_names = _scratch_names(has_interpod, has_gpu, has_local, has_ports)
+
+    def kernel(*refs):
+        Rd = dict(zip(in_names + out_names + scratch_names, refs))
+        u_scratch = refs[len(in_names) + len(out_names) + len(scratch_names):]
         # SMEM streams + tables
-        tmpl_ref, valid_ref, forced_ref,
-        req_ref, cpu_nz_ref, mem_nz_ref, pin_ref,
-        sa_ref, sh_ref, ss_ref, sk_ref, shard_ref, sself_ref, sw_ref,
-        ata_ref, ath_ref, ats_ref, atf_ref,
-        ana_ref, anh_ref, ans_ref,
-        pta_ref, pth_ref, pts_ref, ptw_ref,
-        agh_ref, pgh_ref,
-        gmem_ref, gcnt_ref,
-        lvm_ref, dreq_ref, dneed_ref, dsz_ref,
-        # VMEM inputs
-        alloc_ref, used0_ref, static_ref, affm_ref, shraw_ref,
-        zone_nz_ref, zone_zn_ref, has_zone_ref, matches_ref, nodevalid_ref,
-        antig_ref, gmatch_ref, prefg_ref, pmatch_ref, gpu0_ref,
-        vgcap_ref, vg0_ref, devcap_ref, dev0_ref, media_ref,
-        port_hu_ref, port_conf_hu_ref, na_ref, tt_ref,
-        # outputs
-        chosen_ref, used_out_ref, gpu_take_ref, gpu_out_ref, vg_out_ref, dev_out_ref,
-        # scratch
-        used_ref, node_cnt_ref, zone_cnt_ref,
-        anti_node_ref, anti_zone_ref, prefw_node_ref, prefw_zone_ref,
-        gpu_free_ref, vg_free_ref, dev_free_ref, port_used_ref,
-        # big-U mode appends per-step row/column scratches + DMA semaphores
-        *u_scratch,
-    ):
+        tmpl_ref, valid_ref, forced_ref = Rd["tmpl"], Rd["valid"], Rd["forced"]
+        req_ref, cpu_nz_ref, mem_nz_ref, pin_ref = (
+            Rd["req"], Rd["cpu_nz"], Rd["mem_nz"], Rd["pin"])
+        sa_ref, sh_ref, ss_ref, sk_ref, shard_ref, sself_ref, sw_ref = (
+            Rd["spr_active"], Rd["spr_key"], Rd["spr_sel"], Rd["spr_skew"],
+            Rd["spr_hard"], Rd["spr_self"], Rd["spr_weight"])
+        if has_interpod:
+            ata_ref, ath_ref, ats_ref, atf_ref = (
+                Rd["at_active"], Rd["at_key"], Rd["at_sel"], Rd["at_self"])
+            ana_ref, anh_ref, ans_ref = Rd["an_active"], Rd["an_key"], Rd["an_sel"]
+            pta_ref, pth_ref, pts_ref, ptw_ref = (
+                Rd["pt_active"], Rd["pt_key"], Rd["pt_sel"], Rd["pt_w"])
+            agh_ref, pgh_ref = Rd["anti_g_key"], Rd["prefg_key"]
+            antig_ref, gmatch_ref = Rd["antig_GU"], Rd["gmatch_GU"]
+            prefg_ref, pmatch_ref = Rd["prefg_GU"], Rd["pmatch_GU"]
+            anti_node_ref, anti_zone_ref = Rd["anti_node"], Rd["anti_zone"]
+            prefw_node_ref, prefw_zone_ref = Rd["prefw_node"], Rd["prefw_zone"]
+        if has_gpu:
+            gmem_ref, gcnt_ref = Rd["gpu_mem"], Rd["gpu_cnt"]
+            gpu0_ref, gpu_free_ref = Rd["gpu0_DN"], Rd["gpu_free"]
+            gpu_take_ref, gpu_out_ref = Rd["gpu_take"], Rd["gpu_out"]
+        if has_local:
+            lvm_ref, dreq_ref, dneed_ref, dsz_ref = (
+                Rd["lvm_req"], Rd["dev_req"], Rd["dev_need"], Rd["dev_sizes"])
+            vgcap_ref, vg0_ref = Rd["vg_cap_VN"], Rd["vg0_VN"]
+            devcap_ref, dev0_ref, media_ref = (
+                Rd["dev_cap_DN"], Rd["dev0_DN"], Rd["dev_media_DN"])
+            vg_free_ref, dev_free_ref = Rd["vg_free"], Rd["dev_free"]
+            vg_out_ref, dev_out_ref = Rd["vg_out"], Rd["dev_out"]
+        if has_ports:
+            port_hu_ref, port_conf_hu_ref = Rd["port_HU"], Rd["port_conf_HU"]
+            port_used_ref = Rd["port_used"]
+        if has_na:
+            na_ref = Rd["na_raw"]
+        if has_tt:
+            tt_ref = Rd["tt_raw"]
+        alloc_ref, used0_ref = Rd["alloc_T"], Rd["used0_T"]
+        static_ref, affm_ref, shraw_ref = (
+            Rd["static_pass"], Rd["aff_mask"], Rd["share_raw"])
+        zone_nz_ref, zone_zn_ref, has_zone_ref = (
+            Rd["zone_NZ"], Rd["zone_ZN"], Rd["has_zone"])
+        matches_ref, nodevalid_ref = Rd["matches_AU"], Rd["node_valid"]
+        chosen_ref, used_out_ref = Rd["chosen"], Rd["used_out"]
+        used_ref, node_cnt_ref, zone_cnt_ref = (
+            Rd["used"], Rd["node_cnt"], Rd["zone_cnt"])
         R, N = alloc_ref.shape
         U = static_ref.shape[0]
-        Cs = sa_ref.shape[1]
-        Ti = ata_ref.shape[1]
-        Tn = ana_ref.shape[1]
-        Tp = pta_ref.shape[1]
+        Cs = sa_ref.shape[0]
+        if has_interpod:
+            Ti = ata_ref.shape[0]
+            Tn = ana_ref.shape[0]
+            Tp = pta_ref.shape[0]
 
         @pl.when(pl.program_id(0) == 0)
         def _init():
             used_ref[:] = used0_ref[:]
             node_cnt_ref[:] = jnp.zeros_like(node_cnt_ref)
             zone_cnt_ref[:] = jnp.zeros_like(zone_cnt_ref)
-            anti_node_ref[:] = jnp.zeros_like(anti_node_ref)
-            anti_zone_ref[:] = jnp.zeros_like(anti_zone_ref)
-            prefw_node_ref[:] = jnp.zeros_like(prefw_node_ref)
-            prefw_zone_ref[:] = jnp.zeros_like(prefw_zone_ref)
-            gpu_free_ref[:] = gpu0_ref[:]
-            vg_free_ref[:] = vg0_ref[:]
-            dev_free_ref[:] = dev0_ref[:]
-            port_used_ref[:] = jnp.zeros_like(port_used_ref)
+            if has_interpod:
+                anti_node_ref[:] = jnp.zeros_like(anti_node_ref)
+                anti_zone_ref[:] = jnp.zeros_like(anti_zone_ref)
+                prefw_node_ref[:] = jnp.zeros_like(prefw_node_ref)
+                prefw_zone_ref[:] = jnp.zeros_like(prefw_zone_ref)
+            if has_gpu:
+                gpu_free_ref[:] = gpu0_ref[:]
+            if has_local:
+                vg_free_ref[:] = vg0_ref[:]
+                dev_free_ref[:] = dev0_ref[:]
+            if has_ports:
+                port_used_ref[:] = jnp.zeros_like(port_used_ref)
 
         iota_n = jax.lax.broadcasted_iota(jnp.int32, (1, N), 1)
         iota_u = jax.lax.broadcasted_iota(jnp.int32, (U, 1), 0)
@@ -229,20 +336,23 @@ def _make_kernel(
         def body(i, _):
             u = tmpl_ref[i]
             if big_u:
-                # template tables live in HBM (ANY space): DMA this step's
-                # row (for [U, N] tables) / column (for [X, U] tables) into
-                # VMEM scratch — all copies in flight together, one wait.
-                # VMEM stays independent of U; only SMEM scalars scale.
+                # template tables live in HBM: DMA this step's row (for
+                # [U, N] tables) / 128-lane column block (for [X, U] tables
+                # — a 1-lane HBM slice violates the (8,128) tiling, so the
+                # aligned block containing column u is copied and the single
+                # column extracted in VMEM by a one-hot dot) — all copies in
+                # flight together, one wait. VMEM stays independent of U.
                 sems = u_scratch[-1]
                 bufs = list(u_scratch[:-1])
                 dma_state = {"k": 0}
                 copies = []
+                u_blk = (u // 128) * 128
 
                 def _dma(ref, col):
                     k = dma_state["k"]
                     dma_state["k"] = k + 1
                     scratch = bufs[k]
-                    src = ref.at[:, pl.ds(u, 1)] if col else ref.at[pl.ds(u, 1)]
+                    src = ref.at[:, pl.ds(u_blk, 128)] if col else ref.at[pl.ds(u, 1)]
                     cp = pltpu.make_async_copy(src, scratch, sems.at[k])
                     cp.start()
                     copies.append(cp)
@@ -264,16 +374,24 @@ def _make_kernel(
                     s_pmatch = _dma(pmatch_ref, True)
                 for cp in copies:
                     cp.wait()
+                lane_oh = (
+                    jax.lax.broadcasted_iota(jnp.int32, (128, 1), 0) == (u - u_blk)
+                ).astype(jnp.float32)
+
+                def col_of(scratch):  # [X, 128] block -> [X, 1] column u
+                    return jnp.dot(scratch[:], lane_oh, preferred_element_type=jnp.float32)
+
                 static_row = s_static[:]
             else:
                 static_row = static_ref[pl.ds(u, 1), :]  # [1, N] (validity applied separately)
-            for d in range(n_gpu):  # SMEM outputs have no default value
-                gpu_take_ref[i, d] = jnp.float32(0.0)
+            if has_gpu:
+                for d in range(n_gpu):  # SMEM outputs have no default value
+                    gpu_take_ref[d, i] = jnp.float32(0.0)
 
             # --- NodeResourcesFit
             fit = ones_1n
             for r in range(R):
-                req_r = req_ref[u, r]
+                req_r = req_ref[r, u]
                 over = (used_ref[pl.ds(r, 1), :] + req_r > alloc_ref[pl.ds(r, 1), :]).astype(jnp.float32)
                 fit = fit * jnp.where(req_r > 0, 1.0 - over, 1.0)
             # node validity is a runtime row (NOT folded into static_pass) so
@@ -285,7 +403,7 @@ def _make_kernel(
                 # (wildcard-expanded template rows via one-hot matvec, or the
                 # DMA'd column in big-U mode)
                 if big_u:
-                    my_ports = s_portc[:]  # [Hp, 1]
+                    my_ports = col_of(s_portc)  # [Hp, 1]
                 else:
                     onehot_u_p = (iota_u == u).astype(jnp.float32)
                     my_ports = jnp.dot(
@@ -324,7 +442,7 @@ def _make_kernel(
                 # needs ≥ i+1 free fitting devices (common.go:290-349)
                 for m in range(2):
                     for vi in range(n_dvol):
-                        size = dsz_ref[u, m * n_dvol + vi]
+                        size = dsz_ref[m * n_dvol + vi, u]
                         cnt_fit = jnp.zeros((1, N), jnp.float32)
                         for d in range(n_dev):
                             free_d = dev_free_ref[pl.ds(d, 1), :]
@@ -340,20 +458,20 @@ def _make_kernel(
             ignored = jnp.zeros((1, N), jnp.float32)
             any_soft = jnp.float32(0.0)
             for c in range(Cs):
-                active = sa_ref[u, c]
-                skew = sk_ref[u, c]
-                cnt, has_label = sel_cnt(ss_ref[u, c], sh_ref[u, c])
+                active = sa_ref[c, u]
+                skew = sk_ref[c, u]
+                cnt, has_label = sel_cnt(ss_ref[c, u], sh_ref[c, u])
                 activef = active == 1
-                hardf = activef & (shard_ref[u, c] == 1)
-                softf = activef & (shard_ref[u, c] == 0)
+                hardf = activef & (shard_ref[c, u] == 1)
+                softf = activef & (shard_ref[c, u] == 0)
 
                 elig = aff_row * has_label
                 masked = jnp.where(elig > 0, cnt, jnp.float32(1e30))
                 min_cnt = jnp.min(masked)
-                ok = (cnt + sself_ref[u, c] - min_cnt <= skew) & (has_label > 0)
+                ok = (cnt + sself_ref[c, u] - min_cnt <= skew) & (has_label > 0)
                 feasible = jnp.where(hardf, feasible * ok.astype(jnp.float32), feasible)
 
-                contrib = jnp.where(has_label > 0, cnt * sw_ref[u, c] + (skew - 1.0), 0.0)
+                contrib = jnp.where(has_label > 0, cnt * sw_ref[c, u] + (skew - 1.0), 0.0)
                 soft_raw = soft_raw + jnp.where(softf, contrib, 0.0)
                 ignored = jnp.maximum(ignored, jnp.where(softf, 1.0 - has_label, 0.0))
                 any_soft = jnp.maximum(any_soft, jnp.where(softf, 1.0, 0.0))
@@ -364,10 +482,10 @@ def _make_kernel(
                     onehot_u_col = (iota_u == u).astype(jnp.float32)  # [U, 1]
                 # incoming required anti-affinity: no matching pod in domain
                 for t in range(Tn):
-                    cnt, has_label = sel_cnt(ans_ref[u, t], anh_ref[u, t])
+                    cnt, has_label = sel_cnt(ans_ref[t, u], anh_ref[t, u])
                     violated = (cnt > 0) & (has_label > 0)
                     feasible = jnp.where(
-                        ana_ref[u, t] == 1, feasible * (1.0 - violated.astype(jnp.float32)), feasible
+                        ana_ref[t, u] == 1, feasible * (1.0 - violated.astype(jnp.float32)), feasible
                     )
                 # incoming required affinity: counts use the all-terms
                 # conjunction selector (filtering.go:113-127). A node passes
@@ -380,14 +498,14 @@ def _make_kernel(
                 at_map_total = jnp.float32(0.0)
                 at_self_all = jnp.float32(1.0)
                 for t in range(Ti):
-                    cnt, has_label = sel_cnt(ats_ref[u, t], ath_ref[u, t])
-                    total_host = jnp.sum(node_cnt_ref[pl.ds(ats_ref[u, t], 1), :])
-                    at_k = jnp.maximum(ath_ref[u, t] - 1, 0)
+                    cnt, has_label = sel_cnt(ats_ref[t, u], ath_ref[t, u])
+                    total_host = jnp.sum(node_cnt_ref[pl.ds(ats_ref[t, u], 1), :])
+                    at_k = jnp.maximum(ath_ref[t, u] - 1, 0)
                     total_zone = jnp.sum(
-                        zone_cnt_ref[pl.ds(at_k * A_rows + ats_ref[u, t], 1), :]
+                        zone_cnt_ref[pl.ds(at_k * A_rows + ats_ref[t, u], 1), :]
                     )
-                    total = jnp.where(ath_ref[u, t] == 0, total_host, total_zone)
-                    activef = ata_ref[u, t] == 1
+                    total = jnp.where(ath_ref[t, u] == 0, total_host, total_zone)
+                    activef = ata_ref[t, u] == 1
                     term_ok = ((cnt > 0) & (has_label > 0)).astype(jnp.float32)
                     at_all_ok = jnp.where(activef, at_all_ok * term_ok, at_all_ok)
                     at_labels_ok = jnp.where(
@@ -395,7 +513,7 @@ def _make_kernel(
                     )
                     at_map_total = at_map_total + jnp.where(activef, total, 0.0)
                     at_self_all = at_self_all * jnp.where(
-                        activef, (atf_ref[u, t] > 0).astype(jnp.float32), 1.0
+                        activef, (atf_ref[t, u] > 0).astype(jnp.float32), 1.0
                     )
                 at_bootstrap = ((at_map_total == 0.0) & (at_self_all > 0)).astype(jnp.float32)
                 feasible = feasible * jnp.maximum(at_all_ok, at_labels_ok * at_bootstrap)
@@ -406,7 +524,7 @@ def _make_kernel(
                 # the label (applicable() enforces hostname-identity); zone
                 # gathers give 0 on label-less nodes via the one-hot.
                 if big_u:
-                    my_gmatch = s_gmatch[:]
+                    my_gmatch = col_of(s_gmatch)
                 else:
                     my_gmatch = jnp.dot(gmatch_ref[:], onehot_u_col, preferred_element_type=jnp.float32)
                 m_row = my_gmatch.reshape(1, n_anti)
@@ -422,14 +540,14 @@ def _make_kernel(
                 feasible = feasible * (1.0 - (sym_cnt > 0).astype(jnp.float32))
                 # score: incoming preferred terms
                 for t in range(Tp):
-                    cnt, has_label = sel_cnt(pts_ref[u, t], pth_ref[u, t])
+                    cnt, has_label = sel_cnt(pts_ref[t, u], pth_ref[t, u])
                     ip_raw = ip_raw + jnp.where(
-                        pta_ref[u, t] == 1, cnt * ptw_ref[u, t] * has_label, 0.0
+                        pta_ref[t, u] == 1, cnt * ptw_ref[t, u] * has_label, 0.0
                     )
                 # score: symmetric preferred/hard-affinity weights — same
                 # three-dot contraction over the term axis
                 if big_u:
-                    my_pmatch = s_pmatch[:]
+                    my_pmatch = col_of(s_pmatch)
                 else:
                     my_pmatch = jnp.dot(pmatch_ref[:], onehot_u_col, preferred_element_type=jnp.float32)
                 pm_row = my_pmatch.reshape(1, n_pref)
@@ -520,8 +638,8 @@ def _make_kernel(
                 )
                 count = jnp.where(lvm > 0, 1.0, 0.0)
                 for m in range(2):
-                    size = dreq_ref[u, m]
-                    need = dneed_ref[u, m]
+                    size = dreq_ref[m, u]
+                    need = dneed_ref[m, u]
                     first_cap = jnp.full((1, N), big_f, jnp.float32)
                     for d in range(n_dev):
                         free_d = dev_free_ref[pl.ds(d, 1), :]
@@ -568,23 +686,28 @@ def _make_kernel(
                 iota_r = jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0)
                 req_col = jnp.zeros((R, 1), jnp.float32)
                 for r in range(R):  # static unroll; .at[] would lower to scatter
-                    req_col = jnp.where(iota_r == r, req_ref[u, r], req_col)
+                    req_col = jnp.where(iota_r == r, req_ref[r, u], req_col)
                 used_ref[:] = used_ref[:] + req_col * onehot
 
                 if big_u:
-                    m_col = s_match[:]  # [A, 1]
+                    m_col = col_of(s_match)  # [A, 1]
                 else:
                     onehot_u = (iota_u == u).astype(jnp.float32)  # [U, 1]
                     m_col = jnp.dot(matches_ref[:], onehot_u, preferred_element_type=jnp.float32)
-                zrow_c_full = zone_nz_ref[pl.ds(c, 1), :]  # [1, K*Zk]
+                # per-key [1, Zk] one-hot rows of the chosen node's zones —
+                # read from the 3-D [K, N, Z] table so every key's row sits
+                # at lane offset 0 (a lane-offset slice can't broadcast)
+                zrow_k = [
+                    zone_nz_ref[zk, pl.ds(c, 1), :] for zk in range(n_zkeys)
+                ]
                 node_cnt_ref[:] = node_cnt_ref[:] + m_col * onehot
                 for zk in range(n_zkeys):
                     zone_cnt_ref[pl.ds(zk * A_rows, A_rows), :] = (
                         zone_cnt_ref[pl.ds(zk * A_rows, A_rows), :]
-                        + m_col * zrow_c_full[:, zk * Zk : (zk + 1) * Zk]
+                        + m_col * zrow_k[zk]
                     )
                 if has_ports:
-                    p_col = s_port[:] if big_u else jnp.dot(
+                    p_col = col_of(s_port) if big_u else jnp.dot(
                         port_hu_ref[:], onehot_u, preferred_element_type=jnp.float32
                     )
                     port_used_ref[:] = port_used_ref[:] + p_col * onehot
@@ -611,7 +734,7 @@ def _make_kernel(
                         take_d = jnp.where(gcnt == 1, take_tight, take_greedy)
                         take_d = jnp.where(gmem > 0, take_d, 0.0)
                         gpu_free_ref[pl.ds(d, 1), :] = free_d - take_d * gmem * onehot
-                        gpu_take_ref[i, d] = jnp.sum(take_d * onehot)
+                        gpu_take_ref[d, i] = jnp.sum(take_d * onehot)
                 if has_local:
                     # LVM: tightest-fitting VG (first among equals)
                     lvm = lvm_ref[u]
@@ -636,7 +759,7 @@ def _make_kernel(
                     taken_rows = [jnp.zeros((1, N), jnp.float32) for _ in range(n_dev)]
                     for m in range(2):
                         for vi in reversed(range(n_dvol)):  # ascending sizes
-                            size = dsz_ref[u, m * n_dvol + vi]
+                            size = dsz_ref[m * n_dvol + vi, u]
                             best_cap = jnp.full((1, N), big_cap, jnp.float32)
                             for d in range(n_dev):
                                 free_d = dev_free_ref[pl.ds(d, 1), :]
@@ -666,32 +789,34 @@ def _make_kernel(
                                 taken_rows[d] = jnp.maximum(taken_rows[d], take_d)
                                 dev_free_ref[pl.ds(d, 1), :] = free_d * (1.0 - take_d * onehot)
                 if has_interpod:
-                    a_col = s_antig[:] if big_u else jnp.dot(
+                    a_col = col_of(s_antig) if big_u else jnp.dot(
                         antig_ref[:], onehot_u, preferred_element_type=jnp.float32
                     )
                     anti_node_ref[:] = anti_node_ref[:] + a_col * onehot
                     for zk in range(n_zkeys):
                         key_mask = (g_key_col == zk + 1).astype(jnp.float32)
-                        anti_zone_ref[:] = anti_zone_ref[:] + a_col * key_mask * zrow_c_full[
-                            :, zk * Zk : (zk + 1) * Zk
-                        ]
-                    p_col = s_prefg[:] if big_u else jnp.dot(
+                        anti_zone_ref[:] = (
+                            anti_zone_ref[:] + a_col * key_mask * zrow_k[zk]
+                        )
+                    p_col = col_of(s_prefg) if big_u else jnp.dot(
                         prefg_ref[:], onehot_u, preferred_element_type=jnp.float32
                     )
                     prefw_node_ref[:] = prefw_node_ref[:] + p_col * onehot
                     for zk in range(n_zkeys):
                         key_mask = (p_key_col == zk + 1).astype(jnp.float32)
-                        prefw_zone_ref[:] = prefw_zone_ref[:] + p_col * key_mask * zrow_c_full[
-                            :, zk * Zk : (zk + 1) * Zk
-                        ]
+                        prefw_zone_ref[:] = (
+                            prefw_zone_ref[:] + p_col * key_mask * zrow_k[zk]
+                        )
 
             return 0
 
         jax.lax.fori_loop(0, tmpl_ref.shape[0], body, 0)
         used_out_ref[:] = used_ref[:]
-        gpu_out_ref[:] = gpu_free_ref[:]
-        vg_out_ref[:] = vg_free_ref[:]
-        dev_out_ref[:] = dev_free_ref[:]
+        if has_gpu:
+            gpu_out_ref[:] = gpu_free_ref[:]
+        if has_local:
+            vg_out_ref[:] = vg_free_ref[:]
+            dev_out_ref[:] = dev_free_ref[:]
 
     return kernel
 
@@ -722,7 +847,7 @@ def run_fast_scan(
     R, N = fi.alloc_T.shape
     A = fi.matches_AU.shape[0]
     K = fi.has_zone.shape[0]  # number of non-hostname topology keys (>= 1)
-    Z = fi.zone_NZ.shape[1] // K
+    Z = fi.zone_NZ.shape[2]
     G = fi.antig_GU.shape[0]
     Gp = fi.prefg_GU.shape[0]
     Gd = fi.gpu0_DN.shape[0]
@@ -733,37 +858,104 @@ def run_fast_scan(
 
     smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
     vmem = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
+    # big-U tables are pinned to HBM (not ANY): if Mosaic places an ANY
+    # buffer in VMEM — which it does when the table happens to fit — the
+    # per-step 1-row DMA slice violates the (8,128) VMEM tiling alignment
+    # and the kernel fails to compile
+    anyspace = lambda: pl.BlockSpec(memory_space=pltpu.HBM)
     stream = lambda: pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM)
 
-    # which of the 24 VMEM inputs move to HBM (ANY) in big-U mode: the
-    # U-dimensioned tables, in kernel parameter order
-    _U_TABLE_POS = {2, 3, 4, 8, 10, 11, 12, 13, 20, 21, 22, 23}
+    _I32 = {"tmpl", "valid", "forced", "pin", "spr_active", "spr_key", "spr_sel",
+            "spr_hard", "at_active", "at_key", "at_sel", "an_active", "an_key",
+            "an_sel", "pt_active", "pt_key", "pt_sel", "anti_g_key", "prefg_key"}
+    # [X, U] tables whose big-U DMA copies an aligned 128-lane column block:
+    # pad U to a 128 multiple so the block at (u // 128)·128 never overruns
+    _COL_TABLES = {"matches_AU", "port_HU", "port_conf_HU",
+                   "antig_GU", "gmatch_GU", "prefg_GU", "pmatch_GU"}
+    # 2-D SMEM scalar tables are stored TRANSPOSED ([X, U], U minor): an
+    # SMEM array's minor dim pads to 128 lanes, so the natural [U, X] layout
+    # with X ≤ 8 would cost 128/X× the memory — fatal at big U (a [2048, 2]
+    # table would pad to 1 MB, the whole SMEM)
+    _SMEM_T = {"req", "spr_active", "spr_key", "spr_sel", "spr_skew",
+               "spr_hard", "spr_self", "spr_weight",
+               "at_active", "at_key", "at_sel", "at_self",
+               "an_active", "an_key", "an_sel",
+               "pt_active", "pt_key", "pt_sel", "pt_w",
+               "dev_req", "dev_need", "dev_sizes"}
+    layout = _input_layout(has_interpod, has_gpu, has_local, has_ports, has_na, has_tt, big_u)
+    in_specs, args = [], []
+    for name, kind in layout:
+        if kind == "stream":
+            in_specs.append(stream())
+            src = {"tmpl": tmpl_ids, "valid": pod_valid, "forced": forced}[name]
+        else:
+            in_specs.append({"smem": smem, "vmem": vmem, "any": anyspace}[kind]())
+            src = getattr(fi, name)
+        arr = jnp.asarray(src, jnp.int32 if name in _I32 else jnp.float32)
+        if name in _SMEM_T:
+            arr = arr.T
+        if big_u and name in _COL_TABLES:
+            pad_u = (-arr.shape[1]) % 128
+            if pad_u:
+                arr = jnp.pad(arr, ((0, 0), (0, pad_u)))
+        args.append(arr)
+
+    # outputs: feature-gated, like the inputs. gpu_take is [Gd, P] (device
+    # rows × pod lanes): an SMEM window's minor dim pads to 128 lanes, so the
+    # natural [P, Gd] layout would burn 1 MB of the chip's 1 MB SMEM on
+    # 8-lane rows — transposed, the window is [Gd, CHUNK] = 32 KB.
+    out_shape = [jax.ShapeDtypeStruct((P,), jnp.int32),
+                 jax.ShapeDtypeStruct((R, N), jnp.float32)]
+    out_specs = [pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM),
+                 pl.BlockSpec((R, N), lambda i: (0, 0), memory_space=pltpu.VMEM)]
+    if has_gpu:
+        out_shape += [jax.ShapeDtypeStruct((Gd, P), jnp.float32),
+                      jax.ShapeDtypeStruct((Gd, N), jnp.float32)]
+        out_specs += [pl.BlockSpec((Gd, CHUNK), lambda i: (0, i), memory_space=pltpu.SMEM),
+                      pl.BlockSpec((Gd, N), lambda i: (0, 0), memory_space=pltpu.VMEM)]
+    if has_local:
+        out_shape += [jax.ShapeDtypeStruct((Vg, N), jnp.float32),
+                      jax.ShapeDtypeStruct((Dv, N), jnp.float32)]
+        out_specs += [pl.BlockSpec((Vg, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                      pl.BlockSpec((Dv, N), lambda i: (0, 0), memory_space=pltpu.VMEM)]
+
+    scratch = [pltpu.VMEM((R, N), jnp.float32),
+               pltpu.VMEM((A, N), jnp.float32),
+               pltpu.VMEM((K * A, Z), jnp.float32)]
+    if has_interpod:
+        scratch += [pltpu.VMEM((G, N), jnp.float32),
+                    pltpu.VMEM((G, Z), jnp.float32),
+                    pltpu.VMEM((Gp, N), jnp.float32),
+                    pltpu.VMEM((Gp, Z), jnp.float32)]
+    if has_gpu:
+        scratch += [pltpu.VMEM((Gd, N), jnp.float32)]
+    if has_local:
+        scratch += [pltpu.VMEM((Vg, N), jnp.float32),
+                    pltpu.VMEM((Dv, N), jnp.float32)]
+    if has_ports:
+        scratch += [pltpu.VMEM((Hp, N), jnp.float32)]
+
     if big_u:
-        vmem_specs = [
-            pl.BlockSpec(memory_space=pl.ANY) if k in _U_TABLE_POS else vmem()
-            for k in range(24)
-        ]
-        # per-step scratch: rows [1, N] for the [U, N] tables, columns [X, 1]
-        # for the [X, U] tables — order must match the kernel's _dma calls
+        # per-step scratch: rows [1, N] for the [U, N] tables, 128-lane
+        # column blocks [X, 128] for the [X, U] tables — order must match
+        # the kernel's _dma calls
         u_scratch = [pltpu.VMEM((1, N), jnp.float32)] * 3  # static, affm, shraw
-        u_scratch.append(pltpu.VMEM((A, 1), jnp.float32))  # matches column
+        u_scratch.append(pltpu.VMEM((A, 128), jnp.float32))  # matches block
         if has_na:
             u_scratch.append(pltpu.VMEM((1, N), jnp.float32))
         if has_tt:
             u_scratch.append(pltpu.VMEM((1, N), jnp.float32))
         if has_ports:
-            u_scratch += [pltpu.VMEM((Hp, 1), jnp.float32)] * 2
+            u_scratch += [pltpu.VMEM((Hp, 128), jnp.float32)] * 2
         if has_interpod:
             u_scratch += [
-                pltpu.VMEM((G, 1), jnp.float32),
-                pltpu.VMEM((G, 1), jnp.float32),
-                pltpu.VMEM((Gp, 1), jnp.float32),
-                pltpu.VMEM((Gp, 1), jnp.float32),
+                pltpu.VMEM((G, 128), jnp.float32),
+                pltpu.VMEM((G, 128), jnp.float32),
+                pltpu.VMEM((Gp, 128), jnp.float32),
+                pltpu.VMEM((Gp, 128), jnp.float32),
             ]
         u_scratch.append(pltpu.SemaphoreType.DMA((len(u_scratch),)))
-    else:
-        vmem_specs = [vmem()] * 24
-        u_scratch = []
+        scratch += u_scratch
 
     out = pl.pallas_call(
         _make_kernel(
@@ -771,106 +963,30 @@ def run_fast_scan(
             G, Gp, Gd, Vg, Dv, fi.dev_sizes.shape[1] // 2, big_u, K,
         ),
         grid=grid,
-        out_shape=(
-            jax.ShapeDtypeStruct((P,), jnp.int32),
-            jax.ShapeDtypeStruct((R, N), jnp.float32),
-            jax.ShapeDtypeStruct((P, Gd), jnp.float32),
-            jax.ShapeDtypeStruct((Gd, N), jnp.float32),
-            jax.ShapeDtypeStruct((Vg, N), jnp.float32),
-            jax.ShapeDtypeStruct((Dv, N), jnp.float32),
-        ),
-        in_specs=(
-            [stream(), stream(), stream()]
-            + [smem()] * 4  # req, cpu_nz, mem_nz, pin
-            + [smem()] * 7  # spread tables
-            + [smem()] * 4  # at_*
-            + [smem()] * 3  # an_*
-            + [smem()] * 4  # pt_*
-            + [smem()] * 2  # anti_g_key, prefg_key
-            + [smem()] * 2  # gpu_mem, gpu_cnt
-            + [smem()] * 4  # lvm_req, dev_req, dev_need, dev_sizes
-            + vmem_specs  # VMEM (or ANY, big-U mode) inputs
-        ),
-        out_specs=(
-            pl.BlockSpec((CHUNK,), lambda i: (i,), memory_space=pltpu.SMEM),
-            pl.BlockSpec((R, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((CHUNK, Gd), lambda i: (i, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((Gd, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((Vg, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((Dv, N), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((R, N), jnp.float32),
-            pltpu.VMEM((A, N), jnp.float32),
-            pltpu.VMEM((K * A, Z), jnp.float32),
-            pltpu.VMEM((G, N), jnp.float32),
-            pltpu.VMEM((G, Z), jnp.float32),
-            pltpu.VMEM((Gp, N), jnp.float32),
-            pltpu.VMEM((Gp, Z), jnp.float32),
-            pltpu.VMEM((Gd, N), jnp.float32),
-            pltpu.VMEM((Vg, N), jnp.float32),
-            pltpu.VMEM((Dv, N), jnp.float32),
-            pltpu.VMEM((Hp, N), jnp.float32),
-        ]
-        + u_scratch,
+        out_shape=tuple(out_shape),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        scratch_shapes=scratch,
         interpret=interpret,
-    )(
-        jnp.asarray(tmpl_ids, jnp.int32),
-        jnp.asarray(pod_valid, jnp.int32),
-        jnp.asarray(forced, jnp.int32),
-        jnp.asarray(fi.req, jnp.float32),
-        jnp.asarray(fi.cpu_nz, jnp.float32),
-        jnp.asarray(fi.mem_nz, jnp.float32),
-        jnp.asarray(fi.pin, jnp.int32),
-        jnp.asarray(fi.spr_active, jnp.int32),
-        jnp.asarray(fi.spr_key, jnp.int32),
-        jnp.asarray(fi.spr_sel, jnp.int32),
-        jnp.asarray(fi.spr_skew, jnp.float32),
-        jnp.asarray(fi.spr_hard, jnp.int32),
-        jnp.asarray(fi.spr_self, jnp.float32),
-        jnp.asarray(fi.spr_weight, jnp.float32),
-        jnp.asarray(fi.at_active, jnp.int32),
-        jnp.asarray(fi.at_key, jnp.int32),
-        jnp.asarray(fi.at_sel, jnp.int32),
-        jnp.asarray(fi.at_self, jnp.float32),
-        jnp.asarray(fi.an_active, jnp.int32),
-        jnp.asarray(fi.an_key, jnp.int32),
-        jnp.asarray(fi.an_sel, jnp.int32),
-        jnp.asarray(fi.pt_active, jnp.int32),
-        jnp.asarray(fi.pt_key, jnp.int32),
-        jnp.asarray(fi.pt_sel, jnp.int32),
-        jnp.asarray(fi.pt_w, jnp.float32),
-        jnp.asarray(fi.anti_g_key, jnp.int32),
-        jnp.asarray(fi.prefg_key, jnp.int32),
-        jnp.asarray(fi.gpu_mem, jnp.float32),
-        jnp.asarray(fi.gpu_cnt, jnp.float32),
-        jnp.asarray(fi.lvm_req, jnp.float32),
-        jnp.asarray(fi.dev_req, jnp.float32),
-        jnp.asarray(fi.dev_need, jnp.float32),
-        jnp.asarray(fi.dev_sizes, jnp.float32),
-        jnp.asarray(fi.alloc_T, jnp.float32),
-        jnp.asarray(fi.used0_T, jnp.float32),
-        jnp.asarray(fi.static_pass, jnp.float32),
-        jnp.asarray(fi.aff_mask, jnp.float32),
-        jnp.asarray(fi.share_raw, jnp.float32),
-        jnp.asarray(fi.zone_NZ, jnp.float32),
-        jnp.asarray(fi.zone_ZN, jnp.float32),
-        jnp.asarray(fi.has_zone, jnp.float32),
-        jnp.asarray(fi.matches_AU, jnp.float32),
-        jnp.asarray(fi.node_valid, jnp.float32),
-        jnp.asarray(fi.antig_GU, jnp.float32),
-        jnp.asarray(fi.gmatch_GU, jnp.float32),
-        jnp.asarray(fi.prefg_GU, jnp.float32),
-        jnp.asarray(fi.pmatch_GU, jnp.float32),
-        jnp.asarray(fi.gpu0_DN, jnp.float32),
-        jnp.asarray(fi.vg_cap_VN, jnp.float32),
-        jnp.asarray(fi.vg0_VN, jnp.float32),
-        jnp.asarray(fi.dev_cap_DN, jnp.float32),
-        jnp.asarray(fi.dev0_DN, jnp.float32),
-        jnp.asarray(fi.dev_media_DN, jnp.float32),
-        jnp.asarray(fi.port_HU, jnp.float32),
-        jnp.asarray(fi.port_conf_HU, jnp.float32),
-        jnp.asarray(fi.na_raw, jnp.float32),
-        jnp.asarray(fi.tt_raw, jnp.float32),
-    )
-    return out
+    )(*args)
+
+    # normalize to the fixed 6-tuple callers expect: (chosen, used_T,
+    # gpu_take [P, Gd], gpu_final, vg_final, dev_final) — absent features
+    # report their initial state / zero takes
+    res = list(out)
+    chosen, used_T = res[0], res[1]
+    idx = 2
+    if has_gpu:
+        gpu_take = res[idx].T
+        gpu_T = res[idx + 1]
+        idx += 2
+    else:
+        gpu_take = jnp.zeros((P, Gd), jnp.float32)
+        gpu_T = jnp.asarray(fi.gpu0_DN, jnp.float32)
+    if has_local:
+        vg_T = res[idx]
+        dev_T = res[idx + 1]
+    else:
+        vg_T = jnp.asarray(fi.vg0_VN, jnp.float32)
+        dev_T = jnp.asarray(fi.dev0_DN, jnp.float32)
+    return chosen, used_T, gpu_take, gpu_T, vg_T, dev_T

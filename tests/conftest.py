@@ -6,10 +6,15 @@ var, so the platform must be forced through jax.config after import."""
 
 import os
 
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/opensim-jit-cache")
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# OPENSIM_TEST_BACKEND=tpu opts out of the CPU forcing so the fastpath /
+# kernel-parity tests can run through compiled Mosaic on real hardware.
+if os.environ.get("OPENSIM_TEST_BACKEND") != "tpu":
+    jax.config.update("jax_platforms", "cpu")

@@ -1,5 +1,8 @@
 """The Pallas megakernel must produce IDENTICAL placements to the XLA scan
-on its supported feature subset (runs in interpret mode on CPU)."""
+on its supported feature subset. Runs in interpret mode on CPU;
+OPENSIM_TEST_BACKEND=tpu compiles the kernel through Mosaic for real."""
+
+import os
 
 import numpy as np
 import pytest
@@ -8,6 +11,8 @@ from opensim_tpu.engine import fastpath
 from opensim_tpu.engine.scheduler import pad_pod_stream, schedule_pods
 from opensim_tpu.engine.simulator import AppResource, prepare
 from opensim_tpu.models import ResourceTypes, fixtures as fx
+
+_INTERPRET = os.environ.get("OPENSIM_TEST_BACKEND") != "tpu"
 
 
 @pytest.fixture(autouse=True)
@@ -153,7 +158,7 @@ def test_fastpath_matches_xla_gpu():
     want_take = np.asarray(out.gpu_take)[:P]
     want_gpu = np.asarray(out.final_state.gpu_free)
     got_chosen, got_used, _sf, got_take, got_gpu, _vg, _dv = fastpath.schedule(
-        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=_INTERPRET
     )
     np.testing.assert_array_equal(got_chosen, want_chosen)
     np.testing.assert_allclose(got_take, want_take, rtol=1e-6)
@@ -192,7 +197,7 @@ def test_fastpath_matches_xla_ports_na_tt():
     P = len(prep.ordered)
     want_chosen, want_used = _xla_chosen(prep)
     got_chosen, got_used, *_rest = fastpath.schedule(
-        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=_INTERPRET
     )
     np.testing.assert_array_equal(got_chosen, want_chosen)
     np.testing.assert_allclose(got_used, want_used, rtol=1e-5)
@@ -246,7 +251,7 @@ def test_fastpath_matches_xla_local_storage():
     out = schedule_pods(prep.ec, prep.st0, t, v, f, features=prep.features)
     want_chosen = np.asarray(out.chosen)[:P]
     got_chosen, got_used, _sf, _gt, _gf, got_vg, got_dev = fastpath.schedule(
-        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=_INTERPRET
     )
     np.testing.assert_array_equal(got_chosen, want_chosen)
     np.testing.assert_allclose(got_vg, np.asarray(out.final_state.vg_free), rtol=1e-6)
@@ -260,7 +265,7 @@ def test_fastpath_matches_xla(with_spread, with_zone):
     P = len(prep.ordered)
     want_chosen, want_used = _xla_chosen(prep)
     got_chosen, got_used, *_rest = fastpath.schedule(
-        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=_INTERPRET
     )
     mismatches = np.nonzero(want_chosen != got_chosen)[0]
     assert mismatches.size == 0, (
@@ -344,7 +349,7 @@ def test_fastpath_matches_xla_interpod():
     P = len(prep.ordered)
     want_chosen, want_used = _xla_chosen(prep)
     got_chosen, got_used, *_rest = fastpath.schedule(
-        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=_INTERPRET
     )
     mism = np.nonzero(want_chosen != got_chosen)[0]
     assert mism.size == 0, (
@@ -423,7 +428,7 @@ def test_fastpath_two_zone_keys_matches_xla():
     P = len(prep.ordered)
     want_chosen, want_used = _xla_chosen(prep)
     got_chosen, got_used, *_rest = fastpath.schedule(
-        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=_INTERPRET
     )
     mism = np.nonzero(want_chosen != got_chosen)[0]
     assert mism.size == 0, (
@@ -466,12 +471,16 @@ def test_fastpath_big_u_matches_xla():
     )
     prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
     assert int(prep.ec_np.req.shape[0]) > 512
-    assert fastpath.use_big_u(int(prep.ec_np.req.shape[0]))
+    # the VMEM-aware heuristic keeps this small-N case resident, engaging
+    # only when the resident tables would crowd VMEM (headline-N cases)
+    assert not fastpath.use_big_u(int(prep.ec_np.req.shape[0]), 128)
+    assert fastpath.use_big_u(513, 5120) and fastpath.use_big_u(1000, 5120)
     assert fastpath.applicable(prep)
     P = len(prep.ordered)
     want_chosen, want_used = _xla_chosen(prep)
+    # force big_u to exercise the HBM template-table DMA path at small N
     got_chosen, got_used, *_rest = fastpath.schedule(
-        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=_INTERPRET, big_u=True
     )
     mism = np.nonzero(want_chosen != got_chosen)[0]
     assert mism.size == 0, (
@@ -568,7 +577,7 @@ def test_fastpath_forced_pods():
     P = len(prep.ordered)
     want_chosen, want_used = _xla_chosen(prep)
     got_chosen, got_used, *_rest = fastpath.schedule(
-        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=True
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=_INTERPRET
     )
     np.testing.assert_array_equal(got_chosen, want_chosen)
     np.testing.assert_allclose(got_used, want_used, rtol=1e-5)

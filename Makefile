@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test bench bench-all docs all
+.PHONY: test bench bench-all docs native all
 
 all: test
 
@@ -20,3 +20,6 @@ bench-all: bench
 
 docs:
 	python -m opensim_tpu gen-doc --output-dir docs/commandline
+
+native:
+	python -c "from opensim_tpu import native; p = native.ensure_built(); print(p or native.load_error())"

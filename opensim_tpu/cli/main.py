@@ -37,11 +37,12 @@ def build_parser() -> argparse.ArgumentParser:
     backend_parent.add_argument(
         "--backend",
         default="auto",
-        choices=["auto", "tpu", "cpu", "xla"],
+        choices=["auto", "tpu", "cpu", "xla", "native"],
         help=(
-            "auto = accelerator if reachable (Pallas fast path on TPU); "
-            "tpu = require the accelerator; cpu = force host CPU; "
-            "xla = accelerator but disable the Pallas fast path"
+            "auto = accelerator if reachable (Pallas fast path on TPU, C++ "
+            "engine on CPU); tpu = require the accelerator; cpu = force host "
+            "CPU; xla = disable the Pallas/C++ engines (pure XLA scan); "
+            "native = force the C++ scan engine"
         ),
     )
 
@@ -178,8 +179,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"simon defrag: {e}", file=sys.stderr)
             return 1
     if args.command == "server":
+        from .. import native
         from ..server.rest import serve
 
+        native.available()  # warm the C++ engine build before the first request
         return serve(kubeconfig=args.kubeconfig, master=args.master, port=args.port)
     if args.command == "gen-doc":
         return gen_doc(parser, args.output_dir)
@@ -189,14 +192,31 @@ def main(argv: Optional[List[str]] = None) -> int:
 
 def _select_backend(backend: str) -> None:
     """--backend plumbing (the BASELINE north star's `--backend=tpu` knob):
-    the TPU engine is the default; cpu forces the host platform, xla keeps
-    the accelerator but disables the Pallas fast path."""
+    auto picks the best engine for the platform (Pallas megakernel on TPU,
+    C++ engine on CPU); cpu forces the host platform; xla disables both the
+    Pallas and C++ engines (pure XLA scan); native forces the C++ engine
+    (implies the CPU platform for the JAX side); tpu requires the
+    accelerator."""
     import jax
 
     if backend == "cpu":
         jax.config.update("jax_platforms", "cpu")
+        from .. import native
+
+        native.available()  # warm the g++ build before the first request
     elif backend == "xla":
         os.environ["OPENSIM_DISABLE_FASTPATH"] = "1"
+        os.environ["OPENSIM_DISABLE_NATIVE"] = "1"
+    elif backend == "native":
+        from .. import native
+
+        if not native.available():
+            print(f"simon: --backend native unavailable: {native.load_error()}", file=sys.stderr)
+            raise SystemExit(1)
+        os.environ["OPENSIM_NATIVE"] = "1"
+        # the C++ engine is the no-accelerator path; keep the JAX side
+        # (encoding + static precompute) off the device too
+        jax.config.update("jax_platforms", "cpu")
     elif backend == "tpu":
         if jax.default_backend() != "tpu":
             print("simon: --backend tpu requested but no TPU backend is available", file=sys.stderr)

@@ -92,6 +92,8 @@ def applicable(prep, config=None) -> bool:
 
     if os.environ.get("OPENSIM_DISABLE_FASTPATH"):
         return False  # --backend xla
+    if os.environ.get("OPENSIM_NATIVE") == "1":
+        return False  # --backend native forces the C++ engine even on TPU
     if jax.default_backend() != "tpu" and os.environ.get("OPENSIM_FASTPATH") != "interpret":
         return False
     # VMEM budget. The pallas_call signature is generated per feature-flag

@@ -1,0 +1,175 @@
+"""Native-engine selection and marshalling.
+
+``applicable()`` decides whether a prepared simulation should run on the
+C++ scan engine (``opensim_tpu/native``); ``schedule()`` marshals the
+encoded cluster into its flat-buffer ABI and returns a full
+``ScheduleOutput`` — including a completely populated final ``ScanState``
+and exact per-pod failure attribution, so no XLA re-scan is ever needed.
+
+Selection policy: the Pallas megakernel owns the TPU; the native engine
+owns hosts without an accelerator (the reference itself is a CPU program —
+its engine is the vendored Go scheduler, SURVEY.md §2.2). On a TPU backend
+the native engine only runs when OPENSIM_NATIVE=1 explicitly asks for it.
+Unlike the megakernel it has no feature envelope: every workload the XLA
+scan handles (including --default-scheduler-config weight/disable merges)
+runs natively; only out-of-tree ``extra_plugins`` (arbitrary jittable
+callables) force the XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+from ..encoding import vocab as V
+from ..encoding.state import ScanState
+from ..ops import kernels
+from .schedconfig import DEFAULT_CONFIG
+
+
+def applicable(prep, config=None, extra_plugins: tuple = ()) -> bool:
+    if extra_plugins:
+        return False
+    if os.environ.get("OPENSIM_DISABLE_NATIVE"):
+        return False
+    from .. import native
+
+    if os.environ.get("OPENSIM_NATIVE") == "1":
+        return native.available()
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return False
+    return native.available()
+
+
+@functools.lru_cache(maxsize=None)
+def _cfg_precompute_jit():
+    import jax
+
+    return jax.jit(kernels.precompute_static, static_argnums=(1,))
+
+
+def _stat_np(prep, config):
+    """Static tables as numpy (one jitted precompute; the jit wrapper is a
+    module singleton so its compile cache persists across server requests)."""
+    import jax
+
+    from .fastpath import _precompute_jit
+
+    if config is None or config == DEFAULT_CONFIG:
+        stat = _precompute_jit(prep.ec)
+    else:
+        stat = _cfg_precompute_jit()(prep.ec, config)
+    return jax.tree_util.tree_map(np.asarray, jax.device_get(stat))
+
+
+def schedule(prep, pod_valid: np.ndarray, config=None):
+    """Run the whole pod stream through the C++ engine. Returns a
+    ``ScheduleOutput`` (numpy arrays throughout)."""
+    from .. import native
+    from .scheduler import ScheduleOutput
+
+    cfg = config or DEFAULT_CONFIG
+    ec = prep.ec_np
+    st0 = prep.st0
+    feat = prep.features
+    stat = _stat_np(prep, config)
+
+    def f32(x):
+        return np.ascontiguousarray(x, dtype=np.float32)
+
+    def i32(x):
+        return np.ascontiguousarray(x, dtype=np.int32)
+
+    def u8(x):
+        return np.ascontiguousarray(x, dtype=np.uint8)
+
+    N, R = ec.alloc.shape
+    U = ec.req.shape[0]
+    P = len(prep.tmpl_ids)
+    Gd = ec.node_gpu_mem.shape[1]
+
+    state = {
+        "used": f32(np.array(st0.used, copy=True)),
+        "port_used": f32(np.array(st0.port_used, copy=True)),
+        "dom_sel": f32(np.array(st0.dom_sel, copy=True)),
+        "dom_anti": f32(np.array(st0.dom_anti, copy=True)),
+        "dom_prefw": f32(np.array(st0.dom_prefw, copy=True)),
+        "gpu_free": f32(np.array(st0.gpu_free, copy=True)),
+        "vg_free": f32(np.array(st0.vg_free, copy=True)),
+        "dev_free": f32(np.array(st0.dev_free, copy=True)),
+    }
+    outputs = {
+        "chosen": np.zeros(P, np.int32),
+        "fail_counts": np.zeros((P, kernels.NUM_FILTERS - kernels.F_PORTS), np.int32),
+        "insufficient": np.zeros((P, R), np.int32),
+        "gpu_take": np.zeros((P, Gd), np.float32),
+    }
+
+    dims = {
+        "N": N, "R": R, "U": U, "P": P,
+        "Tk": ec.node_domain.shape[1], "Dp1": ec.domain_topo.shape[0],
+        "A": ec.matches_sel.shape[1], "Hp": ec.ports.shape[1],
+        "Hports": st0.port_used.shape[1], "Cs": ec.spr_topo.shape[1],
+        "Ti": ec.at_sel.shape[1], "Tn": ec.an_sel.shape[1],
+        "Tpp": ec.pt_sel.shape[1], "G": ec.anti_g_sel.shape[0],
+        "Gp": ec.prefg_sel.shape[0], "Gd": Gd,
+        "Vg": ec.node_vg_cap.shape[1], "Dv": ec.node_dev_cap.shape[1],
+        "Mv": ec.dev_req_sizes.shape[2],
+        "res_cpu": V.RES_CPU, "res_mem": V.RES_MEMORY,
+        "ft_ports": feat.ports, "ft_gpu": feat.gpu, "ft_local": feat.local,
+        "ft_interpod": feat.interpod, "ft_prefg": feat.prefg,
+        "ft_spread_hard": feat.spread_hard, "ft_spread_soft": feat.spread_soft,
+        "ft_pref_na": feat.pref_node_affinity,
+        "ft_pref_taints": feat.prefer_taints,
+        "ft_prefer_avoid": feat.prefer_avoid,
+        "cf_ports": cfg.f_ports, "cf_fit": cfg.f_fit, "cf_spread": cfg.f_spread,
+        "cf_interpod": cfg.f_interpod, "cf_gpu": cfg.f_gpu, "cf_local": cfg.f_local,
+    }
+    weights = {k: getattr(cfg, k) for k in (
+        "w_balanced", "w_least", "w_node_affinity", "w_taint_toleration",
+        "w_interpod", "w_spread", "w_prefer_avoid", "w_simon", "w_gpu_share",
+        "w_local",
+    )}
+    buffers = {
+        "node_valid": u8(ec.node_valid), "alloc": f32(ec.alloc),
+        "node_domain": i32(ec.node_domain), "domain_topo": i32(ec.domain_topo),
+        "req": f32(ec.req), "ports": i32(ec.ports),
+        "port_conflict": u8(ec.port_conflict),
+        "spr_topo": i32(ec.spr_topo), "spr_sel": i32(ec.spr_sel),
+        "spr_skew": i32(ec.spr_skew), "spr_hard": u8(ec.spr_hard),
+        "at_sel": i32(ec.at_sel), "at_topo": i32(ec.at_topo),
+        "an_sel": i32(ec.an_sel), "an_topo": i32(ec.an_topo),
+        "pt_sel": i32(ec.pt_sel), "pt_topo": i32(ec.pt_topo), "pt_w": f32(ec.pt_w),
+        "matches_sel": u8(ec.matches_sel), "anti_g": u8(ec.anti_g),
+        "anti_g_sel": i32(ec.anti_g_sel), "anti_g_topo": i32(ec.anti_g_topo),
+        "prefg_w": f32(ec.prefg_w), "prefg_sel": i32(ec.prefg_sel),
+        "prefg_topo": i32(ec.prefg_topo),
+        "gpu_mem": f32(ec.gpu_mem), "gpu_count": i32(ec.gpu_count),
+        "avoid_score": f32(ec.avoid_score),
+        "lvm_req": f32(ec.lvm_req), "dev_req": f32(ec.dev_req),
+        "dev_req_count": i32(ec.dev_req_count),
+        "dev_req_sizes": f32(ec.dev_req_sizes),
+        "node_vg_cap": f32(ec.node_vg_cap), "node_dev_cap": f32(ec.node_dev_cap),
+        "node_dev_media": i32(ec.node_dev_media), "pin": i32(ec.pin),
+        "static_pass": u8(stat.static_pass), "aff_mask": u8(stat.aff_mask),
+        "na_raw": f32(stat.na_raw), "tt_raw": f32(stat.tt_raw),
+        "share_raw": f32(stat.share_raw), "spread_weight": f32(stat.spread_weight),
+        "tmpl_ids": i32(prep.tmpl_ids), "forced": u8(prep.forced),
+        "pod_valid": u8(pod_valid),
+        **state,
+        **outputs,
+    }
+    native.run_scan(dims, weights, buffers)
+
+    return ScheduleOutput(
+        chosen=outputs["chosen"],
+        fail_counts=outputs["fail_counts"],
+        insufficient=outputs["insufficient"],
+        gpu_take=outputs["gpu_take"],
+        static_fail=np.asarray(stat.static_fail),
+        final_state=ScanState(**state),
+    )

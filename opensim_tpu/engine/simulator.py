@@ -428,6 +428,14 @@ def simulate(
                         )
                         out = _fast_failure_details(out, prep, np.nonzero(failed)[0])
         if out is None:
+            from . import nativepath
+
+            if nativepath.applicable(prep, sched_config, extra_plugins):
+                # C++ scan engine: identical placements to the XLA scan with
+                # exact in-stream failure attribution; the default on hosts
+                # without an accelerator (tests/test_native.py asserts parity).
+                out = nativepath.schedule(prep, pod_valid, config=sched_config)
+        if out is None:
             tmpl_p, valid_p, forced_p = pad_pod_stream(tmpl_ids, pod_valid, forced)
             out = schedule_pods(
                 ec, st0, tmpl_p, valid_p, forced_p,

@@ -1,0 +1,208 @@
+"""Native CPU scan engine — build + ctypes bindings.
+
+``scan_engine.cc`` is compiled on demand with the system ``g++`` into a
+shared library cached next to this file (keyed by a hash of the source and
+compiler identity, so editing the source or changing the toolchain rebuilds
+automatically). The C ABI is a single ``ScanArgs`` struct mirrored here as a
+``ctypes.Structure``; ``opensim_args_size()`` is checked at load time so a
+layout drift between the two declarations disables the engine instead of
+corrupting memory.
+
+This is the framework's answer to the reference's vendored Go scheduler
+being its "native engine" (SURVEY.md §2.2): the TPU compute path is
+JAX/XLA/Pallas, and this C++ runtime covers hosts without an accelerator at
+native speed. Placement parity with the XLA scan is asserted by
+tests/test_native.py and the differential fuzz sweep.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+from pathlib import Path
+from typing import Optional
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE / "scan_engine.cc"
+
+_DIMS = [
+    "N", "R", "U", "P", "Tk", "Dp1", "A", "Hp", "Hports", "Cs", "Ti", "Tn",
+    "Tpp", "G", "Gp", "Gd", "Vg", "Dv", "Mv", "res_cpu", "res_mem",
+]
+_FEATURES = [
+    "ft_ports", "ft_gpu", "ft_local", "ft_interpod", "ft_prefg",
+    "ft_spread_hard", "ft_spread_soft", "ft_pref_na", "ft_pref_taints",
+    "ft_prefer_avoid",
+]
+_FILTER_ENABLES = ["cf_ports", "cf_fit", "cf_spread", "cf_interpod", "cf_gpu", "cf_local"]
+_WEIGHTS = [
+    "w_balanced", "w_least", "w_node_affinity", "w_taint_toleration",
+    "w_interpod", "w_spread", "w_prefer_avoid", "w_simon", "w_gpu_share",
+    "w_local",
+]
+# (name, ctypes pointer type, numpy dtype) in the exact struct order of
+# scan_engine.cc — keep in sync
+_U8 = ctypes.POINTER(ctypes.c_uint8)
+_I32 = ctypes.POINTER(ctypes.c_int32)
+_F32 = ctypes.POINTER(ctypes.c_float)
+_BUFFERS = [
+    ("node_valid", _U8, "u8"), ("alloc", _F32, "f32"),
+    ("node_domain", _I32, "i32"), ("domain_topo", _I32, "i32"),
+    ("req", _F32, "f32"), ("ports", _I32, "i32"),
+    ("port_conflict", _U8, "u8"),
+    ("spr_topo", _I32, "i32"), ("spr_sel", _I32, "i32"),
+    ("spr_skew", _I32, "i32"), ("spr_hard", _U8, "u8"),
+    ("at_sel", _I32, "i32"), ("at_topo", _I32, "i32"),
+    ("an_sel", _I32, "i32"), ("an_topo", _I32, "i32"),
+    ("pt_sel", _I32, "i32"), ("pt_topo", _I32, "i32"), ("pt_w", _F32, "f32"),
+    ("matches_sel", _U8, "u8"), ("anti_g", _U8, "u8"),
+    ("anti_g_sel", _I32, "i32"), ("anti_g_topo", _I32, "i32"),
+    ("prefg_w", _F32, "f32"), ("prefg_sel", _I32, "i32"),
+    ("prefg_topo", _I32, "i32"),
+    ("gpu_mem", _F32, "f32"), ("gpu_count", _I32, "i32"),
+    ("avoid_score", _F32, "f32"),
+    ("lvm_req", _F32, "f32"), ("dev_req", _F32, "f32"),
+    ("dev_req_count", _I32, "i32"), ("dev_req_sizes", _F32, "f32"),
+    ("node_vg_cap", _F32, "f32"), ("node_dev_cap", _F32, "f32"),
+    ("node_dev_media", _I32, "i32"), ("pin", _I32, "i32"),
+    ("static_pass", _U8, "u8"), ("aff_mask", _U8, "u8"),
+    ("na_raw", _F32, "f32"), ("tt_raw", _F32, "f32"),
+    ("share_raw", _F32, "f32"), ("spread_weight", _F32, "f32"),
+    ("tmpl_ids", _I32, "i32"), ("forced", _U8, "u8"), ("pod_valid", _U8, "u8"),
+    ("used", _F32, "f32"), ("port_used", _F32, "f32"),
+    ("dom_sel", _F32, "f32"), ("dom_anti", _F32, "f32"),
+    ("dom_prefw", _F32, "f32"), ("gpu_free", _F32, "f32"),
+    ("vg_free", _F32, "f32"), ("dev_free", _F32, "f32"),
+    ("chosen", _I32, "i32"), ("fail_counts", _I32, "i32"),
+    ("insufficient", _I32, "i32"), ("gpu_take", _F32, "f32"),
+]
+
+_NP_DTYPES = {"u8": "uint8", "i32": "int32", "f32": "float32"}
+
+
+class ScanArgs(ctypes.Structure):
+    _fields_ = (
+        [(n, ctypes.c_int64) for n in _DIMS + _FEATURES + _FILTER_ENABLES]
+        + [(n, ctypes.c_double) for n in _WEIGHTS]
+        + [(n, t) for n, t, _ in _BUFFERS]
+    )
+
+
+_CXX_FLAGS = ["-O3", "-std=c++17", "-shared", "-fPIC", "-ffp-contract=off"]
+
+_lib = None
+_lib_error: Optional[str] = None
+
+
+def _cache_key() -> str:
+    h = hashlib.sha256()
+    h.update(_SRC.read_bytes())
+    h.update(" ".join(_CXX_FLAGS).encode())
+    try:
+        h.update(subprocess.run(["g++", "--version"], capture_output=True).stdout)
+    except OSError:
+        pass
+    return h.hexdigest()[:16]
+
+
+def ensure_built() -> Optional[Path]:
+    """Compile the engine if its cached .so is stale. Returns the library
+    path, or None (with the reason in ``load_error()``) when no compiler is
+    available or the build fails."""
+    global _lib_error
+    try:
+        key = _cache_key()
+    except OSError as e:
+        _lib_error = f"cannot read {_SRC}: {e}"
+        return None
+    out = _HERE / f"_scan_engine_{key}.so"
+    if out.exists():
+        return out
+    tmp = out.with_suffix(f".tmp{os.getpid()}")
+    cmd = ["g++", *_CXX_FLAGS, "-o", str(tmp), str(_SRC)]
+    try:
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            _lib_error = f"g++ unavailable: {e}"
+            return None
+        if r.returncode != 0:
+            _lib_error = f"native build failed:\n{r.stderr[-2000:]}"
+            return None
+        os.replace(tmp, out)  # atomic: concurrent builders race benignly
+    finally:
+        tmp.unlink(missing_ok=True)
+    for stale in list(_HERE.glob("_scan_engine_*.so")) + list(_HERE.glob("_scan_engine_*.tmp*")):
+        if stale != out:
+            try:
+                stale.unlink()
+            except OSError:
+                pass
+    return out
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Build (if needed) and dlopen the engine; ABI-checked. Cached."""
+    global _lib, _lib_error
+    if _lib is not None:
+        return _lib
+    if _lib_error is not None:
+        return None
+    path = ensure_built()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+    except OSError as e:
+        _lib_error = f"dlopen failed: {e}"
+        return None
+    lib.opensim_args_size.restype = ctypes.c_int64
+    lib.opensim_abi_version.restype = ctypes.c_int64
+    if lib.opensim_args_size() != ctypes.sizeof(ScanArgs):
+        _lib_error = (
+            f"ABI mismatch: C sizeof(ScanArgs)={lib.opensim_args_size()} vs "
+            f"ctypes {ctypes.sizeof(ScanArgs)} — struct declarations drifted"
+        )
+        return None
+    lib.opensim_run_scan.restype = ctypes.c_int
+    lib.opensim_run_scan.argtypes = [ctypes.POINTER(ScanArgs)]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def load_error() -> Optional[str]:
+    return _lib_error
+
+
+def run_scan(dims: dict, weights: dict, buffers: dict) -> None:
+    """Fill ScanArgs from numpy buffers and invoke the engine. `buffers`
+    maps field name → numpy array (contiguous, correct dtype — validated
+    here); state/output arrays are mutated in place."""
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+        raise RuntimeError(f"native engine unavailable: {_lib_error}")
+    args = ScanArgs()
+    for n in _DIMS + _FEATURES + _FILTER_ENABLES:
+        setattr(args, n, int(dims[n]))
+    for n in _WEIGHTS:
+        setattr(args, n, float(weights[n]))
+    keep = []  # hold array refs across the call
+    for n, ptr_t, kind in _BUFFERS:
+        arr = buffers[n]
+        want = np.dtype(_NP_DTYPES[kind])
+        if arr.dtype != want or not arr.flags["C_CONTIGUOUS"]:
+            raise ValueError(f"buffer {n}: need C-contiguous {want}, got {arr.dtype}")
+        keep.append(arr)
+        setattr(args, n, arr.ctypes.data_as(ptr_t))
+    rc = lib.opensim_run_scan(ctypes.byref(args))
+    if rc != 0:
+        raise RuntimeError(f"native scan failed with code {rc}")

@@ -1,0 +1,1077 @@
+// Native CPU scan engine — the C++ counterpart of the XLA bind scan.
+//
+// Mirrors opensim_tpu/ops/kernels.py (pod_step + bind_update) operation for
+// operation in float32, same evaluation order, so placements are identical
+// to the XLA scan (tests/test_native*.py assert equality). This is the
+// framework's native runtime for hosts without an accelerator: the
+// reference's "native engine" is the vendored Go kube-scheduler
+// (vendor/k8s.io/kubernetes/pkg/scheduler, scheduleOne at
+// scheduler.go:441-614); here the same pipeline is a fused sequential scan
+// over the pod stream with all per-node work in tight vectorizable loops.
+//
+// ABI: a single ScanArgs struct of int64 dims followed by double weights and
+// raw pointers. The Python side (opensim_tpu/native/__init__.py) builds the
+// mirror ctypes.Structure; opensim_args_size() guards against layout drift.
+//
+// Compile: g++ -O3 -std=c++17 -shared -fPIC -ffp-contract=off
+//   (-ffp-contract=off keeps IEEE f32 semantics aligned with XLA:CPU so
+//    score ties break identically in both engines)
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+constexpr float BIG = 1e30f;
+constexpr float NEG = -1e30f;
+constexpr float MAXS = 100.0f;  // MAX_NODE_SCORE
+}  // namespace
+
+extern "C" {
+
+struct ScanArgs {
+  // --- dims (all int64; keep order in sync with native/__init__.py) ---
+  int64_t N, R, U, P, Tk, Dp1, A, Hp, Hports, Cs, Ti, Tn, Tpp, G, Gp, Gd, Vg, Dv, Mv;
+  int64_t res_cpu, res_mem;
+  // workload feature flags (kernels.Features)
+  int64_t ft_ports, ft_gpu, ft_local, ft_interpod, ft_prefg, ft_spread_hard,
+      ft_spread_soft, ft_pref_na, ft_pref_taints, ft_prefer_avoid;
+  // filter enables (SchedulerConfig.f_*; static-filter disables are already
+  // folded into static_pass by precompute_static)
+  int64_t cf_ports, cf_fit, cf_spread, cf_interpod, cf_gpu, cf_local;
+  // score weights (SchedulerConfig.w_*; double like the Python floats, cast
+  // to f32 at the same point jnp's weak-type promotion does)
+  double w_balanced, w_least, w_node_affinity, w_taint_toleration, w_interpod,
+      w_spread, w_prefer_avoid, w_simon, w_gpu_share, w_local;
+
+  // --- EncodedCluster (const) ---
+  const uint8_t* node_valid;     // [N]
+  const float* alloc;            // [N,R]
+  const int32_t* node_domain;    // [N,Tk]
+  const int32_t* domain_topo;    // [Dp1]
+  const float* req;              // [U,R]
+  const int32_t* ports;          // [U,Hp]
+  const uint8_t* port_conflict;  // [Hports,Hports]
+  const int32_t* spr_topo;       // [U,Cs]
+  const int32_t* spr_sel;        // [U,Cs]
+  const int32_t* spr_skew;       // [U,Cs]
+  const uint8_t* spr_hard;       // [U,Cs]
+  const int32_t* at_sel;         // [U,Ti]
+  const int32_t* at_topo;        // [U,Ti]
+  const int32_t* an_sel;         // [U,Tn]
+  const int32_t* an_topo;        // [U,Tn]
+  const int32_t* pt_sel;         // [U,Tpp]
+  const int32_t* pt_topo;        // [U,Tpp]
+  const float* pt_w;             // [U,Tpp]
+  const uint8_t* matches_sel;    // [U,A]
+  const uint8_t* anti_g;         // [U,G]
+  const int32_t* anti_g_sel;     // [G]
+  const int32_t* anti_g_topo;    // [G]
+  const float* prefg_w;          // [U,Gp]
+  const int32_t* prefg_sel;      // [Gp]
+  const int32_t* prefg_topo;     // [Gp]
+  const float* gpu_mem;          // [U]
+  const int32_t* gpu_count;      // [U]
+  const float* avoid_score;      // [U,N]
+  const float* lvm_req;          // [U]
+  const float* dev_req;          // [U,2]
+  const int32_t* dev_req_count;  // [U,2]
+  const float* dev_req_sizes;    // [U,2,Mv]
+  const float* node_vg_cap;      // [N,Vg]
+  const float* node_dev_cap;     // [N,Dv]
+  const int32_t* node_dev_media; // [N,Dv]
+  const int32_t* pin;            // [U]
+
+  // --- StaticTables (const, from kernels.precompute_static) ---
+  const uint8_t* static_pass;    // [U,N]
+  const uint8_t* aff_mask;       // [U,N]
+  const float* na_raw;           // [U,N]
+  const float* tt_raw;           // [U,N]
+  const float* share_raw;        // [U,N]
+  const float* spread_weight;    // [Tk]
+
+  // --- pod stream (const) ---
+  const int32_t* tmpl_ids;       // [P]
+  const uint8_t* forced;         // [P]
+  const uint8_t* pod_valid;      // [P]
+
+  // --- ScanState (mutated in place; caller passes copies of st0) ---
+  float* used;       // [N,R]
+  float* port_used;  // [N,Hports]
+  float* dom_sel;    // [Dp1,A]
+  float* dom_anti;   // [Dp1,G]
+  float* dom_prefw;  // [Dp1,Gp]
+  float* gpu_free;   // [N,Gd]
+  float* vg_free;    // [N,Vg]
+  float* dev_free;   // [N,Dv]
+
+  // --- outputs ---
+  int32_t* chosen;        // [P] node index, -1 unscheduled
+  int32_t* fail_counts;   // [P,7] dynamic-filter first-fail counts
+  int32_t* insufficient;  // [P,R]
+  float* gpu_take;        // [P,Gd]
+};
+
+int64_t opensim_abi_version() { return 1; }
+int64_t opensim_args_size() { return (int64_t)sizeof(ScanArgs); }
+
+}  // extern "C"
+
+namespace {
+
+// Dynamic-filter slots, same order as kernels.pod_step's `masks` list
+// (F_PORTS..F_EXTRA − F_PORTS).
+enum Stage { S_PORTS = 0, S_FIT, S_SPREAD, S_INTERPOD, S_GPU, S_LOCAL, S_EXTRA, N_STAGES };
+
+struct Scratch {
+  std::vector<uint8_t> mask[N_STAGES];  // per-stage node masks (active stages only)
+  std::vector<uint8_t> feas;
+  std::vector<float> raw_ip, raw_spr, raw_loc;
+  std::vector<uint8_t> spr_ignored;
+  std::vector<float> key_sel_total;  // [Tk,A] Σ dom_sel over real domains per key
+  std::vector<float> take;           // [Gd]
+  std::vector<uint8_t> affected;     // delta scratch
+};
+
+// Incremental same-template cache. Pod streams are dominated by runs of one
+// workload's identical pods (the reference schedules app by app,
+// simulator.go:232-249); within a run only the bound node's row and its
+// topology domains change, so the full per-node evaluation from the last
+// step stays valid almost everywhere. Every cached value is recomputed with
+// the exact float ops of the full pass when it CAN change, and the cache is
+// dropped wholesale on anything nontrivial (feasible-set flip, min/max
+// shift it cannot prove unchanged), so placements are bit-identical to the
+// non-incremental path.
+struct TmplCache {
+  int32_t u = -1;
+  bool valid = false;
+  bool prev_failed = false;
+  std::vector<int32_t> pending;  // nodes bound since the cache was computed
+  std::vector<uint8_t> feas;
+  std::vector<uint8_t> ignored;
+  std::vector<float> pre;         // bal+least+na+tt accumulated in pod_step order
+  std::vector<float> spr_raw, spr_term, share_term, av_term, score;
+  float sh_lo = 0, sh_hi = 0, sh_rng = 0, na_max = 0, tt_max = 0;
+  float spr_mn = 0, spr_mx = 0;
+  bool any_soft = false;
+  std::vector<int32_t> fail_row;  // memoized failure outputs (state unchanged)
+  std::vector<int32_t> ins_row;
+};
+
+inline float least_requested(float requested, float capacity) {
+  // kernels._least_requested (least_allocated.go:105-117)
+  float sc = (capacity - requested) * MAXS / std::max(capacity, 1.0f);
+  return (capacity == 0.0f || requested > capacity) ? 0.0f : sc;
+}
+
+inline uint8_t fit_at(const ScanArgs& a, int32_t u, int64_t n) {
+  const float* req = a.req + (int64_t)u * a.R;
+  const float* al = a.alloc + n * a.R;
+  const float* us = a.used + n * a.R;
+  uint8_t ok = 1;
+  for (int64_t r = 0; r < a.R; r++)
+    ok &= (uint8_t)(!(req[r] > 0.0f && us[r] + req[r] > al[r]));
+  return ok;
+}
+
+// The first four score components (pod_step order: balanced, least,
+// node-affinity, taint-toleration) for one node — single source for the
+// generic loop and the incremental cache so both produce identical floats.
+struct PreCtx {
+  float cpuq, memq, na_max, tt_max;
+  float wb, wl, wna, wtt;
+  bool use_bal, use_least, use_na, use_tt;
+  const float* na;
+  const float* tt;
+};
+
+inline float pre_at(const ScanArgs& a, const PreCtx& c, int64_t n) {
+  float sc = 0.0f;
+  float alloc_cpu = a.alloc[n * a.R + a.res_cpu];
+  float alloc_mem = a.alloc[n * a.R + a.res_mem];
+  float used_cpu = a.used[n * a.R + a.res_cpu];
+  float used_mem = a.used[n * a.R + a.res_mem];
+  if (c.use_bal) {
+    float cf = (used_cpu + c.cpuq) / std::max(alloc_cpu, 1.0f);
+    float mf = (used_mem + c.memq) / std::max(alloc_mem, 1.0f);
+    float b = (1.0f - std::fabs(cf - mf)) * MAXS;
+    sc += c.wb * ((cf >= 1.0f || mf >= 1.0f) ? 0.0f : b);
+  }
+  if (c.use_least) {
+    float cs = least_requested(used_cpu + c.cpuq, alloc_cpu);
+    float ms = least_requested(used_mem + c.memq, alloc_mem);
+    sc += c.wl * ((cs + ms) / 2.0f);
+  }
+  if (c.use_na)
+    sc += c.wna * (c.na_max > 0.0f ? c.na[n] * MAXS / std::max(c.na_max, 1.0f) : c.na[n]);
+  if (c.use_tt)
+    sc += c.wtt * (c.tt_max > 0.0f ? MAXS - c.tt[n] * MAXS / std::max(c.tt_max, 1.0f) : MAXS);
+  return sc;
+}
+
+// Single-node spread raw (same op order as the batch spread_raw loop).
+inline float spr_raw_at(const ScanArgs& a, int32_t u, int64_t n, bool* all_labels) {
+  const int32_t trash = (int32_t)a.Dp1 - 1;
+  const int32_t* nd = a.node_domain + n * a.Tk;
+  float raw = 0.0f;
+  bool all = true;
+  for (int64_t c = 0; c < a.Cs; c++) {
+    int32_t tk = a.spr_topo[u * a.Cs + c];
+    bool soft = tk >= 0 && !a.spr_hard[u * a.Cs + c];
+    if (!soft) continue;
+    int32_t dom = nd[tk];
+    if (!(dom < trash)) { all = false; continue; }
+    float cnt = a.dom_sel[(int64_t)dom * a.A + a.spr_sel[u * a.Cs + c]];
+    raw += cnt * a.spread_weight[tk] + ((float)a.spr_skew[u * a.Cs + c] - 1.0f);
+  }
+  *all_labels = all;
+  return raw;
+}
+
+// ---- filter stages (kernels.py ports_filter / fit_filter / spread_filter /
+// interpod_filter / gpu_filter / local_filter) ----
+
+void ports_mask(const ScanArgs& a, int32_t u, uint8_t* out) {
+  const int64_t N = a.N, Hp = a.Hp, Hq = a.Hports;
+  std::vector<int32_t> pids;
+  pids.reserve(Hp);
+  for (int64_t h = 0; h < Hp; h++) {
+    int32_t p = a.ports[u * Hp + h];
+    if (p >= 0) pids.push_back(p);
+  }
+  const size_t np = pids.size();
+  if (np == 0) {
+    std::memset(out, 1, N);
+    return;
+  }
+  for (int64_t n = 0; n < N; n++) {
+    bool conflict = false;
+    const float* pu = a.port_used + n * Hq;
+    for (size_t k = 0; k < np && !conflict; k++) {
+      const uint8_t* crow = a.port_conflict + (int64_t)pids[k] * Hq;
+      for (int64_t q = 0; q < Hq; q++)
+        if (crow[q] && pu[q] > 0.0f) { conflict = true; break; }
+    }
+    out[n] = !conflict;
+  }
+}
+
+void fit_mask(const ScanArgs& a, int32_t u, uint8_t* out) {
+  const int64_t N = a.N, R = a.R;
+  const float* req = a.req + (int64_t)u * R;
+  for (int64_t n = 0; n < N; n++) {
+    const float* al = a.alloc + n * R;
+    const float* us = a.used + n * R;
+    uint8_t ok = 1;
+    for (int64_t r = 0; r < R; r++)
+      ok &= (uint8_t)(!(req[r] > 0.0f && us[r] + req[r] > al[r]));
+    out[n] = ok;
+  }
+}
+
+void spread_mask(const ScanArgs& a, int32_t u, uint8_t* out) {
+  const int64_t N = a.N, Cs = a.Cs, Tk = a.Tk, A = a.A;
+  const int32_t trash = (int32_t)a.Dp1 - 1;
+  const uint8_t* am = a.aff_mask + (int64_t)u * N;
+  std::memset(out, 1, N);
+  for (int64_t c = 0; c < Cs; c++) {
+    int32_t tk = a.spr_topo[u * Cs + c];
+    if (tk < 0 || !a.spr_hard[u * Cs + c]) continue;
+    int32_t sel = a.spr_sel[u * Cs + c];
+    float skew = (float)a.spr_skew[u * Cs + c];
+    float selfm = (float)a.matches_sel[(int64_t)u * A + sel];
+    // min matchNum over eligible domains (filtering.go:276 calPreFilterState)
+    float min_cnt = BIG;
+    for (int64_t n = 0; n < N; n++) {
+      int32_t dom = a.node_domain[n * Tk + tk];
+      if (dom < trash && am[n] && a.node_valid[n]) {
+        float cnt = a.dom_sel[(int64_t)dom * A + sel];
+        if (cnt < min_cnt) min_cnt = cnt;
+      }
+    }
+    for (int64_t n = 0; n < N; n++) {
+      int32_t dom = a.node_domain[n * Tk + tk];
+      bool has = dom < trash;
+      float cnt = a.dom_sel[(int64_t)dom * A + sel];
+      out[n] &= (uint8_t)(has && (cnt + selfm - min_cnt <= skew));
+    }
+  }
+}
+
+void interpod_mask(const ScanArgs& a, const Scratch& s, int32_t u, uint8_t* out) {
+  const int64_t N = a.N, Tk = a.Tk, A = a.A, Ti = a.Ti, Tn = a.Tn, G = a.G;
+  const int32_t trash = (int32_t)a.Dp1 - 1;
+  // incoming required-affinity bookkeeping (filtering.go:347-374): the
+  // bootstrap needs the GLOBAL count map empty and a full self-match
+  float total_active = 0.0f;
+  bool all_self = true, any_at = false;
+  for (int64_t t = 0; t < Ti; t++) {
+    int32_t sel = a.at_sel[u * Ti + t];
+    if (sel < 0) continue;
+    any_at = true;
+    total_active += s.key_sel_total[(int64_t)a.at_topo[u * Ti + t] * A + sel];
+    if (!a.matches_sel[(int64_t)u * A + sel]) all_self = false;
+  }
+  bool bootstrap = (total_active == 0.0f) && all_self && any_at;
+
+  for (int64_t n = 0; n < N; n++) {
+    const int32_t* nd = a.node_domain + n * Tk;
+    bool ok = true;
+    // (1) incoming pod's required anti-affinity terms
+    for (int64_t t = 0; t < Tn && ok; t++) {
+      int32_t sel = a.an_sel[u * Tn + t];
+      if (sel < 0) continue;
+      int32_t dom = nd[a.an_topo[u * Tn + t]];
+      if (dom < trash && a.dom_sel[(int64_t)dom * A + sel] > 0.0f) ok = false;
+    }
+    // (2) existing pods' anti terms matching the incoming pod (symmetric)
+    for (int64_t g = 0; g < G && ok; g++) {
+      if (!a.matches_sel[(int64_t)u * A + a.anti_g_sel[g]]) continue;
+      int32_t dom = nd[a.anti_g_topo[g]];
+      if (dom < trash && a.dom_anti[(int64_t)dom * G + g] > 0.0f) ok = false;
+    }
+    // (3) incoming required affinity
+    if (ok && any_at) {
+      bool per_ok = true, labels_ok = true;
+      for (int64_t t = 0; t < Ti; t++) {
+        int32_t sel = a.at_sel[u * Ti + t];
+        if (sel < 0) continue;
+        int32_t dom = nd[a.at_topo[u * Ti + t]];
+        bool has = dom < trash;
+        if (!has) labels_ok = false;
+        if (!(has && a.dom_sel[(int64_t)dom * A + sel] > 0.0f)) per_ok = false;
+      }
+      ok = per_ok || (labels_ok && bootstrap);
+    }
+    out[n] = ok;
+  }
+}
+
+void gpu_mask(const ScanArgs& a, int32_t u, uint8_t* out) {
+  const int64_t N = a.N, Gd = a.Gd;
+  float mem = a.gpu_mem[u];
+  if (!(mem > 0.0f)) {
+    std::memset(out, 1, N);
+    return;
+  }
+  float memq = std::max(mem, 1.0f);
+  float cnt = (float)a.gpu_count[u];
+  for (int64_t n = 0; n < N; n++) {
+    const float* free = a.gpu_free + n * Gd;
+    float chunks = 0.0f;
+    for (int64_t d = 0; d < Gd; d++) chunks += std::floor(free[d] / memq);
+    out[n] = (chunks >= cnt) && (cnt > 0.0f);
+  }
+}
+
+void local_mask(const ScanArgs& a, int32_t u, uint8_t* out) {
+  const int64_t N = a.N, Vg = a.Vg, Dv = a.Dv, Mv = a.Mv;
+  float lvm = a.lvm_req[u];
+  for (int64_t n = 0; n < N; n++) {
+    bool ok = true;
+    if (lvm > 0.0f) {
+      float best = -BIG;
+      const float* vf = a.vg_free + n * Vg;
+      for (int64_t v = 0; v < Vg; v++) best = std::max(best, vf[v]);
+      ok = best >= lvm;
+    }
+    // exclusive devices: Hall's condition on nested fit sets (volumes
+    // sorted descending — common.go:290-349)
+    for (int media = 0; media < 2 && ok; media++) {
+      const float* sizes = a.dev_req_sizes + ((int64_t)u * 2 + media) * Mv;
+      const float* df = a.dev_free + n * Dv;
+      const int32_t* dm = a.node_dev_media + n * Dv;
+      for (int64_t i = 0; i < Mv; i++) {
+        if (!(sizes[i] > 0.0f)) continue;
+        int fit_cnt = 0;
+        for (int64_t d = 0; d < Dv; d++)
+          if (dm[d] == media && df[d] >= sizes[i] && df[d] > 0.0f) fit_cnt++;
+        if (fit_cnt < (int)(i + 1)) { ok = false; break; }
+      }
+    }
+    out[n] = ok;
+  }
+}
+
+// ---- score raws ----
+
+void interpod_raw(const ScanArgs& a, int32_t u, float* out) {
+  // interpod_score (scoring.go): incoming preferred terms + symmetric terms
+  const int64_t N = a.N, Tk = a.Tk, A = a.A, Tpp = a.Tpp, Gp = a.Gp;
+  const int32_t trash = (int32_t)a.Dp1 - 1;
+  for (int64_t n = 0; n < N; n++) {
+    const int32_t* nd = a.node_domain + n * Tk;
+    float incoming = 0.0f;
+    for (int64_t t = 0; t < Tpp; t++) {
+      int32_t sel = a.pt_sel[u * Tpp + t];
+      int32_t dom = nd[a.pt_topo[u * Tpp + t]];
+      if (sel >= 0 && dom < trash)
+        incoming += a.dom_sel[(int64_t)dom * A + sel] * a.pt_w[u * Tpp + t];
+    }
+    float symmetric = 0.0f;
+    for (int64_t g = 0; g < Gp; g++) {
+      int32_t dom = nd[a.prefg_topo[g]];
+      if (dom < trash)
+        symmetric += a.dom_prefw[(int64_t)dom * Gp + g] *
+                     (float)a.matches_sel[(int64_t)u * A + a.prefg_sel[g]];
+    }
+    out[n] = incoming + symmetric;
+  }
+}
+
+bool spread_raw(const ScanArgs& a, int32_t u, const uint8_t* feas, float* out,
+                uint8_t* ignored) {
+  // spread_score (podtopologyspread/scoring.go:175-248)
+  const int64_t N = a.N, Cs = a.Cs, Tk = a.Tk, A = a.A;
+  const int32_t trash = (int32_t)a.Dp1 - 1;
+  bool any_soft = false;
+  for (int64_t c = 0; c < Cs; c++)
+    if (a.spr_topo[u * Cs + c] >= 0 && !a.spr_hard[u * Cs + c]) any_soft = true;
+  if (!any_soft) return false;
+  for (int64_t n = 0; n < N; n++) {
+    const int32_t* nd = a.node_domain + n * Tk;
+    float raw = 0.0f;
+    bool all_labels = true;
+    for (int64_t c = 0; c < Cs; c++) {
+      int32_t tk = a.spr_topo[u * Cs + c];
+      bool soft = tk >= 0 && !a.spr_hard[u * Cs + c];
+      if (!soft) continue;
+      int32_t dom = nd[tk];
+      bool has = dom < trash;
+      if (!has) { all_labels = false; continue; }
+      float cnt = a.dom_sel[(int64_t)dom * A + a.spr_sel[u * Cs + c]];
+      raw += cnt * a.spread_weight[tk] + ((float)a.spr_skew[u * Cs + c] - 1.0f);
+    }
+    out[n] = raw;
+    ignored[n] = feas[n] && !all_labels;
+  }
+  return true;
+}
+
+void local_raw(const ScanArgs& a, int32_t u, float* out) {
+  // local_score (open-local.go:94-138, vendored common.go:487-509,:660-690)
+  const int64_t N = a.N, Vg = a.Vg, Dv = a.Dv;
+  float lvm = a.lvm_req[u];
+  for (int64_t n = 0; n < N; n++) {
+    const float* vf = a.vg_free + n * Vg;
+    const float* vc = a.node_vg_cap + n * Vg;
+    float tight_free = BIG;
+    int64_t choice = 0;
+    for (int64_t v = 0; v < Vg; v++) {
+      float masked = (vf[v] >= lvm) ? vf[v] : BIG;
+      if (masked < tight_free) { tight_free = masked; choice = v; }
+    }
+    float vg_cap = (Vg > 0) ? vc[choice] : 0.0f;
+    float parts = (lvm > 0.0f && tight_free < BIG) ? lvm / std::max(vg_cap, 1.0f) : 0.0f;
+    float count = (lvm > 0.0f) ? 1.0f : 0.0f;
+    for (int media = 0; media < 2; media++) {
+      float size = a.dev_req[(int64_t)u * 2 + media];
+      float n_dev = (float)a.dev_req_count[(int64_t)u * 2 + media];
+      const float* df = a.dev_free + n * Dv;
+      const int32_t* dm = a.node_dev_media + n * Dv;
+      float first_cap = BIG;
+      for (int64_t d = 0; d < Dv; d++) {
+        bool fitting = dm[d] == media && df[d] >= size && df[d] > 0.0f;
+        float cap = fitting ? a.node_dev_cap[n * Dv + d] : BIG;
+        if (cap < first_cap) first_cap = cap;
+      }
+      if (size > 0.0f) {
+        parts += n_dev * size / std::max(first_cap, 1.0f);
+        count += n_dev;
+      }
+    }
+    out[n] = (count > 0.0f) ? parts / std::max(count, 1.0f) * 10.0f : 0.0f;
+  }
+}
+
+// ---- bind (kernels.bind_update) ----
+
+void bind(ScanArgs& a, Scratch& s, int32_t u, int32_t node, float* take_out) {
+  const int64_t R = a.R, Tk = a.Tk, A = a.A, Hp = a.Hp, Hq = a.Hports;
+  const int64_t G = a.G, Gp = a.Gp, Gd = a.Gd, Vg = a.Vg, Dv = a.Dv, Mv = a.Mv;
+  for (int64_t r = 0; r < R; r++) a.used[(int64_t)node * R + r] += a.req[(int64_t)u * R + r];
+
+  if (a.ft_ports) {
+    for (int64_t h = 0; h < Hp; h++) {
+      int32_t p = a.ports[u * Hp + h];
+      if (p >= 0) a.port_used[(int64_t)node * Hq + p] += 1.0f;
+    }
+  }
+
+  // domain selector counts (gated exactly like Features.sel_counts)
+  if (a.ft_interpod || a.ft_spread_hard || a.ft_spread_soft) {
+    const uint8_t* m = a.matches_sel + (int64_t)u * A;
+    for (int64_t tk = 0; tk < Tk; tk++) {
+      int32_t dom = a.node_domain[(int64_t)node * Tk + tk];
+      float* row = a.dom_sel + (int64_t)dom * A;
+      for (int64_t x = 0; x < A; x++) row[x] += (float)m[x];
+      if (a.domain_topo[dom] >= 0) {
+        float* tot = s.key_sel_total.data() + tk * A;
+        for (int64_t x = 0; x < A; x++) tot[x] += (float)m[x];
+      }
+    }
+  }
+
+  if (a.ft_interpod) {
+    for (int64_t g = 0; g < G; g++) {
+      int32_t dom = a.node_domain[(int64_t)node * Tk + a.anti_g_topo[g]];
+      a.dom_anti[(int64_t)dom * G + g] += (float)a.anti_g[(int64_t)u * G + g];
+    }
+  }
+  if (a.ft_prefg) {
+    for (int64_t g = 0; g < Gp; g++) {
+      int32_t dom = a.node_domain[(int64_t)node * Tk + a.prefg_topo[g]];
+      a.dom_prefw[(int64_t)dom * Gp + g] += a.prefg_w[(int64_t)u * Gp + g];
+    }
+  }
+
+  // gpu-share packing (AllocateGpuId, gpunodeinfo.go:232-290)
+  for (int64_t d = 0; d < Gd; d++) take_out[d] = 0.0f;
+  if (a.ft_gpu) {
+    float mem = a.gpu_mem[u];
+    if (mem > 0.0f) {
+      float memq = std::max(mem, 1.0f);
+      float cnt = (float)a.gpu_count[u];
+      float* free = a.gpu_free + (int64_t)node * Gd;
+      if (cnt == 1.0f) {
+        // single GPU: tightest fit (first argmin of masked free)
+        float best = BIG;
+        int64_t tight = 0;
+        bool any = false;
+        for (int64_t d = 0; d < Gd; d++) {
+          float masked = (free[d] >= mem) ? free[d] : BIG;
+          if (masked < best) { best = masked; tight = d; }
+          if (free[d] >= mem) any = true;
+        }
+        if (any) take_out[tight] = 1.0f;
+      } else {
+        // multi GPU: greedy two-pointer packing = prefix-clipped chunks
+        float cum = 0.0f;
+        for (int64_t d = 0; d < Gd; d++) {
+          float chunks = std::floor(free[d] / memq);
+          float t = cnt - cum;
+          t = std::max(0.0f, std::min(t, chunks));
+          take_out[d] = t;
+          cum += chunks;
+        }
+      }
+      for (int64_t d = 0; d < Gd; d++) free[d] -= take_out[d] * mem;
+    }
+  }
+
+  if (a.ft_local) {
+    // LVM: tightest-fitting VG (ascending free-size first-fit, common.go:111-116)
+    float lvm = a.lvm_req[u];
+    float* vf = a.vg_free + (int64_t)node * Vg;
+    float best = BIG;
+    int64_t choice = 0;
+    bool any = false;
+    for (int64_t v = 0; v < Vg; v++) {
+      float masked = (vf[v] >= lvm) ? vf[v] : BIG;
+      if (masked < best) { best = masked; choice = v; }
+      if (vf[v] >= lvm) any = true;
+    }
+    if (any && Vg > 0) vf[choice] -= std::max(lvm, 0.0f);
+
+    // exclusive devices: smallest volume first onto the smallest-capacity
+    // fitting free device (ties by lowest device index)
+    float* df = a.dev_free + (int64_t)node * Dv;
+    const float* dc = a.node_dev_cap + (int64_t)node * Dv;
+    const int32_t* dm = a.node_dev_media + (int64_t)node * Dv;
+    std::vector<uint8_t> taken(Dv, 0);
+    for (int media = 0; media < 2; media++) {
+      for (int64_t i = Mv - 1; i >= 0; i--) {
+        float size = a.dev_req_sizes[((int64_t)u * 2 + media) * Mv + i];
+        if (!(size > 0.0f)) continue;
+        float bestc = BIG;
+        int64_t pick = -1;
+        for (int64_t d = 0; d < Dv; d++) {
+          bool cand = dm[d] == media && df[d] >= size && df[d] > 0.0f && !taken[d];
+          if (cand && dc[d] < bestc) { bestc = dc[d]; pick = d; }
+        }
+        if (pick >= 0) taken[pick] = 1;
+      }
+    }
+    for (int64_t d = 0; d < Dv; d++)
+      if (taken[d]) df[d] = 0.0f;
+  }
+}
+
+// Failure accounting (pod_step count_fails): first-fail attribution through
+// the stage chain; static-filter counts live in static_fail. Assumes
+// s.mask[k] is filled for every active stage.
+void fail_accounting(ScanArgs& a, Scratch& s, const bool* act, int32_t u, int64_t i) {
+  const int64_t N = a.N, R = a.R;
+  const uint8_t* sp = a.static_pass + (int64_t)u * N;
+  std::vector<uint8_t> passed(sp, sp + N);
+  for (int k = 0; k < N_STAGES; k++) {
+    // per-resource counts only when the fit plugin is enabled (pod_step's
+    // disabled branch zeroes `insufficient`)
+    if (k == S_FIT && a.cf_fit) {
+      const float* req = a.req + (int64_t)u * R;
+      for (int64_t r = 0; r < R; r++) {
+        int32_t cnt = 0;
+        for (int64_t n = 0; n < N; n++)
+          if (passed[n] && a.node_valid[n] && req[r] > 0.0f &&
+              a.used[n * R + r] + req[r] > a.alloc[n * R + r])
+            cnt++;
+        a.insufficient[i * R + r] = cnt;
+      }
+    }
+    int32_t cnt = 0;
+    if (act[k]) {
+      for (int64_t n = 0; n < N; n++) {
+        if (passed[n] && !s.mask[k][n]) cnt++;
+        passed[n] &= s.mask[k][n];
+      }
+    }
+    a.fail_counts[i * N_STAGES + k] = cnt;
+  }
+}
+
+struct EnvCtx {
+  bool act_fit;
+  bool use_spr, use_share, use_avoid;
+  float wsp, wshare, wav;
+};
+
+inline float recombine(const TmplCache& tc, const EnvCtx& e, int64_t n) {
+  float sc = tc.pre[n];
+  if (e.use_spr && tc.any_soft) sc += tc.spr_term[n];
+  if (e.use_share) sc += tc.share_term[n];
+  if (e.use_avoid) sc += tc.av_term[n];
+  return sc;
+}
+
+inline float spr_term_of(const TmplCache& tc, const EnvCtx& e, int64_t n) {
+  float norm;
+  if (tc.spr_mx <= 0.0f)
+    norm = MAXS;
+  else
+    norm = MAXS * (tc.spr_mx + tc.spr_mn - tc.spr_raw[n]) / std::max(tc.spr_mx, 1.0f);
+  if (tc.ignored[n]) norm = 0.0f;
+  return e.wsp * norm;
+}
+
+// Full per-template evaluation into the cache (incremental envelope only:
+// active dynamic masks ⊆ {fit}, no interpod/local score).
+void full_eval_env(ScanArgs& a, TmplCache& tc, const EnvCtx& e, PreCtx& c, int32_t u) {
+  const int64_t N = a.N;
+  tc.u = u;
+  tc.valid = true;
+  tc.prev_failed = false;
+  tc.pending.clear();
+
+  tc.any_soft = false;
+  for (int64_t cc = 0; cc < a.Cs; cc++)
+    if (a.spr_topo[u * a.Cs + cc] >= 0 && !a.spr_hard[u * a.Cs + cc]) tc.any_soft = true;
+
+  const uint8_t* sp = a.static_pass + (int64_t)u * N;
+  const float* share = a.share_raw + (int64_t)u * N;
+  float na_m = NEG, tt_m = NEG, shlo = BIG, shhi = NEG;
+  for (int64_t n = 0; n < N; n++) {
+    uint8_t f = sp[n] && (e.act_fit ? fit_at(a, u, n) : 1);
+    tc.feas[n] = f;
+    if (c.use_na) na_m = std::max(na_m, f ? c.na[n] : 0.0f);
+    if (c.use_tt) tt_m = std::max(tt_m, f ? c.tt[n] : 0.0f);
+    if (e.use_share && f) {
+      shlo = std::min(shlo, share[n]);
+      shhi = std::max(shhi, share[n]);
+    }
+    if (e.use_spr && tc.any_soft) {
+      bool all_labels;
+      tc.spr_raw[n] = spr_raw_at(a, u, n, &all_labels);
+      tc.ignored[n] = f && !all_labels;
+    } else {
+      tc.ignored[n] = 0;
+    }
+  }
+  tc.na_max = na_m;
+  tc.tt_max = tt_m;
+  c.na_max = na_m;
+  c.tt_max = tt_m;
+  tc.sh_lo = shlo;
+  tc.sh_hi = shhi;
+  tc.sh_rng = shhi - shlo;
+  if (e.use_spr && tc.any_soft) {
+    float mn = BIG, mx = NEG;
+    for (int64_t n = 0; n < N; n++) {
+      if (tc.feas[n] && !tc.ignored[n]) {
+        mn = std::min(mn, tc.spr_raw[n]);
+        mx = std::max(mx, tc.spr_raw[n]);
+      }
+    }
+    tc.spr_mn = mn;
+    tc.spr_mx = mx;
+  }
+  const float* avoid = a.avoid_score + (int64_t)u * N;
+  for (int64_t n = 0; n < N; n++) {
+    tc.pre[n] = pre_at(a, c, n);
+    if (e.use_spr && tc.any_soft) tc.spr_term[n] = spr_term_of(tc, e, n);
+    if (e.use_share)
+      tc.share_term[n] =
+          e.wshare * (tc.sh_rng > 0.0f ? (share[n] - tc.sh_lo) * MAXS / tc.sh_rng : 0.0f);
+    if (e.use_avoid) tc.av_term[n] = e.wav * avoid[n];
+    tc.score[n] = recombine(tc, e, n);
+  }
+}
+
+// Fold the pending binds into the cache. Returns false when something it
+// cannot prove unchanged shifted (feasible-set flip) — caller re-evaluates.
+bool apply_deltas(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e, PreCtx& c) {
+  const int64_t N = a.N, Tk = a.Tk, Cs = a.Cs;
+  const int32_t u = tc.u;
+  for (size_t pi = 0; pi < tc.pending.size(); pi++) {
+    int64_t j = tc.pending[pi];
+    uint8_t f = a.static_pass[(int64_t)u * N + j] && (e.act_fit ? fit_at(a, u, j) : 1);
+    if (f != tc.feas[j]) return false;  // feasible set shifted: reductions stale
+    tc.pre[j] = pre_at(a, c, j);
+
+    bool scal_changed = false;
+    if (e.use_spr && tc.any_soft) {
+      // nodes sharing a soft-constraint domain with j see new counts
+      int32_t jdom[16];
+      int32_t jtk[16];
+      int nsoft = 0;
+      for (int64_t cc = 0; cc < Cs && nsoft < 16; cc++) {
+        int32_t tk = a.spr_topo[u * Cs + cc];
+        if (tk >= 0 && !a.spr_hard[u * Cs + cc]) {
+          jtk[nsoft] = tk;
+          jdom[nsoft] = a.node_domain[j * Tk + tk];
+          nsoft++;
+        }
+      }
+      float max_new_aff = NEG;
+      bool mn_rescan = false;
+      for (int64_t n = 0; n < N; n++) {
+        bool aff = false;
+        for (int k = 0; k < nsoft; k++)
+          if (a.node_domain[n * Tk + jtk[k]] == jdom[k]) { aff = true; break; }
+        s.affected[n] = aff;
+        if (!aff) continue;
+        bool scored = tc.feas[n] && !tc.ignored[n];
+        if (scored && tc.spr_raw[n] <= tc.spr_mn) mn_rescan = true;
+        bool all_labels;
+        float nr = spr_raw_at(a, u, n, &all_labels);
+        tc.spr_raw[n] = nr;
+        if (scored) max_new_aff = std::max(max_new_aff, nr);
+      }
+      // counts only grow, so max updates in place; min moves only if the
+      // old minimum sat in an affected domain
+      float new_mx = std::max(tc.spr_mx, max_new_aff);
+      float new_mn = tc.spr_mn;
+      if (mn_rescan) {
+        new_mn = BIG;
+        for (int64_t n = 0; n < N; n++)
+          if (tc.feas[n] && !tc.ignored[n]) new_mn = std::min(new_mn, tc.spr_raw[n]);
+      }
+      scal_changed = (new_mx != tc.spr_mx) || (new_mn != tc.spr_mn);
+      tc.spr_mx = new_mx;
+      tc.spr_mn = new_mn;
+      if (scal_changed) {
+        for (int64_t n = 0; n < N; n++) {
+          if (!tc.feas[n]) continue;
+          tc.spr_term[n] = spr_term_of(tc, e, n);
+          tc.score[n] = recombine(tc, e, n);
+        }
+      } else {
+        for (int64_t n = 0; n < N; n++) {
+          if (!s.affected[n] || !tc.feas[n]) continue;
+          tc.spr_term[n] = spr_term_of(tc, e, n);
+          tc.score[n] = recombine(tc, e, n);
+        }
+      }
+    }
+    if (tc.feas[j]) tc.score[j] = recombine(tc, e, j);
+  }
+  tc.pending.clear();
+  return true;
+}
+
+}  // namespace
+
+extern "C" int opensim_run_scan(ScanArgs* ap) {
+  ScanArgs& a = *ap;
+  const int64_t N = a.N, R = a.R, P = a.P, A = a.A, Tk = a.Tk, Gd = a.Gd;
+  Scratch s;
+  s.feas.resize(N);
+  for (auto& m : s.mask) m.resize(N);
+  s.raw_ip.resize(N);
+  s.raw_spr.resize(N);
+  s.raw_loc.resize(N);
+  s.spr_ignored.resize(N);
+  s.affected.resize(N);
+  s.take.resize(std::max<int64_t>(Gd, 1));
+  // global per-(topology key, selector) match totals for the interpod
+  // bootstrap (Σ over real domains of dom_sel — trash row excluded because
+  // domain_topo[trash] = -1); maintained incrementally on bind
+  s.key_sel_total.assign(Tk * A, 0.0f);
+  for (int64_t d = 0; d < a.Dp1; d++) {
+    int32_t tk = a.domain_topo[d];
+    if (tk < 0) continue;
+    for (int64_t x = 0; x < A; x++) s.key_sel_total[(int64_t)tk * A + x] += a.dom_sel[d * A + x];
+  }
+
+  const bool act_ports = a.ft_ports && a.cf_ports;
+  const bool act_fit = a.cf_fit;
+  const bool act_spread = a.ft_spread_hard && a.cf_spread;
+  const bool act_interpod = a.ft_interpod && a.cf_interpod;
+  const bool act_gpu = a.ft_gpu && a.cf_gpu;
+  const bool act_local = a.ft_local && a.cf_local;
+  const bool act[N_STAGES] = {act_ports, act_fit, act_spread, act_interpod,
+                              act_gpu, act_local, false};
+
+  const float wb = (float)a.w_balanced, wl = (float)a.w_least;
+  const float wna = (float)a.w_node_affinity, wtt = (float)a.w_taint_toleration;
+  const float wip = (float)a.w_interpod, wsp = (float)a.w_spread;
+  const float wav = (float)a.w_prefer_avoid, wloc = (float)a.w_local;
+  const double wshare_d = a.w_simon + a.w_gpu_share;
+  const float wshare = (float)wshare_d;
+  const bool use_bal = a.w_balanced != 0.0, use_least = a.w_least != 0.0;
+  const bool use_na = a.ft_pref_na && a.w_node_affinity != 0.0;
+  const bool use_tt = a.ft_pref_taints && a.w_taint_toleration != 0.0;
+  const bool use_ip = (a.ft_prefg || a.ft_interpod) && a.w_interpod != 0.0;
+  const bool use_spr = a.ft_spread_soft && a.w_spread != 0.0;
+  const bool use_share = wshare_d != 0.0;
+  const bool use_loc = a.ft_local && a.w_local != 0.0;
+  const bool use_avoid = a.ft_prefer_avoid && a.w_prefer_avoid != 0.0;
+
+  // Incremental same-template envelope: the only active dynamic mask may be
+  // fit, and no score component may depend on usage beyond used/dom_sel
+  // (interpod reads dom_prefw, local reads vg/dev state).
+  const bool inc_ok = !act_ports && !act_spread && !act_interpod && !act_gpu &&
+                      !act_local && !use_ip && !use_loc && a.Cs <= 16;
+  constexpr size_t MAX_PENDING = 8;
+  TmplCache tc;
+  EnvCtx env{act_fit, use_spr, use_share, use_avoid, wsp, wshare, wav};
+  if (inc_ok) {
+    tc.feas.resize(N);
+    tc.ignored.resize(N);
+    tc.pre.resize(N);
+    tc.spr_raw.resize(N);
+    tc.spr_term.resize(N);
+    tc.share_term.resize(N);
+    tc.av_term.resize(N);
+    tc.score.resize(N);
+    tc.fail_row.resize(N_STAGES);
+    tc.ins_row.resize(R);
+  }
+
+  for (int64_t i = 0; i < P; i++) {
+    a.chosen[i] = -1;
+    if (!a.pod_valid[i]) continue;
+    const int32_t u = a.tmpl_ids[i];
+
+    if (a.forced[i]) {
+      // forced-bind path (scheduler._step: simulator.go:329-331 — pods with
+      // spec.nodeName never reach the scheduler but still drain resources)
+      int32_t p = a.pin[u];
+      if (p >= 0) {
+        bind(a, s, u, p, s.take.data());
+        a.chosen[i] = p;
+        for (int64_t d = 0; d < Gd; d++) a.gpu_take[i * Gd + d] = s.take[d];
+        if (tc.valid) {
+          tc.pending.push_back(p);
+          if (tc.pending.size() > MAX_PENDING) tc.valid = false;
+        }
+      }
+      continue;
+    }
+
+    if (inc_ok) {
+      PreCtx pc;
+      pc.cpuq = 0;  // filled below
+      pc.memq = 0;
+      pc.na_max = tc.na_max;
+      pc.tt_max = tc.tt_max;
+      pc.wb = wb;
+      pc.wl = wl;
+      pc.wna = wna;
+      pc.wtt = wtt;
+      pc.use_bal = use_bal;
+      pc.use_least = use_least;
+      pc.use_na = use_na;
+      pc.use_tt = use_tt;
+      pc.na = a.na_raw + (int64_t)u * N;
+      pc.tt = a.tt_raw + (int64_t)u * N;
+      float cpu = a.req[(int64_t)u * R + a.res_cpu];
+      float mem = a.req[(int64_t)u * R + a.res_mem];
+      pc.cpuq = cpu > 0.0f ? cpu : 100.0f;
+      pc.memq = mem > 0.0f ? mem : 200.0f * 1024.0f * 1024.0f;
+
+      bool cached = tc.valid && tc.u == u;
+      if (cached && tc.prev_failed && tc.pending.empty()) {
+        // state untouched since the failed evaluation → identical verdict
+        for (int k = 0; k < N_STAGES; k++) a.fail_counts[i * N_STAGES + k] = tc.fail_row[k];
+        for (int64_t r = 0; r < R; r++) a.insufficient[i * R + r] = tc.ins_row[r];
+        continue;
+      }
+      if (cached && !tc.pending.empty() && !apply_deltas(a, s, tc, env, pc)) {
+        tc.valid = false;
+        cached = false;
+      }
+      if (!(tc.valid && tc.u == u)) full_eval_env(a, tc, env, pc, u);
+
+      float best = NEG;
+      int32_t bi = -1;
+      const float* sc = tc.score.data();
+      const uint8_t* fe = tc.feas.data();
+      for (int64_t n = 0; n < N; n++)
+        if (fe[n] && sc[n] > best) { best = sc[n]; bi = (int32_t)n; }
+
+      if (bi < 0) {
+        if (act_fit) fit_mask(a, u, s.mask[S_FIT].data());
+        fail_accounting(a, s, act, u, i);
+        tc.prev_failed = true;
+        for (int k = 0; k < N_STAGES; k++) tc.fail_row[k] = a.fail_counts[i * N_STAGES + k];
+        for (int64_t r = 0; r < R; r++) tc.ins_row[r] = a.insufficient[i * R + r];
+        continue;
+      }
+      tc.prev_failed = false;
+      bind(a, s, u, bi, s.take.data());
+      tc.pending.push_back(bi);
+      a.chosen[i] = bi;
+      for (int64_t d = 0; d < Gd; d++) a.gpu_take[i * Gd + d] = s.take[d];
+      continue;
+    }
+
+    // --- Filter: active dynamic masks over the full node axis ---
+    if (act_ports) ports_mask(a, u, s.mask[S_PORTS].data());
+    if (act_fit) fit_mask(a, u, s.mask[S_FIT].data());
+    if (act_spread) spread_mask(a, u, s.mask[S_SPREAD].data());
+    if (act_interpod) interpod_mask(a, s, u, s.mask[S_INTERPOD].data());
+    if (act_gpu) gpu_mask(a, u, s.mask[S_GPU].data());
+    if (act_local) local_mask(a, u, s.mask[S_LOCAL].data());
+
+    const uint8_t* sp = a.static_pass + (int64_t)u * N;
+    bool any_feas = false;
+    for (int64_t n = 0; n < N; n++) {
+      uint8_t f = sp[n];
+      for (int k = 0; k < N_STAGES; k++)
+        if (act[k]) f &= s.mask[k][n];
+      s.feas[n] = f;
+      any_feas |= (bool)f;
+    }
+
+    if (!any_feas) {
+      fail_accounting(a, s, act, u, i);
+      continue;
+    }
+
+    // --- Score: reductions over the feasible set, then fused accumulate ---
+    float na_max = 0.0f, tt_max = 0.0f;
+    if (use_na) {
+      const float* na = a.na_raw + (int64_t)u * N;
+      float m = NEG;
+      for (int64_t n = 0; n < N; n++) m = std::max(m, s.feas[n] ? na[n] : 0.0f);
+      na_max = m;
+    }
+    if (use_tt) {
+      const float* tt = a.tt_raw + (int64_t)u * N;
+      float m = NEG;
+      for (int64_t n = 0; n < N; n++) m = std::max(m, s.feas[n] ? tt[n] : 0.0f);
+      tt_max = m;
+    }
+    float ip_hi = 0.0f, ip_lo = 0.0f, ip_rng = 0.0f;
+    if (use_ip) {
+      interpod_raw(a, u, s.raw_ip.data());
+      float hi = NEG, lo = BIG;
+      for (int64_t n = 0; n < N; n++) {
+        float v = s.feas[n] ? s.raw_ip[n] : 0.0f;
+        hi = std::max(hi, v);
+        lo = std::min(lo, v);
+      }
+      ip_hi = std::max(hi, 0.0f);
+      ip_lo = std::min(lo, 0.0f);
+      ip_rng = ip_hi - ip_lo;
+    }
+    bool any_soft = false;
+    float spr_mn = BIG, spr_mx = NEG;
+    if (use_spr) {
+      any_soft = spread_raw(a, u, s.feas.data(), s.raw_spr.data(), s.spr_ignored.data());
+      if (any_soft) {
+        for (int64_t n = 0; n < N; n++) {
+          if (s.feas[n] && !s.spr_ignored[n]) {
+            spr_mn = std::min(spr_mn, s.raw_spr[n]);
+            spr_mx = std::max(spr_mx, s.raw_spr[n]);
+          }
+        }
+      }
+    }
+    float sh_lo = BIG, sh_hi = NEG, sh_rng = 0.0f;
+    const float* share = a.share_raw + (int64_t)u * N;
+    if (use_share) {
+      for (int64_t n = 0; n < N; n++) {
+        if (s.feas[n]) {
+          sh_lo = std::min(sh_lo, share[n]);
+          sh_hi = std::max(sh_hi, share[n]);
+        }
+      }
+      sh_rng = sh_hi - sh_lo;
+    }
+    float lc_lo = BIG, lc_hi = NEG, lc_rng = 0.0f;
+    if (use_loc) {
+      local_raw(a, u, s.raw_loc.data());
+      for (int64_t n = 0; n < N; n++) {
+        if (s.feas[n]) {
+          lc_lo = std::min(lc_lo, s.raw_loc[n]);
+          lc_hi = std::max(lc_hi, s.raw_loc[n]);
+        }
+      }
+      lc_rng = lc_hi - lc_lo;
+    }
+
+    const float* avoid = a.avoid_score + (int64_t)u * N;
+    PreCtx pc;
+    float cpu = a.req[(int64_t)u * R + a.res_cpu];
+    float mem = a.req[(int64_t)u * R + a.res_mem];
+    pc.cpuq = cpu > 0.0f ? cpu : 100.0f;  // GetNonzeroRequests defaults
+    pc.memq = mem > 0.0f ? mem : 200.0f * 1024.0f * 1024.0f;
+    pc.na_max = na_max;
+    pc.tt_max = tt_max;
+    pc.wb = wb;
+    pc.wl = wl;
+    pc.wna = wna;
+    pc.wtt = wtt;
+    pc.use_bal = use_bal;
+    pc.use_least = use_least;
+    pc.use_na = use_na;
+    pc.use_tt = use_tt;
+    pc.na = a.na_raw + (int64_t)u * N;
+    pc.tt = a.tt_raw + (int64_t)u * N;
+
+    float best = NEG;
+    int32_t bi = -1;
+    for (int64_t n = 0; n < N; n++) {
+      if (!s.feas[n]) continue;
+      float sc = pre_at(a, pc, n);
+      if (use_ip)
+        sc += wip * (ip_rng > 0.0f
+                         ? MAXS * (s.raw_ip[n] - ip_lo) / std::max(ip_rng, 1.0f)
+                         : 0.0f);
+      if (use_spr && any_soft) {
+        float norm;
+        if (spr_mx <= 0.0f)
+          norm = MAXS;
+        else
+          norm = MAXS * (spr_mx + spr_mn - s.raw_spr[n]) / std::max(spr_mx, 1.0f);
+        if (s.spr_ignored[n]) norm = 0.0f;
+        sc += wsp * norm;
+      }
+      if (use_share)
+        sc += wshare * (sh_rng > 0.0f ? (share[n] - sh_lo) * MAXS / sh_rng : 0.0f);
+      if (use_loc)
+        sc += wloc * (lc_rng > 0.0f ? (s.raw_loc[n] - lc_lo) * MAXS / lc_rng : 0.0f);
+      if (use_avoid) sc += wav * avoid[n];
+      if (sc > best) { best = sc; bi = (int32_t)n; }
+    }
+
+    a.chosen[i] = bi;
+    if (bi >= 0) {
+      bind(a, s, u, bi, s.take.data());
+      for (int64_t d = 0; d < Gd; d++) a.gpu_take[i * Gd + d] = s.take[d];
+    }
+  }
+  return 0;
+}

@@ -1,0 +1,221 @@
+"""The C++ scan engine must produce IDENTICAL placements, failure
+attribution, and final state to the XLA scan on EVERY workload (it has no
+feature envelope — only out-of-tree extra_plugins force the XLA path).
+Covers the incremental same-template cache (long runs, failures, forced
+interleavings) and the scheduler-config weight/disable handling."""
+
+import random
+
+import numpy as np
+import pytest
+
+from opensim_tpu import native
+from opensim_tpu.engine import nativepath
+from opensim_tpu.engine.schedconfig import SchedulerConfig
+from opensim_tpu.engine.scheduler import pad_pod_stream, schedule_pods
+from opensim_tpu.engine.simulator import AppResource, prepare, simulate
+from opensim_tpu.models import ResourceTypes, fixtures as fx
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native engine unavailable: {native.load_error()}"
+)
+
+
+def _xla_out(prep, config=None):
+    P = len(prep.ordered)
+    t, v, f = pad_pod_stream(prep.tmpl_ids, np.ones(P, bool), prep.forced)
+    out = schedule_pods(
+        prep.ec, prep.st0, t, v, f, features=prep.features, config=config
+    )
+    return out, P
+
+
+def _assert_match(prep, config=None):
+    out, P = _xla_out(prep, config)
+    nout = nativepath.schedule(prep, np.ones(P, bool), config=config)
+    want = np.asarray(out.chosen)[:P]
+    mism = np.nonzero(want != nout.chosen)[0]
+    assert mism.size == 0, (
+        f"{mism.size}/{P} placement mismatches at {mism[:10]}: "
+        f"xla={want[mism[:10]]} native={nout.chosen[mism[:10]]}"
+    )
+    np.testing.assert_array_equal(np.asarray(out.fail_counts)[:P], nout.fail_counts)
+    np.testing.assert_array_equal(np.asarray(out.insufficient)[:P], nout.insufficient)
+    np.testing.assert_array_equal(np.asarray(out.final_state.used), nout.final_state.used)
+    np.testing.assert_array_equal(
+        np.asarray(out.final_state.port_used), nout.final_state.port_used
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.final_state.dom_sel), nout.final_state.dom_sel
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.final_state.gpu_free), nout.final_state.gpu_free
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out.final_state.vg_free), nout.final_state.vg_free
+    )
+    return nout
+
+
+def _run_cluster(n_nodes=24):
+    cluster = ResourceTypes()
+    for i in range(n_nodes):
+        labels = {"topology.kubernetes.io/zone": f"z{i % 3}"}
+        cluster.nodes.append(
+            fx.make_fake_node(f"n{i:03d}", "8", "16Gi", "110", fx.with_labels(labels))
+        )
+    return cluster
+
+
+def test_incremental_long_run_with_failures():
+    """One workload far over capacity: exercises the same-template cache
+    through hundreds of binds, then the exact memoized-failure tail."""
+    cluster = _run_cluster()
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("big", 600, "500m", "1Gi"))
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
+    nout = _assert_match(prep)
+    assert (nout.chosen >= 0).sum() > 300 and (nout.chosen < 0).sum() > 100
+
+
+def test_incremental_with_soft_spread():
+    cluster = _run_cluster()
+    app = ResourceTypes()
+    app.deployments.append(
+        fx.make_fake_deployment(
+            "spr", 200, "250m", "512Mi",
+            fx.with_topology_spread(
+                [
+                    {
+                        "maxSkew": 2,
+                        "topologyKey": "topology.kubernetes.io/zone",
+                        "whenUnsatisfiable": "ScheduleAnyway",
+                        "labelSelector": {"matchLabels": {"app": "spr"}},
+                    }
+                ]
+            ),
+        )
+    )
+    app.deployments.append(fx.make_fake_deployment("other", 150, "100m", "256Mi"))
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
+    _assert_match(prep)
+
+
+def test_incremental_forced_interleaving():
+    """Pre-bound pods interleave foreign binds into a template run — the
+    cache must fold them in (or drop) without placement drift."""
+    cluster = _run_cluster(8)
+    for i in range(40):
+        cluster.pods.append(
+            fx.make_fake_pod(f"bound-{i:02d}", "250m", "512Mi",
+                             fx.with_node_name(f"n{i % 8:03d}"))
+        )
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("run", 120, "500m", "1Gi"))
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
+    assert prep.forced.sum() == 40
+    _assert_match(prep)
+
+
+def test_sched_config_weights_and_disables():
+    cluster = _run_cluster(12)
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("w", 80, "500m", "1Gi"))
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
+    cfg = SchedulerConfig(w_least=3.0, w_balanced=0.0, w_spread=5.0, f_ports=False)
+    _assert_match(prep, config=cfg)
+
+
+def test_fit_disabled_zeroes_insufficient():
+    """With NodeResourcesFit disabled the XLA scan reports zero per-resource
+    shortfalls even when a later filter fails; the native engine must too."""
+    cluster = ResourceTypes()
+    for i in range(2):
+        cluster.nodes.append(fx.make_fake_node(f"n{i:03d}", "2", "4Gi", "110"))
+    app = ResourceTypes()
+    app.deployments.append(
+        fx.make_fake_deployment(
+            "blocked", 2, "3", "1Gi",
+            fx.with_affinity(
+                {
+                    "podAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {
+                                "labelSelector": {"matchLabels": {"app": "absent"}},
+                                "topologyKey": "kubernetes.io/hostname",
+                            }
+                        ]
+                    }
+                }
+            ),
+        )
+    )
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
+    nout = _assert_match(prep, config=SchedulerConfig(f_fit=False))
+    assert nout.insufficient.sum() == 0
+
+
+def test_native_engages_through_simulate(monkeypatch):
+    """On a CPU backend simulate() must route through the native engine."""
+    calls = []
+    orig = nativepath.schedule
+
+    def spy(*args, **kwargs):
+        calls.append(1)
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(nativepath, "schedule", spy)
+    cluster = _run_cluster(8)
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("d", 30, "500m", "1Gi"))
+    res = simulate(cluster, [AppResource("a", app)])
+    assert calls, "native engine was not used on the CPU backend"
+    assert sum(len(ns.pods) for ns in res.node_status) == 30
+
+
+def test_disable_env_falls_back(monkeypatch):
+    monkeypatch.setenv("OPENSIM_DISABLE_NATIVE", "1")
+    cluster = _run_cluster(8)
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("d", 10, "500m", "1Gi"))
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
+    assert not nativepath.applicable(prep)
+
+
+def test_failure_reasons_identical_through_simulate(monkeypatch):
+    """Reason strings from the native in-stream attribution must equal the
+    XLA scan's (same '0/N nodes are available: …' reconstruction)."""
+    cluster = _run_cluster(6)
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("fat", 4, "32", "64Gi"))
+    app.deployments.append(fx.make_fake_deployment("fine", 6, "500m", "1Gi"))
+
+    def reasons():
+        # pod names carry per-expansion random suffixes; compare reasons only
+        res = simulate(_run_cluster(6), [AppResource("a", app)])
+        return sorted(u.reason for u in res.unscheduled_pods)
+
+    native_reasons = reasons()
+    monkeypatch.setenv("OPENSIM_DISABLE_NATIVE", "1")
+    xla_reasons = reasons()
+    assert native_reasons == xla_reasons
+    assert native_reasons and "Insufficient" in native_reasons[0]
+
+
+@pytest.mark.parametrize("seed", [3, 11, 31, 77, 1234])
+def test_native_fuzz_vs_xla(seed):
+    """Differential fuzz over the full feature mix (gpu/local/interpod/
+    ports/namespaces) — the generic non-incremental C++ path."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from test_fastpath_fuzz import random_app, random_cluster
+
+    rng = random.Random(seed)
+    cluster = random_cluster(rng, rng.randrange(8, 20))
+    app = random_app(rng, rng.randrange(3, 8))
+    prep = prepare(cluster, [AppResource("fuzz", app)], node_pad=128)
+    if prep is None:
+        pytest.skip("empty workload")
+    _assert_match(prep)

@@ -12,20 +12,33 @@ lower-priority pods frees enough resources, mirroring the shape of
 ``dryRunPreemption`` → ``SelectVictimsOnNode`` → ``pickOneNodeForPreemption``
 (vendored ``defaultpreemption/default_preemption.go``).
 
-Scope (documented simplifications):
-- victims are selected ascending by priority until the preemptor's resource
-  request fits (no PDB accounting — the simulator has no eviction API);
+Modeled dimensions:
+- CPU/memory/extended resources (victims free their requests);
+- host ports (victims free their ports; the preemptor's ports are checked
+  through the wildcard-aware conflict matrix);
+- fractional GPU devices (victims free the exact per-device slots recorded
+  at bind time in ``gpu_take``; the preemptor is re-packed with the same
+  tightest-fit / greedy rules as ``kernels.bind_update``);
+- open-local storage for the PREEMPTOR (tightest-fit VG + smallest-fitting
+  exclusive devices) — storage-holding pods are never victims (their VG
+  allocation is not tracked per pod, so it cannot be released exactly);
+- cascading re-placement: evicted victims are re-queued in stream order and
+  re-placed on the lowest-index feasible node when capacity exists
+  elsewhere, mirroring a nominated pod re-entering the scheduling queue.
+
+Remaining documented simplifications:
+- victims are selected ascending by priority until everything fits (no PDB
+  accounting — the simulator has no eviction API);
 - candidate nodes are ranked by (fewest victims, lowest summed victim
   priority, lowest node index) — a deterministic stand-in for
   ``pickOneNodeForPreemption``'s tie-break ladder;
-- eligibility uses the static filters (unschedulable/taints/affinity/
-  nodeName) plus resource fit; feature filters that depend on *other* pods
-  (anti-affinity, spread) are re-checked conservatively by requiring the
-  preemptor to have none of those constraints when they are active;
-- victims are restricted to plain resource consumers: pods holding GPU
-  devices, host ports, or local storage are skipped (their release is not
-  re-packed), as are pods matched by any inter-pod/spread selector (another
-  placement may depend on them as an affinity anchor or domain count);
+- preemptors carrying required inter-pod terms or hard spread constraints
+  are skipped, as are preemptors matched by an existing pod's global
+  anti-affinity term (placing one would retroactively violate the
+  symmetric check);
+- when inter-pod/spread selectors exist anywhere in the workload, pods
+  matched by any selector are never victims (another placement may depend
+  on them as an affinity anchor or domain count);
 - force-bound (pre-existing) pods are never victims.
 
 Off by default: ``simulate(..., enable_preemption=True)`` or
@@ -34,7 +47,7 @@ Off by default: ``simulate(..., enable_preemption=True)`` or
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -53,28 +66,158 @@ def _static_ok(pod: Pod, node: Node) -> bool:
     return selectors.find_untolerated_taint(taints, pod.spec.tolerations) is None
 
 
+class _State:
+    """Mutable per-node resource view shared by eviction, placement, and
+    cascade — numpy rows of the final ScanState (mutated in place)."""
+
+    def __init__(self, ec, used, alloc, port_used, gpu_free, vg_free, dev_free, gpu_take):
+        self.ec = ec
+        self.used = used
+        self.alloc = alloc
+        self.port_used = port_used
+        self.gpu_free = gpu_free
+        self.vg_free = vg_free
+        self.dev_free = dev_free
+        self.gpu_take = gpu_take
+        self.req = np.asarray(ec.req)
+        self.ports = np.asarray(ec.ports)
+        self.conflict = np.asarray(ec.port_conflict)
+        self.gpu_mem = np.asarray(ec.gpu_mem)
+        self.gpu_count = np.asarray(ec.gpu_count)
+        self.lvm_req = np.asarray(ec.lvm_req)
+        self.dev_req_sizes = np.asarray(ec.dev_req_sizes)
+        self.node_dev_media = np.asarray(ec.node_dev_media)
+        self.node_dev_cap = np.asarray(ec.node_dev_cap)
+        self.Hports = port_used.shape[1] if port_used.ndim == 2 else 0
+
+    def port_hot(self, u: int) -> np.ndarray:
+        ids = self.ports[u]
+        ids = ids[ids >= 0]
+        if self.Hports == 0 or ids.size == 0:
+            return np.zeros((self.Hports,), np.float32)
+        return np.bincount(ids, minlength=self.Hports).astype(np.float32)
+
+    def ports_ok(self, u: int, n: int, freed: np.ndarray) -> bool:
+        """NodePorts with the wildcard-aware conflict matrix
+        (kernels.ports_filter) against the node's counts minus `freed`."""
+        ids = self.ports[u]
+        ids = ids[ids >= 0]
+        if ids.size == 0:
+            return True
+        remaining = self.port_used[n] - freed
+        return not bool((self.conflict[ids] @ remaining > 0).any())
+
+    def gpu_fit(self, u: int, n: int, freed: np.ndarray) -> Optional[np.ndarray]:
+        """GPU packing per kernels.bind_update / AllocateGpuId
+        (gpunodeinfo.go:232-290). Returns per-device take or None."""
+        mem = float(self.gpu_mem[u])
+        if mem <= 0:
+            return np.zeros_like(self.gpu_free[n]) if self.gpu_free.size else None
+        cnt = float(self.gpu_count[u])
+        free = self.gpu_free[n] + freed
+        chunks = np.floor_divide(free, max(mem, 1.0))
+        if not (chunks.sum() >= cnt and cnt > 0):
+            return None
+        if cnt == 1:
+            fits = free >= mem
+            tight = int(np.argmin(np.where(fits, free, np.float32(1e30))))
+            take = np.zeros_like(free)
+            take[tight] = 1.0
+            return take
+        cum = np.cumsum(chunks)
+        return np.clip(cnt - (cum - chunks), 0.0, chunks).astype(free.dtype)
+
+    def storage_fit(self, u: int, n: int) -> Optional[Tuple[int, List[int]]]:
+        """Open-local feasibility for the preemptor (victims free nothing
+        here). Returns (vg_choice or -1, device indices) or None."""
+        lvm = float(self.lvm_req[u])
+        vg_choice = -1
+        if lvm > 0:
+            fits = self.vg_free[n] >= lvm
+            if not fits.any():
+                return None
+            vg_choice = int(np.argmin(np.where(fits, self.vg_free[n], np.float32(1e30))))
+        devs: List[int] = []
+        taken = np.zeros_like(self.dev_free[n], dtype=bool)
+        for media in (0, 1):
+            sizes = self.dev_req_sizes[u, media]
+            for size in sorted(s for s in sizes if s > 0):  # smallest volume first
+                cand = (
+                    (self.node_dev_media[n] == media)
+                    & (self.dev_free[n] >= size)
+                    & (self.dev_free[n] > 0)
+                    & ~taken
+                )
+                if not cand.any():
+                    return None
+                pick = int(np.argmin(np.where(cand, self.node_dev_cap[n], np.float32(1e30))))
+                taken[pick] = True
+                devs.append(pick)
+        return vg_choice, devs
+
+    def place(self, u: int, i: int, n: int, gpu_alloc: Optional[np.ndarray]) -> None:
+        """Commit a placement: resources, ports, gpu slots, storage."""
+        self.used[n] += self.req[u]
+        if self.Hports:
+            self.port_used[n] += self.port_hot(u)
+        if gpu_alloc is not None and float(self.gpu_mem[u]) > 0:
+            self.gpu_free[n] -= gpu_alloc * float(self.gpu_mem[u])
+            self.gpu_take[i] = gpu_alloc
+        st = self.storage_fit(u, n)
+        if st is not None:
+            vg_choice, devs = st
+            if vg_choice >= 0:
+                self.vg_free[n, vg_choice] -= float(self.lvm_req[u])
+            for d in devs:
+                self.dev_free[n, d] = 0.0
+
+    def evict(self, u: int, j: int, n: int) -> None:
+        self.used[n] -= self.req[u]
+        if self.Hports:
+            self.port_used[n] -= self.port_hot(u)
+        mem = float(self.gpu_mem[u])
+        if mem > 0 and self.gpu_take is not None:
+            self.gpu_free[n] += self.gpu_take[j] * mem
+            self.gpu_take[j] = 0.0
+
+
 def preempt_pass(
     prep,
     chosen: np.ndarray,
     nodes: List[Node],
     used: np.ndarray,
     alloc: np.ndarray,
+    port_used: Optional[np.ndarray] = None,
+    gpu_free: Optional[np.ndarray] = None,
+    vg_free: Optional[np.ndarray] = None,
+    dev_free: Optional[np.ndarray] = None,
+    gpu_take: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, Dict[int, int]]:
     """Attempt preemption for every unscheduled, positive-priority pod in
-    stream order. Returns the updated ``chosen`` and a map of
-    victim-stream-index → preemptor-stream-index. ``used``/``alloc`` are the
-    encoded ``[N, R]`` resource tensors (mutated in place on success)."""
+    stream order, then re-place evicted victims where capacity exists.
+    Returns the updated ``chosen`` and a map of victim-stream-index →
+    preemptor-stream-index (victims successfully re-placed are removed).
+    All state arrays are mutated in place."""
     ec = prep.ec_np
     tmpl = prep.tmpl_ids
     forced = prep.forced
     ordered = prep.ordered
-    req = np.asarray(ec.req)  # [U, R]
     prio = np.array([p.spec.priority for p in ordered], dtype=np.int64)
     n_real = len(nodes)
     victims_of: Dict[int, int] = {}
 
-    # pods with inter-pod/spread constraints interact with evictions in ways
-    # this pass does not model — skip preemption for those preemptors
+    if port_used is None:
+        port_used = np.array(np.asarray(prep.st0.port_used), copy=True)
+    if gpu_free is None:
+        gpu_free = np.array(np.asarray(prep.st0.gpu_free), copy=True)
+    if vg_free is None:
+        vg_free = np.array(np.asarray(prep.st0.vg_free), copy=True)
+    if dev_free is None:
+        dev_free = np.array(np.asarray(prep.st0.dev_free), copy=True)
+    if gpu_take is None:
+        gpu_take = np.zeros((len(ordered), gpu_free.shape[1]), np.float32)
+    st = _State(ec, used, alloc, port_used, gpu_free, vg_free, dev_free, gpu_take)
+
     at_sel = np.asarray(ec.at_sel)
     an_sel = np.asarray(ec.an_sel)
     spr_topo = np.asarray(ec.spr_topo)
@@ -82,32 +225,40 @@ def preempt_pass(
     gpu_mem = np.asarray(ec.gpu_mem)
     lvm_req = np.asarray(ec.lvm_req)
     dev_req = np.asarray(ec.dev_req)
-    ports = np.asarray(ec.ports)
+    matches_sel = np.asarray(ec.matches_sel)
+    # only anti-affinity terms some template actually carries can be
+    # violated (the encoder keeps a dummy row at G=0 when none exist)
+    carried_g = np.asarray(ec.anti_g).any(axis=0)
+    anti_g_sel = np.asarray(ec.anti_g_sel)[carried_g]
+    sel_features = bool(prep.features.sel_counts)
 
     def constrained(u: int) -> bool:
         # constraints whose post-eviction state this pass does not model:
-        # inter-pod terms, hard spread, host ports, GPU devices, local storage
-        return bool(
-            (at_sel[u] >= 0).any()
-            or (an_sel[u] >= 0).any()
-            or ((spr_topo[u] >= 0) & spr_hard[u]).any()
-            or (ports[u] >= 0).any()
-            or gpu_mem[u] > 0
-            or lvm_req[u] > 0
-            or (dev_req[u] > 0).any()
-        )
-
-    matches_sel = np.asarray(ec.matches_sel)
-    sel_features = bool(prep.features.sel_counts)
+        # the preemptor's own required inter-pod terms and hard spread, and
+        # being the target of an existing pod's global anti-affinity term
+        if (at_sel[u] >= 0).any() or (an_sel[u] >= 0).any():
+            return True
+        if ((spr_topo[u] >= 0) & spr_hard[u]).any():
+            return True
+        if anti_g_sel.size and matches_sel[u, anti_g_sel].any():
+            return True
+        return False
 
     def victim_ok(u: int) -> bool:
-        # only plain resource consumers release cleanly: no device/port/
-        # storage holdings, and — when inter-pod/spread constraints exist
-        # anywhere in the workload — no selector matches this pod (another
-        # placement may depend on it as an anchor or domain count)
-        if gpu_mem[u] > 0 or lvm_req[u] > 0 or (dev_req[u] > 0).any() or (ports[u] >= 0).any():
+        # storage holders never release exactly (per-pod VG allocation is
+        # not tracked); selector-matched pods may anchor other placements
+        if lvm_req[u] > 0 or (dev_req[u] > 0).any():
             return False
         return not (sel_features and matches_sel[u].any())
+
+    def fits(u: int, n: int, free_res, freed_res, freed_ports, freed_gpu) -> bool:
+        if not np.all(st.req[u] <= free_res + freed_res):
+            return False
+        if not st.ports_ok(u, n, freed_ports):
+            return False
+        if float(gpu_mem[u]) > 0 and st.gpu_fit(u, n, freed_gpu) is None:
+            return False
+        return True
 
     chosen = chosen.copy()
     # node → evictable bound-pod indices, built once and maintained
@@ -117,6 +268,7 @@ def preempt_pass(
     for j in range(len(ordered)):
         if chosen[j] >= 0 and not forced[j] and victim_ok(int(tmpl[j])):
             by_node.setdefault(int(chosen[j]), []).append(j)
+
     for i in range(len(ordered)):
         if chosen[i] >= 0 or forced[i] or prio[i] <= 0:
             continue
@@ -127,17 +279,26 @@ def preempt_pass(
         for n in range(n_real):
             if not _static_ok(ordered[i], nodes[n]):
                 continue
+            if st.storage_fit(u, n) is None:
+                continue  # victims free no storage — the node must fit as-is
             cand = [j for j in by_node.get(n, []) if prio[j] < prio[i]]
             cand.sort(key=lambda j: (prio[j], j))
             free = alloc[n] - used[n]
             taken: List[int] = []
-            freed = np.zeros_like(free)
+            freed_res = np.zeros_like(free)
+            freed_ports = np.zeros((st.Hports,), np.float32)
+            freed_gpu = np.zeros_like(gpu_free[n])
             for j in cand:
-                if np.all(req[u] <= free + freed):
+                if fits(u, n, free, freed_res, freed_ports, freed_gpu):
                     break
-                freed = freed + req[int(tmpl[j])]
+                ju = int(tmpl[j])
+                freed_res = freed_res + st.req[ju]
+                if st.Hports:
+                    freed_ports = freed_ports + st.port_hot(ju)
+                if float(gpu_mem[ju]) > 0:
+                    freed_gpu = freed_gpu + gpu_take[j] * float(gpu_mem[ju])
                 taken.append(j)
-            if not np.all(req[u] <= free + freed):
+            if not fits(u, n, free, freed_res, freed_ports, freed_gpu):
                 continue  # even evicting every candidate is not enough
             key = (len(taken), int(sum(prio[j] for j in taken)), n)
             if best is None or key < best[:3]:
@@ -147,12 +308,37 @@ def preempt_pass(
         _, _, n, taken = best
         for j in taken:
             victims_of[j] = i
-            used[n] -= req[int(tmpl[j])]
+            st.evict(int(tmpl[j]), j, n)
             chosen[j] = -1
         taken_set = set(taken)
         by_node[n] = [j for j in by_node.get(n, []) if j not in taken_set]
-        used[n] += req[u]
+        gpu_alloc = st.gpu_fit(u, n, np.zeros_like(gpu_free[n]))
+        st.place(u, i, n, gpu_alloc)
         chosen[i] = n
         if victim_ok(u):
             by_node[n].append(i)  # the preemptor may itself be preempted later
+
+    # cascade: evicted victims re-enter in stream order and land on the
+    # lowest-index node with spare capacity (a nominated pod going back
+    # through the queue); no further eviction is triggered
+    for j in sorted(victims_of):
+        ju = int(tmpl[j])
+        if constrained(ju):
+            continue  # its inter-pod/spread feasibility cannot be re-checked here
+        for n in range(n_real):
+            if not _static_ok(ordered[j], nodes[n]):
+                continue
+            free = alloc[n] - used[n]
+            if not fits(ju, n, free, 0.0, np.zeros((st.Hports,), np.float32),
+                        np.zeros_like(gpu_free[n])):
+                continue
+            if st.storage_fit(ju, n) is None:
+                continue
+            gpu_alloc = st.gpu_fit(ju, n, np.zeros_like(gpu_free[n]))
+            st.place(ju, j, n, gpu_alloc)
+            chosen[j] = n
+            del victims_of[j]
+            if victim_ok(ju):
+                by_node.setdefault(n, []).append(j)
+            break
     return chosen, victims_of

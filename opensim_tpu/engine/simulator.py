@@ -460,12 +460,21 @@ def simulate(
     if enable_preemption and (chosen[~forced] < 0).any():
         from . import preemption
 
-        used = np.array(np.asarray(out.final_state.used), copy=True)
+        fs = out.final_state
+        # np.asarray of a jax array is a read-only view — preemption mutates
+        gpu_take = np.array(gpu_take, copy=True)
+        used = np.array(np.asarray(fs.used), copy=True)
+        state = {
+            "port_used": np.array(np.asarray(fs.port_used), copy=True),
+            "gpu_free": np.array(np.asarray(fs.gpu_free), copy=True),
+            "vg_free": np.array(np.asarray(fs.vg_free), copy=True),
+            "dev_free": np.array(np.asarray(fs.dev_free), copy=True),
+        }
         chosen, victims_of = preemption.preempt_pass(
-            prep, chosen, cluster.nodes, used, np.asarray(prep.ec_np.alloc)
+            prep, chosen, cluster.nodes, used, np.asarray(prep.ec_np.alloc),
+            gpu_take=gpu_take, **state,
         )
-        if victims_of:
-            out = out._replace(final_state=out.final_state._replace(used=used))
+        out = out._replace(final_state=fs._replace(used=used, **state))
 
     node_pods: Dict[str, List[Pod]] = {n.metadata.name: [] for n in cluster.nodes}
     unscheduled: List[UnscheduledPod] = []

@@ -74,3 +74,162 @@ def test_forced_pods_are_never_victims():
     # the pre-bound resident stays; vip remains unscheduled with a kube reason
     assert {u.pod.metadata.name for u in res.unscheduled_pods} == {"vip"}
     assert "Insufficient" in res.unscheduled_pods[0].reason
+
+
+def test_port_holding_victim_frees_the_port():
+    """A high-priority pod needing a host port evicts the lower-priority
+    port holder (round-2b: ports are modeled through the conflict matrix)."""
+    cluster = _cluster(n=1)
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("holder", "1", "1Gi", fx.with_priority(5),
+                                     fx.with_host_ports([8080])))
+    app.pods.append(fx.make_fake_pod("vip", "1", "1Gi", fx.with_priority(500),
+                                     fx.with_host_ports([8080])))
+    res = simulate(cluster, [AppResource("a", app)], enable_preemption=True)
+    placed = {p.metadata.name for ns in res.node_status for p in ns.pods}
+    assert "vip" in placed
+    unsched = {u.pod.metadata.name: u.reason for u in res.unscheduled_pods}
+    assert set(unsched) == {"holder"}
+    assert "preempted by higher-priority pod" in unsched["holder"]
+
+
+def test_gpu_victim_frees_devices_and_preemptor_gets_annotation():
+    from opensim_tpu.models.objects import ANNO_GPU_INDEX
+
+    cluster = ResourceTypes()
+    cluster.nodes.append(
+        fx.make_fake_node(
+            "g0", "8", "16Gi", "110",
+            fx.with_allocatable({"alibabacloud.com/gpu-mem": "16Gi",
+                                 "alibabacloud.com/gpu-count": "2"}),
+        )
+    )
+    gpu_req = fx.with_annotations({"alibabacloud.com/gpu-mem": "8Gi",
+                                   "alibabacloud.com/gpu-count": "2"})
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("tenant", "1", "1Gi", fx.with_priority(5), gpu_req))
+    app.pods.append(fx.make_fake_pod("vip", "1", "1Gi", fx.with_priority(500), gpu_req))
+    res = simulate(cluster, [AppResource("a", app)], enable_preemption=True)
+    placed = {p.metadata.name: p for ns in res.node_status for p in ns.pods}
+    assert "vip" in placed
+    assert placed["vip"].metadata.annotations.get(ANNO_GPU_INDEX) == "0-1"
+    assert {u.pod.metadata.name for u in res.unscheduled_pods} == {"tenant"}
+
+
+def test_storage_preemptor_lands_on_storage_node():
+    """An open-local preemptor can evict a plain resource hog from the only
+    storage-capable node (victims free cpu/mem; the VG must fit as-is)."""
+    cluster = ResourceTypes()
+    cluster.nodes.append(
+        fx.make_fake_node(
+            "s0", "4", "8Gi", "110",
+            fx.with_node_local_storage(vgs=[{"name": "pool", "capacity": 100 * 1024**3}]),
+        )
+    )
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("hog", "4", "2Gi", fx.with_priority(5)))
+    import json
+
+    payload = json.dumps({"volumes": [{"size": str(10 * 1024**3), "kind": "LVM",
+                                       "scName": "open-local-lvm"}]})
+    app.pods.append(
+        fx.make_fake_pod("db", "2", "2Gi", fx.with_priority(500),
+                         fx.with_pod_local_storage(payload))
+    )
+    res = simulate(cluster, [AppResource("a", app)], enable_preemption=True)
+    placed = {p.metadata.name for ns in res.node_status for p in ns.pods}
+    assert "db" in placed
+    assert {u.pod.metadata.name for u in res.unscheduled_pods} == {"hog"}
+
+
+def test_cascading_replacement_rehomes_the_victim():
+    """Eviction from a pinned-affinity node re-places the victim on the
+    other node instead of reporting it unschedulable (round-2b cascade)."""
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n0", "4", "8Gi", "110", fx.with_labels({"disk": "ssd"})))
+    cluster.nodes.append(fx.make_fake_node("n1", "4", "8Gi"))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("tenant", "3", "2Gi", fx.with_priority(5)))
+    app.pods.append(
+        fx.make_fake_pod("vip", "3", "2Gi", fx.with_priority(500),
+                         fx.with_node_selector({"disk": "ssd"}))
+    )
+    res = simulate(cluster, [AppResource("a", app)], enable_preemption=True)
+    placed = {p.metadata.name: ns.node.metadata.name
+              for ns in res.node_status for p in ns.pods}
+    # vip takes the ssd node; the displaced tenant cascades onto n1
+    assert placed.get("vip") == "n0"
+    assert placed.get("tenant") == "n1"
+    assert not res.unscheduled_pods
+
+
+def test_gpu_preemption_on_xla_path(monkeypatch):
+    """Same GPU eviction through the XLA scan (native disabled): the
+    read-only jax gpu_take buffer must be copied before mutation."""
+    monkeypatch.setenv("OPENSIM_DISABLE_NATIVE", "1")
+    test_gpu_victim_frees_devices_and_preemptor_gets_annotation()
+
+
+def test_spread_constrained_preemptor_still_preempts():
+    """A soft-spread selector registers selector id 0; the dummy anti-term
+    row must not be mistaken for a real anti-affinity target."""
+    cluster = _cluster(n=1)
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("low", "3", "2Gi", fx.with_priority(5)))
+    app.deployments.append(
+        fx.make_fake_deployment(
+            "vip", 1, "3", "2Gi", fx.with_priority(500),
+            fx.with_topology_spread(
+                [
+                    {
+                        "maxSkew": 1,
+                        "topologyKey": "kubernetes.io/hostname",
+                        "whenUnsatisfiable": "ScheduleAnyway",
+                        "labelSelector": {"matchLabels": {"app": "vip"}},
+                    }
+                ]
+            ),
+        )
+    )
+    res = simulate(cluster, [AppResource("a", app)], enable_preemption=True)
+    placed = {p.metadata.name for ns in res.node_status for p in ns.pods}
+    assert any(n.startswith("vip") for n in placed)
+    assert {u.pod.metadata.name for u in res.unscheduled_pods} == {"low"}
+
+
+def test_cascade_skips_anti_affinity_victims():
+    """An evicted victim with its own required anti-affinity must stay
+    preempted rather than cascade onto a node that violates it."""
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n0", "4", "8Gi", "110", fx.with_labels({"disk": "ssd"})))
+    cluster.nodes.append(fx.make_fake_node("n1", "4", "8Gi", "110", fx.with_labels({"disk": "hdd"})))
+    app = ResourceTypes()
+    # db is pinned to n1 (the victim's only alternative) and repels it
+    app.pods.append(fx.make_fake_pod("db", "1", "1Gi", fx.with_labels({"app": "db"}),
+                                     fx.with_node_selector({"disk": "hdd"})))
+    anti = fx.with_affinity(
+        {
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {
+                        "labelSelector": {"matchLabels": {"app": "db"}},
+                        "topologyKey": "kubernetes.io/hostname",
+                    }
+                ]
+            }
+        }
+    )
+    app.pods.append(fx.make_fake_pod("tenant", "3", "2Gi", fx.with_priority(5), anti))
+    vip_app = ResourceTypes()
+    vip_app.pods.append(
+        fx.make_fake_pod("vip", "3", "2Gi", fx.with_priority(500),
+                         fx.with_node_selector({"disk": "ssd"}))
+    )
+    res = simulate(cluster, [AppResource("a", app), AppResource("b", vip_app)],
+                   enable_preemption=True)
+    placed = {p.metadata.name: ns.node.metadata.name
+              for ns in res.node_status for p in ns.pods}
+    unsched = {u.pod.metadata.name: u.reason for u in res.unscheduled_pods}
+    assert placed.get("vip") == "n0"
+    # tenant must NOT cascade next to db; it stays preempted
+    assert "tenant" in unsched and "preempted" in unsched["tenant"]

@@ -159,24 +159,36 @@ def _fast_clone(proto: Pod, name: str) -> Pod:
     """Cheap replica of a sanitized prototype pod: fresh metadata, shared
     (immutable after sanitization) spec internals. Replica expansion is the
     host-side hot path at 50k-pod scale — one deepcopy per workload, not
-    per pod."""
+    per pod, and the per-clone objects are built via ``object.__new__`` to
+    skip dataclass default processing (measured ~2× on this path)."""
     from .objects import ObjectMeta, Pod as PodCls
 
-    meta = ObjectMeta(
+    pm = proto.metadata
+    uid = new_uid()
+    meta = object.__new__(ObjectMeta)
+    meta.__dict__.update(
         name=name,
-        namespace=proto.metadata.namespace,
-        labels=dict(proto.metadata.labels),
-        annotations=dict(proto.metadata.annotations),
-        uid=new_uid(),
-        generate_name=proto.metadata.generate_name,
-        owner_references=list(proto.metadata.owner_references),
+        namespace=pm.namespace,
+        labels=dict(pm.labels),
+        annotations=dict(pm.annotations),
+        uid=uid,
+        generate_name=pm.generate_name,
+        owner_references=list(pm.owner_references),
     )
     # cheap shallow spec copy (node_name is set per pod at bind decode;
     # nested lists stay shared and immutable post-sanitization)
     spec = object.__new__(type(proto.spec))
     spec.__dict__.update(proto.spec.__dict__)
-    raw = {**proto.raw, "metadata": {"name": name, "namespace": meta.namespace, "uid": meta.uid}} if proto.raw else {}
-    return PodCls(metadata=meta, spec=spec, phase=proto.phase, raw=raw)
+    pod = object.__new__(PodCls)
+    pod.__dict__.update(
+        metadata=meta,
+        spec=spec,
+        phase=proto.phase,
+        raw={**proto.raw, "metadata": {"name": name, "namespace": pm.namespace, "uid": uid}}
+        if proto.raw
+        else {},
+    )
+    return pod
 
 
 def pods_from_replica_set(rs: Workload) -> List[Pod]:

@@ -17,8 +17,11 @@
 //   (-ffp-contract=off keeps IEEE f32 semantics aligned with XLA:CPU so
 //    score ties break identically in both engines)
 
+#include <chrono>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <vector>
 
@@ -132,6 +135,13 @@ struct Scratch {
   std::vector<float> key_sel_total;  // [Tk,A] Σ dom_sel over real domains per key
   std::vector<float> take;           // [Gd]
   std::vector<uint8_t> affected;     // delta scratch
+  // incremental-path indexes: nodes per real domain, nodes per key missing
+  // the label (trash row is shared across keys, so it needs per-key lists)
+  std::vector<std::vector<int32_t>> dom_members;
+  std::vector<std::vector<int32_t>> trash_members;
+  std::vector<int32_t> visited;  // epoch stamps for member-union dedup
+  std::vector<int32_t> touch;    // affected nodes collected this delta
+  int32_t epoch = 0;
 };
 
 // Incremental same-template cache. Pod streams are dominated by runs of one
@@ -730,32 +740,30 @@ bool apply_deltas(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e, PreCt
 
     bool scal_changed = false;
     if (e.use_spr && tc.any_soft) {
-      // nodes sharing a soft-constraint domain with j see new counts
-      int32_t jdom[16];
-      int32_t jtk[16];
-      int nsoft = 0;
-      for (int64_t cc = 0; cc < Cs && nsoft < 16; cc++) {
-        int32_t tk = a.spr_topo[u * Cs + cc];
-        if (tk >= 0 && !a.spr_hard[u * Cs + cc]) {
-          jtk[nsoft] = tk;
-          jdom[nsoft] = a.node_domain[j * Tk + tk];
-          nsoft++;
-        }
-      }
+      // only nodes sharing a soft-constraint domain with j see new counts;
+      // walk the per-domain member lists instead of scanning the node axis
+      const int32_t trash = (int32_t)a.Dp1 - 1;
+      s.epoch++;
+      s.touch.clear();
       float max_new_aff = NEG;
       bool mn_rescan = false;
-      for (int64_t n = 0; n < N; n++) {
-        bool aff = false;
-        for (int k = 0; k < nsoft; k++)
-          if (a.node_domain[n * Tk + jtk[k]] == jdom[k]) { aff = true; break; }
-        s.affected[n] = aff;
-        if (!aff) continue;
-        bool scored = tc.feas[n] && !tc.ignored[n];
-        if (scored && tc.spr_raw[n] <= tc.spr_mn) mn_rescan = true;
-        bool all_labels;
-        float nr = spr_raw_at(a, u, n, &all_labels);
-        tc.spr_raw[n] = nr;
-        if (scored) max_new_aff = std::max(max_new_aff, nr);
+      for (int64_t cc = 0; cc < Cs; cc++) {
+        int32_t tk = a.spr_topo[u * Cs + cc];
+        if (tk < 0 || a.spr_hard[u * Cs + cc]) continue;
+        int32_t jdom = a.node_domain[j * Tk + tk];
+        const std::vector<int32_t>& mem =
+            (jdom == trash) ? s.trash_members[tk] : s.dom_members[jdom];
+        for (int32_t n : mem) {
+          if (s.visited[n] == s.epoch) continue;
+          s.visited[n] = s.epoch;
+          s.touch.push_back(n);
+          bool scored = tc.feas[n] && !tc.ignored[n];
+          if (scored && tc.spr_raw[n] <= tc.spr_mn) mn_rescan = true;
+          bool all_labels;
+          float nr = spr_raw_at(a, u, n, &all_labels);
+          tc.spr_raw[n] = nr;
+          if (scored) max_new_aff = std::max(max_new_aff, nr);
+        }
       }
       // counts only grow, so max updates in place; min moves only if the
       // old minimum sat in an affected domain
@@ -763,21 +771,43 @@ bool apply_deltas(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e, PreCt
       float new_mn = tc.spr_mn;
       if (mn_rescan) {
         new_mn = BIG;
-        for (int64_t n = 0; n < N; n++)
-          if (tc.feas[n] && !tc.ignored[n]) new_mn = std::min(new_mn, tc.spr_raw[n]);
+        const uint8_t* fe = tc.feas.data();
+        const uint8_t* ig = tc.ignored.data();
+        const float* raw = tc.spr_raw.data();
+        for (int64_t n = 0; n < N; n++) {
+          float v = (fe[n] && !ig[n]) ? raw[n] : BIG;
+          new_mn = std::min(new_mn, v);
+        }
       }
       scal_changed = (new_mx != tc.spr_mx) || (new_mn != tc.spr_mn);
       tc.spr_mx = new_mx;
       tc.spr_mn = new_mn;
       if (scal_changed) {
+        // normalization scalars moved: every node's spread term shifts.
+        // Branchless over the full axis (values at infeasible nodes are
+        // consistent but never read — argmax guards on feas)
+        const float mx = tc.spr_mx, mn = tc.spr_mn;
+        const float denom = std::max(mx, 1.0f);
+        const uint8_t* ig = tc.ignored.data();
+        const float* raw = tc.spr_raw.data();
+        float* term = tc.spr_term.data();
+        float* score = tc.score.data();
+        const float* pre = tc.pre.data();
+        const float* sht = tc.share_term.data();
+        const float* avt = tc.av_term.data();
+        const bool ush = e.use_share, uav = e.use_avoid;
+        const float wsp = e.wsp;
         for (int64_t n = 0; n < N; n++) {
-          if (!tc.feas[n]) continue;
-          tc.spr_term[n] = spr_term_of(tc, e, n);
-          tc.score[n] = recombine(tc, e, n);
+          float norm = (mx <= 0.0f) ? MAXS : MAXS * (mx + mn - raw[n]) / denom;
+          norm = ig[n] ? 0.0f : norm;
+          term[n] = wsp * norm;
+          float sc = pre[n] + term[n];
+          if (ush) sc += sht[n];
+          if (uav) sc += avt[n];
+          score[n] = sc;
         }
       } else {
-        for (int64_t n = 0; n < N; n++) {
-          if (!s.affected[n] || !tc.feas[n]) continue;
+        for (int32_t n : s.touch) {
           tc.spr_term[n] = spr_term_of(tc, e, n);
           tc.score[n] = recombine(tc, e, n);
         }
@@ -791,9 +821,40 @@ bool apply_deltas(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e, PreCt
 
 }  // namespace
 
+namespace {
+// OPENSIM_NATIVE_PROFILE=1: accumulate per-phase wall time and step
+// counts, printed to stderr at the end of each run.
+struct Prof {
+  bool on = false;
+  double t[6] = {};  // delta, full_eval, argmax, bind, fail, generic
+  int64_t c[6] = {};
+  std::chrono::steady_clock::time_point t0;
+  void start() {
+    if (on) t0 = std::chrono::steady_clock::now();
+  }
+  void stop(int k) {
+    if (!on) return;
+    auto t1 = std::chrono::steady_clock::now();
+    t[k] += std::chrono::duration<double>(t1 - t0).count();
+    c[k]++;
+    t0 = t1;
+  }
+  void report() const {
+    if (!on) return;
+    const char* names[6] = {"delta", "full_eval", "argmax", "bind", "fail", "generic"};
+    for (int k = 0; k < 6; k++)
+      if (c[k])
+        std::fprintf(stderr, "[native] %-9s %8.3fs over %8lld steps (%.1f us/step)\n",
+                     names[k], t[k], (long long)c[k], t[k] / c[k] * 1e6);
+  }
+};
+}  // namespace
+
 extern "C" int opensim_run_scan(ScanArgs* ap) {
   ScanArgs& a = *ap;
   const int64_t N = a.N, R = a.R, P = a.P, A = a.A, Tk = a.Tk, Gd = a.Gd;
+  Prof prof;
+  prof.on = std::getenv("OPENSIM_NATIVE_PROFILE") != nullptr;
   Scratch s;
   s.feas.resize(N);
   for (auto& m : s.mask) m.resize(N);
@@ -856,6 +917,20 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
     tc.score.resize(N);
     tc.fail_row.resize(N_STAGES);
     tc.ins_row.resize(R);
+    // per-domain node lists for the delta path (a real domain belongs to
+    // exactly one topology key; the shared trash row gets per-key lists)
+    s.dom_members.resize(a.Dp1);
+    s.trash_members.resize(Tk);
+    s.visited.assign(N, 0);
+    const int32_t trash = (int32_t)a.Dp1 - 1;
+    for (int64_t tk = 0; tk < Tk; tk++)
+      for (int64_t n = 0; n < N; n++) {
+        int32_t d = a.node_domain[n * Tk + tk];
+        if (d == trash)
+          s.trash_members[tk].push_back((int32_t)n);
+        else
+          s.dom_members[d].push_back((int32_t)n);
+      }
   }
 
   for (int64_t i = 0; i < P; i++) {
@@ -907,34 +982,57 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
         for (int64_t r = 0; r < R; r++) a.insufficient[i * R + r] = tc.ins_row[r];
         continue;
       }
-      if (cached && !tc.pending.empty() && !apply_deltas(a, s, tc, env, pc)) {
-        tc.valid = false;
-        cached = false;
+      prof.start();
+      if (cached && !tc.pending.empty()) {
+        if (!apply_deltas(a, s, tc, env, pc)) {
+          tc.valid = false;
+          cached = false;
+        }
+        prof.stop(0);
       }
-      if (!(tc.valid && tc.u == u)) full_eval_env(a, tc, env, pc, u);
+      if (!(tc.valid && tc.u == u)) {
+        prof.start();
+        full_eval_env(a, tc, env, pc, u);
+        prof.stop(1);
+      }
 
+      prof.start();
+      // two-pass first-argmax: a branchless masked max (vectorizes), then
+      // the first index attaining it — identical to the strict > scan
       float best = NEG;
       int32_t bi = -1;
       const float* sc = tc.score.data();
       const uint8_t* fe = tc.feas.data();
-      for (int64_t n = 0; n < N; n++)
-        if (fe[n] && sc[n] > best) { best = sc[n]; bi = (int32_t)n; }
+      for (int64_t n = 0; n < N; n++) {
+        float v = fe[n] ? sc[n] : NEG;
+        best = std::max(best, v);
+      }
+      if (best > NEG) {
+        for (int64_t n = 0; n < N; n++)
+          if (fe[n] && sc[n] == best) { bi = (int32_t)n; break; }
+      }
+      prof.stop(2);
 
       if (bi < 0) {
+        prof.start();
         if (act_fit) fit_mask(a, u, s.mask[S_FIT].data());
         fail_accounting(a, s, act, u, i);
         tc.prev_failed = true;
         for (int k = 0; k < N_STAGES; k++) tc.fail_row[k] = a.fail_counts[i * N_STAGES + k];
         for (int64_t r = 0; r < R; r++) tc.ins_row[r] = a.insufficient[i * R + r];
+        prof.stop(4);
         continue;
       }
       tc.prev_failed = false;
+      prof.start();
       bind(a, s, u, bi, s.take.data());
+      prof.stop(3);
       tc.pending.push_back(bi);
       a.chosen[i] = bi;
       for (int64_t d = 0; d < Gd; d++) a.gpu_take[i * Gd + d] = s.take[d];
       continue;
     }
+    prof.start();
 
     // --- Filter: active dynamic masks over the full node axis ---
     if (act_ports) ports_mask(a, u, s.mask[S_PORTS].data());
@@ -1072,6 +1170,8 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
       bind(a, s, u, bi, s.take.data());
       for (int64_t d = 0; d < Gd; d++) a.gpu_take[i * Gd + d] = s.take[d];
     }
+    prof.stop(5);
   }
+  prof.report();
   return 0;
 }

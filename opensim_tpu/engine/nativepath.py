@@ -29,6 +29,19 @@ from ..ops import kernels
 from .schedconfig import DEFAULT_CONFIG
 
 
+@functools.lru_cache(maxsize=None)
+def _warn_native_unavailable() -> None:
+    import logging
+
+    from .. import native
+
+    logging.getLogger("opensim_tpu").warning(
+        "OPENSIM_NATIVE=1 but the native engine is unavailable "
+        "(falling back to the XLA scan): %s",
+        native.load_error() or "engine not built",
+    )
+
+
 def applicable(prep, config=None, extra_plugins: tuple = ()) -> bool:
     if extra_plugins:
         return False
@@ -37,6 +50,8 @@ def applicable(prep, config=None, extra_plugins: tuple = ()) -> bool:
     from .. import native
 
     if os.environ.get("OPENSIM_NATIVE") == "1":
+        if not native.available():
+            _warn_native_unavailable()
         return native.available()
     import jax
 

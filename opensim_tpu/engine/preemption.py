@@ -206,6 +206,16 @@ def preempt_pass(
     n_real = len(nodes)
     victims_of: Dict[int, int] = {}
 
+    opt_state = (port_used, gpu_free, vg_free, dev_free, gpu_take)
+    if any(a is None for a in opt_state) and any(a is not None for a in opt_state):
+        # `used` is the caller's FINAL state; defaulting only some of the
+        # companion arrays to the initial st0 would silently mix epochs
+        # (e.g. final resource usage with initial port occupancy)
+        raise ValueError(
+            "preempt_pass: pass port_used/gpu_free/vg_free/dev_free/gpu_take "
+            "together (all or none) — partial state mixes initial and final "
+            "occupancy"
+        )
     if port_used is None:
         port_used = np.array(np.asarray(prep.st0.port_used), copy=True)
     if gpu_free is None:
@@ -252,7 +262,10 @@ def preempt_pass(
         return not (sel_features and matches_sel[u].any())
 
     def fits(u: int, n: int, free_res, freed_res, freed_ports, freed_gpu) -> bool:
-        if not np.all(st.req[u] <= free_res + freed_res):
+        # match fit_filter: only resources the preemptor actually requests
+        # gate the fit (a node overcommitted by force-bound pods in some
+        # resource must still admit a pod requesting none of it)
+        if not np.all((st.req[u] <= free_res + freed_res) | (st.req[u] <= 0)):
             return False
         if not st.ports_ok(u, n, freed_ports):
             return False

@@ -233,3 +233,29 @@ def test_cascade_skips_anti_affinity_victims():
     assert placed.get("vip") == "n0"
     # tenant must NOT cascade next to db; it stays preempted
     assert "tenant" in unsched and "preempted" in unsched["tenant"]
+
+
+def test_partial_state_arguments_rejected():
+    """port_used/gpu_free/vg_free/dev_free/gpu_take must be passed together —
+    partial state would mix initial and final occupancy (ADVICE r2)."""
+    import numpy as np
+    import pytest
+
+    from opensim_tpu.engine import preemption
+    from opensim_tpu.engine.simulator import prepare
+
+    cluster = _cluster(n=1)
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("p", "1", "1Gi", fx.with_priority(5)))
+    prep = prepare(cluster, [AppResource("a", app)])
+    used = np.array(np.asarray(prep.st0.used), copy=True)
+    alloc = np.asarray(prep.ec_np.alloc)
+    chosen = np.array([-1], dtype=np.int64)
+    with pytest.raises(ValueError, match="all or none"):
+        preemption.preempt_pass(
+            prep, chosen, cluster.nodes, used, alloc,
+            port_used=np.array(np.asarray(prep.st0.port_used), copy=True),
+        )
+    # all-none still works
+    out, victims = preemption.preempt_pass(prep, chosen, cluster.nodes, used, alloc)
+    assert victims == {}

@@ -8,13 +8,12 @@ accelerator. `vs_baseline` = the <10 s target from BASELINE.md divided by
 the measured time (>1 means the target is beaten). The reference publishes
 no numbers (SURVEY.md §6), so the driver-set target is the yardstick.
 
-Usage: python bench.py [--pods N] [--nodes N] [--profile small|full]
+Usage: python bench.py [--pods N] [--nodes N] [--config NAME] [--scenarios N]
 """
 
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -22,47 +21,9 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/opensim-jit-cache")
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-BACKEND_NOTE = None
-_PROBE_CACHE = "/tmp/opensim-tpu-probe"
-_PROBE_TTL_S = 600
+from opensim_tpu.utils.probe import ensure_accelerator_or_cpu  # noqa: E402
 
-
-def _probe_accelerator(timeout_s: int = 90) -> bool:
-    """Run a trivial device op in a subprocess: the axon tunnel can die in a
-    way that hangs any jax call forever, which would hang this benchmark.
-    On failure we fall back to CPU and say so in the output. The verdict is
-    cached briefly so bench-all doesn't repay the timeout per invocation."""
-    try:
-        st = os.stat(_PROBE_CACHE)
-        if time.time() - st.st_mtime < _PROBE_TTL_S:
-            with open(_PROBE_CACHE) as f:
-                return f.read().strip() == "ok"
-    except OSError:
-        pass
-    verdict = False
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax, jax.numpy as jnp; import numpy; numpy.asarray(jnp.ones((8,8)).sum()); print('ok')"],
-            timeout=timeout_s,
-            capture_output=True,
-            text=True,
-        )
-        verdict = r.returncode == 0 and "ok" in r.stdout
-    except subprocess.TimeoutExpired:
-        verdict = False
-    try:
-        with open(_PROBE_CACHE, "w") as f:
-            f.write("ok" if verdict else "dead")
-    except OSError:
-        pass
-    return verdict
-
-
-if not _probe_accelerator():
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    BACKEND_NOTE = "cpu fallback: accelerator unreachable (axon tunnel down)"
+BACKEND_NOTE = ensure_accelerator_or_cpu()
 
 import numpy as np  # noqa: E402
 

@@ -110,8 +110,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
 
-    if getattr(args, "backend", "auto") != "auto":
-        _select_backend(args.backend)
+    backend = getattr(args, "backend", "auto")
+    if backend != "auto":
+        _select_backend(backend)
+    elif args.command in ("apply", "defrag", "server"):
+        # auto mode must not hang when the accelerator tunnel is dead: any
+        # jax device op can block forever (utils/probe.py), so probe in a
+        # subprocess first and fall back to the host CPU with a note
+        from ..utils.probe import ensure_accelerator_or_cpu
+
+        note = ensure_accelerator_or_cpu()
+        if note:
+            logging.getLogger("opensim_tpu").warning(note)
 
     if args.command == "version":
         print(f"simon version: {VERSION}, commit: {COMMIT_ID}")
@@ -218,6 +228,13 @@ def _select_backend(backend: str) -> None:
         # (encoding + static precompute) off the device too
         jax.config.update("jax_platforms", "cpu")
     elif backend == "tpu":
+        # probe first: jax.default_backend() itself hangs forever when the
+        # accelerator tunnel is dead (utils/probe.py)
+        from ..utils.probe import accelerator_reachable
+
+        if not accelerator_reachable(fresh=True):
+            print("simon: --backend tpu requested but the accelerator is unreachable", file=sys.stderr)
+            raise SystemExit(1)
         if jax.default_backend() != "tpu":
             print("simon: --backend tpu requested but no TPU backend is available", file=sys.stderr)
             raise SystemExit(1)

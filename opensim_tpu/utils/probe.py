@@ -1,0 +1,77 @@
+"""Accelerator reachability probe.
+
+The axon tunnel that fronts the TPU can die in a total-hang mode where ANY
+jax device op — even ``jax.devices()`` — blocks forever. Every entry point
+that would otherwise touch the device on the user's behalf (the CLI's
+``--backend auto``, ``bench.py``) first runs a trivial device op in a
+*subprocess* with a timeout; on failure the caller forces the CPU platform
+in-process (``jax.config.update("jax_platforms", "cpu")``) instead of
+hanging. The verdict is cached on disk briefly so a batch of invocations
+pays the timeout once.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+_PROBE_CACHE = os.environ.get("OPENSIM_PROBE_CACHE", "/tmp/opensim-tpu-probe")
+_PROBE_TTL_S = 600
+
+
+def accelerator_reachable(timeout_s: int = 90, fresh: bool = False) -> bool:
+    """True when a trivial jax device op completes in a subprocess.
+
+    Note the semantic: "a device op completes", not "a TPU exists" — on a
+    CPU-only host the probe succeeds quickly and auto mode proceeds to the
+    platform jax picks (where the C++ engine is the default anyway).
+    ``fresh=True`` skips the cached verdict (an explicit --backend tpu
+    request must not trust a pre-outage "ok" for up to the TTL) but still
+    records the new one.
+    """
+    if not fresh:
+        try:
+            st = os.stat(_PROBE_CACHE)
+            if time.time() - st.st_mtime < _PROBE_TTL_S:
+                with open(_PROBE_CACHE) as f:
+                    return f.read().strip() == "ok"
+        except OSError:
+            pass
+    verdict = False
+    try:
+        r = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax, jax.numpy as jnp; import numpy; "
+                "numpy.asarray(jnp.ones((8,8)).sum()); print('ok')",
+            ],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+        verdict = r.returncode == 0 and "ok" in r.stdout
+    except (OSError, subprocess.TimeoutExpired):
+        verdict = False
+    try:
+        tmp = f"{_PROBE_CACHE}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write("ok" if verdict else "dead")
+        os.replace(tmp, _PROBE_CACHE)  # atomic: concurrent readers never see a torn write
+    except OSError:
+        pass
+    return verdict
+
+
+def ensure_accelerator_or_cpu(timeout_s: int = 90) -> str | None:
+    """Probe, and force the host-CPU platform in-process when the
+    accelerator is unreachable. Returns a human-readable note on fallback,
+    None when the device path is healthy. Call BEFORE any jax device op."""
+    if accelerator_reachable(timeout_s):
+        return None
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu fallback: accelerator unreachable (axon tunnel down)"

@@ -31,7 +31,7 @@ def _pad8_static(n: int) -> int:
 
 def applicable(prep, config=None) -> bool:
     """The megakernel covers: static filters + fit + least/balanced/share +
-    topology spread + inter-pod terms, hostname plus at most two other
+    topology spread + inter-pod terms, hostname plus at most four other
     topology keys (stacked per-key count blocks)."""
     if config is not None and config != DEFAULT_CONFIG:
         return False
@@ -47,8 +47,6 @@ def applicable(prep, config=None) -> bool:
         or int(ec.dev_req_sizes.shape[2]) > 8
     ):
         return False
-    if f.prefer_avoid:
-        return False  # preferAvoidPods annotations are rare; XLA path handles them
     # inter-pod terms are supported with bounded table sizes
     if f.interpod or f.prefg:
         if int(ec.anti_g_sel.shape[0]) > 16 or int(ec.prefg_sel.shape[0]) > 16:
@@ -59,9 +57,9 @@ def applicable(prep, config=None) -> bool:
             or int(ec.pt_sel.shape[1]) > 4
         ):
             return False
-    N = int(ec.node_valid.shape[0])
-    if N % 128 != 0:
-        return False
+    # N is padded to a 128-lane multiple at marshalling time
+    # (build_inputs), so any encoder node_pad is acceptable
+    N = 128 * math.ceil(int(ec.node_valid.shape[0]) / 128)
     U = int(ec.req.shape[0])
     A = int(ec.matches_sel.shape[1])
     R = int(ec.alloc.shape[1])
@@ -73,8 +71,9 @@ def applicable(prep, config=None) -> bool:
     vocab = prep.meta.vocab
     topo_keys = vocab.topo_keys.items()
     non_host = [k for k in topo_keys if k != HOSTNAME]
-    if len(non_host) > 2:
-        return False  # hostname + up to two zone-like keys
+    if len(non_host) > 4:
+        return False  # hostname + up to four zone-like keys (compile-time
+        # unrolled per-key loops; beyond that the XLA scan wins anyway)
     # hostname domains must be node-identity (each valid node carries its
     # own hostname label) for the per-node count layout to be exact
     if HOSTNAME in topo_keys:
@@ -148,6 +147,8 @@ def applicable(prep, config=None) -> bool:
         rows += U_resident
     if f.prefer_taints:
         rows += U_resident
+    if f.prefer_avoid:
+        rows += U_resident
     vmem = (rows * N + (2 * K * N + zone_z_rows) * Z + u_rows * u_cols) * 4
     if vmem > _VMEM_BUDGET:
         return False
@@ -185,7 +186,21 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
     # all-valid tables would count padding nodes); one extra cached
     # precompute fetches just that small array
     static_fail_real = np.asarray(jax.device_get(_precompute_jit(prep.ec).static_fail))
-    N = int(ec.node_valid.shape[0])
+    # the kernel needs a 128-lane node axis; pad every [*, N] table here
+    # (padding nodes are invalid, domain-less, zero-capacity) and trim the
+    # outputs back in schedule()/sweep()
+    N_orig = int(ec.node_valid.shape[0])
+    N = 128 * math.ceil(N_orig / 128)
+    pad_n = N - N_orig
+
+    def _padN(a, axis=-1, fill=0):
+        a = np.asarray(a)
+        if pad_n == 0:
+            return a
+        widths = [(0, 0)] * a.ndim
+        widths[axis] = (0, pad_n)
+        return np.pad(a, widths, constant_values=fill)
+
     U = int(ec.req.shape[0])
     A = int(ec.matches_sel.shape[1])
     R = int(ec.alloc.shape[1])
@@ -194,8 +209,8 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
     host_tk = topo_keys.index(HOSTNAME) if HOSTNAME in topo_keys else -1
     zone_tks = [i for i, k in enumerate(topo_keys) if k != HOSTNAME]
 
-    node_domain = np.asarray(ec.node_domain)
     trash = np.asarray(ec.domain_topo).shape[0] - 1
+    node_domain = _padN(np.asarray(ec.node_domain), axis=0, fill=trash)
 
     # per-key zone one-hot blocks (dense, shared Z padded to 128 lanes);
     # topo-idx → key-index map: 0 = hostname, 1..K = zone keys in vocab order
@@ -258,8 +273,8 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
         (prep.st0.gpu_free, prep.st0.vg_free, prep.st0.dev_free)
     )
 
-    def _padT(mat):  # [N, K] -> [K_pad, N]
-        mat = np.asarray(mat)
+    def _padT(mat):  # [N_orig, K] -> [K_pad, N]
+        mat = _padN(np.asarray(mat), axis=0)
         Kp = _pad8_static(mat.shape[1])
         out_m = np.zeros((Kp, mat.shape[0]), np.float32)
         out_m[: mat.shape[1]] = mat.T.astype(np.float32)
@@ -271,7 +286,7 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
     vg0_VN = _padT(vg_free0)
     dev_cap_DN = _padT(prep.meta.node_dev_cap)
     dev0_DN = _padT(dev_free0)
-    media = np.asarray(prep.meta.node_dev_media)  # [N, Dv]
+    media = _padN(np.asarray(prep.meta.node_dev_media), axis=0, fill=-1)  # [N, Dv]
     Dv_pad = dev_cap_DN.shape[0]
     dev_media_DN = np.zeros((2 * Dv_pad, N), np.float32)
     for m in range(2):
@@ -345,16 +360,16 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
         pmatch_GU[g] = matches_sel[:, p_sel[g]].astype(np.float32)
 
     fi = FastInputs(
-        alloc_T=np.ascontiguousarray(np.asarray(ec.alloc).T.astype(np.float32)),
-        used0_T=np.ascontiguousarray(np.asarray(jax.device_get(prep.st0.used)).T.astype(np.float32)),
-        static_pass=np.asarray(stat.static_pass).astype(np.float32),
-        aff_mask=np.asarray(stat.aff_mask).astype(np.float32),
-        share_raw=np.asarray(stat.share_raw).astype(np.float32),
+        alloc_T=np.ascontiguousarray(_padN(ec.alloc, axis=0).T.astype(np.float32)),
+        used0_T=np.ascontiguousarray(_padN(jax.device_get(prep.st0.used), axis=0).T.astype(np.float32)),
+        static_pass=_padN(stat.static_pass).astype(np.float32),
+        aff_mask=_padN(stat.aff_mask).astype(np.float32),
+        share_raw=_padN(stat.share_raw).astype(np.float32),
         zone_NZ=zone_NZ,
         zone_ZN=zone_ZN,
         has_zone=has_zone,
         matches_AU=matches_AU,
-        node_valid=np.asarray(ec.node_valid).astype(np.float32)[None, :],
+        node_valid=_padN(ec.node_valid, axis=0).astype(np.float32)[None, :],
         req=req_np,
         cpu_nz=cpu_nz,
         mem_nz=mem_nz,
@@ -397,10 +412,11 @@ def build_inputs(prep) -> Tuple[FastInputs, dict]:
         dev_media_DN=dev_media_DN,
         port_HU=port_HU,
         port_conf_HU=port_conf_HU,
-        na_raw=np.asarray(stat.na_raw).astype(np.float32),
-        tt_raw=np.asarray(stat.tt_raw).astype(np.float32),
+        na_raw=_padN(stat.na_raw).astype(np.float32),
+        tt_raw=_padN(stat.tt_raw).astype(np.float32),
+        avoid_raw=_padN(ec.avoid_score).astype(np.float32),
     )
-    meta = {"static_fail": static_fail_real}
+    meta = {"static_fail": static_fail_real, "n_orig": N_orig}
     # device-resident copies so repeated runs (capacity loops, sweeps) skip
     # the host→device transfer of ~25 arrays
     fi = FastInputs(*[jax.numpy.asarray(a) for a in fi])
@@ -438,11 +454,16 @@ def sweep(
     prep, node_valid_masks, pod_valid_masks, forced_masks,
     interpret: Optional[bool] = None, big_u: Optional[bool] = None,
 ):
-    """Scenario sweep on the megakernel: one dispatch per scenario, queued
-    asynchronously on the device. Returns (unscheduled [S], used [S, N, R],
-    chosen [S, P], vg_used [S]) matching parallel.scenarios.SweepResult.
-    `big_u=None` defers to the use_big_u heuristic (tests override it to
-    exercise the HBM-DMA path on small shapes)."""
+    """Scenario sweep on the megakernel: ALL scenarios in ONE batched
+    dispatch — ``jax.vmap`` over the per-scenario inputs (node validity,
+    spread weights, pod masks) prepends a scenario axis to the kernel grid,
+    so S scans run back-to-back in a single Pallas program with no
+    per-scenario dispatch overhead (the shared template/state tables are
+    not duplicated: unbatched operands keep their block mappings). Returns
+    (unscheduled [S], used [S, N, R], chosen [S, P], vg_used [S]) matching
+    parallel.scenarios.SweepResult. `big_u=None` defers to the use_big_u
+    heuristic (tests override it to exercise the HBM-DMA path on small
+    shapes)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     fi, meta = build_inputs(prep)
@@ -454,50 +475,52 @@ def sweep(
     tmpl = np.asarray(prep.tmpl_ids)
     if pad:
         tmpl = np.concatenate([tmpl, np.zeros(pad, tmpl.dtype)])
-    has_interpod = bool(prep.features.interpod or prep.features.prefg)
-    has_gpu = bool(prep.features.gpu)
-    has_local = bool(prep.features.local)
     ctx = _SweepContext(prep)
     vg0 = np.asarray(fi.vg0_VN)
+    N_orig = meta["n_orig"]
+    N_pad = int(fi.node_valid.shape[1])
 
-    pending = []
-    for s in range(S):
-        nv = np.asarray(node_valid_masks[s], dtype=bool)
-        pv = np.asarray(pod_valid_masks[s], dtype=bool)
-        fm = np.asarray(forced_masks[s], dtype=bool)
-        if pad:
-            pv = np.concatenate([pv, np.zeros(pad, bool)])
-            fm = np.concatenate([fm, np.zeros(pad, bool)])
-        fi_s = fi._replace(
-            node_valid=nv.astype(np.float32)[None, :],
-            spr_weight=ctx.spread_weights(nv),
-        )
-        pending.append(
-            run_fast_scan(
-                fi_s, tmpl, pv, fm,
-                has_interpod=has_interpod, has_gpu=has_gpu, has_local=has_local,
-                has_ports=bool(prep.features.ports),
-                has_na=bool(prep.features.pref_node_affinity),
-                has_tt=bool(prep.features.prefer_taints),
-                interpret=interpret,
-                big_u=big_u,
-            )
+    nv_all = np.zeros((S, N_pad), bool)
+    nv_all[:, :N_orig] = np.asarray(node_valid_masks, dtype=bool)
+    pv_all = np.zeros((S, P + pad), bool)
+    pv_all[:, :P] = np.asarray(pod_valid_masks, dtype=bool)
+    fm_all = np.zeros((S, P + pad), bool)
+    fm_all[:, :P] = np.asarray(forced_masks, dtype=bool)
+    sw_all = np.stack(
+        [ctx.spread_weights(nv_all[s, :N_orig]) for s in range(S)]
+    )
+
+    def one(nv_row, sw, pv, fm):
+        return run_fast_scan(
+            fi._replace(node_valid=nv_row, spr_weight=sw), tmpl, pv, fm,
+            has_interpod=bool(prep.features.interpod or prep.features.prefg),
+            has_gpu=bool(prep.features.gpu),
+            has_local=bool(prep.features.local),
+            has_ports=bool(prep.features.ports),
+            has_na=bool(prep.features.pref_node_affinity),
+            has_tt=bool(prep.features.prefer_taints),
+            has_avoid=bool(prep.features.prefer_avoid),
+            interpret=interpret,
+            big_u=big_u,
         )
 
-    unscheduled = np.zeros((S,), np.int32)
-    used = []
-    chosen_all = []
-    vg_used = np.zeros((S,), np.float32)
-    for s, (chosen, used_T, _gt, _gf, vg_T, _dev) in enumerate(pending):
-        c = np.asarray(chosen)[:P]
-        chosen_all.append(c)
-        pv = np.asarray(pod_valid_masks[s], dtype=bool)
-        unscheduled[s] = int(((c < 0) & pv).sum())
-        used.append(np.asarray(used_T).T)
-        # per the XLA sweep, VG usage counts only scenario-valid nodes
-        nv = np.asarray(node_valid_masks[s], dtype=bool)
-        vg_used[s] = float(((vg0 - np.asarray(vg_T)) * nv[None, :]).sum())
-    return unscheduled, np.stack(used), np.stack(chosen_all), vg_used
+    import jax.numpy as jnp
+
+    chosen_b, used_b, _gt, _gf, vg_b, _dev = jax.vmap(one)(
+        jnp.asarray(nv_all.astype(np.float32)[:, None, :]),
+        jnp.asarray(sw_all),
+        jnp.asarray(pv_all),
+        jnp.asarray(fm_all),
+    )
+
+    chosen_all = np.asarray(chosen_b)[:, :P]
+    unscheduled = ((chosen_all < 0) & pv_all[:, :P]).sum(axis=1).astype(np.int32)
+    used = np.asarray(used_b).transpose(0, 2, 1)[:, :N_orig]
+    # per the XLA sweep, VG usage counts only scenario-valid nodes
+    vg_used = ((vg0[None] - np.asarray(vg_b)) * nv_all[:, None, :]).sum(
+        axis=(1, 2)
+    ).astype(np.float32)
+    return unscheduled, used, chosen_all, vg_used
 
 
 def schedule(
@@ -531,18 +554,20 @@ def schedule(
         has_ports=bool(prep.features.ports),
         has_na=bool(prep.features.pref_node_affinity),
         has_tt=bool(prep.features.prefer_taints),
+        has_avoid=bool(prep.features.prefer_avoid),
         interpret=interpret,
         big_u=big_u,
     )
     Gd = int(prep.st0.gpu_free.shape[1])
     Vg = int(prep.st0.vg_free.shape[1])
     Dv = int(prep.st0.dev_free.shape[1])
+    No = meta["n_orig"]  # lane padding added in build_inputs is trimmed here
     return (
         np.asarray(chosen)[:P],
-        np.asarray(used_T).T,
+        np.asarray(used_T).T[:No],
         meta["static_fail"],
         np.asarray(gpu_take)[:P, :Gd],
-        np.asarray(gpu_T)[:Gd].T,
-        np.asarray(vg_T)[:Vg].T,
-        np.asarray(dev_T)[:Dv].T,
+        np.asarray(gpu_T)[:Gd].T[:No],
+        np.asarray(vg_T)[:Vg].T[:No],
+        np.asarray(dev_T)[:Dv].T[:No],
     )

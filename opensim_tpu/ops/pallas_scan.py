@@ -10,9 +10,9 @@ VMEM-bandwidth, not kernel launches.
 
 Scope: every scheduler feature — resource fit, topology spread, inter-pod
 affinity, GPU-share devices, open-local storage, host ports, preferred node
-affinity and PreferNoSchedule scoring — bounded by table-size caps and at
-most three topology keys (hostname + two zone-like keys, stacked per-key
-count blocks); `engine/fastpath.py`
+affinity, PreferNoSchedule and NodePreferAvoidPods scoring — bounded by
+table-size caps and at most five topology keys (hostname + four zone-like
+keys, stacked per-key count blocks); `engine/fastpath.py`
 gates applicability and guarantees identical placements to the XLA scan
 (tests + randomized differential fuzzing assert equality). Past 512
 templates the kernel switches to big-U mode: the [U, N]/[X, U] template
@@ -116,6 +116,7 @@ class FastInputs(NamedTuple):
     # static score tables (inert when the matching feature flag is off)
     na_raw: np.ndarray  # [U, N] f32 preferred-node-affinity weights
     tt_raw: np.ndarray  # [U, N] f32 intolerable PreferNoSchedule counts
+    avoid_raw: np.ndarray  # [U, N] f32 NodePreferAvoidPods raw score (0 or 100)
 
 
 def _input_layout(
@@ -125,6 +126,7 @@ def _input_layout(
     has_ports: bool,
     has_na: bool,
     has_tt: bool,
+    has_avoid: bool,
     big_u: bool,
 ):
     """Ordered (name, kind) list of kernel inputs for one feature-flag
@@ -172,6 +174,8 @@ def _input_layout(
         L += [("na_raw", ut)]
     if has_tt:
         L += [("tt_raw", ut)]
+    if has_avoid:
+        L += [("avoid_raw", ut)]
     return L
 
 
@@ -195,6 +199,7 @@ def _make_kernel(
     has_ports: bool,
     has_na: bool,
     has_tt: bool,
+    has_avoid: bool,
     n_anti: int,
     n_pref: int,
     n_gpu: int,
@@ -204,7 +209,7 @@ def _make_kernel(
     big_u: bool = False,
     n_zkeys: int = 1,
 ):
-    layout = _input_layout(has_interpod, has_gpu, has_local, has_ports, has_na, has_tt, big_u)
+    layout = _input_layout(has_interpod, has_gpu, has_local, has_ports, has_na, has_tt, has_avoid, big_u)
     in_names = [n for n, _ in layout]
     out_names = ["chosen", "used_out"]
     if has_gpu:
@@ -253,6 +258,8 @@ def _make_kernel(
             na_ref = Rd["na_raw"]
         if has_tt:
             tt_ref = Rd["tt_raw"]
+        if has_avoid:
+            av_ref = Rd["avoid_raw"]
         alloc_ref, used0_ref = Rd["alloc_T"], Rd["used0_T"]
         static_ref, affm_ref, shraw_ref = (
             Rd["static_pass"], Rd["aff_mask"], Rd["share_raw"])
@@ -364,6 +371,7 @@ def _make_kernel(
                 s_match = _dma(matches_ref, True)
                 s_na = _dma(na_ref, False) if has_na else None
                 s_tt = _dma(tt_ref, False) if has_tt else None
+                s_av = _dma(av_ref, False) if has_avoid else None
                 if has_ports:
                     s_port = _dma(port_hu_ref, True)
                     s_portc = _dma(port_conf_hu_ref, True)
@@ -620,6 +628,11 @@ def _make_kernel(
                 score = score + jnp.where(
                     tt_max > 0, MAX_SCORE - tt_row * MAX_SCORE / jnp.maximum(tt_max, 1.0), MAX_SCORE
                 )
+            if has_avoid:
+                # NodePreferAvoidPods (w=10000, no NormalizeScore): raw
+                # 0/100 static table, same shape class as na_raw
+                av_row = s_av[:] if big_u else av_ref[pl.ds(u, 1), :]
+                score = score + 10000.0 * av_row
             if has_local:
                 # Open-Local binpack score (local_score in kernels.py):
                 # mean over units of used/capacity × 10, min-max normalized
@@ -832,6 +845,7 @@ def run_fast_scan(
     has_ports: bool = False,
     has_na: bool = False,
     has_tt: bool = False,
+    has_avoid: bool = False,
     interpret: bool = False,
     big_u: bool = False,
 ):
@@ -882,7 +896,7 @@ def run_fast_scan(
                "an_active", "an_key", "an_sel",
                "pt_active", "pt_key", "pt_sel", "pt_w",
                "dev_req", "dev_need", "dev_sizes"}
-    layout = _input_layout(has_interpod, has_gpu, has_local, has_ports, has_na, has_tt, big_u)
+    layout = _input_layout(has_interpod, has_gpu, has_local, has_ports, has_na, has_tt, has_avoid, big_u)
     in_specs, args = [], []
     for name, kind in layout:
         if kind == "stream":
@@ -945,6 +959,8 @@ def run_fast_scan(
             u_scratch.append(pltpu.VMEM((1, N), jnp.float32))
         if has_tt:
             u_scratch.append(pltpu.VMEM((1, N), jnp.float32))
+        if has_avoid:
+            u_scratch.append(pltpu.VMEM((1, N), jnp.float32))
         if has_ports:
             u_scratch += [pltpu.VMEM((Hp, 128), jnp.float32)] * 2
         if has_interpod:
@@ -959,7 +975,7 @@ def run_fast_scan(
 
     out = pl.pallas_call(
         _make_kernel(
-            has_interpod, has_gpu, has_local, has_ports, has_na, has_tt,
+            has_interpod, has_gpu, has_local, has_ports, has_na, has_tt, has_avoid,
             G, Gp, Gd, Vg, Dv, fi.dev_sizes.shape[1] // 2, big_u, K,
         ),
         grid=grid,

@@ -67,10 +67,10 @@ def sweep_auto(
     forced_masks: Optional[np.ndarray] = None,
     config=None,
 ) -> SweepResult:
-    """Route a scenario sweep: on a single device, dispatch the Pallas
-    megakernel once per scenario (queued asynchronously — each scan runs at
-    the kernel's step rate); on a multi-device mesh, shard the vmapped XLA
-    scan across devices instead."""
+    """Route a scenario sweep: on a single device, run ALL scenarios in one
+    batched Pallas dispatch (vmap prepends a scenario axis to the kernel
+    grid — no per-scenario dispatch overhead); on a multi-device mesh,
+    shard the vmapped XLA scan across devices instead."""
     S = node_valid_masks.shape[0]
     if forced_masks is None:
         forced_masks = np.broadcast_to(prep.forced, (S, len(prep.forced)))

@@ -114,18 +114,29 @@ def test_fastpath_rejects_unsupported():
         node_pad=128,
     )
     assert fastpath.applicable(prep2)
+    # up to four non-hostname keys are in scope; a fifth is not
     prep2b = prepare(
         cluster,
         [AppResource("a", spread_app([
-            "topology.kubernetes.io/zone", "topology.kubernetes.io/region", "topology.rack",
+            "topology.kubernetes.io/zone", "topology.kubernetes.io/region",
+            "topology.rack", "topology.row",
         ]))],
         node_pad=128,
     )
-    assert not fastpath.applicable(prep2b)
+    assert fastpath.applicable(prep2b)
+    prep2c = prepare(
+        cluster,
+        [AppResource("a", spread_app([
+            "topology.kubernetes.io/zone", "topology.kubernetes.io/region",
+            "topology.rack", "topology.row", "topology.cell",
+        ]))],
+        node_pad=128,
+    )
+    assert not fastpath.applicable(prep2c)
 
-    # non-128-multiple node padding stays on the XLA path
+    # non-128-multiple node padding is padded at marshalling time, not rejected
     prep3 = prepare(cluster, [AppResource("a", app)], node_pad=8)
-    assert not fastpath.applicable(prep3)
+    assert fastpath.applicable(prep3)
 
 
 def test_fastpath_matches_xla_gpu():
@@ -581,3 +592,123 @@ def test_fastpath_forced_pods():
     )
     np.testing.assert_array_equal(got_chosen, want_chosen)
     np.testing.assert_allclose(got_used, want_used, rtol=1e-5)
+
+
+def test_fastpath_matches_xla_prefer_avoid():
+    """NodePreferAvoidPods (w=10000 raw 0/100 table) through the megakernel
+    must match the XLA scan — including the avoided node winning when it is
+    the only feasible one."""
+    import json
+
+    cluster = ResourceTypes()
+    avoid = json.dumps(
+        {"preferAvoidPods": [
+            {"podSignature": {"podController": {"kind": "ReplicaSet", "uid": "rs-avoid"}}}
+        ]}
+    )
+    for i in range(6):
+        opts = [fx.with_labels({"disk": "ssd" if i < 4 else "hdd"})]
+        if i < 4:  # the four best-fit nodes all carry the avoid annotation
+            opts.append(
+                fx.with_annotations({"scheduler.alpha.kubernetes.io/preferAvoidPods": avoid})
+            )
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi", "110", *opts))
+    app = ResourceTypes()
+    for k in range(12):
+        p = fx.make_fake_pod(f"av-{k}", "1", "1Gi")
+        from opensim_tpu.models.objects import OwnerReference
+
+        p.metadata.owner_references = [
+            OwnerReference(kind="ReplicaSet", name="rs-avoid", uid="rs-avoid", controller=True)
+        ]
+        app.pods.append(p)
+    for k in range(4):
+        app.pods.append(fx.make_fake_pod(f"plain-{k}", "1", "1Gi"))
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
+    assert prep.features.prefer_avoid, "fixture must trigger the avoid table"
+    assert fastpath.applicable(prep)
+    want_chosen, want_used = _xla_chosen(prep)
+    P = len(prep.ordered)
+    got_chosen, got_used, *_ = fastpath.schedule(
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=_INTERPRET
+    )
+    np.testing.assert_array_equal(got_chosen, want_chosen)
+    np.testing.assert_allclose(got_used, want_used, rtol=1e-6)
+
+
+def test_fastpath_matches_xla_unpadded_nodes():
+    """node_pad=8 encodings (N not a multiple of 128) are lane-padded at
+    marshalling time; placements and final state must still match the XLA
+    scan bit-for-bit."""
+    cluster = ResourceTypes()
+    for i in range(21):  # pads to 24 under node_pad=8
+        labels = {"topology.kubernetes.io/zone": f"z{i % 3}"} if i % 5 else {}
+        cluster.nodes.append(
+            fx.make_fake_node(f"n{i:03d}", "16", "32Gi", "110", fx.with_labels(labels))
+        )
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("plain", 48, "500m", "1Gi"))
+    app.deployments.append(
+        fx.make_fake_deployment(
+            "spread", 24, "250m", "512Mi",
+            fx.with_topology_spread(
+                [{"maxSkew": 2, "topologyKey": "topology.kubernetes.io/zone",
+                  "whenUnsatisfiable": "DoNotSchedule",
+                  "labelSelector": {"matchLabels": {"app": "spread"}}}]
+            ),
+        )
+    )
+    app.deployments.append(fx.make_fake_deployment("fat", 6, "9", "20Gi"))
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=8)
+    assert int(prep.ec_np.node_valid.shape[0]) % 128 != 0
+    assert fastpath.applicable(prep)
+    want_chosen, want_used = _xla_chosen(prep)
+    P = len(prep.ordered)
+    got_chosen, got_used, *_ = fastpath.schedule(
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=_INTERPRET
+    )
+    np.testing.assert_array_equal(got_chosen, want_chosen)
+    np.testing.assert_allclose(got_used, want_used, rtol=1e-6)
+
+
+def test_fastpath_matches_xla_four_zone_keys():
+    """Four non-hostname topology keys (the new cap) must match the XLA
+    scan, mixing hard and soft constraints across keys."""
+    keys = ["topology.kubernetes.io/zone", "topology.kubernetes.io/region",
+            "topology.rack", "topology.row"]
+    cluster = ResourceTypes()
+    for i in range(16):
+        labels = {
+            keys[0]: f"z{i % 3}", keys[1]: f"r{i % 2}",
+            keys[2]: f"k{i % 4}", keys[3]: f"w{i % 5}",
+        }
+        if i % 7 == 6:
+            labels.pop(keys[2])  # some nodes lack a key
+        cluster.nodes.append(
+            fx.make_fake_node(f"n{i:03d}", "16", "32Gi", "110", fx.with_labels(labels))
+        )
+    app = ResourceTypes()
+    constraints = [
+        {"maxSkew": 2, "topologyKey": keys[0], "whenUnsatisfiable": "DoNotSchedule",
+         "labelSelector": {"matchLabels": {"app": "multi"}}},
+        {"maxSkew": 1, "topologyKey": keys[1], "whenUnsatisfiable": "ScheduleAnyway",
+         "labelSelector": {"matchLabels": {"app": "multi"}}},
+        {"maxSkew": 3, "topologyKey": keys[2], "whenUnsatisfiable": "ScheduleAnyway",
+         "labelSelector": {"matchLabels": {"app": "multi"}}},
+        {"maxSkew": 2, "topologyKey": keys[3], "whenUnsatisfiable": "DoNotSchedule",
+         "labelSelector": {"matchLabels": {"app": "multi"}}},
+    ]
+    app.deployments.append(
+        fx.make_fake_deployment("multi", 40, "500m", "1Gi",
+                                fx.with_topology_spread(constraints))
+    )
+    app.deployments.append(fx.make_fake_deployment("plain", 24, "250m", "512Mi"))
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=128)
+    assert fastpath.applicable(prep)
+    want_chosen, want_used = _xla_chosen(prep)
+    P = len(prep.ordered)
+    got_chosen, got_used, *_ = fastpath.schedule(
+        prep, prep.tmpl_ids, np.ones(P, bool), prep.forced, interpret=_INTERPRET
+    )
+    np.testing.assert_array_equal(got_chosen, want_chosen)
+    np.testing.assert_allclose(got_used, want_used, rtol=1e-6)

@@ -22,6 +22,8 @@ def _enable_interpret_fastpath(monkeypatch):
 
 
 def random_cluster(rng: random.Random, n_nodes: int) -> ResourceTypes:
+    import json
+
     rt = ResourceTypes()
     for i in range(n_nodes):
         opts = []
@@ -30,9 +32,29 @@ def random_cluster(rng: random.Random, n_nodes: int) -> ResourceTypes:
             labels["topology.kubernetes.io/zone"] = f"z{rng.randrange(3)}"
         if rng.random() < 0.5:
             labels["topology.kubernetes.io/region"] = f"r{rng.randrange(2)}"
+        if rng.random() < 0.25:
+            labels["topology.rack"] = f"k{rng.randrange(4)}"
+        if rng.random() < 0.15:
+            labels["topology.row"] = f"w{rng.randrange(2)}"
         if rng.random() < 0.5:
             labels["disk"] = rng.choice(["ssd", "hdd"])
         opts.append(fx.with_labels(labels))
+        if rng.random() < 0.15:
+            # NodePreferAvoidPods: repel one of the fuzz RS controllers
+            opts.append(
+                fx.with_annotations(
+                    {
+                        "scheduler.alpha.kubernetes.io/preferAvoidPods": json.dumps(
+                            {"preferAvoidPods": [
+                                {"podSignature": {"podController": {
+                                    "kind": "ReplicaSet",
+                                    "uid": f"rs-fuzz-{rng.randrange(3)}",
+                                }}}
+                            ]}
+                        )
+                    }
+                )
+            )
         if rng.random() < 0.25:
             effect = rng.choice(["NoSchedule", "PreferNoSchedule"])
             opts.append(fx.with_taints([{"key": "dedicated", "value": "x", "effect": effect}]))
@@ -81,7 +103,8 @@ def random_app(rng: random.Random, n_workloads: int) -> ResourceTypes:
                             "maxSkew": rng.choice([1, 2, 5]),
                             "topologyKey": rng.choice(
                                 ["kubernetes.io/hostname", "topology.kubernetes.io/zone",
-                                 "topology.kubernetes.io/region"]
+                                 "topology.kubernetes.io/region", "topology.rack",
+                                 "topology.row"]
                             ),
                             "whenUnsatisfiable": rng.choice(["DoNotSchedule", "ScheduleAnyway"]),
                             "labelSelector": {"matchLabels": {"app": f"w{w}"}},
@@ -96,7 +119,7 @@ def random_app(rng: random.Random, n_workloads: int) -> ResourceTypes:
                 "labelSelector": {"matchLabels": {"app": f"w{max(w - 1, 0)}"}},
                 "topologyKey": rng.choice(
                     ["kubernetes.io/hostname", "topology.kubernetes.io/zone",
-                     "topology.kubernetes.io/region"]
+                     "topology.kubernetes.io/region", "topology.rack"]
                 ),
             }
             if rng.random() < 0.3:
@@ -156,6 +179,18 @@ def random_app(rng: random.Random, n_workloads: int) -> ResourceTypes:
         rt.stateful_sets.append(sts)
     if rng.random() < 0.3:
         rt.pods.append(fx.make_fake_pod("pinned", "100m", "128Mi", fx.with_node_name("n000")))
+    if rng.random() < 0.3:
+        # bare pods owned by the RS controllers the avoid annotations name
+        from opensim_tpu.models.objects import OwnerReference
+
+        rs = rng.randrange(3)
+        for k in range(rng.randrange(1, 5)):
+            p = fx.make_fake_pod(f"avoided-{rs}-{k}", "200m", "256Mi")
+            p.metadata.owner_references = [
+                OwnerReference(kind="ReplicaSet", name=f"rs-fuzz-{rs}",
+                               uid=f"rs-fuzz-{rs}", controller=True)
+            ]
+            rt.pods.append(p)
     return rt
 
 
@@ -174,7 +209,8 @@ def test_fuzz_fastpath_vs_xla(seed):
     rng = random.Random(seed)
     cluster = random_cluster(rng, rng.randrange(8, 20))
     app = random_app(rng, rng.randrange(3, 8))
-    prep = prepare(cluster, [AppResource("fuzz", app)], node_pad=128)
+    # node_pad=8 leaves N off the 128-lane grid; build_inputs pads it
+    prep = prepare(cluster, [AppResource("fuzz", app)], node_pad=rng.choice([8, 128]))
     if prep is None or not fastpath.applicable(prep):
         pytest.skip("generated workload outside fast-path bounds")
     P = len(prep.ordered)

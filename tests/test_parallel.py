@@ -92,3 +92,39 @@ def test_fastpath_sweep_matches_xla_sweep(monkeypatch):
     np.testing.assert_array_equal(got_chosen, np.asarray(want.chosen)[:, :P])
     np.testing.assert_allclose(got_used, np.asarray(want.used), rtol=1e-5)
     np.testing.assert_allclose(got_vg, np.asarray(want.vg_used), rtol=1e-5)
+
+
+def test_fastpath_sweep_large_batch(monkeypatch):
+    """A larger scenario batch (S=40) through the single-dispatch vmapped
+    megakernel still matches the XLA sweep — guards the batched-grid path
+    (scratch reinit per scenario, unbatched table sharing)."""
+    monkeypatch.setenv("OPENSIM_FASTPATH", "interpret")
+    from opensim_tpu.engine import fastpath
+
+    cluster, apps = _setup(n_nodes=8, replicas=24)
+    prep = prepare(cluster, apps, node_pad=128)
+    assert fastpath.applicable(prep)
+    N = prep.ec.node_valid.shape[0]
+    P = len(prep.ordered)
+    S = 40
+    rng = np.random.RandomState(7)
+    node_valid = np.zeros((S, N), dtype=bool)
+    base = np.asarray(prep.ec.node_valid)
+    for s in range(S):
+        node_valid[s] = base
+        # drain a random real node per scenario
+        node_valid[s, rng.randint(0, 8)] = False
+    pod_valid = np.ones((S, P), dtype=bool)
+    forced = np.broadcast_to(prep.forced, (S, P)).copy()
+
+    want = scenarios.sweep(
+        prep.ec, prep.st0, prep.tmpl_ids, prep.forced, node_valid, pod_valid,
+        features=prep.features,
+    )
+    got_unsched, got_used, got_chosen, got_vg = fastpath.sweep(
+        prep, node_valid, pod_valid, forced, interpret=True
+    )
+    np.testing.assert_array_equal(got_unsched, np.asarray(want.unscheduled))
+    np.testing.assert_array_equal(got_chosen, np.asarray(want.chosen)[:, :P])
+    np.testing.assert_allclose(got_used, np.asarray(want.used), rtol=1e-5)
+    np.testing.assert_allclose(got_vg, np.asarray(want.vg_used), rtol=1e-5)

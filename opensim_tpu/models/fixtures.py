@@ -91,7 +91,9 @@ def with_tolerations(tolerations: List[dict]) -> Option:
 
 def with_affinity(affinity: dict) -> Option:
     def apply(d: dict) -> None:
-        _pod_spec(d)["affinity"] = affinity
+        # merge at the top level so nodeAffinity and podAffinity options
+        # compose instead of the last call replacing the whole dict
+        _pod_spec(d).setdefault("affinity", {}).update(affinity)
 
     return apply
 

@@ -218,6 +218,17 @@ def random_cluster(rng, n):
         if rng.random() < 0.25:
             opts.append(fx.with_taints([{"key": "dedicated", "value": "x",
                                          "effect": rng.choice(["NoSchedule", "PreferNoSchedule"])}]))
+        if rng.random() < 0.15:
+            # NodePreferAvoidPods annotation naming one of the bare-pod RS
+            # controllers the app generator can emit
+            import json as _json
+
+            opts.append(fx.with_annotations({
+                "scheduler.alpha.kubernetes.io/preferAvoidPods": _json.dumps(
+                    {"preferAvoidPods": [{"podSignature": {"podController": {
+                        "kind": "ReplicaSet", "uid": f"rs-oracle-{rng.randrange(2)}"}}}]}
+                )
+            }))
         rt.nodes.append(fx.make_fake_node(f"n{i:03d}", str(rng.choice([4, 8])), "16Gi", "20", *opts))
     return rt
 
@@ -241,7 +252,8 @@ def random_app(rng, n_workloads):
             }]))
         if rng.random() < 0.35:
             kind = rng.choice(["podAffinity", "podAntiAffinity"])
-            n_terms = rng.randrange(1, 3) if kind == "podAffinity" else 1
+            mode = "preferred" if rng.random() < 0.4 else "required"
+            n_terms = rng.randrange(1, 3) if (kind == "podAffinity" and mode == "required") else 1
             terms = []
             for _ in range(n_terms):
                 term = {
@@ -252,8 +264,23 @@ def random_app(rng, n_workloads):
                 if rng.random() < 0.4:  # explicit multi-namespace scoping
                     term["namespaces"] = rng.sample(["ns-a", "ns-b", "default"], rng.randrange(1, 3))
                 terms.append(term)
-            opts.append(fx.with_affinity(
-                {kind: {"requiredDuringSchedulingIgnoredDuringExecution": terms}}))
+            if mode == "required":
+                aff = {kind: {"requiredDuringSchedulingIgnoredDuringExecution": terms}}
+            else:
+                aff = {kind: {"preferredDuringSchedulingIgnoredDuringExecution": [
+                    {"weight": rng.choice([10, 50, 100]), "podAffinityTerm": t} for t in terms
+                ]}}
+            opts.append(fx.with_affinity(aff))
+        if rng.random() < 0.25:
+            # preferred node affinity (NodeAffinity score plugin)
+            opts.append(fx.with_affinity({
+                "nodeAffinity": {"preferredDuringSchedulingIgnoredDuringExecution": [
+                    {"weight": rng.choice([5, 20, 100]),
+                     "preference": {"matchExpressions": [
+                         {"key": "disk", "operator": "In",
+                          "values": [rng.choice(["ssd", "hdd"])]}]}}
+                ]}
+            }))
         if rng.random() < 0.25:
             opts.append(fx.with_host_ports([rng.choice([8080, 9090])]))
         if rng.random() < 0.5:
@@ -261,6 +288,18 @@ def random_app(rng, n_workloads):
         rt.deployments.append(fx.make_fake_deployment(
             f"w{w}", rng.randrange(2, 7),
             f"{rng.choice([250, 500, 1000, 2000])}m", f"{rng.choice([256, 512, 2048])}Mi", *opts))
+    if rng.random() < 0.3:
+        # bare pods owned by the RS controllers the avoid annotations name
+        from opensim_tpu.models.objects import OwnerReference
+
+        rs = rng.randrange(2)
+        for k in range(rng.randrange(1, 4)):
+            p = fx.make_fake_pod(f"avoided-{rs}-{k}", "250m", "256Mi")
+            p.metadata.owner_references = [
+                OwnerReference(kind="ReplicaSet", name=f"rs-oracle-{rs}",
+                               uid=f"rs-oracle-{rs}", controller=True)
+            ]
+            rt.pods.append(p)
     return rt
 
 
@@ -296,3 +335,315 @@ def test_engine_matches_k8s_oracle(seed):
                 f"seed={seed}: engine left {pod.metadata.name} unscheduled but the oracle "
                 f"finds feasible nodes {feasible_nodes}"
             )
+
+
+# ---------------------------------------------------------------------------
+# scoring oracle — independent implementation of the default score plugins,
+# weights, and normalization (registry.go:119-132 + Simon/Open-Gpu-Share at
+# weight 1 each, pkg/simulator/utils.go:321-368; per-plugin normalization
+# over the filtered-node list, framework.go:635). Works on Pod/Node objects
+# and the oracle's bound list, never on the tensor encodings.
+# ---------------------------------------------------------------------------
+
+import math
+
+NONZERO_CPU = 0.1  # GetNonzeroRequests defaults: 100m
+NONZERO_MEM = 200.0 * 1024 * 1024  # 200MB
+
+
+def _nonzero(pod: Pod):
+    req = pod.resource_requests()
+    return (req.get("cpu") or NONZERO_CPU, req.get("memory") or NONZERO_MEM)
+
+
+class ScoreOracle:
+    """Given the filter oracle's bound state, computes each feasible node's
+    total weighted score for the incoming pod. Float arithmetic: the engine
+    scores in f32 while kube rounds to int64 at each step — the assertion's
+    epsilon absorbs both (documented divergence, like the lowest-index
+    tie-break)."""
+
+    W_BALANCED = 1.0
+    W_LEAST = 1.0
+    W_NODE_AFFINITY = 1.0
+    W_TAINT = 1.0
+    W_INTERPOD = 1.0
+    W_SPREAD = 2.0
+    W_SHARE = 2.0  # Simon (1) + Open-Gpu-Share (1): same formula, same norm
+    W_AVOID = 10000.0
+
+    def __init__(self, oracle: Oracle):
+        self.o = oracle
+
+    def totals(self, pod: Pod, feasible, owner_selector=None):
+        """node name → total score over the feasible node list.
+        `owner_selector` feeds the system-default spread constraints (the
+        k8s 1.21 DefaultPodTopologySpread scoring defaults applied when the
+        pod carries none of its own)."""
+        out = {n.metadata.name: 0.0 for n in feasible}
+        self._least_balanced(pod, feasible, out)
+        self._node_affinity(pod, feasible, out)
+        self._taints(pod, feasible, out)
+        self._interpod(pod, feasible, out)
+        self._spread(pod, feasible, out, owner_selector)
+        self._share(pod, feasible, out)
+        self._avoid(pod, feasible, out)
+        return out
+
+    def _used_nonzero(self, node):
+        cpu = mem = 0.0
+        for p, n in self.o.bound:
+            if n is node:
+                c, m = _nonzero(p)
+                cpu += c
+                mem += m
+        return cpu, mem
+
+    def _least_balanced(self, pod, feasible, out):
+        # least_allocated.go:93 leastRequestedScore; balanced_allocation.go:82
+        pc, pm = _nonzero(pod)
+        for n in feasible:
+            uc, um = self._used_nonzero(n)
+            ac = n.allocatable.get("cpu", 0.0)
+            am = n.allocatable.get("memory", 0.0)
+            rc, rm = uc + pc, um + pm
+
+            def least(req, cap):
+                if cap == 0 or req > cap:
+                    return 0.0
+                return (cap - req) * 100.0 / cap
+
+            out[n.metadata.name] += self.W_LEAST * (least(rc, ac) + least(rm, am)) / 2.0
+            cf = rc / ac if ac else 0.0
+            mf = rm / am if am else 0.0
+            bal = 0.0 if (cf >= 1 or mf >= 1) else (1.0 - abs(cf - mf)) * 100.0
+            out[n.metadata.name] += self.W_BALANCED * bal
+
+    def _node_affinity(self, pod, feasible, out):
+        # node_affinity.go Score + DefaultNormalizeScore(100, reverse=false)
+        raw = {n.metadata.name: float(selectors.node_affinity_preferred_score(pod, n))
+               for n in feasible}
+        mx = max(raw.values(), default=0.0)
+        for k, v in raw.items():
+            out[k] += self.W_NODE_AFFINITY * (v * 100.0 / mx if mx > 0 else v)
+
+    def _taints(self, pod, feasible, out):
+        # taint_toleration.go CountIntolerableTaintsOfNode + reverse norm
+        raw = {n.metadata.name: float(selectors.count_intolerable_prefer_no_schedule(pod, n))
+               for n in feasible}
+        mx = max(raw.values(), default=0.0)
+        for k, v in raw.items():
+            out[k] += self.W_TAINT * (100.0 - v * 100.0 / mx if mx > 0 else 100.0)
+
+    def _interpod(self, pod, feasible, out):
+        # interpodaffinity/scoring.go: incoming preferred terms (anti
+        # negative), symmetric existing preferred terms, and existing
+        # REQUIRED affinity terms at HardPodAffinityWeight=1
+        ns = pod.metadata.namespace
+        raw = {n.metadata.name: 0.0 for n in feasible}
+
+        def domain_match(node, other_node, key):
+            v = other_node.metadata.labels.get(key)
+            return v is not None and node.metadata.labels.get(key) == v
+
+        for n in feasible:
+            s = 0.0
+            for term_w in _terms(pod, "podAffinity", "preferred"):
+                t, w = term_w.get("podAffinityTerm") or {}, float(term_w.get("weight", 0))
+                for p, pn in self.o.bound:
+                    if _match_term(t, ns, p) and domain_match(n, pn, t.get("topologyKey", "")):
+                        s += w
+            for term_w in _terms(pod, "podAntiAffinity", "preferred"):
+                t, w = term_w.get("podAffinityTerm") or {}, float(term_w.get("weight", 0))
+                for p, pn in self.o.bound:
+                    if _match_term(t, ns, p) and domain_match(n, pn, t.get("topologyKey", "")):
+                        s -= w
+            for p, pn in self.o.bound:
+                pns = p.metadata.namespace
+                for term_w in _terms(p, "podAffinity", "preferred"):
+                    t, w = term_w.get("podAffinityTerm") or {}, float(term_w.get("weight", 0))
+                    if _match_term(t, pns, pod) and domain_match(n, pn, t.get("topologyKey", "")):
+                        s += w
+                for term_w in _terms(p, "podAntiAffinity", "preferred"):
+                    t, w = term_w.get("podAffinityTerm") or {}, float(term_w.get("weight", 0))
+                    if _match_term(t, pns, pod) and domain_match(n, pn, t.get("topologyKey", "")):
+                        s -= w
+                for t in _terms(p, "podAffinity", "required"):
+                    if _match_term(t, pns, pod) and domain_match(n, pn, t.get("topologyKey", "")):
+                        s += 1.0  # HardPodAffinityWeight
+            raw[n.metadata.name] = s
+        hi = max(max(raw.values(), default=0.0), 0.0)
+        lo = min(min(raw.values(), default=0.0), 0.0)
+        rng = hi - lo
+        for k, v in raw.items():
+            out[k] += self.W_INTERPOD * (100.0 * (v - lo) / rng if rng > 0 else 0.0)
+
+    def _spread(self, pod, feasible, out, owner_selector=None):
+        # podtopologyspread/scoring.go: soft constraints only; raw =
+        # Σ count·log(size+2) + (maxSkew-1); nodes missing a key are
+        # "ignored" (score 0); normalize 100·(max+min-raw)/max. Pods with no
+        # explicit constraints get the system defaults (maxSkew 3 hostname,
+        # maxSkew 5 zone, ScheduleAnyway) with the owning workload's selector
+        ns = pod.metadata.namespace
+        explicit = pod.spec.topology_spread_constraints
+        if explicit:
+            soft = [c for c in explicit
+                    if c.get("whenUnsatisfiable", "DoNotSchedule") == "ScheduleAnyway"]
+        elif owner_selector is not None:
+            soft = [
+                {"topologyKey": HOSTNAME, "maxSkew": 3,
+                 "whenUnsatisfiable": "ScheduleAnyway", "labelSelector": owner_selector},
+                {"topologyKey": "topology.kubernetes.io/zone", "maxSkew": 5,
+                 "whenUnsatisfiable": "ScheduleAnyway", "labelSelector": owner_selector},
+            ]
+        else:
+            soft = []
+        if not soft:
+            return
+        raw, ignored = {}, set()
+        for n in feasible:
+            s = 0.0
+            for c in soft:
+                key = c.get("topologyKey", "")
+                my = n.metadata.labels.get(key)
+                if my is None:
+                    ignored.add(n.metadata.name)
+                    continue
+                sel = c.get("labelSelector")
+                cnt = sum(
+                    1 for p, pn in self.o.bound
+                    if p.metadata.namespace == ns and sel is not None
+                    and selectors.match_label_selector(sel, p.metadata.labels)
+                    and pn.metadata.labels.get(key) == my
+                )
+                size = len({x.metadata.labels.get(key) for x in self.o.nodes
+                            if x.metadata.labels.get(key) is not None})
+                s += cnt * math.log(size + 2.0) + (int(c.get("maxSkew", 1)) - 1)
+            raw[n.metadata.name] = s
+        scored = [v for k, v in raw.items() if k not in ignored]
+        mx = max(scored, default=0.0)
+        mn = min(scored, default=0.0)
+        for k, v in raw.items():
+            if k in ignored:
+                continue  # normalized score 0
+            out[k] += self.W_SPREAD * (100.0 if mx <= 0 else 100.0 * (mx + mn - v) / mx)
+
+    def _share(self, pod, feasible, out):
+        # plugin/simon.go:45-101 + algo.Share (greed.go:70-83): max over the
+        # node's declared allocatable resources of req/(alloc - req), static
+        # allocatable; no requests → MaxNodeScore; then min-max normalize
+        req = pod.resource_requests()
+        raw = {}
+        for n in feasible:
+            if not req:
+                raw[n.metadata.name] = 100.0
+                continue
+            best = 0.0
+            for r, alloc in n.allocatable.items():
+                pr = req.get(r, 0.0)
+                avail = alloc - pr
+                share = (1.0 if pr else 0.0) if avail == 0 else pr / avail
+                best = max(best, share)
+            raw[n.metadata.name] = best * 100.0
+        hi = max(raw.values(), default=0.0)
+        lo = min(raw.values(), default=0.0)
+        rng = hi - lo
+        for k, v in raw.items():
+            out[k] += self.W_SHARE * ((v - lo) * 100.0 / rng if rng > 0 else 0.0)
+
+    def _avoid(self, pod, feasible, out):
+        # node_prefer_avoid_pods.go:47-82: controller (RS/RC) listed in the
+        # node's preferAvoidPods annotation → 0, else 100; no normalization
+        import json
+
+        ctrl = None
+        for ref in pod.metadata.owner_references:
+            if ref.controller and ref.kind in ("ReplicaSet", "ReplicationController"):
+                ctrl = (ref.kind, ref.uid)
+                break
+        for n in feasible:
+            score = 100.0
+            anno = n.metadata.annotations.get("scheduler.alpha.kubernetes.io/preferAvoidPods")
+            if anno and ctrl is not None:
+                try:
+                    entries = json.loads(anno).get("preferAvoidPods") or []
+                except (ValueError, AttributeError):
+                    entries = []
+                for e in entries:
+                    pc = ((e.get("podSignature") or {}).get("podController") or {})
+                    if (str(pc.get("kind", "")), str(pc.get("uid", ""))) == ctrl:
+                        score = 0.0
+                        break
+            out[n.metadata.name] += self.W_AVOID * score
+
+
+def _replay_with_scores(prep, cluster, chosen):
+    """Replays the engine's placements through both oracles; returns the
+    number of score-suboptimal binds (engine chose a node more than EPS
+    below the oracle's best over the feasible set)."""
+    from opensim_tpu.engine.simulator import _owner_selector
+
+    oracle = Oracle(cluster.nodes)
+    scorer = ScoreOracle(oracle)
+    node_names = prep.meta.node_names
+    violations = 0
+    for i, pod in enumerate(prep.ordered):
+        c = int(chosen[i])
+        feasible = [n for n in cluster.nodes if oracle.feasible(pod, n)]
+        if c >= 0:
+            node = oracle.by_name[node_names[c]]
+            totals = scorer.totals(pod, feasible, _owner_selector(pod))
+            best = max(totals.values())
+            mine = totals[node.metadata.name]
+            spread_mag = max(abs(v) for v in totals.values()) if totals else 1.0
+            eps = max(1e-4 * spread_mag, 1e-3)  # f32-engine vs f64-oracle
+            if mine < best - eps:
+                violations += 1
+            oracle.bind(pod, node)
+    return violations
+
+
+SCORE_SEEDS = [3, 17, 29, 61, 97, 131, 151] + list(range(500, 523))  # 30 seeds
+
+
+@pytest.mark.parametrize("seed", SCORE_SEEDS)
+def test_engine_scores_match_k8s_oracle(seed):
+    """Every bind must land on a score-optimal feasible node per the
+    independent score oracle (weights, formulas, and normalization from the
+    Go sources)."""
+    rng = random.Random(seed)
+    cluster = random_cluster(rng, rng.randrange(4, 10))
+    app = random_app(rng, rng.randrange(3, 7))
+    prep = prepare(cluster, [AppResource("oracle", app)], node_pad=8)
+    if prep is None:
+        pytest.skip("empty workload")
+    P = len(prep.ordered)
+    t, v, f = pad_pod_stream(prep.tmpl_ids, np.ones(P, bool), prep.forced)
+    out = schedule_pods(prep.ec, prep.st0, t, v, f, features=prep.features)
+    chosen = np.asarray(out.chosen)[:P]
+    violations = _replay_with_scores(prep, cluster, chosen)
+    assert violations == 0
+
+
+def test_score_oracle_rejects_misweighted_engine():
+    """Sensitivity check: an engine running with deliberately wrong score
+    weights must produce binds the oracle flags as suboptimal — otherwise
+    the oracle is vacuous."""
+    from opensim_tpu.engine.schedconfig import DEFAULT_CONFIG
+
+    bad = DEFAULT_CONFIG._replace(w_least=0.0, w_balanced=0.0, w_simon=20.0)
+    caught = 0
+    for seed in SCORE_SEEDS:
+        rng = random.Random(seed)
+        cluster = random_cluster(rng, rng.randrange(4, 10))
+        app = random_app(rng, rng.randrange(3, 7))
+        prep = prepare(cluster, [AppResource("oracle", app)], node_pad=8)
+        if prep is None:
+            continue
+        P = len(prep.ordered)
+        t, v, f = pad_pod_stream(prep.tmpl_ids, np.ones(P, bool), prep.forced)
+        out = schedule_pods(
+            prep.ec, prep.st0, t, v, f, features=prep.features, config=bad
+        )
+        caught += _replay_with_scores(prep, cluster, np.asarray(out.chosen)[:P])
+    assert caught > 0, "oracle failed to flag a mis-weighted engine"

@@ -19,19 +19,28 @@ Modeled dimensions:
 - fractional GPU devices (victims free the exact per-device slots recorded
   at bind time in ``gpu_take``; the preemptor is re-packed with the same
   tightest-fit / greedy rules as ``kernels.bind_update``);
-- open-local storage for the PREEMPTOR (tightest-fit VG + smallest-fitting
-  exclusive devices) — storage-holding pods are never victims (their VG
-  allocation is not tracked per pod, so it cannot be released exactly);
+- open-local storage for BOTH sides: the preemptor is placed with
+  tightest-fit VG + smallest-fitting exclusive devices, and storage-holding
+  victims release their exact allocation — recovered by a deterministic
+  host-side replay of the bind stream through the same allocation rules
+  (the engines don't record per-pod VG/device choices; the replay is
+  verified against the final state and storage victims are disabled if it
+  diverges);
+- PodDisruptionBudgets (``default_preemption.go:642,731-775``): victim
+  selection mirrors ``selectVictimsOnNode`` — remove every lower-priority
+  pod, then reprieve PDB-violating victims first (highest priority first),
+  then non-violating ones; candidate nodes are ranked by
+  ``pickOneNodeForPreemption``'s ladder (fewest PDB violations, lowest
+  highest-victim priority, lowest summed priority, fewest victims, lowest
+  node index — the pod-start-time criterion collapses onto stream order).
+  DisruptionsAllowed is derived from spec + currently-bound matching pods
+  (the simulator has no PDB status controller); committed evictions
+  consume allowance, successful cascade re-placements restore it;
 - cascading re-placement: evicted victims are re-queued in stream order and
   re-placed on the lowest-index feasible node when capacity exists
   elsewhere, mirroring a nominated pod re-entering the scheduling queue.
 
 Remaining documented simplifications:
-- victims are selected ascending by priority until everything fits (no PDB
-  accounting — the simulator has no eviction API);
-- candidate nodes are ranked by (fewest victims, lowest summed victim
-  priority, lowest node index) — a deterministic stand-in for
-  ``pickOneNodeForPreemption``'s tie-break ladder;
 - preemptors carrying required inter-pod terms or hard spread constraints
   are skipped, as are preemptors matched by an existing pod's global
   anti-affinity term (placing one would retroactively violate the
@@ -42,7 +51,13 @@ Remaining documented simplifications:
 - force-bound (pre-existing) pods are never victims.
 
 Off by default: ``simulate(..., enable_preemption=True)`` or
-``simon apply --enable-preemption``.
+``simon apply --enable-preemption``. DECISION (r3): this stays opt-in —
+the reference's default profile registers DefaultPreemption
+(``registry.go:104``) but its driver deletes every unschedulable pod
+before a retry could use the nominated node (``simulator.go:333-342``), so
+the reference's OBSERVED default behavior is no preemption. Matching
+observed behavior by default and offering the working pass behind a flag
+is strictly more capable without diverging on any reference workload.
 """
 
 from __future__ import annotations
@@ -79,6 +94,7 @@ class _State:
         self.vg_free = vg_free
         self.dev_free = dev_free
         self.gpu_take = gpu_take
+        self.storage_of: Dict[int, Tuple[int, int, List[int]]] = {}
         self.req = np.asarray(ec.req)
         self.ports = np.asarray(ec.ports)
         self.conflict = np.asarray(ec.port_conflict)
@@ -127,25 +143,33 @@ class _State:
         cum = np.cumsum(chunks)
         return np.clip(cnt - (cum - chunks), 0.0, chunks).astype(free.dtype)
 
-    def storage_fit(self, u: int, n: int) -> Optional[Tuple[int, List[int]]]:
-        """Open-local feasibility for the preemptor (victims free nothing
-        here). Returns (vg_choice or -1, device indices) or None."""
+    def has_storage(self, u: int) -> bool:
+        return float(self.lvm_req[u]) > 0 or (self.dev_req_sizes[u] > 0).any()
+
+    def storage_fit(
+        self, u: int, n: int, vg_row=None, dev_row=None
+    ) -> Optional[Tuple[int, List[int]]]:
+        """Open-local feasibility. Returns (vg_choice or -1, device indices)
+        or None. `vg_row`/`dev_row` override the node's live state (used for
+        the remove-all / reprieve hypotheticals and the bind replay)."""
+        vg_free = self.vg_free[n] if vg_row is None else vg_row
+        dev_free = self.dev_free[n] if dev_row is None else dev_row
         lvm = float(self.lvm_req[u])
         vg_choice = -1
         if lvm > 0:
-            fits = self.vg_free[n] >= lvm
+            fits = vg_free >= lvm
             if not fits.any():
                 return None
-            vg_choice = int(np.argmin(np.where(fits, self.vg_free[n], np.float32(1e30))))
+            vg_choice = int(np.argmin(np.where(fits, vg_free, np.float32(1e30))))
         devs: List[int] = []
-        taken = np.zeros_like(self.dev_free[n], dtype=bool)
+        taken = np.zeros_like(dev_free, dtype=bool)
         for media in (0, 1):
             sizes = self.dev_req_sizes[u, media]
             for size in sorted(s for s in sizes if s > 0):  # smallest volume first
                 cand = (
                     (self.node_dev_media[n] == media)
-                    & (self.dev_free[n] >= size)
-                    & (self.dev_free[n] > 0)
+                    & (dev_free >= size)
+                    & (dev_free > 0)
                     & ~taken
                 )
                 if not cand.any():
@@ -156,7 +180,8 @@ class _State:
         return vg_choice, devs
 
     def place(self, u: int, i: int, n: int, gpu_alloc: Optional[np.ndarray]) -> None:
-        """Commit a placement: resources, ports, gpu slots, storage."""
+        """Commit a placement: resources, ports, gpu slots, storage (the
+        storage choice is recorded so a later eviction can release it)."""
         self.used[n] += self.req[u]
         if self.Hports:
             self.port_used[n] += self.port_hot(u)
@@ -170,6 +195,8 @@ class _State:
                 self.vg_free[n, vg_choice] -= float(self.lvm_req[u])
             for d in devs:
                 self.dev_free[n, d] = 0.0
+            if vg_choice >= 0 or devs:
+                self.storage_of[i] = (n, vg_choice, devs)
 
     def evict(self, u: int, j: int, n: int) -> None:
         self.used[n] -= self.req[u]
@@ -179,6 +206,101 @@ class _State:
         if mem > 0 and self.gpu_take is not None:
             self.gpu_free[n] += self.gpu_take[j] * mem
             self.gpu_take[j] = 0.0
+        rec = self.storage_of.pop(j, None)
+        if rec is not None:
+            rn, vg_choice, devs = rec
+            if vg_choice >= 0:
+                self.vg_free[rn, vg_choice] += float(self.lvm_req[u])
+            for d in devs:
+                self.dev_free[rn, d] = self.node_dev_cap[rn, d]
+
+
+def _replay_storage(st: "_State", prep, chosen, tmpl) -> bool:
+    """Recover each bound pod's VG/device allocation by replaying the bind
+    stream through the same tightest-fit rules from the initial state.
+    Populates ``st.storage_of``; returns False (and leaves it empty) when
+    the replayed final state disagrees with the engine's — storage-holding
+    victims are then disabled rather than released inexactly."""
+    vg0 = np.array(np.asarray(prep.st0.vg_free), copy=True)
+    dev0 = np.array(np.asarray(prep.st0.dev_free), copy=True)
+    rec: Dict[int, Tuple[int, int, List[int]]] = {}
+    for j in range(len(chosen)):
+        n = int(chosen[j])
+        if n < 0:
+            continue
+        u = int(tmpl[j])
+        if not st.has_storage(u):
+            continue
+        fitres = st.storage_fit(u, n, vg_row=vg0[n], dev_row=dev0[n])
+        if fitres is None:
+            return False
+        vg_choice, devs = fitres
+        if vg_choice >= 0:
+            vg0[n, vg_choice] -= float(st.lvm_req[u])
+        for d in devs:
+            dev0[n, d] = 0.0
+        rec[j] = (n, vg_choice, devs)
+    if not (np.allclose(vg0, st.vg_free, rtol=1e-5) and np.allclose(dev0, st.dev_free, rtol=1e-5)):
+        return False
+    st.storage_of.update(rec)
+    return True
+
+
+def _pdb_budgets(pdbs, ordered, chosen) -> List[dict]:
+    """Derive each PDB's DisruptionsAllowed from its spec and the bound
+    matching pods (the simulator has no disruption-status controller;
+    every bound pod counts healthy). Nil/empty selectors match nothing
+    (``filterPodsWithPDBViolation``, default_preemption.go:736-775)."""
+    import math
+
+    out = []
+    for obj in pdbs:
+        raw = getattr(obj, "raw", None) or (obj if isinstance(obj, dict) else {})
+        meta = raw.get("metadata") or {}
+        spec = raw.get("spec") or {}
+        ns = meta.get("namespace") or "default"
+        sel = spec.get("selector") or {}
+        if not sel.get("matchLabels") and not sel.get("matchExpressions"):
+            continue
+        healthy = sum(
+            1
+            for j, p in enumerate(ordered)
+            if int(chosen[j]) >= 0
+            and p.metadata.namespace == ns
+            and p.metadata.labels
+            and selectors.match_label_selector(sel, p.metadata.labels)
+        )
+
+        def _val(v, expected):
+            if isinstance(v, str) and v.strip().endswith("%"):
+                return int(math.ceil(float(v.strip()[:-1]) / 100.0 * expected))
+            return int(v)
+
+        if spec.get("minAvailable") is not None:
+            allowed = healthy - _val(spec["minAvailable"], healthy)
+        elif spec.get("maxUnavailable") is not None:
+            allowed = _val(spec["maxUnavailable"], healthy)
+        else:
+            continue
+        out.append({"ns": ns, "sel": sel, "allowed": max(int(allowed), 0)})
+    return out
+
+
+def _pdb_matches(pdb: dict, pod: Pod) -> bool:
+    return (
+        pod.metadata.namespace == pdb["ns"]
+        and bool(pod.metadata.labels)
+        and selectors.match_label_selector(pdb["sel"], pod.metadata.labels)
+    )
+
+
+# MaxInt32+1, added per victim INSIDE the summed-priority criterion — kube
+# does exactly this (default_preemption.go:500-502), deliberately making the
+# sum count-sensitive so "a node with a few pods with negative priority is
+# not picked over a node with a smaller number of pods with the same
+# negative priority". Not a bug to simplify away: removing the offset would
+# diverge from pickOneNodeForPreemption on any mixed victim-count tie.
+_PRIO_OFFSET = 2**31
 
 
 def preempt_pass(
@@ -192,6 +314,7 @@ def preempt_pass(
     vg_free: Optional[np.ndarray] = None,
     dev_free: Optional[np.ndarray] = None,
     gpu_take: Optional[np.ndarray] = None,
+    pdbs: tuple = (),
 ) -> Tuple[np.ndarray, Dict[int, int]]:
     """Attempt preemption for every unscheduled, positive-priority pod in
     stream order, then re-place evicted victims where capacity exists.
@@ -254,14 +377,26 @@ def preempt_pass(
             return True
         return False
 
+    # recover per-pod storage allocations by replay; when the replay cannot
+    # reproduce the engine's final state, storage holders stay non-victims
+    storage_replay_ok = _replay_storage(st, prep, chosen, tmpl)
+    pdb_list = _pdb_budgets(pdbs, ordered, chosen)
+    pdb_of: Dict[int, List[int]] = {}  # stream index → matching pdb indices
+    for j, p in enumerate(ordered):
+        ks = [k for k, pdb in enumerate(pdb_list) if _pdb_matches(pdb, p)]
+        if ks:
+            pdb_of[j] = ks
+    allowed = [pdb["allowed"] for pdb in pdb_list]
+
     def victim_ok(u: int) -> bool:
-        # storage holders never release exactly (per-pod VG allocation is
-        # not tracked); selector-matched pods may anchor other placements
-        if lvm_req[u] > 0 or (dev_req[u] > 0).any():
+        # selector-matched pods may anchor other placements; storage holders
+        # are only evictable when their allocation was recovered exactly
+        if not storage_replay_ok and (lvm_req[u] > 0 or (dev_req[u] > 0).any()):
             return False
         return not (sel_features and matches_sel[u].any())
 
-    def fits(u: int, n: int, free_res, freed_res, freed_ports, freed_gpu) -> bool:
+    def fits(u: int, n: int, free_res, freed_res, freed_ports, freed_gpu,
+             vg_row=None, dev_row=None) -> bool:
         # match fit_filter: only resources the preemptor actually requests
         # gate the fit (a node overcommitted by force-bound pods in some
         # resource must still admit a pod requesting none of it)
@@ -270,6 +405,8 @@ def preempt_pass(
         if not st.ports_ok(u, n, freed_ports):
             return False
         if float(gpu_mem[u]) > 0 and st.gpu_fit(u, n, freed_gpu) is None:
+            return False
+        if st.has_storage(u) and st.storage_fit(u, n, vg_row=vg_row, dev_row=dev_row) is None:
             return False
         return True
 
@@ -282,47 +419,90 @@ def preempt_pass(
         if chosen[j] >= 0 and not forced[j] and victim_ok(int(tmpl[j])):
             by_node.setdefault(int(chosen[j]), []).append(j)
 
+    def free_of(j: int, n: int, freed_res, freed_ports, freed_gpu, vg_hyp, dev_hyp, sign):
+        """Add (sign=+1) or retract (sign=-1) victim j's holdings from the
+        hypothetical freed state."""
+        ju = int(tmpl[j])
+        freed_res += sign * st.req[ju]
+        if st.Hports:
+            freed_ports += sign * st.port_hot(ju)
+        if float(gpu_mem[ju]) > 0:
+            freed_gpu += sign * gpu_take[j] * float(gpu_mem[ju])
+        rec = st.storage_of.get(j)
+        if rec is not None and rec[0] == n:
+            _, vg_choice, devs = rec
+            if vg_choice >= 0:
+                vg_hyp[vg_choice] += sign * float(lvm_req[ju])
+            for d in devs:
+                dev_hyp[d] = st.node_dev_cap[n, d] if sign > 0 else 0.0
+
     for i in range(len(ordered)):
         if chosen[i] >= 0 or forced[i] or prio[i] <= 0:
             continue
         u = int(tmpl[i])
         if constrained(u):
             continue
-        best = None  # (n_victims, sum_prio, node, victim_indices)
+        # (numPDBViolations, highest victim prio, Σ(prio+2^31), n victims,
+        # node index, victims) — pickOneNodeForPreemption's ladder; the
+        # pod-start-time criterion collapses onto stream order
+        best = None
         for n in range(n_real):
             if not _static_ok(ordered[i], nodes[n]):
                 continue
-            if st.storage_fit(u, n) is None:
-                continue  # victims free no storage — the node must fit as-is
             cand = [j for j in by_node.get(n, []) if prio[j] < prio[i]]
-            cand.sort(key=lambda j: (prio[j], j))
             free = alloc[n] - used[n]
-            taken: List[int] = []
+            # selectVictimsOnNode: remove ALL lower-priority pods first
             freed_res = np.zeros_like(free)
             freed_ports = np.zeros((st.Hports,), np.float32)
             freed_gpu = np.zeros_like(gpu_free[n])
+            vg_hyp = vg_free[n].copy()
+            dev_hyp = dev_free[n].copy()
             for j in cand:
-                if fits(u, n, free, freed_res, freed_ports, freed_gpu):
-                    break
-                ju = int(tmpl[j])
-                freed_res = freed_res + st.req[ju]
-                if st.Hports:
-                    freed_ports = freed_ports + st.port_hot(ju)
-                if float(gpu_mem[ju]) > 0:
-                    freed_gpu = freed_gpu + gpu_take[j] * float(gpu_mem[ju])
-                taken.append(j)
-            if not fits(u, n, free, freed_res, freed_ports, freed_gpu):
+                free_of(j, n, freed_res, freed_ports, freed_gpu, vg_hyp, dev_hyp, +1)
+            if not fits(u, n, free, freed_res, freed_ports, freed_gpu, vg_hyp, dev_hyp):
                 continue  # even evicting every candidate is not enough
-            key = (len(taken), int(sum(prio[j] for j in taken)), n)
-            if best is None or key < best[:3]:
-                best = (*key, taken)
+            # MoreImportantPod order: higher priority first, then stream
+            # order (our stand-in for pod start time)
+            cand_sorted = sorted(cand, key=lambda j: (-prio[j], j))
+            # split by PDB violation against a local allowance snapshot
+            local_allowed = list(allowed)
+            violating, nonviolating = [], []
+            for j in cand_sorted:
+                viol = False
+                for k in pdb_of.get(j, ()):
+                    local_allowed[k] -= 1
+                    if local_allowed[k] < 0:
+                        viol = True
+                (violating if viol else nonviolating).append(j)
+            # reprieve as many as possible: PDB-violating victims first,
+            # then non-violating, highest priority first in both groups
+            victims = set(cand)
+            for j in violating + nonviolating:
+                free_of(j, n, freed_res, freed_ports, freed_gpu, vg_hyp, dev_hyp, -1)
+                if fits(u, n, free, freed_res, freed_ports, freed_gpu, vg_hyp, dev_hyp):
+                    victims.discard(j)  # reprieved: stays bound
+                else:
+                    free_of(j, n, freed_res, freed_ports, freed_gpu, vg_hyp, dev_hyp, +1)
+            viol_set = set(violating)
+            n_viol = sum(1 for j in victims if j in viol_set)
+            key = (
+                n_viol,
+                max((int(prio[j]) for j in victims), default=-_PRIO_OFFSET),
+                sum(int(prio[j]) + _PRIO_OFFSET for j in victims),
+                len(victims),
+                n,
+            )
+            if best is None or key < best[:5]:
+                best = (*key, sorted(victims))
         if best is None:
             continue
-        _, _, n, taken = best
+        n, taken = best[4], best[5]
         for j in taken:
             victims_of[j] = i
             st.evict(int(tmpl[j]), j, n)
             chosen[j] = -1
+            for k in pdb_of.get(j, ()):
+                allowed[k] -= 1  # committed disruption consumes budget
         taken_set = set(taken)
         by_node[n] = [j for j in by_node.get(n, []) if j not in taken_set]
         gpu_alloc = st.gpu_fit(u, n, np.zeros_like(gpu_free[n]))
@@ -345,12 +525,12 @@ def preempt_pass(
             if not fits(ju, n, free, 0.0, np.zeros((st.Hports,), np.float32),
                         np.zeros_like(gpu_free[n])):
                 continue
-            if st.storage_fit(ju, n) is None:
-                continue
             gpu_alloc = st.gpu_fit(ju, n, np.zeros_like(gpu_free[n]))
             st.place(ju, j, n, gpu_alloc)
             chosen[j] = n
             del victims_of[j]
+            for k in pdb_of.get(j, ()):
+                allowed[k] += 1  # re-placed: the pod runs again, budget restored
             if victim_ok(ju):
                 by_node.setdefault(n, []).append(j)
             break

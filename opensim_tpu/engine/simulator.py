@@ -470,9 +470,12 @@ def simulate(
             "vg_free": np.array(np.asarray(fs.vg_free), copy=True),
             "dev_free": np.array(np.asarray(fs.dev_free), copy=True),
         }
+        all_pdbs = tuple(cluster.pdbs) + tuple(
+            pdb for app in apps for pdb in app.resources.pdbs
+        )
         chosen, victims_of = preemption.preempt_pass(
             prep, chosen, cluster.nodes, used, np.asarray(prep.ec_np.alloc),
-            gpu_take=gpu_take, **state,
+            gpu_take=gpu_take, pdbs=all_pdbs, **state,
         )
         out = out._replace(final_state=fs._replace(used=used, **state))
 

@@ -259,3 +259,115 @@ def test_partial_state_arguments_rejected():
     # all-none still works
     out, victims = preemption.preempt_pass(prep, chosen, cluster.nodes, used, alloc)
     assert victims == {}
+
+
+def _pdb(name, match_labels, min_available=None, max_unavailable=None, ns="default"):
+    from opensim_tpu.models.objects import ObjectMeta, RawObject
+
+    spec = {"selector": {"matchLabels": match_labels}}
+    if min_available is not None:
+        spec["minAvailable"] = min_available
+    if max_unavailable is not None:
+        spec["maxUnavailable"] = max_unavailable
+    return RawObject(
+        kind="PodDisruptionBudget",
+        metadata=ObjectMeta(name=name, namespace=ns),
+        raw={"metadata": {"name": name, "namespace": ns}, "spec": spec},
+    )
+
+
+def test_pdb_saves_victim():
+    """A PDB with no disruption allowance makes its pods last-resort
+    victims (default_preemption.go:642): with an unprotected alternative
+    victim available, the protected pod survives."""
+    cluster = _cluster(n=1, cpu="4")
+    cluster.pdbs.append(_pdb("guard", {"app": "protected"}, min_available=1))
+    app = ResourceTypes()
+    # protected (matches the PDB, minAvailable=1 of 1 -> 0 disruptions) and
+    # plain both evictable by priority; only plain should be evicted
+    app.pods.append(
+        fx.make_fake_pod("protected", "2", "1Gi", fx.with_priority(10),
+                         fx.with_labels({"app": "protected"}))
+    )
+    app.pods.append(fx.make_fake_pod("plain", "2", "1Gi", fx.with_priority(10)))
+    app.pods.append(fx.make_fake_pod("vip", "2", "1Gi", fx.with_priority(1000)))
+    res = simulate(cluster, [AppResource("a", app)], enable_preemption=True)
+    placed = {p.metadata.name for ns in res.node_status for p in ns.pods}
+    unsched = {u.pod.metadata.name for u in res.unscheduled_pods}
+    assert "vip" in placed
+    assert "protected" in placed, "PDB-covered pod must be reprieved"
+    assert unsched == {"plain"}
+
+
+def test_pdb_exhausted_budget_still_preempts_when_no_alternative():
+    """When every candidate victim violates its PDB, preemption still
+    proceeds (kube treats PDB as a preference ladder, not a hard block)."""
+    cluster = _cluster(n=1, cpu="4")
+    cluster.pdbs.append(_pdb("guard", {"app": "db"}, min_available=2))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("db-0", "2", "1Gi", fx.with_priority(10),
+                                     fx.with_labels({"app": "db"})))
+    app.pods.append(fx.make_fake_pod("db-1", "2", "1Gi", fx.with_priority(20),
+                                     fx.with_labels({"app": "db"})))
+    app.pods.append(fx.make_fake_pod("vip", "2", "1Gi", fx.with_priority(1000)))
+    res = simulate(cluster, [AppResource("a", app)], enable_preemption=True)
+    placed = {p.metadata.name for ns in res.node_status for p in ns.pods}
+    assert "vip" in placed
+    # the lowest-priority PDB victim is taken
+    assert {u.pod.metadata.name for u in res.unscheduled_pods} == {"db-0"}
+
+
+def test_pdb_ranking_prefers_node_without_violation():
+    """pickOneNodeForPreemption criterion #1: among feasible candidate
+    nodes, the one whose victims violate fewer PDBs wins even when the
+    other node's victim has lower priority."""
+    cluster = _cluster(n=2, cpu="4")
+    cluster.pdbs.append(_pdb("guard", {"app": "prot"}, min_available=1))
+    app = ResourceTypes()
+    # n0 gets the protected pod (lower priority), n1 the plain pod: the
+    # scheduler spreads them; vip must land on the plain pod's node
+    app.pods.append(fx.make_fake_pod("prot", "3", "1Gi", fx.with_priority(5),
+                                     fx.with_labels({"app": "prot"})))
+    app.pods.append(fx.make_fake_pod("plain", "3", "1Gi", fx.with_priority(50)))
+    app.pods.append(fx.make_fake_pod("vip", "3", "1Gi", fx.with_priority(1000)))
+    res = simulate(cluster, [AppResource("a", app)], enable_preemption=True)
+    unsched = {u.pod.metadata.name for u in res.unscheduled_pods}
+    placed = {p.metadata.name: ns.node.metadata.name
+              for ns in res.node_status for p in ns.pods}
+    assert "vip" in placed
+    assert "prot" in placed, "protected pod's node must not be chosen"
+    assert unsched == {"plain"}
+
+
+def test_storage_holding_victim_released_exactly():
+    """A victim holding open-local storage is evictable; its VG bytes and
+    exclusive device return to the pool and the preemptor (also a storage
+    consumer) packs into the freed capacity."""
+    cluster = ResourceTypes()
+    cluster.nodes.append(
+        fx.make_fake_node(
+            "n0", "4", "8Gi", "110",
+            fx.with_node_local_storage(
+                vgs=[{"name": "pool0", "capacity": 100 * 1024**3}],
+                devices=[{"device": "/dev/vdb", "capacity": 50 * 1024**3, "mediaType": "ssd"}],
+            ),
+        )
+    )
+    import json
+
+    def lvm(size):
+        return fx.with_pod_local_storage(json.dumps(
+            {"volumes": [{"size": str(size), "kind": "LVM", "scName": "open-local-lvm"}]}
+        ))
+
+    app = ResourceTypes()
+    # the low pod consumes 90Gi of the 100Gi VG; vip needs 80Gi
+    app.pods.append(fx.make_fake_pod("low", "1", "1Gi", fx.with_priority(5),
+                                     lvm(90 * 1024**3)))
+    app.pods.append(fx.make_fake_pod("vip", "1", "1Gi", fx.with_priority(1000),
+                                     lvm(80 * 1024**3)))
+    res = simulate(cluster, [AppResource("a", app)], enable_preemption=True)
+    placed = {p.metadata.name for ns in res.node_status for p in ns.pods}
+    unsched = {u.pod.metadata.name for u in res.unscheduled_pods}
+    assert "vip" in placed, f"vip should evict low and take its VG space (unsched={unsched})"
+    assert "low" in unsched

@@ -82,6 +82,7 @@ def _render_chart_dir(release_name: str, path: str) -> List[str]:
     if os.path.isfile(values_path):
         with open(values_path) as f:
             values = yaml.safe_load(f) or {}
+    _validate_values_schema(path, chart_meta.get("name", path), values)
     ctx = {
         "Values": values,
         "Release": {"Name": release_name, "Namespace": "default", "Service": "Helm"},
@@ -113,6 +114,58 @@ def _render_chart_dir(release_name: str, path: str) -> List[str]:
                 ) from None
             docs.extend(_split_docs(rendered))
     return docs
+
+
+def _validate_values_schema(path: str, chart_name: str, values: dict) -> None:
+    """Schema-validate the coalesced values against ``values.schema.json``
+    when the chart ships one — chartutil.ValidateAgainstSchema, invoked by
+    the installability check the reference performs (pkg/chart/chart.go:18-41
+    → action.Install's chartutil.ProcessDependencies/ValidateAgainstSchema).
+    The helm-binary path needs none of this: helm validates itself."""
+    schema_path = os.path.join(path, "values.schema.json")
+    if not os.path.isfile(schema_path):
+        return
+    import json
+
+    try:
+        with open(schema_path) as f:
+            schema = json.load(f)
+    except ValueError as e:
+        raise ChartError(f"{chart_name}: invalid values.schema.json: {e}") from None
+    try:
+        import jsonschema
+        from jsonschema import validators
+    except ImportError:
+        import logging
+
+        logging.getLogger("opensim_tpu").warning(
+            "%s ships values.schema.json but the `jsonschema` package is not "
+            "installed; skipping schema validation", chart_name,
+        )
+        return
+    try:
+        # honor the schema's declared draft like helm does; Draft7 default
+        cls = validators.validator_for(schema, default=jsonschema.Draft7Validator)
+        cls.check_schema(schema)
+        errors = sorted(
+            cls(schema).iter_errors(values),
+            key=lambda e: list(e.absolute_path),
+        )
+    except jsonschema.SchemaError as e:
+        raise ChartError(
+            f"{chart_name}: invalid values.schema.json: {e.message}"
+        ) from None
+    if errors:
+        # helm's wording: "values don't meet the specifications of the
+        # schema(s) in the following chart(s):"
+        detail = "; ".join(
+            f"{'.'.join(str(p) for p in e.absolute_path) or '(root)'}: {e.message}"
+            for e in errors[:5]
+        )
+        raise ChartError(
+            f"{chart_name}: values don't meet the specifications of the "
+            f"schema(s) in the following chart(s): {detail}"
+        )
 
 
 def _sort_manifests(docs: List[str]) -> List[str]:

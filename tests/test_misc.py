@@ -264,3 +264,69 @@ def test_package_root_api():
 
     with _pytest.raises(AttributeError):
         ot.nonexistent_symbol
+
+
+def test_chart_values_schema_validation(tmp_path):
+    """Charts carrying values.schema.json are schema-validated before
+    rendering (chartutil.ValidateAgainstSchema parity, pkg/chart/chart.go:
+    18-41): good values render, violating values fail with the helm
+    wording, and the error names the offending path."""
+    import shutil
+
+    import yaml
+
+    from opensim_tpu.chart.render import ChartError, process_chart
+
+    src = "example/application/charts/obs-stack"
+    chart = tmp_path / "obs-stack"
+    shutil.copytree(src, chart)
+    schema = {
+        "$schema": "http://json-schema.org/draft-07/schema#",
+        "type": "object",
+        "properties": {
+            "replicas": {"type": "integer", "minimum": 1},
+        },
+        "required": ["replicas"],
+    }
+    (chart / "values.schema.json").write_text(json.dumps(schema))
+
+    values = yaml.safe_load((chart / "values.yaml").read_text()) or {}
+    values["replicas"] = 2
+    (chart / "values.yaml").write_text(yaml.safe_dump(values))
+    docs = process_chart("obs", str(chart))
+    assert len(docs) >= 10  # valid values render normally
+
+    values["replicas"] = 0  # violates minimum: 1
+    (chart / "values.yaml").write_text(yaml.safe_dump(values))
+    try:
+        process_chart("obs", str(chart))
+        raise AssertionError("schema violation must fail the chart")
+    except ChartError as e:
+        msg = str(e)
+        assert "values don't meet the specifications" in msg
+        assert "replicas" in msg
+
+    (chart / "values.schema.json").write_text("{not json")
+    try:
+        process_chart("obs", str(chart))
+        raise AssertionError("unparseable schema must fail the chart")
+    except ChartError as e:
+        assert "invalid values.schema.json" in str(e)
+
+
+def test_chart_schema_invalid_schema_document(tmp_path):
+    """A parseable-JSON but invalid schema raises ChartError (not a raw
+    jsonschema.SchemaError), and a bad-draft keyword is caught by
+    check_schema."""
+    import shutil
+
+    from opensim_tpu.chart.render import ChartError, process_chart
+
+    chart = tmp_path / "obs-stack"
+    shutil.copytree("example/application/charts/obs-stack", chart)
+    (chart / "values.schema.json").write_text(json.dumps({"type": 123}))
+    try:
+        process_chart("obs", str(chart))
+        raise AssertionError("invalid schema document must fail the chart")
+    except ChartError as e:
+        assert "invalid values.schema.json" in str(e)

@@ -187,3 +187,61 @@ def test_server_caches_snapshot_between_requests(monkeypatch):
     srv2.current_cluster()
     srv2.current_cluster()
     assert len(fetches) == 4
+
+
+def test_recorded_snapshot_round_trip(monkeypatch):
+    """Recorded apiserver JSON → cluster_from_kubeconfig → ResourceTypes →
+    simulate: the kubeconfig path exercised end-to-end past decode
+    (simulator.go:503-601 + the deploy-apps flow). Pre-bound pods replay as
+    forced binds on their recorded nodes, the recorded pending pod and a
+    new app schedule onto untainted workers, and daemonset expansion covers
+    every eligible node."""
+    import json
+    import os
+
+    with open(os.path.join(os.path.dirname(__file__), "fixtures", "live_snapshot.json")) as f:
+        store = json.load(f)
+    calls = {}
+    _install_fake_kubernetes(monkeypatch, store, calls)
+    from opensim_tpu.server.snapshot import cluster_from_kubeconfig
+
+    rt = cluster_from_kubeconfig("/tmp/kubeconfig")
+
+    # decode-level checks: filters applied, objects landed in their slots
+    assert [n.metadata.name for n in rt.nodes] == [
+        "prod-worker-1", "prod-worker-2", "prod-master-1",
+    ]
+    assert sorted(p.metadata.name for p in rt.pods) == [
+        "batch-import-1", "web-7d4b9c-k2xzq", "web-7d4b9c-m8trw",
+    ]  # ds-owned, deleting, and Succeeded pods all filtered
+    assert rt.nodes[2].taints[0].key == "node-role.kubernetes.io/master"
+    assert rt.nodes[0].allocatable["cpu"] == 15.6  # 15600m
+    assert len(rt.daemon_sets) == 1 and len(rt.pdbs) == 1
+    assert len(rt.services) == len(rt.storage_classes) == 1
+    assert len(rt.pvcs) == len(rt.config_maps) == 1
+
+    # round-trip: simulate the snapshot plus a new deployment (deploy-apps)
+    from opensim_tpu.engine.simulator import AppResource, simulate
+
+    app = ResourceTypes()
+    app.deployments.append(
+        fx.make_fake_deployment("rollout", 4, "1", "2Gi",
+                                fx.with_namespace("shop"))
+    )
+    res = simulate(rt, [AppResource("rollout", app)])
+    assert not res.unscheduled_pods, [
+        (u.pod.metadata.name, u.reason) for u in res.unscheduled_pods
+    ]
+    placed = {p.metadata.name: ns.node.metadata.name
+              for ns in res.node_status for p in ns.pods}
+    # recorded bindings replay exactly
+    assert placed["web-7d4b9c-k2xzq"] == "prod-worker-1"
+    assert placed["web-7d4b9c-m8trw"] == "prod-worker-2"
+    # the recorded pending pod lands on an untainted worker
+    assert placed["batch-import-1"].startswith("prod-worker")
+    # daemonset pods expand onto every node (tolerates the master taint)
+    ds_pods = [n for n in placed if n.startswith("node-agent")]
+    assert len(ds_pods) == 3
+    # the new deployment spreads over the two schedulable workers only
+    rollout_nodes = {placed[n] for n in placed if n.startswith("rollout")}
+    assert rollout_nodes == {"prod-worker-1", "prod-worker-2"}

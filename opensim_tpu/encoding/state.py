@@ -95,6 +95,12 @@ class EncodedCluster(NamedTuple):
     gpu_mem: np.ndarray  # [U] f32 per-GPU memory request
     gpu_count: np.ndarray  # [U] i32
     node_gpu_mem: np.ndarray  # [N, Gd] f32 per-device total memory
+    # one-hot over the resource axis marking alibabacloud.com/gpu-count. The
+    # reference rewrites that allocatable at gpushare Reserve to the count of
+    # not-fully-used devices (open-gpu-share.go:147-188, gpunodeinfo.go:354-369),
+    # so its alloc column is DYNAMIC on device-bearing nodes — kernels derive
+    # it from gpu_free instead of this table when Features.gc_dyn is set.
+    gc_mask: np.ndarray  # [R] bool
     # open-local extension
     avoid_score: np.ndarray  # [U, N] f32 NodePreferAvoidPods raw score (0 or 100)
     lvm_req: np.ndarray  # [U] f32 total LVM bytes requested
@@ -513,6 +519,12 @@ class ClusterEncoder:
         from .extensions import encode_gpu_nodes, encode_local_storage, encode_local_requests
 
         node_gpu_mem, node_gpu_count = encode_gpu_nodes(self.nodes, N)
+        from ..models.objects import RES_GPU_COUNT
+
+        gc_mask = np.zeros((R,), dtype=bool)
+        gc_col = vb.resources.get(RES_GPU_COUNT)
+        if gc_col >= 0:
+            gc_mask[gc_col] = True
         node_vg_cap, node_dev_cap, node_dev_media, vg_names, dev_names = encode_local_storage(self.nodes, N)
         lvm_req, dev_req, dev_req_count, dev_req_sizes = encode_local_requests(templates)
 
@@ -571,6 +583,7 @@ class ClusterEncoder:
             gpu_mem=gpu_mem,
             gpu_count=gpu_count,
             node_gpu_mem=node_gpu_mem,
+            gc_mask=gc_mask,
             lvm_req=lvm_req,
             dev_req=dev_req,
             dev_req_count=dev_req_count,

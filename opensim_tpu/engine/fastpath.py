@@ -155,6 +155,14 @@ def applicable(prep, config=None) -> bool:
     return True
 
 
+def _gc_row(prep) -> int:
+    """Resource-axis row of alibabacloud.com/gpu-count when the dynamic
+    allocatable path (Features.gc_dyn) is active, else -1."""
+    if not prep.features.gc_dyn:
+        return -1
+    return kernels.gc_row_of(prep.ec_np if prep.ec_np is not None else prep.ec)
+
+
 def use_big_u(U: int, N: int) -> bool:
     """Template tables move to HBM (per-step DMA) once the three resident
     [U, N] tables would crowd VMEM; below that the fully-resident kernel is
@@ -502,6 +510,7 @@ def sweep(
             has_avoid=bool(prep.features.prefer_avoid),
             interpret=interpret,
             big_u=big_u,
+            gc_row=_gc_row(prep),
         )
 
     import jax.numpy as jnp
@@ -557,6 +566,7 @@ def schedule(
         has_avoid=bool(prep.features.prefer_avoid),
         interpret=interpret,
         big_u=big_u,
+        gc_row=_gc_row(prep),
     )
     Gd = int(prep.st0.gpu_free.shape[1])
     Vg = int(prep.st0.vg_free.shape[1])

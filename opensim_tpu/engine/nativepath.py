@@ -135,12 +135,14 @@ def schedule(prep, pod_valid: np.ndarray, config=None):
         "Vg": ec.node_vg_cap.shape[1], "Dv": ec.node_dev_cap.shape[1],
         "Mv": ec.dev_req_sizes.shape[2],
         "res_cpu": V.RES_CPU, "res_mem": V.RES_MEMORY,
+        "res_gc": kernels.gc_row_of(ec),
         "ft_ports": feat.ports, "ft_gpu": feat.gpu, "ft_local": feat.local,
         "ft_interpod": feat.interpod, "ft_prefg": feat.prefg,
         "ft_spread_hard": feat.spread_hard, "ft_spread_soft": feat.spread_soft,
         "ft_pref_na": feat.pref_node_affinity,
         "ft_pref_taints": feat.prefer_taints,
         "ft_prefer_avoid": feat.prefer_avoid,
+        "ft_gc_dyn": feat.gc_dyn,
         "cf_ports": cfg.f_ports, "cf_fit": cfg.f_fit, "cf_spread": cfg.f_spread,
         "cf_interpod": cfg.f_interpod, "cf_gpu": cfg.f_gpu, "cf_local": cfg.f_local,
     }
@@ -164,6 +166,7 @@ def schedule(prep, pod_valid: np.ndarray, config=None):
         "prefg_w": f32(ec.prefg_w), "prefg_sel": i32(ec.prefg_sel),
         "prefg_topo": i32(ec.prefg_topo),
         "gpu_mem": f32(ec.gpu_mem), "gpu_count": i32(ec.gpu_count),
+        "node_gpu_cap": f32(ec.node_gpu_mem),
         "avoid_score": f32(ec.avoid_score),
         "lvm_req": f32(ec.lvm_req), "dev_req": f32(ec.dev_req),
         "dev_req_count": i32(ec.dev_req_count),

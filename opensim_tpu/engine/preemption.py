@@ -395,6 +395,17 @@ def preempt_pass(
             return False
         return not (sel_features and matches_sel[u].any())
 
+    # dynamic gpu-count allocatable (kernels.gc_dynamic_alloc — the gpushare
+    # Reserve rewrite): on device-bearing nodes the gc column's effective
+    # allocatable is the count of not-fully-used devices. dyn <= static, so
+    # the static vector check below stays a valid necessary condition and
+    # the column just gets this extra, stricter test.
+    from ..ops.kernels import gc_row_of
+
+    _gc_col = gc_row_of(ec)
+    _dev_valid = np.asarray(ec.node_gpu_mem) > 0  # [N, Gd]
+    _has_dev = _dev_valid.any(axis=1) if _dev_valid.size else np.zeros(0, bool)
+
     def fits(u: int, n: int, free_res, freed_res, freed_ports, freed_gpu,
              vg_row=None, dev_row=None) -> bool:
         # match fit_filter: only resources the preemptor actually requests
@@ -402,6 +413,12 @@ def preempt_pass(
         # resource must still admit a pod requesting none of it)
         if not np.all((st.req[u] <= free_res + freed_res) | (st.req[u] <= 0)):
             return False
+        if _gc_col >= 0 and n < _has_dev.shape[0] and _has_dev[n] and st.req[u][_gc_col] > 0:
+            gfree = st.gpu_free[n] + freed_gpu
+            dyn = float((_dev_valid[n] & (gfree > 0)).sum())
+            adj = dyn - alloc[n][_gc_col]
+            if st.req[u][_gc_col] > np.asarray(free_res + freed_res)[_gc_col] + adj:
+                return False
         if not st.ports_ok(u, n, freed_ports):
             return False
         if float(gpu_mem[u]) > 0 and st.gpu_fit(u, n, freed_gpu) is None:

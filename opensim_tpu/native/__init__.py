@@ -30,12 +30,12 @@ _SRC = _HERE / "scan_engine.cc"
 
 _DIMS = [
     "N", "R", "U", "P", "Tk", "Dp1", "A", "Hp", "Hports", "Cs", "Ti", "Tn",
-    "Tpp", "G", "Gp", "Gd", "Vg", "Dv", "Mv", "res_cpu", "res_mem",
+    "Tpp", "G", "Gp", "Gd", "Vg", "Dv", "Mv", "res_cpu", "res_mem", "res_gc",
 ]
 _FEATURES = [
     "ft_ports", "ft_gpu", "ft_local", "ft_interpod", "ft_prefg",
     "ft_spread_hard", "ft_spread_soft", "ft_pref_na", "ft_pref_taints",
-    "ft_prefer_avoid",
+    "ft_prefer_avoid", "ft_gc_dyn",
 ]
 _FILTER_ENABLES = ["cf_ports", "cf_fit", "cf_spread", "cf_interpod", "cf_gpu", "cf_local"]
 _WEIGHTS = [
@@ -63,6 +63,7 @@ _BUFFERS = [
     ("prefg_w", _F32, "f32"), ("prefg_sel", _I32, "i32"),
     ("prefg_topo", _I32, "i32"),
     ("gpu_mem", _F32, "f32"), ("gpu_count", _I32, "i32"),
+    ("node_gpu_cap", _F32, "f32"),
     ("avoid_score", _F32, "f32"),
     ("lvm_req", _F32, "f32"), ("dev_req", _F32, "f32"),
     ("dev_req_count", _I32, "i32"), ("dev_req_sizes", _F32, "f32"),

@@ -37,9 +37,10 @@ struct ScanArgs {
   // --- dims (all int64; keep order in sync with native/__init__.py) ---
   int64_t N, R, U, P, Tk, Dp1, A, Hp, Hports, Cs, Ti, Tn, Tpp, G, Gp, Gd, Vg, Dv, Mv;
   int64_t res_cpu, res_mem;
+  int64_t res_gc;  // resource row of alibabacloud.com/gpu-count (-1 absent)
   // workload feature flags (kernels.Features)
   int64_t ft_ports, ft_gpu, ft_local, ft_interpod, ft_prefg, ft_spread_hard,
-      ft_spread_soft, ft_pref_na, ft_pref_taints, ft_prefer_avoid;
+      ft_spread_soft, ft_pref_na, ft_pref_taints, ft_prefer_avoid, ft_gc_dyn;
   // filter enables (SchedulerConfig.f_*; static-filter disables are already
   // folded into static_pass by precompute_static)
   int64_t cf_ports, cf_fit, cf_spread, cf_interpod, cf_gpu, cf_local;
@@ -76,6 +77,7 @@ struct ScanArgs {
   const int32_t* prefg_topo;     // [Gp]
   const float* gpu_mem;          // [U]
   const int32_t* gpu_count;      // [U]
+  const float* node_gpu_cap;     // [N,Gd] static per-device total memory
   const float* avoid_score;      // [U,N]
   const float* lvm_req;          // [U]
   const float* dev_req;          // [U,2]
@@ -116,7 +118,7 @@ struct ScanArgs {
   float* gpu_take;        // [P,Gd]
 };
 
-int64_t opensim_abi_version() { return 1; }
+int64_t opensim_abi_version() { return 2; }
 int64_t opensim_args_size() { return (int64_t)sizeof(ScanArgs); }
 
 }  // extern "C"
@@ -175,13 +177,59 @@ inline float least_requested(float requested, float capacity) {
   return (capacity == 0.0f || requested > capacity) ? 0.0f : sc;
 }
 
+// Allocatable with the dynamic gpu-count substitution (Features.gc_dyn):
+// the gpushare Reserve rewrites a device-bearing node's gpu-count
+// allocatable to the count of not-fully-used devices
+// (open-gpu-share.go:177-182, gpunodeinfo.go:354-369).
+inline float alloc_at(const ScanArgs& a, int64_t n, int64_t r) {
+  if (a.ft_gc_dyn && r == a.res_gc) {
+    const float* cap = a.node_gpu_cap + n * a.Gd;
+    const float* fr = a.gpu_free + n * a.Gd;
+    bool has = false;
+    float dyn = 0.0f;
+    for (int64_t d = 0; d < a.Gd; d++)
+      if (cap[d] > 0.0f) {
+        has = true;
+        if (fr[d] > 0.0f) dyn += 1.0f;
+      }
+    if (has) return dyn;
+  }
+  return a.alloc[n * a.R + r];
+}
+
+// Simon/GpuShare share with the dynamic gpu-count term folded back in
+// (share_raw zeroed that column on device-bearing nodes; algo.Share,
+// greed.go:70-83 over the Reserve-updated allocatable).
+inline float share_at(const ScanArgs& a, int32_t u, int64_t n) {
+  float s = a.share_raw[(int64_t)u * a.N + n];
+  if (a.ft_gc_dyn) {
+    float gc_req = a.req[(int64_t)u * a.R + a.res_gc];
+    if (gc_req > 0.0f && a.alloc[n * a.R + a.res_gc] > 0.0f) {
+      const float* cap = a.node_gpu_cap + n * a.Gd;
+      const float* fr = a.gpu_free + n * a.Gd;
+      bool has = false;
+      float dyn = 0.0f;
+      for (int64_t d = 0; d < a.Gd; d++)
+        if (cap[d] > 0.0f) {
+          has = true;
+          if (fr[d] > 0.0f) dyn += 1.0f;
+        }
+      if (has) {
+        float avail = dyn - gc_req;
+        float sh = (avail == 0.0f) ? 1.0f : gc_req / avail;
+        s = std::max(s, std::max(sh, 0.0f) * MAXS);
+      }
+    }
+  }
+  return s;
+}
+
 inline uint8_t fit_at(const ScanArgs& a, int32_t u, int64_t n) {
   const float* req = a.req + (int64_t)u * a.R;
-  const float* al = a.alloc + n * a.R;
   const float* us = a.used + n * a.R;
   uint8_t ok = 1;
   for (int64_t r = 0; r < a.R; r++)
-    ok &= (uint8_t)(!(req[r] > 0.0f && us[r] + req[r] > al[r]));
+    ok &= (uint8_t)(!(req[r] > 0.0f && us[r] + req[r] > alloc_at(a, n, r)));
   return ok;
 }
 
@@ -271,11 +319,10 @@ void fit_mask(const ScanArgs& a, int32_t u, uint8_t* out) {
   const int64_t N = a.N, R = a.R;
   const float* req = a.req + (int64_t)u * R;
   for (int64_t n = 0; n < N; n++) {
-    const float* al = a.alloc + n * R;
     const float* us = a.used + n * R;
     uint8_t ok = 1;
     for (int64_t r = 0; r < R; r++)
-      ok &= (uint8_t)(!(req[r] > 0.0f && us[r] + req[r] > al[r]));
+      ok &= (uint8_t)(!(req[r] > 0.0f && us[r] + req[r] > alloc_at(a, n, r)));
     out[n] = ok;
   }
 }
@@ -624,7 +671,7 @@ void fail_accounting(ScanArgs& a, Scratch& s, const bool* act, int32_t u, int64_
         int32_t cnt = 0;
         for (int64_t n = 0; n < N; n++)
           if (passed[n] && a.node_valid[n] && req[r] > 0.0f &&
-              a.used[n * R + r] + req[r] > a.alloc[n * R + r])
+              a.used[n * R + r] + req[r] > alloc_at(a, n, r))
             cnt++;
         a.insufficient[i * R + r] = cnt;
       }
@@ -902,7 +949,7 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
   // fit, and no score component may depend on usage beyond used/dom_sel
   // (interpod reads dom_prefw, local reads vg/dev state).
   const bool inc_ok = !act_ports && !act_spread && !act_interpod && !act_gpu &&
-                      !act_local && !use_ip && !use_loc && a.Cs <= 16;
+                      !act_local && !use_ip && !use_loc && !a.ft_gc_dyn && a.Cs <= 16;
   constexpr size_t MAX_PENDING = 8;
   TmplCache tc;
   EnvCtx env{act_fit, use_spr, use_share, use_avoid, wsp, wshare, wav};
@@ -1098,12 +1145,12 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
       }
     }
     float sh_lo = BIG, sh_hi = NEG, sh_rng = 0.0f;
-    const float* share = a.share_raw + (int64_t)u * N;
     if (use_share) {
       for (int64_t n = 0; n < N; n++) {
         if (s.feas[n]) {
-          sh_lo = std::min(sh_lo, share[n]);
-          sh_hi = std::max(sh_hi, share[n]);
+          float sh = share_at(a, u, n);
+          sh_lo = std::min(sh_lo, sh);
+          sh_hi = std::max(sh_hi, sh);
         }
       }
       sh_rng = sh_hi - sh_lo;
@@ -1158,7 +1205,7 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
         sc += wsp * norm;
       }
       if (use_share)
-        sc += wshare * (sh_rng > 0.0f ? (share[n] - sh_lo) * MAXS / sh_rng : 0.0f);
+        sc += wshare * (sh_rng > 0.0f ? (share_at(a, u, n) - sh_lo) * MAXS / sh_rng : 0.0f);
       if (use_loc)
         sc += wloc * (lc_rng > 0.0f ? (s.raw_loc[n] - lc_lo) * MAXS / lc_rng : 0.0f);
       if (use_avoid) sc += wav * avoid[n];

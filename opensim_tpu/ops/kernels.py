@@ -166,11 +166,42 @@ def ports_filter(ec, st, u):
     return ~jnp.any(conflict, axis=-1)
 
 
-def fit_filter(ec, st, u):
+def gc_row_of(ec) -> int:
+    """Host-side resource-axis row of alibabacloud.com/gpu-count, -1 when
+    absent. The single source for the engines' static `gc_row` parameter —
+    keep fastpath/nativepath/preemption in lockstep through this."""
+    import numpy as np
+
+    mask = np.asarray(ec.gc_mask)
+    return int(np.argmax(mask)) if mask.any() else -1
+
+
+def gc_dynamic_alloc(ec, st):
+    """The gpushare Reserve rewrite (open-gpu-share.go:177-182 →
+    ExportGpuNodeInfoAsNodeGpuInfo, gpunodeinfo.go:354-369): a device-bearing
+    node's ``gpu-count`` allocatable is the count of devices that are not
+    fully used. Returns (dyn [N] f32, has_dev [N] bool)."""
+    valid_dev = ec.node_gpu_mem > 0
+    dyn = jnp.sum(valid_dev & (st.gpu_free > 0), axis=-1).astype(jnp.float32)
+    return dyn, jnp.any(valid_dev, axis=-1)
+
+
+def effective_alloc(ec, st):
+    """Allocatable with the dynamic gpu-count column substituted on
+    device-bearing nodes (all other columns — and device-less nodes, whose
+    fake-client objects the reference never updates — stay static)."""
+    dyn, has_dev = gc_dynamic_alloc(ec, st)
+    return jnp.where(ec.gc_mask[None, :] & has_dev[:, None], dyn[:, None], ec.alloc)
+
+
+def fit_filter(ec, st, u, alloc=None):
     """NodeResourcesFit (noderesources/fit.go:195-260): requested resources
-    must fit allocatable - used. Returns (mask, insufficient [N, R])."""
+    must fit allocatable - used. Returns (mask, insufficient [N, R]).
+    `alloc` overrides ec.alloc (the Features.gc_dyn dynamic-allocatable
+    path)."""
+    alloc = ec.alloc if alloc is None else alloc
     req = ec.req[u]  # [R]
-    insufficient = (req[None, :] > 0) & (st.used + req[None, :] > ec.alloc)
+    insufficient = (req[None, :] > 0) & (st.used + req[None, :] > alloc)
     return ~jnp.any(insufficient, axis=-1), insufficient
 
 
@@ -421,9 +452,11 @@ def spread_score(ec, stat: StaticTables, st, u, feasible):
 def share_raw(ec, u):
     """Simon / Open-Gpu-Share share score (plugin/simon.go:45-74 +
     algo.Share, pkg/algo/greed.go:70-83), pre-normalization: max over
-    node-allocatable resources of req/(allocatable - req). Static
-    allocatable is used (the fake client's node objects are never
-    decremented), so this is usage-independent — matching the reference."""
+    node-allocatable resources of req/(allocatable - req). Allocatable is
+    static — the fake client's node objects are never decremented — EXCEPT
+    the gpu-count column on device-bearing nodes, which the gpushare
+    Reserve rewrites (open-gpu-share.go:177-182): that column is excluded
+    here and re-added per step by gc_share_dyn when Features.gc_dyn."""
     req = ec.req[u].at[V.RES_PODS].set(0.0)  # 'pods' request is not in PodRequestsAndLimits
     avail = ec.alloc - req[None, :]
     share = jnp.where(
@@ -432,9 +465,40 @@ def share_raw(ec, u):
     # only resources the node actually declares participate; negative shares
     # (req > allocatable) floor at 0 like the Go accumulator starting at 0
     share = jnp.where(ec.alloc > 0, share, 0.0)
+    # the gpu-count column is DYNAMIC on device-bearing nodes (the gpushare
+    # Reserve rewrite, open-gpu-share.go:177-182): its static contribution is
+    # excluded here and pod_step adds the usage-dependent term per step
+    # (gc_share_dyn). The exclusion MUST mirror Features.gc_dyn exactly —
+    # some template must carry a gpushare annotation (else devices never
+    # fill and no add-back runs) and some template must request gpu-count
+    # (else the column is 0 anyway). Device-less nodes keep the static
+    # column in all cases.
+    has_dev = jnp.any(ec.node_gpu_mem > 0, axis=-1)  # [N]
+    dyn_active = jnp.any(ec.gpu_mem > 0) & jnp.any(
+        jnp.where(ec.gc_mask[None, :], ec.req, 0.0) > 0
+    )
+    share = jnp.where(
+        ec.gc_mask[None, :] & has_dev[:, None] & dyn_active, 0.0, share
+    )
     raw = jnp.maximum(jnp.max(share, axis=-1), 0.0) * MAX_NODE_SCORE
     # pods with no requests score MaxNodeScore on every node
     return jnp.where(jnp.any(req > 0), raw, MAX_NODE_SCORE)
+
+
+def gc_share_dyn(ec, st, u):
+    """Per-step share term for the dynamic gpu-count allocatable
+    (algo.Share over the Reserve-updated value, open-gpu-share.go:94-106):
+    req / (dyn_alloc - req), 1 when the denominator is 0, negative floored
+    at 0 (the Go accumulator starts at 0). Zero on device-less nodes (their
+    static column stays in share_raw) and for templates not requesting
+    gpu-count."""
+    gc_req = jnp.sum(jnp.where(ec.gc_mask, ec.req[u], 0.0))
+    dyn, has_dev = gc_dynamic_alloc(ec, st)
+    declared = jnp.sum(jnp.where(ec.gc_mask[None, :], ec.alloc, 0.0), axis=-1) > 0
+    avail = dyn - gc_req
+    share = jnp.where(avail == 0, jnp.where(gc_req == 0, 0.0, 1.0), gc_req / avail)
+    share = jnp.where(declared & has_dev, jnp.maximum(share, 0.0), 0.0)
+    return jnp.where(gc_req > 0, share * MAX_NODE_SCORE, 0.0)
 
 
 class StaticTables(NamedTuple):
@@ -553,13 +617,17 @@ class Features(NamedTuple):
     pref_node_affinity: bool
     prefer_taints: bool
     prefer_avoid: bool
+    # some template requests alibabacloud.com/gpu-count as a SPEC resource
+    # while gpushare devices exist: the allocatable column follows the device
+    # state (Reserve rewrite) instead of the static table
+    gc_dyn: bool = False
 
     @property
     def sel_counts(self) -> bool:
         return self.interpod or self.spread_hard or self.spread_soft
 
 
-ALL_FEATURES = Features(*([True] * 10))
+ALL_FEATURES = Features(*([True] * 11))
 
 
 def features_of(ec_np) -> Features:
@@ -587,6 +655,11 @@ def features_of(ec_np) -> Features:
             (np.asarray(ec_np.taint_effect) == V.EFFECT_PREFER_NO_SCHEDULE).any()
         ),
         prefer_avoid=bool((np.asarray(ec_np.avoid_score) < 100.0).any()),
+        gc_dyn=bool(
+            (np.asarray(ec_np.gpu_mem) > 0).any()
+            and np.asarray(ec_np.gc_mask).any()
+            and (np.asarray(ec_np.req)[:, np.asarray(ec_np.gc_mask)] > 0).any()
+        ),
     )
 
 
@@ -620,8 +693,9 @@ def pod_step(
     static_pass = stat.static_pass[u]  # valid already folded in
     true_mask = jnp.ones_like(static_pass)
     masks = [ports_filter(ec, st, u) if feat.ports and cfg.f_ports else true_mask]
+    alloc_eff = effective_alloc(ec, st) if feat.gc_dyn else None
     if cfg.f_fit:
-        fit_mask, insufficient = fit_filter(ec, st, u)
+        fit_mask, insufficient = fit_filter(ec, st, u, alloc=alloc_eff)
     else:
         fit_mask, insufficient = true_mask, jnp.zeros_like(ec.alloc, dtype=bool)
     masks.append(fit_mask)
@@ -695,8 +769,14 @@ def pod_step(
         score = score + cfg.w_spread * spread_score(ec, stat, st, u, feasible)
     if cfg.w_simon + cfg.w_gpu_share:
         # Simon + Open-Gpu-Share share the same formula and normalization
+        share_row = stat.share_raw[u]
+        if feat.gc_dyn:
+            # add back the gpu-count column with the Reserve-updated value
+            # (share_raw zeroed it on device-bearing nodes); max mirrors the
+            # Go accumulator taking the largest per-resource share
+            share_row = jnp.maximum(share_row, gc_share_dyn(ec, st, u))
         score = score + (cfg.w_simon + cfg.w_gpu_share) * _minmax_normalize(
-            stat.share_raw[u], feasible
+            share_row, feasible
         )
     if feat.local and cfg.w_local:
         score = score + cfg.w_local * _minmax_normalize(local_score(ec, st, u), feasible)

@@ -208,6 +208,7 @@ def _make_kernel(
     n_dvol: int,
     big_u: bool = False,
     n_zkeys: int = 1,
+    gc_row: int = -1,
 ):
     layout = _input_layout(has_interpod, has_gpu, has_local, has_ports, has_na, has_tt, has_avoid, big_u)
     in_names = [n for n, _ in layout]
@@ -397,10 +398,25 @@ def _make_kernel(
                     gpu_take_ref[d, i] = jnp.float32(0.0)
 
             # --- NodeResourcesFit
+            # dynamic gpu-count allocatable (Features.gc_dyn; the gpushare
+            # Reserve rewrite, open-gpu-share.go:177-182): on device-bearing
+            # nodes the gc_row alloc is the count of not-fully-used devices
+            use_gc = has_gpu and gc_row >= 0
+            if use_gc:
+                gc_dyn_row = jnp.zeros((1, N), jnp.float32)
+                gc_has_dev = jnp.zeros((1, N), jnp.float32)
+                for d in range(n_gpu):
+                    valid_d = (gpu0_ref[pl.ds(d, 1), :] > 0).astype(jnp.float32)
+                    free_d = (gpu_free_ref[pl.ds(d, 1), :] > 0).astype(jnp.float32)
+                    gc_dyn_row = gc_dyn_row + valid_d * free_d
+                    gc_has_dev = jnp.maximum(gc_has_dev, valid_d)
             fit = ones_1n
             for r in range(R):
                 req_r = req_ref[r, u]
-                over = (used_ref[pl.ds(r, 1), :] + req_r > alloc_ref[pl.ds(r, 1), :]).astype(jnp.float32)
+                alloc_r = alloc_ref[pl.ds(r, 1), :]
+                if use_gc and r == gc_row:
+                    alloc_r = jnp.where(gc_has_dev > 0, gc_dyn_row, alloc_r)
+                over = (used_ref[pl.ds(r, 1), :] + req_r > alloc_r).astype(jnp.float32)
                 fit = fit * jnp.where(req_r > 0, 1.0 - over, 1.0)
             # node validity is a runtime row (NOT folded into static_pass) so
             # scenario sweeps can vary it without re-marshalling the tables
@@ -596,6 +612,22 @@ def _make_kernel(
             )
 
             share_row = s_share[:] if big_u else shraw_ref[pl.ds(u, 1), :]
+            if use_gc:
+                # add back the gpu-count share with the Reserve-updated
+                # value (share_raw zeroed that column on device-bearing
+                # nodes; algo.Share semantics, greed.go:70-83)
+                gc_req = req_ref[gc_row, u]
+                declared = (alloc_ref[pl.ds(gc_row, 1), :] > 0).astype(jnp.float32)
+                avail = gc_dyn_row - gc_req
+                sh = jnp.where(
+                    avail == 0,
+                    jnp.where(gc_req == 0, 0.0, 1.0),
+                    gc_req / jnp.where(avail == 0, 1.0, avail),
+                )
+                sh = jnp.where(
+                    (declared > 0) & (gc_has_dev > 0), jnp.maximum(sh, 0.0), 0.0
+                ) * MAX_SCORE
+                share_row = jnp.maximum(share_row, jnp.where(gc_req > 0, sh, 0.0))
             feas_b = feasible > 0
             lo = jnp.min(jnp.where(feas_b, share_row, jnp.float32(1e30)))
             hi = jnp.max(jnp.where(feas_b, share_row, jnp.float32(-1e30)))
@@ -848,6 +880,7 @@ def run_fast_scan(
     has_avoid: bool = False,
     interpret: bool = False,
     big_u: bool = False,
+    gc_row: int = -1,
 ):
     """Execute the megakernel. tmpl_ids/pod_valid/forced are [P] (P a
     multiple of CHUNK). Returns (chosen [P] i32, used_final [R, N],
@@ -976,7 +1009,7 @@ def run_fast_scan(
     out = pl.pallas_call(
         _make_kernel(
             has_interpod, has_gpu, has_local, has_ports, has_na, has_tt, has_avoid,
-            G, Gp, Gd, Vg, Dv, fi.dev_sizes.shape[1] // 2, big_u, K,
+            G, Gp, Gd, Vg, Dv, fi.dev_sizes.shape[1] // 2, big_u, K, gc_row,
         ),
         grid=grid,
         out_shape=tuple(out_shape),

@@ -137,6 +137,11 @@ def random_app(rng: random.Random, n_workloads: int) -> ResourceTypes:
             opts.append(fx.with_affinity(aff))
         if rng.random() < 0.2:
             opts.append(fx.with_host_ports([rng.choice([8080, 9090, 9443])]))
+        if rng.random() < 0.15:
+            # whole-GPU pods: gpu-count as a SPEC resource exercises the
+            # dynamic allocatable (gpushare Reserve rewrite) fit/share path
+            opts.append(fx.with_requests(
+                {"alibabacloud.com/gpu-count": rng.choice(["1", "2"])}))
         if rng.random() < 0.4:
             opts.append(fx.with_namespace(rng.choice(["ns-a", "ns-b"])))
         deploy = fx.make_fake_deployment(

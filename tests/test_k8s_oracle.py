@@ -56,6 +56,12 @@ class Oracle:
     def bind(self, pod: Pod, node: Node):
         self.bound.append((pod, node))
 
+    def alloc_view(self, node: Node) -> dict:
+        """Scheduler-visible allocatable. The base oracle has no gpushare
+        devices, so it is the static node object; ExtOracle overrides with
+        the Reserve-updated gpu-count (open-gpu-share.go:177-182)."""
+        return node.allocatable
+
     # -- individual filters --------------------------------------------------
 
     def static_ok(self, pod: Pod, node: Node) -> bool:
@@ -77,8 +83,9 @@ class Oracle:
                     used[k] = used.get(k, 0.0) + v
         req = dict(pod.resource_requests())
         req["pods"] = req.get("pods", 0.0) + 1
+        alloc = self.alloc_view(node)
         for k, v in req.items():
-            if v > 0 and used.get(k, 0.0) + v > node.allocatable.get(k, 0.0):
+            if v > 0 and used.get(k, 0.0) + v > alloc.get(k, 0.0):
                 return False
         return True
 
@@ -539,7 +546,7 @@ class ScoreOracle:
                 raw[n.metadata.name] = 100.0
                 continue
             best = 0.0
-            for r, alloc in n.allocatable.items():
+            for r, alloc in self.o.alloc_view(n).items():
                 pr = req.get(r, 0.0)
                 avail = alloc - pr
                 share = (1.0 if pr else 0.0) if avail == 0 else pr / avail
@@ -736,6 +743,17 @@ class ExtOracle(Oracle):
             self.vg[n.metadata.name] = vgs
             self.devs[n.metadata.name] = devs
 
+    def alloc_view(self, node: Node) -> dict:
+        """Reserve-updated allocatable (open-gpu-share.go:147-188 →
+        gpunodeinfo.go:354-369): on device-bearing nodes gpu-count is the
+        number of not-fully-used devices; everything else stays static."""
+        free = self.gpu_free.get(node.metadata.name) or []
+        if not free:
+            return node.allocatable
+        alloc = dict(node.allocatable)
+        alloc["alibabacloud.com/gpu-count"] = float(sum(1 for f in free if f > 0))
+        return alloc
+
     def gpu_ok(self, pod: Pod, node: Node) -> bool:
         mem, cnt = _pod_gpu(pod)
         if mem <= 0:
@@ -882,10 +900,15 @@ def ext_app(rng, n_pods):
     for k in range(n_pods):
         opts = []
         roll = rng.random()
-        if roll < 0.45:
+        if roll < 0.35:
             opts.append(fx.with_annotations(
                 {"alibabacloud.com/gpu-mem": rng.choice(["2Gi", "4Gi", "8Gi"]),
                  "alibabacloud.com/gpu-count": rng.choice(["1", "1", "2"])}))
+        elif roll < 0.5:
+            # whole-GPU pod: gpu-count as a SPEC resource — exercises the
+            # dynamic allocatable (Reserve rewrite) in fit and share
+            opts.append(fx.with_requests(
+                {"alibabacloud.com/gpu-count": rng.choice(["1", "1", "2"])}))
         elif roll < 0.8:
             vols = [{"size": str(rng.choice([5, 10, 20]) * 1024**3), "kind": "LVM",
                      "scName": "open-local-lvm"}]

@@ -144,3 +144,109 @@ def test_daemonset_eligibility_matches_engine():
     got = {ns.node.metadata.name for ns in res.node_status if ns.pods}
     assert got == expected
     assert not res.unscheduled_pods
+
+
+# ---------------------------------------------------------------------------
+# dynamic gpu-count allocatable (PARITY divergence #3, now closed): the
+# reference rewrites a device-bearing node's gpu-count allocatable to the
+# count of not-fully-used devices at gpushare Reserve
+# (open-gpu-share.go:147-188 -> gpunodeinfo.go:354-369), feeding later
+# NodeResourcesFit checks and Simon/GpuShare share scores for pods that
+# request alibabacloud.com/gpu-count as a SPEC resource.
+# ---------------------------------------------------------------------------
+
+
+def test_gpu_count_allocatable_decrements_for_fit():
+    """A whole-GPU pod requesting gpu-count=2 must NOT fit once a sharing
+    pod has fully used one of the node's two devices (static allocatable
+    would wrongly admit it)."""
+    from opensim_tpu.engine.simulator import AppResource, simulate
+
+    rt = ResourceTypes()
+    rt.nodes.append(fx.make_fake_node(
+        "g0", "32", "64Gi", "110",
+        fx.with_allocatable({"alibabacloud.com/gpu-mem": "16Gi",
+                             "alibabacloud.com/gpu-count": "2"}),
+    ))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod(
+        "share", "100m", "128Mi",
+        fx.with_annotations({"alibabacloud.com/gpu-mem": "8Gi",
+                             "alibabacloud.com/gpu-count": "1"}),
+    ))
+    app.pods.append(fx.make_fake_pod(
+        "whole", "100m", "128Mi",
+        fx.with_requests({"alibabacloud.com/gpu-count": "2"}),
+    ))
+    result = simulate(rt, [AppResource("a", app)], node_pad=8)
+    assert "share" in [p.metadata.name for p in result.pods_on("g0")]
+    unsched = {up.pod.metadata.name: up.reason for up in result.unscheduled_pods}
+    assert "whole" in unsched, "static allocatable would wrongly admit the pod"
+    assert "Insufficient alibabacloud.com/gpu-count" in unsched["whole"]
+
+
+def test_gpu_count_decrement_feeds_share_score():
+    """Binpack placement must follow the Reserve-updated allocatable: with
+    g0 (4 devices, 2 filled -> dyn 2) and g1 (3 free devices), a whole-GPU
+    pod requesting gpu-count=1 shares 1/(2-1)=1.0 on g0 vs 1/(3-1)=0.5 on
+    g1 and must land on g0; the static view (1/3 vs 1/2) would pick g1."""
+    from opensim_tpu.engine.simulator import AppResource, simulate
+
+    rt = ResourceTypes()
+    rt.nodes.append(fx.make_fake_node(
+        "g0", "32", "64Gi", "110",
+        fx.with_allocatable({"alibabacloud.com/gpu-mem": "32Gi",
+                             "alibabacloud.com/gpu-count": "4"}),
+    ))
+    rt.nodes.append(fx.make_fake_node(
+        "g1", "32", "64Gi", "110",
+        fx.with_allocatable({"alibabacloud.com/gpu-mem": "24Gi",
+                             "alibabacloud.com/gpu-count": "3"}),
+    ))
+    app = ResourceTypes()
+    for k in range(2):  # fill two of g0's four 8Gi devices exactly
+        app.pods.append(fx.make_fake_pod(
+            f"fill-{k}", "0", "0",
+            fx.with_node_name("g0"),
+            fx.with_annotations({"alibabacloud.com/gpu-mem": "8Gi",
+                                 "alibabacloud.com/gpu-count": "1"}),
+        ))
+    app.pods.append(fx.make_fake_pod(
+        "whole", "0", "0",
+        fx.with_requests({"alibabacloud.com/gpu-count": "1"}),
+    ))
+    result = simulate(rt, [AppResource("a", app)], node_pad=8)
+    assert not result.unscheduled_pods
+    assert "whole" in [p.metadata.name for p in result.pods_on("g0")], (
+        "share score must use the Reserve-updated gpu-count allocatable"
+    )
+
+
+def test_whole_gpu_only_workload_keeps_static_share():
+    """With NO gpushare-annotation pods, devices never fill and the
+    reference's Reserve never rewrites allocatable — the gpu-count share
+    must be the plain static share (regression: the column exclusion in
+    share_raw must mirror Features.gc_dyn exactly, or whole-GPU-only
+    workloads lose the term and binpack degenerates to lowest-index)."""
+    from opensim_tpu.engine.simulator import AppResource, simulate
+
+    rt = ResourceTypes()
+    rt.nodes.append(fx.make_fake_node(
+        "g0", "32", "64Gi", "110",
+        fx.with_allocatable({"alibabacloud.com/gpu-mem": "32Gi",
+                             "alibabacloud.com/gpu-count": "4"}),
+    ))
+    rt.nodes.append(fx.make_fake_node(
+        "g1", "32", "64Gi", "110",
+        fx.with_allocatable({"alibabacloud.com/gpu-mem": "16Gi",
+                             "alibabacloud.com/gpu-count": "2"}),
+    ))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod(
+        "whole", "0", "0",
+        fx.with_requests({"alibabacloud.com/gpu-count": "1"}),
+    ))
+    result = simulate(rt, [AppResource("a", app)], node_pad=8)
+    assert not result.unscheduled_pods
+    # static shares: 1/(4-1) on g0 vs 1/(2-1) on g1 -> binpack picks g1
+    assert "whole" in [p.metadata.name for p in result.pods_on("g1")]

@@ -35,6 +35,27 @@ def _fmt(n: int) -> str:
     return f"{n // 1000}k" if n >= 1000 and n % 1000 == 0 else str(n)
 
 
+def _serial_floor(config: str, pods: int, nodes: int):
+    """Measured python-serial baseline (tools/serial_baseline.py) for the
+    same workload at the same shape, if one has been recorded. Returns the
+    record or None. The floor UNDERSTATES the Go reference's speed (Python
+    per-op cost); BENCH.md's modeled brackets convert. bench's `plan`
+    config and the baseline tool's `synthetic` use the same generators, so
+    either key matches by shape."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BASELINE_MEASURED.json")
+    try:
+        with open(path) as f:
+            measured = json.load(f)
+    except (OSError, ValueError):
+        return None
+    keys = {"plan": ["plan", "synthetic"]}.get(config, [config])
+    for key in keys:
+        rec = measured.get(key)
+        if rec and rec.get("pods") == pods and rec.get("nodes") == nodes:
+            return rec
+    return None
+
+
 def synthetic_cluster(n_nodes: int) -> ResourceTypes:
     rt = ResourceTypes()
     zones = [f"zone-{z}" for z in range(4)]
@@ -316,6 +337,12 @@ def main() -> int:
     }
     if cold_s is not None:
         record["cold_s"] = cold_s  # includes first-compile (cached across runs)
+    serial = _serial_floor(
+        args.config, scheduled + len(result.unscheduled_pods), args.nodes
+    )
+    if serial and serial.get("schedule_s") and dt > 0:
+        record["vs_serial"] = round(serial["schedule_s"] / dt, 1)
+        record["serial_schedule_s"] = serial["schedule_s"]
     if BACKEND_NOTE:
         record["backend"] = BACKEND_NOTE
     print(json.dumps(record))

@@ -136,13 +136,14 @@ def _validate_values_schema(path: str, chart_name: str, values: dict) -> None:
         import jsonschema
         from jsonschema import validators
     except ImportError:
-        import logging
-
-        logging.getLogger("opensim_tpu").warning(
-            "%s ships values.schema.json but the `jsonschema` package is not "
-            "installed; skipping schema validation", chart_name,
-        )
-        return
+        # A chart that ships a schema MUST be validated against it — helm
+        # would refuse to install on violation, so silently rendering here
+        # would be a parity divergence. Fail loudly instead of warning.
+        raise ChartError(
+            f"{chart_name} ships values.schema.json but the `jsonschema` "
+            "package is not installed; install it (or a `helm` binary on "
+            "PATH) to render this chart"
+        ) from None
     try:
         # honor the schema's declared draft like helm does; Draft7 default
         cls = validators.validator_for(schema, default=jsonschema.Draft7Validator)

@@ -144,6 +144,11 @@ struct Scratch {
   std::vector<int32_t> visited;  // epoch stamps for member-union dedup
   std::vector<int32_t> touch;    // affected nodes collected this delta
   int32_t epoch = 0;
+  // [N] dynamic gpu-count allocatable (-1 on device-less nodes); filled and
+  // maintained only under ft_gc_dyn — gpu_free changes only at bind, so one
+  // per-bound-node refresh replaces per-(node, call) device rescans
+  std::vector<float> gc_dyn;
+  const float* gc_dyn_ptr() const { return gc_dyn.empty() ? nullptr : gc_dyn.data(); }
 };
 
 // Incremental same-template cache. Pod streams are dominated by runs of one
@@ -177,22 +182,30 @@ inline float least_requested(float requested, float capacity) {
   return (capacity == 0.0f || requested > capacity) ? 0.0f : sc;
 }
 
-// Allocatable with the dynamic gpu-count substitution (Features.gc_dyn):
-// the gpushare Reserve rewrites a device-bearing node's gpu-count
-// allocatable to the count of not-fully-used devices
-// (open-gpu-share.go:177-182, gpunodeinfo.go:354-369).
-inline float alloc_at(const ScanArgs& a, int64_t n, int64_t r) {
+// Dynamic gpu-count allocatable of one node (Features.gc_dyn): the
+// gpushare Reserve rewrites a device-bearing node's gpu-count allocatable
+// to the count of not-fully-used devices (open-gpu-share.go:177-182,
+// gpunodeinfo.go:354-369). Returns -1 on device-less nodes (static
+// allocatable applies). Invariant between binds — callers pass the
+// Scratch::gc_dyn row (recomputed per bound node in bind()) instead of
+// rescanning devices per (node, call); nullptr falls back to the scan.
+inline float gc_dyn_of(const ScanArgs& a, int64_t n) {
+  const float* cap = a.node_gpu_cap + n * a.Gd;
+  const float* fr = a.gpu_free + n * a.Gd;
+  bool has = false;
+  float dyn = 0.0f;
+  for (int64_t d = 0; d < a.Gd; d++)
+    if (cap[d] > 0.0f) {
+      has = true;
+      if (fr[d] > 0.0f) dyn += 1.0f;
+    }
+  return has ? dyn : -1.0f;
+}
+
+inline float alloc_at(const ScanArgs& a, const float* gc_dyn, int64_t n, int64_t r) {
   if (a.ft_gc_dyn && r == a.res_gc) {
-    const float* cap = a.node_gpu_cap + n * a.Gd;
-    const float* fr = a.gpu_free + n * a.Gd;
-    bool has = false;
-    float dyn = 0.0f;
-    for (int64_t d = 0; d < a.Gd; d++)
-      if (cap[d] > 0.0f) {
-        has = true;
-        if (fr[d] > 0.0f) dyn += 1.0f;
-      }
-    if (has) return dyn;
+    float dyn = gc_dyn ? gc_dyn[n] : gc_dyn_of(a, n);
+    if (dyn >= 0.0f) return dyn;
   }
   return a.alloc[n * a.R + r];
 }
@@ -200,21 +213,13 @@ inline float alloc_at(const ScanArgs& a, int64_t n, int64_t r) {
 // Simon/GpuShare share with the dynamic gpu-count term folded back in
 // (share_raw zeroed that column on device-bearing nodes; algo.Share,
 // greed.go:70-83 over the Reserve-updated allocatable).
-inline float share_at(const ScanArgs& a, int32_t u, int64_t n) {
+inline float share_at(const ScanArgs& a, const float* gc_dyn, int32_t u, int64_t n) {
   float s = a.share_raw[(int64_t)u * a.N + n];
   if (a.ft_gc_dyn) {
     float gc_req = a.req[(int64_t)u * a.R + a.res_gc];
     if (gc_req > 0.0f && a.alloc[n * a.R + a.res_gc] > 0.0f) {
-      const float* cap = a.node_gpu_cap + n * a.Gd;
-      const float* fr = a.gpu_free + n * a.Gd;
-      bool has = false;
-      float dyn = 0.0f;
-      for (int64_t d = 0; d < a.Gd; d++)
-        if (cap[d] > 0.0f) {
-          has = true;
-          if (fr[d] > 0.0f) dyn += 1.0f;
-        }
-      if (has) {
+      float dyn = gc_dyn ? gc_dyn[n] : gc_dyn_of(a, n);
+      if (dyn >= 0.0f) {
         float avail = dyn - gc_req;
         float sh = (avail == 0.0f) ? 1.0f : gc_req / avail;
         s = std::max(s, std::max(sh, 0.0f) * MAXS);
@@ -225,11 +230,13 @@ inline float share_at(const ScanArgs& a, int32_t u, int64_t n) {
 }
 
 inline uint8_t fit_at(const ScanArgs& a, int32_t u, int64_t n) {
+  // incremental-cache path only (inc_ok excludes ft_gc_dyn, so the
+  // nullptr slow path below never actually rescans devices)
   const float* req = a.req + (int64_t)u * a.R;
   const float* us = a.used + n * a.R;
   uint8_t ok = 1;
   for (int64_t r = 0; r < a.R; r++)
-    ok &= (uint8_t)(!(req[r] > 0.0f && us[r] + req[r] > alloc_at(a, n, r)));
+    ok &= (uint8_t)(!(req[r] > 0.0f && us[r] + req[r] > alloc_at(a, nullptr, n, r)));
   return ok;
 }
 
@@ -315,14 +322,14 @@ void ports_mask(const ScanArgs& a, int32_t u, uint8_t* out) {
   }
 }
 
-void fit_mask(const ScanArgs& a, int32_t u, uint8_t* out) {
+void fit_mask(const ScanArgs& a, const float* gc_dyn, int32_t u, uint8_t* out) {
   const int64_t N = a.N, R = a.R;
   const float* req = a.req + (int64_t)u * R;
   for (int64_t n = 0; n < N; n++) {
     const float* us = a.used + n * R;
     uint8_t ok = 1;
     for (int64_t r = 0; r < R; r++)
-      ok &= (uint8_t)(!(req[r] > 0.0f && us[r] + req[r] > alloc_at(a, n, r)));
+      ok &= (uint8_t)(!(req[r] > 0.0f && us[r] + req[r] > alloc_at(a, gc_dyn, n, r)));
     out[n] = ok;
   }
 }
@@ -616,6 +623,8 @@ void bind(ScanArgs& a, Scratch& s, int32_t u, int32_t node, float* take_out) {
       for (int64_t d = 0; d < Gd; d++) free[d] -= take_out[d] * mem;
     }
   }
+  if (a.ft_gc_dyn && !s.gc_dyn.empty())
+    s.gc_dyn[node] = gc_dyn_of(a, node);
 
   if (a.ft_local) {
     // LVM: tightest-fitting VG (ascending free-size first-fit, common.go:111-116)
@@ -671,7 +680,7 @@ void fail_accounting(ScanArgs& a, Scratch& s, const bool* act, int32_t u, int64_
         int32_t cnt = 0;
         for (int64_t n = 0; n < N; n++)
           if (passed[n] && a.node_valid[n] && req[r] > 0.0f &&
-              a.used[n * R + r] + req[r] > alloc_at(a, n, r))
+              a.used[n * R + r] + req[r] > alloc_at(a, s.gc_dyn_ptr(), n, r))
             cnt++;
         a.insufficient[i * R + r] = cnt;
       }
@@ -915,6 +924,10 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
   // bootstrap (Σ over real domains of dom_sel — trash row excluded because
   // domain_topo[trash] = -1); maintained incrementally on bind
   s.key_sel_total.assign(Tk * A, 0.0f);
+  if (a.ft_gc_dyn) {
+    s.gc_dyn.resize(N);
+    for (int64_t n = 0; n < N; n++) s.gc_dyn[n] = gc_dyn_of(a, n);
+  }
   for (int64_t d = 0; d < a.Dp1; d++) {
     int32_t tk = a.domain_topo[d];
     if (tk < 0) continue;
@@ -1062,7 +1075,7 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
 
       if (bi < 0) {
         prof.start();
-        if (act_fit) fit_mask(a, u, s.mask[S_FIT].data());
+        if (act_fit) fit_mask(a, s.gc_dyn_ptr(), u, s.mask[S_FIT].data());
         fail_accounting(a, s, act, u, i);
         tc.prev_failed = true;
         for (int k = 0; k < N_STAGES; k++) tc.fail_row[k] = a.fail_counts[i * N_STAGES + k];
@@ -1083,7 +1096,7 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
 
     // --- Filter: active dynamic masks over the full node axis ---
     if (act_ports) ports_mask(a, u, s.mask[S_PORTS].data());
-    if (act_fit) fit_mask(a, u, s.mask[S_FIT].data());
+    if (act_fit) fit_mask(a, s.gc_dyn_ptr(), u, s.mask[S_FIT].data());
     if (act_spread) spread_mask(a, u, s.mask[S_SPREAD].data());
     if (act_interpod) interpod_mask(a, s, u, s.mask[S_INTERPOD].data());
     if (act_gpu) gpu_mask(a, u, s.mask[S_GPU].data());
@@ -1144,11 +1157,12 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
         }
       }
     }
+    const float* gcd = s.gc_dyn_ptr();
     float sh_lo = BIG, sh_hi = NEG, sh_rng = 0.0f;
     if (use_share) {
       for (int64_t n = 0; n < N; n++) {
         if (s.feas[n]) {
-          float sh = share_at(a, u, n);
+          float sh = share_at(a, gcd, u, n);
           sh_lo = std::min(sh_lo, sh);
           sh_hi = std::max(sh_hi, sh);
         }
@@ -1205,7 +1219,7 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
         sc += wsp * norm;
       }
       if (use_share)
-        sc += wshare * (sh_rng > 0.0f ? (share_at(a, u, n) - sh_lo) * MAXS / sh_rng : 0.0f);
+        sc += wshare * (sh_rng > 0.0f ? (share_at(a, gcd, u, n) - sh_lo) * MAXS / sh_rng : 0.0f);
       if (use_loc)
         sc += wloc * (lc_rng > 0.0f ? (s.raw_loc[n] - lc_lo) * MAXS / lc_rng : 0.0f);
       if (use_avoid) sc += wav * avoid[n];

@@ -40,6 +40,12 @@ def load_state(path: str) -> Tuple[EncodedCluster, ScanState, dict]:
         meta = json.loads(bytes(data["__meta__"]).decode())
         if meta.get("version") != _FORMAT_VERSION:
             raise ValueError(f"{path}: unsupported checkpoint version {meta.get('version')}")
-        ec = EncodedCluster(**{k[3:]: data[k] for k in data.files if k.startswith("ec_")})
+        fields = {k[3:]: data[k] for k in data.files if k.startswith("ec_")}
+        # additive-field compatibility: version-1 checkpoints written before
+        # gc_mask existed load with the conservative default (all-static
+        # allocatable — exactly their behavior when saved)
+        if "gc_mask" not in fields:
+            fields["gc_mask"] = np.zeros((fields["alloc"].shape[1],), dtype=bool)
+        ec = EncodedCluster(**fields)
         st = ScanState(**{k[3:]: data[k] for k in data.files if k.startswith("st_")})
     return ec, st, meta.get("extra", {})

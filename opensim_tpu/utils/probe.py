@@ -15,9 +15,21 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
-_PROBE_CACHE = os.environ.get("OPENSIM_PROBE_CACHE", "/tmp/opensim-tpu-probe")
+
+def _default_cache_path() -> str:
+    """Per-user verdict cache. A world-shared fixed path would let another
+    user's file pin a stale verdict (or hold the name so os.replace fails
+    forever); scoping by uid inside XDG_RUNTIME_DIR (itself per-user) or the
+    tmpdir avoids both."""
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    base = os.environ.get("XDG_RUNTIME_DIR") or tempfile.gettempdir()
+    return os.path.join(base, f"opensim-tpu-probe-{uid}")
+
+
+_PROBE_CACHE = os.environ.get("OPENSIM_PROBE_CACHE") or _default_cache_path()
 _PROBE_TTL_S = 600
 
 
@@ -34,7 +46,8 @@ def accelerator_reachable(timeout_s: int = 90, fresh: bool = False) -> bool:
     if not fresh:
         try:
             st = os.stat(_PROBE_CACHE)
-            if time.time() - st.st_mtime < _PROBE_TTL_S:
+            owned = not hasattr(os, "getuid") or st.st_uid == os.getuid()
+            if owned and time.time() - st.st_mtime < _PROBE_TTL_S:
                 with open(_PROBE_CACHE) as f:
                     return f.read().strip() == "ok"
         except OSError:

@@ -1,0 +1,103 @@
+"""The measured serial baseline (tools/serial_baseline.py) must agree with
+the engines and the independent kube oracle: same scheduled/unscheduled
+structure as the XLA scan, and every serial decision accepted by the
+oracle. This guards the baseline's incremental memoization (CarrierCounts/
+MatchCounts/NodeInfo) against drift from the recompute-from-scratch oracle
+semantics — a wrong baseline would corrupt every speedup claim built on it."""
+
+import os
+import random
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from tools.serial_baseline import run_serial  # noqa: E402
+
+from opensim_tpu.engine.scheduler import pad_pod_stream, schedule_pods  # noqa: E402
+from opensim_tpu.engine.simulator import AppResource, prepare  # noqa: E402
+
+from test_k8s_oracle import (  # noqa: E402
+    ExtOracle,
+    Oracle,
+    _replay_with_scores,
+    ext_app,
+    ext_cluster,
+    random_app,
+    random_cluster,
+)
+
+
+@pytest.mark.parametrize("seed", [3, 17, 29, 61, 97])
+def test_serial_baseline_matches_oracle_and_engine(seed):
+    rng = random.Random(seed)
+    cluster = random_cluster(rng, rng.randrange(4, 10))
+    app = random_app(rng, rng.randrange(3, 7))
+    apps = [AppResource("oracle", app)]
+
+    scheduled, unscheduled, _es, _ss, chosen = run_serial(cluster, apps)
+
+    # oracle replay: every serial bind feasible, every failure total
+    prep = prepare(cluster, apps, node_pad=8)
+    if prep is None:
+        pytest.skip("empty workload")
+    oracle = Oracle(cluster.nodes)
+    for pod, name in zip(prep.ordered, chosen):
+        if name is not None:
+            node = oracle.by_name[name]
+            assert oracle.feasible(pod, node), (
+                f"seed={seed}: serial bound {pod.metadata.name} to {name}, "
+                "oracle says infeasible"
+            )
+            oracle.bind(pod, node)
+        else:
+            feas = [n.metadata.name for n in cluster.nodes if oracle.feasible(pod, n)]
+            assert not feas, (
+                f"seed={seed}: serial left {pod.metadata.name} unscheduled "
+                f"but {feas} are feasible"
+            )
+
+    # every serial bind must also be score-optimal per the score oracle
+    idx_of = {name: i for i, name in enumerate(prep.meta.node_names)}
+    serial_idx = np.array([idx_of[n] if n is not None else -1 for n in chosen])
+    assert _replay_with_scores(prep, cluster, serial_idx) == 0
+
+    # structural parity with the XLA scan
+    P = len(prep.ordered)
+    t, v, f = pad_pod_stream(prep.tmpl_ids, np.ones(P, bool), prep.forced)
+    out = schedule_pods(prep.ec, prep.st0, t, v, f, features=prep.features)
+    eng = np.asarray(out.chosen)[:P]
+    assert scheduled == int((eng >= 0).sum())
+    assert unscheduled == int((eng < 0).sum())
+
+
+@pytest.mark.parametrize("seed", [11, 42, 123, 777])
+def test_serial_baseline_matches_ext_oracle(seed):
+    """GPU-share (incl. the Reserve-updated gpu-count allocatable) and
+    open-local decisions replayed against the extension oracle."""
+    rng = random.Random(seed)
+    cluster = ext_cluster(rng, rng.randrange(3, 8))
+    app = ext_app(rng, rng.randrange(8, 25))
+    apps = [AppResource("ext", app)]
+    _s, _u, _es, _ss, chosen = run_serial(cluster, apps)
+
+    prep = prepare(cluster, apps, node_pad=8)
+    if prep is None:
+        pytest.skip("empty workload")
+    oracle = ExtOracle(cluster.nodes)
+    for pod, name in zip(prep.ordered, chosen):
+        if name is not None:
+            node = oracle.by_name[name]
+            assert oracle.feasible(pod, node), (
+                f"seed={seed}: serial bound {pod.metadata.name} to {name}, "
+                f"ext oracle says infeasible (gpu={oracle.gpu_ok(pod, node)} "
+                f"local={oracle.local_ok(pod, node)})"
+            )
+            oracle.bind(pod, node)
+        else:
+            feas = [n.metadata.name for n in cluster.nodes if oracle.feasible(pod, n)]
+            assert not feas, (
+                f"seed={seed}: serial left {pod.metadata.name} unscheduled "
+                f"but {feas} are feasible"
+            )

@@ -69,6 +69,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     apply_p.add_argument("--max-new-nodes", type=int, default=128, help="upper bound for the node sweep")
     apply_p.add_argument("--report-pods", action="store_true", help="include the per-node Pod Info table")
+    apply_p.add_argument(
+        "--tie-break", default="lowest", metavar="lowest|sample[:seed]",
+        help="equal-score node selection: deterministic lowest index "
+        "(default) or the reference's sampled tie-break, seeded for "
+        "reproducible distribution-comparison runs (forces the XLA scan)",
+    )
 
     defrag_p = sub.add_parser(
         "defrag",
@@ -139,6 +145,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             extended_resources=[r for r in args.extended_resources.split(",") if r],
             report_pods=args.report_pods,
             max_new_nodes=args.max_new_nodes,
+            tie_break=args.tie_break,
         )
         try:
             return Applier(opts).run()

@@ -110,6 +110,12 @@ class EncodedCluster(NamedTuple):
     node_vg_cap: np.ndarray  # [N, Vg] f32 volume-group capacities
     node_dev_cap: np.ndarray  # [N, Dv] f32 device capacities
     node_dev_media: np.ndarray  # [N, Dv] i32 0=ssd 1=hdd (-1 pad)
+    # log(k+2) lookup over possible per-key domain counts (k = 0..N): the
+    # topology-spread normalizing weight is a GATHER from this table in
+    # every engine, so the XLA scan, the numpy precompute (native path) and
+    # the sweeps produce bitwise-identical weights — XLA:CPU's f32 log and
+    # numpy's differ by 1 ulp on ~3% of inputs, enough to flip score ties.
+    log_sizes: np.ndarray  # [N+1] f32
 
 
 class ScanState(NamedTuple):
@@ -591,6 +597,9 @@ class ClusterEncoder:
             node_vg_cap=node_vg_cap,
             node_dev_cap=node_dev_cap,
             node_dev_media=node_dev_media,
+            log_sizes=np.log(np.arange(N + 1, dtype=np.float64) + 2.0).astype(
+                np.float32
+            ),
         )
 
         state0 = ScanState(

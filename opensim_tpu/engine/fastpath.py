@@ -443,16 +443,19 @@ class _SweepContext:
         self.node_domain = np.asarray(ec.node_domain)
         self.trash = np.asarray(ec.domain_topo).shape[0] - 1
         self.spr_topo = np.asarray(ec.spr_topo)
+        self.log_sizes = np.asarray(ec.log_sizes)
 
     def spread_weights(self, node_valid: np.ndarray) -> np.ndarray:
         """[U, Cs] log(size+2) table for a scenario's valid-node subset
-        (domain counts are valid-set dependent)."""
+        (domain counts are valid-set dependent). Weights come from the
+        shared ec.log_sizes lookup so they are bitwise-identical to every
+        other engine's."""
         Tk = self.node_domain.shape[1]
-        sizes = np.zeros((Tk,), np.float64)
+        sizes = np.zeros((Tk,), np.int64)
         for tk in range(Tk):
             doms = self.node_domain[node_valid, tk]
             sizes[tk] = len(np.unique(doms[doms != self.trash]))
-        weights = np.log(sizes + 2.0).astype(np.float32)
+        weights = self.log_sizes[np.clip(sizes, 0, self.log_sizes.shape[0] - 1)]
         return np.where(
             self.spr_topo >= 0, weights[np.maximum(self.spr_topo, 0)], 0.0
         ).astype(np.float32)

@@ -45,6 +45,10 @@ def _warn_native_unavailable() -> None:
 def applicable(prep, config=None, extra_plugins: tuple = ()) -> bool:
     if extra_plugins:
         return False
+    if config is not None and getattr(config, "fit_ignored_cols", ()):
+        # NodeResourcesFitArgs ignored columns are an XLA-scan feature; the
+        # C++ fit loop has no per-column skip (rare config — not worth ABI)
+        return False
     if os.environ.get("OPENSIM_DISABLE_NATIVE"):
         return False
     from .. import native
@@ -60,30 +64,30 @@ def applicable(prep, config=None, extra_plugins: tuple = ()) -> bool:
     return native.available()
 
 
-@functools.lru_cache(maxsize=None)
-def _cfg_precompute_jit():
-    import jax
+def _stat_np(prep, config, node_valid=None):
+    """Static tables via the numpy mirror (kernels.precompute_static_np):
+    bitwise-equal to the jitted tables with ZERO XLA compiles, keeping
+    `--backend native` ms-scale cold. `node_valid` overrides the encoder's
+    mask — only the valid-set-dependent fold (static_pass, static_fail,
+    spread weights) recomputes per scenario; the expensive per-template
+    core is computed once per Prepared and cached on it."""
+    ec = prep.ec_np
+    core = getattr(prep, "_np_core", None)
+    if core is None:
+        core = kernels.precompute_core_np(ec)
+        try:
+            prep._np_core = core
+        except AttributeError:
+            pass
+    if node_valid is not None:
+        ec = ec._replace(node_valid=np.ascontiguousarray(node_valid, dtype=bool))
+    return kernels.precompute_static_np(ec, config, core=core)
 
-    return jax.jit(kernels.precompute_static, static_argnums=(1,))
 
-
-def _stat_np(prep, config):
-    """Static tables as numpy (one jitted precompute; the jit wrapper is a
-    module singleton so its compile cache persists across server requests)."""
-    import jax
-
-    from .fastpath import _precompute_jit
-
-    if config is None or config == DEFAULT_CONFIG:
-        stat = _precompute_jit(prep.ec)
-    else:
-        stat = _cfg_precompute_jit()(prep.ec, config)
-    return jax.tree_util.tree_map(np.asarray, jax.device_get(stat))
-
-
-def schedule(prep, pod_valid: np.ndarray, config=None):
+def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=None):
     """Run the whole pod stream through the C++ engine. Returns a
-    ``ScheduleOutput`` (numpy arrays throughout)."""
+    ``ScheduleOutput`` (numpy arrays throughout). `node_valid`/`forced`
+    override the prepared masks (scenario sweeps)."""
     from .. import native
     from .scheduler import ScheduleOutput
 
@@ -91,7 +95,9 @@ def schedule(prep, pod_valid: np.ndarray, config=None):
     ec = prep.ec_np
     st0 = prep.st0
     feat = prep.features
-    stat = _stat_np(prep, config)
+    stat = _stat_np(prep, config, node_valid=node_valid)
+    node_valid_arr = ec.node_valid if node_valid is None else node_valid
+    forced_arr = prep.forced if forced is None else forced
 
     def f32(x):
         return np.ascontiguousarray(x, dtype=np.float32)
@@ -152,7 +158,7 @@ def schedule(prep, pod_valid: np.ndarray, config=None):
         "w_local",
     )}
     buffers = {
-        "node_valid": u8(ec.node_valid), "alloc": f32(ec.alloc),
+        "node_valid": u8(node_valid_arr), "alloc": f32(ec.alloc),
         "node_domain": i32(ec.node_domain), "domain_topo": i32(ec.domain_topo),
         "req": f32(ec.req), "ports": i32(ec.ports),
         "port_conflict": u8(ec.port_conflict),
@@ -176,7 +182,7 @@ def schedule(prep, pod_valid: np.ndarray, config=None):
         "static_pass": u8(stat.static_pass), "aff_mask": u8(stat.aff_mask),
         "na_raw": f32(stat.na_raw), "tt_raw": f32(stat.tt_raw),
         "share_raw": f32(stat.share_raw), "spread_weight": f32(stat.spread_weight),
-        "tmpl_ids": i32(prep.tmpl_ids), "forced": u8(prep.forced),
+        "tmpl_ids": i32(prep.tmpl_ids), "forced": u8(forced_arr),
         "pod_valid": u8(pod_valid),
         **state,
         **outputs,
@@ -191,3 +197,31 @@ def schedule(prep, pod_valid: np.ndarray, config=None):
         static_fail=np.asarray(stat.static_fail),
         final_state=ScanState(**state),
     )
+
+
+def sweep(prep, node_valid_masks, pod_valid_masks, forced_masks, config=None):
+    """Scenario sweep on the C++ engine: one sequential scan per scenario
+    — the accelerator-less counterpart of the batched Pallas/XLA sweeps, so
+    `simon apply`/`simon defrag` under --backend native never touch an XLA
+    scan compile (the reference's capacity loop is ms-scale serial re-runs,
+    apply.go:203-259). Returns (unscheduled [S], used [S,N,R], chosen
+    [S,P], vg_used [S]) matching parallel.scenarios.SweepResult."""
+    S = node_valid_masks.shape[0]
+    vg0 = np.asarray(prep.st0.vg_free)
+    unscheduled = np.zeros((S,), np.int32)
+    used, chosen, vg_used = [], [], np.zeros((S,), np.float32)
+    for s in range(S):
+        nv = np.asarray(node_valid_masks[s], bool)
+        pv = np.asarray(pod_valid_masks[s], bool)
+        out = schedule(
+            prep, pv, config=config, node_valid=nv,
+            forced=np.asarray(forced_masks[s], bool),
+        )
+        ch = np.asarray(out.chosen)
+        chosen.append(ch)
+        unscheduled[s] = int((pv & (ch < 0)).sum())
+        used.append(np.asarray(out.final_state.used))
+        vg_used[s] = float(
+            ((vg0 - np.asarray(out.final_state.vg_free)) * nv[:, None]).sum()
+        )
+    return unscheduled, np.stack(used), np.stack(chosen), vg_used

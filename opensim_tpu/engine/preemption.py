@@ -40,15 +40,18 @@ Modeled dimensions:
   re-placed on the lowest-index feasible node when capacity exists
   elsewhere, mirroring a nominated pod re-entering the scheduling queue.
 
+Required inter-pod affinity/anti-affinity and hard topology spread are
+re-evaluated against the post-eviction placement (``_TermChecker`` — the
+object-level analogue of ``selectVictimsOnNode`` re-running the filter
+plugins after ``RemovePod``), for the preemptor, during the reprieve loop,
+and for cascade re-placements; selector-matched pods are eligible victims
+(kube's ``IgnoredDuringExecution``: evicting an affinity anchor never
+re-validates other already-bound pods — exactly the reference's behavior).
+
 Remaining documented simplifications:
-- preemptors carrying required inter-pod terms or hard spread constraints
-  are skipped, as are preemptors matched by an existing pod's global
-  anti-affinity term (placing one would retroactively violate the
-  symmetric check);
-- when inter-pod/spread selectors exist anywhere in the workload, pods
-  matched by any selector are never victims (another placement may depend
-  on them as an affinity anchor or domain count);
-- force-bound (pre-existing) pods are never victims.
+- force-bound (pre-existing) pods are never victims;
+- preferred (soft) terms do not influence which candidate node wins beyond
+  ``pickOneNodeForPreemption``'s ladder (kube likewise does not re-score).
 
 Off by default: ``simulate(..., enable_preemption=True)`` or
 ``simon apply --enable-preemption``. DECISION (r3): this stays opt-in —
@@ -247,10 +250,17 @@ def _replay_storage(st: "_State", prep, chosen, tmpl) -> bool:
 
 
 def _pdb_budgets(pdbs, ordered, chosen) -> List[dict]:
-    """Derive each PDB's DisruptionsAllowed from its spec and the bound
-    matching pods (the simulator has no disruption-status controller;
-    every bound pod counts healthy). Nil/empty selectors match nothing
-    (``filterPodsWithPDBViolation``, default_preemption.go:736-775)."""
+    """Derive each PDB's DisruptionsAllowed from its spec, the bound
+    matching pods (healthy — the simulator has no disruption-status
+    controller, every bound pod counts healthy) and the EXPECTED count —
+    the owning workloads' declared replicas, kube's ``GetExpectedPodCount``
+    (disruption controller): the expansion creates exactly
+    ``spec.replicas`` stream pods per workload, so the expected count is
+    the number of stream pods (bound or not) sharing the matching pods'
+    controllers, plus matching bare pods. minAvailable 50% with 4 desired
+    but only 2 bound therefore allows 0 disruptions, not 1. Nil/empty
+    selectors match nothing (``filterPodsWithPDBViolation``,
+    default_preemption.go:736-775)."""
     import math
 
     out = []
@@ -262,24 +272,45 @@ def _pdb_budgets(pdbs, ordered, chosen) -> List[dict]:
         sel = spec.get("selector") or {}
         if not sel.get("matchLabels") and not sel.get("matchExpressions"):
             continue
-        healthy = sum(
-            1
+        matching = [
+            (j, p)
             for j, p in enumerate(ordered)
-            if int(chosen[j]) >= 0
-            and p.metadata.namespace == ns
+            if p.metadata.namespace == ns
             and p.metadata.labels
             and selectors.match_label_selector(sel, p.metadata.labels)
-        )
+        ]
+        healthy = sum(1 for j, _p in matching if int(chosen[j]) >= 0)
+        # expected: every stream pod owned by a controller that owns at
+        # least one matching pod (the stream holds exactly the declared
+        # replica set), plus matching controller-less pods
+        owners = set()
+        expected = 0
+        for _j, p in matching:
+            ctrl = next(
+                (r.uid for r in p.metadata.owner_references if r.controller), None
+            )
+            if ctrl is None:
+                expected += 1
+            else:
+                owners.add((p.metadata.namespace, ctrl))
+        for p in ordered:
+            ctrl = next(
+                (r.uid for r in p.metadata.owner_references if r.controller), None
+            )
+            if ctrl is not None and (p.metadata.namespace, ctrl) in owners:
+                expected += 1
 
-        def _val(v, expected):
+        def _val(v, basis):
             if isinstance(v, str) and v.strip().endswith("%"):
-                return int(math.ceil(float(v.strip()[:-1]) / 100.0 * expected))
+                return int(math.ceil(float(v.strip()[:-1]) / 100.0 * basis))
             return int(v)
 
         if spec.get("minAvailable") is not None:
-            allowed = healthy - _val(spec["minAvailable"], healthy)
+            # desiredHealthy = minAvailable (int) or ceil(pct·expected)
+            allowed = healthy - _val(spec["minAvailable"], expected)
         elif spec.get("maxUnavailable") is not None:
-            allowed = _val(spec["maxUnavailable"], healthy)
+            # desiredHealthy = expected − maxUnavailable (int or pct·expected)
+            allowed = healthy - (expected - _val(spec["maxUnavailable"], expected))
         else:
             continue
         out.append({"ns": ns, "sel": sel, "allowed": max(int(allowed), 0)})
@@ -292,6 +323,147 @@ def _pdb_matches(pdb: dict, pod: Pod) -> bool:
         and bool(pod.metadata.labels)
         and selectors.match_label_selector(pdb["sel"], pod.metadata.labels)
     )
+
+
+def _aff_terms(pod: Pod, kind: str, mode: str):
+    aff = (pod.spec.affinity or {}).get(kind) or {}
+    return aff.get(f"{mode}DuringSchedulingIgnoredDuringExecution") or []
+
+
+class _TermChecker:
+    """Post-eviction required inter-pod-affinity / hard-spread feasibility
+    for one preemptor on one node — the object-level equivalent of
+    ``selectVictimsOnNode`` re-running the filter plugins after ``RemovePod``
+    (vendored ``default_preemption.go`` → ``RunFilterPluginsWithNominatedPods``).
+    Counts are recomputed from the live placement (``ordered`` + ``chosen``)
+    at query time, with the hypothetical victim set excluded, so eviction
+    effects — an anti-affinity blocker leaving, an affinity anchor leaving,
+    a spread domain emptying — are all modeled. kube's
+    ``IgnoredDuringExecution`` semantics apply throughout: evicting an
+    anchor never re-validates other already-bound pods."""
+
+    def __init__(self, ordered: List[Pod], nodes: List[Node]):
+        self.ordered = ordered
+        self.nodes = nodes
+        self._eligible: Dict[tuple, frozenset] = {}
+
+    def _bound(self, chosen, evicted):
+        for j, p in enumerate(self.ordered):
+            n = int(chosen[j])
+            if n >= 0 and j not in evicted:
+                yield p, self.nodes[n]
+
+    def _eligible_vals(self, pod: Pod, key: str) -> frozenset:
+        import json as _json
+
+        sig = (
+            tuple(sorted(pod.spec.node_selector.items())),
+            _json.dumps((pod.spec.affinity or {}).get("nodeAffinity"), sort_keys=True),
+            key,
+        )
+        vals = self._eligible.get(sig)
+        if vals is None:
+            vals = frozenset(
+                n.metadata.labels[key]
+                for n in self.nodes
+                if key in n.metadata.labels
+                and selectors.pod_matches_node_selector_and_affinity(pod, n)
+            )
+            self._eligible[sig] = vals
+        return vals
+
+    def ok(self, i: int, n_idx: int, chosen, evicted) -> bool:
+        pod = self.ordered[i]
+        node = self.nodes[n_idx]
+        ns = pod.metadata.namespace
+        bound = list(self._bound(chosen, evicted))
+
+        # (1) existing pods' required anti-affinity vs the preemptor
+        for p, pn in bound:
+            for term in _aff_terms(p, "podAntiAffinity", "required"):
+                if not selectors.affinity_term_matches_pod(
+                    term, p.metadata.namespace, pod
+                ):
+                    continue
+                key = term.get("topologyKey", "")
+                val = pn.metadata.labels.get(key)
+                if val is not None and node.metadata.labels.get(key) == val:
+                    return False
+        # (2) the preemptor's required anti-affinity
+        for term in _aff_terms(pod, "podAntiAffinity", "required"):
+            key = term.get("topologyKey", "")
+            my = node.metadata.labels.get(key)
+            if my is None:
+                continue
+            for p, pn in bound:
+                if pn.metadata.labels.get(key) == my and (
+                    selectors.affinity_term_matches_pod(term, ns, p)
+                ):
+                    return False
+        # (3) the preemptor's required affinity (+ first-pod bootstrap)
+        terms = _aff_terms(pod, "podAffinity", "required")
+        if terms:
+            matching = [
+                (p, pn)
+                for p, pn in bound
+                if all(selectors.affinity_term_matches_pod(t, ns, p) for t in terms)
+            ]
+            labels_ok = all(
+                node.metadata.labels.get(t.get("topologyKey", "")) is not None
+                for t in terms
+            )
+            per_term_ok = labels_ok and all(
+                any(
+                    pn.metadata.labels.get(t.get("topologyKey", ""))
+                    == node.metadata.labels.get(t.get("topologyKey", ""))
+                    for _p, pn in matching
+                    if pn.metadata.labels.get(t.get("topologyKey", "")) is not None
+                )
+                for t in terms
+            )
+            if not per_term_ok:
+                map_empty = not any(
+                    pn.metadata.labels.get(t.get("topologyKey", "")) is not None
+                    for _p, pn in matching
+                    for t in terms
+                )
+                self_match = all(
+                    selectors.affinity_term_matches_pod(t, ns, pod) for t in terms
+                )
+                if not (labels_ok and map_empty and self_match):
+                    return False
+        # (4) hard topology-spread constraints
+        for c in pod.spec.topology_spread_constraints:
+            if c.get("whenUnsatisfiable", "DoNotSchedule") != "DoNotSchedule":
+                continue
+            key = c.get("topologyKey", "")
+            my = node.metadata.labels.get(key)
+            if my is None:
+                return False
+            sel = c.get("labelSelector")
+            counts: Dict[str, int] = {}
+            for p, pn in bound:
+                val = pn.metadata.labels.get(key)
+                if (
+                    val is not None
+                    and p.metadata.namespace == ns
+                    and sel is not None
+                    and selectors.match_label_selector(sel, p.metadata.labels)
+                ):
+                    counts[val] = counts.get(val, 0) + 1
+            elig = self._eligible_vals(pod, key)
+            if not elig:
+                return False
+            min_cnt = min(counts.get(v, 0) for v in elig)
+            self_match = (
+                1
+                if sel is not None
+                and selectors.match_label_selector(sel, pod.metadata.labels)
+                else 0
+            )
+            if counts.get(my, 0) + self_match - min_cnt > int(c.get("maxSkew", 1)):
+                return False
+        return True
 
 
 # MaxInt32+1, added per victim INSIDE the summed-priority criterion — kube
@@ -315,6 +487,7 @@ def preempt_pass(
     dev_free: Optional[np.ndarray] = None,
     gpu_take: Optional[np.ndarray] = None,
     pdbs: tuple = (),
+    eligible: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, Dict[int, int]]:
     """Attempt preemption for every unscheduled, positive-priority pod in
     stream order, then re-place evicted victims where capacity exists.
@@ -351,31 +524,12 @@ def preempt_pass(
         gpu_take = np.zeros((len(ordered), gpu_free.shape[1]), np.float32)
     st = _State(ec, used, alloc, port_used, gpu_free, vg_free, dev_free, gpu_take)
 
-    at_sel = np.asarray(ec.at_sel)
-    an_sel = np.asarray(ec.an_sel)
-    spr_topo = np.asarray(ec.spr_topo)
-    spr_hard = np.asarray(ec.spr_hard)
     gpu_mem = np.asarray(ec.gpu_mem)
     lvm_req = np.asarray(ec.lvm_req)
     dev_req = np.asarray(ec.dev_req)
-    matches_sel = np.asarray(ec.matches_sel)
-    # only anti-affinity terms some template actually carries can be
-    # violated (the encoder keeps a dummy row at G=0 when none exist)
-    carried_g = np.asarray(ec.anti_g).any(axis=0)
-    anti_g_sel = np.asarray(ec.anti_g_sel)[carried_g]
-    sel_features = bool(prep.features.sel_counts)
-
-    def constrained(u: int) -> bool:
-        # constraints whose post-eviction state this pass does not model:
-        # the preemptor's own required inter-pod terms and hard spread, and
-        # being the target of an existing pod's global anti-affinity term
-        if (at_sel[u] >= 0).any() or (an_sel[u] >= 0).any():
-            return True
-        if ((spr_topo[u] >= 0) & spr_hard[u]).any():
-            return True
-        if anti_g_sel.size and matches_sel[u, anti_g_sel].any():
-            return True
-        return False
+    # object-level interpod/spread re-evaluation against the post-eviction
+    # placement (selectVictimsOnNode's RemovePod → filter re-run)
+    checker = _TermChecker(ordered, nodes)
 
     # recover per-pod storage allocations by replay; when the replay cannot
     # reproduce the engine's final state, storage holders stay non-victims
@@ -389,11 +543,14 @@ def preempt_pass(
     allowed = [pdb["allowed"] for pdb in pdb_list]
 
     def victim_ok(u: int) -> bool:
-        # selector-matched pods may anchor other placements; storage holders
-        # are only evictable when their allocation was recovered exactly
+        # storage holders are only evictable when their allocation was
+        # recovered exactly. Selector-matched pods ARE evictable (r4: the
+        # checker recomputes domain counts from the live placement, and
+        # kube's IgnoredDuringExecution never re-validates bound pods that
+        # depended on an evicted anchor)
         if not storage_replay_ok and (lvm_req[u] > 0 or (dev_req[u] > 0).any()):
             return False
-        return not (sel_features and matches_sel[u].any())
+        return True
 
     # dynamic gpu-count allocatable (kernels.gc_dynamic_alloc — the gpushare
     # Reserve rewrite): on device-bearing nodes the gc column's effective
@@ -456,9 +613,11 @@ def preempt_pass(
     for i in range(len(ordered)):
         if chosen[i] >= 0 or forced[i] or prio[i] <= 0:
             continue
-        u = int(tmpl[i])
-        if constrained(u):
+        if eligible is not None and not eligible[i]:
+            # pods outside every scheduler profile never enter a queue —
+            # they cannot preempt either (simulate passes pod_valid here)
             continue
+        u = int(tmpl[i])
         # (numPDBViolations, highest victim prio, Σ(prio+2^31), n victims,
         # node index, victims) — pickOneNodeForPreemption's ladder; the
         # pod-start-time criterion collapses onto stream order
@@ -467,6 +626,11 @@ def preempt_pass(
             if not _static_ok(ordered[i], nodes[n]):
                 continue
             cand = [j for j in by_node.get(n, []) if prio[j] < prio[i]]
+            if not cand:
+                # selectVictimsOnNode returns early when there are no
+                # potential victims (default_preemption.go:582-585): a
+                # zero-victim node is NOT a preemption candidate
+                continue
             free = alloc[n] - used[n]
             # selectVictimsOnNode: remove ALL lower-priority pods first
             freed_res = np.zeros_like(free)
@@ -478,6 +642,8 @@ def preempt_pass(
                 free_of(j, n, freed_res, freed_ports, freed_gpu, vg_hyp, dev_hyp, +1)
             if not fits(u, n, free, freed_res, freed_ports, freed_gpu, vg_hyp, dev_hyp):
                 continue  # even evicting every candidate is not enough
+            if not checker.ok(i, n, chosen, set(cand)):
+                continue  # interpod/spread still violated with all evicted
             # MoreImportantPod order: higher priority first, then stream
             # order (our stand-in for pod start time)
             cand_sorted = sorted(cand, key=lambda j: (-prio[j], j))
@@ -496,7 +662,9 @@ def preempt_pass(
             victims = set(cand)
             for j in violating + nonviolating:
                 free_of(j, n, freed_res, freed_ports, freed_gpu, vg_hyp, dev_hyp, -1)
-                if fits(u, n, free, freed_res, freed_ports, freed_gpu, vg_hyp, dev_hyp):
+                if fits(
+                    u, n, free, freed_res, freed_ports, freed_gpu, vg_hyp, dev_hyp
+                ) and checker.ok(i, n, chosen, victims - {j}):
                     victims.discard(j)  # reprieved: stays bound
                 else:
                     free_of(j, n, freed_res, freed_ports, freed_gpu, vg_hyp, dev_hyp, +1)
@@ -533,8 +701,6 @@ def preempt_pass(
     # through the queue); no further eviction is triggered
     for j in sorted(victims_of):
         ju = int(tmpl[j])
-        if constrained(ju):
-            continue  # its inter-pod/spread feasibility cannot be re-checked here
         for n in range(n_real):
             if not _static_ok(ordered[j], nodes[n]):
                 continue
@@ -542,6 +708,8 @@ def preempt_pass(
             if not fits(ju, n, free, 0.0, np.zeros((st.Hports,), np.float32),
                         np.zeros_like(gpu_free[n])):
                 continue
+            if not checker.ok(j, n, chosen, set()):
+                continue  # re-placement must satisfy interpod/spread too
             gpu_alloc = st.gpu_fit(ju, n, np.zeros_like(gpu_free[n]))
             st.place(ju, j, n, gpu_alloc)
             chosen[j] = n

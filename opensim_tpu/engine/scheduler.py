@@ -10,7 +10,11 @@ no channels, no goroutines, no fake apiserver.
 
 Determinism note: the reference tie-breaks equal-score nodes by reservoir
 sampling (``generic_scheduler.go:188-210``, nondeterministic); we take the
-lowest node index. Structural results (counts, feasibility) are identical.
+lowest node index by default. Structural results (counts, feasibility) are
+identical. The opt-in ``tie_seed`` (CLI ``--tie-break=sample[:seed]``)
+reproduces the reference's sampled distribution — seeded and reproducible —
+for distribution-level comparison runs; it forces the XLA scan (the
+megakernel and C++ engines stay lowest-index).
 """
 
 from __future__ import annotations
@@ -52,7 +56,7 @@ class ScheduleOutput(NamedTuple):
     final_state: ScanState
 
 
-def _step(ec: EncodedCluster, stat, feat, cfg, extra, st: ScanState, x):
+def _step(ec: EncodedCluster, stat, feat, cfg, extra, st: ScanState, x, select_key=None):
     u, pod_valid, forced = x
     # Pre-bound pods (spec.nodeName set) bypass the scheduler in the
     # reference (simulator.go:329-331 only waits for unbound pods): they
@@ -64,7 +68,19 @@ def _step(ec: EncodedCluster, stat, feat, cfg, extra, st: ScanState, x):
 
     def run_pipeline(_):
         res = kernels.pod_step(ec, stat, st, u, feat, cfg, extra)
-        return res.chosen, res.fail_counts, res.insufficient
+        if select_key is None:
+            return res.chosen, res.fail_counts, res.insufficient
+        # --tie-break=sample: uniform choice among the score maxima — the
+        # distribution of selectHost's reservoir sampling
+        # (generic_scheduler.go:188-210) instead of the deterministic
+        # lowest-index default
+        neg = jnp.float32(-1e30)
+        masked = jnp.where(res.feasible, res.score, neg)
+        eq = res.feasible & (masked == jnp.max(masked))
+        r = jax.random.uniform(select_key, masked.shape)
+        pick = jnp.argmax(jnp.where(eq, r, -1.0)).astype(jnp.int32)
+        chosen = jnp.where(jnp.any(res.feasible), pick, jnp.int32(-1))
+        return chosen, res.fail_counts, res.insufficient
 
     def skip_pipeline(_):
         return (
@@ -83,7 +99,9 @@ def _step(ec: EncodedCluster, stat, feat, cfg, extra, st: ScanState, x):
     return st_next, (chosen, fail_counts, insufficient, gpu_take)
 
 
-@functools.partial(jax.jit, static_argnames=("features", "config", "extra_plugins", "unroll"))
+@functools.partial(
+    jax.jit, static_argnames=("features", "config", "extra_plugins", "unroll", "tie_seed")
+)
 def schedule_pods(
     ec: EncodedCluster,
     st0: ScanState,
@@ -94,20 +112,37 @@ def schedule_pods(
     config=None,
     extra_plugins: tuple = (),
     unroll: int = 1,
+    tie_seed=None,
 ):
     """Run the bind scan. tmpl_ids [P] i32, pod_valid/forced [P] bool.
 
     Static per-(template, node) filter/score tables are computed once up
     front; the scan body only evaluates usage-dependent kernels the
-    workload's `features` actually exercise."""
+    workload's `features` actually exercise. `tie_seed` (an int) switches
+    selectHost to the reference's sampled tie-break: a PRNG key rides the
+    scan carry and every step draws uniformly over its score maxima."""
     from .schedconfig import DEFAULT_CONFIG
 
     config = config or DEFAULT_CONFIG
     stat = kernels.precompute_static(ec, config)
-    step = functools.partial(_step, ec, stat, features, config, extra_plugins)
-    final_state, (chosen, fail_counts, insufficient, gpu_take) = jax.lax.scan(
-        step, st0, (tmpl_ids, pod_valid, forced), unroll=unroll
-    )
+    if tie_seed is None:
+        step = functools.partial(_step, ec, stat, features, config, extra_plugins)
+        final_state, (chosen, fail_counts, insufficient, gpu_take) = jax.lax.scan(
+            step, st0, (tmpl_ids, pod_valid, forced), unroll=unroll
+        )
+    else:
+        def step(carry, x):
+            st, key = carry
+            key, sub = jax.random.split(key)
+            st_next, out = _step(
+                ec, stat, features, config, extra_plugins, st, x, select_key=sub
+            )
+            return (st_next, key), out
+
+        (final_state, _), (chosen, fail_counts, insufficient, gpu_take) = jax.lax.scan(
+            step, (st0, jax.random.PRNGKey(int(tie_seed))),
+            (tmpl_ids, pod_valid, forced), unroll=unroll,
+        )
     return ScheduleOutput(
         chosen=chosen,
         fail_counts=fail_counts,

@@ -369,6 +369,21 @@ def prepare(
     )
 
 
+def parse_tie_break(spec: str):
+    """CLI ``--tie-break`` value → tie_seed (None = deterministic default).
+    Accepted: ``sample`` (seed 0) or ``sample:<int>``."""
+    if not spec or spec == "lowest":
+        return None
+    if spec == "sample":
+        return 0
+    if spec.startswith("sample:"):
+        try:
+            return int(spec.split(":", 1)[1])
+        except ValueError:
+            pass
+    raise ValueError(f"--tie-break must be 'lowest' or 'sample[:seed]', got {spec!r}")
+
+
 def simulate(
     cluster: ResourceTypes,
     apps: List[AppResource],
@@ -378,6 +393,7 @@ def simulate(
     patch_pods_fn=None,
     extra_plugins: tuple = (),
     enable_preemption: bool = False,
+    tie_seed: Optional[int] = None,
 ) -> SimulateResult:
     """One full simulation: cluster pods then apps in order. `sched_config`
     is an optional SchedulerConfig (the --default-scheduler-config merge);
@@ -402,8 +418,35 @@ def simulate(
         ordered, tmpl_ids, forced = prep.ordered, prep.tmpl_ids, prep.forced
 
         pod_valid = np.ones((len(ordered),), dtype=bool)
+        # multi-profile KubeSchedulerConfiguration: route the stream onto one
+        # effective config; pods naming an unknown profile never enter any
+        # scheduling queue (kube event-handler filtering) and are reported
+        # unschedulable with an explicit reason. Force-bound pods bypass the
+        # scheduler entirely (simulator.go:329-331) — profiles don't apply.
+        custom_reasons: Dict[int, str] = {}
+        if sched_config is not None:
+            from .schedconfig import DEFAULT_CONFIG, resolve_profiles
+
+            sched_config, custom_reasons = resolve_profiles(
+                sched_config, ordered, meta.resource_names, forced=forced
+            )
+            for i in custom_reasons:
+                pod_valid[i] = False
+            if sched_config == DEFAULT_CONFIG:
+                sched_config = None  # fast-path eligible
         out = None
-        if sched_config is None and not extra_plugins:
+        # importing the megakernel module costs ~1 s of pallas Python-module
+        # compile — only pay it where it can actually run (TPU backend, or
+        # the tests' interpret mode); CPU hosts go straight to the C++ path
+        use_fastpath = sched_config is None and not extra_plugins and tie_seed is None
+        if use_fastpath:
+            import os as _os
+
+            use_fastpath = (
+                jax.default_backend() == "tpu"
+                or _os.environ.get("OPENSIM_FASTPATH") == "interpret"
+            )
+        if use_fastpath:
             from . import fastpath
 
             if fastpath.applicable(prep):
@@ -430,7 +473,7 @@ def simulate(
         if out is None:
             from . import nativepath
 
-            if nativepath.applicable(prep, sched_config, extra_plugins):
+            if tie_seed is None and nativepath.applicable(prep, sched_config, extra_plugins):
                 # C++ scan engine: identical placements to the XLA scan with
                 # exact in-stream failure attribution; the default on hosts
                 # without an accelerator (tests/test_native.py asserts parity).
@@ -440,7 +483,7 @@ def simulate(
             out = schedule_pods(
                 ec, st0, tmpl_p, valid_p, forced_p,
                 features=prep.features, config=sched_config, extra_plugins=extra_plugins,
-                unroll=scan_unroll(),
+                unroll=scan_unroll(), tie_seed=tie_seed,
             )
             jax.block_until_ready(out.chosen)  # dispatch is async; trace real device time
         tr.step(f"schedule {len(ordered)} pods")
@@ -475,7 +518,7 @@ def simulate(
         )
         chosen, victims_of = preemption.preempt_pass(
             prep, chosen, cluster.nodes, used, np.asarray(prep.ec_np.alloc),
-            gpu_take=gpu_take, pdbs=all_pdbs, **state,
+            gpu_take=gpu_take, pdbs=all_pdbs, eligible=pod_valid, **state,
         )
         out = out._replace(final_state=fs._replace(used=used, **state))
 
@@ -505,6 +548,8 @@ def simulate(
                 # timestamp in nanoseconds
                 pod.metadata.annotations[ANNO_GPU_ASSUME_TIME] = str(time.time_ns())
             pod_lists[c].append(pod)
+        elif i in custom_reasons:
+            unscheduled.append(UnscheduledPod(pod, custom_reasons[i]))
         elif i in victims_of:
             preemptor = ordered[victims_of[i]]
             unscheduled.append(

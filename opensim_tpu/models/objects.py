@@ -32,7 +32,10 @@ ENV_MAX_CPU = "MaxCPU"
 ENV_MAX_MEMORY = "MaxMemory"
 ENV_MAX_VG = "MaxVG"
 SEPARATE_SYMBOL = "-"
-DEFAULT_SCHEDULER_NAME = "simon-scheduler"
+# simontype.DefaultSchedulerName = corev1.DefaultSchedulerName
+# (pkg/type/const.go:12): the reference schedules with the DEFAULT
+# kube scheduler name, and MakeValidPod defaults pods to it
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
 LABEL_HOSTNAME = "kubernetes.io/hostname"
 
 # GPU-share annotation protocol — pkg/type/open-gpu-share/utils/const.go:4-8.

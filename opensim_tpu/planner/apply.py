@@ -73,6 +73,7 @@ class Options:
     extended_resources: List[str] = field(default_factory=list)
     report_pods: bool = False  # include the per-node Pod Info table
     max_new_nodes: int = 128  # sweep upper bound (auto mode)
+    tie_break: str = "lowest"  # lowest | sample[:seed] (see parse_tie_break)
     base_dir: str = ""  # paths in the config resolve relative to this
 
 
@@ -146,6 +147,12 @@ class Applier:
         base = opts.base_dir or os.path.dirname(os.path.abspath(opts.simon_config))
         self.base = base
         self.out: TextIO = sys.stdout
+        from ..engine.simulator import parse_tie_break
+
+        # sampled tie-break applies to the full simulations; the batched
+        # capacity sweep stays deterministic lowest-index (one packing per
+        # candidate count — like running the reference's loop once)
+        self.tie_seed = parse_tie_break(opts.tie_break)
         self.sched_config = None
         if opts.default_scheduler_config:
             from ..engine.schedconfig import load_scheduler_config
@@ -309,7 +316,7 @@ class Applier:
         with Spinner("schedule pods"):
             result = simulate(
                 cluster, apps, use_greed=self.opts.use_greed, sched_config=self.sched_config,
-                enable_preemption=self.opts.enable_preemption,
+                enable_preemption=self.opts.enable_preemption, tie_seed=self.tie_seed,
             )
         n_new = 0
         if result.unscheduled_pods or not satisfy_resource_setting(result)[0]:
@@ -333,6 +340,7 @@ class Applier:
                     use_greed=self.opts.use_greed,
                     sched_config=self.sched_config,
                     enable_preemption=self.opts.enable_preemption,
+                    tie_seed=self.tie_seed,
                 )
         print("Simulation success!", file=self.out)
         if n_new:
@@ -360,6 +368,7 @@ class Applier:
                     use_greed=self.opts.use_greed,
                     sched_config=self.sched_config,
                     enable_preemption=self.opts.enable_preemption,
+                    tie_seed=self.tie_seed,
                 )
             if result.unscheduled_pods:
                 print(

@@ -60,7 +60,7 @@ def test_deployment_expansion_names_and_owners():
         assert p.metadata.name.startswith("web-")
         assert p.metadata.owner_references[0].kind == "ReplicaSet"
         assert p.metadata.annotations[ANNO_WORKLOAD_KIND] == "ReplicaSet"
-        assert p.spec.scheduler_name == "simon-scheduler"
+        assert p.spec.scheduler_name == "default-scheduler"
     # All pods share one generated ReplicaSet owner.
     assert len({p.metadata.owner_references[0].name for p in pods}) == 1
 

@@ -219,3 +219,85 @@ def test_native_fuzz_vs_xla(seed):
     if prep is None:
         pytest.skip("empty workload")
     _assert_match(prep)
+
+
+def test_precompute_np_bitwise_matches_jit():
+    """The numpy static tables (native path, zero XLA compiles) must be
+    BITWISE equal to the jitted ones — any drift between the two
+    implementations silently desynchronizes the engines."""
+    import random
+
+    import jax
+    import numpy as np
+
+    from opensim_tpu.engine.simulator import AppResource, prepare
+    from opensim_tpu.ops import kernels
+    from test_fastpath_fuzz import random_app, random_cluster
+    from test_k8s_oracle import ext_app, ext_cluster
+
+    cases = []
+    for seed in (1, 23, 99):
+        rng = random.Random(seed)
+        cases.append((random_cluster(rng, rng.randrange(6, 14)),
+                      random_app(rng, rng.randrange(3, 7))))
+    rng = random.Random(42)
+    cases.append((ext_cluster(rng, 6), ext_app(rng, 15)))
+
+    for cluster, app in cases:
+        prep = prepare(cluster, [AppResource("x", app)], node_pad=8)
+        if prep is None:
+            continue
+        jit_stat = jax.device_get(
+            jax.jit(kernels.precompute_static)(prep.ec)
+        )
+        np_stat = kernels.precompute_static_np(prep.ec_np)
+        for name in kernels.StaticTables._fields:
+            a = np.asarray(getattr(jit_stat, name))
+            b = np.asarray(getattr(np_stat, name))
+            assert a.shape == b.shape, name
+            mism = (a != b).sum()
+            assert mism == 0, f"{name}: {mism} bitwise mismatches"
+
+
+def test_native_scenario_sweep_matches_xla_sweep():
+    """sweep_auto's C++ branch must return the same scenarios verdicts as
+    the XLA sweep (unscheduled counts, placements, usage)."""
+    import numpy as np
+
+    from opensim_tpu.engine.simulator import AppResource, prepare
+    from opensim_tpu.models import ResourceTypes, fixtures as fx
+    from opensim_tpu.parallel import scenarios
+
+    cluster = ResourceTypes()
+    for i in range(6):
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi", "20"))
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("w", 30, "1", "2Gi"))
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=8)
+    P = len(prep.ordered)
+    N = prep.ec.node_valid.shape[0]
+    S = 5
+    node_valid = np.zeros((S, N), bool)
+    for s in range(S):
+        node_valid[s, : s + 2] = True  # 2..6 nodes available
+    pod_valid = np.ones((S, P), bool)
+
+    res_native = scenarios.sweep_auto(prep, node_valid, pod_valid)
+
+    import os
+
+    os.environ["OPENSIM_DISABLE_NATIVE"] = "1"
+    try:
+        res_xla = scenarios.sweep_auto(prep, node_valid, pod_valid)
+    finally:
+        del os.environ["OPENSIM_DISABLE_NATIVE"]
+
+    np.testing.assert_array_equal(
+        np.asarray(res_native.unscheduled), np.asarray(res_xla.unscheduled)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res_native.chosen), np.asarray(res_xla.chosen)
+    )
+    np.testing.assert_allclose(
+        np.asarray(res_native.used), np.asarray(res_xla.used), rtol=0, atol=0
+    )

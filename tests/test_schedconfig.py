@@ -135,3 +135,330 @@ def test_node_prefer_avoid_pods():
     # the 10000-weight avoidance dominates: both replicas land on 'ok'
     assert placed.get("avoided", 0) == 0
     assert placed["ok"] == 2
+
+
+# ---------------------------------------------------------------------------
+# multi-profile + per-plugin args (pkg/simulator/utils.go:304-381 loads the
+# full v1beta1 surface; VERDICT r3 #7)
+# ---------------------------------------------------------------------------
+
+import pytest
+
+from opensim_tpu.engine.schedconfig import SchedulerProfiles
+
+
+def _write(tmp_path, text):
+    p = tmp_path / "sched.yaml"
+    p.write_text(text)
+    return str(p)
+
+
+def test_multi_profile_selects_by_scheduler_name(tmp_path):
+    """profiles[0] being a NAMED profile must not shadow default-scheduler:
+    pods route by spec.schedulerName, defaulting to default-scheduler."""
+    path = _write(tmp_path, """apiVersion: kubescheduler.config.k8s.io/v1beta1
+kind: KubeSchedulerConfiguration
+profiles:
+  - schedulerName: custom-sched
+    plugins:
+      filter:
+        disabled:
+          - name: TaintToleration
+  - schedulerName: default-scheduler
+""")
+    cfg = load_scheduler_config(path)
+    assert isinstance(cfg, SchedulerProfiles)
+
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node(
+        "tainted", "8", "16Gi", "110",
+        fx.with_taints([{"key": "d", "value": "x", "effect": "NoSchedule"}]),
+    ))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("p", "100m", "128Mi"))
+    # the pod uses default-scheduler (second profile, defaults) -> taint blocks
+    res = simulate(cluster, [AppResource("a", app)], sched_config=cfg)
+    assert len(res.unscheduled_pods) == 1
+    assert "taint" in res.unscheduled_pods[0].reason
+
+    # a pod explicitly naming custom-sched gets that profile (taints off)
+    app2 = ResourceTypes()
+    pod = fx.make_fake_pod("p2", "100m", "128Mi")
+    pod.spec.scheduler_name = "custom-sched"
+    pod.raw.setdefault("spec", {})["schedulerName"] = "custom-sched"
+    app2.pods.append(pod)
+    res = simulate(cluster, [AppResource("a", app2)], sched_config=cfg)
+    assert not res.unscheduled_pods
+
+
+def test_unknown_profile_pod_gets_explicit_reason(tmp_path):
+    path = _write(tmp_path, """kind: KubeSchedulerConfiguration
+profiles:
+  - schedulerName: default-scheduler
+  - schedulerName: batch
+""")
+    cfg = load_scheduler_config(path)
+    assert isinstance(cfg, SchedulerProfiles)
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n0", "8", "16Gi"))
+    app = ResourceTypes()
+    pod = fx.make_fake_pod("ghost", "100m", "128Mi")
+    pod.spec.scheduler_name = "no-such-scheduler"
+    pod.raw.setdefault("spec", {})["schedulerName"] = "no-such-scheduler"
+    app.pods.append(pod)
+    app.pods.append(fx.make_fake_pod("ok", "100m", "128Mi"))
+    res = simulate(cluster, [AppResource("a", app)], sched_config=cfg)
+    assert len(res.unscheduled_pods) == 1
+    assert "no scheduler profile named 'no-such-scheduler'" in res.unscheduled_pods[0].reason
+    placed = sum(len(ns.pods) for ns in res.node_status)
+    assert placed == 1  # the default-profile pod scheduled normally
+
+
+def test_differing_referenced_profiles_fail_loudly(tmp_path):
+    path = _write(tmp_path, """kind: KubeSchedulerConfiguration
+profiles:
+  - schedulerName: default-scheduler
+  - schedulerName: lean
+    plugins:
+      score:
+        disabled:
+          - name: "*"
+""")
+    cfg = load_scheduler_config(path)
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n0", "8", "16Gi"))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("a1", "100m", "128Mi"))
+    lean = fx.make_fake_pod("a2", "100m", "128Mi")
+    lean.spec.scheduler_name = "lean"
+    lean.raw.setdefault("spec", {})["schedulerName"] = "lean"
+    app.pods.append(lean)
+    with pytest.raises(ValueError, match="differing plugin configurations"):
+        simulate(cluster, [AppResource("a", app)], sched_config=cfg)
+
+
+def test_fit_ignored_resources(tmp_path):
+    """NodeResourcesFitArgs.ignoredResources: a pod over-requesting an
+    ignored extended resource schedules anyway (fit skips the column)."""
+    path = _write(tmp_path, """kind: KubeSchedulerConfiguration
+profiles:
+  - schedulerName: default-scheduler
+    pluginConfig:
+      - name: NodeResourcesFit
+        args:
+          ignoredResources:
+            - example.com/widget
+""")
+    cfg = load_scheduler_config(path)
+    assert isinstance(cfg, SchedulerProfiles)
+
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n0", "8", "16Gi"))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod(
+        "widgety", "100m", "128Mi",
+        fx.with_requests({"example.com/widget": "4"}),
+    ))
+    # without the config: no node declares the resource -> unschedulable
+    res = simulate(cluster, [AppResource("a", app)])
+    assert len(res.unscheduled_pods) == 1
+    assert "Insufficient example.com/widget" in res.unscheduled_pods[0].reason
+    # with ignoredResources: schedules
+    res = simulate(cluster, [AppResource("a", app)], sched_config=cfg)
+    assert not res.unscheduled_pods
+
+
+def test_fit_ignored_resource_groups(tmp_path):
+    path = _write(tmp_path, """kind: KubeSchedulerConfiguration
+profiles:
+  - schedulerName: default-scheduler
+    pluginConfig:
+      - name: NodeResourcesFit
+        args:
+          ignoredResourceGroups:
+            - example.com
+""")
+    cfg = load_scheduler_config(path)
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n0", "8", "16Gi"))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod(
+        "widgety", "100m", "128Mi",
+        fx.with_requests({"example.com/widget": "4"}),
+    ))
+    res = simulate(cluster, [AppResource("a", app)], sched_config=cfg)
+    assert not res.unscheduled_pods
+
+
+def test_unsupported_fields_fail_loudly(tmp_path):
+    # unknown plugin name in an enable list
+    with pytest.raises(ValueError, match="unknown plugin 'Fancy'"):
+        load_scheduler_config(_write(tmp_path, """kind: KubeSchedulerConfiguration
+profiles:
+  - plugins:
+      score:
+        enabled:
+          - name: Fancy
+"""))
+    # percentageOfNodesToScore != 100
+    with pytest.raises(ValueError, match="percentageOfNodesToScore=50"):
+        load_scheduler_config(_write(tmp_path, """kind: KubeSchedulerConfiguration
+percentageOfNodesToScore: 50
+profiles:
+  - plugins: {}
+"""))
+    # outcome-changing plugin args
+    with pytest.raises(ValueError, match="PodTopologySpread"):
+        load_scheduler_config(_write(tmp_path, """kind: KubeSchedulerConfiguration
+profiles:
+  - pluginConfig:
+      - name: PodTopologySpread
+        args:
+          defaultConstraints:
+            - maxSkew: 1
+"""))
+    # non-default hardPodAffinityWeight
+    with pytest.raises(ValueError, match="hardPodAffinityWeight=7"):
+        load_scheduler_config(_write(tmp_path, """kind: KubeSchedulerConfiguration
+profiles:
+  - pluginConfig:
+      - name: InterPodAffinity
+        args:
+          hardPodAffinityWeight: 7
+"""))
+    # unknown extension point
+    with pytest.raises(ValueError, match="extension point 'scorer'"):
+        load_scheduler_config(_write(tmp_path, """kind: KubeSchedulerConfiguration
+profiles:
+  - plugins:
+      scorer:
+        enabled:
+          - name: Simon
+"""))
+    # duplicate profile names
+    with pytest.raises(ValueError, match="duplicate profile"):
+        load_scheduler_config(_write(tmp_path, """kind: KubeSchedulerConfiguration
+profiles:
+  - schedulerName: default-scheduler
+  - schedulerName: default-scheduler
+"""))
+
+
+def test_vacuous_plugin_args_accepted(tmp_path):
+    """DefaultPreemption / VolumeBinding args cannot change a simulation's
+    outcome in either implementation (PARITY.md) and must be accepted."""
+    path = _write(tmp_path, """kind: KubeSchedulerConfiguration
+profiles:
+  - schedulerName: default-scheduler
+    pluginConfig:
+      - name: DefaultPreemption
+        args:
+          minCandidateNodesPercentage: 10
+      - name: VolumeBinding
+        args:
+          bindTimeoutSeconds: 600
+""")
+    cfg = load_scheduler_config(path)
+    assert cfg == DEFAULT_CONFIG  # single default profile, no mapped args
+
+
+# ---------------------------------------------------------------------------
+# --tie-break=sample[:seed] (selectHost reservoir sampling,
+# generic_scheduler.go:188-210; VERDICT r3 #5)
+# ---------------------------------------------------------------------------
+
+
+def test_tie_break_sample_covers_equal_score_set():
+    """Over seeds, sampled placements must cover more than one member of
+    the equal-score node set while structural results stay identical to
+    the deterministic run — and every sampled bind stays score-optimal."""
+    import numpy as np
+
+    from opensim_tpu.engine.scheduler import pad_pod_stream, schedule_pods
+    from opensim_tpu.engine.simulator import parse_tie_break, prepare
+
+    assert parse_tie_break("lowest") is None
+    assert parse_tie_break("sample") == 0
+    assert parse_tie_break("sample:7") == 7
+    with pytest.raises(ValueError):
+        parse_tie_break("bogus")
+
+    cluster = ResourceTypes()
+    for i in range(6):  # identical nodes -> every score ties
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("p", "100m", "128Mi"))
+    apps = [AppResource("a", app)]
+
+    det = simulate(cluster, apps, node_pad=8)
+    det_node = det.node_status[0].node.metadata.name if det.node_status[0].pods else None
+    assert not det.unscheduled_pods
+
+    prep = prepare(cluster, apps, node_pad=8)
+    P = len(prep.ordered)
+    t, v, f = pad_pod_stream(prep.tmpl_ids, np.ones(P, bool), prep.forced)
+    landed = set()
+    for seed in range(10):
+        out = schedule_pods(
+            prep.ec, prep.st0, t, v, f, features=prep.features, tie_seed=seed
+        )
+        c = int(np.asarray(out.chosen)[0])
+        assert c >= 0  # structural parity: still scheduled
+        landed.add(c)
+    assert len(landed) > 1, "sampling never left the lowest index"
+
+    res = simulate(cluster, apps, node_pad=8, tie_seed=3)
+    assert not res.unscheduled_pods
+    assert sum(len(ns.pods) for ns in res.node_status) == 1
+
+
+def test_tie_break_sampled_binds_stay_score_optimal():
+    """A sampled run on an affinity-bearing workload must keep every bind
+    score-optimal per the independent kube oracle (sampling only permutes
+    WITHIN the max set, never off it)."""
+    import random as _random
+
+    import numpy as np
+
+    from test_k8s_oracle import _replay_with_scores, random_app, random_cluster
+
+    from opensim_tpu.engine.scheduler import pad_pod_stream, schedule_pods
+    from opensim_tpu.engine.simulator import prepare
+
+    rng = _random.Random(29)
+    cluster = random_cluster(rng, 8)
+    app = random_app(rng, 5)
+    prep = prepare(cluster, [AppResource("oracle", app)], node_pad=8)
+    P = len(prep.ordered)
+    t, v, f = pad_pod_stream(prep.tmpl_ids, np.ones(P, bool), prep.forced)
+    out = schedule_pods(
+        prep.ec, prep.st0, t, v, f, features=prep.features, tie_seed=11
+    )
+    chosen = np.asarray(out.chosen)[:P]
+    assert _replay_with_scores(prep, cluster, chosen) == 0
+
+
+def test_forced_pod_scheduler_name_never_routes(tmp_path):
+    """A pre-bound (forced) pod bypasses every scheduler — its
+    schedulerName must neither raise the differing-profiles error nor mark
+    it invalid (review regression)."""
+    path = _write(tmp_path, """kind: KubeSchedulerConfiguration
+profiles:
+  - schedulerName: default-scheduler
+  - schedulerName: lean
+    plugins:
+      score:
+        disabled:
+          - name: "*"
+""")
+    cfg = load_scheduler_config(path)
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n0", "8", "16Gi"))
+    bound = fx.make_fake_pod("pre", "100m", "128Mi", fx.with_node_name("n0"))
+    bound.raw.setdefault("spec", {})["schedulerName"] = "lean"
+    cluster.pods.append(bound)
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("new", "100m", "128Mi"))
+    res = simulate(cluster, [AppResource("a", app)], sched_config=cfg)
+    assert not res.unscheduled_pods
+    assert sum(len(ns.pods) for ns in res.node_status) == 2

@@ -531,3 +531,24 @@ def test_multi_namespace_anti_affinity():
     nodes = {p.metadata.name: ns.node.metadata.name for ns in res.node_status for p in ns.pods}
     # ns-b is the SECOND listed namespace; the avoider must still dodge it
     assert nodes["avoider"] != nodes["occupant"]
+
+
+def test_10k_node_cluster_encodes_and_schedules():
+    """Scale-point guard (BASELINE 2x headline shape): a 10k-node cluster
+    encodes and schedules without shape/memory cliffs — the node axis pads
+    to 128-lane buckets (10000 -> 10240) and placements stay structural."""
+    from opensim_tpu.engine.simulator import AppResource, simulate
+    from opensim_tpu.models import ResourceTypes, fixtures as fx
+
+    rt = ResourceTypes()
+    zones = [f"z{z}" for z in range(4)]
+    for i in range(10_000):
+        rt.nodes.append(fx.make_fake_node(
+            f"n{i:05d}", "64", "256Gi", "256",
+            fx.with_labels({"topology.kubernetes.io/zone": zones[i % 4]}),
+        ))
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("w", 500, "500m", "1Gi"))
+    res = simulate(rt, [AppResource("a", app)])
+    assert not res.unscheduled_pods
+    assert sum(len(ns.pods) for ns in res.node_status) == 500

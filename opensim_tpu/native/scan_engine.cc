@@ -230,13 +230,14 @@ inline float share_at(const ScanArgs& a, const float* gc_dyn, int32_t u, int64_t
 }
 
 inline uint8_t fit_at(const ScanArgs& a, int32_t u, int64_t n) {
-  // incremental-cache path only (inc_ok excludes ft_gc_dyn, so the
-  // nullptr slow path below never actually rescans devices)
+  // incremental-cache path only; inc_ok excludes ft_gc_dyn, so the static
+  // alloc row is always correct here (keep the tight loop branch-free)
   const float* req = a.req + (int64_t)u * a.R;
+  const float* al = a.alloc + n * a.R;
   const float* us = a.used + n * a.R;
   uint8_t ok = 1;
   for (int64_t r = 0; r < a.R; r++)
-    ok &= (uint8_t)(!(req[r] > 0.0f && us[r] + req[r] > alloc_at(a, nullptr, n, r)));
+    ok &= (uint8_t)(!(req[r] > 0.0f && us[r] + req[r] > al[r]));
   return ok;
 }
 
@@ -325,6 +326,19 @@ void ports_mask(const ScanArgs& a, int32_t u, uint8_t* out) {
 void fit_mask(const ScanArgs& a, const float* gc_dyn, int32_t u, uint8_t* out) {
   const int64_t N = a.N, R = a.R;
   const float* req = a.req + (int64_t)u * R;
+  if (!a.ft_gc_dyn) {
+    // hot path (2e9 inner iterations at headline shape): keep the plain
+    // pointer walk fully branch-free and vectorizable
+    for (int64_t n = 0; n < N; n++) {
+      const float* al = a.alloc + n * R;
+      const float* us = a.used + n * R;
+      uint8_t ok = 1;
+      for (int64_t r = 0; r < R; r++)
+        ok &= (uint8_t)(!(req[r] > 0.0f && us[r] + req[r] > al[r]));
+      out[n] = ok;
+    }
+    return;
+  }
   for (int64_t n = 0; n < N; n++) {
     const float* us = a.used + n * R;
     uint8_t ok = 1;
@@ -1158,11 +1172,12 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
       }
     }
     const float* gcd = s.gc_dyn_ptr();
+    const float* share = a.share_raw + (int64_t)u * N;
     float sh_lo = BIG, sh_hi = NEG, sh_rng = 0.0f;
     if (use_share) {
       for (int64_t n = 0; n < N; n++) {
         if (s.feas[n]) {
-          float sh = share_at(a, gcd, u, n);
+          float sh = a.ft_gc_dyn ? share_at(a, gcd, u, n) : share[n];
           sh_lo = std::min(sh_lo, sh);
           sh_hi = std::max(sh_hi, sh);
         }
@@ -1218,8 +1233,10 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
         if (s.spr_ignored[n]) norm = 0.0f;
         sc += wsp * norm;
       }
-      if (use_share)
-        sc += wshare * (sh_rng > 0.0f ? (share_at(a, gcd, u, n) - sh_lo) * MAXS / sh_rng : 0.0f);
+      if (use_share) {
+        float sh = a.ft_gc_dyn ? share_at(a, gcd, u, n) : share[n];
+        sc += wshare * (sh_rng > 0.0f ? (sh - sh_lo) * MAXS / sh_rng : 0.0f);
+      }
       if (use_loc)
         sc += wloc * (lc_rng > 0.0f ? (s.raw_loc[n] - lc_lo) * MAXS / lc_rng : 0.0f);
       if (use_avoid) sc += wav * avoid[n];

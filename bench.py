@@ -149,18 +149,18 @@ def bench_defrag(n_scenarios: int, n_nodes: int, n_pods: int, warmup: bool) -> i
     t0 = time.time()
     result = plan_drains(cluster, apps, candidates=candidates)
     dt = time.time() - t0
-    print(
-        json.dumps(
-            {
-                "metric": f"defrag sweep ({len(candidates)} drain scenarios, {n_pods} pods/{n_nodes} nodes)",
-                "value": round(len(candidates) / dt, 2),
-                "unit": "scenarios/s/chip",
-                "vs_baseline": round(len(candidates) / dt, 2),  # no reference number exists
-                "drainable": len(result.drainable()),
-                "wall_s": round(dt, 2),
-            }
-        )
-    )
+    record = {
+        "metric": f"defrag sweep ({len(candidates)} drain scenarios, {n_pods} pods/{n_nodes} nodes)",
+        "value": round(len(candidates) / dt, 2),
+        "unit": "scenarios/s/chip",
+        "vs_baseline": round(len(candidates) / dt, 2),  # no reference number exists
+        "drainable": len(result.drainable()),
+        "wall_s": round(dt, 2),
+    }
+    serial = _serial_floor("defrag", n_pods, n_nodes)
+    if serial and serial.get("scenarios_per_sec"):
+        record["vs_serial"] = round(record["value"] / serial["scenarios_per_sec"], 1)
+    print(json.dumps(record))
     return 0
 
 

@@ -11,7 +11,7 @@ import os
 import sys
 from typing import List, Optional
 
-VERSION = "0.1.0"
+VERSION = "0.4.0"
 COMMIT_ID = os.environ.get("SIMON_COMMIT_ID", "unknown")
 
 LOG_LEVELS = {

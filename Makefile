@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test bench bench-all docs native all
+.PHONY: test bench bench-all bench-serial docs native all
 
 all: test
 
@@ -17,6 +17,11 @@ bench-all: bench
 	python bench.py --config defrag --scenarios 64 --nodes 200 --pods 2000
 	python bench.py --config bigu --pods 50000 --nodes 5000
 	python bench.py --config forced --pods 50000 --nodes 5000
+
+# measured serial floor on the 5 BASELINE configs (hours at full scale;
+# see tools/serial_baseline.py --help for per-config runs)
+bench-serial:
+	python tools/serial_baseline.py --config all
 
 docs:
 	python -m opensim_tpu gen-doc --output-dir docs/commandline

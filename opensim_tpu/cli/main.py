@@ -11,7 +11,7 @@ import os
 import sys
 from typing import List, Optional
 
-VERSION = "0.4.0"
+from .. import __version__ as VERSION  # single source of truth
 COMMIT_ID = os.environ.get("SIMON_COMMIT_ID", "unknown")
 
 LOG_LEVELS = {

@@ -26,6 +26,9 @@ import numpy as np
 from jax.sharding import Mesh
 
 
+_initialized = False
+
+
 def initialize(
     coordinator: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -33,10 +36,26 @@ def initialize(
 ) -> bool:
     """Join a multi-host jax.distributed job. Parameters default from the
     JAX_COORDINATOR / JAX_NUM_PROCESSES / JAX_PROCESS_ID env vars; returns
-    False (no-op) when unset so single-host runs need nothing."""
+    False (no-op) when unset so single-host runs need nothing. Idempotent:
+    the planner calls this on every run, and a library caller may already
+    have joined the job before invoking the planner — a second call is a
+    no-op (jax.distributed.initialize itself raises on reuse)."""
+    global _initialized
     coordinator = coordinator or os.environ.get("JAX_COORDINATOR", "")
     if not coordinator:
         return False
+    if _initialized:
+        return True
+    try:
+        # a library caller may have joined jax.distributed directly — honor
+        # that instead of crashing on the double-initialize
+        from jax._src.distributed import global_state as _gs
+
+        if getattr(_gs, "client", None) is not None:
+            _initialized = True
+            return True
+    except ImportError:
+        pass
     num_processes = int(num_processes or os.environ.get("JAX_NUM_PROCESSES", "1"))
     process_id = int(process_id if process_id is not None else os.environ.get("JAX_PROCESS_ID", "0"))
     jax.distributed.initialize(
@@ -44,6 +63,7 @@ def initialize(
         num_processes=num_processes,
         process_id=process_id,
     )
+    _initialized = True
     return True
 
 

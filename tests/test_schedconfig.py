@@ -462,3 +462,40 @@ profiles:
     res = simulate(cluster, [AppResource("a", app)], sched_config=cfg)
     assert not res.unscheduled_pods
     assert sum(len(ns.pods) for ns in res.node_status) == 2
+
+
+def test_sweep_auto_masks_unknown_profile_pods(tmp_path):
+    """Scenario sweeps must apply the same profile routing as simulate():
+    unknown-profile pods are masked out of every scenario so capacity
+    verdicts don't chase pods that can never schedule."""
+    import numpy as np
+
+    from opensim_tpu.engine.simulator import prepare
+    from opensim_tpu.parallel import scenarios
+
+    path = _write(tmp_path, """kind: KubeSchedulerConfiguration
+profiles:
+  - schedulerName: default-scheduler
+  - schedulerName: batch
+""")
+    cfg = load_scheduler_config(path)
+    cluster = ResourceTypes()
+    for i in range(3):
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+    app = ResourceTypes()
+    ghost = fx.make_fake_pod("ghost", "100m", "128Mi")
+    ghost.spec.scheduler_name = "nope"
+    ghost.raw.setdefault("spec", {})["schedulerName"] = "nope"
+    app.pods.append(ghost)
+    app.pods.append(fx.make_fake_pod("ok", "100m", "128Mi"))
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=8)
+    P = len(prep.ordered)
+    N = prep.ec.node_valid.shape[0]
+    node_valid = np.zeros((2, N), bool)
+    node_valid[:, :3] = True
+    res = scenarios.sweep_auto(prep, node_valid, np.ones((2, P), bool), config=cfg)
+    # the ghost pod is masked (not counted unscheduled), the ok pod binds
+    assert list(np.asarray(res.unscheduled)) == [0, 0]
+    ghost_idx = [i for i, p in enumerate(prep.ordered)
+                 if p.metadata.name == "ghost"][0]
+    assert (np.asarray(res.chosen)[:, ghost_idx] == -1).all()

@@ -1,6 +1,7 @@
 """Scenario sweep + defragmentation tests on the 8-device virtual CPU mesh."""
 
 import numpy as np
+import pytest
 
 from opensim_tpu.engine.simulator import AppResource, prepare
 from opensim_tpu.models import ResourceTypes
@@ -123,6 +124,93 @@ def test_fastpath_sweep_large_batch(monkeypatch):
     )
     got_unsched, got_used, got_chosen, got_vg = fastpath.sweep(
         prep, node_valid, pod_valid, forced, interpret=True
+    )
+    np.testing.assert_array_equal(got_unsched, np.asarray(want.unscheduled))
+    np.testing.assert_array_equal(got_chosen, np.asarray(want.chosen)[:, :P])
+    np.testing.assert_allclose(got_used, np.asarray(want.used), rtol=1e-5)
+    np.testing.assert_allclose(got_vg, np.asarray(want.vg_used), rtol=1e-5)
+
+
+@pytest.mark.parametrize("seed", [13, 47])
+def test_fastpath_sweep_fuzz_feature_rich(monkeypatch, seed):
+    """Batched-sweep differential fuzz: random FEATURE-RICH workloads
+    (gpu/local/ports/interpod/spread/avoid from the fastpath fuzz
+    generators) through the single-dispatch vmapped megakernel vs the XLA
+    sweep, with per-scenario drains AND per-scenario forced-mask releases
+    (the defrag shape). This is the strongest interpret-mode evidence for
+    the batched kernel awaiting compiled-Mosaic validation."""
+    import random as _random
+
+    monkeypatch.setenv("OPENSIM_FASTPATH", "interpret")
+    from opensim_tpu.engine import fastpath
+    from test_fastpath_fuzz import random_app, random_cluster
+
+    rng = _random.Random(seed)
+    cluster = random_cluster(rng, rng.randrange(8, 14))
+    apps = [AppResource("fuzz", random_app(rng, rng.randrange(3, 6)))]
+    prep = prepare(cluster, apps, node_pad=128)
+    if prep is None or not fastpath.applicable(prep):
+        pytest.skip("generated workload outside fast-path bounds")
+    N = prep.ec.node_valid.shape[0]
+    P = len(prep.ordered)
+    S = 12
+    nrng = np.random.RandomState(seed)
+    base = np.asarray(prep.ec.node_valid)
+    node_valid = np.zeros((S, N), bool)
+    forced = np.broadcast_to(prep.forced, (S, P)).copy()
+    for s in range(S):
+        node_valid[s] = base
+        drain = nrng.randint(0, int(base.sum()))
+        node_valid[s, drain] = False
+        # defrag semantics: pods pinned to the drained node become free
+        for j, pod in enumerate(prep.ordered):
+            if prep.forced[j] and pod.spec.node_name == prep.meta.node_names[drain]:
+                forced[s, j] = False
+    pod_valid = np.ones((S, P), bool)
+
+    want = scenarios.sweep(
+        prep.ec, prep.st0, prep.tmpl_ids, prep.forced, node_valid, pod_valid,
+        features=prep.features, forced_masks=forced,
+    )
+    got_unsched, got_used, got_chosen, got_vg = fastpath.sweep(
+        prep, node_valid, pod_valid, forced, interpret=True
+    )
+    np.testing.assert_array_equal(got_unsched, np.asarray(want.unscheduled))
+    np.testing.assert_array_equal(got_chosen, np.asarray(want.chosen)[:, :P])
+    np.testing.assert_allclose(got_used, np.asarray(want.used), rtol=1e-5)
+    np.testing.assert_allclose(got_vg, np.asarray(want.vg_used), rtol=1e-5)
+
+
+def test_fastpath_sweep_big_u_mode(monkeypatch):
+    """Batched sweep with the template tables in HBM (big-U per-step DMA)
+    — the combination of the two round-3 envelope features, previously
+    only tested separately."""
+    monkeypatch.setenv("OPENSIM_FASTPATH", "interpret")
+    from opensim_tpu.engine import fastpath
+
+    cluster, apps = _setup(n_nodes=6, replicas=8)
+    # inflate the template space so big_u=True is meaningful
+    extra = ResourceTypes()
+    for i in range(40):
+        extra.pods.append(fx.make_fake_pod(f"u{i:03d}", f"{50 + i}m", "64Mi"))
+    apps = apps + [AppResource("bigu", extra)]
+    prep = prepare(cluster, apps, node_pad=128)
+    assert fastpath.applicable(prep)
+    N = prep.ec.node_valid.shape[0]
+    P = len(prep.ordered)
+    S = 5
+    node_valid = np.zeros((S, N), bool)
+    for s in range(S):
+        node_valid[s, : s + 2] = True
+    pod_valid = np.ones((S, P), bool)
+    forced = np.broadcast_to(prep.forced, (S, P)).copy()
+
+    want = scenarios.sweep(
+        prep.ec, prep.st0, prep.tmpl_ids, prep.forced, node_valid, pod_valid,
+        features=prep.features,
+    )
+    got_unsched, got_used, got_chosen, got_vg = fastpath.sweep(
+        prep, node_valid, pod_valid, forced, interpret=True, big_u=True
     )
     np.testing.assert_array_equal(got_unsched, np.asarray(want.unscheduled))
     np.testing.assert_array_equal(got_chosen, np.asarray(want.chosen)[:, :P])

@@ -2,6 +2,8 @@
 registers but can never exercise (its driver deletes unschedulable pods,
 simulator.go:333-342). See opensim_tpu/engine/preemption.py."""
 
+import pytest
+
 from opensim_tpu.engine.simulator import AppResource, simulate
 from opensim_tpu.models import ResourceTypes
 from opensim_tpu.models import fixtures as fx
@@ -519,3 +521,59 @@ def test_pdb_expected_count_from_declared_replicas():
     # one replica evicted (remove-all then reprieve keeps one).
     placed = {p.metadata.name for ns in res.node_status for p in ns.pods}
     assert "vip" in placed
+
+
+@pytest.mark.parametrize("seed", [5, 21, 88, 144])
+def test_preemption_fuzz_invariants(seed):
+    """Randomized preemption runs (priorities + affinity + spread + gpu
+    from the oracle generators) must preserve the end-state invariants:
+    no node overcommitted in any resource, no host-port conflicts, every
+    victim strictly lower priority than its preemptor, and every
+    preemption reason names a real placed preemptor."""
+    import random
+
+    from test_k8s_oracle import random_app, random_cluster
+
+    rng = random.Random(seed)
+    cluster = random_cluster(rng, rng.randrange(3, 7))
+    app = random_app(rng, rng.randrange(3, 6))
+    # prioritize a random subset so preemption has work to do
+    for w in app.deployments:
+        if rng.random() < 0.5:
+            prio = rng.choice([10, 100, 1000])
+            w.template_spec.priority = prio
+            w.template_raw.setdefault("spec", {})["priority"] = prio
+    res = simulate(cluster, [AppResource("a", app)], enable_preemption=True)
+
+    placed_names = {p.metadata.name for ns in res.node_status for p in ns.pods}
+    by_name = {p.metadata.name: p for ns in res.node_status for p in ns.pods}
+    for ns in res.node_status:
+        node = ns.node
+        used = {}
+        ports = []
+        for p in ns.pods:
+            for k, v in p.resource_requests().items():
+                used[k] = used.get(k, 0.0) + v
+            ports.extend(
+                (c.protocol, c.host_port) for c in p.host_ports()
+            )
+        for k, v in used.items():
+            assert v <= node.allocatable.get(k, 0.0) + 1e-6, (
+                f"seed={seed}: {node.metadata.name} overcommitted {k}: "
+                f"{v} > {node.allocatable.get(k)}"
+            )
+        assert len(ports) == len(set(ports)), (
+            f"seed={seed}: duplicate host ports on {node.metadata.name}"
+        )
+        assert len(ns.pods) <= node.allocatable.get("pods", 1e9)
+
+    for up in res.unscheduled_pods:
+        if "preempted by higher-priority pod" in up.reason:
+            preemptor_name = up.reason.rsplit("/", 1)[-1]
+            assert preemptor_name in placed_names, (
+                f"seed={seed}: victim {up.pod.metadata.name} names missing "
+                f"preemptor {preemptor_name}"
+            )
+            assert by_name[preemptor_name].spec.priority > up.pod.spec.priority, (
+                f"seed={seed}: victim not strictly lower priority"
+            )

@@ -712,3 +712,36 @@ def test_fastpath_matches_xla_four_zone_keys():
     )
     np.testing.assert_array_equal(got_chosen, want_chosen)
     np.testing.assert_allclose(got_used, want_used, rtol=1e-6)
+
+
+def test_megakernel_failure_degrades_to_xla(monkeypatch, caplog):
+    """A Mosaic compile failure (constructs that pass interpret mode can
+    fail the real compiler) must degrade to the XLA scan with a warning,
+    never kill the simulation — placements are identical either way."""
+    import logging
+
+    from opensim_tpu.engine import fastpath
+    from opensim_tpu.engine.simulator import AppResource, simulate
+    from opensim_tpu.models import ResourceTypes, fixtures as fx
+
+    import jax
+
+    # simulate a REAL-hardware failure: tpu backend, no interpret mode (in
+    # interpret/test mode the exception re-raises so CI can't silently
+    # validate the fallback engine instead of the kernel)
+    monkeypatch.delenv("OPENSIM_FASTPATH", raising=False)
+    monkeypatch.setenv("OPENSIM_DISABLE_NATIVE", "1")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    def boom(*a, **k):
+        raise RuntimeError("Mosaic lowering failed (simulated)")
+
+    monkeypatch.setattr(fastpath, "schedule", boom)
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n0", "8", "16Gi"))
+    app = ResourceTypes()
+    app.pods.append(fx.make_fake_pod("p", "100m", "128Mi"))
+    with caplog.at_level(logging.WARNING, logger="opensim_tpu"):
+        res = simulate(cluster, [AppResource("a", app)], node_pad=8)
+    assert not res.unscheduled_pods
+    assert any("falling back to a slower engine" in r.message for r in caplog.records)

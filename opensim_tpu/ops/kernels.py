@@ -578,6 +578,28 @@ def precompute_static(ec, cfg=None) -> StaticTables:
     )
 
 
+def _unique_rows_np(*arrays):
+    """(index, inverse) of the unique joint rows of per-template field
+    arrays — live-cluster replays dedup pods per PINNED NODE (U ≈ N
+    templates differing only in `pin`), but none of the static-table
+    computations read the pin, so computing on unique field rows and
+    scattering back turns an O(U·N·…) broadcast into O(U_eff·N·…) with
+    U_eff = the handful of genuinely distinct specs."""
+    import numpy as np
+
+    packed = np.concatenate(
+        [
+            np.ascontiguousarray(a.reshape(a.shape[0], -1))
+            .view(np.uint8)
+            .reshape(a.shape[0], -1)
+            for a in arrays
+        ],
+        axis=1,
+    )
+    _, idx, inv = np.unique(packed, axis=0, return_index=True, return_inverse=True)
+    return idx, inv
+
+
 def precompute_core_np(ec):
     """The node_valid- and config-INDEPENDENT half of
     :func:`precompute_static_np`: per-(template, node) filter masks and raw
@@ -665,39 +687,69 @@ def precompute_core_np(ec):
         * max(int(np.asarray(ec.aff_val).shape[3]), 1),
     )
     chunk = max(1, int(4e7 // max(per_u, 1)))
+
+    def dedup(fields, compute, outs):
+        """Compute per unique field rows, scatter to [U, ...] outputs."""
+        idx, inv = _unique_rows_np(*[np.asarray(f) for f in fields])
+        ueff = idx.shape[0]
+        parts = [np.empty((ueff,) + o.shape[1:], o.dtype) for o in outs]
+        for lo in range(0, ueff, chunk):
+            sel = idx[lo : lo + chunk]
+            vals = compute(sel)
+            if not isinstance(vals, tuple):
+                vals = (vals,)
+            for p, v in zip(parts, vals):
+                p[lo : lo + chunk] = v
+        for o, p in zip(outs, parts):
+            o[:] = p[inv]
+
     taint = np.empty((U, N), bool)
     aff = np.empty((U, N), bool)
     na_raw = np.empty((U, N), f32)
     tt_raw = np.empty((U, N), f32)
-    for lo in range(0, U, chunk):
-        sl = slice(lo, min(lo + chunk, U))
-        taint[sl], tt_raw[sl] = taints_of(sl)
-        aff[sl] = affinity_of(sl)
-        na_raw[sl] = na_raw_of(sl)
+    dedup(
+        (ec.tol_valid, ec.tol_key, ec.tol_op, ec.tol_val, ec.tol_effect),
+        taints_of, (taint, tt_raw),
+    )
+    dedup(
+        (ec.ns_key, ec.ns_val, ec.has_req_aff, ec.aff_term_valid,
+         ec.aff_key, ec.aff_op, ec.aff_val, ec.aff_num),
+        affinity_of, (aff,),
+    )
+    dedup(
+        (ec.pna_weight, ec.pna_key, ec.pna_op, ec.pna_val, ec.pna_num),
+        na_raw_of, (na_raw,),
+    )
 
     # share_raw (see the jnp version for the formula provenance)
     req_full = np.asarray(ec.req, f32)
-    req = req_full.copy()
-    req[:, V.RES_PODS] = 0.0
     alloc = np.asarray(ec.alloc, f32)
-    with np.errstate(divide="ignore", invalid="ignore"):
-        avail = alloc[None] - req[:, None, :]
-        share = np.where(
-            avail == 0,
-            np.where(req[:, None, :] == 0, f32(0), f32(1)),
-            req[:, None, :] / avail,
-        )
-    share = np.where(alloc[None] > 0, share, f32(0))
     has_dev = (np.asarray(ec.node_gpu_mem) > 0).any(-1)
     gc_mask = np.asarray(ec.gc_mask, bool)
     dyn_active = bool((np.asarray(ec.gpu_mem) > 0).any()) and bool(
         (np.where(gc_mask[None, :], req_full, 0.0) > 0).any()
     )
-    share = np.where(
-        gc_mask[None, None, :] & has_dev[None, :, None] & dyn_active, f32(0), share
-    )
-    raw = np.maximum(share.max(-1), f32(0)) * f32(MAX_NODE_SCORE)
-    share_tbl = np.where((req > 0).any(-1)[:, None], raw, f32(MAX_NODE_SCORE))
+    share_tbl = np.empty((U, N), f32)
+
+    def share_of(sel):
+        req = req_full[sel].copy()
+        req[:, V.RES_PODS] = 0.0
+        with np.errstate(divide="ignore", invalid="ignore"):
+            avail = alloc[None] - req[:, None, :]
+            share = np.where(
+                avail == 0,
+                np.where(req[:, None, :] == 0, f32(0), f32(1)),
+                req[:, None, :] / avail,
+            )
+        share = np.where(alloc[None] > 0, share, f32(0))
+        share = np.where(
+            gc_mask[None, None, :] & has_dev[None, :, None] & dyn_active,
+            f32(0), share,
+        )
+        raw = np.maximum(share.max(-1), f32(0)) * f32(MAX_NODE_SCORE)
+        return np.where((req > 0).any(-1)[:, None], raw, f32(MAX_NODE_SCORE))
+
+    dedup((req_full,), share_of, (share_tbl,))
 
     return {
         "taint": taint,

@@ -337,6 +337,12 @@ def main() -> int:
     }
     if cold_s is not None:
         record["cold_s"] = cold_s  # includes first-compile (cached across runs)
+    if result.engine is not None:
+        # engine attribution (VERDICT r4 #3): which engine produced this
+        # number, and why the faster ones (if any) were skipped
+        record["engine"] = result.engine.name
+        if result.engine.skipped:
+            record["engine_skipped"] = result.engine.skipped
     serial = _serial_floor(
         args.config, scheduled + len(result.unscheduled_pods), args.nodes
     )

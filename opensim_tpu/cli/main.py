@@ -245,6 +245,9 @@ def _select_backend(backend: str) -> None:
         if jax.default_backend() != "tpu":
             print("simon: --backend tpu requested but no TPU backend is available", file=sys.stderr)
             raise SystemExit(1)
+        # a megakernel compile failure must be a hard error under an explicit
+        # TPU request, not a silent fallback (engine/simulator.py honors this)
+        os.environ["OPENSIM_REQUIRE_TPU"] = "1"
 
 
 def gen_doc(parser: argparse.ArgumentParser, output_dir: str) -> int:

@@ -30,33 +30,39 @@ def _pad8_static(n: int) -> int:
 
 
 def applicable(prep, config=None) -> bool:
-    """The megakernel covers: static filters + fit + least/balanced/share +
-    topology spread + inter-pod terms, hostname plus at most four other
-    topology keys (stacked per-key count blocks)."""
+    return why_not(prep, config) is None
+
+
+def why_not(prep, config=None) -> Optional[str]:
+    """Envelope check for the megakernel: returns None when the prepared
+    simulation can run on it, else a one-line reason (surfaced as engine
+    attribution — VERDICT r4 #3). The kernel covers: static filters + fit +
+    least/balanced/share + topology spread + inter-pod terms, hostname plus
+    at most four other topology keys (stacked per-key count blocks)."""
     if config is not None and config != DEFAULT_CONFIG:
-        return False
+        return "non-default scheduler config (weight/disable merges run on the XLA or C++ engine)"
     f = prep.features
     ec = prep.ec_np if prep.ec_np is not None else prep.ec
     if f.ports and int(ec.ports.max() if ec.ports.size else -1) >= 64:
-        return False  # port-vocab ids ≥64 exceed the 64 padded rows budgeted
+        return "port-vocab ids >=64 exceed the 64 padded port rows"
     if f.gpu and int(ec.node_gpu_mem.shape[1]) > 8:
-        return False
+        return f"{int(ec.node_gpu_mem.shape[1])} GPUs/node > 8 supported"
     if f.local and (
         int(ec.node_vg_cap.shape[1]) > 8
         or int(ec.node_dev_cap.shape[1]) > 8
         or int(ec.dev_req_sizes.shape[2]) > 8
     ):
-        return False
+        return "open-local VG/device axes > 8 supported"
     # inter-pod terms are supported with bounded table sizes
     if f.interpod or f.prefg:
         if int(ec.anti_g_sel.shape[0]) > 16 or int(ec.prefg_sel.shape[0]) > 16:
-            return False
+            return "inter-pod global term tables > 16 rows"
         if (
             int(ec.at_sel.shape[1]) > 4
             or int(ec.an_sel.shape[1]) > 4
             or int(ec.pt_sel.shape[1]) > 4
         ):
-            return False
+            return "inter-pod per-template terms > 4 per pod"
     # N is padded to a 128-lane multiple at marshalling time
     # (build_inputs), so any encoder node_pad is acceptable
     N = 128 * math.ceil(int(ec.node_valid.shape[0]) / 128)
@@ -67,13 +73,19 @@ def applicable(prep, config=None) -> bool:
     # tables in HBM, one DMA per step — see use_big_u/run_fast_scan);
     # 2048 bounds the SMEM scalar tables
     if R > 8 or U > 2048 or A > 64:
-        return False
+        over = [
+            f"{label}={val} > {cap} supported"
+            for label, val, cap in (("R", R, 8), ("U", U, 2048), ("A", A, 64))
+            if val > cap
+        ]
+        return "table sizes outside envelope: " + ", ".join(over)
     vocab = prep.meta.vocab
     topo_keys = vocab.topo_keys.items()
     non_host = [k for k in topo_keys if k != HOSTNAME]
     if len(non_host) > 4:
-        return False  # hostname + up to four zone-like keys (compile-time
-        # unrolled per-key loops; beyond that the XLA scan wins anyway)
+        # hostname + up to four zone-like keys (compile-time unrolled
+        # per-key loops; beyond that the XLA scan wins anyway)
+        return f"{len(non_host)} non-hostname topology keys > 4 supported"
     # hostname domains must be node-identity (each valid node carries its
     # own hostname label) for the per-node count layout to be exact
     if HOSTNAME in topo_keys:
@@ -82,19 +94,19 @@ def applicable(prep, config=None) -> bool:
         nv = np.asarray(ec.node_valid)
         trash = np.asarray(ec.domain_topo).shape[0] - 1
         if (nd[nv] == trash).any():
-            return False
+            return "some valid nodes carry no hostname label"
         if len(np.unique(nd[nv])) != int(nv.sum()):
-            return False
+            return "hostname domains are not node-identity (duplicate hostname labels)"
     # pallas compiled path only on TPU; elsewhere the interpreter would be
     # slower than the XLA scan (tests force it via OPENSIM_FASTPATH=interpret)
     import os
 
     if os.environ.get("OPENSIM_DISABLE_FASTPATH"):
-        return False  # --backend xla
+        return "disabled by --backend xla (OPENSIM_DISABLE_FASTPATH)"
     if os.environ.get("OPENSIM_NATIVE") == "1":
-        return False  # --backend native forces the C++ engine even on TPU
+        return "disabled by --backend native (OPENSIM_NATIVE=1)"
     if jax.default_backend() != "tpu" and os.environ.get("OPENSIM_FASTPATH") != "interpret":
-        return False
+        return f"no TPU backend (jax.default_backend()={jax.default_backend()!r})"
     # VMEM budget. The pallas_call signature is generated per feature-flag
     # combination (_input_layout): a feature that is off contributes ZERO
     # rows — its buffers don't exist in the program. Resident rows ([x, N]):
@@ -151,8 +163,8 @@ def applicable(prep, config=None) -> bool:
         rows += U_resident
     vmem = (rows * N + (2 * K * N + zone_z_rows) * Z + u_rows * u_cols) * 4
     if vmem > _VMEM_BUDGET:
-        return False
-    return True
+        return f"VMEM estimate {vmem / 1e6:.1f} MB exceeds the {_VMEM_BUDGET / 1e6:.0f} MB budget"
+    return None
 
 
 def _gc_row(prep) -> int:

@@ -43,25 +43,36 @@ def _warn_native_unavailable() -> None:
 
 
 def applicable(prep, config=None, extra_plugins: tuple = ()) -> bool:
+    return why_not(prep, config, extra_plugins) is None
+
+
+def why_not(prep, config=None, extra_plugins: tuple = (), tie_seed=None):
+    """Selection check for the C++ engine: returns None when it should run,
+    else a one-line reason (engine attribution — VERDICT r4 #3)."""
+    if tie_seed is not None:
+        return "sampled tie-break runs on the XLA scan"
     if extra_plugins:
-        return False
+        return "out-of-tree extra_plugins are jittable callables (XLA scan only)"
     if config is not None and getattr(config, "fit_ignored_cols", ()):
         # NodeResourcesFitArgs ignored columns are an XLA-scan feature; the
         # C++ fit loop has no per-column skip (rare config — not worth ABI)
-        return False
+        return "NodeResourcesFitArgs ignoredResources need the XLA scan's per-column skip"
     if os.environ.get("OPENSIM_DISABLE_NATIVE"):
-        return False
+        return "disabled by --backend xla (OPENSIM_DISABLE_NATIVE)"
     from .. import native
 
     if os.environ.get("OPENSIM_NATIVE") == "1":
         if not native.available():
             _warn_native_unavailable()
-        return native.available()
+            return f"engine not built: {native.load_error() or 'unknown'}"
+        return None
     import jax
 
     if jax.default_backend() == "tpu":
-        return False
-    return native.available()
+        return "TPU backend present (the megakernel/XLA scan own the accelerator)"
+    if not native.available():
+        return f"engine not built: {native.load_error() or 'unknown'}"
+    return None
 
 
 def _stat_np(prep, config, node_valid=None):

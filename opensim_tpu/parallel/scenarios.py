@@ -114,7 +114,8 @@ def sweep_auto(
     ):
         from ..engine import fastpath
 
-        if fastpath.applicable(prep):
+        miss = fastpath.why_not(prep)
+        if miss is None:
             try:
                 unscheduled, used, chosen, vg_used = fastpath.sweep(
                     prep, node_valid_masks, pod_valid_masks, forced_masks
@@ -124,15 +125,28 @@ def sweep_auto(
                 )
             except Exception as e:
                 # a Mosaic compile failure on the batched kernel must not
-                # kill the sweep — the XLA path below computes the same
+                # kill the sweep — the XLA path below computes the same —
+                # unless --backend tpu explicitly demanded the TPU engine
                 import logging
 
                 if _os.environ.get("OPENSIM_FASTPATH") == "interpret":
                     raise  # test/CI mode: fail loudly, don't validate the fallback
+                if _os.environ.get("OPENSIM_REQUIRE_TPU") == "1":
+                    raise RuntimeError(
+                        "--backend tpu: the batched megakernel sweep failed "
+                        f"({type(e).__name__}: {e}); refusing to silently "
+                        "fall back to the XLA sweep"
+                    ) from e
                 logging.getLogger("opensim_tpu").warning(
                     "megakernel sweep failed (%s: %s); falling back to the "
                     "XLA sweep", type(e).__name__, e,
                 )
+        else:
+            import logging
+
+            logging.getLogger("opensim_tpu").info(
+                "megakernel sweep envelope miss: %s", miss
+            )
     return sweep(
         prep.ec,
         prep.st0,

@@ -352,6 +352,8 @@ class Applier:
             out=self.out,
             pod_nodes=[] if self.opts.report_pods else None,
         )
+        if result.engine is not None:
+            print(f"Scheduling engine: {result.engine.describe()}", file=self.out)
         return 0
 
     def _run_interactive(self, cluster, apps, template) -> int:
@@ -417,4 +419,6 @@ class Applier:
             out=self.out,
             pod_nodes=pod_nodes,
         )
+        if result.engine is not None:
+            print(f"Scheduling engine: {result.engine.describe()}", file=self.out)
         return 0

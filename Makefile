@@ -1,10 +1,18 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test bench bench-all bench-serial docs native all
+.PHONY: test test-quick test-slow bench bench-all bench-serial docs native all
 
 all: test
 
 test:
 	python -m pytest tests/ -q
+
+# inner-loop tier (<90 s): skips the nightly oracle/fuzz/multihost/parity
+# matrix suites — run `make test` (both tiers) before shipping
+test-quick:
+	python -m pytest tests/ -q -m "not slow"
+
+test-slow:
+	python -m pytest tests/ -q -m slow
 
 bench:
 	python bench.py

@@ -10,6 +10,8 @@ import pytest
 from opensim_tpu.engine import fastpath
 from opensim_tpu.engine.scheduler import pad_pod_stream, schedule_pods
 from opensim_tpu.engine.simulator import AppResource, prepare
+
+pytestmark = pytest.mark.slow  # nightly tier: full megakernel-vs-XLA parity matrix (README: test tiering)
 from opensim_tpu.models import ResourceTypes, fixtures as fx
 
 _INTERPRET = os.environ.get("OPENSIM_TEST_BACKEND") != "tpu"

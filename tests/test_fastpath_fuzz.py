@@ -13,6 +13,8 @@ from opensim_tpu.engine.scheduler import pad_pod_stream, schedule_pods
 from opensim_tpu.engine.simulator import AppResource, prepare
 from opensim_tpu.models import ResourceTypes, fixtures as fx
 
+pytestmark = pytest.mark.slow  # nightly tier (README: test tiering)
+
 _INTERPRET = os.environ.get("OPENSIM_TEST_BACKEND") != "tpu"
 
 

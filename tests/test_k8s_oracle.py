@@ -20,6 +20,8 @@ from opensim_tpu.engine.simulator import AppResource, prepare
 from opensim_tpu.models import ResourceTypes, fixtures as fx, selectors
 from opensim_tpu.models.objects import Node, Pod
 
+pytestmark = pytest.mark.slow  # nightly tier (README: test tiering)
+
 HOSTNAME = "kubernetes.io/hostname"
 
 

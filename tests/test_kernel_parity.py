@@ -3,6 +3,7 @@ the host-side golden implementations in ``opensim_tpu/models/selectors.py``
 on every (template, node) pair. This is the per-kernel unit layer the
 reference lacks (SURVEY.md §4)."""
 
+import pytest
 import random
 
 import numpy as np
@@ -63,6 +64,7 @@ def random_pod(rng: random.Random, i: int) -> Pod:
     return fx.make_fake_pod(f"p{i}", "100m", "128Mi", *opts)
 
 
+@pytest.mark.slow
 def test_static_filter_kernels_match_host_golden():
     rng = random.Random(42)
     nodes = [random_node(rng, i) for i in range(24)]
@@ -101,6 +103,7 @@ def test_static_filter_kernels_match_host_golden():
             )
 
 
+@pytest.mark.slow
 def test_share_score_matches_reference_formula():
     """share_raw must equal the Simon plugin formula (plugin/simon.go:57-68
     + algo.Share) computed by hand."""

@@ -1,6 +1,7 @@
 """Coverage for the remaining small components: greed queue, chart
 tarballs, the scale-apps endpoint, report pod table, CLI doc generation."""
 
+import pytest
 import json
 import os
 import tarfile
@@ -113,6 +114,7 @@ def test_gen_doc(tmp_path):
     assert "--use-greed" in (out_dir / "simon_apply.md").read_text()
 
 
+@pytest.mark.slow
 def test_defrag_cli(tmp_path):
     import yaml as _yaml
 

@@ -13,6 +13,8 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+pytestmark = pytest.mark.slow  # nightly tier (README: test tiering)
+
 _CHILD = r"""
 import os, sys
 import jax

@@ -203,6 +203,7 @@ def test_failure_reasons_identical_through_simulate(monkeypatch):
 
 
 @pytest.mark.parametrize("seed", [3, 11, 31, 77, 1234])
+@pytest.mark.slow
 def test_native_fuzz_vs_xla(seed):
     """Differential fuzz over the full feature mix (gpu/local/interpod/
     ports/namespaces) — the generic non-incremental C++ path."""

@@ -64,6 +64,7 @@ def test_defrag_reschedules_prebound_pods():
     assert by_node["n0"].feasible  # pod fits elsewhere
 
 
+@pytest.mark.slow
 def test_fastpath_sweep_matches_xla_sweep(monkeypatch):
     """The megakernel-backed sweep must agree with the vmapped XLA sweep on
     unscheduled counts, placements, and final usage."""
@@ -95,6 +96,7 @@ def test_fastpath_sweep_matches_xla_sweep(monkeypatch):
     np.testing.assert_allclose(got_vg, np.asarray(want.vg_used), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_fastpath_sweep_large_batch(monkeypatch):
     """A larger scenario batch (S=40) through the single-dispatch vmapped
     megakernel still matches the XLA sweep — guards the batched-grid path
@@ -132,6 +134,7 @@ def test_fastpath_sweep_large_batch(monkeypatch):
 
 
 @pytest.mark.parametrize("seed", [13, 47])
+@pytest.mark.slow
 def test_fastpath_sweep_fuzz_feature_rich(monkeypatch, seed):
     """Batched-sweep differential fuzz: random FEATURE-RICH workloads
     (gpu/local/ports/interpod/spread/avoid from the fastpath fuzz
@@ -181,6 +184,7 @@ def test_fastpath_sweep_fuzz_feature_rich(monkeypatch, seed):
     np.testing.assert_allclose(got_vg, np.asarray(want.vg_used), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_fastpath_sweep_big_u_mode(monkeypatch):
     """Batched sweep with the template tables in HBM (big-U per-step DMA)
     — the combination of the two round-3 envelope features, previously

@@ -368,6 +368,7 @@ profiles:
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_tie_break_sample_covers_equal_score_set():
     """Over seeds, sampled placements must cover more than one member of
     the equal-score node set while structural results stay identical to

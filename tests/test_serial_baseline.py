@@ -28,6 +28,8 @@ from test_k8s_oracle import (  # noqa: E402
     random_cluster,
 )
 
+pytestmark = pytest.mark.slow  # nightly tier (README: test tiering)
+
 
 @pytest.mark.parametrize("seed", [3, 17, 29, 61, 97])
 def test_serial_baseline_matches_oracle_and_engine(seed):
@@ -101,3 +103,55 @@ def test_serial_baseline_matches_ext_oracle(seed):
                 f"seed={seed}: serial left {pod.metadata.name} unscheduled "
                 f"but {feas} are feasible"
             )
+
+
+# ---------------------------------------------------------------------------
+# C++ serial engine (native/serial_engine.cc): the measured Go-cost stand-in
+# must place every pod exactly where the python pipeline does.
+# ---------------------------------------------------------------------------
+
+def _native_serial():
+    from opensim_tpu.native import serial
+
+    if not serial.available():
+        pytest.skip(f"serial engine unavailable: {serial.load_error()}")
+    return serial.run_serial_native
+
+
+@pytest.mark.parametrize("seed", [3, 17, 29, 61, 97, 123, 250])
+def test_cxx_serial_matches_python_serial(seed):
+    run_native = _native_serial()
+    rng = random.Random(seed)
+    cluster = random_cluster(rng, rng.randrange(4, 10))
+    app = random_app(rng, rng.randrange(3, 7))
+    apps = [AppResource("x", app)]
+    s1, u1, _, _, c1 = run_serial(cluster, apps)
+    s2, u2, _, _, c2 = run_native(cluster, apps)
+    assert (s1, u1) == (s2, u2)
+    assert c1 == c2, f"seed={seed}: placements diverge"
+
+
+@pytest.mark.parametrize("seed", [501, 502, 77, 1234, 31, 999])
+def test_cxx_serial_matches_python_serial_ext(seed):
+    """GPU-share + open-local workloads: device binpack and VG/exclusive
+    device choices must agree bind-for-bind."""
+    run_native = _native_serial()
+    rng = random.Random(seed)
+    cluster = ext_cluster(rng, rng.randrange(4, 9))
+    app = ext_app(rng, rng.randrange(3, 7))
+    apps = [AppResource("x", app)]
+    _, _, _, _, c1 = run_serial(cluster, apps)
+    _, _, _, _, c2 = run_native(cluster, apps)
+    assert c1 == c2, f"seed={seed}: ext placements diverge"
+
+
+def test_cxx_serial_matches_python_on_examples():
+    from tools.serial_baseline import _REPO, _example
+
+    run_native = _native_serial()
+    for name in ("simon-config.yaml", "simon-gpushare-config.yaml"):
+        path = os.path.join(_REPO, "example", name)
+        cluster, apps = _example(path)
+        s1, u1, _, _, c1 = run_serial(cluster, apps)
+        s2, u2, _, _, c2 = run_native(cluster, apps)
+        assert (s1, u1, c1) == (s2, u2, c2), path

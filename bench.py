@@ -35,25 +35,30 @@ def _fmt(n: int) -> str:
     return f"{n // 1000}k" if n >= 1000 and n % 1000 == 0 else str(n)
 
 
-def _serial_floor(config: str, pods: int, nodes: int):
-    """Measured python-serial baseline (tools/serial_baseline.py) for the
-    same workload at the same shape, if one has been recorded. Returns the
-    record or None. The floor UNDERSTATES the Go reference's speed (Python
-    per-op cost); BENCH.md's modeled brackets convert. bench's `plan`
-    config and the baseline tool's `synthetic` use the same generators, so
-    either key matches by shape."""
+def _serial_floors(config: str, pods: int, nodes: int):
+    """Measured serial baselines (tools/serial_baseline.py) for the same
+    workload at the same shape, if recorded. Returns (python_rec, cxx_rec),
+    either None. The python-serial floor UNDERSTATES the Go reference's
+    speed; the c++-serial row (native/serial_engine.cc) is the measured
+    stand-in for the Go constant factor. bench's `plan` config and the
+    baseline tool's `synthetic` use the same generators, so either key
+    matches by shape."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BASELINE_MEASURED.json")
     try:
         with open(path) as f:
             measured = json.load(f)
     except (OSError, ValueError):
-        return None
+        return None, None
     keys = {"plan": ["plan", "synthetic"]}.get(config, [config])
-    for key in keys:
-        rec = measured.get(key)
-        if rec and rec.get("pods") == pods and rec.get("nodes") == nodes:
-            return rec
-    return None
+
+    def find(suffix):
+        for key in keys:
+            rec = measured.get(key + suffix)
+            if rec and rec.get("pods") == pods and rec.get("nodes") == nodes:
+                return rec
+        return None
+
+    return find(""), find("-cxx")
 
 
 def synthetic_cluster(n_nodes: int) -> ResourceTypes:
@@ -157,9 +162,11 @@ def bench_defrag(n_scenarios: int, n_nodes: int, n_pods: int, warmup: bool) -> i
         "drainable": len(result.drainable()),
         "wall_s": round(dt, 2),
     }
-    serial = _serial_floor("defrag", n_pods, n_nodes)
+    serial, cxx = _serial_floors("defrag", n_pods, n_nodes)
     if serial and serial.get("scenarios_per_sec"):
         record["vs_serial"] = round(record["value"] / serial["scenarios_per_sec"], 1)
+    if cxx and cxx.get("scenarios_per_sec"):
+        record["vs_serial_cxx"] = round(record["value"] / cxx["scenarios_per_sec"], 1)
     print(json.dumps(record))
     return 0
 
@@ -343,12 +350,17 @@ def main() -> int:
         record["engine"] = result.engine.name
         if result.engine.skipped:
             record["engine_skipped"] = result.engine.skipped
-    serial = _serial_floor(
+    serial, cxx = _serial_floors(
         args.config, scheduled + len(result.unscheduled_pods), args.nodes
     )
     if serial and serial.get("schedule_s") and dt > 0:
         record["vs_serial"] = round(serial["schedule_s"] / dt, 1)
         record["serial_schedule_s"] = serial["schedule_s"]
+    if cxx and cxx.get("schedule_s") and dt > 0:
+        # the headline honest ratio: vectorized wall-clock vs the measured
+        # compiled-serial (Go-cost stand-in) schedule time
+        record["vs_serial_cxx"] = round(cxx["schedule_s"] / dt, 1)
+        record["cxx_serial_schedule_s"] = cxx["schedule_s"]
     if BACKEND_NOTE:
         record["backend"] = BACKEND_NOTE
     print(json.dumps(record))

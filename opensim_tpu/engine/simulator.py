@@ -472,7 +472,7 @@ def simulate(
         elif extra_plugins:
             skips["megakernel"] = "out-of-tree extra_plugins run on the XLA scan"
         elif tie_seed is not None:
-            skips["megakernel"] = "sampled tie-break runs on the XLA scan or C++ engine"
+            skips["megakernel"] = "sampled tie-break runs on the XLA scan"
         elif jax.default_backend() != "tpu" and not interpret:
             skips["megakernel"] = (
                 f"no TPU backend (jax.default_backend()={jax.default_backend()!r})"

@@ -98,15 +98,46 @@ _lib = None
 _lib_error: Optional[str] = None
 
 
-def _cache_key() -> str:
-    h = hashlib.sha256()
-    h.update(_SRC.read_bytes())
-    h.update(" ".join(_CXX_FLAGS).encode())
+def build_cached(src: Path, prefix: str, flags: list) -> tuple:
+    """Compile ``src`` into a content-hash-keyed .so next to it (shared by
+    the scan and serial engines). Returns (path, None) or (None, reason).
+    Concurrent builders race benignly: each writes its own pid-suffixed tmp
+    and only stale *.so* files are cleaned up (never another process's
+    in-flight tmp)."""
     try:
-        h.update(subprocess.run(["g++", "--version"], capture_output=True).stdout)
-    except OSError:
-        pass
-    return h.hexdigest()[:16]
+        h = hashlib.sha256()
+        h.update(src.read_bytes())
+        h.update(" ".join(flags).encode())
+        try:
+            h.update(subprocess.run(["g++", "--version"], capture_output=True).stdout)
+        except OSError:
+            pass
+        key = h.hexdigest()[:16]
+    except OSError as e:
+        return None, f"cannot read {src}: {e}"
+    here = src.parent
+    out = here / f"{prefix}{key}.so"
+    if out.exists():
+        return out, None
+    tmp = out.with_suffix(f".tmp{os.getpid()}")
+    cmd = ["g++", *flags, "-o", str(tmp), str(src)]
+    try:
+        try:
+            r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            return None, f"g++ unavailable: {e}"
+        if r.returncode != 0:
+            return None, f"native build failed:\n{r.stderr[-2000:]}"
+        os.replace(tmp, out)  # atomic: concurrent builders race benignly
+    finally:
+        tmp.unlink(missing_ok=True)
+    for stale in here.glob(f"{prefix}*.so"):
+        if stale != out:
+            try:
+                stale.unlink()
+            except OSError:
+                pass
+    return out, None
 
 
 def ensure_built() -> Optional[Path]:
@@ -114,35 +145,10 @@ def ensure_built() -> Optional[Path]:
     path, or None (with the reason in ``load_error()``) when no compiler is
     available or the build fails."""
     global _lib_error
-    try:
-        key = _cache_key()
-    except OSError as e:
-        _lib_error = f"cannot read {_SRC}: {e}"
-        return None
-    out = _HERE / f"_scan_engine_{key}.so"
-    if out.exists():
-        return out
-    tmp = out.with_suffix(f".tmp{os.getpid()}")
-    cmd = ["g++", *_CXX_FLAGS, "-o", str(tmp), str(_SRC)]
-    try:
-        try:
-            r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
-        except (OSError, subprocess.TimeoutExpired) as e:
-            _lib_error = f"g++ unavailable: {e}"
-            return None
-        if r.returncode != 0:
-            _lib_error = f"native build failed:\n{r.stderr[-2000:]}"
-            return None
-        os.replace(tmp, out)  # atomic: concurrent builders race benignly
-    finally:
-        tmp.unlink(missing_ok=True)
-    for stale in list(_HERE.glob("_scan_engine_*.so")) + list(_HERE.glob("_scan_engine_*.tmp*")):
-        if stale != out:
-            try:
-                stale.unlink()
-            except OSError:
-                pass
-    return out
+    path, err = build_cached(_SRC, "_scan_engine_", _CXX_FLAGS)
+    if path is None:
+        _lib_error = err
+    return path
 
 
 def load() -> Optional[ctypes.CDLL]:

@@ -783,6 +783,18 @@ def _example(config_path: str):
     return a.load_cluster(), a.load_apps()
 
 
+def _runner(args):
+    """--impl python → this module's run_serial; --impl c++ → the compiled
+    serial engine (opensim_tpu/native/serial_engine.cc), the same pipeline
+    in C++ — the measured stand-in for the Go reference's constant factor
+    (placement parity asserted by tests/test_serial_baseline.py)."""
+    if getattr(args, "impl", "python") == "c++":
+        from opensim_tpu.native.serial import run_serial_native
+
+        return run_serial_native, "c++-serial (same NodeInfo/PreFilter pipeline compiled -O3; see native/serial_engine.cc)"
+    return run_serial, "python-serial (kube NodeInfo/PreFilter design; see module docstring)"
+
+
 def run_config(name: str, args):
     from opensim_tpu.engine.simulator import AppResource
 
@@ -812,7 +824,8 @@ def run_config(name: str, args):
     else:
         raise SystemExit(f"unknown config {name}")
 
-    scheduled, unscheduled, expand_s, schedule_s, _ = run_serial(
+    run, impl = _runner(args)
+    scheduled, unscheduled, expand_s, schedule_s, _ = run(
         cluster, apps, progress=True
     )
     total = scheduled + unscheduled
@@ -825,7 +838,7 @@ def run_config(name: str, args):
         "pods_per_sec": round(total / schedule_s, 1) if schedule_s else None,
         "scheduled": scheduled,
         "unscheduled": unscheduled,
-        "impl": "python-serial (kube NodeInfo/PreFilter design; see module docstring)",
+        "impl": impl,
     }
     print(json.dumps(rec))
     return rec
@@ -838,6 +851,7 @@ def run_defrag(args):
     from opensim_tpu.engine.simulator import AppResource
 
     bench = _bench()
+    run, impl = _runner(args)
     pods_n, nodes_n = args.pods or 10000, args.nodes or 1000
     k = args.scenarios or 3
     cluster = bench.synthetic_cluster(nodes_n)
@@ -848,7 +862,7 @@ def run_defrag(args):
 
         sub = copy.copy(cluster)
         sub.nodes = [n for i, n in enumerate(cluster.nodes) if i != c]
-        run_serial(sub, apps)
+        run(sub, apps)
     dt = time.time() - t0
     rec = {
         "config": "defrag",
@@ -857,7 +871,7 @@ def run_defrag(args):
         "scenarios": k,
         "wall_s": round(dt, 3),
         "scenarios_per_sec": round(k / dt, 4),
-        "impl": "python-serial, one full re-simulation per drain scenario",
+        "impl": f"{impl.split(' ')[0]}, one full re-simulation per drain scenario",
     }
     print(json.dumps(rec))
     return rec
@@ -874,6 +888,11 @@ def main() -> int:
     ap.add_argument("--nodes", type=int, default=None)
     ap.add_argument("--scenarios", type=int, default=None)
     ap.add_argument(
+        "--impl", default="python", choices=["python", "c++"],
+        help="c++ runs the compiled serial engine (the measured Go-cost "
+        "stand-in); results are stored under '<config>-cxx' keys",
+    )
+    ap.add_argument(
         "--out", default=os.path.join(_REPO, "BASELINE_MEASURED.json"),
         help="merge results into this JSON file ('' disables)",
     )
@@ -883,9 +902,10 @@ def main() -> int:
         ["example", "gpushare", "synthetic", "affinity", "defrag"]
         if args.config == "all" else [args.config]
     )
+    suffix = "-cxx" if args.impl == "c++" else ""
     results = {}
     for name in names:
-        results[name] = run_config(name, args)
+        results[name + suffix] = run_config(name, args)
 
     if args.out:
         merged = {}

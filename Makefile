@@ -1,10 +1,16 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test test-quick test-slow bench bench-all bench-serial docs native all
+.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all
 
 all: test
 
 test:
 	python -m pytest tests/ -q
+
+# run the moment the TPU tunnel opens (tools/tpu_probe_loop.sh writes
+# /tmp/opensim-tpu-watch.up): compiled-Mosaic parity suite + full bench
+# sweep + scenarios/s/chip, logged to TPU_REVALIDATION.log
+tpu-revalidate:
+	sh tools/tpu_revalidate.sh
 
 # inner-loop tier (<90 s): skips the nightly oracle/fuzz/multihost/parity
 # matrix suites — run `make test` (both tiers) before shipping

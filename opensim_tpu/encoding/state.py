@@ -183,8 +183,10 @@ class ClusterEncoder:
         self.ts = TemplateSet()
         self.nodes: List[Node] = []
         self.node_index: Dict[str, int] = {}
-        self.pod_tmpl: List[int] = []
         self.node_pad = node_pad
+        # encoded labels per node, built once at add_nodes and reused by
+        # build() — encode_labels is 2×5k calls at headline shape otherwise
+        self._node_enc: List[Dict[int, Tuple[int, float]]] = []
 
     # -- ingestion ----------------------------------------------------------
 
@@ -195,7 +197,9 @@ class ClusterEncoder:
             self.node_index[n.metadata.name] = len(self.nodes)
             self.nodes.append(n)
             # Pre-intern label/taint strings so vocab is complete.
-            encode_labels(self.vocab, n.metadata.labels, {"metadata.name": n.metadata.name})
+            self._node_enc.append(
+                encode_labels(self.vocab, n.metadata.labels, {"metadata.name": n.metadata.name})
+            )
             for t in n.taints:
                 self.vocab.key_id(t.key)
                 self.vocab.val_id(t.value)
@@ -203,9 +207,7 @@ class ClusterEncoder:
                 self.vocab.resource_id(r)
 
     def add_pod(self, pod: Pod, owner_selector: Optional[dict] = None, hint: Optional[tuple] = None) -> int:
-        tid = self.ts.add_pod(pod, owner_selector, hint=hint)
-        self.pod_tmpl.append(tid)
-        return tid
+        return self.ts.add_pod(pod, owner_selector, hint=hint)
 
     # -- template feature interning (strings → ids) -------------------------
 
@@ -353,9 +355,7 @@ class ClusterEncoder:
                 taint_key[i, j] = vb.key_id(t.key)
                 taint_val[i, j] = vb.val_id(t.value)
                 taint_effect[i, j] = V.EFFECT_CODES.get(t.effect, -1)
-            for kid, (vid, num) in encode_labels(
-                vb, n.metadata.labels, {"metadata.name": n.metadata.name}
-            ).items():
+            for kid, (vid, num) in self._node_enc[i].items():
                 if kid < K:
                     label_val[i, kid] = vid
                     label_num[i, kid] = num

@@ -168,6 +168,10 @@ class TemplateSet:
             idx = self._hint_index.get(hint)
             if idx is not None:
                 return idx
+        # owner_selector may be a callable (lazy): hint hits above never pay
+        # the selector dict build, only actual extractions do
+        if callable(owner_selector):
+            owner_selector = owner_selector()
         tmpl = self._extract(pod, owner_selector)
         key = self._canon_key(tmpl)
         idx = self._index.get(key)

@@ -339,6 +339,13 @@ def prepare(
 ) -> Optional[Prepared]:
     """Expand cluster + app workloads into an ordered pod stream and encode
     everything into device tensors. Returns None when there are no pods."""
+    from ..utils.gcpause import gc_paused
+
+    with gc_paused():
+        return _prepare_inner(cluster, apps, use_greed, node_pad, patch_pods_fn)
+
+
+def _prepare_inner(cluster, apps, use_greed, node_pad, patch_pods_fn):
     enc = ClusterEncoder(node_pad=node_pad)
     enc.add_nodes(cluster.nodes)
 
@@ -348,6 +355,7 @@ def prepare(
     for p in _cluster_pods(cluster):
         ordered.append(p)
         forced.append(bool(p.spec.node_name))
+    n_cluster = len(ordered)  # pods below went through patch_pods_fn
 
     for app in apps:
         app_pods = expand.generate_pods_from_resources(app.resources, cluster.nodes)
@@ -366,14 +374,36 @@ def prepare(
     if not ordered:
         return None
 
+    # pods of one workload share a template: the hint short-circuits
+    # canonical extraction (TemplateSet._hint_index) and the lazy selector
+    # callable skips the per-pod dict build on hint hits. patch_pods_fn may
+    # have mutated individual app pods, which the workload-identity hint
+    # cannot see — those pods take the content-keyed extraction path.
     tmpl_ids = np.array(
-        [enc.add_pod(p, _owner_selector(p), hint=_tmpl_hint(p)) for p in ordered], dtype=np.int32
+        [
+            enc.add_pod(
+                p,
+                (lambda p=p: _owner_selector(p)),
+                hint=None if (patch_pods_fn is not None and i >= n_cluster) else _tmpl_hint(p),
+            )
+            for i, p in enumerate(ordered)
+        ],
+        dtype=np.int32,
     )
     ec_np, st0, meta = enc.build()
     features = kernels.features_of(ec_np)
     ec, st0 = to_device(ec_np, st0)
     node_idx = {name: i for i, name in enumerate(meta.node_names)}
-    ds_target = [node_idx.get(pinned_node_name(p), -1) for p in ordered]
+    # only DaemonSet expansion creates metadata.name matchFields pins; the
+    # consumers (planner/defrag scenario masks) specifically want "DaemonSet
+    # pod pinned to node i" — a bare pinned pod must stay in the stream and
+    # fail visibly when its node vanishes, not be masked out like a DS pod
+    ds_target = [
+        node_idx.get(pinned_node_name(p), -1)
+        if p.metadata.annotations.get(ANNO_WORKLOAD_KIND) == "DaemonSet"
+        else -1
+        for p in ordered
+    ]
     return Prepared(
         ec=ec,
         st0=st0,
@@ -412,6 +442,8 @@ def simulate(
     extra_plugins: tuple = (),
     enable_preemption: bool = False,
     tie_seed: Optional[int] = None,
+    prep: Optional["Prepared"] = None,
+    node_valid: Optional[np.ndarray] = None,
 ) -> SimulateResult:
     """One full simulation: cluster pods then apps in order. `sched_config`
     is an optional SchedulerConfig (the --default-scheduler-config merge);
@@ -419,15 +451,30 @@ def simulate(
     (pkg/simulator/simulator.go:243-249, :471-500) — a caller hook that may
     mutate each app's expanded pods before they are scheduled.
     `extra_plugins` is the WithExtraRegistry equivalent: out-of-tree
-    jittable filter/score plugins (see kernels.pod_step)."""
+    jittable filter/score plugins (see kernels.pod_step).
+
+    `prep`/`node_valid` (planner prep reuse — VERDICT r4 #5): run against
+    an existing Prepared whose node axis is masked down to `node_valid`.
+    `cluster.nodes` must be exactly the valid prefix of the prepared node
+    order (the planner slices its candidate list). Placements, reasons and
+    node annotations are identical to a fresh prepare of the sub-cluster:
+    invalid nodes never enter any filter-failure bucket
+    (kernels.precompute_static starts its fold from node_valid) and
+    DaemonSet pods pinned to masked-out candidates are dropped from the
+    stream exactly as a smaller expansion would never create them."""
     from ..utils.trace import Trace
 
     _validate_extra_plugins(extra_plugins)
+    if prep is not None and enable_preemption:
+        raise ValueError("prep reuse does not support enable_preemption; pass prep=None")
     with Trace("Simulate", threshold_s=1.0) as tr:
-        prep = prepare(
-            cluster, apps, use_greed=use_greed, node_pad=node_pad, patch_pods_fn=patch_pods_fn
-        )
-        tr.step("expand and encode")
+        if prep is None:
+            prep = prepare(
+                cluster, apps, use_greed=use_greed, node_pad=node_pad, patch_pods_fn=patch_pods_fn
+            )
+            tr.step("expand and encode")
+        else:
+            tr.step("reuse prepared encoding")
         if prep is None:
             return SimulateResult(
                 node_status=[NodeStatus(node=n, pods=[]) for n in cluster.nodes]
@@ -435,7 +482,29 @@ def simulate(
         ec, st0, meta = prep.ec, prep.st0, prep.meta
         ordered, tmpl_ids, forced = prep.ordered, prep.tmpl_ids, prep.forced
 
+        nv_mask: Optional[np.ndarray] = None
+        drop_pods: set = set()
+        if node_valid is not None:
+            nv_mask = np.asarray(node_valid, dtype=bool)
+            if nv_mask.shape[0] != int(np.asarray(prep.ec_np.node_valid).shape[0]):
+                raise ValueError("node_valid mask must cover the prepared (padded) node axis")
+            names = [n.metadata.name for n in cluster.nodes]
+            if names != list(meta.node_names[: len(names)]):
+                raise ValueError(
+                    "cluster.nodes must be the valid prefix of the prepared node order"
+                )
+            n_valid = int(nv_mask.sum())
+            if n_valid != len(names) or not nv_mask[:n_valid].all():
+                raise ValueError("node_valid must select exactly cluster.nodes as a prefix")
+            # DaemonSet pods pinned to masked-out nodes would not exist in a
+            # fresh expansion of the sub-cluster: drop them from the stream
+            drop_pods = {
+                i for i, t in enumerate(prep.ds_target) if t >= 0 and not nv_mask[t]
+            }
+
         pod_valid = np.ones((len(ordered),), dtype=bool)
+        for i in drop_pods:
+            pod_valid[i] = False
         # multi-profile KubeSchedulerConfiguration: route the stream onto one
         # effective config; pods naming an unknown profile never enter any
         # scheduling queue (kube event-handler filtering) and are reported
@@ -467,7 +536,9 @@ def simulate(
         # These pre-import gates mirror the first checks of fastpath.why_not
         # (which stays authoritative once the module is imported) — they
         # exist only so the import itself can be skipped.
-        if sched_config is not None:
+        if nv_mask is not None:
+            skips["megakernel"] = "masked re-simulation (planner prep reuse) runs on the C++/XLA engines"
+        elif sched_config is not None:
             skips["megakernel"] = "non-default scheduler config"
         elif extra_plugins:
             skips["megakernel"] = "out-of-tree extra_plugins run on the XLA scan"
@@ -544,15 +615,20 @@ def simulate(
                 # C++ scan engine: identical placements to the XLA scan with
                 # exact in-stream failure attribution; the default on hosts
                 # without an accelerator (tests/test_native.py asserts parity).
-                out = nativepath.schedule(prep, pod_valid, config=sched_config)
+                out = nativepath.schedule(
+                    prep, pod_valid, config=sched_config, node_valid=nv_mask
+                )
                 engine_name = "native"
             else:
                 skips["native"] = miss
                 log.info("native engine skipped: %s", miss)
         if out is None:
             tmpl_p, valid_p, forced_p = pad_pod_stream(tmpl_ids, pod_valid, forced)
+            ec_run = (
+                ec._replace(node_valid=jnp.asarray(nv_mask)) if nv_mask is not None else ec
+            )
             out = schedule_pods(
-                ec, st0, tmpl_p, valid_p, forced_p,
+                ec_run, st0, tmpl_p, valid_p, forced_p,
                 features=prep.features, config=sched_config, extra_plugins=extra_plugins,
                 unroll=scan_unroll(), tie_seed=tie_seed,
             )
@@ -594,14 +670,36 @@ def simulate(
         )
         out = out._replace(final_state=fs._replace(used=used, **state))
 
+    from ..utils.gcpause import gc_paused
+
     node_pods: Dict[str, List[Pod]] = {n.metadata.name: [] for n in cluster.nodes}
     unscheduled: List[UnscheduledPod] = []
-    n_nodes = meta.n_real_nodes
+    n_nodes = int(nv_mask.sum()) if nv_mask is not None else meta.n_real_nodes
     node_names = meta.node_names
-    pod_lists = [node_pods[n] for n in node_names]
+    # masked runs: candidate nodes beyond the valid prefix have no report
+    # bucket (chosen never points at an invalid node)
+    pod_lists = [node_pods.get(n) for n in node_names]
     gpu_any = gpu_take.sum(axis=1) > 0  # one vectorized pass, not per-pod sums
 
+    with gc_paused():
+        statuses = _decode(
+            ordered, chosen, forced, custom_reasons, victims_of, gpu_any, gpu_take,
+            tmpl_ids, static_fail, fail_counts, insufficient, meta, n_nodes,
+            node_names, pod_lists, node_pods, unscheduled, cluster, out, drop_pods,
+        )
+    return SimulateResult(unscheduled_pods=unscheduled, node_status=statuses, engine=engine)
+
+
+def _decode(
+    ordered, chosen, forced, custom_reasons, victims_of, gpu_any, gpu_take,
+    tmpl_ids, static_fail, fail_counts, insufficient, meta, n_nodes,
+    node_names, pod_lists, node_pods, unscheduled, cluster, out, drop_pods=(),
+):
     for i, pod in enumerate(ordered):
+        if i in drop_pods:
+            # DaemonSet pod pinned to a masked-out candidate node: a fresh
+            # expansion of the sub-cluster would never have created it
+            continue
         c = int(chosen[i])
         if forced[i] and c < 0:
             unscheduled.append(UnscheduledPod(pod, f'node "{pod.spec.node_name}" not found'))
@@ -641,8 +739,7 @@ def simulate(
                 )
             )
 
-    statuses = _node_statuses(cluster.nodes, node_pods, out, meta)
-    return SimulateResult(unscheduled_pods=unscheduled, node_status=statuses, engine=engine)
+    return _node_statuses(cluster.nodes, node_pods, out, meta)
 
 
 def _node_statuses(nodes, node_pods, out, meta: ClusterMeta) -> List[NodeStatus]:

@@ -393,20 +393,23 @@ def run_serial_native(cluster, apps, progress: bool = False):
     from ..models import expand
     from ..models.objects import LABEL_APP_NAME
 
+    from ..utils.gcpause import gc_paused
+
     lib = load()
     if lib is None:
         raise RuntimeError(f"serial engine unavailable: {_lib_error}")
 
     t0 = time.time()
     stream: List[Tuple[Pod, bool]] = []
-    for p in _cluster_pods(cluster):
-        stream.append((p, bool(p.spec.node_name)))
-    for app in apps:
-        pods = expand.generate_pods_from_resources(app.resources, cluster.nodes)
-        for p in pods:
-            p.metadata.labels.setdefault(LABEL_APP_NAME, app.name)
-        pods = queues.toleration_sort(queues.affinity_sort(pods))
-        stream.extend((p, bool(p.spec.node_name)) for p in pods)
+    with gc_paused():
+        for p in _cluster_pods(cluster):
+            stream.append((p, bool(p.spec.node_name)))
+        for app in apps:
+            pods = expand.generate_pods_from_resources(app.resources, cluster.nodes)
+            for p in pods:
+                p.metadata.labels.setdefault(LABEL_APP_NAME, app.name)
+            pods = queues.toleration_sort(queues.affinity_sort(pods))
+            stream.extend((p, bool(p.spec.node_name)) for p in pods)
     expand_s = time.time() - t0
 
     buf = marshal(cluster.nodes, stream)

@@ -1,0 +1,29 @@
+"""Pause the cyclic GC across bulk allocate-and-retain phases.
+
+Workload expansion materializes ~10 container objects per pod and RETAINS
+them all, so the generational collector re-scans a monotonically growing
+heap several times per plan — and jax registers a gc callback that makes
+every collection pricier still. Measured at the 50k-pod headline shape:
+expansion drops 0.94 s → 0.22 s with collection paused (the objects are
+acyclic; nothing is freed mid-phase anyway, so pausing loses nothing —
+CPython's refcounting still reclaims all non-cyclic garbage immediately).
+"""
+
+from __future__ import annotations
+
+import gc
+from contextlib import contextmanager
+
+
+@contextmanager
+def gc_paused():
+    """Disable cyclic collection for the duration; nestable and exception
+    safe. No-op when collection is already disabled (outer pause wins)."""
+    if not gc.isenabled():
+        yield
+        return
+    gc.disable()
+    try:
+        yield
+    finally:
+        gc.enable()

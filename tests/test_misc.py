@@ -208,6 +208,30 @@ def test_patch_pods_fn_hook():
     assert all(p.metadata.annotations.get("patched") == "yes" for ns in res.node_status for p in ns.pods)
 
 
+def test_patch_pods_fn_per_pod_mutation_is_honored():
+    """A hook that mutates ONE pod of a workload must change that pod's
+    scheduling: workload-identity template hints are bypassed for patched
+    app pods (the hint cannot see per-pod spec edits)."""
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n1", "4", "8Gi"))
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("w", 5, "1", "1Gi"))
+
+    def patch(app_name, pods):
+        # pod 3 alone demands more cpu than the node has. Clones share
+        # nested spec lists, so a per-pod edit replaces the container list.
+        import copy
+
+        containers = copy.deepcopy(pods[3].spec.containers)
+        containers[0].requests["cpu"] = 100.0
+        pods[3].spec.containers = containers
+
+    res = simulate(cluster, [AppResource("a", app)], patch_pods_fn=patch)
+    assert len(res.unscheduled_pods) == 1
+    assert "Insufficient cpu" in res.unscheduled_pods[0].reason
+    assert sum(len(ns.pods) for ns in res.node_status) == 4
+
+
 def test_server_newnodes_become_fake_nodes():
     from http.server import ThreadingHTTPServer
 

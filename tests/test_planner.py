@@ -179,3 +179,63 @@ def test_rest_server_deploy_and_healthz():
             assert e.code == 400
     finally:
         httpd.shutdown()
+
+
+def test_masked_prep_reuse_matches_fresh_simulate():
+    """Planner prep reuse (VERDICT r4 #5): a masked re-simulation over the
+    full-candidate Prepared must equal a fresh simulate of the sub-cluster
+    — placements (by workload counts per node), unschedulable reasons, and
+    the report-visible node set."""
+    import collections
+    import copy
+
+    import numpy as np
+
+    from opensim_tpu.engine.simulator import prepare
+    from opensim_tpu.models import expand
+
+    cluster = ResourceTypes()
+    for i in range(4):
+        cluster.nodes.append(
+            fx.make_fake_node(
+                f"n{i}", "8", "16Gi", "110",
+                fx.with_labels({"topology.kubernetes.io/zone": f"z{i % 2}"}),
+            )
+        )
+    cluster.daemon_sets.append(fx.make_fake_daemon_set("logger", "100m", "64Mi"))
+    rt = ResourceTypes()
+    rt.deployments.append(fx.make_fake_deployment("web", 120, "1", "2Gi"))
+    apps = [AppResource("web", rt)]
+
+    template = fx.make_fake_node("tmpl", "16", "32Gi")
+    candidates = expand.new_fake_nodes(template, 8)
+    full = copy.copy(cluster)
+    full.nodes = list(cluster.nodes) + candidates
+
+    def agg(res):
+        out = {}
+        for ns in res.node_status:
+            c = collections.Counter()
+            for p in ns.pods:
+                kind = p.metadata.annotations.get("simon/workload-kind")
+                wl = p.metadata.annotations.get("simon/workload-name") or p.metadata.name
+                c["web" if kind == "ReplicaSet" else wl] += 1
+            out[ns.node.metadata.name] = dict(c)
+        return out
+
+    for k in (0, 3, 8):
+        sub = copy.copy(cluster)
+        sub.nodes = list(cluster.nodes) + candidates[:k]
+        prep_full = prepare(full, apps)  # fresh each k: decode mutates pods
+        mask = np.zeros(np.asarray(prep_full.ec_np.node_valid).shape[0], bool)
+        mask[: len(sub.nodes)] = True
+        masked = simulate(sub, apps, prep=prep_full, node_valid=mask)
+        fresh = simulate(sub, apps)
+        assert agg(masked) == agg(fresh), f"k={k}"
+        assert sorted(u.reason for u in masked.unscheduled_pods) == sorted(
+            u.reason for u in fresh.unscheduled_pods
+        ), f"k={k}"
+        # the masked run reports exactly the sub-cluster's nodes
+        assert [ns.node.metadata.name for ns in masked.node_status] == [
+            n.metadata.name for n in sub.nodes
+        ]

@@ -1,0 +1,56 @@
+#!/bin/sh
+# One-command TPU revalidation (VERDICT r4 #1): run the moment the axon
+# tunnel opens (`/tmp/opensim-tpu-watch.up` appears, or `make tpu-probe`
+# succeeds). Everything is timeout-wrapped because a dying tunnel hangs
+# any device op forever.
+#
+#   make tpu-revalidate          # = sh tools/tpu_revalidate.sh
+#
+# Produces TPU_REVALIDATION.log (full output) and prints a summary. Steps:
+#  1. probe the accelerator (fail fast if the tunnel is down)
+#  2. compiled-Mosaic test pass: every megakernel/sweep parity test that
+#     round 3-5 added on top of the last silicon-validated commit c4ea5bd
+#  3. bench.py on every BASELINE config + the 100k/10k double-scale point
+#  4. the batched-sweep scenarios/s/chip number (target >=50)
+set -u
+REPO=$(cd "$(dirname "$0")/.." && pwd)
+cd "$REPO" || exit 1
+LOG="$REPO/TPU_REVALIDATION.log"
+: > "$LOG"
+say() { echo "== $*" | tee -a "$LOG"; }
+
+say "probe"
+if ! timeout 120 python -c "
+import jax, numpy as np
+d = jax.devices()
+assert d and d[0].platform == 'tpu', d
+x = np.asarray(jax.numpy.ones((8, 8)) * 2)
+assert float(x.sum()) == 128.0
+print('TPU OK:', d)
+" >> "$LOG" 2>&1; then
+  say "FAIL: accelerator unreachable (tunnel down) — see $LOG"
+  exit 1
+fi
+
+say "compiled-Mosaic test pass (fastpath + sweeps + kernel parity)"
+timeout 3000 env OPENSIM_TEST_BACKEND=tpu python -m pytest \
+  tests/test_fastpath.py tests/test_fastpath_fuzz.py tests/test_parallel.py \
+  tests/test_kernel_parity.py -q >> "$LOG" 2>&1
+TESTS_RC=$?
+say "tests rc=$TESTS_RC (0 = all compiled-Mosaic parity tests green)"
+
+say "bench: headline + all configs"
+for ARGS in "" "--config bigu" "--config forced" "--config affinity --pods 5000 --nodes 500" \
+            "--config example" "--config gpushare" "--pods 100000 --nodes 10000"; do
+  say "bench.py $ARGS"
+  timeout 1200 python bench.py $ARGS >> "$LOG" 2>&1 || say "  (rc=$? for '$ARGS')"
+done
+
+say "batched sweep scenarios/s/chip (target >=50)"
+timeout 1200 python bench.py --config defrag --scenarios 64 --nodes 200 --pods 2000 >> "$LOG" 2>&1
+timeout 1800 python bench.py --config defrag --scenarios 1000 --nodes 1000 --pods 10000 >> "$LOG" 2>&1
+
+say "summary (JSON lines measured above)"
+grep -h '^{' "$LOG" | tee -a /dev/null
+say "done — paste the JSON lines into BENCH.md (round-5 TPU table), update README headline, and commit"
+[ "$TESTS_RC" -eq 0 ] || exit 1

@@ -73,7 +73,8 @@ def build_parser() -> argparse.ArgumentParser:
         "--tie-break", default="lowest", metavar="lowest|sample[:seed]",
         help="equal-score node selection: deterministic lowest index "
         "(default) or the reference's sampled tie-break, seeded for "
-        "reproducible distribution-comparison runs (forces the XLA scan)",
+        "reproducible distribution-comparison runs (C++ engine or XLA "
+        "scan; the Pallas megakernel stays lowest-index)",
     )
 
     defrag_p = sub.add_parser(

@@ -48,9 +48,8 @@ def applicable(prep, config=None, extra_plugins: tuple = ()) -> bool:
 
 def why_not(prep, config=None, extra_plugins: tuple = (), tie_seed=None):
     """Selection check for the C++ engine: returns None when it should run,
-    else a one-line reason (engine attribution — VERDICT r4 #3)."""
-    if tie_seed is not None:
-        return "sampled tie-break runs on the XLA scan"
+    else a one-line reason (engine attribution — VERDICT r4 #3). tie_seed
+    is accepted: the engine implements the seeded sampled tie-break."""
     if extra_plugins:
         return "out-of-tree extra_plugins are jittable callables (XLA scan only)"
     if config is not None and getattr(config, "fit_ignored_cols", ()):
@@ -95,10 +94,13 @@ def _stat_np(prep, config, node_valid=None):
     return kernels.precompute_static_np(ec, config, core=core)
 
 
-def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=None):
+def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=None,
+             tie_seed=None):
     """Run the whole pod stream through the C++ engine. Returns a
     ``ScheduleOutput`` (numpy arrays throughout). `node_valid`/`forced`
-    override the prepared masks (scenario sweeps)."""
+    override the prepared masks (scenario sweeps). `tie_seed` switches
+    selection to seeded uniform sampling over the score maxima (the
+    reference's selectHost reservoir distribution)."""
     from .. import native
     from .scheduler import ScheduleOutput
 
@@ -162,6 +164,7 @@ def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=N
         "ft_gc_dyn": feat.gc_dyn,
         "cf_ports": cfg.f_ports, "cf_fit": cfg.f_fit, "cf_spread": cfg.f_spread,
         "cf_interpod": cfg.f_interpod, "cf_gpu": cfg.f_gpu, "cf_local": cfg.f_local,
+        "tie_sample": tie_seed is not None, "tie_seed": tie_seed or 0,
     }
     weights = {k: getattr(cfg, k) for k in (
         "w_balanced", "w_least", "w_node_affinity", "w_taint_toleration",

@@ -543,7 +543,7 @@ def simulate(
         elif extra_plugins:
             skips["megakernel"] = "out-of-tree extra_plugins run on the XLA scan"
         elif tie_seed is not None:
-            skips["megakernel"] = "sampled tie-break runs on the XLA scan"
+            skips["megakernel"] = "sampled tie-break runs on the C++ engine or XLA scan"
         elif jax.default_backend() != "tpu" and not interpret:
             skips["megakernel"] = (
                 f"no TPU backend (jax.default_backend()={jax.default_backend()!r})"
@@ -616,7 +616,8 @@ def simulate(
                 # exact in-stream failure attribution; the default on hosts
                 # without an accelerator (tests/test_native.py asserts parity).
                 out = nativepath.schedule(
-                    prep, pod_valid, config=sched_config, node_valid=nv_mask
+                    prep, pod_valid, config=sched_config, node_valid=nv_mask,
+                    tie_seed=tie_seed,
                 )
                 engine_name = "native"
             else:

@@ -38,6 +38,8 @@ _FEATURES = [
     "ft_prefer_avoid", "ft_gc_dyn",
 ]
 _FILTER_ENABLES = ["cf_ports", "cf_fit", "cf_spread", "cf_interpod", "cf_gpu", "cf_local"]
+# sampled tie-break knobs (--tie-break=sample[:seed])
+_SELECT = ["tie_sample", "tie_seed"]
 _WEIGHTS = [
     "w_balanced", "w_least", "w_node_affinity", "w_taint_toleration",
     "w_interpod", "w_spread", "w_prefer_avoid", "w_simon", "w_gpu_share",
@@ -86,7 +88,7 @@ _NP_DTYPES = {"u8": "uint8", "i32": "int32", "f32": "float32"}
 
 class ScanArgs(ctypes.Structure):
     _fields_ = (
-        [(n, ctypes.c_int64) for n in _DIMS + _FEATURES + _FILTER_ENABLES]
+        [(n, ctypes.c_int64) for n in _DIMS + _FEATURES + _FILTER_ENABLES + _SELECT]
         + [(n, ctypes.c_double) for n in _WEIGHTS]
         + [(n, t) for n, t, _ in _BUFFERS]
     )
@@ -200,6 +202,8 @@ def run_scan(dims: dict, weights: dict, buffers: dict) -> None:
     args = ScanArgs()
     for n in _DIMS + _FEATURES + _FILTER_ENABLES:
         setattr(args, n, int(dims[n]))
+    for n in _SELECT:
+        setattr(args, n, int(dims.get(n, 0)))
     for n in _WEIGHTS:
         setattr(args, n, float(weights[n]))
     keep = []  # hold array refs across the call

@@ -33,6 +33,16 @@ constexpr float MAXS = 100.0f;  // MAX_NODE_SCORE
 
 extern "C" {
 
+// splitmix64: the per-step PRNG behind the sampled tie-break (seeded,
+// reproducible; stream = f(tie_seed, step index))
+static inline uint64_t sm64_next(uint64_t* x) {
+  *x += 0x9E3779B97F4A7C15ULL;
+  uint64_t z = *x;
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
 struct ScanArgs {
   // --- dims (all int64; keep order in sync with native/__init__.py) ---
   int64_t N, R, U, P, Tk, Dp1, A, Hp, Hports, Cs, Ti, Tn, Tpp, G, Gp, Gd, Vg, Dv, Mv;
@@ -44,6 +54,10 @@ struct ScanArgs {
   // filter enables (SchedulerConfig.f_*; static-filter disables are already
   // folded into static_pass by precompute_static)
   int64_t cf_ports, cf_fit, cf_spread, cf_interpod, cf_gpu, cf_local;
+  // sampled tie-break (--tie-break=sample[:seed]): uniform choice among the
+  // score maxima per step — the distribution of the reference's selectHost
+  // reservoir sampling (generic_scheduler.go:188-210)
+  int64_t tie_sample, tie_seed;
   // score weights (SchedulerConfig.w_*; double like the Python floats, cast
   // to f32 at the same point jnp's weak-type promotion does)
   double w_balanced, w_least, w_node_affinity, w_taint_toleration, w_interpod,
@@ -1082,8 +1096,19 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
         best = std::max(best, v);
       }
       if (best > NEG) {
-        for (int64_t n = 0; n < N; n++)
-          if (fe[n] && sc[n] == best) { bi = (int32_t)n; break; }
+        if (a.tie_sample) {
+          // reservoir over the score maxima: uniform, seeded per step
+          uint64_t rs = (uint64_t)a.tie_seed * 0x9E3779B97F4A7C15ULL + (uint64_t)i;
+          uint64_t c = 0;
+          for (int64_t n = 0; n < N; n++)
+            if (fe[n] && sc[n] == best) {
+              c++;
+              if (sm64_next(&rs) % c == 0) bi = (int32_t)n;
+            }
+        } else {
+          for (int64_t n = 0; n < N; n++)
+            if (fe[n] && sc[n] == best) { bi = (int32_t)n; break; }
+        }
       }
       prof.stop(2);
 
@@ -1217,6 +1242,8 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
 
     float best = NEG;
     int32_t bi = -1;
+    uint64_t tie_c = 0;
+    uint64_t rs = (uint64_t)a.tie_seed * 0x9E3779B97F4A7C15ULL + (uint64_t)i;
     for (int64_t n = 0; n < N; n++) {
       if (!s.feas[n]) continue;
       float sc = pre_at(a, pc, n);
@@ -1240,7 +1267,20 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
       if (use_loc)
         sc += wloc * (lc_rng > 0.0f ? (s.raw_loc[n] - lc_lo) * MAXS / lc_rng : 0.0f);
       if (use_avoid) sc += wav * avoid[n];
-      if (sc > best) { best = sc; bi = (int32_t)n; }
+      if (a.tie_sample) {
+        // one-pass reservoir: reset on a new max, uniform among equals
+        if (sc > best) {
+          best = sc;
+          bi = (int32_t)n;
+          tie_c = 1;
+        } else if (sc == best && bi >= 0) {
+          tie_c++;
+          if (sm64_next(&rs) % tie_c == 0) bi = (int32_t)n;
+        }
+      } else if (sc > best) {
+        best = sc;
+        bi = (int32_t)n;
+      }
     }
 
     a.chosen[i] = bi;

@@ -47,8 +47,8 @@ for ARGS in "" "--config bigu" "--config forced" "--config affinity --pods 5000 
 done
 
 say "batched sweep scenarios/s/chip (target >=50)"
-timeout 1200 python bench.py --config defrag --scenarios 64 --nodes 200 --pods 2000 >> "$LOG" 2>&1
-timeout 1800 python bench.py --config defrag --scenarios 1000 --nodes 1000 --pods 10000 >> "$LOG" 2>&1
+timeout 1200 python bench.py --config defrag --scenarios 64 --nodes 200 --pods 2000 >> "$LOG" 2>&1 || say "  (rc=$? for small sweep)"
+timeout 1800 python bench.py --config defrag --scenarios 1000 --nodes 1000 --pods 10000 >> "$LOG" 2>&1 || say "  (rc=$? for 1000-scenario sweep)"
 
 say "summary (JSON lines measured above)"
 grep -h '^{' "$LOG" | tee -a /dev/null

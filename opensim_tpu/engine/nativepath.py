@@ -90,23 +90,40 @@ def _stat_np(prep, config, node_valid=None):
         except AttributeError:
             pass
     if node_valid is not None:
+        # scenario sweeps: every mask is distinct — caching the [U, N]-scale
+        # fold per mask would trade unbounded memory for nothing
         ec = ec._replace(node_valid=np.ascontiguousarray(node_valid, dtype=bool))
-    return kernels.precompute_static_np(ec, config, core=core)
+        return kernels.precompute_static_np(ec, config, core=core)
+    # per-config fold cache: segmented multi-profile runs revisit the same
+    # few configs once per segment; identical folds are reused
+    cache = getattr(prep, "_np_stat_cache", None)
+    if cache is None:
+        cache = {}
+        try:
+            prep._np_stat_cache = cache
+        except AttributeError:
+            pass
+    stat = cache.get(config)
+    if stat is None:
+        stat = cache[config] = kernels.precompute_static_np(ec, config, core=core)
+    return stat
 
 
 def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=None,
-             tie_seed=None):
+             tie_seed=None, st0=None):
     """Run the whole pod stream through the C++ engine. Returns a
     ``ScheduleOutput`` (numpy arrays throughout). `node_valid`/`forced`
     override the prepared masks (scenario sweeps). `tie_seed` switches
     selection to seeded uniform sampling over the score maxima (the
-    reference's selectHost reservoir distribution)."""
+    reference's selectHost reservoir distribution). `st0` overrides the
+    initial carry (segmented multi-profile runs chain scans)."""
     from .. import native
     from .scheduler import ScheduleOutput
 
     cfg = config or DEFAULT_CONFIG
     ec = prep.ec_np
-    st0 = prep.st0
+    if st0 is None:
+        st0 = prep.st0
     feat = prep.features
     stat = _stat_np(prep, config, node_valid=node_valid)
     node_valid_arr = ec.node_valid if node_valid is None else node_valid

@@ -295,28 +295,18 @@ def load_scheduler_config(path: str):
     return SchedulerProfiles(profiles=profiles)
 
 
-def resolve_profiles(sched_config, ordered, resource_names, forced=None):
-    """Route the pod stream onto one effective SchedulerConfig.
+# pathological profile alternation would mean one scan per pod; above this
+# many contiguous segments the stream is treated as non-segmentable
+MAX_PROFILE_SEGMENTS = 64
 
-    Returns (config_or_None, invalid) where `invalid` maps pod index →
-    unschedulable reason for pods whose spec.schedulerName matches no
-    profile (kube's event handlers never admit them to the queue, so they
-    stay Pending forever; the simulation reports that explicitly).
 
-    Force-bound pods (``forced`` mask) never route: they bypass every
-    scheduler (simulator.go:329-331), so their schedulerName neither
-    invalidates them nor counts toward the referenced-profile set.
-
-    Unforced pods referencing two or more profiles whose resolved configs
-    DIFFER raise ValueError — per-pod plugin pipelines inside one compiled
-    scan are not supported, and silently using one profile for all would
-    be wrong. Identical profiles under different names resolve fine.
-    """
-    if sched_config is None or isinstance(sched_config, SchedulerConfig):
-        return sched_config, {}
-    if not isinstance(sched_config, SchedulerProfiles):
-        raise ValueError(f"unsupported scheduler config object: {sched_config!r}")
-
+def _route_stream(sched_config, ordered, resource_names, forced=None):
+    """Shared profile routing: returns (segments, invalid, used) where
+    ``segments`` is ``[(config_or_None, lo, hi)]`` contiguous same-profile
+    runs covering the stream in order, ``invalid`` maps pod index →
+    unknown-profile reason, and ``used`` maps profile name → resolved
+    config (None for unknown names). Both public resolvers wrap this so
+    the per-profile column resolution and reason wording cannot drift."""
     def resolve_cols(profile: Profile) -> SchedulerConfig:
         cols = []
         for i, rname in enumerate(resource_names):
@@ -328,24 +318,52 @@ def resolve_profiles(sched_config, ordered, resource_names, forced=None):
 
     invalid = {}
     used = {}
+    segments = []
+    cur_cfg = None
+    have_cur = False
+    lo = 0
     for i, pod in enumerate(ordered):
         if forced is not None and forced[i]:
-            continue
+            continue  # bypasses every scheduler (simulator.go:329-331)
         name = pod.spec.scheduler_name or DEFAULT_SCHEDULER_NAME
-        if name in used:
-            continue
-        profile = sched_config.lookup(name)
-        used[name] = None if profile is None else resolve_cols(profile)
-    for i, pod in enumerate(ordered):
-        if forced is not None and forced[i]:
-            continue
-        name = pod.spec.scheduler_name or DEFAULT_SCHEDULER_NAME
-        if used.get(name) is None:
+        if name not in used:
+            profile = sched_config.lookup(name)
+            used[name] = None if profile is None else resolve_cols(profile)
+        cfg = used[name]
+        if cfg is None:
             invalid[i] = (
                 f"no scheduler profile named {name!r} "
                 "(pod never enters any profile's scheduling queue)"
             )
-    distinct = {cfg for cfg in used.values() if cfg is not None}
+            continue  # never scheduled; extends the active segment
+        if not have_cur:
+            cur_cfg, have_cur = cfg, True
+        elif cfg != cur_cfg:
+            segments.append((cur_cfg, lo, i))
+            cur_cfg, lo = cfg, i
+    segments.append((cur_cfg if have_cur else None, lo, len(ordered)))
+    return segments, invalid, used
+
+
+def resolve_profiles(sched_config, ordered, resource_names, forced=None):
+    """Route the pod stream onto ONE effective SchedulerConfig.
+
+    Returns (config_or_None, invalid) where `invalid` maps pod index →
+    unschedulable reason for pods whose spec.schedulerName matches no
+    profile (kube's event handlers never admit them to the queue, so they
+    stay Pending forever; the simulation reports that explicitly).
+
+    Unforced pods referencing two or more profiles whose resolved configs
+    DIFFER raise ValueError — the callers of this resolver (batched
+    scenario sweeps) run one compiled pipeline for the whole stream.
+    ``simulate`` routes through :func:`resolve_profile_segments` instead,
+    which supports differing profiles as consecutive scans."""
+    if sched_config is None or isinstance(sched_config, SchedulerConfig):
+        return sched_config, {}
+    if not isinstance(sched_config, SchedulerProfiles):
+        raise ValueError(f"unsupported scheduler config object: {sched_config!r}")
+    segments, invalid, used = _route_stream(sched_config, ordered, resource_names, forced)
+    distinct = {cfg for cfg, _, _ in segments if cfg is not None}
     if len(distinct) > 1:
         names = sorted(n for n, c in used.items() if c is not None)
         raise ValueError(
@@ -354,3 +372,33 @@ def resolve_profiles(sched_config, ordered, resource_names, forced=None):
             "inside one simulation is not supported"
         )
     return (distinct.pop() if distinct else None), invalid
+
+
+def resolve_profile_segments(sched_config, ordered, resource_names, forced=None):
+    """Split the pod stream into contiguous same-profile segments.
+
+    Returns (segments, invalid): ``segments`` is a list of
+    ``(config_or_None, lo, hi)`` half-open index ranges covering the whole
+    stream in order; ``invalid`` maps pod index → unschedulable reason
+    (unknown profile — kube's event handlers never admit such pods).
+
+    Where :func:`resolve_profiles` raises on DIFFERING referenced profiles,
+    this resolver supports them (``utils.go:304-381`` accepts the full
+    multi-profile surface): consecutive scans share the scheduling carry,
+    so placements equal the reference's serial driver routing each pod to
+    its profile's framework. Forced pods bypass every scheduler and simply
+    extend the current segment (binds stay in stream order). Only a
+    pathological interleaving (> MAX_PROFILE_SEGMENTS contiguous runs)
+    raises."""
+    if sched_config is None or isinstance(sched_config, SchedulerConfig):
+        return [(sched_config, 0, len(ordered))], {}
+    if not isinstance(sched_config, SchedulerProfiles):
+        raise ValueError(f"unsupported scheduler config object: {sched_config!r}")
+    segments, invalid, _used = _route_stream(sched_config, ordered, resource_names, forced)
+    if len(segments) > MAX_PROFILE_SEGMENTS:
+        raise ValueError(
+            f"pod stream alternates scheduler profiles into {len(segments)} "
+            f"segments (> {MAX_PROFILE_SEGMENTS}): non-segmentable "
+            "interleaving; order pods by profile"
+        )
+    return segments, invalid

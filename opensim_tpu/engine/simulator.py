@@ -417,6 +417,91 @@ def _prepare_inner(cluster, apps, use_greed, node_pad, patch_pods_fn):
     )
 
 
+def _run_segments(
+    prep, segments, pod_valid, forced, tmpl_ids, extra_plugins, tie_seed,
+    nv_mask, skips, log,
+):
+    """Consecutive scans over contiguous same-profile segments, sharing the
+    scheduling carry — the segmented multi-profile path
+    (``utils.go:304-381``). Each segment runs the full padded stream with
+    out-of-segment pods masked invalid (engines skip them without touching
+    state), so binds happen in exact stream order; the final state of
+    segment k seeds segment k+1. Returns (ScheduleOutput, engine_name);
+    the output's static_fail is PER POD ([P, n_static], callers index it
+    with sf_rows=arange) because static filter tables are config-dependent
+    and failure attribution resolves per segment."""
+    from . import nativepath
+    from .scheduler import pad_pod_stream, schedule_pods, scan_unroll
+
+    P = len(tmpl_ids)
+    n_dyn = kernels.NUM_FILTERS - kernels.F_PORTS
+    R = int(prep.ec_np.alloc.shape[1])
+    Gd = int(prep.ec_np.node_gpu_mem.shape[1])
+    n_static = kernels.F_PORTS
+    chosen = np.full((P,), -1, dtype=np.int32)
+    fail_counts = np.zeros((P, n_dyn), np.int32)
+    insufficient = np.zeros((P, R), np.int32)
+    gpu_take = np.zeros((P, Gd), np.float32)
+    sf_pod = np.zeros((P, n_static), np.int32)
+
+    use_native = all(
+        nativepath.why_not(prep, cfg, extra_plugins, tie_seed=tie_seed) is None
+        for cfg, _, _ in segments
+    )
+    if not use_native:
+        reasons = {
+            nativepath.why_not(prep, cfg, extra_plugins, tie_seed=tie_seed)
+            for cfg, _, _ in segments
+        } - {None}
+        skips["native"] = "; ".join(sorted(reasons)) or "segment config unsupported"
+        log.info("segmented run on the XLA scan: %s", skips["native"])
+
+    st = prep.st0
+    final_state = None
+    for cfg, lo, hi in segments:
+        seg_valid = np.zeros((P,), dtype=bool)
+        seg_valid[lo:hi] = pod_valid[lo:hi]
+        if use_native:
+            out = nativepath.schedule(
+                prep, seg_valid, config=cfg, node_valid=nv_mask,
+                tie_seed=tie_seed, st0=st,
+            )
+        else:
+            tmpl_p, valid_p, forced_p = pad_pod_stream(tmpl_ids, seg_valid, forced)
+            ec_run = (
+                prep.ec._replace(node_valid=jnp.asarray(nv_mask))
+                if nv_mask is not None
+                else prep.ec
+            )
+            st_dev = ScanState(*[jnp.asarray(a) for a in st])
+            out = schedule_pods(
+                ec_run, st_dev, tmpl_p, valid_p, forced_p,
+                features=prep.features, config=cfg, extra_plugins=extra_plugins,
+                unroll=scan_unroll(), tie_seed=tie_seed,
+            )
+            jax.block_until_ready(out.chosen)
+        chosen[lo:hi] = np.asarray(out.chosen)[lo:hi]
+        fail_counts[lo:hi] = np.asarray(out.fail_counts)[lo:hi]
+        insufficient[lo:hi] = np.asarray(out.insufficient)[lo:hi]
+        gpu_take[lo:hi] = np.asarray(out.gpu_take)[lo:hi]
+        sf_seg = np.asarray(out.static_fail)
+        sf_pod[lo:hi] = sf_seg[tmpl_ids[lo:hi]]
+        st = out.final_state
+        final_state = out.final_state
+
+    from .scheduler import ScheduleOutput
+
+    stitched = ScheduleOutput(
+        chosen=chosen,
+        fail_counts=fail_counts,
+        insufficient=insufficient,
+        gpu_take=gpu_take,
+        static_fail=sf_pod,  # per POD, not per template (sf_rows=arange)
+        final_state=final_state,
+    )
+    return stitched, ("native" if use_native else "xla")
+
+
 def parse_tie_break(spec: str):
     """CLI ``--tie-break`` value → tie_seed (None = deterministic default).
     Accepted: ``sample`` (seed 0) or ``sample:<int>``."""
@@ -511,16 +596,31 @@ def simulate(
         # unschedulable with an explicit reason. Force-bound pods bypass the
         # scheduler entirely (simulator.go:329-331) — profiles don't apply.
         custom_reasons: Dict[int, str] = {}
+        segments = None
         if sched_config is not None:
-            from .schedconfig import DEFAULT_CONFIG, resolve_profiles
+            from .schedconfig import DEFAULT_CONFIG, resolve_profile_segments
 
-            sched_config, custom_reasons = resolve_profiles(
+            segs, custom_reasons = resolve_profile_segments(
                 sched_config, ordered, meta.resource_names, forced=forced
             )
             for i in custom_reasons:
                 pod_valid[i] = False
-            if sched_config == DEFAULT_CONFIG:
-                sched_config = None  # fast-path eligible
+            if len(segs) == 1:
+                sched_config = segs[0][0]
+                if sched_config == DEFAULT_CONFIG:
+                    sched_config = None  # fast-path eligible
+            else:
+                # differing profiles (utils.go:304-381): consecutive scans
+                # per contiguous same-profile segment, sharing the carry
+                if enable_preemption:
+                    raise ValueError(
+                        "segmented multi-profile simulation does not support "
+                        "enable_preemption"
+                    )
+                segments = [
+                    (None if c == DEFAULT_CONFIG else c, lo, hi) for c, lo, hi in segs
+                ]
+                sched_config = None
         import logging
         import os as _os
 
@@ -530,13 +630,23 @@ def simulate(
         skips: Dict[str, str] = {}
         require_tpu = _os.environ.get("OPENSIM_REQUIRE_TPU") == "1"
         interpret = _os.environ.get("OPENSIM_FASTPATH") == "interpret"
+        sf_rows = tmpl_ids  # decode: static_fail row per pod
+        if segments is not None:
+            skips["megakernel"] = (
+                f"segmented multi-profile stream ({len(segments)} segments)"
+            )
+            out, engine_name = _run_segments(
+                prep, segments, pod_valid, forced, tmpl_ids, extra_plugins,
+                tie_seed, nv_mask, skips, log,
+            )
+            sf_rows = np.arange(len(ordered), dtype=np.int32)
         # importing the megakernel module costs ~1 s of pallas Python-module
         # compile — only pay it where it can actually run (TPU backend, or
         # the tests' interpret mode); CPU hosts go straight to the C++ path.
         # These pre-import gates mirror the first checks of fastpath.why_not
         # (which stays authoritative once the module is imported) — they
         # exist only so the import itself can be skipped.
-        if nv_mask is not None:
+        elif nv_mask is not None:
             skips["megakernel"] = "masked re-simulation (planner prep reuse) runs on the C++/XLA engines"
         elif sched_config is not None:
             skips["megakernel"] = "non-default scheduler config"
@@ -685,7 +795,7 @@ def simulate(
     with gc_paused():
         statuses = _decode(
             ordered, chosen, forced, custom_reasons, victims_of, gpu_any, gpu_take,
-            tmpl_ids, static_fail, fail_counts, insufficient, meta, n_nodes,
+            sf_rows, static_fail, fail_counts, insufficient, meta, n_nodes,
             node_names, pod_lists, node_pods, unscheduled, cluster, out, drop_pods,
         )
     return SimulateResult(unscheduled_pods=unscheduled, node_status=statuses, engine=engine)
@@ -693,7 +803,7 @@ def simulate(
 
 def _decode(
     ordered, chosen, forced, custom_reasons, victims_of, gpu_any, gpu_take,
-    tmpl_ids, static_fail, fail_counts, insufficient, meta, n_nodes,
+    sf_rows, static_fail, fail_counts, insufficient, meta, n_nodes,
     node_names, pod_lists, node_pods, unscheduled, cluster, out, drop_pods=(),
 ):
     for i, pod in enumerate(ordered):
@@ -735,7 +845,7 @@ def _decode(
                 UnscheduledPod(
                     pod,
                     _reason_string(
-                        static_fail[int(tmpl_ids[i])], fail_counts[i], insufficient[i], meta, n_nodes
+                        static_fail[int(sf_rows[i])], fail_counts[i], insufficient[i], meta, n_nodes
                     ),
                 )
             )

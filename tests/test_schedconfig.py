@@ -214,7 +214,13 @@ profiles:
     assert placed == 1  # the default-profile pod scheduled normally
 
 
-def test_differing_referenced_profiles_fail_loudly(tmp_path):
+def test_differing_referenced_profiles_schedule_segmented(tmp_path):
+    """Differing referenced profiles now schedule via segmentation (round
+    5); the capacity-sweep path (resolve_profiles) still fails loudly —
+    see test_non_segmentable_interleaving_raises for the segmented path's
+    remaining loud failure."""
+    from opensim_tpu.engine.schedconfig import resolve_profiles
+
     path = _write(tmp_path, """kind: KubeSchedulerConfiguration
 profiles:
   - schedulerName: default-scheduler
@@ -233,8 +239,15 @@ profiles:
     lean.spec.scheduler_name = "lean"
     lean.raw.setdefault("spec", {})["schedulerName"] = "lean"
     app.pods.append(lean)
+    res = simulate(cluster, [AppResource("a", app)], sched_config=cfg)
+    assert not res.unscheduled_pods
+    assert sum(len(ns.pods) for ns in res.node_status) == 2
+    assert "segmented multi-profile" in res.engine.skipped["megakernel"]
+
+    # the single-config resolver (scenario sweeps) still refuses
+    pods = [p for ns in res.node_status for p in ns.pods]
     with pytest.raises(ValueError, match="differing plugin configurations"):
-        simulate(cluster, [AppResource("a", app)], sched_config=cfg)
+        resolve_profiles(cfg, pods, ["cpu", "memory"], forced=[False] * len(pods))
 
 
 def test_fit_ignored_resources(tmp_path):
@@ -500,3 +513,105 @@ profiles:
     ghost_idx = [i for i, p in enumerate(prep.ordered)
                  if p.metadata.name == "ghost"][0]
     assert (np.asarray(res.chosen)[:, ghost_idx] == -1).all()
+
+
+# ---------------------------------------------------------------------------
+# segmented multi-profile scheduling (VERDICT r4 #7; utils.go:304-381)
+# ---------------------------------------------------------------------------
+
+
+def _two_profile_config(tmp_path):
+    p = tmp_path / "profiles.yaml"
+    p.write_text(
+        "apiVersion: kubescheduler.config.k8s.io/v1beta1\n"
+        "kind: KubeSchedulerConfiguration\n"
+        "profiles:\n"
+        "  - schedulerName: default-scheduler\n"
+        "  - schedulerName: packer\n"
+        "    plugins:\n"
+        "      score:\n"
+        "        disabled:\n"
+        "          - name: NodeResourcesBalancedAllocation\n"
+        "          - name: NodeResourcesLeastAllocated\n"
+    )
+    return load_scheduler_config(str(p))
+
+
+def test_segmented_two_differing_profiles_schedule(tmp_path):
+    """Two differing profiles in one stream: consecutive scans share the
+    carry; each segment runs its own plugin config (the packer profile
+    packs where the default spreads)."""
+    cfg = _two_profile_config(tmp_path)
+    cluster = ResourceTypes()
+    for i in range(4):
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+    rt = ResourceTypes()
+    d1 = fx.make_fake_deployment("default-app", 6, "500m", "1Gi")
+    d2 = fx.make_fake_deployment("packer-app", 6, "500m", "1Gi")
+    d2.template_spec.scheduler_name = "packer"
+    rt.deployments.extend([d1, d2])
+    res = simulate(cluster, [AppResource("a", rt)], sched_config=cfg)
+    assert not res.unscheduled_pods
+    assert res.engine.name in ("native", "xla")
+    assert "segmented multi-profile" in res.engine.skipped["megakernel"]
+    by_app = {}
+    for ns in res.node_status:
+        for p in ns.pods:
+            app = p.metadata.labels.get("app", "")
+            by_app.setdefault(app, {}).setdefault(ns.node.metadata.name, 0)
+            by_app[app][ns.node.metadata.name] += 1
+    # default profile spreads its 6 pods; the packer profile concentrates
+    assert len(by_app["default-app"]) == 4
+    assert max(by_app["packer-app"].values()) >= 4
+
+
+def test_segmented_profiles_share_the_carry(tmp_path):
+    """Segment 2 must see segment 1's binds: a full node cannot be reused,
+    and a failing pod's reason reflects the shared usage."""
+    cfg = _two_profile_config(tmp_path)
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n0", "8", "16Gi"))
+    cluster.nodes.append(fx.make_fake_node("n1", "8", "16Gi"))
+    rt = ResourceTypes()
+    d1 = fx.make_fake_deployment("filler", 2, "7", "1Gi")  # one per node
+    d2 = fx.make_fake_deployment("late", 2, "4", "1Gi")
+    d2.template_spec.scheduler_name = "packer"
+    rt.deployments.extend([d1, d2])
+    res = simulate(cluster, [AppResource("a", rt)], sched_config=cfg)
+    # both nodes carry one 7-cpu filler; neither fits a 4-cpu late pod
+    assert len(res.unscheduled_pods) == 2
+    for up in res.unscheduled_pods:
+        assert "0/2 nodes are available: 2 Insufficient cpu." == up.reason
+
+
+def test_segmented_unknown_profile_reason(tmp_path):
+    cfg = _two_profile_config(tmp_path)
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n0", "8", "16Gi"))
+    rt = ResourceTypes()
+    d1 = fx.make_fake_deployment("ok", 1, "500m", "1Gi")
+    d2 = fx.make_fake_deployment("ghost", 1, "500m", "1Gi")
+    d2.template_spec.scheduler_name = "packer"
+    d3 = fx.make_fake_deployment("lost", 1, "500m", "1Gi")
+    d3.template_spec.scheduler_name = "no-such-profile"
+    rt.deployments.extend([d1, d2, d3])
+    res = simulate(cluster, [AppResource("a", rt)], sched_config=cfg)
+    assert len(res.unscheduled_pods) == 1
+    assert "no scheduler profile named 'no-such-profile'" in res.unscheduled_pods[0].reason
+
+
+def test_non_segmentable_interleaving_raises(tmp_path):
+    """A pathological alternation (one scan per pod) still fails loudly."""
+    from opensim_tpu.engine.schedconfig import MAX_PROFILE_SEGMENTS
+
+    cfg = _two_profile_config(tmp_path)
+    cluster = ResourceTypes()
+    cluster.nodes.append(fx.make_fake_node("n0", "64", "64Gi"))
+    rt = ResourceTypes()
+    for i in range(MAX_PROFILE_SEGMENTS + 2):
+        pod = fx.make_fake_pod(f"p{i}", "10m", "16Mi")
+        if i % 2:
+            pod.spec.scheduler_name = "packer"
+        rt.pods.append(pod)
+    with pytest.raises(ValueError, match="non-segmentable"):
+        simulate(cluster, [AppResource("a", rt)], sched_config=cfg)

@@ -2,11 +2,17 @@
 load chart dir/tarball, coalesce values, render templates, drop NOTES.txt,
 sort by install order).
 
-The environment ships no ``helm`` binary, so this implements the Go-template
-subset real-world simulator charts use (verified against the reference's
-``example/application/charts/yoda``): ``{{ .Values.path }}``,
-``{{ .Release.* }}``/``{{ .Chart.* }}``, ``$`` root refs, ``int``/``quote``/
-``default`` pipelines, and ``{{- if }}/{{- else }}/{{- end }}`` blocks.
+The environment ships no ``helm`` binary, so this implements the
+Go-template/sprig subset real-world charts use: ``{{ .Values.path }}``,
+``{{ .Release.* }}``/``{{ .Chart.* }}``, ``$`` root refs, variables
+(``{{ $x := ... }}``), ``if/else``, ``range``, ``with``, named templates
+(``define`` / ``include`` / ``template`` — collected globally across the
+chart and its subcharts, helm's namespace), subchart rendering with value
+coalescing (parent overrides + ``global`` + ``dependencies[].condition``
+gating), and the common pipeline functions (``quote``, ``default``,
+``toYaml``, ``nindent``/``indent``, ``printf``, ``eq``/``and``/``or``,
+``trimPrefix``/``trimSuffix``, ``replace``, ``contains``, ``required``,
+...). Constructs outside the subset fail loudly naming the template.
 If a ``helm`` binary is on PATH it is preferred.
 """
 
@@ -18,7 +24,7 @@ import shutil
 import subprocess
 import tarfile
 import tempfile
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import yaml
 
@@ -71,48 +77,142 @@ def _split_docs(text: str) -> List[str]:
     return [d.strip() for d in re.split(r"(?m)^---\s*$", text) if d.strip()]
 
 
-def _render_chart_dir(release_name: str, path: str) -> List[str]:
+# ---------------------------------------------------------------------------
+# chart tree loading (parent + subcharts, value coalescing)
+# ---------------------------------------------------------------------------
+
+
+class _Chart:
+    def __init__(self, name: str, meta: dict, values: dict, tpl_dir: str):
+        self.name = name
+        self.meta = meta
+        self.values = values
+        self.tpl_dir = tpl_dir
+
+
+def _deep_merge(base: dict, override: dict) -> dict:
+    """helm's CoalesceValues: override wins; nested maps merge."""
+    out = dict(base)
+    for k, v in (override or {}).items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _load_chart(path: str) -> Tuple[dict, dict]:
     chart_yaml = os.path.join(path, "Chart.yaml")
     if not os.path.isfile(chart_yaml):
         raise ChartError(f"{path}: not a chart (no Chart.yaml)")
     with open(chart_yaml) as f:
-        chart_meta = yaml.safe_load(f) or {}
-    values_path = os.path.join(path, "values.yaml")
+        meta = yaml.safe_load(f) or {}
     values = {}
+    values_path = os.path.join(path, "values.yaml")
     if os.path.isfile(values_path):
         with open(values_path) as f:
             values = yaml.safe_load(f) or {}
-    _validate_values_schema(path, chart_meta.get("name", path), values)
-    ctx = {
-        "Values": values,
-        "Release": {"Name": release_name, "Namespace": "default", "Service": "Helm"},
-        "Chart": {
-            "Name": chart_meta.get("name", ""),
-            "Version": chart_meta.get("version", ""),
-            "AppVersion": chart_meta.get("appVersion", ""),
-        },
-        "Capabilities": {"KubeVersion": {"Version": "v1.21.0", "Major": "1", "Minor": "21"}},
-    }
+    return meta, values
+
+
+def _gather_charts(
+    path: str, values_override: Optional[dict], parent_globals: Optional[dict]
+) -> List[_Chart]:
+    """Load a chart and its charts/ subcharts with coalesced values:
+    the parent's ``values[<subchart name>]`` overrides the subchart's own
+    values.yaml; ``global`` flows down; ``dependencies[].condition`` paths
+    evaluated against the PARENT's values gate each subchart (an absent
+    condition path keeps the subchart enabled — helm semantics)."""
+    meta, own_values = _load_chart(path)
+    name = meta.get("name", os.path.basename(path))
+    values = _deep_merge(own_values, values_override or {})
+    if parent_globals:
+        values["global"] = _deep_merge(values.get("global") or {}, parent_globals)
+    _validate_values_schema(path, name, values)
+    charts = [_Chart(name, meta, values, os.path.join(path, "templates"))]
+
+    conditions: Dict[str, str] = {}
+    for dep in meta.get("dependencies") or []:
+        if isinstance(dep, dict) and dep.get("name") and dep.get("condition"):
+            conditions[str(dep["name"])] = str(dep["condition"])
+
+    charts_dir = os.path.join(path, "charts")
+    if os.path.isdir(charts_dir):
+        for entry in sorted(os.listdir(charts_dir)):
+            sub_path = os.path.join(charts_dir, entry)
+            if not os.path.isdir(sub_path) or not os.path.isfile(
+                os.path.join(sub_path, "Chart.yaml")
+            ):
+                continue
+            sub_meta, _ = _load_chart(sub_path)
+            sub_name = sub_meta.get("name", entry)
+            cond = conditions.get(sub_name)
+            if cond is not None:
+                flag = _lookup(values, cond)
+                if flag is not None and not _truthy(flag):
+                    continue
+            charts.extend(
+                _gather_charts(
+                    sub_path,
+                    values.get(sub_name) if isinstance(values.get(sub_name), dict) else {},
+                    values.get("global") or {},
+                )
+            )
+    return charts
+
+
+def _render_chart_dir(release_name: str, path: str) -> List[str]:
+    charts = _gather_charts(path, None, None)
+
+    # pass 1: collect named templates (define blocks) from EVERY template
+    # file of every chart — helm's template namespace is global, and
+    # helpers conventionally live in _helpers.tpl (collected, not emitted)
+    defs: Dict[str, list] = {}
+    pending = []  # (chart, fname, tokens) for files that emit output
+    for chart in charts:
+        if not os.path.isdir(chart.tpl_dir):
+            continue
+        for root, _dirs, files in os.walk(chart.tpl_dir):
+            for fname in sorted(files):
+                if not fname.endswith((".yaml", ".yml", ".tpl", ".txt")):
+                    continue
+                with open(os.path.join(root, fname)) as f:
+                    text = f.read()
+                try:
+                    tokens = _collect_defines(_tokenize(text), defs)
+                except ChartError as e:
+                    raise ChartError(f"{chart.name}/templates/{fname}: {e}") from None
+                if fname == "NOTES.txt" or fname.startswith("_"):
+                    continue  # define-collection only
+                pending.append((chart, fname, tokens))
+
     docs: List[str] = []
-    tpl_dir = os.path.join(path, "templates")
-    for root, _dirs, files in os.walk(tpl_dir):
-        for fname in sorted(files):
-            if fname == "NOTES.txt" or fname.startswith("_"):
-                continue
-            if not fname.endswith((".yaml", ".yml", ".tpl")):
-                continue
-            with open(os.path.join(root, fname)) as f:
-                text = f.read()
-            try:
-                rendered = render_template(text, ctx)
-            except ChartError as e:
-                # fail the whole chart with the offending template named,
-                # before any partial output escapes
-                raise ChartError(
-                    f"{chart_meta.get('name', path)}/templates/{fname}: {e}; "
-                    "install a `helm` binary on PATH for full template support"
-                ) from None
-            docs.extend(_split_docs(rendered))
+    for chart, fname, tokens in pending:
+        ctx = {
+            "Values": chart.values,
+            "Release": {"Name": release_name, "Namespace": "default", "Service": "Helm"},
+            "Chart": {
+                "Name": chart.meta.get("name", ""),
+                "Version": chart.meta.get("version", ""),
+                "AppVersion": chart.meta.get("appVersion", ""),
+            },
+            "Capabilities": {
+                "KubeVersion": {"Version": "v1.21.0", "Major": "1", "Minor": "21"}
+            },
+        }
+        ctx["__defs__"] = defs
+        ctx["__root__"] = ctx
+        ctx["__vars__"] = _Vars()
+        try:
+            rendered, _ = _render_block(tokens, 0, ctx, stop=set())
+        except ChartError as e:
+            # fail the whole chart with the offending template named,
+            # before any partial output escapes
+            raise ChartError(
+                f"{chart.name}/templates/{fname}: {e}; "
+                "install a `helm` binary on PATH for full template support"
+            ) from None
+        docs.extend(_split_docs(rendered))
     return docs
 
 
@@ -186,16 +286,25 @@ def _sort_manifests(docs: List[str]) -> List[str]:
 
 _TOKEN = re.compile(r"\{\{(-?)\s*(.*?)\s*(-?)\}\}", re.S)
 
+_BLOCK_OPENERS = ("if", "range", "with", "define", "block")
+
 
 def render_template(text: str, ctx: dict) -> str:
-    tokens = _tokenize(text)
+    """Render standalone template text (unit-test surface). Collects any
+    define blocks in the text itself."""
+    ctx = dict(ctx)
+    defs = dict(ctx.get("__defs__") or {})
+    ctx["__defs__"] = defs
+    ctx.setdefault("__root__", ctx)
+    ctx.setdefault("__vars__", _Vars())
+    tokens = _collect_defines(_tokenize(text), defs)
     out, _pos = _render_block(tokens, 0, ctx, stop={"end", "else"})
     return out
 
 
 def _tokenize(text: str):
     """Split into literal / action tokens, applying {{- and -}} whitespace
-    trimming to adjacent literals."""
+    trimming to adjacent literals. Comments {{/* ... */}} drop."""
     tokens = []
     last = 0
     for m in _TOKEN.finditer(text):
@@ -203,7 +312,11 @@ def _tokenize(text: str):
         if m.group(1) == "-":
             lit = lit.rstrip()
         tokens.append(("lit", lit))
-        tokens.append(("act", m.group(2), m.group(3) == "-"))
+        action = m.group(2)
+        if not (action.startswith("/*") and action.endswith("*/")):
+            tokens.append(("act", action, m.group(3) == "-"))
+        else:
+            tokens.append(("act", "", m.group(3) == "-"))  # comment: no-op
         last = m.end()
     tokens.append(("lit", text[last:]))
     # apply right-trim to following literal
@@ -211,6 +324,103 @@ def _tokenize(text: str):
         if t[0] == "act" and t[2] and i + 1 < len(tokens) and tokens[i + 1][0] == "lit":
             tokens[i + 1] = ("lit", tokens[i + 1][1].lstrip())
     return tokens
+
+
+def _first_word(action: str) -> str:
+    parts = action.split()
+    return parts[0] if parts else ""
+
+
+def _collect_defines(tokens, defs: Dict[str, list]):
+    """Strip {{ define "name" }}...{{ end }} blocks out of the token stream,
+    registering their bodies in `defs` (helm's global template namespace).
+    Returns the remaining tokens."""
+    out = []
+    i = 0
+    while i < len(tokens):
+        tok = tokens[i]
+        if tok[0] == "act" and _first_word(tok[1]) == "define":
+            m = re.match(r'define\s+"([^"]+)"', tok[1])
+            if not m:
+                raise ChartError(f"malformed define: {{{{ {tok[1]} }}}}")
+            depth = 1
+            j = i + 1
+            while j < len(tokens) and depth:
+                if tokens[j][0] == "act":
+                    w = _first_word(tokens[j][1])
+                    if w in _BLOCK_OPENERS:
+                        depth += 1
+                    elif w == "end":
+                        depth -= 1
+                j += 1
+            if depth:
+                raise ChartError(f'unterminated define "{m.group(1)}"')
+            defs[m.group(1)] = tokens[i + 1 : j - 1]
+            i = j
+        else:
+            out.append(tok)
+            i += 1
+    return out
+
+
+class _Vars:
+    """Lexically scoped template variables (Go template semantics):
+    ``:=`` declares in the current block scope; ``=`` assigns the nearest
+    enclosing declaration (the range-accumulator idiom) and fails loudly if
+    none exists."""
+
+    def __init__(self, parent: Optional["_Vars"] = None):
+        self.map: Dict[str, Any] = {}
+        self.parent = parent
+
+    def get(self, name: str):
+        scope = self
+        while scope is not None:
+            if name in scope.map:
+                return scope.map[name]
+            scope = scope.parent
+        return None
+
+    def declare(self, name: str, val: Any) -> None:
+        self.map[name] = val
+
+    def assign(self, name: str, val: Any) -> None:
+        scope = self
+        while scope is not None:
+            if name in scope.map:
+                scope.map[name] = val
+                return
+            scope = scope.parent
+        raise ChartError(f"assignment to undeclared variable ${name}")
+
+
+def _child_scope(ctx: dict) -> dict:
+    sub = dict(ctx)
+    sub["__vars__"] = _Vars(ctx.get("__vars__"))
+    return sub
+
+
+def _scan_block(tokens, start) -> tuple:
+    """Locate the matching {{ end }} (and top-level {{ else }}) for a block
+    whose opener sits just before `start`, WITHOUT evaluating anything —
+    falsy branches must never run their bodies' side effects (required,
+    include of absent templates...). Returns (else_pos_or_None, end_pos)."""
+    depth = 1
+    else_pos = None
+    i = start
+    while i < len(tokens):
+        if tokens[i][0] == "act":
+            w = _first_word(tokens[i][1])
+            if w in _BLOCK_OPENERS:
+                depth += 1
+            elif w == "end":
+                depth -= 1
+                if depth == 0:
+                    return else_pos, i
+            elif w == "else" and depth == 1 and else_pos is None:
+                else_pos = i
+        i += 1
+    raise ChartError("unterminated block in template")
 
 
 def _render_block(tokens, pos, ctx, stop) -> tuple:
@@ -225,26 +435,57 @@ def _render_block(tokens, pos, ctx, stop) -> tuple:
             i += 1
             continue
         action = tok[1]
-        word = action.split()[0] if action.split() else ""
+        if not action:  # stripped comment
+            i += 1
+            continue
+        word = _first_word(action)
         if word in stop:
             return "".join(parts), i
-        if word in ("define", "template", "include", "with", "block"):
-            # recognized Go-template constructs outside the supported subset:
-            # fail loudly rather than silently rendering an empty string
+        if word in ("define", "block"):
+            # define is collected pre-render; block (define+emit in place)
+            # stays outside the supported subset: fail loudly
             raise ChartError(f"unsupported template construct: {{{{ {word} }}}}")
+        m_assign = re.match(r"\$(\w+)\s*(:?=)\s*(.+)$", action, re.S)
         if word == "if":
-            cond = _eval_expr(action[2:].strip(), ctx)
-            body, j = _render_block(tokens, i + 1, ctx, stop={"else", "end"})
-            if j >= len(tokens):
-                raise ChartError("unterminated {{ if }} block in template")
-            if tokens[j][1].split()[0] == "else":
-                else_body, j = _render_block(tokens, j + 1, ctx, stop={"end"})
-            else:
-                else_body = ""
-            parts.append(body if _truthy(cond) else else_body)
-            i = j + 1
+            else_pos, end_pos = _scan_block(tokens, i + 1)
+            if _truthy(_eval_expr(action[2:].strip(), ctx)):
+                body, _ = _render_block(
+                    tokens, i + 1, _child_scope(ctx), stop={"else", "end"}
+                )
+                parts.append(body)
+            elif else_pos is not None:
+                else_action = tokens[else_pos][1][4:].strip()
+                if else_action.startswith("if"):
+                    # {{ else if X }}: re-enter as a fresh if-chain sharing
+                    # the outer end token; the slice is bounded at end_pos so
+                    # nothing after the block can leak into the chain render
+                    chain = [("act", else_action, False)] + tokens[
+                        else_pos + 1 : end_pos + 1
+                    ]
+                    else_body, _ = _render_block(chain, 0, ctx, stop={"end"})
+                else:
+                    else_body, _ = _render_block(
+                        tokens, else_pos + 1, _child_scope(ctx), stop={"end"}
+                    )
+                parts.append(else_body)
+            i = end_pos + 1
+        elif word == "with":
+            else_pos, end_pos = _scan_block(tokens, i + 1)
+            val = _eval_expr(action[len("with") :].strip(), ctx)
+            if _truthy(val):
+                sub = _child_scope(ctx)
+                sub["."] = val
+                body, _ = _render_block(tokens, i + 1, sub, stop={"else", "end"})
+                parts.append(body)
+            elif else_pos is not None:
+                else_body, _ = _render_block(
+                    tokens, else_pos + 1, _child_scope(ctx), stop={"end"}
+                )
+                parts.append(else_body)
+            i = end_pos + 1
         elif word == "range":
             # {{ range .Values.list }} / {{ range $k, $v := .Values.map }}
+            else_pos, end_pos = _scan_block(tokens, i + 1)
             expr = action[len("range") :].strip()
             var_names = []
             if ":=" in expr:
@@ -252,22 +493,44 @@ def _render_block(tokens, pos, ctx, stop) -> tuple:
                 var_names = [v.strip().lstrip("$") for v in names.split(",")]
                 expr = expr.strip()
             coll = _eval_expr(expr, ctx)
-            body_start = i + 1
-            _, j = _render_block(tokens, body_start, ctx, stop={"end"})
-            if j >= len(tokens):
-                raise ChartError("unterminated {{ range }} block in template")
-            items = coll.items() if isinstance(coll, dict) else enumerate(coll or [])
+            if isinstance(coll, dict):
+                items = sorted(coll.items())  # Go templates range maps in key order
+            else:
+                items = list(enumerate(coll or []))
+            if not items and else_pos is not None:
+                else_body, _ = _render_block(
+                    tokens, else_pos + 1, _child_scope(ctx), stop={"end"}
+                )
+                parts.append(else_body)
             for k, v in items:
-                sub = dict(ctx)
+                sub = _child_scope(ctx)
                 if var_names:
                     if len(var_names) == 2:
-                        sub[var_names[0]], sub[var_names[1]] = k, v
+                        sub["__vars__"].declare(var_names[0], k)
+                        sub["__vars__"].declare(var_names[1], v)
                     else:
-                        sub[var_names[0]] = v
+                        sub["__vars__"].declare(var_names[0], v)
                 sub["."] = v
-                body, _ = _render_block(tokens, body_start, sub, stop={"end"})
+                body, _ = _render_block(tokens, i + 1, sub, stop={"else", "end"})
                 parts.append(body)
-            i = j + 1
+            i = end_pos + 1
+        elif word == "template":
+            args = _split_args(action[len("template") :].strip())
+            if not args:
+                raise ChartError("template invocation needs a name")
+            name = _eval_atom(args[0], ctx)
+            arg = _eval_expr(" ".join(args[1:]), ctx) if len(args) > 1 else None
+            parts.append(_call_template(str(name), arg, ctx))
+            i += 1
+        elif m_assign:
+            name, op, rhs = m_assign.group(1), m_assign.group(2), m_assign.group(3)
+            val = _eval_expr(rhs.strip(), ctx)
+            scope = ctx.setdefault("__vars__", _Vars())
+            if op == ":=":
+                scope.declare(name, val)
+            else:  # {{ $x = ... }} updates the enclosing declaration
+                scope.assign(name, val)
+            i += 1
         elif word == "end":
             return "".join(parts), i
         else:
@@ -275,6 +538,23 @@ def _render_block(tokens, pos, ctx, stop) -> tuple:
             parts.append("" if val is None else _to_str(val))
             i += 1
     return "".join(parts), i
+
+
+def _call_template(name: str, arg: Any, ctx: dict):
+    """include/template: render a named define with "." bound to arg.
+    Caller variables do not leak in (Go template scoping); $ and the root
+    keys stay reachable."""
+    defs = ctx.get("__defs__") or {}
+    if name not in defs:
+        raise ChartError(f'include of undefined template "{name}"')
+    root = ctx.get("__root__") or ctx
+    sub = {k: v for k, v in root.items() if not k.startswith("__")}
+    sub["__defs__"] = defs
+    sub["__root__"] = root
+    sub["__vars__"] = _Vars()
+    sub["."] = arg
+    out, _ = _render_block(defs[name], 0, sub, stop=set())
+    return out
 
 
 def _truthy(v: Any) -> bool:
@@ -289,28 +569,86 @@ def _to_str(v: Any) -> str:
     return str(v)
 
 
+# -- expression evaluation ---------------------------------------------------
+
+
+def _split_top(s: str, sep_ws: bool) -> List[str]:
+    """Split at top level: on whitespace (sep_ws) or on '|', respecting
+    double quotes, backquotes and parentheses."""
+    out: List[str] = []
+    cur = []
+    depth = 0
+    quote = ""
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if quote:
+            cur.append(c)
+            if c == quote and s[i - 1] != "\\":
+                quote = ""
+        elif c in ('"', "`"):
+            quote = c
+            cur.append(c)
+        elif c == "(":
+            depth += 1
+            cur.append(c)
+        elif c == ")":
+            depth -= 1
+            cur.append(c)
+        elif depth == 0 and ((c.isspace() and sep_ws) or (c == "|" and not sep_ws)):
+            if "".join(cur).strip():
+                out.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(c)
+        i += 1
+    if "".join(cur).strip():
+        out.append("".join(cur).strip())
+    return out
+
+
+def _split_args(s: str) -> List[str]:
+    return _split_top(s, sep_ws=True)
+
+
 def _eval_expr(expr: str, ctx: dict) -> Any:
-    """Evaluate a pipeline: `func arg | func2` with funcs int, quote,
-    default, toString, upper, lower, trunc."""
-    stages = [s.strip() for s in expr.split("|")]
+    """Evaluate a pipeline: `func arg | func2 ...`."""
+    stages = _split_top(expr, sep_ws=False)
+    if not stages:
+        return None
     val = _eval_atom(stages[0], ctx)
     for stage in stages[1:]:
-        parts = stage.split()
+        parts = _split_args(stage)
         fn, args = parts[0], [_eval_atom(a, ctx) for a in parts[1:]]
-        val = _apply_fn(fn, args + [val])
+        val = _apply_fn(fn, args + [val], ctx)
     return val
+
+
+_FUNCS = {
+    "int", "quote", "squote", "default", "toString", "upper", "lower", "not",
+    "toYaml", "trunc", "indent", "nindent", "printf", "print", "eq", "ne",
+    "lt", "le", "gt", "ge", "and", "or", "trimSuffix", "trimPrefix", "trim",
+    "replace", "contains", "hasPrefix", "hasSuffix", "required", "include",
+    "len", "add", "sub", "mul", "title", "kindIs", "empty", "coalesce",
+    "ternary", "join", "splitList", "first", "last", "get", "index", "dict",
+    "list", "toJson",
+}
 
 
 def _eval_atom(atom: str, ctx: dict) -> Any:
     atom = atom.strip()
-    if atom.startswith('"') and atom.endswith('"'):
+    if atom.startswith("(") and atom.endswith(")"):
+        return _eval_expr(atom[1:-1], ctx)
+    if atom.startswith('"') and atom.endswith('"') and len(atom) >= 2:
+        return atom[1:-1].replace('\\"', '"').replace("\\n", "\n").replace("\\t", "\t")
+    if atom.startswith("`") and atom.endswith("`") and len(atom) >= 2:
         return atom[1:-1]
-    parts = atom.split()
+    parts = _split_args(atom)
     if len(parts) > 1:
         fn = parts[0]
-        if fn in ("int", "quote", "default", "toString", "upper", "lower", "not", "toYaml", "trunc"):
+        if fn in _FUNCS:
             args = [_eval_atom(a, ctx) for a in parts[1:]]
-            return _apply_fn(fn, args)
+            return _apply_fn(fn, args, ctx)
         # a call to anything else would silently render as empty — refuse
         raise ChartError(f"unsupported template function: {fn}")
     if re.fullmatch(r"-?\d+", atom):
@@ -319,10 +657,18 @@ def _eval_atom(atom: str, ctx: dict) -> Any:
         return float(atom)
     if atom in ("true", "false"):
         return atom == "true"
+    if atom in ("nil", "null"):
+        return None
+    if atom == "$":
+        return ctx.get("__root__", ctx)
     if atom.startswith("$."):
-        return _lookup(ctx, atom[2:])
+        return _lookup(ctx.get("__root__", ctx), atom[2:])
     if atom.startswith("$"):
-        return ctx.get(atom[1:].split(".")[0])
+        name = atom[1:].split(".")[0]
+        vars_ = ctx.get("__vars__")
+        base = vars_.get(name) if vars_ is not None else None
+        rest = atom[1 + len(name) :].lstrip(".")
+        return _lookup(base, rest) if rest else base
     if atom == ".":
         return ctx.get(".", ctx)
     if atom.startswith("."):
@@ -352,26 +698,158 @@ def _lookup(obj: Any, path: str) -> Any:
     return cur
 
 
-def _apply_fn(fn: str, args: List[Any]) -> Any:
+def _num(v: Any) -> float:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def _apply_fn(fn: str, args: List[Any], ctx: Optional[dict] = None) -> Any:
+    """Pipeline/function application. Piped values arrive as the LAST arg
+    (sprig convention: `"x" | trimSuffix "-"` → trimSuffix("-", "x"))."""
     if fn == "int":
         try:
             return int(float(args[-1]))
         except (TypeError, ValueError):
             return 0
     if fn == "quote":
-        return '"%s"' % ("" if args[-1] is None else args[-1])
+        return '"%s"' % ("" if args[-1] is None else _to_str(args[-1]))
+    if fn == "squote":
+        return "'%s'" % ("" if args[-1] is None else _to_str(args[-1]))
     if fn == "default":
-        return args[-1] if args[-1] not in (None, "", 0, False) else args[0]
+        return args[-1] if args[-1] not in (None, "", 0, False, [], {}) else args[0]
     if fn == "toString":
         return _to_str(args[-1])
     if fn == "upper":
         return str(args[-1]).upper()
     if fn == "lower":
         return str(args[-1]).lower()
+    if fn == "title":
+        return str(args[-1]).title()
     if fn == "not":
         return not _truthy(args[-1])
     if fn == "toYaml":
-        return yaml.safe_dump(args[-1], default_flow_style=False).rstrip()
+        return yaml.safe_dump(args[-1], default_flow_style=False, sort_keys=False).rstrip()
+    if fn == "toJson":
+        import json
+
+        return json.dumps(args[-1])
     if fn == "trunc":
-        return str(args[-1])[: int(args[0])]
+        n = int(args[0])
+        s = str(args[-1])
+        return s[:n] if n >= 0 else s[n:]
+    if fn == "indent":
+        pad = " " * int(args[0])
+        return pad + str(args[-1]).replace("\n", "\n" + pad)
+    if fn == "nindent":
+        pad = " " * int(args[0])
+        return "\n" + pad + str(args[-1]).replace("\n", "\n" + pad)
+    if fn in ("printf", "print"):
+        if fn == "print":
+            return "".join(_to_str(a) for a in args)
+        fmt = str(args[0])
+        fmt = fmt.replace("%v", "%s").replace("%q", '"%s"')
+        vals = tuple(_to_str(a) if isinstance(a, (dict, list, bool)) else a for a in args[1:])
+        try:
+            return fmt % vals
+        except (TypeError, ValueError) as e:
+            raise ChartError(f"printf {fmt!r}: {e}")
+    if fn == "eq":
+        return any(args[0] == b for b in args[1:])
+    if fn == "ne":
+        return args[0] != args[1]
+    if fn == "lt":
+        return _num(args[0]) < _num(args[1])
+    if fn == "le":
+        return _num(args[0]) <= _num(args[1])
+    if fn == "gt":
+        return _num(args[0]) > _num(args[1])
+    if fn == "ge":
+        return _num(args[0]) >= _num(args[1])
+    if fn == "and":
+        for a in args:
+            if not _truthy(a):
+                return a
+        return args[-1]
+    if fn == "or":
+        for a in args:
+            if _truthy(a):
+                return a
+        return args[-1]
+    if fn == "trimSuffix":
+        s, suf = str(args[-1]), str(args[0])
+        return s[: -len(suf)] if suf and s.endswith(suf) else s
+    if fn == "trimPrefix":
+        s, pre = str(args[-1]), str(args[0])
+        return s[len(pre) :] if pre and s.startswith(pre) else s
+    if fn == "trim":
+        return str(args[-1]).strip()
+    if fn == "replace":
+        return str(args[-1]).replace(str(args[0]), str(args[1]))
+    if fn == "contains":
+        return str(args[0]) in str(args[-1])
+    if fn == "hasPrefix":
+        return str(args[-1]).startswith(str(args[0]))
+    if fn == "hasSuffix":
+        return str(args[-1]).endswith(str(args[0]))
+    if fn == "required":
+        if args[-1] in (None, ""):
+            raise ChartError(str(args[0]))
+        return args[-1]
+    if fn == "include":
+        if ctx is None:
+            raise ChartError("include outside a template context")
+        return _call_template(str(args[0]), args[1] if len(args) > 1 else None, ctx)
+    if fn == "len":
+        try:
+            return len(args[-1])
+        except TypeError:
+            return 0
+    if fn == "add":
+        return sum(int(_num(a)) for a in args)
+    if fn == "sub":
+        return int(_num(args[0])) - int(_num(args[1]))
+    if fn == "mul":
+        out = 1
+        for a in args:
+            out *= int(_num(a))
+        return out
+    if fn == "kindIs":
+        kinds = {dict: "map", list: "slice", str: "string", bool: "bool", int: "int", float: "float64"}
+        return kinds.get(type(args[-1])) == str(args[0])
+    if fn == "empty":
+        return not _truthy(args[-1])
+    if fn == "coalesce":
+        for a in args:
+            if _truthy(a):
+                return a
+        return None
+    if fn == "ternary":
+        return args[0] if _truthy(args[-1]) else args[1]
+    if fn == "join":
+        return str(args[0]).join(_to_str(x) for x in (args[-1] or []))
+    if fn == "splitList":
+        return str(args[-1]).split(str(args[0]))
+    if fn == "first":
+        return (args[-1] or [None])[0]
+    if fn == "last":
+        return (args[-1] or [None])[-1]
+    if fn in ("get", "index"):
+        cur = args[0]
+        for key in args[1:]:
+            if isinstance(cur, dict):
+                cur = cur.get(key)
+            elif isinstance(cur, (list, tuple)):
+                try:
+                    cur = cur[int(key)]
+                except (IndexError, ValueError, TypeError):
+                    return None
+            else:
+                return None
+        return cur
+    if fn == "dict":
+        return {str(args[i]): args[i + 1] for i in range(0, len(args) - 1, 2)}
+    if fn == "list":
+        return list(args)
     raise ChartError(f"unsupported template function: {fn}")

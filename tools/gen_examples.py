@@ -328,6 +328,19 @@ retention:
 storage:
   className: open-local-lvm
   size: 30Gi
+scrape:
+  interval: 30s
+  timeout: 10s
+""",
+    "templates/_helpers.tpl": """\
+{{- define "obs-stack.fullname" -}}
+{{ printf "%s-%s" .Release.Name .Chart.Name | trunc 63 | trimSuffix "-" }}
+{{- end -}}
+{{- define "obs-stack.labels" -}}
+app.kubernetes.io/name: {{ .Chart.Name }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+{{- end }}
 """,
     "templates/configmap.yaml": """\
 apiVersion: v1
@@ -335,10 +348,16 @@ kind: ConfigMap
 metadata:
   name: {{ .Release.Name }}-config
   namespace: {{ .Values.namespace }}
+  labels:
+    {{- include "obs-stack.labels" . | nindent 4 }}
 data:
   chart: {{ .Chart.Name | quote }}
+  fullname: {{ include "obs-stack.fullname" . | quote }}
   version: {{ .Chart.Version | quote }}
   retention: {{ .Values.retention.enabled | toString | quote }}
+{{- range $k, $v := .Values.scrape }}
+  scrape.{{ $k }}: {{ $v | quote }}
+{{- end }}
 """,
     "templates/service.yaml": """\
 apiVersion: v1
@@ -392,6 +411,8 @@ kind: Deployment
 metadata:
   name: {{ .Release.Name }}-server
   namespace: {{ .Values.namespace }}
+  labels:
+    {{- include "obs-stack.labels" . | nindent 4 }}
 spec:
   replicas: {{ .Values.server.replicas | int }}
   selector:

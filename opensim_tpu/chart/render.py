@@ -212,6 +212,10 @@ def _render_chart_dir(release_name: str, path: str) -> List[str]:
                 f"{chart.name}/templates/{fname}: {e}; "
                 "install a `helm` binary on PATH for full template support"
             ) from None
+        except Exception as e:  # never a raw traceback without the template name
+            raise ChartError(
+                f"{chart.name}/templates/{fname}: {type(e).__name__}: {e}"
+            ) from e
         docs.extend(_split_docs(rendered))
     return docs
 
@@ -471,6 +475,11 @@ def _render_block(tokens, pos, ctx, stop) -> tuple:
             i = end_pos + 1
         elif word == "with":
             else_pos, end_pos = _scan_block(tokens, i + 1)
+            if else_pos is not None and tokens[else_pos][1].strip() != "else":
+                # Go rejects {{ else if }} after with/range at parse time
+                raise ChartError(
+                    f"unexpected {{{{ {tokens[else_pos][1]} }}}} in with block"
+                )
             val = _eval_expr(action[len("with") :].strip(), ctx)
             if _truthy(val):
                 sub = _child_scope(ctx)
@@ -486,6 +495,10 @@ def _render_block(tokens, pos, ctx, stop) -> tuple:
         elif word == "range":
             # {{ range .Values.list }} / {{ range $k, $v := .Values.map }}
             else_pos, end_pos = _scan_block(tokens, i + 1)
+            if else_pos is not None and tokens[else_pos][1].strip() != "else":
+                raise ChartError(
+                    f"unexpected {{{{ {tokens[else_pos][1]} }}}} in range block"
+                )
             expr = action[len("range") :].strip()
             var_names = []
             if ":=" in expr:
@@ -494,7 +507,9 @@ def _render_block(tokens, pos, ctx, stop) -> tuple:
                 expr = expr.strip()
             coll = _eval_expr(expr, ctx)
             if isinstance(coll, dict):
-                items = sorted(coll.items())  # Go templates range maps in key order
+                # Go templates range maps in key order; YAML permits
+                # non-string keys, so compare stringified
+                items = sorted(coll.items(), key=lambda kv: str(kv[0]))
             else:
                 items = list(enumerate(coll or []))
             if not items and else_pos is not None:
@@ -714,9 +729,11 @@ def _apply_fn(fn: str, args: List[Any], ctx: Optional[dict] = None) -> Any:
         except (TypeError, ValueError):
             return 0
     if fn == "quote":
-        return '"%s"' % ("" if args[-1] is None else _to_str(args[-1]))
+        v = "" if args[-1] is None else _to_str(args[-1])
+        return '"%s"' % v.replace("\\", "\\\\").replace('"', '\\"')
     if fn == "squote":
-        return "'%s'" % ("" if args[-1] is None else _to_str(args[-1]))
+        v = "" if args[-1] is None else _to_str(args[-1])
+        return "'%s'" % v.replace("'", "''")
     if fn == "default":
         return args[-1] if args[-1] not in (None, "", 0, False, [], {}) else args[0]
     if fn == "toString":

@@ -2,24 +2,126 @@
 (``pkg/simulator/simulator.go:503-601``): list Nodes; Pods (Running +
 Pending, skip DaemonSet-owned and deleting); PDBs, Services, StorageClasses,
 PVCs, ConfigMaps, DaemonSets — via the Kubernetes Python client when
-available (gated: the client is not in the base image)."""
+available, else a stdlib REST fallback speaking the list endpoints directly
+(urllib + the kubeconfig's server/token), so kubeConfig mode works even
+without the ``kubernetes`` package (it is absent from this base image)."""
 
 from __future__ import annotations
 
-from typing import Optional
+import json
+import ssl
+import urllib.request
+from typing import List, Optional
+
+import yaml
 
 from ..models.objects import Node, Pod, RawObject, ResourceTypes, Workload
+
+
+def _pod_admissible(d: dict) -> bool:
+    """The snapshot's pod filter (simulator.go:527-543): Running/Pending,
+    not deleting, not DaemonSet-owned (those re-expand per node)."""
+    phase = (d.get("status") or {}).get("phase", "")
+    if phase not in ("Running", "Pending"):
+        return False
+    if (d.get("metadata") or {}).get("deletionTimestamp"):
+        return False
+    owners = (d.get("metadata") or {}).get("ownerReferences") or []
+    return not any(o.get("kind") == "DaemonSet" for o in owners)
+
+
+# (endpoint path, ResourceTypes field, wrapper) — the list calls
+# CreateClusterResourceFromClient performs, as raw REST paths
+_REST_LISTS = [
+    ("/api/v1/nodes", "nodes", Node.from_dict),
+    ("/api/v1/pods?resourceVersion=0", "pods", Pod.from_dict),
+    ("/apis/apps/v1/daemonsets", "daemon_sets", Workload.from_dict),
+    ("/apis/policy/v1/poddisruptionbudgets", "pdbs", RawObject.from_dict),
+    ("/api/v1/services", "services", RawObject.from_dict),
+    ("/apis/storage.k8s.io/v1/storageclasses", "storage_classes", RawObject.from_dict),
+    ("/api/v1/persistentvolumeclaims", "pvcs", RawObject.from_dict),
+    ("/api/v1/configmaps", "config_maps", RawObject.from_dict),
+]
+
+
+def _load_kubeconfig(kubeconfig: str, master: Optional[str]) -> tuple:
+    """(server, headers, ssl_context) from a kubeconfig's current context.
+    Supports bearer-token auth and insecure-skip-tls-verify; client-cert
+    auth needs the real kubernetes client."""
+    with open(kubeconfig) as f:
+        doc = yaml.safe_load(f) or {}
+    ctx_name = doc.get("current-context", "")
+    contexts = {e.get("name"): e.get("context") or {} for e in doc.get("contexts") or []}
+    clusters = {e.get("name"): e.get("cluster") or {} for e in doc.get("clusters") or []}
+    users = {e.get("name"): e.get("user") or {} for e in doc.get("users") or []}
+    ctx = contexts.get(ctx_name) or (next(iter(contexts.values())) if contexts else {})
+    cluster = clusters.get(ctx.get("cluster")) or (next(iter(clusters.values())) if clusters else {})
+    user = users.get(ctx.get("user")) or {}
+    server = master or cluster.get("server", "")
+    if not server:
+        raise RuntimeError(f"{kubeconfig}: no cluster server in kubeconfig")
+    headers = {"Accept": "application/json"}
+    if user.get("token"):
+        headers["Authorization"] = f"Bearer {user['token']}"
+    else:
+        unsupported = [
+            k for k in (
+                "client-certificate", "client-certificate-data", "exec",
+                "auth-provider", "tokenFile",
+            ) if user.get(k)
+        ]
+        if unsupported:
+            raise RuntimeError(
+                f"{kubeconfig}: auth method {unsupported[0]!r} needs the "
+                "`kubernetes` Python client (the stdlib REST fallback "
+                "supports bearer-token auth only)"
+            )
+    ssl_ctx = None
+    if server.startswith("https"):
+        if cluster.get("insecure-skip-tls-verify"):
+            ssl_ctx = ssl._create_unverified_context()
+        elif cluster.get("certificate-authority-data"):
+            import base64
+
+            cadata = base64.b64decode(cluster["certificate-authority-data"]).decode()
+            ssl_ctx = ssl.create_default_context(cadata=cadata)
+        elif cluster.get("certificate-authority"):
+            ssl_ctx = ssl.create_default_context(cafile=cluster["certificate-authority"])
+    return server.rstrip("/"), headers, ssl_ctx
+
+
+def _cluster_via_rest(kubeconfig: str, master: Optional[str]) -> ResourceTypes:
+    """Stdlib fallback: GET the list endpoints directly. Endpoint JSON is
+    already the wire form ``from_dict`` consumes (no client sanitization
+    needed). A missing optional endpoint (404/403 on PDBs in a minimal
+    cluster) yields an empty list rather than failing the snapshot."""
+    server, headers, ssl_ctx = _load_kubeconfig(kubeconfig, master)
+    rt = ResourceTypes()
+    for path, field, wrap in _REST_LISTS:
+        req = urllib.request.Request(server + path, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=60, context=ssl_ctx) as resp:
+                body = json.load(resp)
+        except urllib.error.HTTPError as e:
+            if field in ("pdbs", "storage_classes", "pvcs") and e.code in (403, 404):
+                continue
+            raise RuntimeError(f"list {path} failed: HTTP {e.code}") from e
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise RuntimeError(f"list {path} failed: {e}") from e
+        items: List[dict] = body.get("items") or []
+        dest = getattr(rt, field)
+        for d in items:
+            if field == "pods" and not _pod_admissible(d):
+                continue
+            dest.append(wrap(d))
+    return rt
 
 
 def cluster_from_kubeconfig(kubeconfig: str, master: Optional[str] = None) -> ResourceTypes:
     try:
         from kubernetes import client, config  # type: ignore
-    except ImportError as e:
-        raise RuntimeError(
-            "live-cluster mode needs the `kubernetes` Python client, which is "
-            "not installed in this environment; use spec.cluster.customConfig "
-            "with a YAML directory instead"
-        ) from e
+    except ImportError:
+        return _cluster_via_rest(kubeconfig, master)
 
     config.load_kube_config(config_file=kubeconfig)
     core = client.CoreV1Api()
@@ -37,13 +139,7 @@ def cluster_from_kubeconfig(kubeconfig: str, master: Optional[str] = None) -> Re
         rt.nodes.append(Node.from_dict(to_dict(n)))
     for p in core.list_pod_for_all_namespaces(resource_version="0").items:
         d = to_dict(p)
-        phase = (d.get("status") or {}).get("phase", "")
-        if phase not in ("Running", "Pending"):
-            continue
-        if (d.get("metadata") or {}).get("deletionTimestamp"):
-            continue
-        owners = (d.get("metadata") or {}).get("ownerReferences") or []
-        if any(o.get("kind") == "DaemonSet" for o in owners):
+        if not _pod_admissible(d):
             continue
         rt.pods.append(Pod.from_dict(d))
     for ds in apps.list_daemon_set_for_all_namespaces().items:

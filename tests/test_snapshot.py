@@ -142,14 +142,18 @@ def test_snapshot_filters_match_reference(monkeypatch):
     assert len(rt.pvcs) == 1 and len(rt.config_maps) == 1 and len(rt.pdbs) == 1
 
 
-def test_snapshot_missing_client_raises(monkeypatch):
+def test_snapshot_missing_client_falls_back_to_rest(monkeypatch, tmp_path):
+    """Without the kubernetes package the stdlib REST fallback takes over
+    (round 5); a kubeconfig with no reachable server still fails clearly."""
     for mod in ("kubernetes", "kubernetes.client", "kubernetes.config"):
         monkeypatch.delitem(sys.modules, mod, raising=False)
     monkeypatch.setitem(sys.modules, "kubernetes", None)  # force ImportError
     from opensim_tpu.server.snapshot import cluster_from_kubeconfig
 
-    with pytest.raises(RuntimeError, match="customConfig"):
-        cluster_from_kubeconfig("/tmp/kubeconfig")
+    empty = tmp_path / "kubeconfig"
+    empty.write_text("apiVersion: v1\nkind: Config\n")
+    with pytest.raises(RuntimeError, match="no cluster server"):
+        cluster_from_kubeconfig(str(empty))
 
 
 def test_server_caches_snapshot_between_requests(monkeypatch):
@@ -245,3 +249,109 @@ def test_recorded_snapshot_round_trip(monkeypatch):
     # the new deployment spreads over the two schedulable workers only
     rollout_nodes = {placed[n] for n in placed if n.startswith("rollout")}
     assert rollout_nodes == {"prod-worker-1", "prod-worker-2"}
+
+
+# ---------------------------------------------------------------------------
+# stub-apiserver e2e (VERDICT r4 #8): the stdlib REST fallback drives the
+# full kubeConfig-mode `simon apply` pipeline against a canned HTTP server
+# ---------------------------------------------------------------------------
+
+
+def _stub_apiserver(payloads):
+    """~40-line fake apiserver: GET the kube list endpoints, serve canned
+    kind: List JSON; everything else 404."""
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            if path not in payloads:
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = json.dumps({"kind": "List", "items": payloads[path]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+def test_apply_against_stub_apiserver(tmp_path):
+    """End-to-end: kubeConfig-mode Applier.run() lists the cluster from a
+    stub apiserver over HTTP (no kubernetes package in this image), binds
+    the snapshot's Running pod as forced, schedules the app, and reports."""
+    import sys as _sys
+
+    assert "kubernetes" not in _sys.modules or not getattr(
+        _sys.modules.get("kubernetes"), "__file__", None
+    )
+
+    node = fx.make_fake_node("live-1", "8", "16Gi").raw
+    node2 = fx.make_fake_node("live-2", "8", "16Gi").raw
+    payloads = {
+        "/api/v1/nodes": [node, node2],
+        "/api/v1/pods": [
+            _pod("bound", phase="Running", node="live-1"),
+            _pod("finished", phase="Succeeded", node="live-1"),  # filtered
+            _pod(
+                "ds-owned",
+                phase="Running",
+                node="live-2",
+                owners=[{"kind": "DaemonSet", "name": "d", "uid": "u1"}],
+            ),  # filtered (re-expanded from the DS)
+        ],
+        "/apis/apps/v1/daemonsets": [],
+        "/apis/policy/v1/poddisruptionbudgets": [],
+        "/api/v1/services": [],
+        "/apis/storage.k8s.io/v1/storageclasses": [],
+        "/api/v1/persistentvolumeclaims": [],
+        "/api/v1/configmaps": [],
+    }
+    httpd = _stub_apiserver(payloads)
+    try:
+        port = httpd.server_address[1]
+        kubeconfig = tmp_path / "kubeconfig"
+        kubeconfig.write_text(
+            "apiVersion: v1\nkind: Config\ncurrent-context: stub\n"
+            "contexts:\n  - name: stub\n    context: {cluster: stub, user: stub}\n"
+            f"clusters:\n  - name: stub\n    cluster: {{server: 'http://127.0.0.1:{port}'}}\n"
+            "users:\n  - name: stub\n    user: {token: stub-token}\n"
+        )
+        appdir = tmp_path / "app"
+        appdir.mkdir()
+        import yaml as _yaml
+
+        (appdir / "deploy.yaml").write_text(
+            _yaml.safe_dump(fx.make_fake_deployment("web", 3, "500m", "512Mi").raw)
+        )
+        cfg = tmp_path / "simon-config.yaml"
+        cfg.write_text(
+            "apiVersion: simon/v1alpha1\nkind: Config\nmetadata: {name: live}\n"
+            "spec:\n"
+            f"  cluster: {{kubeConfig: '{kubeconfig}'}}\n"
+            "  appList:\n"
+            f"    - {{name: webapp, path: '{appdir}'}}\n"
+        )
+        from opensim_tpu.planner.apply import Applier, Options
+
+        out = tmp_path / "report.txt"
+        rc = Applier(Options(simon_config=str(cfg), output_file=str(out))).run()
+        text = out.read_text()
+        assert rc == 0, text
+        assert "Simulation success!" in text
+        assert "live-1" in text and "live-2" in text
+        # the snapshot's Running pod re-bound as forced onto live-1: its
+        # 100m shows in live-1's requests alongside any app pods
+        assert "webapp" in text
+    finally:
+        httpd.shutdown()

@@ -182,10 +182,41 @@ struct TmplCache {
   std::vector<uint8_t> feas;
   std::vector<uint8_t> ignored;
   std::vector<float> pre;         // bal+least+na+tt accumulated in pod_step order
-  std::vector<float> spr_raw, spr_term, share_term, av_term, score;
+  std::vector<float> spr_raw, share_term, av_term, score;
   float sh_lo = 0, sh_hi = 0, sh_rng = 0, na_max = 0, tt_max = 0;
   float spr_mn = 0, spr_mx = 0;
   bool any_soft = false;
+  // domain mode: exactly ONE active soft spread constraint. Every member
+  // of a topology domain shares the same raw (cnt·w + (skew-1)), so the
+  // cache keeps ONE value per domain (dm_V) instead of per node — a bind
+  // then updates O(1) state (+ an O(domains) min rescan when the old min
+  // domain grew) instead of walking the domain's member nodes.
+  bool dom_mode = false;
+  int32_t dm_tk = -1, dm_sel = -1;
+  float dm_w = 0, dm_k = 0;
+  std::vector<float> dm_V;       // [Dp1] per-domain raw; trash row = 0
+  std::vector<int32_t> dm_dom;   // [N] node → domain of dm_tk (contiguous)
+  std::vector<int32_t> dm_scored;  // [Dp1] feas && !ignored member count
+  std::vector<int32_t> dm_doms;  // compact list of scored domains
+  std::vector<int32_t> dm_zi;    // [N] compact domain index (0 for ig)
+  // hier mode: exactly TWO active soft constraints where one partitions
+  // nodes into singleton domains (hostname) — the system-default spread
+  // pair. raw = fine-term + coarse-term (in cc order); min/max maintain
+  // via per-coarse-domain histograms of the fine count level, so a bind
+  // is O(1) amortized (+ an O(coarse domains) global-min recompute).
+  bool hier_mode = false;
+  bool hier_fine_first = true;   // is the FINE cc the first in cc order?
+  int32_t hf_sel = -1, hc_sel = -1;
+  float hf_w = 0, hf_k = 0, hc_w = 0, hc_k = 0;
+  std::vector<float> hf_V, hc_V;        // [Dp1] per-domain term; trash = 0
+  std::vector<int32_t> hf_dom, hc_dom;  // [N] node → domain (contiguous)
+  std::vector<int32_t> hf_lev;          // [N] int fine count level
+  std::vector<std::vector<int32_t>> hc_hist;  // per coarse dom: scored levels
+  std::vector<int32_t> hc_minlev, hc_maxlev;  // [Dp1]
+  std::vector<uint8_t> hc_has;          // [Dp1] any scored member
+  std::vector<int32_t> hc_doms;         // compact list of scored coarse doms
+  std::vector<int32_t> hc_zi;           // [N] compact coarse-dom index (0 for ig)
+  std::vector<float> sel_T;             // per-step (zone, level) term LUT scratch
   std::vector<int32_t> fail_row;  // memoized failure outputs (state unchanged)
   std::vector<int32_t> ins_row;
 };
@@ -731,21 +762,12 @@ struct EnvCtx {
 };
 
 inline float recombine(const TmplCache& tc, const EnvCtx& e, int64_t n) {
+  // only called for templates WITHOUT an active soft spread (those
+  // combine the spread term on the fly in the select loop)
   float sc = tc.pre[n];
-  if (e.use_spr && tc.any_soft) sc += tc.spr_term[n];
   if (e.use_share) sc += tc.share_term[n];
   if (e.use_avoid) sc += tc.av_term[n];
   return sc;
-}
-
-inline float spr_term_of(const TmplCache& tc, const EnvCtx& e, int64_t n) {
-  float norm;
-  if (tc.spr_mx <= 0.0f)
-    norm = MAXS;
-  else
-    norm = MAXS * (tc.spr_mx + tc.spr_mn - tc.spr_raw[n]) / std::max(tc.spr_mx, 1.0f);
-  if (tc.ignored[n]) norm = 0.0f;
-  return e.wsp * norm;
 }
 
 // Full per-template evaluation into the cache (incremental envelope only:
@@ -758,8 +780,92 @@ void full_eval_env(ScanArgs& a, TmplCache& tc, const EnvCtx& e, PreCtx& c, int32
   tc.pending.clear();
 
   tc.any_soft = false;
+  int n_soft = 0;
+  int64_t soft_cc = -1;
   for (int64_t cc = 0; cc < a.Cs; cc++)
-    if (a.spr_topo[u * a.Cs + cc] >= 0 && !a.spr_hard[u * a.Cs + cc]) tc.any_soft = true;
+    if (a.spr_topo[u * a.Cs + cc] >= 0 && !a.spr_hard[u * a.Cs + cc]) {
+      tc.any_soft = true;
+      n_soft++;
+      soft_cc = cc;
+    }
+  tc.dom_mode = e.use_spr && n_soft == 1;
+  if (tc.dom_mode) {
+    const int32_t trash = (int32_t)a.Dp1 - 1;
+    tc.dm_tk = a.spr_topo[u * a.Cs + soft_cc];
+    tc.dm_sel = a.spr_sel[u * a.Cs + soft_cc];
+    tc.dm_w = a.spread_weight[tc.dm_tk];
+    tc.dm_k = (float)a.spr_skew[u * a.Cs + soft_cc] - 1.0f;
+    tc.dm_V.assign(a.Dp1, 0.0f);
+    for (int32_t d = 0; d < trash; d++)
+      tc.dm_V[d] = a.dom_sel[(int64_t)d * a.A + tc.dm_sel] * tc.dm_w + tc.dm_k;
+    tc.dm_dom.resize(N);
+    for (int64_t n = 0; n < N; n++) tc.dm_dom[n] = a.node_domain[n * a.Tk + tc.dm_tk];
+    tc.dm_scored.assign(a.Dp1, 0);
+  }
+  tc.hier_mode = false;
+  if (e.use_spr && n_soft == 2) {
+    const int32_t trash = (int32_t)a.Dp1 - 1;
+    int64_t ccs[2];
+    int k = 0;
+    for (int64_t cc = 0; cc < a.Cs; cc++)
+      if (a.spr_topo[u * a.Cs + cc] >= 0 && !a.spr_hard[u * a.Cs + cc]) ccs[k++] = cc;
+    // fine = a cc whose non-trash domains are node-singletons; coarse =
+    // the other, with a bounded domain count (global-min recompute is
+    // O(coarse domains) per bind)
+    auto singleton = [&](int64_t cc) {
+      int32_t tk = a.spr_topo[u * a.Cs + cc];
+      std::vector<int32_t> cnt(a.Dp1, 0);
+      for (int64_t n = 0; n < N; n++) {
+        int32_t d = a.node_domain[n * a.Tk + tk];
+        if (d != trash && ++cnt[d] > 1) return false;
+      }
+      return true;
+    };
+    auto dom_count = [&](int64_t cc) {
+      int32_t tk = a.spr_topo[u * a.Cs + cc];
+      std::vector<uint8_t> seen(a.Dp1, 0);
+      int64_t c = 0;
+      for (int64_t n = 0; n < N; n++) {
+        int32_t d = a.node_domain[n * a.Tk + tk];
+        if (d != trash && !seen[d]) { seen[d] = 1; c++; }
+      }
+      return c;
+    };
+    int fine = singleton(ccs[0]) ? 0 : (singleton(ccs[1]) ? 1 : -1);
+    if (fine >= 0 && dom_count(ccs[1 - fine]) <= 256) {
+      tc.hier_mode = true;
+      tc.hier_fine_first = fine == 0;
+      int64_t fcc = ccs[fine], ccc = ccs[1 - fine];
+      int32_t ftk = a.spr_topo[u * a.Cs + fcc];
+      int32_t ctk = a.spr_topo[u * a.Cs + ccc];
+      tc.hf_sel = a.spr_sel[u * a.Cs + fcc];
+      tc.hc_sel = a.spr_sel[u * a.Cs + ccc];
+      tc.hf_w = a.spread_weight[ftk];
+      tc.hf_k = (float)a.spr_skew[u * a.Cs + fcc] - 1.0f;
+      tc.hc_w = a.spread_weight[ctk];
+      tc.hc_k = (float)a.spr_skew[u * a.Cs + ccc] - 1.0f;
+      tc.hf_V.assign(a.Dp1, 0.0f);
+      tc.hc_V.assign(a.Dp1, 0.0f);
+      for (int32_t d = 0; d < trash; d++) {
+        tc.hf_V[d] = a.dom_sel[(int64_t)d * a.A + tc.hf_sel] * tc.hf_w + tc.hf_k;
+        tc.hc_V[d] = a.dom_sel[(int64_t)d * a.A + tc.hc_sel] * tc.hc_w + tc.hc_k;
+      }
+      tc.hf_dom.resize(N);
+      tc.hc_dom.resize(N);
+      tc.hf_lev.resize(N);
+      for (int64_t n = 0; n < N; n++) {
+        tc.hf_dom[n] = a.node_domain[n * a.Tk + ftk];
+        tc.hc_dom[n] = a.node_domain[n * a.Tk + ctk];
+        int32_t fd = tc.hf_dom[n];
+        tc.hf_lev[n] =
+            fd == trash ? 0 : (int32_t)a.dom_sel[(int64_t)fd * a.A + tc.hf_sel];
+      }
+      tc.hc_hist.assign(a.Dp1, {});
+      tc.hc_minlev.assign(a.Dp1, 0);
+      tc.hc_maxlev.assign(a.Dp1, 0);
+      tc.hc_has.assign(a.Dp1, 0);
+    }
+  }
 
   const uint8_t* sp = a.static_pass + (int64_t)u * N;
   const float* share = a.share_raw + (int64_t)u * N;
@@ -774,9 +880,24 @@ void full_eval_env(ScanArgs& a, TmplCache& tc, const EnvCtx& e, PreCtx& c, int32
       shhi = std::max(shhi, share[n]);
     }
     if (e.use_spr && tc.any_soft) {
-      bool all_labels;
-      tc.spr_raw[n] = spr_raw_at(a, u, n, &all_labels);
-      tc.ignored[n] = f && !all_labels;
+      if (tc.dom_mode) {
+        // single soft constraint: raw is the domain's value (bit-exact
+        // with spr_raw_at: 0.0f + term == term for the non-negative terms)
+        int32_t dom = tc.dm_dom[n];
+        tc.spr_raw[n] = tc.dm_V[dom];
+        tc.ignored[n] = f && dom == (int32_t)a.Dp1 - 1;
+      } else if (tc.hier_mode) {
+        const int32_t trash = (int32_t)a.Dp1 - 1;
+        int32_t fd = tc.hf_dom[n], cd = tc.hc_dom[n];
+        float first = tc.hier_fine_first ? tc.hf_V[fd] : tc.hc_V[cd];
+        float second = tc.hier_fine_first ? tc.hc_V[cd] : tc.hf_V[fd];
+        tc.spr_raw[n] = first + second;  // cc-order sum, trash rows are 0
+        tc.ignored[n] = f && (fd == trash || cd == trash);
+      } else {
+        bool all_labels;
+        tc.spr_raw[n] = spr_raw_at(a, u, n, &all_labels);
+        tc.ignored[n] = f && !all_labels;
+      }
     } else {
       tc.ignored[n] = 0;
     }
@@ -794,20 +915,57 @@ void full_eval_env(ScanArgs& a, TmplCache& tc, const EnvCtx& e, PreCtx& c, int32
       if (tc.feas[n] && !tc.ignored[n]) {
         mn = std::min(mn, tc.spr_raw[n]);
         mx = std::max(mx, tc.spr_raw[n]);
+        if (tc.dom_mode) tc.dm_scored[tc.dm_dom[n]]++;
+        if (tc.hier_mode) {
+          int32_t cd = tc.hc_dom[n];
+          int32_t lev = tc.hf_lev[n];
+          auto& h = tc.hc_hist[cd];
+          if ((size_t)lev >= h.size()) h.resize(lev + 1, 0);
+          h[lev]++;
+          if (!tc.hc_has[cd]) {
+            tc.hc_has[cd] = 1;
+            tc.hc_minlev[cd] = tc.hc_maxlev[cd] = lev;
+          } else {
+            tc.hc_minlev[cd] = std::min(tc.hc_minlev[cd], lev);
+            tc.hc_maxlev[cd] = std::max(tc.hc_maxlev[cd], lev);
+          }
+        }
       }
     }
     tc.spr_mn = mn;
     tc.spr_mx = mx;
+    if (tc.dom_mode) {
+      tc.dm_doms.clear();
+      std::vector<int32_t> zidx(a.Dp1, 0);
+      for (int32_t d = 0; d < (int32_t)a.Dp1 - 1; d++)
+        if (tc.dm_scored[d] > 0) {
+          zidx[d] = (int32_t)tc.dm_doms.size();
+          tc.dm_doms.push_back(d);
+        }
+      tc.dm_zi.resize(N);
+      for (int64_t n = 0; n < N; n++) tc.dm_zi[n] = zidx[tc.dm_dom[n]];
+    }
+    if (tc.hier_mode) {
+      tc.hc_doms.clear();
+      std::vector<int32_t> zidx(a.Dp1, 0);
+      for (int32_t d = 0; d < (int32_t)a.Dp1 - 1; d++)
+        if (tc.hc_has[d]) {
+          zidx[d] = (int32_t)tc.hc_doms.size();
+          tc.hc_doms.push_back(d);
+        }
+      tc.hc_zi.resize(N);
+      for (int64_t n = 0; n < N; n++) tc.hc_zi[n] = zidx[tc.hc_dom[n]];
+    }
   }
   const float* avoid = a.avoid_score + (int64_t)u * N;
+  const bool lazy = e.use_spr && tc.any_soft;  // select combines on the fly
   for (int64_t n = 0; n < N; n++) {
     tc.pre[n] = pre_at(a, c, n);
-    if (e.use_spr && tc.any_soft) tc.spr_term[n] = spr_term_of(tc, e, n);
     if (e.use_share)
       tc.share_term[n] =
           e.wshare * (tc.sh_rng > 0.0f ? (share[n] - tc.sh_lo) * MAXS / tc.sh_rng : 0.0f);
     if (e.use_avoid) tc.av_term[n] = e.wav * avoid[n];
-    tc.score[n] = recombine(tc, e, n);
+    if (!lazy) tc.score[n] = recombine(tc, e, n);
   }
 }
 
@@ -822,8 +980,79 @@ bool apply_deltas(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e, PreCt
     if (f != tc.feas[j]) return false;  // feasible set shifted: reductions stale
     tc.pre[j] = pre_at(a, c, j);
 
-    bool scal_changed = false;
-    if (e.use_spr && tc.any_soft) {
+    if (e.use_spr && tc.any_soft && tc.dom_mode) {
+      // single soft constraint: every member of j's domain shares one raw
+      // value — update it and the min/max scalars in O(1) (+ an
+      // O(domains) min rescan when the previous-minimum domain grew)
+      const int32_t trash = (int32_t)a.Dp1 - 1;
+      int32_t jdom = tc.dm_dom[j];
+      if (jdom != trash) {
+        float newV =
+            a.dom_sel[(int64_t)jdom * a.A + tc.dm_sel] * tc.dm_w + tc.dm_k;
+        float oldV = tc.dm_V[jdom];
+        if (newV != oldV) {
+          tc.dm_V[jdom] = newV;
+          if (tc.dm_scored[jdom] > 0) {
+            tc.spr_mx = std::max(tc.spr_mx, newV);
+            if (oldV <= tc.spr_mn) {
+              float mn = BIG;
+              for (int32_t d = 0; d < trash; d++)
+                if (tc.dm_scored[d] > 0) mn = std::min(mn, tc.dm_V[d]);
+              tc.spr_mn = mn;
+            }
+          }
+        }
+      }
+    } else if (e.use_spr && tc.any_soft && tc.hier_mode) {
+      // default-spread pair: O(1) term updates; min/max via the
+      // per-coarse-domain histograms of the fine count level
+      const int32_t trash = (int32_t)a.Dp1 - 1;
+      int32_t fd = tc.hf_dom[j], cd = tc.hc_dom[j];
+      bool cd_changed = false;
+      if (fd != trash) {
+        float fcount = a.dom_sel[(int64_t)fd * a.A + tc.hf_sel];
+        float nV = fcount * tc.hf_w + tc.hf_k;
+        if (nV != tc.hf_V[fd]) {
+          tc.hf_V[fd] = nV;
+          int32_t nl = (int32_t)fcount;
+          int32_t ol = tc.hf_lev[j];
+          tc.hf_lev[j] = nl;
+          if (tc.feas[j] && !tc.ignored[j] && nl != ol) {
+            auto& h = tc.hc_hist[cd];
+            if ((size_t)nl >= h.size()) h.resize(nl + 1, 0);
+            h[ol]--;
+            h[nl]++;
+            if (nl > tc.hc_maxlev[cd]) tc.hc_maxlev[cd] = nl;
+            if (ol == tc.hc_minlev[cd])
+              while (tc.hc_minlev[cd] < tc.hc_maxlev[cd] &&
+                     h[tc.hc_minlev[cd]] == 0)
+                tc.hc_minlev[cd]++;
+            cd_changed = true;
+          }
+        }
+      }
+      if (cd != trash) {
+        float nV = a.dom_sel[(int64_t)cd * a.A + tc.hc_sel] * tc.hc_w + tc.hc_k;
+        if (nV != tc.hc_V[cd]) {
+          tc.hc_V[cd] = nV;
+          if (tc.hc_has[cd]) cd_changed = true;
+        }
+      }
+      if (cd_changed && cd != trash && tc.hc_has[cd]) {
+        // fine value from the integer level: (float)lev equals the count
+        // float exactly (< 2^24), so these sums are bit-identical to the
+        // per-node spr_raw_at recomputation
+        auto dom_raw = [&](int32_t d, int32_t lev) {
+          float fv = (float)lev * tc.hf_w + tc.hf_k;
+          float cv = tc.hc_V[d];
+          return tc.hier_fine_first ? fv + cv : cv + fv;
+        };
+        tc.spr_mx = std::max(tc.spr_mx, dom_raw(cd, tc.hc_maxlev[cd]));
+        float mn = BIG;
+        for (int32_t d : tc.hc_doms) mn = std::min(mn, dom_raw(d, tc.hc_minlev[d]));
+        tc.spr_mn = mn;
+      }
+    } else if (e.use_spr && tc.any_soft) {
       // only nodes sharing a soft-constraint domain with j see new counts;
       // walk the per-domain member lists instead of scanning the node axis
       const int32_t trash = (int32_t)a.Dp1 - 1;
@@ -863,41 +1092,15 @@ bool apply_deltas(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e, PreCt
           new_mn = std::min(new_mn, v);
         }
       }
-      scal_changed = (new_mx != tc.spr_mx) || (new_mn != tc.spr_mn);
       tc.spr_mx = new_mx;
       tc.spr_mn = new_mn;
-      if (scal_changed) {
-        // normalization scalars moved: every node's spread term shifts.
-        // Branchless over the full axis (values at infeasible nodes are
-        // consistent but never read — argmax guards on feas)
-        const float mx = tc.spr_mx, mn = tc.spr_mn;
-        const float denom = std::max(mx, 1.0f);
-        const uint8_t* ig = tc.ignored.data();
-        const float* raw = tc.spr_raw.data();
-        float* term = tc.spr_term.data();
-        float* score = tc.score.data();
-        const float* pre = tc.pre.data();
-        const float* sht = tc.share_term.data();
-        const float* avt = tc.av_term.data();
-        const bool ush = e.use_share, uav = e.use_avoid;
-        const float wsp = e.wsp;
-        for (int64_t n = 0; n < N; n++) {
-          float norm = (mx <= 0.0f) ? MAXS : MAXS * (mx + mn - raw[n]) / denom;
-          norm = ig[n] ? 0.0f : norm;
-          term[n] = wsp * norm;
-          float sc = pre[n] + term[n];
-          if (ush) sc += sht[n];
-          if (uav) sc += avt[n];
-          score[n] = sc;
-        }
-      } else {
-        for (int32_t n : s.touch) {
-          tc.spr_term[n] = spr_term_of(tc, e, n);
-          tc.score[n] = recombine(tc, e, n);
-        }
-      }
+      // NOTE: no materialized score for any_soft templates — the select
+      // loop combines pre/spr/share/avoid on the fly (identical float op
+      // order to the old recombine()+spr_term path, so placements are
+      // unchanged). A moved normalization scalar therefore costs nothing
+      // here, where it used to rewrite term+score over the node axis.
     }
-    if (tc.feas[j]) tc.score[j] = recombine(tc, e, j);
+    if (!(e.use_spr && tc.any_soft) && tc.feas[j]) tc.score[j] = recombine(tc, e, j);
   }
   tc.pending.clear();
   return true;
@@ -999,7 +1202,6 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
     tc.ignored.resize(N);
     tc.pre.resize(N);
     tc.spr_raw.resize(N);
-    tc.spr_term.resize(N);
     tc.share_term.resize(N);
     tc.av_term.resize(N);
     tc.score.resize(N);
@@ -1086,14 +1288,113 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
 
       prof.start();
       // two-pass first-argmax: a branchless masked max (vectorizes), then
-      // the first index attaining it — identical to the strict > scan
+      // the first index attaining it — identical to the strict > scan.
+      // For soft-spread templates the score is combined on the fly from
+      // its cached components (pre + wsp·norm + share + avoid, the exact
+      // recombine() op order) so binds never rewrite a full score axis.
       float best = NEG;
       int32_t bi = -1;
-      const float* sc = tc.score.data();
       const uint8_t* fe = tc.feas.data();
-      for (int64_t n = 0; n < N; n++) {
-        float v = fe[n] ? sc[n] : NEG;
-        best = std::max(best, v);
+      const bool lazy_spr = env.use_spr && tc.any_soft;
+      const bool dm = tc.dom_mode;
+      const bool hm = tc.hier_mode;
+      const bool hff = tc.hier_fine_first;
+      const float* sc = tc.score.data();
+      const float* pre = tc.pre.data();
+      const float* raw = tc.spr_raw.data();
+      const float* dmV = dm ? tc.dm_V.data() : nullptr;
+      const int32_t* dmD = dm ? tc.dm_dom.data() : nullptr;
+      const float* hfV = hm ? tc.hf_V.data() : nullptr;
+      const float* hcV = hm ? tc.hc_V.data() : nullptr;
+      const int32_t* hfD = hm ? tc.hf_dom.data() : nullptr;
+      const int32_t* hcD = hm ? tc.hc_dom.data() : nullptr;
+      const float* sht = tc.share_term.data();
+      const float* avt = tc.av_term.data();
+      const uint8_t* ig = tc.ignored.data();
+      const float l_mx = tc.spr_mx, l_mn = tc.spr_mn;
+      const float l_denom = std::max(l_mx, 1.0f);
+      const bool ush = env.use_share, uav = env.use_avoid;
+      const float l_wsp = env.wsp;
+      auto sc_at = [&](int64_t n) -> float {
+        if (!lazy_spr) return sc[n];
+        float r;
+        if (dm)
+          r = dmV[dmD[n]];
+        else if (hm) {
+          float fv = hfV[hfD[n]], cv = hcV[hcD[n]];
+          r = hff ? fv + cv : cv + fv;
+        } else
+          r = raw[n];
+        float norm = (l_mx <= 0.0f) ? MAXS : MAXS * (l_mx + l_mn - r) / l_denom;
+        norm = ig[n] ? 0.0f : norm;
+        float v = pre[n] + l_wsp * norm;
+        if (ush) v += sht[n];
+        if (uav) v += avt[n];
+        return v;
+      };
+      // hier fast path: the spread term takes at most (zones × levels)
+      // distinct values per step — precompute the normed term once (same
+      // float expression as sc_at, so scores are bit-identical) and run
+      // the select as a division-free gather loop
+      const float* T = nullptr;
+      int64_t TL = 0;
+      const int32_t* zi = nullptr;
+      const int32_t* lv = nullptr;
+      if (lazy_spr && hm) {
+        int32_t maxl = 0;
+        for (int32_t d : tc.hc_doms) maxl = std::max(maxl, tc.hc_maxlev[d]);
+        TL = (int64_t)maxl + 1;
+        int64_t Z = (int64_t)tc.hc_doms.size();
+        if (Z > 0 && Z * TL <= 4096) {
+          tc.sel_T.resize(Z * TL);
+          for (int64_t z = 0; z < Z; z++) {
+            float cv = tc.hc_V[tc.hc_doms[z]];
+            for (int64_t l = 0; l < TL; l++) {
+              float fv = (float)l * tc.hf_w + tc.hf_k;
+              float r = hff ? fv + cv : cv + fv;
+              float norm =
+                  (l_mx <= 0.0f) ? MAXS : MAXS * (l_mx + l_mn - r) / l_denom;
+              tc.sel_T[z * TL + l] = l_wsp * norm;
+            }
+          }
+          T = tc.sel_T.data();
+          zi = tc.hc_zi.data();
+          lv = tc.hf_lev.data();
+        }
+      } else if (lazy_spr && dm && !tc.dm_doms.empty() &&
+                 (int64_t)tc.dm_doms.size() <= 4096) {
+        // single-constraint LUT: one normed term per scored domain
+        TL = 1;
+        int64_t Z = (int64_t)tc.dm_doms.size();
+        tc.sel_T.resize(Z);
+        for (int64_t z = 0; z < Z; z++) {
+          float r = tc.dm_V[tc.dm_doms[z]];
+          float norm = (l_mx <= 0.0f) ? MAXS : MAXS * (l_mx + l_mn - r) / l_denom;
+          tc.sel_T[z] = l_wsp * norm;
+        }
+        T = tc.sel_T.data();
+        zi = tc.dm_zi.data();
+        lv = nullptr;
+      }
+      auto sc_fast = [&](int64_t n) -> float {
+        // ignored nodes may carry fine levels beyond the scored LUT range
+        // (e.g. a zone-less host full of pods): never index T for them
+        float t = ig[n] ? 0.0f : T[(int64_t)zi[n] * TL + (lv ? lv[n] : 0)];
+        float v = pre[n] + t;
+        if (ush) v += sht[n];
+        if (uav) v += avt[n];
+        return v;
+      };
+      if (T != nullptr) {
+        for (int64_t n = 0; n < N; n++) {
+          float v = fe[n] ? sc_fast(n) : NEG;
+          best = std::max(best, v);
+        }
+      } else {
+        for (int64_t n = 0; n < N; n++) {
+          float v = fe[n] ? sc_at(n) : NEG;
+          best = std::max(best, v);
+        }
       }
       if (best > NEG) {
         if (a.tie_sample) {
@@ -1101,13 +1402,16 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
           uint64_t rs = (uint64_t)a.tie_seed * 0x9E3779B97F4A7C15ULL + (uint64_t)i;
           uint64_t c = 0;
           for (int64_t n = 0; n < N; n++)
-            if (fe[n] && sc[n] == best) {
+            if (fe[n] && (T ? sc_fast(n) : sc_at(n)) == best) {
               c++;
               if (sm64_next(&rs) % c == 0) bi = (int32_t)n;
             }
         } else {
           for (int64_t n = 0; n < N; n++)
-            if (fe[n] && sc[n] == best) { bi = (int32_t)n; break; }
+            if (fe[n] && (T ? sc_fast(n) : sc_at(n)) == best) {
+              bi = (int32_t)n;
+              break;
+            }
         }
       }
       prof.stop(2);

@@ -801,6 +801,37 @@ def simulate(
     return SimulateResult(unscheduled_pods=unscheduled, node_status=statuses, engine=engine)
 
 
+def snapshot_bind_state(prep: "Prepared") -> list:
+    """Capture everything ``_decode`` mutates on the prepared pods so a
+    caller re-running simulations over one Prepared (the planner's
+    sequential differing-profile probes) can restore between runs. Kept
+    NEXT TO ``_decode`` on purpose: any new bind-time pod mutation must be
+    added to both."""
+    return [
+        (
+            p.spec.node_name,
+            p.phase,
+            p.metadata.annotations.get(ANNO_GPU_INDEX),
+            p.metadata.annotations.get(ANNO_GPU_ASSUME_TIME),
+        )
+        for p in prep.ordered
+    ]
+
+
+def restore_bind_state(prep: "Prepared", snap: list) -> None:
+    for p, (node_name, phase, gpu_idx, assume) in zip(prep.ordered, snap):
+        p.spec.node_name = node_name
+        p.phase = phase
+        if gpu_idx is None:
+            p.metadata.annotations.pop(ANNO_GPU_INDEX, None)
+        else:
+            p.metadata.annotations[ANNO_GPU_INDEX] = gpu_idx
+        if assume is None:
+            p.metadata.annotations.pop(ANNO_GPU_ASSUME_TIME, None)
+        else:
+            p.metadata.annotations[ANNO_GPU_ASSUME_TIME] = assume
+
+
 def _decode(
     ordered, chosen, forced, custom_reasons, victims_of, gpu_any, gpu_take,
     sf_rows, static_fail, fail_counts, insufficient, meta, n_nodes,

@@ -145,6 +145,10 @@ enum Stage { S_PORTS = 0, S_FIT, S_SPREAD, S_INTERPOD, S_GPU, S_LOCAL, S_EXTRA, 
 
 struct Scratch {
   std::vector<uint8_t> mask[N_STAGES];  // per-stage node masks (active stages only)
+  // per-topology-key facts, template-independent, memoized lazily:
+  // -1 unknown; singleton = every non-trash domain has <= 1 member
+  std::vector<int8_t> tk_singleton;
+  std::vector<int64_t> tk_domcount;
   std::vector<uint8_t> feas;
   std::vector<float> raw_ip, raw_spr, raw_loc;
   std::vector<uint8_t> spr_ignored;
@@ -772,7 +776,8 @@ inline float recombine(const TmplCache& tc, const EnvCtx& e, int64_t n) {
 
 // Full per-template evaluation into the cache (incremental envelope only:
 // active dynamic masks ⊆ {fit}, no interpod/local score).
-void full_eval_env(ScanArgs& a, TmplCache& tc, const EnvCtx& e, PreCtx& c, int32_t u) {
+void full_eval_env(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e,
+                   PreCtx& c, int32_t u) {
   const int64_t N = a.N;
   tc.u = u;
   tc.valid = true;
@@ -811,25 +816,36 @@ void full_eval_env(ScanArgs& a, TmplCache& tc, const EnvCtx& e, PreCtx& c, int32
       if (a.spr_topo[u * a.Cs + cc] >= 0 && !a.spr_hard[u * a.Cs + cc]) ccs[k++] = cc;
     // fine = a cc whose non-trash domains are node-singletons; coarse =
     // the other, with a bounded domain count (global-min recompute is
-    // O(coarse domains) per bind)
+    // O(coarse domains) per bind). Both facts are per-TOPOLOGY-KEY and
+    // template-independent — memoized in Scratch across full_evals.
+    if (s.tk_singleton.empty()) {
+      s.tk_singleton.assign(a.Tk, -1);
+      s.tk_domcount.assign(a.Tk, -1);
+    }
+    auto tk_facts = [&](int32_t tk) {
+      if (s.tk_singleton[tk] < 0) {
+        std::vector<int32_t> cnt(a.Dp1, 0);
+        bool single = true;
+        int64_t doms = 0;
+        for (int64_t n = 0; n < N; n++) {
+          int32_t d = a.node_domain[n * a.Tk + tk];
+          if (d == trash) continue;
+          if (++cnt[d] == 1) doms++;
+          if (cnt[d] > 1) single = false;
+        }
+        s.tk_singleton[tk] = single ? 1 : 0;
+        s.tk_domcount[tk] = doms;
+      }
+    };
     auto singleton = [&](int64_t cc) {
       int32_t tk = a.spr_topo[u * a.Cs + cc];
-      std::vector<int32_t> cnt(a.Dp1, 0);
-      for (int64_t n = 0; n < N; n++) {
-        int32_t d = a.node_domain[n * a.Tk + tk];
-        if (d != trash && ++cnt[d] > 1) return false;
-      }
-      return true;
+      tk_facts(tk);
+      return s.tk_singleton[tk] == 1;
     };
     auto dom_count = [&](int64_t cc) {
       int32_t tk = a.spr_topo[u * a.Cs + cc];
-      std::vector<uint8_t> seen(a.Dp1, 0);
-      int64_t c = 0;
-      for (int64_t n = 0; n < N; n++) {
-        int32_t d = a.node_domain[n * a.Tk + tk];
-        if (d != trash && !seen[d]) { seen[d] = 1; c++; }
-      }
-      return c;
+      tk_facts(tk);
+      return s.tk_domcount[tk];
     };
     int fine = singleton(ccs[0]) ? 0 : (singleton(ccs[1]) ? 1 : -1);
     if (fine >= 0 && dom_count(ccs[1 - fine]) <= 256) {
@@ -1282,7 +1298,7 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
       }
       if (!(tc.valid && tc.u == u)) {
         prof.start();
-        full_eval_env(a, tc, env, pc, u);
+        full_eval_env(a, s, tc, env, pc, u);
         prof.stop(1);
       }
 

@@ -391,3 +391,35 @@ def test_native_sampled_matches_deterministic_structure_on_fuzz():
     for seed in (0, 1, 7):
         out = nativepath.schedule(prep, pv, tie_seed=seed)
         assert int((out.chosen >= 0).sum()) == det_sched
+
+
+def test_native_default_spread_with_unlabeled_nodes():
+    """Hier-mode edge: a node WITHOUT the zone label is spread-ignored but
+    still schedulable, and its per-host pod count can exceed every scored
+    zone's level range — the select must never index the (zone, level) LUT
+    for it. Placements must match the XLA scan exactly."""
+    cluster = ResourceTypes()
+    for i in range(4):
+        cluster.nodes.append(
+            fx.make_fake_node(
+                f"z{i}", "4", "8Gi", "110",
+                fx.with_labels({"topology.kubernetes.io/zone": f"zone-{i % 2}"}),
+            )
+        )
+    # zone-less big node: attracts many pods once the labeled ones fill
+    cluster.nodes.append(fx.make_fake_node("plain", "64", "128Gi"))
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("web", 60, "500m", "512Mi"))
+    apps = [AppResource("a", app)]
+
+    prep = prepare(cluster, apps, node_pad=8)
+    pv = np.ones(len(prep.ordered), bool)
+    out_native = nativepath.schedule(prep, pv)
+    t, v, f = pad_pod_stream(prep.tmpl_ids, pv, prep.forced)
+    out_xla = schedule_pods(prep.ec, prep.st0, t, v, f, features=prep.features)
+    assert np.array_equal(
+        np.asarray(out_native.chosen), np.asarray(out_xla.chosen)[: len(prep.ordered)]
+    )
+    # the unlabeled node really did absorb a level beyond the zoned hosts
+    plain_count = int((np.asarray(out_native.chosen) == 4).sum())
+    assert plain_count > 15, plain_count

@@ -615,3 +615,70 @@ def test_non_segmentable_interleaving_raises(tmp_path):
         rt.pods.append(pod)
     with pytest.raises(ValueError, match="non-segmentable"):
         simulate(cluster, [AppResource("a", rt)], sched_config=cfg)
+
+
+def test_differing_profiles_capacity_sweep(tmp_path):
+    """Full `simon apply` with DIFFERING profiles and a cluster that needs
+    new nodes: the batched sweep cannot run one pipeline, so the planner
+    probes candidate counts with segmented masked simulations and still
+    finds the minimum node count."""
+    import yaml as _yaml
+
+    from opensim_tpu.planner.apply import Applier, Options
+
+    cfgdir = tmp_path / "cluster"
+    cfgdir.mkdir()
+    (cfgdir / "node.yaml").write_text(
+        _yaml.safe_dump(fx.make_fake_node("n0", "8", "16Gi").raw)
+    )
+    newnode = tmp_path / "newnode"
+    newnode.mkdir()
+    (newnode / "node.yaml").write_text(
+        _yaml.safe_dump(fx.make_fake_node("tmpl", "16", "32Gi").raw)
+    )
+    appdir = tmp_path / "app"
+    appdir.mkdir()
+    d1 = fx.make_fake_deployment("default-app", 6, "2", "2Gi")
+    d2 = fx.make_fake_deployment("packer-app", 6, "2", "2Gi")
+    d2.template_spec.scheduler_name = "packer"
+    d2.raw["spec"]["template"].setdefault("spec", {})["schedulerName"] = "packer"
+    (appdir / "apps.yaml").write_text(
+        "---\n".join(_yaml.safe_dump(w.raw) for w in (d1, d2))
+    )
+    sched = tmp_path / "profiles.yaml"
+    sched.write_text(
+        "apiVersion: kubescheduler.config.k8s.io/v1beta1\n"
+        "kind: KubeSchedulerConfiguration\n"
+        "profiles:\n"
+        "  - schedulerName: default-scheduler\n"
+        "  - schedulerName: packer\n"
+        "    plugins:\n"
+        "      score:\n"
+        "        disabled:\n"
+        "          - name: NodeResourcesBalancedAllocation\n"
+        "          - name: NodeResourcesLeastAllocated\n"
+    )
+    cfg = tmp_path / "simon-config.yaml"
+    cfg.write_text(
+        "apiVersion: simon/v1alpha1\nkind: Config\nmetadata: {name: t}\n"
+        "spec:\n"
+        f"  cluster: {{customConfig: '{cfgdir}'}}\n"
+        f"  newNode: '{newnode}'\n"
+        "  appList:\n"
+        f"    - {{name: apps, path: '{appdir}'}}\n"
+    )
+    out = tmp_path / "report.txt"
+    rc = Applier(
+        Options(
+            simon_config=str(cfg),
+            default_scheduler_config=str(sched),
+            output_file=str(out),
+            max_new_nodes=8,
+        )
+    ).run()
+    text = out.read_text()
+    assert rc == 0, text
+    assert "Simulation success!" in text
+    # 12 pods x 2 cpu = 24 cpu; n0 has 8 => at least 1 new 16-cpu node
+    assert "(added" in text
+    assert "segmented multi-profile" in text  # engine footer names the path

@@ -423,51 +423,126 @@ class Applier:
             print(f"Scheduling engine: {result.engine.describe()}", file=self.out)
         return 0
 
+    # survey.Select option labels (apply.go SurveyShowResults/AddNode/Exit)
+    SURVEY_SHOW = "Show unschedulable pods"
+    SURVEY_ADD = "Add nodes"
+    SURVEY_EXIT = "Exit"
+
+    def _survey_select(self, message: str, options: List[str]) -> str:
+        """A terminal stand-in for the reference's pterm/survey selection
+        (apply.go:219-248): numbered options, accepting the number, a
+        unique prefix of the label, or the legacy show/add/exit words."""
+        print(message)
+        for i, opt in enumerate(options, 1):
+            print(f"  {i}) {opt}")
+        legacy = {"show": self.SURVEY_SHOW, "add": self.SURVEY_ADD, "exit": self.SURVEY_EXIT}
+        while True:
+            try:
+                raw = input("> ").strip()
+            except EOFError:
+                return self.SURVEY_EXIT
+            if raw.isdigit() and 1 <= int(raw) <= len(options):
+                return options[int(raw) - 1]
+            lowered = raw.lower()
+            if lowered in legacy and legacy[lowered] in options:
+                return legacy[lowered]
+            # legacy one-shot "add N" (the pre-round-5 syntax): stash the
+            # count so the number prompt is skipped
+            parts = lowered.split()
+            if (
+                len(parts) == 2 and parts[0] == "add" and self.SURVEY_ADD in options
+                and parts[1].lstrip("-").isdigit()
+            ):
+                self._pending_add = int(parts[1])
+                return self.SURVEY_ADD
+            matches = [o for o in options if o.lower().startswith(lowered)] if raw else []
+            if len(matches) == 1:
+                return matches[0]
+            print(f"choose 1-{len(options)}")
+
+    def _survey_int(self, message: str) -> Optional[int]:
+        """survey.Input for 'input node number' (apply.go:235-241)."""
+        pending = getattr(self, "_pending_add", None)
+        if pending is not None:
+            self._pending_add = None
+            raw = str(pending)
+        else:
+            try:
+                raw = input(f"{message} > ").strip()
+            except EOFError:
+                return None
+        try:
+            num = int(raw)
+        except ValueError:
+            print("not a number")
+            return None
+        if num < 1:
+            print("node number must be >= 1")
+            return None
+        return num
+
     def _run_interactive(self, cluster, apps, template) -> int:
-        """The reference's prompt loop (apply.go:203-259)."""
+        """The reference's prompt loop (apply.go:203-259): re-simulate only
+        when the node count changed (Show Results re-prompts over the SAME
+        result), survey-style selection, separate node-number input."""
         from ..utils.progress import Spinner
 
         n_new = 0
         result = None
+        resimulate = True
         while True:
-            with Spinner(f"schedule pods ({n_new} new node(s))"):
-                result = simulate(
-                    self._cluster_with_new_nodes(cluster, template, n_new) if template else cluster,
-                    apps,
-                    use_greed=self.opts.use_greed,
-                    sched_config=self.sched_config,
-                    enable_preemption=self.opts.enable_preemption,
-                    tie_seed=self.tie_seed,
-                )
+            if resimulate:
+                with Spinner(f"schedule pods ({n_new} new node(s))"):
+                    result = simulate(
+                        self._cluster_with_new_nodes(cluster, template, n_new) if template else cluster,
+                        apps,
+                        use_greed=self.opts.use_greed,
+                        sched_config=self.sched_config,
+                        enable_preemption=self.opts.enable_preemption,
+                        tie_seed=self.tie_seed,
+                    )
+            resimulate = True
             if result.unscheduled_pods:
-                print(
-                    f"there are still {len(result.unscheduled_pods)} pod(s) that can not be "
-                    f"scheduled when add {n_new} nodes, you can: [show/add N/exit]"
+                choice = self._survey_select(
+                    f"there are still {len(result.unscheduled_pods)} pod(s) that can "
+                    f"not be scheduled when add {n_new} nodes, you can:",
+                    [self.SURVEY_SHOW, self.SURVEY_ADD, self.SURVEY_EXIT],
                 )
-                choice = input("> ").strip()
-                if choice == "show":
+                if choice == self.SURVEY_SHOW:
                     for i, up in enumerate(result.unscheduled_pods):
                         print(f"{i:4d} {up.pod.metadata.namespace}/{up.pod.metadata.name}: {up.reason}")
-                elif choice.startswith("add"):
+                    resimulate = False  # apply.go:204: Show re-prompts, no re-run
+                elif choice == self.SURVEY_ADD:
                     if template is None:
                         print("no newNode template configured (spec.newNode); cannot add nodes")
+                        resimulate = False
                         continue
-                    try:
-                        n_new = int(choice.split()[1])
-                    except (IndexError, ValueError):
-                        print("usage: add <node count>")
-                elif choice == "exit":
+                    num = self._survey_int("input node number")
+                    if num is None:
+                        resimulate = False
+                    else:
+                        n_new = num
+                else:
                     return 1
             else:
                 ok, reason = satisfy_resource_setting(result)
                 if not ok:
                     print(reason)
-                    choice = input("add more nodes? [add N/exit] > ").strip()
-                    if choice.startswith("add"):
-                        try:
-                            n_new = int(choice.split()[1])
-                        except (IndexError, ValueError):
-                            print("usage: add <node count>")
+                    if template is None:
+                        # nothing can improve occupancy without a newNode
+                        # template; looping would re-simulate forever
+                        print("no newNode template configured (spec.newNode); cannot add nodes")
+                        return 1
+                    choice = self._survey_select(
+                        "resource occupancy exceeds the env caps, you can:",
+                        [self.SURVEY_ADD, self.SURVEY_EXIT],
+                    )
+                    if choice == self.SURVEY_ADD:
+                        num = self._survey_int("input node number")
+                        if num is None:
+                            resimulate = False
+                        else:
+                            n_new = num
                     else:
                         return 1
                 else:

@@ -181,7 +181,10 @@ def test_interactive_apply_scripted(tmp_path, monkeypatch):
         f"spec:\n  cluster: {{customConfig: {cluster_dir}}}\n"
         f"  appList:\n    - name: a\n      path: {app_dir}\n  newNode: {nn_dir}\n"
     )
-    answers = iter(["show", "add 1", "-"])
+    # survey-style: Show results, Add nodes, "1" into the number prompt,
+    # '-' declines the pod-table node selection. Legacy 'show'/'add' words
+    # and numeric selections both resolve.
+    answers = iter(["show", "2", "1", "-"])
     monkeypatch.setattr("builtins.input", lambda *a: next(answers))
     out = tmp_path / "out.txt"
     rc = Applier(Options(simon_config=str(cfg), interactive=True, output_file=str(out))).run()

@@ -1401,6 +1401,26 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
         if (uav) v += avt[n];
         return v;
       };
+      if (!a.tie_sample && lazy_spr) {
+        // gather-based lazy scoring doesn't vectorize, so the two-pass
+        // max+find does double work: one strict-> pass yields the same
+        // lowest-index argmax on the same float values
+        if (T != nullptr) {
+          for (int64_t n = 0; n < N; n++) {
+            if (!fe[n]) continue;
+            float v = sc_fast(n);
+            if (v > best) { best = v; bi = (int32_t)n; }
+          }
+        } else {
+          for (int64_t n = 0; n < N; n++) {
+            if (!fe[n]) continue;
+            float v = sc_at(n);
+            if (v > best) { best = v; bi = (int32_t)n; }
+          }
+        }
+        prof.stop(2);
+        goto selected;
+      }
       if (T != nullptr) {
         for (int64_t n = 0; n < N; n++) {
           float v = fe[n] ? sc_fast(n) : NEG;
@@ -1432,6 +1452,7 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
       }
       prof.stop(2);
 
+    selected:
       if (bi < 0) {
         prof.start();
         if (act_fit) fit_mask(a, s.gc_dyn_ptr(), u, s.mask[S_FIT].data());

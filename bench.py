@@ -49,16 +49,20 @@ def _serial_floors(config: str, pods: int, nodes: int):
             measured = json.load(f)
     except (OSError, ValueError):
         return None, None
-    keys = {"plan": ["plan", "synthetic"]}.get(config, [config])
+    cfgs = {"plan": ("plan", "synthetic")}.get(config, (config,))
 
-    def find(suffix):
-        for key in keys:
-            rec = measured.get(key + suffix)
-            if rec and rec.get("pods") == pods and rec.get("nodes") == nodes:
+    def find(cxx):
+        for rec in measured.values():
+            if not isinstance(rec, dict) or rec.get("config") not in cfgs:
+                continue
+            # classify by the record's own impl field, not key naming
+            if str(rec.get("impl", "")).startswith("c++") != cxx:
+                continue
+            if rec.get("pods") == pods and rec.get("nodes") == nodes:
                 return rec
         return None
 
-    return find(""), find("-cxx")
+    return find(False), find(True)
 
 
 def synthetic_cluster(n_nodes: int) -> ResourceTypes:

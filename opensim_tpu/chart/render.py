@@ -720,6 +720,13 @@ def _num(v: Any) -> float:
         return 0.0
 
 
+def _num_strict(fn: str, v: Any) -> float:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        raise ChartError(f"non-numeric operand for {fn}: {v!r}") from None
+
+
 def _apply_fn(fn: str, args: List[Any], ctx: Optional[dict] = None) -> Any:
     """Pipeline/function application. Piped values arrive as the LAST arg
     (sprig convention: `"x" | trimSuffix "-"` → trimSuffix("-", "x"))."""
@@ -762,28 +769,50 @@ def _apply_fn(fn: str, args: List[Any], ctx: Optional[dict] = None) -> Any:
     if fn == "nindent":
         pad = " " * int(args[0])
         return "\n" + pad + str(args[-1]).replace("\n", "\n" + pad)
-    if fn in ("printf", "print"):
-        if fn == "print":
-            return "".join(_to_str(a) for a in args)
+    if fn == "print":
+        return "".join(_to_str(a) for a in args)
+    if fn == "printf":
         fmt = str(args[0])
-        fmt = fmt.replace("%v", "%s").replace("%q", '"%s"')
-        vals = tuple(_to_str(a) if isinstance(a, (dict, list, bool)) else a for a in args[1:])
+        vals = iter(args[1:])
+        out = []
+        i = 0
         try:
-            return fmt % vals
-        except (TypeError, ValueError) as e:
-            raise ChartError(f"printf {fmt!r}: {e}")
+            while i < len(fmt):
+                c = fmt[i]
+                if c != "%":
+                    out.append(c)
+                    i += 1
+                    continue
+                d = fmt[i + 1] if i + 1 < len(fmt) else ""
+                if d == "%":
+                    out.append("%")
+                elif d in ("s", "v"):
+                    out.append(_to_str(next(vals)))
+                elif d == "q":
+                    v = _to_str(next(vals))
+                    out.append('"%s"' % v.replace("\\", "\\\\").replace('"', '\\"'))
+                elif d == "d":
+                    out.append(str(int(_num_strict("printf %d", next(vals)))))
+                elif d == "f":
+                    out.append("%f" % _num_strict("printf %f", next(vals)))
+                else:
+                    raise ChartError(f"printf: unsupported directive %{d}")
+                i += 2
+        except StopIteration:
+            raise ChartError(f"printf {fmt!r}: not enough arguments") from None
+        return "".join(out)
     if fn == "eq":
         return any(args[0] == b for b in args[1:])
     if fn == "ne":
         return args[0] != args[1]
     if fn == "lt":
-        return _num(args[0]) < _num(args[1])
+        return _num_strict(fn, args[0]) < _num_strict(fn, args[1])
     if fn == "le":
-        return _num(args[0]) <= _num(args[1])
+        return _num_strict(fn, args[0]) <= _num_strict(fn, args[1])
     if fn == "gt":
-        return _num(args[0]) > _num(args[1])
+        return _num_strict(fn, args[0]) > _num_strict(fn, args[1])
     if fn == "ge":
-        return _num(args[0]) >= _num(args[1])
+        return _num_strict(fn, args[0]) >= _num_strict(fn, args[1])
     if fn == "and":
         for a in args:
             if not _truthy(a):
@@ -853,8 +882,13 @@ def _apply_fn(fn: str, args: List[Any], ctx: Optional[dict] = None) -> Any:
     if fn == "last":
         return (args[-1] or [None])[-1]
     if fn in ("get", "index"):
-        cur = args[0]
-        for key in args[1:]:
+        # direct call: container first (`index .Values.list 1`); piped:
+        # container arrives LAST (`.Values.labels | get "app"`)
+        if isinstance(args[0], (dict, list, tuple)):
+            cur, keys = args[0], args[1:]
+        else:
+            cur, keys = args[-1], args[:-1]
+        for key in keys:
             if isinstance(cur, dict):
                 cur = cur.get(key)
             elif isinstance(cur, (list, tuple)):

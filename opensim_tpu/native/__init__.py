@@ -133,12 +133,22 @@ def build_cached(src: Path, prefix: str, flags: list) -> tuple:
         os.replace(tmp, out)  # atomic: concurrent builders race benignly
     finally:
         tmp.unlink(missing_ok=True)
+    import time as _time
+
     for stale in here.glob(f"{prefix}*.so"):
         if stale != out:
             try:
                 stale.unlink()
             except OSError:
                 pass
+    # orphaned tmp files from builders killed mid-compile: reap only ones
+    # old enough that no in-flight build (<=120 s) can still own them
+    for tmp_orphan in here.glob(f"{prefix}*.tmp*"):
+        try:
+            if _time.time() - tmp_orphan.stat().st_mtime > 600:
+                tmp_orphan.unlink()
+        except OSError:
+            pass
     return out, None
 
 

@@ -152,7 +152,7 @@ struct PodTerm {  // inter-pod affinity term
   std::vector<std::string> namespaces;
   Selector selector;
   std::string topo;
-  double weight;
+  double weight = 0;  // unset for synthetic spread terms (never read)
 };
 
 struct SpreadC {

@@ -646,7 +646,8 @@ _FUNCS = {
     "replace", "contains", "hasPrefix", "hasSuffix", "required", "include",
     "len", "add", "sub", "mul", "title", "kindIs", "empty", "coalesce",
     "ternary", "join", "splitList", "first", "last", "get", "index", "dict",
-    "list", "toJson",
+    "list", "toJson", "b64enc", "b64dec", "sha256sum", "hasKey", "keys",
+    "sortAlpha", "min", "max", "until", "repeat",
 }
 
 
@@ -903,4 +904,37 @@ def _apply_fn(fn: str, args: List[Any], ctx: Optional[dict] = None) -> Any:
         return {str(args[i]): args[i + 1] for i in range(0, len(args) - 1, 2)}
     if fn == "list":
         return list(args)
+    if fn == "b64enc":
+        import base64
+
+        return base64.b64encode(_to_str(args[-1]).encode()).decode()
+    if fn == "b64dec":
+        import base64
+
+        try:
+            return base64.b64decode(str(args[-1])).decode()
+        except Exception as e:
+            raise ChartError(f"b64dec: {e}") from None
+    if fn == "sha256sum":
+        import hashlib
+
+        return hashlib.sha256(_to_str(args[-1]).encode()).hexdigest()
+    if fn == "hasKey":
+        if len(args) < 2:
+            return False
+        # direct form: hasKey DICT KEY; piped: DICT arrives last
+        d, k = (args[0], args[1]) if isinstance(args[0], dict) else (args[-1], args[0])
+        return isinstance(d, dict) and str(k) in d
+    if fn == "keys":
+        return list(args[-1]) if isinstance(args[-1], dict) else []
+    if fn == "sortAlpha":
+        return sorted(_to_str(x) for x in (args[-1] or []))
+    if fn == "min":
+        return min(int(_num(a)) for a in args)
+    if fn == "max":
+        return max(int(_num(a)) for a in args)
+    if fn == "until":
+        return list(range(int(_num(args[-1]))))
+    if fn == "repeat":
+        return str(args[-1]) * int(args[0])
     raise ChartError(f"unsupported template function: {fn}")

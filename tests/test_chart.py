@@ -326,3 +326,18 @@ def test_variable_scoping_go_semantics():
     assert out == "BTAIL"  # else-if must not re-render trailing content
     with pytest.raises(ChartError, match="undeclared"):
         render_template("{{ $nope = 1 }}", {"Values": {}})
+
+
+def test_checksum_and_secret_idioms():
+    """The checksum/config and Secret-encoding idioms real charts rely on."""
+    import hashlib
+
+    ctx = {"Values": {"conf": "a: 1\n", "pw": "s3cret", "m": {"x": 1}}}
+    out = render_template('{{ .Values.conf | sha256sum }}', dict(ctx))
+    assert out == hashlib.sha256(b"a: 1\n").hexdigest()
+    assert render_template('{{ .Values.pw | b64enc }}', dict(ctx)) == "czNjcmV0"
+    assert render_template('{{ "czNjcmV0" | b64dec }}', dict(ctx)) == "s3cret"
+    assert render_template('{{ if hasKey .Values.m "x" }}y{{ end }}', dict(ctx)) == "y"
+    assert render_template('{{ keys .Values.m | sortAlpha | join "," }}', dict(ctx)) == "x"
+    assert render_template('{{ range until 3 }}{{ . }}{{ end }}', dict(ctx)) == "012"
+    assert render_template('{{ repeat 3 "ab" }}', dict(ctx)) == "ababab"

@@ -907,7 +907,8 @@ def _apply_fn(fn: str, args: List[Any], ctx: Optional[dict] = None) -> Any:
     if fn == "b64enc":
         import base64
 
-        return base64.b64encode(_to_str(args[-1]).encode()).decode()
+        v = "" if args[-1] is None else _to_str(args[-1])
+        return base64.b64encode(v.encode()).decode()
     if fn == "b64dec":
         import base64
 
@@ -918,7 +919,8 @@ def _apply_fn(fn: str, args: List[Any], ctx: Optional[dict] = None) -> Any:
     if fn == "sha256sum":
         import hashlib
 
-        return hashlib.sha256(_to_str(args[-1]).encode()).hexdigest()
+        v = "" if args[-1] is None else _to_str(args[-1])
+        return hashlib.sha256(v.encode()).hexdigest()
     if fn == "hasKey":
         if len(args) < 2:
             return False
@@ -936,5 +938,5 @@ def _apply_fn(fn: str, args: List[Any], ctx: Optional[dict] = None) -> Any:
     if fn == "until":
         return list(range(int(_num(args[-1]))))
     if fn == "repeat":
-        return str(args[-1]) * int(args[0])
+        return str(args[-1]) * int(_num_strict("repeat", args[0]))
     raise ChartError(f"unsupported template function: {fn}")

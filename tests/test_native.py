@@ -423,3 +423,101 @@ def test_native_default_spread_with_unlabeled_nodes():
     # the unlabeled node really did absorb a level beyond the zoned hosts
     plain_count = int((np.asarray(out_native.chosen) == 4).sum())
     assert plain_count > 15, plain_count
+
+
+def _assert_native_parity(cluster, apps):
+    """Full-strength parity (placements + failure attribution + final
+    state) via the module's _assert_match; returns the chosen array."""
+    prep = prepare(cluster, apps, node_pad=8)
+    return np.asarray(_assert_match(prep).chosen)
+
+
+def test_native_hier_mode_reversed_constraint_order():
+    """Explicit soft spread [zone, hostname] puts the FINE (singleton)
+    constraint second — hier_fine_first=False: the cc-order float sum must
+    still match the XLA scan bit-for-bit."""
+    cluster = ResourceTypes()
+    for i in range(6):
+        cluster.nodes.append(
+            fx.make_fake_node(
+                f"n{i}", "8", "16Gi", "110",
+                fx.with_labels({"topology.kubernetes.io/zone": f"z{i % 3}"}),
+            )
+        )
+    app = ResourceTypes()
+    app.deployments.append(
+        fx.make_fake_deployment(
+            "rev", 24, "200m", "256Mi",
+            fx.with_topology_spread([
+                {"maxSkew": 2, "topologyKey": "topology.kubernetes.io/zone",
+                 "whenUnsatisfiable": "ScheduleAnyway",
+                 "labelSelector": {"matchLabels": {"app": "rev"}}},
+                {"maxSkew": 1, "topologyKey": "kubernetes.io/hostname",
+                 "whenUnsatisfiable": "ScheduleAnyway",
+                 "labelSelector": {"matchLabels": {"app": "rev"}}},
+            ]),
+        )
+    )
+    chosen = _assert_native_parity(cluster, [AppResource("a", app)])
+    assert (chosen >= 0).all()
+    # hostname (fine) really is the SECOND constraint in cc order
+    prep = prepare(cluster, [AppResource("a", app)], node_pad=8)
+    topo = np.asarray(prep.ec_np.spr_topo)[int(prep.tmpl_ids[0])]
+    keys = list(prep.meta.vocab.topo_keys.items())
+    active = [keys[t] for t in topo if t >= 0]
+    assert active and active[-1] == "kubernetes.io/hostname", active
+
+
+def test_native_dom_mode_with_hard_constraint_mix():
+    """One soft + one hard spread constraint: dom mode handles the soft
+    term while the hard constraint keeps filtering; placements match XLA
+    including the hard-skew failures."""
+    cluster = ResourceTypes()
+    for i in range(4):
+        cluster.nodes.append(
+            fx.make_fake_node(
+                f"n{i}", "8", "16Gi", "110",
+                fx.with_labels({"topology.kubernetes.io/zone": f"z{i % 2}"}),
+            )
+        )
+    app = ResourceTypes()
+    app.deployments.append(
+        fx.make_fake_deployment(
+            "mix", 20, "1", "1Gi",
+            fx.with_topology_spread([
+                {"maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+                 "whenUnsatisfiable": "DoNotSchedule",
+                 "labelSelector": {"matchLabels": {"app": "mix"}}},
+                {"maxSkew": 3, "topologyKey": "topology.kubernetes.io/zone",
+                 "whenUnsatisfiable": "ScheduleAnyway",
+                 "labelSelector": {"matchLabels": {"app": "mix"}}},
+            ]),
+        )
+    )
+    # zone z0 has 2 nodes (16 cpu), z1 has 2 (16 cpu); 20 one-cpu pods fit
+    # numerically but the DoNotSchedule maxSkew=1 caps the zone imbalance;
+    # shrink z1 to one node so capacity forces skew and the hard filter
+    # actually rejects the tail
+    cluster.nodes.pop()  # drop n3 (z1)
+    chosen = _assert_native_parity(cluster, [AppResource("a", app)])
+    assert (chosen == -1).sum() > 0  # the hard-skew failure path ran
+
+
+def test_native_hier_mode_feasibility_flip_rebuild():
+    """Default-spread pods that FILL nodes mid-run flip feasibility, which
+    must invalidate the per-domain cache (apply_deltas bails, full_eval
+    rebuilds histograms) — placements must match XLA through the flip,
+    including the final failures."""
+    cluster = ResourceTypes()
+    for i in range(3):
+        cluster.nodes.append(
+            fx.make_fake_node(
+                f"n{i}", "4", "8Gi", "110",
+                fx.with_labels({"topology.kubernetes.io/zone": f"z{i % 2}"}),
+            )
+        )
+    app = ResourceTypes()
+    # 4-cpu nodes, 1-cpu pods: every 4th bind on a node flips it infeasible
+    app.deployments.append(fx.make_fake_deployment("fill", 15, "1", "512Mi"))
+    chosen = _assert_native_parity(cluster, [AppResource("a", app)])
+    assert (chosen == -1).sum() == 3  # 12 fit, 3 fail

@@ -541,16 +541,14 @@ def test_native_fuzz_random_configs(seed):
     app = random_app(rng, rng.randrange(3, 8))
     kw = {}
     for w in ("w_balanced", "w_least", "w_node_affinity", "w_taint_toleration",
-              "w_interpod", "w_spread", "w_simon", "w_gpu_share", "w_local"):
-        if hasattr(DEFAULT_CONFIG, w):
-            kw[w] = float(rng.choice([0.0, 0.5, 1.0, 2.0, 5.0]))
-    for f in ("f_ports", "f_fit", "f_spread", "f_interpod", "f_taints",
-              "f_node_affinity", "f_unschedulable"):
-        if hasattr(DEFAULT_CONFIG, f):
-            kw[f] = rng.random() > 0.15
-    cfg = DEFAULT_CONFIG._replace(**kw)
+              "w_interpod", "w_spread", "w_prefer_avoid", "w_simon",
+              "w_gpu_share", "w_local"):
+        kw[w] = float(rng.choice([0.0, 0.5, 1.0, 2.0, 5.0]))
+    for f in ("f_ports", "f_fit", "f_spread", "f_interpod", "f_gpu", "f_local",
+              "f_taints", "f_node_affinity", "f_unschedulable"):
+        kw[f] = rng.random() > 0.15
+    cfg = DEFAULT_CONFIG._replace(**kw)  # raises on any unknown field name
 
     prep = prepare(cluster, [AppResource("s", app)], node_pad=8)
-    if prep is None or nativepath.why_not(prep, cfg) is not None:
-        pytest.skip("config outside the native envelope for this seed")
+    assert prep is not None
     _assert_match(prep, config=cfg)

@@ -201,7 +201,8 @@ def _render_chart_dir(release_name: str, path: str) -> List[str]:
             },
         }
         ctx["__defs__"] = defs
-        ctx["__root__"] = ctx
+        ctx["__root__"] = ctx  # what $ resolves to (rebound per include arg)
+        ctx["__top__"] = ctx  # the file-level context (.Values etc. source)
         ctx["__vars__"] = _Vars()
         try:
             rendered, _ = _render_block(tokens, 0, ctx, stop=set())
@@ -300,6 +301,7 @@ def render_template(text: str, ctx: dict) -> str:
     defs = dict(ctx.get("__defs__") or {})
     ctx["__defs__"] = defs
     ctx.setdefault("__root__", ctx)
+    ctx.setdefault("__top__", ctx)
     ctx.setdefault("__vars__", _Vars())
     tokens = _collect_defines(_tokenize(text), defs)
     out, _pos = _render_block(tokens, 0, ctx, stop={"end", "else"})
@@ -384,6 +386,14 @@ class _Vars:
                 return scope.map[name]
             scope = scope.parent
         return None
+
+    def has(self, name: str) -> bool:
+        scope = self
+        while scope is not None:
+            if name in scope.map:
+                return True
+            scope = scope.parent
+        return False
 
     def declare(self, name: str, val: Any) -> None:
         self.map[name] = val
@@ -556,16 +566,21 @@ def _render_block(tokens, pos, ctx, stop) -> tuple:
 
 
 def _call_template(name: str, arg: Any, ctx: dict):
-    """include/template: render a named define with "." bound to arg.
-    Caller variables do not leak in (Go template scoping); $ and the root
-    keys stay reachable."""
+    """include/template: render a named define with "." AND "$" bound to
+    the invocation argument — Go template semantics: $ is documented as the
+    starting value of dot for the template being executed, so a helper
+    invoked with a non-root argument sees that argument through $, not the
+    calling file's root. Caller variables do not leak in (Go scoping); the
+    file-level keys (.Values, .Release, ...) stay reachable for the helm
+    include idiom."""
     defs = ctx.get("__defs__") or {}
     if name not in defs:
         raise ChartError(f'include of undefined template "{name}"')
-    root = ctx.get("__root__") or ctx
-    sub = {k: v for k, v in root.items() if not k.startswith("__")}
+    top = ctx.get("__top__") or ctx
+    sub = {k: v for k, v in top.items() if not k.startswith("__")}
     sub["__defs__"] = defs
-    sub["__root__"] = root
+    sub["__top__"] = top
+    sub["__root__"] = arg
     sub["__vars__"] = _Vars()
     sub["."] = arg
     out, _ = _render_block(defs[name], 0, sub, stop=set())
@@ -682,7 +697,11 @@ def _eval_atom(atom: str, ctx: dict) -> Any:
     if atom.startswith("$"):
         name = atom[1:].split(".")[0]
         vars_ = ctx.get("__vars__")
-        base = vars_.get(name) if vars_ is not None else None
+        if vars_ is None or not vars_.has(name):
+            # Go fails template execution on an undefined variable; silently
+            # rendering None would feed wrong manifests into the simulation
+            raise ChartError(f"undefined variable ${name}")
+        base = vars_.get(name)
         rest = atom[1 + len(name) :].lstrip(".")
         return _lookup(base, rest) if rest else base
     if atom == ".":

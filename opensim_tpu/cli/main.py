@@ -109,8 +109,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     # persistent XLA compilation cache: repeated simon invocations with the
-    # same shapes skip the (tens of seconds) first-compile cost
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.expanduser("~/.cache/opensim-tpu-jit"))
+    # same shapes skip the (tens of seconds) first-compile cost; opt out /
+    # relocate with OPENSIM_JIT_CACHE (utils/jitcache.py)
+    from ..utils.jitcache import maybe_enable as _enable_jit_cache
+
+    _enable_jit_cache(default=True)
     level = LOG_LEVELS.get(os.environ.get("LogLevel", "info").lower(), logging.INFO)
     logging.basicConfig(level=level, format="%(levelname)s %(message)s")
 

@@ -155,6 +155,58 @@ def _pad_to(n: int, mult: int) -> int:
     return max(mult, mult * math.ceil(n / mult))
 
 
+def _grown(a: np.ndarray, shape: Tuple[int, ...], fill) -> np.ndarray:
+    """Re-allocate `a` at `shape`, copying the existing prefix block and
+    filling the rest with `fill` (axis growth for delta re-encoding)."""
+    out = np.full(shape, fill, dtype=a.dtype)
+    out[tuple(slice(0, s) for s in a.shape)] = a
+    return out
+
+
+@dataclass
+class NodeArenas:
+    """The O(N) node-axis build products, cached across builds.
+
+    This is the expensive half of ``ClusterEncoder.build()`` at cluster
+    scale (the per-node python loop over labels/taints/resources/domains).
+    The incremental-prepare layer reuses these arenas across repeated
+    builds so a delta build pays O(changes), not O(cluster). Arrays are
+    immutable once built — ``extend`` paths re-allocate instead of
+    mutating — so forked encoders share them by reference."""
+
+    N: int
+    K: int  # label-key axis width the arrays were built at
+    R: int  # resource axis width the arrays were built at
+    Tt: int
+    node_valid: np.ndarray
+    alloc: np.ndarray
+    unschedulable: np.ndarray
+    taint_key: np.ndarray
+    taint_val: np.ndarray
+    taint_effect: np.ndarray
+    label_val: np.ndarray
+    label_num: np.ndarray
+    domain_ids: Dict[Tuple[int, int], int]  # (topo key idx, label vid) -> domain id
+    node_domain: np.ndarray  # [N, n_topo] raw domain ids, -1 = absent (pre-trash)
+    n_topo: int  # real topo-key count covered by node_domain columns
+    node_gpu_mem: np.ndarray
+    node_gpu_count: np.ndarray
+    node_vg_cap: np.ndarray
+    node_dev_cap: np.ndarray
+    node_dev_media: np.ndarray
+    vg_names: List[List[str]]
+    dev_names: List[List[str]]
+    avoid_entries: List[Tuple[int, frozenset]]  # (node idx, {(kind, uid)})
+
+    def clone(self) -> "NodeArenas":
+        import copy as _copy
+
+        new = _copy.copy(self)
+        # the only pieces mutated in place by domain-column extension
+        new.domain_ids = dict(self.domain_ids)
+        return new
+
+
 def encode_labels(vocab: V.Vocab, labels: Dict[str, str], extra: Dict[str, str]) -> Dict[int, Tuple[int, float]]:
     out: Dict[int, Tuple[int, float]] = {}
     for k, v in {**labels, **extra}.items():
@@ -187,6 +239,25 @@ class ClusterEncoder:
         # encoded labels per node, built once at add_nodes and reused by
         # build() — encode_labels is 2×5k calls at headline shape otherwise
         self._node_enc: List[Dict[int, Tuple[int, float]]] = []
+        # cached node-axis build (incremental prepare: rebuilds skip the
+        # O(N) node loop) and the count of templates already interned
+        self._arenas: Optional[NodeArenas] = None
+        self._n_interned = 0
+
+    def fork(self) -> "ClusterEncoder":
+        """Copy-on-write fork for delta re-encoding: vocab and template
+        tables are copied (they are append-only, so the base stays valid),
+        built node arenas are shared by reference."""
+        new = object.__new__(ClusterEncoder)
+        new.vocab = self.vocab.clone()
+        new.ts = self.ts.clone()
+        new.nodes = list(self.nodes)
+        new.node_index = dict(self.node_index)
+        new.node_pad = self.node_pad
+        new._node_enc = list(self._node_enc)
+        new._arenas = self._arenas.clone() if self._arenas is not None else None
+        new._n_interned = self._n_interned
+        return new
 
     # -- ingestion ----------------------------------------------------------
 
@@ -277,12 +348,240 @@ class ClusterEncoder:
     # -- build --------------------------------------------------------------
 
     def build(self) -> Tuple[EncodedCluster, ScanState, ClusterMeta]:
-        vb = self.vocab
-        templates = self.ts.templates or [SchedTemplate()]
-        for t in templates:
+        """Materialize the tensors. Repeat builds on the same encoder (the
+        incremental-prepare layer: a fork with extra pods or nodes) reuse
+        the cached node arenas, so a rebuild pays O(templates + changes)
+        instead of re-running the O(N) node loop."""
+        for t in self.ts.templates[self._n_interned :]:
             self._intern_template(t)
+        self._n_interned = len(self.ts.templates)
+        templates = self.ts.templates or [SchedTemplate()]
+        if self._arenas is None:
+            self._arenas = self._build_node_arenas()
+        self._extend_domain_columns(self._arenas)
+        return self._assemble(self._arenas, templates)
 
+    def _build_node_arenas(self) -> NodeArenas:
+        """The O(N) half: per-node resource/taint/label tensors, topology
+        domains, extension capacities, preferAvoidPods annotations."""
+        vb = self.vocab
         N = _pad_to(len(self.nodes), self.node_pad)
+        R = vb.n_resources
+        K = max(vb.n_label_keys, 1)
+        Tt = max([len(n.taints) for n in self.nodes] + [1])
+
+        arrays = {
+            "node_valid": np.zeros((N,), dtype=bool),
+            "alloc": np.zeros((N, R), dtype=np.float32),
+            "unschedulable": np.zeros((N,), dtype=bool),
+            "taint_key": np.full((N, Tt), -1, dtype=np.int32),
+            "taint_val": np.full((N, Tt), -1, dtype=np.int32),
+            "taint_effect": np.full((N, Tt), -1, dtype=np.int32),
+            "label_val": np.full((N, K), -1, dtype=np.int32),
+            "label_num": np.full((N, K), _NAN, dtype=np.float32),
+        }
+        self._encode_node_rows(arrays, 0, K, Tt)
+
+        # topology domains, raw ids (-1 = label absent); the trash-row
+        # substitution happens at assemble time once D is final
+        n_topo = vb.n_topo_keys
+        domain_ids: Dict[Tuple[int, int], int] = {}
+        node_domain = np.full((N, n_topo), -1, dtype=np.int32)
+        label_val = arrays["label_val"]
+        topo_key_to_label = [vb.label_keys.get(k) for k in vb.topo_keys.items()]
+        for i in range(len(self.nodes)):
+            for tki in range(n_topo):
+                lk = topo_key_to_label[tki]
+                vid = label_val[i, lk] if lk >= 0 else -1
+                if vid >= 0:
+                    node_domain[i, tki] = domain_ids.setdefault(
+                        (tki, int(vid)), len(domain_ids)
+                    )
+
+        from .extensions import encode_gpu_nodes, encode_local_storage
+
+        node_gpu_mem, node_gpu_count = encode_gpu_nodes(self.nodes, N)
+        node_vg_cap, node_dev_cap, node_dev_media, vg_names, dev_names = (
+            encode_local_storage(self.nodes, N)
+        )
+
+        avoid_entries: List[Tuple[int, frozenset]] = []
+        for i, n in enumerate(self.nodes):
+            avoided = self._node_avoid_set(n)
+            if avoided:
+                avoid_entries.append((i, avoided))
+
+        return NodeArenas(
+            N=N, K=K, R=R, Tt=Tt,
+            node_valid=arrays["node_valid"], alloc=arrays["alloc"],
+            unschedulable=arrays["unschedulable"],
+            taint_key=arrays["taint_key"], taint_val=arrays["taint_val"],
+            taint_effect=arrays["taint_effect"],
+            label_val=arrays["label_val"], label_num=arrays["label_num"],
+            domain_ids=domain_ids, node_domain=node_domain, n_topo=n_topo,
+            node_gpu_mem=node_gpu_mem, node_gpu_count=node_gpu_count,
+            node_vg_cap=node_vg_cap, node_dev_cap=node_dev_cap,
+            node_dev_media=node_dev_media, vg_names=vg_names,
+            dev_names=dev_names, avoid_entries=avoid_entries,
+        )
+
+    def _encode_node_rows(self, arrays: dict, start: int, K: int, Tt: int) -> None:
+        vb = self.vocab
+        for i in range(start, len(self.nodes)):
+            n = self.nodes[i]
+            arrays["node_valid"][i] = True
+            arrays["unschedulable"][i] = n.unschedulable
+            for rname, v in n.allocatable.items():
+                rid = vb.resource_id(rname)
+                if rid >= 0:
+                    arrays["alloc"][i, rid] = v * 1000.0 if rname == "cpu" else v
+            for j, t in enumerate(n.taints[:Tt]):
+                arrays["taint_key"][i, j] = vb.key_id(t.key)
+                arrays["taint_val"][i, j] = vb.val_id(t.value)
+                arrays["taint_effect"][i, j] = V.EFFECT_CODES.get(t.effect, -1)
+            for kid, (vid, num) in self._node_enc[i].items():
+                if kid < K:
+                    arrays["label_val"][i, kid] = vid
+                    arrays["label_num"][i, kid] = num
+
+    @staticmethod
+    def _node_avoid_set(n: Node) -> Optional[frozenset]:
+        """NodePreferAvoidPods (node_prefer_avoid_pods.go:47-82): the set of
+        (controller kind, uid) the node's preferAvoidPods annotation names."""
+        anno = n.metadata.annotations.get("scheduler.alpha.kubernetes.io/preferAvoidPods")
+        if not anno:
+            return None
+        try:
+            entries = json.loads(anno).get("preferAvoidPods") or []
+        except (ValueError, AttributeError):
+            return None
+        return frozenset(
+            (
+                str(((e.get("podSignature") or {}).get("podController") or {}).get("kind", "")),
+                str(((e.get("podSignature") or {}).get("podController") or {}).get("uid", "")),
+            )
+            for e in entries
+        )
+
+    def _extend_domain_columns(self, ar: NodeArenas) -> None:
+        """Add node_domain columns for topo keys interned since the arenas
+        were built (a delta pod batch spreading on a new topology key):
+        O(N) per new key instead of an O(N·Tk) domain rebuild."""
+        vb = self.vocab
+        n_now = vb.n_topo_keys
+        if n_now <= ar.n_topo:
+            return
+        topo_keys = vb.topo_keys.items()
+        cols = np.full((ar.N, n_now - ar.n_topo), -1, dtype=np.int32)
+        label_val = ar.label_val
+        for c, tki in enumerate(range(ar.n_topo, n_now)):
+            lk = vb.label_keys.get(topo_keys[tki])
+            if lk < 0 or lk >= ar.K:
+                continue  # key unknown to every node: whole column absent
+            for i in range(len(self.nodes)):
+                vid = label_val[i, lk]
+                if vid >= 0:
+                    cols[i, c] = ar.domain_ids.setdefault(
+                        (tki, int(vid)), len(ar.domain_ids)
+                    )
+        ar.node_domain = np.concatenate([ar.node_domain, cols], axis=1)
+        ar.n_topo = n_now
+
+    def extend_nodes(self, new_nodes: List[Node]) -> None:
+        """Delta re-encode for node addition: append nodes to a BUILT
+        encoder by re-allocating the node arenas and encoding only the new
+        rows — O(new nodes) host work plus O(N) memcpy, instead of the full
+        O(N) python node build."""
+        if self._arenas is None:
+            raise ValueError("extend_nodes needs a built encoder (call build() first)")
+        ar = self._arenas
+        n0 = len(self.nodes)
+        self.add_nodes(new_nodes)  # interns labels/taints/resources + _node_enc
+        added = self.nodes[n0:]
+        if not added:
+            return
+        vb = self.vocab
+        n1 = len(self.nodes)
+        N2 = max(_pad_to(n1, self.node_pad), ar.N)
+        K2 = max(vb.n_label_keys, ar.K)
+        R2 = max(vb.n_resources, ar.R)
+        Tt2 = max([len(n.taints) for n in added] + [ar.Tt])
+
+        arrays = {
+            "node_valid": _grown(ar.node_valid, (N2,), False),
+            "alloc": _grown(ar.alloc, (N2, R2), 0.0),
+            "unschedulable": _grown(ar.unschedulable, (N2,), False),
+            "taint_key": _grown(ar.taint_key, (N2, Tt2), -1),
+            "taint_val": _grown(ar.taint_val, (N2, Tt2), -1),
+            "taint_effect": _grown(ar.taint_effect, (N2, Tt2), -1),
+            "label_val": _grown(ar.label_val, (N2, K2), -1),
+            "label_num": _grown(ar.label_num, (N2, K2), _NAN),
+        }
+        self._encode_node_rows(arrays, n0, K2, Tt2)
+
+        domain_ids = dict(ar.domain_ids)
+        node_domain = _grown(ar.node_domain, (N2, ar.n_topo), -1)
+        label_val = arrays["label_val"]
+        topo_key_to_label = [
+            vb.label_keys.get(k) for k in vb.topo_keys.items()[: ar.n_topo]
+        ]
+        for i in range(n0, n1):
+            for tki in range(ar.n_topo):
+                lk = topo_key_to_label[tki]
+                vid = label_val[i, lk] if lk >= 0 else -1
+                if vid >= 0:
+                    node_domain[i, tki] = domain_ids.setdefault(
+                        (tki, int(vid)), len(domain_ids)
+                    )
+
+        from .extensions import encode_gpu_nodes, encode_local_storage
+
+        gm_new, gc_new = encode_gpu_nodes(added, len(added))
+        vg_new, dev_new, media_new, vgn_new, devn_new = encode_local_storage(
+            added, len(added)
+        )
+        Gd2 = max(ar.node_gpu_mem.shape[1], gm_new.shape[1])
+        Vg2 = max(ar.node_vg_cap.shape[1], vg_new.shape[1])
+        Dv2 = max(ar.node_dev_cap.shape[1], dev_new.shape[1])
+        node_gpu_mem = _grown(ar.node_gpu_mem, (N2, Gd2), 0.0)
+        node_gpu_mem[n0:n1, : gm_new.shape[1]] = gm_new
+        node_gpu_count = _grown(ar.node_gpu_count, (N2,), 0)
+        node_gpu_count[n0:n1] = gc_new
+        node_vg_cap = _grown(ar.node_vg_cap, (N2, Vg2), 0.0)
+        node_vg_cap[n0:n1, : vg_new.shape[1]] = vg_new
+        node_dev_cap = _grown(ar.node_dev_cap, (N2, Dv2), 0.0)
+        node_dev_cap[n0:n1, : dev_new.shape[1]] = dev_new
+        node_dev_media = _grown(ar.node_dev_media, (N2, Dv2), -1)
+        node_dev_media[n0:n1, : media_new.shape[1]] = media_new
+
+        avoid_entries = list(ar.avoid_entries)
+        for k, n in enumerate(added):
+            avoided = self._node_avoid_set(n)
+            if avoided:
+                avoid_entries.append((n0 + k, avoided))
+
+        self._arenas = NodeArenas(
+            N=N2, K=K2, R=R2, Tt=Tt2,
+            node_valid=arrays["node_valid"], alloc=arrays["alloc"],
+            unschedulable=arrays["unschedulable"],
+            taint_key=arrays["taint_key"], taint_val=arrays["taint_val"],
+            taint_effect=arrays["taint_effect"],
+            label_val=arrays["label_val"], label_num=arrays["label_num"],
+            domain_ids=domain_ids, node_domain=node_domain, n_topo=ar.n_topo,
+            node_gpu_mem=node_gpu_mem, node_gpu_count=node_gpu_count,
+            node_vg_cap=node_vg_cap, node_dev_cap=node_dev_cap,
+            node_dev_media=node_dev_media,
+            vg_names=ar.vg_names + vgn_new, dev_names=ar.dev_names + devn_new,
+            avoid_entries=avoid_entries,
+        )
+
+    def _assemble(
+        self, ar: NodeArenas, templates: List[SchedTemplate]
+    ) -> Tuple[EncodedCluster, ScanState, ClusterMeta]:
+        """The O(U) half: template tensors + global term tables, assembled
+        against the (possibly cached) node arenas."""
+        vb = self.vocab
+        N = ar.N
         R = vb.n_resources
         K = max(vb.n_label_keys, 1)
         U = len(templates)
@@ -290,7 +589,17 @@ class ClusterEncoder:
         Tk = max(vb.n_topo_keys, 1)
         Hports = max(vb.n_ports, 1)
 
-        Tt = max([len(n.taints) for n in self.nodes] + [1])
+        Tt = ar.Tt
+        # node arrays: shared from the arenas; axes that grew since the
+        # arenas were built (new label keys / resources from delta pods)
+        # are padded with "absent" on the node side
+        node_valid = ar.node_valid
+        unschedulable = ar.unschedulable
+        taint_key, taint_val, taint_effect = ar.taint_key, ar.taint_val, ar.taint_effect
+        alloc = ar.alloc if R == ar.R else _grown(ar.alloc, (N, R), 0.0)
+        label_val = ar.label_val if K == ar.K else _grown(ar.label_val, (N, K), -1)
+        label_num = ar.label_num if K == ar.K else _grown(ar.label_num, (N, K), _NAN)
+
         Tl = max([len(t.tolerations) for t in templates] + [1])
         Qs = max([len(t.node_selector) for t in templates] + [1])
         T = max([len(t.affinity_terms) for t in templates] + [1])
@@ -334,48 +643,18 @@ class ClusterEncoder:
         Tn = max([len(t.anti_terms) for t in templates] + [1])
         Tpp = max([len(t.pref_terms) for t in templates] + [1])
 
-        # ---- node tensors
-        node_valid = np.zeros((N,), dtype=bool)
-        alloc = np.zeros((N, R), dtype=np.float32)
-        unschedulable = np.zeros((N,), dtype=bool)
-        taint_key = np.full((N, Tt), -1, dtype=np.int32)
-        taint_val = np.full((N, Tt), -1, dtype=np.int32)
-        taint_effect = np.full((N, Tt), -1, dtype=np.int32)
-        label_val = np.full((N, K), -1, dtype=np.int32)
-        label_num = np.full((N, K), _NAN, dtype=np.float32)
-
-        for i, n in enumerate(self.nodes):
-            node_valid[i] = True
-            unschedulable[i] = n.unschedulable
-            for rname, v in n.allocatable.items():
-                rid = vb.resource_id(rname)
-                if rid >= 0:
-                    alloc[i, rid] = v * 1000.0 if rname == "cpu" else v
-            for j, t in enumerate(n.taints[:Tt]):
-                taint_key[i, j] = vb.key_id(t.key)
-                taint_val[i, j] = vb.val_id(t.value)
-                taint_effect[i, j] = V.EFFECT_CODES.get(t.effect, -1)
-            for kid, (vid, num) in self._node_enc[i].items():
-                if kid < K:
-                    label_val[i, kid] = vid
-                    label_num[i, kid] = num
-
-        # ---- topology domains
-        domain_ids: Dict[Tuple[int, int], int] = {}
-        node_domain = np.zeros((N, Tk), dtype=np.int32)
-        topo_key_to_label = [vb.label_keys.get(k) for k in vb.topo_keys.items()]
-        for i in range(N):
-            for tki in range(Tk):
-                lk = topo_key_to_label[tki] if tki < len(topo_key_to_label) else -1
-                vid = label_val[i, lk] if (node_valid[i] and lk is not None and lk >= 0) else -1
-                if vid < 0:
-                    node_domain[i, tki] = -1
-                else:
-                    node_domain[i, tki] = domain_ids.setdefault((tki, vid), len(domain_ids))
-        D = max(len(domain_ids), 1)
-        node_domain = np.where(node_domain < 0, D, node_domain).astype(np.int32)  # D = trash row
+        # ---- topology domains: trash-row substitution over the raw arena
+        # ids (the arena keeps -1 for absent so D can keep growing)
+        raw_domain = ar.node_domain
+        if raw_domain.shape[1] < Tk:
+            raw_domain = np.concatenate(
+                [raw_domain, np.full((N, Tk - raw_domain.shape[1]), -1, np.int32)],
+                axis=1,
+            )
+        D = max(len(ar.domain_ids), 1)
+        node_domain = np.where(raw_domain < 0, D, raw_domain).astype(np.int32)  # D = trash row
         domain_topo = np.full((D + 1,), -1, dtype=np.int32)
-        for (tki, _vid), did in domain_ids.items():
+        for (tki, _vid), did in ar.domain_ids.items():
             domain_topo[did] = tki
 
         # ---- global inter-pod term tables
@@ -502,36 +781,26 @@ class ClusterEncoder:
         # controlled by an RS/RC listed in the node's preferAvoidPods
         # annotation score 0 there, 100 elsewhere
         avoid_score = np.full((U, N), 100.0, dtype=np.float32)
-        for i, n in enumerate(self.nodes):
-            anno = n.metadata.annotations.get("scheduler.alpha.kubernetes.io/preferAvoidPods")
-            if not anno:
-                continue
-            try:
-                entries = json.loads(anno).get("preferAvoidPods") or []
-            except (ValueError, AttributeError):
-                continue
-            avoided = {
-                (
-                    str(((e.get("podSignature") or {}).get("podController") or {}).get("kind", "")),
-                    str(((e.get("podSignature") or {}).get("podController") or {}).get("uid", "")),
-                )
-                for e in entries
-            }
+        for i, avoided in ar.avoid_entries:
             for u, t in enumerate(templates):
                 if t.controller[0] and tuple(t.controller) in avoided:
                     avoid_score[u, i] = 0.0
 
-        # ---- extensions: encoded by their dedicated modules (task: gpu/local)
-        from .extensions import encode_gpu_nodes, encode_local_storage, encode_local_requests
+        # ---- extensions: node side cached in the arenas, template side
+        # encoded by its dedicated module (task: gpu/local)
+        from .extensions import encode_local_requests
 
-        node_gpu_mem, node_gpu_count = encode_gpu_nodes(self.nodes, N)
+        node_gpu_mem, node_gpu_count = ar.node_gpu_mem, ar.node_gpu_count
         from ..models.objects import RES_GPU_COUNT
 
         gc_mask = np.zeros((R,), dtype=bool)
         gc_col = vb.resources.get(RES_GPU_COUNT)
         if gc_col >= 0:
             gc_mask[gc_col] = True
-        node_vg_cap, node_dev_cap, node_dev_media, vg_names, dev_names = encode_local_storage(self.nodes, N)
+        node_vg_cap, node_dev_cap, node_dev_media = (
+            ar.node_vg_cap, ar.node_dev_cap, ar.node_dev_media
+        )
+        vg_names, dev_names = ar.vg_names, ar.dev_names
         lvm_req, dev_req, dev_req_count, dev_req_sizes = encode_local_requests(templates)
 
         cluster = EncodedCluster(

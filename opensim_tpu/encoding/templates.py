@@ -129,6 +129,20 @@ class TemplateSet:
         self._hint_index: Dict[tuple, int] = {}
         self.selectors: List[Optional[tuple]] = []
         self._sel_index: Dict[Optional[tuple], int] = {}
+        self._mm = None  # cached match matrix (incremental rebuilds)
+
+    def clone(self) -> "TemplateSet":
+        """Fork for delta re-encoding: template/selector ids are
+        append-only, so a fork can add pods without touching the base.
+        SchedTemplate objects are shared (immutable after extraction)."""
+        new = object.__new__(TemplateSet)
+        new.templates = list(self.templates)
+        new._index = dict(self._index)
+        new._hint_index = dict(self._hint_index)
+        new.selectors = list(self.selectors)
+        new._sel_index = dict(self._sel_index)
+        new._mm = self._mm  # replaced, never mutated, on rebuild
+        return new
 
     def selector_id(self, ns: "str | tuple", selector: Optional[dict]) -> int:
         canon = canon_selector(ns, selector)
@@ -298,12 +312,24 @@ class TemplateSet:
     # -- host-side match precompute ----------------------------------------
 
     def match_matrix(self):
-        """[U, A] bool: does a pod of template u match selector a?"""
+        """[U, A] bool: does a pod of template u match selector a?
+
+        Incremental: the previous matrix (if any) fills the known block, so
+        a delta build evaluates only new-template rows and new-selector
+        columns — O(ΔU·A + U·ΔA) python selector matches, not O(U·A)."""
         import numpy as np
 
         U, A = len(self.templates), len(self.selectors)
         m = np.zeros((U, A), dtype=bool)
+        u0 = a0 = 0
+        prev = self._mm
+        if prev is not None and prev.shape[0] <= U and prev.shape[1] <= A:
+            u0, a0 = prev.shape
+            m[:u0, :a0] = prev
         for u, t in enumerate(self.templates):
             for a, canon in enumerate(self.selectors):
+                if u < u0 and a < a0:
+                    continue
                 m[u, a] = selector_matches(canon, t.namespace, t.labels)
+        self._mm = m
         return m

@@ -65,6 +65,12 @@ class Interner:
         self._ids: Dict[Hashable, int] = {}
         self._items: List[Hashable] = []
 
+    def clone(self) -> "Interner":
+        new = Interner()
+        new._ids = dict(self._ids)
+        new._items = list(self._items)
+        return new
+
     def intern(self, item: Hashable) -> int:
         idx = self._ids.get(item)
         if idx is None:
@@ -100,6 +106,18 @@ class Vocab:
         self.topo_keys = Interner()  # label keys used as topology keys (subset)
         for r in BASE_RESOURCES:
             self.resources.intern(r)
+
+    def clone(self) -> "Vocab":
+        """Fork for delta re-encoding: ids are append-only, so a forked
+        vocab can intern new strings without invalidating the base's
+        already-encoded tensors."""
+        new = object.__new__(Vocab)
+        new.label_keys = self.label_keys.clone()
+        new.label_vals = self.label_vals.clone()
+        new.ports = self.ports.clone()
+        new.resources = self.resources.clone()
+        new.topo_keys = self.topo_keys.clone()
+        return new
 
     # -- resources ----------------------------------------------------------
 

@@ -1,0 +1,479 @@
+"""Incremental prepare: content-keyed encode cache + delta re-encoding.
+
+Repeated simulations against one cluster used to pay the full host-side
+``prepare()`` cost — workload expansion plus cluster encoding, the dominant
+host cost at 50k-pod scale (NOTES.md round-5 #5) — on every call: every REST
+request re-encoded the snapshot, every planner sweep re-prepared its
+candidate cluster. This module makes the host path pay O(changes) instead of
+O(cluster):
+
+- ``PrepareCache``: an LRU of ``prepare()`` outputs keyed by a cluster/app
+  content fingerprint, with per-entry locks and pristine bind-state
+  snapshots (``simulate``'s decode mutates the prepared pods; entries are
+  restored after every use so a cache hit is indistinguishable from a fresh
+  prepare).
+- Delta re-encoders over a cached base ``Prepared``:
+    * ``derive_with_apps``  — append an app's expanded pods to the stream
+      (new templates re-assemble against the cached O(N) node arenas);
+    * ``extend_with_nodes`` — add nodes cloned from a template (the planner
+      case), splicing per-node DaemonSet pods in at exactly the positions a
+      fresh expansion would produce them;
+    * ``drop_mask_for_scaled`` — flip valid-mask bits for pods a scale
+      request removed, instead of re-encoding the shrunk cluster.
+
+Correctness bar (tests/test_prepcache.py): placements byte-identical to a
+full re-encode on every path. The delta stream preserves the exact pod
+order a fresh ``prepare()`` would produce; template/domain/vocab ids may be
+numbered differently (they are opaque to the engines).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..encoding.state import EncodedCluster, ScanState
+from ..models import expand
+from ..models.objects import ANNO_WORKLOAD_KIND, LABEL_APP_NAME, ResourceTypes
+from ..utils.trace import PREP_STATS
+from . import queues
+from .simulator import (
+    AppResource,
+    Prepared,
+    _owner_selector,
+    _tmpl_hint,
+    pinned_node_name,
+    prepare,
+    restore_bind_state,
+    simulate,
+    snapshot_bind_state,
+)
+from ..ops import kernels
+
+# ---------------------------------------------------------------------------
+# content fingerprints
+# ---------------------------------------------------------------------------
+
+
+def _meta_rv(obj) -> str:
+    raw = getattr(obj, "raw", None) or {}
+    return str((raw.get("metadata") or {}).get("resourceVersion", ""))
+
+
+def fingerprint_cluster(cluster: ResourceTypes) -> str:
+    """Content key for a cluster snapshot. Hashes object identity + version
+    (name/uid/resourceVersion) plus the node fields that feed the encoder
+    directly, so hand-built clusters (no uid/rv) still key on node content.
+    In-place mutation of an already-fingerprinted object is NOT detected —
+    callers that edit objects must invalidate explicitly (the REST server
+    re-fingerprints on every snapshot refresh)."""
+    h = hashlib.blake2b(digest_size=16)
+    for n in cluster.nodes:
+        h.update(
+            "|".join(
+                (
+                    "n",
+                    n.metadata.name,
+                    n.metadata.uid or "",
+                    _meta_rv(n),
+                    "1" if n.unschedulable else "0",
+                    json.dumps(sorted(n.metadata.labels.items())),
+                    json.dumps(sorted((t.key, t.value, t.effect) for t in n.taints)),
+                    json.dumps(sorted(n.allocatable.items())),
+                    n.metadata.annotations.get("simon/node-local-storage", ""),
+                )
+            ).encode()
+        )
+    for p in cluster.pods:
+        m = p.metadata
+        h.update(
+            f"p|{m.namespace}|{m.name}|{m.uid}|{_meta_rv(p)}|{p.spec.node_name}|{p.phase}".encode()
+        )
+    for kind, objs in (
+        ("dep", cluster.deployments),
+        ("rs", cluster.replica_sets),
+        ("sts", cluster.stateful_sets),
+        ("ds", cluster.daemon_sets),
+        ("job", cluster.jobs),
+        ("cj", cluster.cron_jobs),
+    ):
+        for w in objs:
+            h.update(
+                f"{kind}|{w.metadata.namespace}|{w.metadata.name}|{w.metadata.uid}|{_meta_rv(w)}|{w.replicas}".encode()
+            )
+    return h.hexdigest()
+
+
+def fingerprint_apps(apps: List[AppResource]) -> str:
+    """Content key for an app list: hashes each object's raw dict when
+    present (request payloads round-trip exactly), identity otherwise."""
+    h = hashlib.blake2b(digest_size=16)
+    for app in apps:
+        h.update(f"a|{app.name}".encode())
+        rt = app.resources
+        for objs in (
+            rt.pods, rt.deployments, rt.replica_sets, rt.stateful_sets,
+            rt.daemon_sets, rt.jobs, rt.cron_jobs,
+        ):
+            for o in objs:
+                raw = getattr(o, "raw", None)
+                if raw:
+                    h.update(json.dumps(raw, sort_keys=True, default=str).encode())
+                else:
+                    h.update(
+                        f"{type(o).__name__}|{o.metadata.namespace}|{o.metadata.name}|{o.metadata.uid}".encode()
+                    )
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# cache
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CacheStats:
+    hits: int = 0
+    misses: int = 0
+    invalidations: int = 0
+    evictions: int = 0
+
+    def as_dict(self) -> dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "invalidations": self.invalidations,
+            "evictions": self.evictions,
+        }
+
+
+class CacheEntry:
+    """One cached ``Prepared`` plus everything reuse needs: a pristine
+    bind-state snapshot, a lock serializing uses of the (shared) pod
+    objects, and a numpy→device map so delta builds re-upload only changed
+    tensors. Entries derived from a base share the base's lock — their pod
+    streams alias the same objects."""
+
+    def __init__(self, key: str, prep: Optional[Prepared], base: Optional["CacheEntry"] = None):
+        self.key = key
+        self.prep = prep
+        self.base = base
+        self.lock = base.lock if base is not None else threading.RLock()
+        self.bind_snap = snapshot_bind_state(prep) if prep is not None else []
+        self._dev_map = None
+
+    def restore(self) -> None:
+        if self.prep is not None:
+            restore_bind_state(self.prep, self.bind_snap)
+
+    def dev_map(self) -> dict:
+        """{id(numpy leaf): device leaf} over the entry's EncodedCluster —
+        delta assemblies reuse the already-uploaded tensors for every leaf
+        the delta did not touch."""
+        if self._dev_map is None:
+            self._dev_map = {
+                id(np_leaf): dev_leaf
+                for np_leaf, dev_leaf in zip(self.prep.ec_np, self.prep.ec)
+            }
+        return self._dev_map
+
+
+class PrepareCache:
+    """Thread-safe LRU of CacheEntry keyed by content fingerprint."""
+
+    def __init__(self, capacity: int = 8) -> None:
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[str, CacheEntry]" = OrderedDict()
+        self.stats = CacheStats()
+
+    def get(self, key: str) -> Optional[CacheEntry]:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.stats.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.stats.hits += 1
+            return entry
+
+    def put(self, key: str, entry: CacheEntry) -> CacheEntry:
+        with self._lock:
+            existing = self._entries.get(key)
+            if existing is not None:
+                return existing  # racing builders: first one wins
+            self._entries[key] = entry
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.stats.evictions += 1
+            return entry
+
+    def invalidate(self, prefix: str = "") -> int:
+        """Drop entries whose key starts with `prefix` ('' = all); returns
+        the number dropped. The REST server calls this when the live
+        snapshot's fingerprint changes."""
+        with self._lock:
+            doomed = [k for k in self._entries if k.startswith(prefix)]
+            for k in doomed:
+                del self._entries[k]
+            self.stats.invalidations += len(doomed)
+            return len(doomed)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+# ---------------------------------------------------------------------------
+# delta assembly
+# ---------------------------------------------------------------------------
+
+
+def _to_device_reusing(ec_np: EncodedCluster, st0_np: ScanState, base_entry: Optional[CacheEntry]):
+    """``scheduler.to_device`` with leaf reuse: tensors the delta shares
+    with the cached base keep their device copies (no re-upload)."""
+    dev_map = base_entry.dev_map() if base_entry is not None and base_entry.prep is not None else {}
+    ec = EncodedCluster(
+        *[dev_map[id(a)] if id(a) in dev_map else jnp.asarray(a) for a in ec_np]
+    )
+    st0 = ScanState(*[jnp.asarray(a) for a in st0_np])
+    return ec, st0
+
+
+def _assemble_delta(
+    base_entry: Optional[CacheEntry],
+    enc,
+    ordered,
+    tmpl_parts,
+    forced_parts,
+    n_cluster: int,
+    n_bare: int,
+    ds_group_sizes: List[int],
+) -> Prepared:
+    ec_np, st0_np, meta = enc.build()
+    features = kernels.features_of(ec_np)
+    ec, st0 = _to_device_reusing(ec_np, st0_np, base_entry)
+    tmpl_ids = np.concatenate(
+        [np.asarray(p, dtype=np.int32) for p in tmpl_parts]
+    ) if tmpl_parts else np.zeros((0,), np.int32)
+    forced = np.concatenate(
+        [np.asarray(p, dtype=bool) for p in forced_parts]
+    ) if forced_parts else np.zeros((0,), bool)
+    node_idx = {name: i for i, name in enumerate(meta.node_names)}
+    ds_target = [
+        node_idx.get(pinned_node_name(p), -1)
+        if p.metadata.annotations.get(ANNO_WORKLOAD_KIND) == "DaemonSet"
+        else -1
+        for p in ordered
+    ]
+    return Prepared(
+        ec=ec,
+        st0=st0,
+        meta=meta,
+        ordered=ordered,
+        tmpl_ids=tmpl_ids,
+        forced=forced,
+        ds_target=ds_target,
+        features=features,
+        ec_np=ec_np,
+        encoder=enc,
+        n_cluster=n_cluster,
+        n_bare=n_bare,
+        ds_group_sizes=ds_group_sizes,
+    )
+
+
+def _expand_app(cluster: ResourceTypes, app: AppResource, use_greed: bool):
+    """The exact app expansion pipeline of ``simulator._prepare_inner``."""
+    app_pods = expand.generate_pods_from_resources(app.resources, cluster.nodes)
+    for p in app_pods:
+        p.metadata.labels.setdefault(LABEL_APP_NAME, app.name)
+    app_pods = queues.toleration_sort(queues.affinity_sort(app_pods))
+    if use_greed:
+        app_pods = queues.greed_sort(cluster.nodes, app_pods)
+    return app_pods
+
+
+def derive_with_apps(
+    base: Prepared,
+    cluster: ResourceTypes,
+    apps: List[AppResource],
+    use_greed: bool = False,
+    base_entry: Optional[CacheEntry] = None,
+) -> Optional[Prepared]:
+    """Delta re-encode: the cached base's stream plus `apps` appended —
+    exactly the stream ``prepare(cluster, apps)`` would produce when the
+    base was prepared from the same cluster with no apps. `base_entry`
+    (when `base` is its prep) enables device-tensor reuse for unchanged
+    leaves. Returns None when the result would be empty."""
+    if isinstance(base, CacheEntry):  # convenience: entry accepted directly
+        base_entry, base = base, base.prep
+    t0 = time.monotonic()
+    enc = base.encoder.fork()
+    new_pods: List = []
+    forced_new: List[bool] = []
+    for app in apps:
+        for p in _expand_app(cluster, app, use_greed):
+            new_pods.append(p)
+            forced_new.append(bool(p.spec.node_name))
+    if not new_pods and not base.ordered:
+        return None
+    tmpl_new = [
+        enc.add_pod(p, (lambda p=p: _owner_selector(p)), hint=_tmpl_hint(p))
+        for p in new_pods
+    ]
+    prep = _assemble_delta(
+        base_entry,
+        enc,
+        ordered=list(base.ordered) + new_pods,
+        tmpl_parts=[base.tmpl_ids, tmpl_new] if len(base.tmpl_ids) else [tmpl_new],
+        forced_parts=[base.forced, forced_new] if len(base.forced) else [forced_new],
+        n_cluster=base.n_cluster,
+        n_bare=base.n_bare,
+        ds_group_sizes=list(base.ds_group_sizes or []),
+    )
+    PREP_STATS.record("delta_apps", time.monotonic() - t0)
+    return prep
+
+
+def extend_with_nodes(
+    base_prep: Prepared,
+    new_nodes: List,
+    cluster: ResourceTypes,
+    apps: List[AppResource],
+    use_greed: bool = False,
+    base_entry: Optional[CacheEntry] = None,
+) -> Optional[Prepared]:
+    """Delta re-encode for node addition (the planner's candidate sweep):
+    encode the new nodes into the cached arenas and splice their DaemonSet
+    pods in at the exact stream positions a fresh full expansion would
+    produce. Returns None when the delta cannot reproduce a fresh prepare:
+
+    - greedy sort orders app pods by node TOTALS, which the added nodes
+      change — the whole stream may reorder;
+    - app DaemonSets expand one pod per node inside the app's sorted
+      region — splicing there is not order-preserving in general.
+    """
+    if use_greed:
+        return None
+    if any(a.resources.daemon_sets for a in apps):
+        return None
+    if base_prep is None or base_prep.encoder is None or base_prep.ds_group_sizes is None:
+        return None
+    t0 = time.monotonic()
+    enc = base_prep.encoder.fork()
+    enc.extend_nodes(new_nodes)
+
+    # per-DaemonSet pods for the new nodes, in cluster.daemon_sets order —
+    # the same expansion order _cluster_pods uses
+    groups_new = [expand.pods_from_daemon_set(ds, new_nodes) for ds in cluster.daemon_sets]
+    if len(groups_new) != len(base_prep.ds_group_sizes):
+        return None  # cluster's DS set changed vs the base prep: not a pure node delta
+
+    b = base_prep.n_cluster - sum(base_prep.ds_group_sizes)
+    ordered: List = list(base_prep.ordered[:b])
+    tmpl_parts: List = [base_prep.tmpl_ids[:b]]
+    forced_parts: List = [base_prep.forced[:b]]
+    ds_group_sizes: List[int] = []
+    off = b
+    for size, pods_k in zip(base_prep.ds_group_sizes, groups_new):
+        ordered.extend(base_prep.ordered[off : off + size])
+        tmpl_parts.append(base_prep.tmpl_ids[off : off + size])
+        forced_parts.append(base_prep.forced[off : off + size])
+        off += size
+        ids = [
+            enc.add_pod(p, (lambda p=p: _owner_selector(p)), hint=_tmpl_hint(p))
+            for p in pods_k
+        ]
+        ordered.extend(pods_k)
+        tmpl_parts.append(ids)
+        forced_parts.append([bool(p.spec.node_name) for p in pods_k])
+        ds_group_sizes.append(size + len(pods_k))
+    # the app region rides along unchanged (apps have no DaemonSets here)
+    ordered.extend(base_prep.ordered[base_prep.n_cluster :])
+    tmpl_parts.append(base_prep.tmpl_ids[base_prep.n_cluster :])
+    forced_parts.append(base_prep.forced[base_prep.n_cluster :])
+
+    prep = _assemble_delta(
+        base_entry,
+        enc,
+        ordered=ordered,
+        tmpl_parts=[p for p in tmpl_parts if len(p)],
+        forced_parts=[p for p in forced_parts if len(p)],
+        n_cluster=base_prep.n_cluster + sum(len(g) for g in groups_new),
+        n_bare=base_prep.n_bare,
+        ds_group_sizes=ds_group_sizes,
+    )
+    PREP_STATS.record("delta_nodes", time.monotonic() - t0)
+    return prep
+
+
+def drop_mask_for_scaled(prep: Prepared, owned_by, scaled: set) -> np.ndarray:
+    """Valid-mask flip for a scale request: mark the BARE cluster pods owned
+    by the scaled workloads (the pods ``scale-apps`` removes from the
+    snapshot before re-simulating). Only the bare prefix is eligible — the
+    fresh path filters ``cluster.pods``, never workload expansions."""
+    mask = np.zeros((len(prep.ordered),), dtype=bool)
+    for i in range(prep.n_bare):
+        if owned_by(prep.ordered[i], scaled):
+            mask[i] = True
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# steady-state entry point
+# ---------------------------------------------------------------------------
+
+
+def simulate_cached(
+    cluster: ResourceTypes,
+    apps: List[AppResource],
+    cache: PrepareCache,
+    *,
+    use_greed: bool = False,
+    node_pad: int = 128,
+    sched_config=None,
+    extra_plugins: tuple = (),
+    tie_seed: Optional[int] = None,
+    key: Optional[str] = None,
+):
+    """One full simulation through the encode cache: the first call for a
+    (cluster, apps) content key pays the full prepare; every later call
+    reuses the cached Prepared (fingerprint + bind-state restore — O(pods)
+    pointer work, no expansion, no encode). The steady-state path bench.py
+    --config steady measures."""
+    full_key = key or (
+        fingerprint_cluster(cluster)
+        + "|" + fingerprint_apps(apps)
+        + f"|g{int(use_greed)}|p{node_pad}"
+    )
+    entry = cache.get(full_key)
+    if entry is None:
+        prep = prepare(cluster, apps, use_greed=use_greed, node_pad=node_pad)
+        entry = cache.put(full_key, CacheEntry(full_key, prep))
+    else:
+        t0 = time.monotonic()
+        with entry.lock:
+            entry.restore()
+        PREP_STATS.record("hit", time.monotonic() - t0)
+    if entry.prep is None:
+        return simulate(
+            cluster, apps, use_greed=use_greed, node_pad=node_pad,
+            sched_config=sched_config, extra_plugins=extra_plugins, tie_seed=tie_seed,
+        )
+    with entry.lock:
+        try:
+            return simulate(
+                cluster, apps, sched_config=sched_config,
+                extra_plugins=extra_plugins, tie_seed=tie_seed, prep=entry.prep,
+            )
+        finally:
+            entry.restore()

@@ -246,28 +246,40 @@ def _owner_selector(pod: Pod) -> Optional[dict]:
     return None
 
 
-def _cluster_pods(cluster: ResourceTypes) -> List[Pod]:
+def _cluster_pods(cluster: ResourceTypes) -> Tuple[List[Pod], int, List[int]]:
     """GetValidPodExcludeDaemonSet (pkg/simulator/utils.go:77-230): bare
     cluster pods minus DaemonSet-owned ones (those are re-expanded per
-    node), plus expanded cluster workloads."""
+    node), plus expanded cluster workloads.
+
+    Returns ``(pods, n_bare, ds_group_sizes)`` — the bare-pod prefix length
+    and the per-DaemonSet expansion sizes (the DS pods form the stream
+    tail, grouped in ``cluster.daemon_sets`` order). The delta re-encoder
+    uses both to splice changes in at exactly the positions a fresh
+    expansion would produce them."""
     ds_names = {(d.metadata.namespace, d.metadata.name) for d in cluster.daemon_sets}
+    bare = [
+        p
+        for p in cluster.pods
+        if not any(
+            r.kind == "DaemonSet" and (p.metadata.namespace, r.name) in ds_names
+            for r in p.metadata.owner_references
+        )
+    ]
     rt = ResourceTypes(
-        pods=[
-            p
-            for p in cluster.pods
-            if not any(
-                r.kind == "DaemonSet" and (p.metadata.namespace, r.name) in ds_names
-                for r in p.metadata.owner_references
-            )
-        ],
+        pods=bare,
         deployments=cluster.deployments,
         replica_sets=cluster.replica_sets,
         stateful_sets=cluster.stateful_sets,
-        daemon_sets=cluster.daemon_sets,
         jobs=cluster.jobs,
         cron_jobs=cluster.cron_jobs,
     )
-    return expand.generate_pods_from_resources(rt, cluster.nodes)
+    pods = expand.generate_pods_from_resources(rt, cluster.nodes, include_daemon_sets=False)
+    ds_group_sizes: List[int] = []
+    for ds in cluster.daemon_sets:
+        group = expand.pods_from_daemon_set(ds, cluster.nodes)
+        ds_group_sizes.append(len(group))
+        pods.extend(group)
+    return pods, len(bare), ds_group_sizes
 
 
 def _reason_string(
@@ -314,6 +326,14 @@ class Prepared:
     ds_target: List[int]  # node index a DaemonSet pod is pinned to, -1 otherwise
     features: kernels.Features = kernels.ALL_FEATURES
     ec_np: object = None  # host-side numpy EncodedCluster (fast-path marshalling)
+    # incremental-prepare provenance (engine/prepcache.py): the encoder that
+    # built this (forked for delta re-encoding), the cluster-pod prefix
+    # length of the stream, the bare-pod prefix within it, and the cluster
+    # DaemonSet expansion group sizes (stream tail of the cluster region)
+    encoder: object = None
+    n_cluster: int = 0
+    n_bare: int = 0
+    ds_group_sizes: Optional[List[int]] = None
 
 
 def pinned_node_name(pod: Pod) -> str:
@@ -340,9 +360,13 @@ def prepare(
     """Expand cluster + app workloads into an ordered pod stream and encode
     everything into device tensors. Returns None when there are no pods."""
     from ..utils.gcpause import gc_paused
+    from ..utils.trace import PREP_STATS
 
+    t0 = time.monotonic()
     with gc_paused():
-        return _prepare_inner(cluster, apps, use_greed, node_pad, patch_pods_fn)
+        prep = _prepare_inner(cluster, apps, use_greed, node_pad, patch_pods_fn)
+    PREP_STATS.record("full", time.monotonic() - t0)
+    return prep
 
 
 def _prepare_inner(cluster, apps, use_greed, node_pad, patch_pods_fn):
@@ -352,7 +376,8 @@ def _prepare_inner(cluster, apps, use_greed, node_pad, patch_pods_fn):
     ordered: List[Pod] = []
     forced: List[bool] = []
 
-    for p in _cluster_pods(cluster):
+    cluster_pods, n_bare, ds_group_sizes = _cluster_pods(cluster)
+    for p in cluster_pods:
         ordered.append(p)
         forced.append(bool(p.spec.node_name))
     n_cluster = len(ordered)  # pods below went through patch_pods_fn
@@ -414,6 +439,10 @@ def _prepare_inner(cluster, apps, use_greed, node_pad, patch_pods_fn):
         ds_target=ds_target,
         features=features,
         ec_np=ec_np,
+        encoder=enc,
+        n_cluster=n_cluster,
+        n_bare=n_bare,
+        ds_group_sizes=ds_group_sizes,
     )
 
 
@@ -529,6 +558,7 @@ def simulate(
     tie_seed: Optional[int] = None,
     prep: Optional["Prepared"] = None,
     node_valid: Optional[np.ndarray] = None,
+    drop_pods: Optional[np.ndarray] = None,
 ) -> SimulateResult:
     """One full simulation: cluster pods then apps in order. `sched_config`
     is an optional SchedulerConfig (the --default-scheduler-config merge);
@@ -546,12 +576,20 @@ def simulate(
     invalid nodes never enter any filter-failure bucket
     (kernels.precompute_static starts its fold from node_valid) and
     DaemonSet pods pinned to masked-out candidates are dropped from the
-    stream exactly as a smaller expansion would never create them."""
+    stream exactly as a smaller expansion would never create them.
+
+    `drop_pods` (incremental prepare): a bool mask over the prepared pod
+    stream; marked pods are excluded from scheduling AND from the report,
+    exactly as if the pods had never been in the input — the valid-mask
+    flip that lets a cached Prepared serve a cluster whose pods shrank
+    (e.g. scale-apps removing a workload's existing pods)."""
     from ..utils.trace import Trace
 
     _validate_extra_plugins(extra_plugins)
     if prep is not None and enable_preemption:
         raise ValueError("prep reuse does not support enable_preemption; pass prep=None")
+    if drop_pods is not None and prep is None:
+        raise ValueError("drop_pods is a mask over an existing Prepared; pass prep=")
     with Trace("Simulate", threshold_s=1.0) as tr:
         if prep is None:
             prep = prepare(
@@ -568,7 +606,12 @@ def simulate(
         ordered, tmpl_ids, forced = prep.ordered, prep.tmpl_ids, prep.forced
 
         nv_mask: Optional[np.ndarray] = None
-        drop_pods: set = set()
+        drops: set = set()
+        if drop_pods is not None:
+            dm = np.asarray(drop_pods, dtype=bool)
+            if dm.shape[0] != len(prep.ordered):
+                raise ValueError("drop_pods mask must cover the prepared pod stream")
+            drops |= {int(i) for i in np.nonzero(dm)[0]}
         if node_valid is not None:
             nv_mask = np.asarray(node_valid, dtype=bool)
             if nv_mask.shape[0] != int(np.asarray(prep.ec_np.node_valid).shape[0]):
@@ -583,12 +626,12 @@ def simulate(
                 raise ValueError("node_valid must select exactly cluster.nodes as a prefix")
             # DaemonSet pods pinned to masked-out nodes would not exist in a
             # fresh expansion of the sub-cluster: drop them from the stream
-            drop_pods = {
+            drops |= {
                 i for i, t in enumerate(prep.ds_target) if t >= 0 and not nv_mask[t]
             }
 
         pod_valid = np.ones((len(ordered),), dtype=bool)
-        for i in drop_pods:
+        for i in drops:
             pod_valid[i] = False
         # multi-profile KubeSchedulerConfiguration: route the stream onto one
         # effective config; pods naming an unknown profile never enter any
@@ -796,7 +839,7 @@ def simulate(
         statuses = _decode(
             ordered, chosen, forced, custom_reasons, victims_of, gpu_any, gpu_take,
             sf_rows, static_fail, fail_counts, insufficient, meta, n_nodes,
-            node_names, pod_lists, node_pods, unscheduled, cluster, out, drop_pods,
+            node_names, pod_lists, node_pods, unscheduled, cluster, out, drops,
         )
     return SimulateResult(unscheduled_pods=unscheduled, node_status=statuses, engine=engine)
 

@@ -60,6 +60,30 @@ def _sweep_impl(
     )(node_valid_masks, pod_valid_masks, forced_masks)
 
 
+def sweep_counts(
+    prep, n_real: int, ks, config=None
+) -> "tuple[SweepResult, np.ndarray]":
+    """Candidate new-node count sweep directly over a prepared (possibly
+    cached/delta-derived) arena: scenario s enables the first ``n_real +
+    ks[s]`` nodes of the prepared node axis, and DaemonSet pods pinned to
+    disabled candidate nodes are masked out of that scenario (a smaller
+    expansion would never have created them). This is the mask-flip
+    materialization of the planner's sweep — the encoded tensors are built
+    once (or delta re-encoded from a cached base) and every probe is just a
+    pair of boolean masks. Returns (SweepResult, node_valid_masks)."""
+    N = int(np.asarray(prep.ec_np.node_valid).shape[0])
+    P = len(prep.ordered)
+    S = len(ks)
+    node_valid = np.zeros((S, N), dtype=bool)
+    for s, k in enumerate(ks):
+        node_valid[s, : n_real + k] = True
+    pod_valid = np.ones((S, P), dtype=bool)
+    for p, target in enumerate(prep.ds_target):
+        if target >= n_real:  # DaemonSet pod pinned to a candidate node
+            pod_valid[:, p] = node_valid[:, target]
+    return sweep_auto(prep, node_valid, pod_valid, config=config), node_valid
+
+
 def sweep_auto(
     prep,
     node_valid_masks: np.ndarray,

@@ -23,7 +23,14 @@ from typing import List, Optional, TextIO
 
 import numpy as np
 
-from ..engine.simulator import AppResource, SimulateResult, prepare, simulate
+from ..engine.simulator import (
+    AppResource,
+    SimulateResult,
+    prepare,
+    restore_bind_state,
+    simulate,
+    snapshot_bind_state,
+)
 from ..models import expand
 from ..models.objects import ENV_MAX_CPU, ENV_MAX_MEMORY, ENV_MAX_VG, Node, ResourceTypes
 from ..parallel import scenarios
@@ -247,20 +254,9 @@ class Applier:
     ) -> List[bool]:
         """One sharded sweep over candidate new-node counts; a count is
         feasible when everything schedules within the env caps."""
-        N = prep.ec.node_valid.shape[0]
-        P = len(prep.ordered)
-        S = len(ks)
-        node_valid = np.zeros((S, N), dtype=bool)
-        for s, k in enumerate(ks):
-            node_valid[s, : n_real + k] = True
-        pod_valid = np.ones((S, P), dtype=bool)
-        for p, target in enumerate(prep.ds_target):
-            if target >= n_real:  # DaemonSet pod pinned to a candidate node
-                pod_valid[:, p] = node_valid[:, target]
-
         try:
-            res = scenarios.sweep_auto(
-                prep, node_valid, pod_valid, config=self.sched_config
+            res, node_valid = scenarios.sweep_counts(
+                prep, n_real, ks, config=self.sched_config
             )
         except ValueError as e:
             if "differing plugin configurations" not in str(e):
@@ -273,6 +269,7 @@ class Applier:
             if fallback_ctx is None:
                 raise
             return self._feasible_counts_sequential(prep, n_real, ks, fallback_ctx)
+        S = len(ks)
         unscheduled = np.asarray(res.unscheduled)
         used = np.asarray(res.used)  # [S, N, R]
         max_cpu, max_mem, max_vg = resource_caps()
@@ -355,12 +352,25 @@ class Applier:
         if self.opts.interactive:
             return self._run_interactive(cluster, apps, template)
 
-        # auto mode: batched capacity search
+        # auto mode: batched capacity search. The initial simulation's
+        # Prepared is kept so the sweep can DELTA re-encode the candidate
+        # node template into it (encode once, materialize every count as
+        # mask flips) instead of re-preparing the whole cluster.
+        prep0 = snap0 = None
+        if not self.opts.enable_preemption:  # prep reuse can't serve preemption
+            prep0 = prepare(cluster, apps, use_greed=self.opts.use_greed)
+            snap0 = snapshot_bind_state(prep0) if prep0 is not None else None
         with Spinner("schedule pods"):
-            result = simulate(
-                cluster, apps, use_greed=self.opts.use_greed, sched_config=self.sched_config,
-                enable_preemption=self.opts.enable_preemption, tie_seed=self.tie_seed,
-            )
+            if prep0 is not None:
+                result = simulate(
+                    cluster, apps, sched_config=self.sched_config,
+                    tie_seed=self.tie_seed, prep=prep0,
+                )
+            else:
+                result = simulate(
+                    cluster, apps, use_greed=self.opts.use_greed, sched_config=self.sched_config,
+                    enable_preemption=self.opts.enable_preemption, tie_seed=self.tie_seed,
+                )
         n_new = 0
         if result.unscheduled_pods or not satisfy_resource_setting(result)[0]:
             if template is None:
@@ -369,13 +379,23 @@ class Applier:
                     print(f"{i:4d} {up.pod.metadata.namespace}/{up.pod.metadata.name}: {up.reason}", file=self.out)
                 return 1
             # one expansion+encode serves the whole sweep AND the final
-            # re-simulation: generate the candidate nodes once, prepare the
-            # full cluster, then mask the node axis down to the answer
+            # re-simulation: the candidate template is encoded ONCE and
+            # tiled into the existing arenas (prepcache.extend_with_nodes);
+            # only greed/app-DaemonSet shapes fall back to a full prepare
             candidates = expand.new_fake_nodes(template, self.opts.max_new_nodes)
             full = copy.copy(cluster)
             full.nodes = list(cluster.nodes) + candidates
             with Spinner(f"capacity sweep (0..{self.opts.max_new_nodes} new nodes)"):
-                prep_full = prepare(full, apps, use_greed=self.opts.use_greed)
+                prep_full = None
+                if prep0 is not None:
+                    from ..engine import prepcache
+
+                    restore_bind_state(prep0, snap0)  # decode mutated the pods
+                    prep_full = prepcache.extend_with_nodes(
+                        prep0, candidates, cluster, apps, use_greed=self.opts.use_greed
+                    )
+                if prep_full is None:
+                    prep_full = prepare(full, apps, use_greed=self.opts.use_greed)
                 n_new = self.find_min_nodes_batched(
                     prep_full, len(cluster.nodes), fallback_ctx=(full, apps)
                 )

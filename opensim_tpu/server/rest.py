@@ -15,7 +15,9 @@ Implementation notes vs the reference:
 
 from __future__ import annotations
 
+import hashlib
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
@@ -49,7 +51,9 @@ class _Metrics:
             self.pods_unscheduled += len(result.unscheduled_pods)
             self.simulate_seconds_total += seconds
 
-    def render(self) -> str:
+    def render(self, prep_cache=None) -> str:
+        from ..utils.trace import PREP_STATS
+
         with self.lock:
             lines = [
                 "# TYPE simon_requests_total counter",
@@ -65,6 +69,22 @@ class _Metrics:
                 f"simon_pods_unscheduled_total {self.pods_unscheduled}",
                 "# TYPE simon_simulate_seconds_total counter",
                 f"simon_simulate_seconds_total {self.simulate_seconds_total:.6f}",
+            ]
+        # host-side prepare attribution (incremental prepare): total seconds
+        # spent producing Prepared inputs, and the encode-cache counters
+        lines += [
+            "# TYPE simon_prepare_seconds_total counter",
+            f"simon_prepare_seconds_total {PREP_STATS.total_seconds():.6f}",
+        ]
+        if prep_cache is not None:
+            st = prep_cache.stats
+            lines += [
+                "# TYPE simon_prep_cache_hits_total counter",
+                f"simon_prep_cache_hits_total {st.hits}",
+                "# TYPE simon_prep_cache_misses_total counter",
+                f"simon_prep_cache_misses_total {st.misses}",
+                "# TYPE simon_prep_cache_invalidations_total counter",
+                f"simon_prep_cache_invalidations_total {st.invalidations}",
             ]
         return "\n".join(lines) + "\n"
 
@@ -138,6 +158,7 @@ class SimonServer:
         master: str = "",
         base_cluster: Optional[ResourceTypes] = None,
         snapshot_ttl_s: float = 30.0,
+        prep_cache=None,
     ):
         self.kubeconfig = kubeconfig
         self.master = master
@@ -149,27 +170,172 @@ class SimonServer:
         self.snapshot_ttl_s = snapshot_ttl_s
         self._snapshot: Optional[ResourceTypes] = None
         self._snapshot_at = 0.0
+        self._snapshot_fp: Optional[str] = None
+        # encode cache (incremental prepare): the snapshot's expanded+encoded
+        # cluster is cached across requests keyed by content fingerprint, so
+        # a request pays O(its own app) host work, not O(cluster). Opt out
+        # with OPENSIM_PREP_CACHE=0 (restores per-request full prepare).
+        if prep_cache is None and os.environ.get("OPENSIM_PREP_CACHE", "1") != "0":
+            from ..engine.prepcache import PrepareCache
+
+            prep_cache = PrepareCache()
+        self.prep_cache = prep_cache if prep_cache is not False else None
 
     def current_cluster(self) -> ResourceTypes:
         if self.base_cluster is not None:
             return self.base_cluster
         if self.kubeconfig:
             import copy as _copy
-            import time as _time
 
-            now = _time.monotonic()
-            if self._snapshot is None or (
-                self.snapshot_ttl_s <= 0 or now - self._snapshot_at > self.snapshot_ttl_s
-            ):
-                self._snapshot = cluster_from_kubeconfig(self.kubeconfig, self.master)
-                self._snapshot_at = now
+            self._refresh_snapshot()
             # hand each request its own copy: simulate() mutates pods/nodes
             # in place (bind writes nodeName/phase/annotations), and the
             # cached snapshot must stay pristine across requests
             return _copy.deepcopy(self._snapshot)
         return ResourceTypes()
 
+    def _refresh_snapshot(self) -> None:
+        import time as _time
+
+        now = _time.monotonic()
+        if self._snapshot is None or (
+            self.snapshot_ttl_s <= 0 or now - self._snapshot_at > self.snapshot_ttl_s
+        ):
+            self._snapshot = cluster_from_kubeconfig(self.kubeconfig, self.master)
+            self._snapshot_at = now
+            self._snapshot_fp = None  # re-fingerprint lazily
+
+    def _snapshot_for_cache(self) -> tuple:
+        """(cluster, content fingerprint) for the encode-cache path — no
+        defensive deepcopy: the cached Prepared owns sanitized pod copies
+        and its bind state is restored after every use, so the snapshot
+        objects are never mutated. A fingerprint change (snapshot refresh
+        picked up cluster changes) invalidates the stale entries."""
+        from ..engine.prepcache import fingerprint_cluster
+
+        if self.base_cluster is not None:
+            if self._snapshot_fp is None:
+                self._snapshot_fp = fingerprint_cluster(self.base_cluster)
+            return self.base_cluster, self._snapshot_fp
+        if self.kubeconfig:
+            old_fp = self._snapshot_fp
+            self._refresh_snapshot()
+            if self._snapshot_fp is None:
+                self._snapshot_fp = fingerprint_cluster(self._snapshot)
+                if old_fp is not None and old_fp != self._snapshot_fp:
+                    self.prep_cache.invalidate(old_fp)
+            return self._snapshot, self._snapshot_fp
+        return ResourceTypes(), "empty"
+
     # -- handlers -----------------------------------------------------------
+
+    def _simulate_request(self, kind: str, payload: dict) -> SimulateResult:
+        """Shared deploy/scale simulation through the encode cache:
+
+        1. identical repeated request → full-key hit: restore + simulate,
+           zero re-encoding;
+        2. known snapshot → base-entry hit: delta re-encode (append the
+           request's app pods; extend nodes from the request's templates;
+           flip valid-mask bits for scaled-away pods);
+        3. cold → one full prepare of the snapshot, cached for 1+2.
+        """
+        from ..engine import prepcache
+        from ..utils.trace import PREP_STATS
+        import time as _time
+
+        new_nodes = _decode_new_nodes(payload)
+        app = _decode_app(payload)
+        apps = [AppResource(kind, app)]
+        scaled: set = set()
+        if kind == "scale":
+            scaled = {
+                (w.kind, w.metadata.namespace, w.metadata.name)
+                for w in app.deployments + app.daemon_sets + app.stateful_sets
+            }
+
+        if self.prep_cache is None:
+            # legacy path: per-request snapshot copy + full prepare
+            cluster = _with_new_nodes(self.current_cluster(), new_nodes)
+            if scaled:
+                cluster.pods = [p for p in cluster.pods if not _owned_by(p, scaled)]
+            return simulate(cluster, apps)
+
+        cluster0, fp = self._snapshot_for_cache()
+        cluster = _with_new_nodes(cluster0, new_nodes)
+
+        def _filtered() -> ResourceTypes:
+            # only the cold full-prepare fallbacks need the scaled pods
+            # actually removed from the input; the cached paths express the
+            # removal as a drop mask over the prepared stream instead, so
+            # the O(all pods) owner scan is skipped on the hot path
+            if not scaled:
+                return cluster
+            out = _with_new_nodes(cluster0, new_nodes)
+            out.pods = [p for p in cluster0.pods if not _owned_by(p, scaled)]
+            return out
+
+        payload_fp = hashlib.blake2b(
+            json.dumps(payload, sort_keys=True, default=str).encode(), digest_size=16
+        ).hexdigest()
+        full_key = f"{fp}|{kind}|{payload_fp}"
+        # full-key reuse only without newnodes: fake-node names are freshly
+        # randomized per request, and a cached derived prep would replay the
+        # first request's names into later responses
+        entry = self.prep_cache.get(full_key) if not new_nodes else None
+        if entry is not None and entry.prep is not None:
+            t0 = _time.monotonic()
+            with entry.lock:
+                entry.restore()
+                PREP_STATS.record("hit", _time.monotonic() - t0)
+                try:
+                    return simulate(
+                        cluster, apps, prep=entry.prep,
+                        drop_pods=getattr(entry, "drop_mask", None),
+                    )
+                finally:
+                    entry.restore()
+
+        base_key = f"{fp}|base"
+        base = self.prep_cache.get(base_key)
+        if base is None:
+            from ..engine.simulator import prepare
+
+            base = self.prep_cache.put(
+                base_key, prepcache.CacheEntry(base_key, prepare(cluster0, []))
+            )
+        if base.prep is None:
+            # snapshot with no schedulable pods: nothing worth caching
+            return simulate(_filtered(), apps)
+        with base.lock:
+            base.restore()
+            base_prep = base.prep
+            if new_nodes:
+                base_prep = prepcache.extend_with_nodes(
+                    base_prep, new_nodes, cluster0, [], base_entry=base
+                )
+            derived = (
+                prepcache.derive_with_apps(
+                    base_prep, cluster, apps,
+                    base_entry=base if not new_nodes else None,
+                )
+                if base_prep is not None
+                else None
+            )
+            if derived is None:
+                return simulate(_filtered(), apps)
+            drop = (
+                prepcache.drop_mask_for_scaled(derived, _owned_by, scaled)
+                if scaled
+                else None
+            )
+            entry = prepcache.CacheEntry(full_key, derived, base=base)
+            entry.drop_mask = drop
+            if not new_nodes:
+                self.prep_cache.put(full_key, entry)
+            try:
+                return simulate(cluster, apps, prep=derived, drop_pods=drop)
+            finally:
+                entry.restore()
 
     def deploy_apps(self, payload: dict) -> tuple:
         if not _deploy_lock.acquire(blocking=False):
@@ -177,11 +343,8 @@ class SimonServer:
         try:
             import time
 
-            cluster = self.current_cluster()
-            cluster = _with_new_nodes(cluster, _decode_new_nodes(payload))
-            app = _decode_app(payload)
             t0 = time.monotonic()
-            result = simulate(cluster, [AppResource("deploy", app)])
+            result = self._simulate_request("deploy", payload)
             METRICS.record("deploy-apps", result, time.monotonic() - t0)
             return 200, _response(result)
         except Exception as e:  # surface as 500 like gin's error handler
@@ -191,26 +354,16 @@ class SimonServer:
 
     def scale_apps(self, payload: dict) -> tuple:
         """scale-apps (server.go:233-312): remove the workload's existing
-        pods from the cluster snapshot, then re-simulate at the new scale."""
+        pods from the cluster snapshot, then re-simulate at the new scale —
+        on the cached path the removal is a valid-mask flip over the
+        snapshot's cached encoding, not a re-encode."""
         if not _scale_lock.acquire(blocking=False):
             return 503, {"error": "the server is busy now, please try again later"}
         try:
-            cluster = self.current_cluster()
-            cluster = _with_new_nodes(cluster, _decode_new_nodes(payload))
-            app = _decode_app(payload)
-            scaled = {
-                (w.kind, w.metadata.namespace, w.metadata.name)
-                for w in app.deployments + app.daemon_sets + app.stateful_sets
-            }
-            cluster.pods = [
-                p
-                for p in cluster.pods
-                if not _owned_by(p, scaled)
-            ]
             import time
 
             t0 = time.monotonic()
-            result = simulate(cluster, [AppResource("scale", app)])
+            result = self._simulate_request("scale", payload)
             METRICS.record("scale-apps", result, time.monotonic() - t0)
             return 200, _response(result)
         except Exception as e:
@@ -258,7 +411,7 @@ def make_handler(server: SimonServer):
             if self.path == "/healthz":
                 self._send(200, {"status": "ok"})
             elif self.path == "/metrics":
-                data = METRICS.render().encode()
+                data = METRICS.render(prep_cache=server.prep_cache).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(data)))

@@ -65,10 +65,14 @@ def _load_kubeconfig(kubeconfig: str, master: Optional[str]) -> tuple:
     if user.get("token"):
         headers["Authorization"] = f"Bearer {user['token']}"
     else:
+        # any non-bearer auth the fallback can't speak must fail HERE with a
+        # clear error, not proceed unauthenticated into an opaque 401 —
+        # including basic auth and bare client-key material (ADVICE r5 #2)
         unsupported = [
             k for k in (
                 "client-certificate", "client-certificate-data", "exec",
-                "auth-provider", "tokenFile",
+                "auth-provider", "tokenFile", "username", "password",
+                "client-key", "client-key-data",
             ) if user.get(k)
         ]
         if unsupported:
@@ -80,7 +84,10 @@ def _load_kubeconfig(kubeconfig: str, master: Optional[str]) -> tuple:
     ssl_ctx = None
     if server.startswith("https"):
         if cluster.get("insecure-skip-tls-verify"):
-            ssl_ctx = ssl._create_unverified_context()
+            # public-API equivalent of ssl._create_unverified_context()
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ssl_ctx.check_hostname = False
+            ssl_ctx.verify_mode = ssl.CERT_NONE
         elif cluster.get("certificate-authority-data"):
             import base64
 

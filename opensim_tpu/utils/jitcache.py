@@ -1,0 +1,60 @@
+"""JAX persistent compilation cache wiring.
+
+The headline 50k/5k plan spends ~1 s of cold start compiling the scan/
+megakernel pipelines; the persistent cache amortizes that across processes
+(CI runs, repeated `simon apply` invocations, server restarts).
+
+Opt-in via environment:
+  OPENSIM_JIT_CACHE=1        enable at the default dir (~/.cache/opensim-tpu/jit)
+  OPENSIM_JIT_CACHE=<path>   enable at <path>
+  OPENSIM_JIT_CACHE=0        force-disable (even for callers that default on)
+
+``bench.py`` and test conftest enable it by default (JAX_COMPILATION_CACHE_DIR
+wins if already set so existing workflows keep their cache location).
+Call ``maybe_enable`` BEFORE the first jax import when possible — the env
+var route is the most portable across jax versions; the config.update calls
+cover an already-imported jax.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+DEFAULT_DIR = os.path.join(
+    os.path.expanduser(os.environ.get("XDG_CACHE_HOME", "~/.cache")),
+    "opensim-tpu",
+    "jit",
+)
+
+
+def maybe_enable(default: bool = False, path: Optional[str] = None) -> Optional[str]:
+    """Enable the persistent compilation cache if opted in.
+
+    Returns the cache directory in effect, or None when disabled. `default`
+    is the behavior with OPENSIM_JIT_CACHE unset: benches/CLIs that always
+    benefited from a warm cache pass True."""
+    raw = os.environ.get("OPENSIM_JIT_CACHE", "")
+    if raw == "0":
+        return None
+    if not raw and not default and not path:
+        return None
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or (
+        raw if raw not in ("", "1") else None
+    ) or path or DEFAULT_DIR
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        return None
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    try:  # jax may already be imported: set the config knobs directly too
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache every compilation, not only the slow ones: the scan pipeline
+        # recompiles per (P, N, feature) signature and each one matters
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass  # pre-import usage: the env var alone is enough
+    return cache_dir

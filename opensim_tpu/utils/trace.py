@@ -53,6 +53,52 @@ class Trace:
         log.warning("\n".join(lines))
 
 
+class PrepStats:
+    """Host-side prepare attribution (incremental-prepare observability).
+
+    Every way a simulation can obtain its ``Prepared`` records here:
+      ``full``        — a cold expand+encode of the whole cluster
+      ``delta_apps``  — delta re-encode: pods appended to a cached base
+      ``delta_nodes`` — delta re-encode: nodes added to a cached base
+      ``hit``         — encode-cache hit (fingerprint + bind-state restore)
+
+    ``bench.py`` emits these as ``host_prep_s``; the REST server exports
+    them as ``simon_prepare_seconds_total``; tests use ``last`` to assert a
+    request skipped re-encoding."""
+
+    def __init__(self) -> None:
+        import threading
+
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        self.seconds: dict = {}
+        self.counts: dict = {}
+        self.last: Optional[Tuple[str, float]] = None
+
+    def record(self, kind: str, seconds: float) -> None:
+        with self._lock:
+            self.seconds[kind] = self.seconds.get(kind, 0.0) + seconds
+            self.counts[kind] = self.counts.get(kind, 0) + 1
+            self.last = (kind, seconds)
+
+    def total_seconds(self) -> float:
+        with self._lock:
+            return sum(self.seconds.values())
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "seconds": dict(self.seconds),
+                "counts": dict(self.counts),
+                "last": self.last,
+            }
+
+
+PREP_STATS = PrepStats()
+
+
 _profiler_active = False
 
 

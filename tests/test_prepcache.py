@@ -1,0 +1,347 @@
+"""Incremental prepare (opensim_tpu/engine/prepcache.py): encode-cache
+hit/miss/invalidation behavior, and the correctness bar of the delta
+re-encoders — placements byte-identical to a full re-encode, fuzz-corpus
+included."""
+
+import copy
+import json
+import random
+import threading
+import urllib.request
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+from opensim_tpu.engine import prepcache
+from opensim_tpu.engine.simulator import AppResource, prepare, simulate
+from opensim_tpu.models import ResourceTypes, fixtures as fx
+from opensim_tpu.models.expand import new_fake_nodes
+from opensim_tpu.utils.trace import PREP_STATS
+
+
+def _cluster(n_nodes=8, with_ds=False):
+    rt = ResourceTypes()
+    for i in range(n_nodes):
+        rt.nodes.append(
+            fx.make_fake_node(
+                f"n{i:03d}", "16", "64Gi", "110",
+                fx.with_labels(
+                    {
+                        "topology.kubernetes.io/zone": f"z{i % 3}",
+                        "disk": "ssd" if i % 2 else "hdd",
+                    }
+                ),
+            )
+        )
+    if with_ds:
+        rt.daemon_sets.append(fx.make_fake_daemon_set("logd", "100m", "128Mi"))
+    rt.pods.append(fx.make_fake_pod("pinned", "100m", "128Mi", fx.with_node_name("n000")))
+    return rt
+
+
+def _apps():
+    rt = ResourceTypes()
+    rt.deployments.append(
+        fx.make_fake_deployment("web", 10, "500m", "1Gi", fx.with_node_selector({"disk": "ssd"}))
+    )
+    rt.deployments.append(
+        fx.make_fake_deployment(
+            "db", 4, "1", "2Gi",
+            fx.with_topology_spread(
+                [
+                    {
+                        "maxSkew": 1,
+                        "topologyKey": "topology.kubernetes.io/zone",
+                        "whenUnsatisfiable": "DoNotSchedule",
+                        "labelSelector": {"matchLabels": {"app": "db"}},
+                    }
+                ]
+            ),
+        )
+    )
+    return [AppResource("a", rt)]
+
+
+def _placements(prep):
+    """(stream-position → node name, sorted reasons) after a simulate —
+    pod names are randomized per expansion, so positionwise node names are
+    the strongest comparable signal."""
+    return [p.spec.node_name for p in prep.ordered]
+
+
+def _result_shape(res):
+    return (
+        [(ns.node.metadata.name, len(ns.pods)) for ns in res.node_status],
+        sorted(u.reason for u in res.unscheduled_pods),
+    )
+
+
+# ---------------------------------------------------------------------------
+# cache behavior
+# ---------------------------------------------------------------------------
+
+
+def test_cache_hit_miss_eviction_invalidation():
+    cache = prepcache.PrepareCache(capacity=2)
+    assert cache.get("a") is None
+    cache.put("a", prepcache.CacheEntry("a", None))
+    cache.put("b", prepcache.CacheEntry("b", None))
+    assert cache.get("a") is not None and cache.get("b") is not None
+    cache.put("c", prepcache.CacheEntry("c", None))  # evicts LRU ("a")
+    assert cache.get("a") is None
+    assert cache.stats.evictions == 1
+    assert cache.invalidate("b") == 1
+    assert cache.get("b") is None
+    assert cache.stats.hits == 2 and cache.stats.invalidations == 1
+
+
+def test_fingerprint_tracks_cluster_content():
+    rt = _cluster()
+    fp0 = prepcache.fingerprint_cluster(rt)
+    assert fp0 == prepcache.fingerprint_cluster(rt)  # stable
+    rt2 = copy.copy(rt)
+    rt2.nodes = rt.nodes + [fx.make_fake_node("extra", "8", "16Gi")]
+    assert prepcache.fingerprint_cluster(rt2) != fp0
+    rt3 = copy.copy(rt)
+    rt3.pods = rt.pods + [fx.make_fake_pod("p2", "100m", "128Mi")]
+    assert prepcache.fingerprint_cluster(rt3) != fp0
+    assert prepcache.fingerprint_apps(_apps()) == prepcache.fingerprint_apps(_apps())
+
+
+def test_simulate_cached_second_call_is_a_hit():
+    cluster, apps = _cluster(), _apps()
+    cache = prepcache.PrepareCache()
+    r1 = prepcache.simulate_cached(cluster, apps, cache)
+    PREP_STATS.reset()
+    r2 = prepcache.simulate_cached(cluster, apps, cache)
+    snap = PREP_STATS.snapshot()
+    assert snap["counts"].get("hit") == 1 and "full" not in snap["counts"]
+    assert cache.stats.hits == 1 and cache.stats.misses == 1
+    assert _result_shape(r1) == _result_shape(r2)
+    # third call still pristine (bind-state restored between uses)
+    r3 = prepcache.simulate_cached(cluster, apps, cache)
+    assert _result_shape(r1) == _result_shape(r3)
+
+
+# ---------------------------------------------------------------------------
+# delta re-encode == full re-encode
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("with_ds", [False, True])
+def test_derive_with_apps_matches_full_prepare(with_ds):
+    cluster, apps = _cluster(with_ds=with_ds), _apps()
+    full = prepare(cluster, apps)
+    r_full = simulate(cluster, apps, prep=full)
+
+    base = prepare(cluster, [])
+    entry = prepcache.CacheEntry("base", base)
+    derived = prepcache.derive_with_apps(base, cluster, apps, base_entry=entry)
+    assert len(derived.ordered) == len(full.ordered)
+    r_delta = simulate(cluster, apps, prep=derived)
+    assert _result_shape(r_full) == _result_shape(r_delta)
+    assert _placements(full) == _placements(derived)
+
+
+@pytest.mark.parametrize("with_ds", [False, True])
+def test_extend_with_nodes_matches_full_prepare(with_ds):
+    cluster, apps = _cluster(n_nodes=6, with_ds=with_ds), _apps()
+    template = fx.make_fake_node(
+        "tpl", "32", "128Gi", "110",
+        fx.with_labels({"topology.kubernetes.io/zone": "z9", "disk": "ssd"}),
+    )
+    candidates = new_fake_nodes(template, 4)
+    full_cluster = copy.copy(cluster)
+    full_cluster.nodes = list(cluster.nodes) + candidates
+
+    prep_fresh = prepare(full_cluster, apps)
+    prep_base = prepare(cluster, apps)
+    prep_ext = prepcache.extend_with_nodes(prep_base, candidates, cluster, apps)
+    assert prep_ext is not None
+    assert len(prep_ext.ordered) == len(prep_fresh.ordered)
+    assert prep_ext.ds_target == prep_fresh.ds_target
+
+    r1 = simulate(full_cluster, apps, prep=prep_fresh)
+    r2 = simulate(full_cluster, apps, prep=prep_ext)
+    assert _result_shape(r1) == _result_shape(r2)
+    assert _placements(prep_fresh) == _placements(prep_ext)
+
+    # masked re-simulation (the planner's final step) must agree too
+    N = int(np.asarray(prep_ext.ec_np.node_valid).shape[0])
+    sub = copy.copy(cluster)
+    sub.nodes = list(cluster.nodes) + candidates[:2]
+    mask = np.zeros(N, dtype=bool)
+    mask[: len(sub.nodes)] = True
+    m1 = simulate(sub, apps, prep=prep_fresh, node_valid=mask[: np.asarray(prep_fresh.ec_np.node_valid).shape[0]])
+    m2 = simulate(sub, apps, prep=prep_ext, node_valid=mask)
+    assert _result_shape(m1) == _result_shape(m2)
+
+
+def test_extend_declines_greed_and_app_daemonsets():
+    cluster, apps = _cluster(n_nodes=4), _apps()
+    template = fx.make_fake_node("tpl", "8", "16Gi")
+    prep_base = prepare(cluster, apps)
+    assert prepcache.extend_with_nodes(prep_base, new_fake_nodes(template, 2), cluster, apps, use_greed=True) is None
+    ds_app = ResourceTypes()
+    ds_app.daemon_sets.append(fx.make_fake_daemon_set("agent", "50m", "64Mi"))
+    assert (
+        prepcache.extend_with_nodes(
+            prep_base, new_fake_nodes(template, 2), cluster, [AppResource("d", ds_app)]
+        )
+        is None
+    )
+
+
+def test_drop_mask_matches_filtered_cluster():
+    """scale-apps as a valid-mask flip: masking the scaled workload's bare
+    pods out of a cached prep == re-preparing the filtered cluster."""
+    cluster = _cluster()
+    owned = fx.make_fake_pod("web-1", "500m", "1Gi", fx.with_node_name("n001"))
+    from opensim_tpu.models.objects import OwnerReference
+
+    owned.metadata.owner_references = [
+        OwnerReference(kind="Deployment", name="web", uid="u1", controller=True)
+    ]
+    cluster.pods.append(owned)
+    apps = _apps()
+    scaled = {("Deployment", "default", "web")}
+
+    from opensim_tpu.server.rest import _owned_by
+
+    filtered = copy.copy(cluster)
+    filtered.pods = [p for p in cluster.pods if not _owned_by(p, scaled)]
+    r_fresh = simulate(filtered, apps)
+
+    base = prepare(cluster, [])
+    derived = prepcache.derive_with_apps(base, filtered, apps)
+    drop = prepcache.drop_mask_for_scaled(derived, _owned_by, scaled)
+    assert drop.sum() == 1
+    r_masked = simulate(filtered, apps, prep=derived, drop_pods=drop)
+    assert _result_shape(r_fresh)[1] == _result_shape(r_masked)[1]
+    # node pod COUNTS: fresh result has no row for the dropped pod at all
+    assert {n: c for n, c in _result_shape(r_fresh)[0]} == {
+        n: c for n, c in _result_shape(r_masked)[0]
+    }
+
+
+def test_delta_vs_full_on_fuzz_corpus():
+    """The fastpath-fuzz generators (every supported feature mixed) through
+    both delta paths: placements must match a full re-encode exactly."""
+    from test_fastpath_fuzz import random_app, random_cluster
+
+    for seed in (3, 11, 42):
+        rng = random.Random(seed)
+        cluster = random_cluster(rng, rng.randrange(6, 12))
+        apps = [AppResource("fuzz", random_app(rng, rng.randrange(2, 5)))]
+
+        full = prepare(cluster, apps, node_pad=8)
+        r_full = simulate(cluster, apps, prep=full)
+        base = prepare(cluster, [], node_pad=8)
+        if base is None:
+            continue
+        derived = prepcache.derive_with_apps(base, cluster, apps)
+        r_delta = simulate(cluster, apps, prep=derived)
+        assert _result_shape(r_full) == _result_shape(r_delta), f"seed {seed}"
+        assert _placements(full) == _placements(derived), f"seed {seed}"
+
+        template = fx.make_fake_node(
+            "tpl", "16", "64Gi", "110",
+            fx.with_labels({"topology.kubernetes.io/zone": "z0"}),
+        )
+        candidates = new_fake_nodes(template, 3)
+        full_cluster = copy.copy(cluster)
+        full_cluster.nodes = list(cluster.nodes) + candidates
+        prep_fresh = prepare(full_cluster, apps, node_pad=8)
+        prep_ext = prepcache.extend_with_nodes(full, candidates, cluster, apps)
+        assert prep_ext is not None
+        rf = simulate(full_cluster, apps, prep=prep_fresh)
+        re_ = simulate(full_cluster, apps, prep=prep_ext)
+        assert _result_shape(rf) == _result_shape(re_), f"seed {seed}"
+        assert _placements(prep_fresh) == _placements(prep_ext), f"seed {seed}"
+
+
+# ---------------------------------------------------------------------------
+# REST: the second identical request skips re-encoding
+# ---------------------------------------------------------------------------
+
+
+@contextmanager
+def _serve(server):
+    from http.server import ThreadingHTTPServer
+
+    from opensim_tpu.server.rest import make_handler
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(server))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        yield httpd.server_address[1]
+    finally:
+        httpd.shutdown()
+
+
+def _metric(text, name):
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[-1])
+    return None
+
+
+def test_rest_second_identical_deploy_skips_reencode():
+    from opensim_tpu.server.rest import SimonServer
+
+    cluster = _cluster()
+    server = SimonServer(base_cluster=cluster)
+    assert server.prep_cache is not None
+    body = json.dumps(
+        {"deployments": [fx.make_fake_deployment("m", 3, "100m", "128Mi").raw]}
+    ).encode()
+    with _serve(server) as port:
+        results = []
+        for _ in range(2):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/deploy-apps", data=body, method="POST"
+            )
+            with urllib.request.urlopen(req) as r:
+                results.append(json.loads(r.read()))
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as r:
+            text = r.read().decode()
+    # identical placements, and the second request hit the full-key entry
+    assert results[0] == results[1]
+    assert _metric(text, "simon_prep_cache_hits_total") >= 1
+    assert _metric(text, "simon_prepare_seconds_total") > 0
+    # the whole-request cache state: base entry + derived entry, one hit
+    assert server.prep_cache.stats.hits >= 1
+
+
+def test_rest_scale_apps_uses_drop_mask_and_matches_legacy(monkeypatch):
+    """The cached scale-apps path must answer exactly like the legacy
+    (full-prepare) path."""
+    from opensim_tpu.server.rest import SimonServer
+
+    cluster = _cluster()
+    owned = fx.make_fake_pod("web-1", "500m", "1Gi", fx.with_node_name("n001"))
+    from opensim_tpu.models.objects import OwnerReference
+
+    owned.metadata.owner_references = [
+        OwnerReference(kind="Deployment", name="web", uid="u1", controller=True)
+    ]
+    cluster.pods.append(owned)
+    payload = {"deployments": [fx.make_fake_deployment("web", 4, "200m", "256Mi").raw]}
+
+    cached = SimonServer(base_cluster=cluster)
+    code1, resp1 = cached.scale_apps(payload)
+    code1b, resp1b = cached.scale_apps(payload)  # second: full-key hit
+    legacy = SimonServer(base_cluster=cluster, prep_cache=False)
+    assert legacy.prep_cache is None
+    code2, resp2 = legacy.scale_apps(payload)
+    assert code1 == code1b == code2 == 200
+
+    def shape(resp):
+        return (
+            sorted((e["node"], len(e["pods"])) for e in resp["nodeStatus"]),
+            sorted(u["reason"] for u in resp["unscheduledPods"]),
+        )
+
+    assert shape(resp1) == shape(resp2)
+    assert shape(resp1b) == shape(resp2)

@@ -726,7 +726,8 @@ def run_serial(cluster, apps, progress=False):
 
     t0 = time.time()
     stream = []
-    for p in _cluster_pods(cluster):
+    cluster_pods, _n_bare, _ds_sizes = _cluster_pods(cluster)
+    for p in cluster_pods:
         stream.append((p, bool(p.spec.node_name)))
     for app in apps:
         pods = expand.generate_pods_from_resources(app.resources, cluster.nodes)

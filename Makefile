@@ -1,10 +1,28 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all
+.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify
 
 all: test
 
 test:
 	python -m pytest tests/ -q
+
+# opensim-lint: repo-specific AST correctness analyzer (docs/static-analysis.md)
+lint:
+	python -m opensim_tpu.analysis opensim_tpu
+
+# strict on the typed core (engine/prepcache, encoding/state, models/quantity);
+# skipped with a notice when mypy is not in the image — the CI gate still
+# runs the AST signature check below, which needs only the stdlib
+mypy:
+	@if python -c "import mypy" 2>/dev/null; then \
+		python -m mypy opensim_tpu; \
+	else \
+		echo "mypy not installed: falling back to stdlib signature check"; \
+		python -m opensim_tpu.analysis --check-typed-core; \
+	fi
+
+# the CI gate: static analysis + types + tier-1 tests
+verify: lint mypy test-quick
 
 # run the moment the TPU tunnel opens (tools/tpu_probe_loop.sh writes
 # /tmp/opensim-tpu-watch.up): compiled-Mosaic parity suite + full bench

@@ -7,7 +7,7 @@ Public API:
     from opensim_tpu import load_cluster_from_dir, load_yaml_objects
 """
 
-__version__ = "0.5.0"
+__version__ = "0.7.0"
 
 
 def __getattr__(name):

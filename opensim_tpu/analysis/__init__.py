@@ -1,0 +1,34 @@
+"""opensim-lint: AST-level correctness analyzer for this repo.
+
+Usage:
+    python -m opensim_tpu.analysis [paths...] [--json] [--rules a,b]
+    make lint
+
+Rules (short name = suppression id; see docs/static-analysis.md):
+    OSL101 jit-boundary       host-side work inside jit-traced code
+    OSL201 dtype-drift        encoder arrays off the Go dtype policy
+    OSL301 determinism        unordered iteration on ordered streams
+    OSL401 cache-mutation     mutation of fingerprinted objects
+    OSL501 exception-swallow  broad except without raise/log
+"""
+
+from .core import (  # noqa: F401
+    RULES,
+    FileContext,
+    Finding,
+    Rule,
+    lint_paths,
+    lint_source,
+    register,
+    render_human,
+    render_json,
+)
+
+# importing the rule modules registers them
+from . import (  # noqa: F401,E402
+    rules_cache,
+    rules_determinism,
+    rules_dtype,
+    rules_except,
+    rules_jit,
+)

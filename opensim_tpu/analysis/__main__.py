@@ -1,0 +1,97 @@
+"""CLI entry: ``python -m opensim_tpu.analysis [paths...]``."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+from . import RULES, lint_paths, render_human, render_json
+
+
+def pyproject_defaults(path: str = "pyproject.toml") -> Dict[str, List[str]]:
+    """Defaults from ``[tool.opensim-lint]`` (``paths``/``rules`` string
+    arrays). Uses tomllib where available (3.11+); this image runs 3.10,
+    so a minimal literal reader covers the two keys we define."""
+    if not os.path.isfile(path):
+        return {}
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    try:
+        import tomllib  # Python 3.11+
+    except ImportError:
+        tomllib = None
+    if tomllib is not None:
+        try:
+            table = tomllib.loads(raw.decode()).get("tool", {}).get("opensim-lint", {})
+            return {k: v for k, v in table.items() if isinstance(v, list)}
+        except tomllib.TOMLDecodeError:
+            pass  # malformed elsewhere in the file: the minimal reader below
+    m = re.search(r"^\[tool\.opensim-lint\]\s*$(.*?)(?=^\[|\Z)", raw.decode(), re.M | re.S)
+    if not m:
+        return {}
+    out: Dict[str, List[str]] = {}
+    for key, body in re.findall(r"^(\w[\w-]*)\s*=\s*\[(.*?)\]", m.group(1), re.M | re.S):
+        out[key] = re.findall(r'"([^"]+)"', body)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="opensim-lint",
+        description="repo-specific AST correctness analyzer (see docs/static-analysis.md)",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=None,
+        help="files/directories to lint (default: [tool.opensim-lint] paths "
+        "in ./pyproject.toml, else opensim_tpu)",
+    )
+    ap.add_argument("--json", action="store_true", help="emit findings as JSON")
+    ap.add_argument(
+        "--rules",
+        help="comma-separated rule names/codes to run (default: all)",
+    )
+    ap.add_argument("--list-rules", action="store_true", help="list rules and exit")
+    ap.add_argument(
+        "--check-typed-core",
+        action="store_true",
+        help="stdlib typed-core signature check (make mypy fallback)",
+    )
+    args = ap.parse_args(argv)
+
+    if args.check_typed_core:
+        from .typed_core import check_typed_core
+
+        problems = check_typed_core()
+        for p in problems:
+            print(p)
+        print(
+            f"typed-core: {len(problems)} problem(s)"
+            if problems
+            else "typed-core: signatures complete"
+        )
+        return 1 if problems else 0
+
+    if args.list_rules:
+        for name in sorted(RULES):
+            r = RULES[name]
+            print(f"{r.code}  {r.name:18s} {r.description}")
+        return 0
+
+    cfg = pyproject_defaults()
+    if args.rules:
+        rules: Optional[List[str]] = [r for r in args.rules.split(",") if r]
+    else:
+        rules = cfg.get("rules") or None
+    paths = args.paths or cfg.get("paths") or ["opensim_tpu"]
+    findings = lint_paths(paths, rules=rules)
+    print(render_json(findings) if args.json else render_human(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

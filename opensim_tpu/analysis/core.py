@@ -1,0 +1,277 @@
+"""opensim-lint engine: rule registry, per-file AST walk, suppression.
+
+The analyzer is the Python/JAX analogue of the `go vet` + race-detector
+gate the reference's vendored kube-scheduler ships under: a small set of
+repo-specific rules for the bug classes the tier-1 tests cannot see until
+they bite on TPU — host work leaking into jit-traced code, dtype drift off
+the Go int64/float32 parity contract, iteration-order nondeterminism in
+encoder/fingerprint streams, in-place mutation of fingerprinted objects,
+and swallowed exceptions.
+
+Suppression syntax (pylint-style, checked on the finding's line and on a
+standalone comment line directly above it):
+
+    do_risky_thing()  # opensim-lint: disable=jit-boundary
+    # opensim-lint: disable=determinism,cache-mutation
+    next_line_is_exempt()
+
+File-level (anywhere in the first 10 lines):
+
+    # opensim-lint: disable-file=dtype-drift
+
+``disable=all`` suppresses every rule. Rules are addressed by short name
+(``jit-boundary``) or code (``OSL101``).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding",
+    "FileContext",
+    "Rule",
+    "RULES",
+    "register",
+    "lint_source",
+    "lint_paths",
+    "render_human",
+    "render_json",
+]
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: rule identity + location + message."""
+
+    rule: str  # short name, e.g. "jit-boundary"
+    code: str  # stable id, e.g. "OSL101"
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "code": self.code,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+
+@dataclass
+class FileContext:
+    """Parsed source handed to each rule (one parse per file)."""
+
+    path: str  # display path (as given / repo-relative)
+    source: str
+    tree: ast.Module
+    lines: List[str]
+
+
+class Rule:
+    """Base class: subclasses set ``name``/``code`` and implement ``check``.
+
+    ``paths`` restricts the rule to files whose normalized path contains one
+    of the fragments (empty = every file); ``exclude_paths`` wins over
+    ``paths``."""
+
+    name: str = ""
+    code: str = ""
+    description: str = ""
+    paths: Tuple[str, ...] = ()
+    exclude_paths: Tuple[str, ...] = ()
+
+    def applies_to(self, path: str) -> bool:
+        p = path.replace(os.sep, "/")
+        if any(frag in p for frag in self.exclude_paths):
+            return False
+        return not self.paths or any(frag in p for frag in self.paths)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            rule=self.name,
+            code=self.code,
+            path=ctx.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+        )
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator adding a rule (by short name) to the registry."""
+    rule = cls()
+    if not rule.name or not rule.code:
+        raise ValueError(f"rule {cls.__name__} needs name and code")
+    RULES[rule.name] = rule
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(r"#\s*opensim-lint:\s*(disable(?:-file)?)\s*=\s*([A-Za-z0-9_,\- ]+)")
+
+
+def _suppressions(lines: List[str]) -> Tuple[Dict[int, set], set]:
+    """(per-line rule sets keyed by 1-based line, file-level rule set).
+
+    A standalone suppression comment (nothing but the comment on its line)
+    also covers the next line, so fixes can keep long lines intact."""
+    per_line: Dict[int, set] = {}
+    file_level: set = set()
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        names = {n.strip().lower() for n in m.group(2).split(",") if n.strip()}
+        if m.group(1) == "disable-file":
+            if i <= 10:
+                file_level |= names
+            continue
+        per_line.setdefault(i, set()).update(names)
+        if text.lstrip().startswith("#"):
+            per_line.setdefault(i + 1, set()).update(names)
+    return per_line, file_level
+
+
+def _suppressed(f: Finding, per_line: Dict[int, set], file_level: set) -> bool:
+    for names in (file_level, per_line.get(f.line, ())):
+        if not names:
+            continue
+        lowered = {f.rule.lower(), f.code.lower()}
+        if "all" in names or (lowered & set(names)):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# engine
+# ---------------------------------------------------------------------------
+
+
+def _select_rules(rules: Optional[Sequence[str]]) -> List[Rule]:
+    if rules is None:
+        return list(RULES.values())
+    out = []
+    by_code = {r.code.lower(): r for r in RULES.values()}
+    for name in rules:
+        key = name.strip().lower()
+        rule = RULES.get(key) or by_code.get(key)
+        if rule is None:
+            raise KeyError(f"unknown rule {name!r}; known: {sorted(RULES)}")
+        out.append(rule)
+    return out
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    rules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Lint one source string (the unit tests' entry point)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="parse-error",
+                code="OSL000",
+                path=path,
+                line=e.lineno or 1,
+                col=e.offset or 0,
+                message=f"syntax error: {e.msg}",
+            )
+        ]
+    lines = source.splitlines()
+    ctx = FileContext(path=path, source=source, tree=tree, lines=lines)
+    per_line, file_level = _suppressions(lines)
+    findings: List[Finding] = []
+    for rule in _select_rules(rules):
+        if not rule.applies_to(path):
+            continue
+        for f in rule.check(ctx):
+            if not _suppressed(f, per_line, file_level):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+def _iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(d for d in dirnames if d not in ("__pycache__", ".git"))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def lint_paths(
+    paths: Sequence[str],
+    rules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Lint files/directories; directories are walked for ``.py`` files."""
+    findings: List[Finding] = []
+    for fpath in _iter_py_files(paths):
+        with open(fpath, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        findings.extend(lint_source(source, path=fpath, rules=rules))
+    return findings
+
+
+def render_human(findings: List[Finding]) -> str:
+    lines = [
+        f"{f.path}:{f.line}:{f.col + 1}: {f.code} [{f.rule}] {f.message}" for f in findings
+    ]
+    lines.append(
+        f"opensim-lint: {len(findings)} finding(s)" if findings else "opensim-lint: clean"
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: List[Finding]) -> str:
+    return json.dumps([f.as_dict() for f in findings], indent=2)
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers for the rule modules
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.lax.scan' for nested Attribute/Name chains, '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents

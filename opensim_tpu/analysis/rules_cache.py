@@ -1,0 +1,190 @@
+"""cache-mutation (OSL401): in-place mutation after fingerprinting.
+
+The NOTES.md hazard class the fuzzers keep re-finding: ``PrepareCache``
+keys entries by content fingerprint, and fingerprints hash object identity
++ version — so editing an already-fingerprinted object in place leaves a
+stale cache entry serving results for a cluster that no longer exists.
+
+Within one function, after a name is passed to ``fingerprint_cluster`` /
+``fingerprint_apps`` / ``simulate_cached``, this rule flags:
+
+- attribute/subscript assignment rooted at that name
+  (``cluster.pods[0].phase = ...``);
+- mutator-method calls rooted at it (``cluster.pods.append(...)``);
+- the same two through a loop variable drawn from it
+  (``for p in cluster.pods: p.metadata.labels[...] = ...``).
+
+The escape hatch IS the fix: call ``cache.invalidate(obj)`` (or bump the
+object with ``obj.touch()``) after mutating — a later call naming the
+mutated object (directly or through a loop alias) clears that object's
+findings; an argument-less ``cache.invalidate()`` clears everything.
+Analysis is per-function: nested functions get their own scope (a closure
+mutating an outer fingerprinted name is outside the rule's reach).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import FileContext, Finding, Rule, dotted_name, register
+
+_FINGERPRINT_CALLS = {
+    "fingerprint_cluster": 1,
+    "fingerprint_apps": 1,
+    "simulate_cached": 2,  # (cluster, apps, cache)
+}
+_MUTATORS = {
+    "append",
+    "extend",
+    "insert",
+    "add",
+    "update",
+    "setdefault",
+    "pop",
+    "popitem",
+    "remove",
+    "discard",
+    "clear",
+    "sort",
+    "reverse",
+}
+_RELEASE_ATTRS = {"invalidate", "touch"}
+
+
+_FuncLike = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _walk_scope(fn: ast.AST):
+    """ast.walk that stays inside one function scope: nested function
+    definitions are not descended into (each gets its own check pass)."""
+    stack = [fn]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FuncLike):
+                continue
+            stack.append(child)
+
+
+def _root_name(node: ast.AST) -> str:
+    """Leftmost dotted root of an attribute/subscript chain: the chain
+    ``cluster.pods[0].phase`` roots at ``cluster``; ``self.base.pods`` roots
+    at ``self.base`` (two segments, so methods can track self attributes)."""
+    parts: List[str] = []
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return ""
+    if node.id == "self" and parts:
+        return f"self.{parts[-1]}"
+    return node.id
+
+
+@register
+class CacheMutationRule(Rule):
+    name = "cache-mutation"
+    code = "OSL401"
+    description = "in-place mutation of a fingerprinted object"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(ctx, fn)
+
+    def _check_function(self, ctx: FileContext, fn: ast.AST) -> Iterable[Finding]:
+        # pass 1 (line-ordered events): fingerprints, releases, loop aliases
+        fingerprinted: Dict[str, int] = {}  # name -> first fingerprint line
+        # (line, released root or None=wildcard): .touch() releases its
+        # receiver, .invalidate(x) releases x, .invalidate() releases all
+        releases: List[Tuple[int, Optional[str]]] = []
+        aliases: List[Tuple[str, str]] = []  # (loop var, source root)
+        for node in _walk_scope(fn):
+            if isinstance(node, ast.Call):
+                callee = dotted_name(node.func)
+                short = callee.rsplit(".", 1)[-1]
+                nargs = _FINGERPRINT_CALLS.get(short)
+                if nargs:
+                    for arg in node.args[:nargs]:
+                        root = _root_name(arg)
+                        if root:
+                            line = getattr(node, "lineno", 0)
+                            fingerprinted.setdefault(root, line)
+                            fingerprinted[root] = min(fingerprinted[root], line)
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _RELEASE_ATTRS
+                ):
+                    line = getattr(node, "lineno", 0)
+                    if node.func.attr == "touch":
+                        releases.append((line, _root_name(node.func.value) or None))
+                    elif node.args:
+                        releases.append((line, _root_name(node.args[0]) or None))
+                    else:
+                        releases.append((line, None))
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                src = _root_name(node.iter)
+                if src and isinstance(node.target, ast.Name):
+                    aliases.append((node.target.id, src))
+        if not fingerprinted:
+            return
+
+        def resolve(root: str) -> str:
+            """Chase loop aliases until a fingerprinted name (or dead end)."""
+            seen: Set[str] = set()
+            while root and root not in seen:
+                seen.add(root)
+                if root in fingerprinted:
+                    return root
+                for var, src in aliases:
+                    if var == root:
+                        root = src
+                        break
+                else:
+                    break
+            return ""
+
+        def tracked(root: str) -> Tuple[str, int]:
+            """(fingerprinted name, fingerprint line) or ('', 0)."""
+            name = resolve(root)
+            return (name, fingerprinted[name]) if name else ("", 0)
+
+        def released_after(line: int, name: str) -> bool:
+            return any(
+                rl >= line and (root is None or root == name or resolve(root) == name)
+                for rl, root in releases
+            )
+
+        # pass 2: mutations on tracked roots after their fingerprint line
+        for node in _walk_scope(fn):
+            line = getattr(node, "lineno", 0)
+            targets: List[ast.AST] = []
+            verb = ""
+            if isinstance(node, ast.Assign):
+                targets, verb = list(node.targets), "assignment to"
+            elif isinstance(node, ast.AugAssign):
+                targets, verb = [node.target], "augmented assignment to"
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS
+            ):
+                targets, verb = [node.func.value], f"`.{node.func.attr}()` on"
+            for tgt in targets:
+                if isinstance(tgt, ast.Name) and not isinstance(node, ast.Call):
+                    continue  # rebinding a local is not a mutation
+                root = _root_name(tgt)
+                name, fp_line = tracked(root)
+                if not name or line < fp_line or released_after(line, name):
+                    continue
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"{verb} `{ast.unparse(tgt)}` mutates `{name}` after it "
+                    "was fingerprinted; the cache entry is now stale — call "
+                    "PrepareCache.invalidate(obj) or obj.touch() "
+                    "(docs/static-analysis.md#cache-mutation)",
+                )

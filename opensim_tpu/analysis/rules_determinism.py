@@ -1,0 +1,126 @@
+"""determinism (OSL301): iteration-order nondeterminism on ordered paths.
+
+Two patterns that break run-to-run reproducibility (encoder streams must be
+byte-stable so content fingerprints and golden reports hold):
+
+- iterating a ``set`` (literal, comprehension, ``set()``/``frozenset()``
+  call) without ``sorted(...)`` — set order varies with PYTHONHASHSEED;
+- inside a fingerprint/hash-building function (one that feeds a hasher
+  constructed from ``hashlib.*`` via ``.update``), iterating
+  ``.items()`` / ``.keys()`` / ``.values()`` without ``sorted(...)``:
+  dict order is insertion order, which for hand-assembled clusters is
+  call-site dependent — a fingerprint must not depend on it.
+
+Plain dict iteration outside hash scopes is NOT flagged (insertion order
+is deterministic for a fixed build path).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from .core import FileContext, Finding, Rule, dotted_name, parent_map, register
+
+_DICT_VIEWS = {"items", "keys", "values"}
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and dotted_name(node.func) in ("set", "frozenset"):
+        return True
+    return False
+
+
+def _inside_sorted(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> bool:
+    cur = parents.get(node)
+    while cur is not None:
+        # NOTE: `sum` is deliberately NOT exempt — float addition is
+        # non-associative, so summing a set varies in the last ulp with
+        # iteration order (enough to flip score ties in this repo)
+        if isinstance(cur, ast.Call) and dotted_name(cur.func) in ("sorted", "min", "max", "len", "any", "all"):
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return False
+        cur = parents.get(cur)
+    return False
+
+
+@register
+class DeterminismRule(Rule):
+    name = "determinism"
+    code = "OSL301"
+    description = "unordered iteration feeding an order-sensitive stream"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        parents = parent_map(ctx.tree)
+
+        # -- set iteration anywhere -----------------------------------------
+        iter_sites: List[ast.AST] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iter_sites.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.DictComp, ast.SetComp)):
+                iter_sites.extend(gen.iter for gen in node.generators)
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Attribute) and fn.attr == "join" and node.args:
+                    iter_sites.append(node.args[0])
+                # `sum` qualifies: float addition is non-associative, so a
+                # set's iteration order moves the result in the last ulp
+                elif dotted_name(fn) in ("list", "tuple", "enumerate", "sum") and node.args:
+                    iter_sites.append(node.args[0])
+        for site in iter_sites:
+            if _is_set_expr(site) and not _inside_sorted(site, parents):
+                yield self.finding(
+                    ctx,
+                    site,
+                    "iteration over a set is ordered by PYTHONHASHSEED; wrap "
+                    "in sorted(...) before it feeds an ordered stream",
+                )
+
+        # -- dict views inside hash-building functions ----------------------
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not self._is_hash_builder(fn):
+                continue
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _DICT_VIEWS
+                    and not node.args
+                    and not _inside_sorted(node, parents)
+                ):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"`.{node.func.attr}()` order feeds a content "
+                        f"fingerprint in `{fn.name}`; wrap in sorted(...) so "
+                        "the hash is independent of dict build order",
+                    )
+
+    @staticmethod
+    def _is_hash_builder(fn: ast.AST) -> bool:
+        """Function constructs a hasher from hashlib.* and .update()s it."""
+        hasher_names: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if dotted_name(node.value.func).startswith("hashlib."):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            hasher_names.add(tgt.id)
+        if not hasher_names:
+            return False
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "update"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in hasher_names
+            ):
+                return True
+        return False

@@ -1,0 +1,74 @@
+"""dtype-drift (OSL201): encoder arrays off the Go parity dtype policy.
+
+The encoded cluster must match the vendored Go scheduler's arithmetic:
+resource math is float32 (scores are compared bit-exactly against the
+serial oracle) and ids/indices are int32. Bare ``np.float64`` or a
+default-dtype constructor silently widens an array — XLA then inserts
+converts, and score ties can flip relative to the Go baseline.
+
+Every float/int array in ``encoding/`` must name its dtype, and the only
+place ``np.float64`` may appear is ``encoding/dtypes.py`` — the module
+that defines the policy (float64 is legal there only as the documented
+log-table accumulation dtype).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import FileContext, Finding, Rule, dotted_name, register
+
+# constructor -> positional arity at which the dtype is already explicit
+_CONSTRUCTOR_DTYPE_ARITY = {
+    "np.zeros": 2,
+    "np.ones": 2,
+    "np.empty": 2,
+    "np.full": 3,
+    "numpy.zeros": 2,
+    "numpy.ones": 2,
+    "numpy.empty": 2,
+    "numpy.full": 3,
+    # arange/array infer from operands — require the kwarg always
+    "np.arange": 99,
+    "np.array": 99,
+    "numpy.arange": 99,
+    "numpy.array": 99,
+}
+
+_FLOAT64_NAMES = {"np.float64", "numpy.float64"}
+
+
+@register
+class DtypeDriftRule(Rule):
+    name = "dtype-drift"
+    code = "OSL201"
+    description = "encoder array without the explicit Go-parity dtype"
+    paths = ("opensim_tpu/encoding/",)
+    exclude_paths = ("opensim_tpu/encoding/dtypes.py",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Attribute) and dotted_name(node) in _FLOAT64_NAMES:
+                yield self.finding(
+                    ctx,
+                    node,
+                    "bare np.float64 in an encoder path; use the policy "
+                    "constants in opensim_tpu/encoding/dtypes.py (Go "
+                    "int64/float32 parity)",
+                )
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                arity = _CONSTRUCTOR_DTYPE_ARITY.get(name)
+                if arity is None:
+                    continue
+                if len(node.args) >= arity:
+                    continue
+                if any(kw.arg == "dtype" for kw in node.keywords):
+                    continue
+                yield self.finding(
+                    ctx,
+                    node,
+                    f"`{name}` without an explicit dtype defaults to float64/"
+                    "platform-int; name the dtype (see encoding/dtypes.py)",
+                )

@@ -1,0 +1,180 @@
+"""jit-boundary (OSL101): host-side work inside jit-traced code.
+
+Finds functions reachable from a ``jax.jit`` / ``jax.lax`` / ``pallas_call``
+tracing entry point in the same file, and flags constructs that run on the
+HOST at trace time (or fail outright under a tracer):
+
+- calls into ``time.*`` / ``random.*`` / ``np.random.*`` / ``datetime.now``
+  — they execute once at trace time and bake a constant into the program;
+- ``.item()`` — forces a device sync and breaks under jit;
+- ``np.asarray`` / ``np.array`` on a function parameter — parameters of a
+  traced function are tracers, and numpy coercion forces a host transfer;
+- ``if`` / ``while`` whose test calls ``jnp.*`` / ``lax.*`` — Python
+  control flow on a traced boolean raises ConcretizationTypeError.
+
+Reachability is per-file (simple-name call graph); cross-module tracing is
+out of scope and documented in docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from .core import FileContext, Finding, Rule, dotted_name, register
+
+# decorators / callables whose function argument is traced
+_JIT_NAMES = {"jax.jit", "jit"}
+_TRACING_CALLS = _JIT_NAMES | {
+    "jax.vmap",
+    "vmap",
+    "jax.pmap",
+    "pmap",
+    "jax.checkpoint",
+    "jax.lax.scan",
+    "lax.scan",
+    "jax.lax.cond",
+    "lax.cond",
+    "jax.lax.while_loop",
+    "lax.while_loop",
+    "jax.lax.fori_loop",
+    "lax.fori_loop",
+    "jax.lax.switch",
+    "lax.switch",
+    "jax.lax.map",
+    "lax.map",
+    "pl.pallas_call",
+    "pallas_call",
+}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+_HOST_CALL_PREFIXES = (
+    "time.",
+    "random.",
+    "np.random.",
+    "numpy.random.",
+    "datetime.datetime.now",
+    "datetime.now",
+)
+
+_FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if dotted_name(dec) in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        fn = dotted_name(dec.func)
+        if fn in _JIT_NAMES:
+            return True
+        if fn in _PARTIAL_NAMES:
+            return any(dotted_name(a) in _JIT_NAMES for a in dec.args)
+    return False
+
+
+def _traced_value_call(node: ast.AST) -> bool:
+    """Does the expression contain a call into jnp./lax. (a traced value)?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            fn = dotted_name(sub.func)
+            if fn.startswith(("jnp.", "jax.numpy.", "lax.", "jax.lax.")):
+                return True
+    return False
+
+
+@register
+class JitBoundaryRule(Rule):
+    name = "jit-boundary"
+    code = "OSL101"
+    description = "host-side work inside jit-traced code"
+    paths = ("opensim_tpu/engine/", "opensim_tpu/ops/", "opensim_tpu/parallel/")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        defs: Dict[str, List[ast.AST]] = {}
+        all_funcs: List[ast.AST] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, _FuncNode):
+                defs.setdefault(node.name, []).append(node)
+                all_funcs.append(node)
+
+        roots: Set[ast.AST] = set()
+        for node in all_funcs:
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                roots.add(node)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and dotted_name(node.func) in _TRACING_CALLS:
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if isinstance(arg, ast.Name):
+                        roots.update(defs.get(arg.id, ()))
+                    elif isinstance(arg, ast.Lambda):
+                        roots.add(arg)
+                    elif isinstance(arg, _FuncNode):
+                        roots.add(arg)
+
+        # propagate through the same-file simple-name call graph
+        reachable: Set[ast.AST] = set(roots)
+        frontier = list(roots)
+        while frontier:
+            fn = frontier.pop()
+            body = fn.body if isinstance(fn, _FuncNode) else [fn.body]
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name):
+                        for callee in defs.get(sub.func.id, ()):
+                            if callee not in reachable:
+                                reachable.add(callee)
+                                frontier.append(callee)
+
+        for fn in sorted(reachable, key=lambda n: getattr(n, "lineno", 0)):
+            yield from self._check_traced_function(ctx, fn)
+
+    def _check_traced_function(self, ctx: FileContext, fn: ast.AST) -> Iterable[Finding]:
+        if isinstance(fn, _FuncNode):
+            params = {a.arg for a in fn.args.args + fn.args.posonlyargs + fn.args.kwonlyargs}
+            body = fn.body
+            label = fn.name
+        else:  # Lambda
+            params = {a.arg for a in fn.args.args}
+            body = [fn.body]
+            label = "<lambda>"
+
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    name = dotted_name(node.func)
+                    if name.startswith(_HOST_CALL_PREFIXES):
+                        yield self.finding(
+                            ctx,
+                            node,
+                            f"host-side call `{name}` inside jit-traced `{label}` "
+                            "executes once at trace time (stale clock/PRNG baked "
+                            "into the compiled program)",
+                        )
+                    elif (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item"
+                        and not node.args
+                    ):
+                        yield self.finding(
+                            ctx,
+                            node,
+                            f"`.item()` inside jit-traced `{label}` forces a host "
+                            "sync and fails on tracers; keep the value on device",
+                        )
+                    elif name in ("np.asarray", "np.array", "numpy.asarray", "numpy.array"):
+                        if node.args and isinstance(node.args[0], ast.Name) and node.args[0].id in params:
+                            yield self.finding(
+                                ctx,
+                                node,
+                                f"`{name}` on parameter `{node.args[0].id}` of "
+                                f"jit-traced `{label}` coerces a tracer to host "
+                                "numpy (transfer or ConcretizationTypeError)",
+                            )
+                elif isinstance(node, (ast.If, ast.While)) and _traced_value_call(node.test):
+                    kw = "if" if isinstance(node, ast.If) else "while"
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"Python `{kw}` on a traced value inside `{label}`; use "
+                        "jnp.where / lax.cond / lax.while_loop instead",
+                    )

@@ -1,0 +1,40 @@
+"""Encoder dtype policy — the single place width decisions live.
+
+The vendored Go scheduler does resource math in float32-comparable space
+and keys everything else by integer id, and the differential oracle
+compares scores bit-exactly. Every array the encoder builds therefore
+names its dtype from here; ``opensim-lint``'s dtype-drift rule (OSL201)
+flags any encoder-path array that doesn't.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: All resource/score/weight tensors. Go parity: float32 end to end — a
+#: float64 leak makes XLA insert converts and can flip score ties.
+FLOAT_DTYPE = np.float32
+
+#: All id/index tensors (template ids, vocab ids, domain ids, node indices).
+INT_DTYPE = np.int32
+
+#: Quantities that must round-trip Go int64 exactly (resourceVersion,
+#: replica counts) stay host-side Python ints; when they must enter an
+#: array, this is the dtype.
+INT64_DTYPE = np.int64
+
+#: Accumulation dtype for the log(k+2) topology-spread weight table — the
+#: one sanctioned float64 in the encoder. The table is computed in float64
+#: and cast to FLOAT_DTYPE so the XLA scan, the numpy precompute and the
+#: sweeps gather bitwise-identical weights (XLA:CPU's f32 log and numpy's
+#: differ by 1 ulp on ~3% of inputs, enough to flip score ties).
+LOG_ACC_DTYPE = np.float64
+
+
+def log_size_table(n: int) -> np.ndarray:
+    """The shared [n+1] float32 log(k+2) lookup (see LOG_ACC_DTYPE).
+
+    Used by the encoder (encoding/state.py) and by checkpoint loading
+    (utils/checkpoint.py) for pre-log_sizes checkpoints — both must produce
+    the same bits for the same node count."""
+    return np.log(np.arange(n + 1, dtype=LOG_ACC_DTYPE) + 2.0).astype(FLOAT_DTYPE)

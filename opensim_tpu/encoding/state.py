@@ -30,6 +30,7 @@ import numpy as np
 
 from ..models.objects import Node, Pod
 from . import vocab as V
+from .dtypes import log_size_table
 from .templates import SchedTemplate, TemplateSet
 
 _NAN = float("nan")
@@ -155,7 +156,7 @@ def _pad_to(n: int, mult: int) -> int:
     return max(mult, mult * math.ceil(n / mult))
 
 
-def _grown(a: np.ndarray, shape: Tuple[int, ...], fill) -> np.ndarray:
+def _grown(a: np.ndarray, shape: Tuple[int, ...], fill: object) -> np.ndarray:
     """Re-allocate `a` at `shape`, copying the existing prefix block and
     filling the rest with `fill` (axis growth for delta re-encoding)."""
     out = np.full(shape, fill, dtype=a.dtype)
@@ -316,7 +317,9 @@ class ClusterEncoder:
 
     # -- node-affinity term encoding helper ---------------------------------
 
-    def _encode_terms(self, terms: List[dict], T: int, Q: int, Vv: int):
+    def _encode_terms(
+        self, terms: List[dict], T: int, Q: int, Vv: int
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         vb = self.vocab
         valid = np.zeros((T,), dtype=bool)
         key = np.full((T, Q), -1, dtype=np.int32)
@@ -866,9 +869,7 @@ class ClusterEncoder:
             node_vg_cap=node_vg_cap,
             node_dev_cap=node_dev_cap,
             node_dev_media=node_dev_media,
-            log_sizes=np.log(np.arange(N + 1, dtype=np.float64) + 2.0).astype(
-                np.float32
-            ),
+            log_sizes=log_size_table(N),
         )
 
         state0 = ScanState(

@@ -35,19 +35,26 @@ import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Callable, List, Optional, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np
 
-from ..encoding.state import EncodedCluster, ScanState
+from ..encoding.state import ClusterEncoder, EncodedCluster, ScanState
 from ..models import expand
-from ..models.objects import ANNO_WORKLOAD_KIND, LABEL_APP_NAME, ResourceTypes
+from ..models.objects import (
+    ANNO_WORKLOAD_KIND,
+    LABEL_APP_NAME,
+    Pod,
+    ResourceTypes,
+    touch_epoch,
+)
 from ..utils.trace import PREP_STATS
 from . import queues
 from .simulator import (
     AppResource,
     Prepared,
+    SimulateResult,
     _owner_selector,
     _tmpl_hint,
     pinned_node_name,
@@ -63,7 +70,7 @@ from ..ops import kernels
 # ---------------------------------------------------------------------------
 
 
-def _meta_rv(obj) -> str:
+def _meta_rv(obj: object) -> str:
     raw = getattr(obj, "raw", None) or {}
     return str((raw.get("metadata") or {}).get("resourceVersion", ""))
 
@@ -139,6 +146,62 @@ def fingerprint_apps(apps: List[AppResource]) -> str:
 # ---------------------------------------------------------------------------
 
 
+class StaleFingerprintError(RuntimeError):
+    """A cache hit landed on an entry whose watched object was ``touch()``ed
+    after the entry was fingerprinted — the cached encoding no longer
+    matches the object's content. Fix: ``cache.invalidate(obj)`` after the
+    mutation (see models.objects.VersionedObject and
+    docs/static-analysis.md#cache-mutation). ``obj`` carries the offending
+    object so the cache can evict everything it taints."""
+
+    def __init__(self, message: str, obj: Optional[object] = None) -> None:
+        super().__init__(message)
+        self.obj = obj
+
+
+def _watched_objects(cluster: ResourceTypes, apps: List[AppResource]) -> List[object]:
+    """Every model object a (cluster, apps) fingerprint covers — the set
+    the stale-entry guard watches for version bumps."""
+    out: List[object] = []
+    rts = [cluster] + [a.resources for a in apps]
+    for rt in rts:
+        out.extend(rt.nodes)
+        out.extend(rt.pods)
+        out.extend(rt.deployments)
+        out.extend(rt.replica_sets)
+        out.extend(rt.stateful_sets)
+        out.extend(rt.daemon_sets)
+        out.extend(rt.jobs)
+        out.extend(rt.cron_jobs)
+        # RawObject kinds are versioned too: they don't enter the content
+        # fingerprint, but the touch()/invalidate(obj) protocol must hold
+        # uniformly for every model object a cluster carries
+        out.extend(rt.services)
+        out.extend(rt.pdbs)
+        out.extend(rt.storage_classes)
+        out.extend(rt.pvcs)
+        out.extend(rt.config_maps)
+    return out
+
+
+#: (watched (object, version) pairs, touch epoch) — both captured at
+#: FINGERPRINT time, i.e. before the (possibly seconds-long) prepare runs,
+#: so a touch()+invalidate() landing during the build is not lost: the
+#: entry records pre-build versions and an epoch older than the touch,
+#: forcing the next check_fresh to scan and catch it.
+WatchSnapshot = Tuple[List[Tuple[object, int]], int]
+
+
+def watch_snapshot(cluster: ResourceTypes, apps: List[AppResource]) -> WatchSnapshot:
+    """Capture the stale-guard baseline for a (cluster, apps) pair. The
+    epoch is read BEFORE the versions: a touch interleaving between the
+    two reads then leaves the entry's epoch behind the global one, which
+    forces a full version scan on the next check_fresh."""
+    epoch = touch_epoch()
+    pairs = [(o, getattr(o, "_local_version", 0)) for o in _watched_objects(cluster, apps)]
+    return pairs, epoch
+
+
 @dataclass
 class CacheStats:
     hits: int = 0
@@ -162,17 +225,64 @@ class CacheEntry:
     tensors. Entries derived from a base share the base's lock — their pod
     streams alias the same objects."""
 
-    def __init__(self, key: str, prep: Optional[Prepared], base: Optional["CacheEntry"] = None):
+    def __init__(
+        self,
+        key: str,
+        prep: Optional[Prepared],
+        base: Optional["CacheEntry"] = None,
+        watch: Optional[WatchSnapshot] = None,
+    ) -> None:
         self.key = key
         self.prep = prep
         self.base = base
         self.lock = base.lock if base is not None else threading.RLock()
         self.bind_snap = snapshot_bind_state(prep) if prep is not None else []
-        self._dev_map = None
+        self._dev_map: Optional[dict] = None
+        # (object, local_version at fingerprint time) — the stale-entry
+        # guard; see VersionedObject (models/objects.py) and
+        # watch_snapshot(). Derived entries share the base's list: their
+        # stream aliases the same objects, and the base was proven fresh
+        # before the delta was built.
+        if watch is None and base is not None:
+            self.watched: List[Tuple[object, int]] = base.watched
+            self._touch_epoch = base._touch_epoch
+        elif watch is not None:
+            self.watched, self._touch_epoch = watch
+        else:
+            self.watched, self._touch_epoch = [], touch_epoch()
 
     def restore(self) -> None:
         if self.prep is not None:
             restore_bind_state(self.prep, self.bind_snap)
+
+    def watches(self, obj: object) -> bool:
+        return any(o is obj for o, _ in self.watched)
+
+    def check_fresh(self) -> None:
+        """Raise StaleFingerprintError if any watched object was touched
+        since this entry was fingerprinted.
+
+        Fast path: ``touch()`` bumps a process-global epoch, so when no
+        object anywhere was touched since this entry (the steady state)
+        this is one integer compare, not an O(watched) scan. A clean scan
+        re-arms the fast path at the current epoch."""
+        epoch = touch_epoch()
+        if epoch == self._touch_epoch:
+            return
+        for obj, v0 in self.watched:
+            v1 = getattr(obj, "_local_version", 0)
+            if v1 != v0:
+                kind = getattr(obj, "kind", type(obj).__name__)
+                meta = getattr(obj, "metadata", None)
+                name = getattr(meta, "name", "?") if meta is not None else "?"
+                raise StaleFingerprintError(
+                    f"cached prepare is stale: {kind} {name!r} was touch()ed "
+                    f"(version {v1} vs {v0} at fingerprint time) without cache "
+                    "invalidation; call cache.invalidate(obj) after mutating "
+                    "a fingerprinted object (docs/static-analysis.md#cache-mutation)",
+                    obj=obj,
+                )
+        self._touch_epoch = epoch
 
     def dev_map(self) -> dict:
         """{id(numpy leaf): device leaf} over the entry's EncodedCluster —
@@ -216,16 +326,44 @@ class PrepareCache:
                 self.stats.evictions += 1
             return entry
 
-    def invalidate(self, prefix: str = "") -> int:
-        """Drop entries whose key starts with `prefix` ('' = all); returns
-        the number dropped. The REST server calls this when the live
-        snapshot's fingerprint changes."""
+    def invalidate(self, target: Union[str, object] = "") -> int:
+        """Drop cache entries; returns the number dropped.
+
+        - ``invalidate()`` — everything;
+        - ``invalidate(prefix)`` — entries whose key starts with ``prefix``
+          (the REST server's path when the live snapshot's fingerprint
+          changes);
+        - ``invalidate(obj)`` — entries whose fingerprint covered the model
+          object ``obj`` (by identity): THE call to make after mutating an
+          already-fingerprinted Pod/Node/Workload in place, closing the
+          NOTES.md in-place-mutation envelope. Pair with ``obj.touch()`` so
+          a forgotten invalidation fails loudly (StaleFingerprintError)
+          instead of serving stale placements."""
         with self._lock:
-            doomed = [k for k in self._entries if k.startswith(prefix)]
+            if isinstance(target, str):
+                doomed = [k for k in self._entries if k.startswith(target)]
+            else:
+                doomed = [k for k, e in self._entries.items() if e.watches(target)]
             for k in doomed:
                 del self._entries[k]
             self.stats.invalidations += len(doomed)
             return len(doomed)
+
+    def check_fresh(self, entry: CacheEntry) -> None:
+        """Entry freshness check that also EVICTS on staleness: once an
+        entry is proven stale it can never become fresh again, so leaving
+        it cached would turn every later hit on its key into the same
+        error (a REST client has no way to call invalidate(obj)). Eviction
+        is by the offending OBJECT, dropping every entry it taints (e.g. a
+        REST base entry and its derived full-key entries share one watch
+        list) — recovery costs one failed request, not one per entry."""
+        try:
+            entry.check_fresh()
+        except StaleFingerprintError as e:
+            if e.obj is not None:
+                self.invalidate(e.obj)
+            self.invalidate(entry.key)
+            raise
 
     def __len__(self) -> int:
         with self._lock:
@@ -237,7 +375,9 @@ class PrepareCache:
 # ---------------------------------------------------------------------------
 
 
-def _to_device_reusing(ec_np: EncodedCluster, st0_np: ScanState, base_entry: Optional[CacheEntry]):
+def _to_device_reusing(
+    ec_np: EncodedCluster, st0_np: ScanState, base_entry: Optional[CacheEntry]
+) -> Tuple[EncodedCluster, ScanState]:
     """``scheduler.to_device`` with leaf reuse: tensors the delta shares
     with the cached base keep their device copies (no re-upload)."""
     dev_map = base_entry.dev_map() if base_entry is not None and base_entry.prep is not None else {}
@@ -250,10 +390,10 @@ def _to_device_reusing(ec_np: EncodedCluster, st0_np: ScanState, base_entry: Opt
 
 def _assemble_delta(
     base_entry: Optional[CacheEntry],
-    enc,
-    ordered,
-    tmpl_parts,
-    forced_parts,
+    enc: "ClusterEncoder",
+    ordered: List[Pod],
+    tmpl_parts: List[object],
+    forced_parts: List[object],
     n_cluster: int,
     n_bare: int,
     ds_group_sizes: List[int],
@@ -291,7 +431,7 @@ def _assemble_delta(
     )
 
 
-def _expand_app(cluster: ResourceTypes, app: AppResource, use_greed: bool):
+def _expand_app(cluster: ResourceTypes, app: AppResource, use_greed: bool) -> List[Pod]:
     """The exact app expansion pipeline of ``simulator._prepare_inner``."""
     app_pods = expand.generate_pods_from_resources(app.resources, cluster.nodes)
     for p in app_pods:
@@ -416,7 +556,9 @@ def extend_with_nodes(
     return prep
 
 
-def drop_mask_for_scaled(prep: Prepared, owned_by, scaled: set) -> np.ndarray:
+def drop_mask_for_scaled(
+    prep: Prepared, owned_by: Callable[[Pod, set], bool], scaled: set
+) -> np.ndarray:
     """Valid-mask flip for a scale request: mark the BARE cluster pods owned
     by the scaled workloads (the pods ``scale-apps`` removes from the
     snapshot before re-simulating). Only the bare prefix is eligible — the
@@ -440,11 +582,11 @@ def simulate_cached(
     *,
     use_greed: bool = False,
     node_pad: int = 128,
-    sched_config=None,
+    sched_config: Optional[object] = None,
     extra_plugins: tuple = (),
     tie_seed: Optional[int] = None,
     key: Optional[str] = None,
-):
+) -> "SimulateResult":
     """One full simulation through the encode cache: the first call for a
     (cluster, apps) content key pays the full prepare; every later call
     reuses the cached Prepared (fingerprint + bind-state restore — O(pods)
@@ -457,10 +599,14 @@ def simulate_cached(
     )
     entry = cache.get(full_key)
     if entry is None:
+        # baseline captured BEFORE the build: a touch()+invalidate() racing
+        # the prepare leaves this entry provably stale, not silently fresh
+        watch = watch_snapshot(cluster, apps)
         prep = prepare(cluster, apps, use_greed=use_greed, node_pad=node_pad)
-        entry = cache.put(full_key, CacheEntry(full_key, prep))
+        entry = cache.put(full_key, CacheEntry(full_key, prep, watch=watch))
     else:
         t0 = time.monotonic()
+        cache.check_fresh(entry)
         with entry.lock:
             entry.restore()
         PREP_STATS.record("hit", time.monotonic() - t0)

@@ -12,6 +12,7 @@ ConfigMaps.
 from __future__ import annotations
 
 import copy
+import threading as _threading
 import uuid as _uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -46,6 +47,46 @@ ANNO_GPU_ASSUME_TIME = "alibabacloud.com/assume-time"
 LABEL_GPU_CARD_MODEL = "alibabacloud.com/gpu-card-model"
 
 _counter = [0]
+
+
+class VersionedObject:
+    """Local mutation counter for the prepare-cache coherence protocol.
+
+    ``PrepareCache`` fingerprints hash object identity + version, NOT deep
+    content — so in-place edits of an already-fingerprinted object are
+    invisible to the cache (the NOTES.md envelope). The protocol:
+
+    1. mutate the object, then call ``obj.touch()`` — a cheap marker that
+       the content behind the fingerprint changed;
+    2. drop the stale entries with ``cache.invalidate(obj)``.
+
+    A cache hit on an entry whose watched object was touched without
+    invalidation raises ``StaleFingerprintError`` (engine/prepcache.py).
+    The static side of the same contract is opensim-lint's cache-mutation
+    rule (OSL401)."""
+
+    _local_version = 0  # class default: instances allocate on first touch
+    # process-global epoch: bumped on EVERY touch so cache freshness checks
+    # are one integer compare in the steady state (no touches anywhere)
+    # instead of an O(watched objects) version scan per cache hit. Lock-
+    # guarded: a lost increment would let an entry re-arm its fast path
+    # past a concurrent touch and silently serve a stale prepare.
+    _touch_epoch = [0]
+    _touch_lock = _threading.Lock()
+
+    def touch(self) -> None:
+        with VersionedObject._touch_lock:
+            self._local_version = self._local_version + 1
+            VersionedObject._touch_epoch[0] += 1
+
+    @property
+    def local_version(self) -> int:
+        return self._local_version
+
+
+def touch_epoch() -> int:
+    """Current global touch epoch (see VersionedObject.touch)."""
+    return VersionedObject._touch_epoch[0]
 
 
 def _rand_suffix(n: int = 10) -> str:
@@ -241,7 +282,7 @@ class PodSpec:
 
 
 @dataclass
-class Pod:
+class Pod(VersionedObject):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodSpec = field(default_factory=PodSpec)
     phase: str = ""
@@ -334,7 +375,7 @@ class Pod:
 
 
 @dataclass
-class Node:
+class Node(VersionedObject):
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     allocatable: Dict[str, float] = field(default_factory=dict)
     capacity: Dict[str, float] = field(default_factory=dict)
@@ -372,7 +413,7 @@ class Node:
 
 
 @dataclass
-class Workload:
+class Workload(VersionedObject):
     """Common shape for Deployment / ReplicaSet / StatefulSet / DaemonSet /
     Job / CronJob: metadata + pod template (+ replicas/completions)."""
 
@@ -426,7 +467,7 @@ class Workload:
 
 
 @dataclass
-class RawObject:
+class RawObject(VersionedObject):
     """Kinds carried through but not interpreted beyond a few fields:
     Service, PodDisruptionBudget, StorageClass, PersistentVolumeClaim,
     ConfigMap."""

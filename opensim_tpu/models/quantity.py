@@ -10,9 +10,10 @@ back for reports.
 from __future__ import annotations
 
 from fractions import Fraction
+from typing import Dict, Union
 
 # Binary SI (power-of-two) suffixes.
-_BINARY = {
+_BINARY: Dict[str, int] = {
     "Ki": 1024,
     "Mi": 1024**2,
     "Gi": 1024**3,
@@ -21,7 +22,7 @@ _BINARY = {
     "Ei": 1024**6,
 }
 # Decimal SI suffixes (note lowercase k, as in upstream).
-_DECIMAL = {
+_DECIMAL: Dict[str, Union[int, Fraction]] = {
     "n": Fraction(1, 10**9),
     "u": Fraction(1, 10**6),
     "m": Fraction(1, 1000),
@@ -35,7 +36,7 @@ _DECIMAL = {
 }
 
 
-def parse_quantity(value) -> float:
+def parse_quantity(value: object) -> float:
     """Parse a Kubernetes quantity (e.g. ``"1500m"``, ``"16Gi"``, ``2``) to a
     float in base units."""
     if value is None:
@@ -61,7 +62,7 @@ def parse_quantity(value) -> float:
     raise ValueError(f"unparseable quantity: {value!r}")
 
 
-def parse_quantity_milli(value) -> int:
+def parse_quantity_milli(value: object) -> int:
     """Parse to integer milli-units (the natural unit for CPU)."""
     return int(round(parse_quantity(value) * 1000))
 

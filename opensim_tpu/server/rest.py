@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -25,6 +26,8 @@ from typing import List, Optional
 from ..engine.simulator import AppResource, SimulateResult, simulate
 from ..models.objects import LABEL_APP_NAME, Node, ResourceTypes, object_from_dict
 from .snapshot import cluster_from_kubeconfig
+
+log = logging.getLogger("opensim_tpu.server")
 
 _deploy_lock = threading.Lock()
 _scale_lock = threading.Lock()
@@ -283,6 +286,7 @@ class SimonServer:
         # first request's names into later responses
         entry = self.prep_cache.get(full_key) if not new_nodes else None
         if entry is not None and entry.prep is not None:
+            self.prep_cache.check_fresh(entry)
             t0 = _time.monotonic()
             with entry.lock:
                 entry.restore()
@@ -300,12 +304,15 @@ class SimonServer:
         if base is None:
             from ..engine.simulator import prepare
 
+            watch = prepcache.watch_snapshot(cluster0, [])  # before the build
             base = self.prep_cache.put(
-                base_key, prepcache.CacheEntry(base_key, prepare(cluster0, []))
+                base_key,
+                prepcache.CacheEntry(base_key, prepare(cluster0, []), watch=watch),
             )
         if base.prep is None:
             # snapshot with no schedulable pods: nothing worth caching
             return simulate(_filtered(), apps)
+        self.prep_cache.check_fresh(base)
         with base.lock:
             base.restore()
             base_prep = base.prep
@@ -348,6 +355,7 @@ class SimonServer:
             METRICS.record("deploy-apps", result, time.monotonic() - t0)
             return 200, _response(result)
         except Exception as e:  # surface as 500 like gin's error handler
+            log.warning("deploy-apps failed: %s: %s", type(e).__name__, e)
             return 500, {"error": str(e)}
         finally:
             _deploy_lock.release()
@@ -367,6 +375,7 @@ class SimonServer:
             METRICS.record("scale-apps", result, time.monotonic() - t0)
             return 200, _response(result)
         except Exception as e:
+            log.warning("scale-apps failed: %s: %s", type(e).__name__, e)
             return 500, {"error": str(e)}
         finally:
             _scale_lock.release()
@@ -427,6 +436,7 @@ def make_handler(server: SimonServer):
                     port = start_profiler()
                     self._send(200, {"profiler": "running", "port": port, "ui": "tensorboard --logdir ... (trace viewer)"})
                 except Exception as e:
+                    log.warning("profiler start failed: %s: %s", type(e).__name__, e)
                     self._send(500, {"error": str(e)})
             else:
                 self._send(404, {"error": "not found"})

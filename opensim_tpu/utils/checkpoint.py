@@ -47,10 +47,9 @@ def load_state(path: str) -> Tuple[EncodedCluster, ScanState, dict]:
         if "gc_mask" not in fields:
             fields["gc_mask"] = np.zeros((fields["alloc"].shape[1],), dtype=bool)
         if "log_sizes" not in fields:
-            n = fields["alloc"].shape[0]
-            fields["log_sizes"] = np.log(
-                np.arange(n + 1, dtype=np.float64) + 2.0
-            ).astype(np.float32)
+            from ..encoding.dtypes import log_size_table
+
+            fields["log_sizes"] = log_size_table(fields["alloc"].shape[0])
         ec = EncodedCluster(**fields)
         st = ScanState(**{k[3:]: data[k] for k in data.files if k.startswith("st_")})
     return ec, st, meta.get("extra", {})

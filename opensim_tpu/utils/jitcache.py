@@ -55,6 +55,6 @@ def maybe_enable(default: bool = False, path: Optional[str] = None) -> Optional[
         # recompiles per (P, N, feature) signature and each one matters
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception:
-        pass  # pre-import usage: the env var alone is enough
+    except (ImportError, AttributeError, ValueError, KeyError):
+        pass  # pre-import usage / older jax without the knob: the env var alone is enough
     return cache_dir

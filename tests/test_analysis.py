@@ -1,0 +1,469 @@
+"""opensim-lint (opensim_tpu/analysis): each rule fires on a known-bad
+fixture, stays silent on the known-good twin, and honors the suppression
+syntax — plus the meta-test that the repo itself is lint-clean and the
+typed-core signature gate holds."""
+
+import os
+import textwrap
+
+from opensim_tpu.analysis import RULES, lint_paths, lint_source, render_human, render_json
+from opensim_tpu.analysis.typed_core import check_typed_core
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _codes(src, path="x.py", rules=None):
+    return [f.code for f in lint_source(textwrap.dedent(src), path=path, rules=rules)]
+
+
+# ---------------------------------------------------------------------------
+# OSL101 jit-boundary
+# ---------------------------------------------------------------------------
+
+JIT_PATH = "opensim_tpu/engine/fixture.py"  # rule is scoped to engine/ops/parallel
+
+
+def test_jit_boundary_fires_on_host_calls_in_traced_code():
+    src = """
+    import time, random, jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    @jax.jit
+    def step(x):
+        t = time.monotonic()          # host clock at trace time
+        y = np.asarray(x)             # tracer -> host numpy
+        v = x.sum().item()            # device sync
+        if jnp.any(x > 0):            # python control flow on tracer
+            x = x + 1
+        return x
+    """
+    codes = _codes(src, path=JIT_PATH, rules=["jit-boundary"])
+    assert codes == ["OSL101"] * 4
+
+
+def test_jit_boundary_reaches_through_call_graph_and_lax_entry_points():
+    src = """
+    import random, jax
+
+    def helper(c):
+        return c * random.random()
+
+    def body(carry, x):
+        return helper(carry), x
+
+    def outer(xs):
+        return jax.lax.scan(body, 0, xs)
+    """
+    codes = _codes(src, path=JIT_PATH, rules=["jit-boundary"])
+    assert codes == ["OSL101"]  # random.random inside helper, via body
+
+
+def test_jit_boundary_silent_on_host_side_code_and_other_dirs():
+    src = """
+    import time, jax
+
+    @jax.jit
+    def step(x):
+        return x + 1
+
+    def host_driver(xs):
+        t0 = time.monotonic()        # fine: not traced
+        return step(xs), time.monotonic() - t0
+    """
+    assert _codes(src, path=JIT_PATH, rules=["jit-boundary"]) == []
+    bad = """
+    import time, jax
+
+    @jax.jit
+    def step(x):
+        return time.time()
+    """
+    # same code outside engine/ops/parallel is out of the rule's scope
+    assert _codes(bad, path="opensim_tpu/chart/fixture.py", rules=["jit-boundary"]) == []
+
+
+def test_jit_boundary_suppression():
+    src = """
+    import time, jax
+
+    @jax.jit
+    def step(x):
+        t = time.monotonic()  # opensim-lint: disable=jit-boundary
+        return x
+    """
+    assert _codes(src, path=JIT_PATH, rules=["jit-boundary"]) == []
+
+
+# ---------------------------------------------------------------------------
+# OSL201 dtype-drift
+# ---------------------------------------------------------------------------
+
+ENC_PATH = "opensim_tpu/encoding/fixture.py"  # rule is scoped to encoding/
+
+
+def test_dtype_drift_fires_on_float64_and_default_dtype():
+    src = """
+    import numpy as np
+
+    def build(n):
+        a = np.zeros((n,))                       # default dtype
+        b = np.arange(n + 1, dtype=np.float64)   # bare float64
+        c = np.full((n,), -1)                    # no dtype
+        return a, b, c
+    """
+    codes = _codes(src, path=ENC_PATH, rules=["dtype-drift"])
+    assert codes == ["OSL201"] * 3
+
+
+def test_dtype_drift_silent_on_policy_compliant_arrays():
+    src = """
+    import numpy as np
+    from opensim_tpu.encoding.dtypes import FLOAT_DTYPE, INT_DTYPE, log_size_table
+
+    def build(n, a):
+        x = np.zeros((n,), dtype=FLOAT_DTYPE)
+        y = np.full((n,), -1, np.int32)          # positional dtype
+        z = np.full(a.shape, 0, dtype=a.dtype)   # dtype-preserving growth
+        return x, y, z, log_size_table(n)
+    """
+    assert _codes(src, path=ENC_PATH, rules=["dtype-drift"]) == []
+    # out of scope: non-encoding paths may use numpy defaults
+    bad = "import numpy as np\na = np.zeros((3,))\n"
+    assert _codes(bad, path="opensim_tpu/planner/fixture.py", rules=["dtype-drift"]) == []
+
+
+def test_dtype_drift_file_level_suppression():
+    src = """
+    # opensim-lint: disable-file=dtype-drift
+    import numpy as np
+    a = np.zeros((4,))
+    """
+    assert _codes(src, path=ENC_PATH, rules=["dtype-drift"]) == []
+
+
+# ---------------------------------------------------------------------------
+# OSL301 determinism
+# ---------------------------------------------------------------------------
+
+
+def test_determinism_fires_on_set_iteration_and_hash_fed_dict_views():
+    src = """
+    import hashlib
+
+    def fingerprint(d):
+        h = hashlib.blake2b()
+        for k, v in d.items():        # dict order feeds the hash
+            h.update(str((k, v)).encode())
+        return h.hexdigest()
+
+    def render(names):
+        return ",".join({n for n in names})   # set order into a stream
+    """
+    codes = _codes(src, rules=["determinism"])
+    assert codes == ["OSL301"] * 2
+
+
+def test_determinism_silent_on_sorted_iteration():
+    src = """
+    import hashlib
+
+    def fingerprint(d):
+        h = hashlib.blake2b()
+        for k in sorted(d.items()):
+            h.update(str(k).encode())
+        return h.hexdigest()
+
+    def render(names):
+        return ",".join(sorted(set(names)))
+
+    def count(names):
+        return len(set(names))        # cardinality: order irrelevant
+
+    def plain(d):
+        return [v for v in d.values()]  # dict order, no hash scope: fine
+    """
+    assert _codes(src, rules=["determinism"]) == []
+
+
+def test_determinism_suppression_on_previous_line():
+    src = """
+    def render(names):
+        # opensim-lint: disable=determinism
+        return ",".join({n for n in names})
+    """
+    assert _codes(src, rules=["determinism"]) == []
+
+
+# ---------------------------------------------------------------------------
+# OSL401 cache-mutation
+# ---------------------------------------------------------------------------
+
+
+def test_cache_mutation_fires_on_mutation_after_fingerprint():
+    src = """
+    from opensim_tpu.engine.prepcache import fingerprint_cluster
+
+    def bad(cluster, extra_pod):
+        fp = fingerprint_cluster(cluster)
+        cluster.pods.append(extra_pod)          # direct container mutation
+        for p in cluster.pods:
+            p.phase = "Running"                 # via a loop alias
+        return fp
+    """
+    codes = _codes(src, rules=["cache-mutation"])
+    assert codes == ["OSL401"] * 2
+
+
+def test_cache_mutation_silent_when_invalidated_or_before_fingerprint():
+    src = """
+    from opensim_tpu.engine.prepcache import fingerprint_cluster
+
+    def fixed(cluster, cache, extra_pod):
+        fp = fingerprint_cluster(cluster)
+        cluster.pods.append(extra_pod)
+        cache.invalidate(cluster)               # the sanctioned escape
+
+    def mutate_then_fingerprint(cluster, extra_pod):
+        cluster.pods.append(extra_pod)          # before: content not yet keyed
+        return fingerprint_cluster(cluster)
+
+    def unrelated(cluster, other, extra_pod):
+        fp = fingerprint_cluster(cluster)
+        other.pods.append(extra_pod)            # different object
+    """
+    assert _codes(src, rules=["cache-mutation"]) == []
+
+
+def test_cache_mutation_suppression():
+    src = """
+    from opensim_tpu.engine.prepcache import fingerprint_cluster
+
+    def bad(cluster, extra_pod):
+        fp = fingerprint_cluster(cluster)
+        cluster.pods.append(extra_pod)  # opensim-lint: disable=cache-mutation
+    """
+    assert _codes(src, rules=["cache-mutation"]) == []
+
+
+# ---------------------------------------------------------------------------
+# OSL501 exception-swallow
+# ---------------------------------------------------------------------------
+
+
+def test_exception_swallow_fires_on_silent_broad_handlers():
+    src = """
+    def swallow():
+        try:
+            risky()
+        except Exception:
+            pass
+
+    def swallow_bare():
+        try:
+            risky()
+        except:
+            return None
+    """
+    codes = _codes(src, rules=["exception-swallow"])
+    assert codes == ["OSL501"] * 2
+
+
+def test_exception_swallow_silent_on_raise_log_or_narrow():
+    src = """
+    import logging
+    log = logging.getLogger(__name__)
+
+    def translated():
+        try:
+            risky()
+        except Exception as e:
+            raise RuntimeError(str(e)) from e
+
+    def logged():
+        try:
+            risky()
+        except Exception as e:
+            log.warning("risky failed: %s", e)
+
+    def narrowed():
+        try:
+            risky()
+        except ValueError:
+            pass
+    """
+    assert _codes(src, rules=["exception-swallow"]) == []
+
+
+def test_exception_swallow_suppression_by_code():
+    src = """
+    def swallow():
+        try:
+            risky()
+        except Exception:  # opensim-lint: disable=OSL501
+            pass
+    """
+    assert _codes(src, rules=["exception-swallow"]) == []
+
+
+# ---------------------------------------------------------------------------
+# engine plumbing + meta-tests
+# ---------------------------------------------------------------------------
+
+
+def test_unknown_rule_is_an_error():
+    import pytest
+
+    with pytest.raises(KeyError):
+        lint_source("x = 1", rules=["no-such-rule"])
+
+
+def test_render_formats():
+    findings = lint_source(
+        "def f():\n    try:\n        g()\n    except Exception:\n        pass\n",
+        path="a.py",
+    )
+    assert len(findings) == 1
+    human = render_human(findings)
+    assert "a.py:4" in human and "OSL501" in human
+    import json
+
+    data = json.loads(render_json(findings))
+    assert data[0]["rule"] == "exception-swallow" and data[0]["line"] == 4
+
+
+def test_all_five_rules_registered():
+    assert {
+        "jit-boundary",
+        "dtype-drift",
+        "determinism",
+        "cache-mutation",
+        "exception-swallow",
+    } <= set(RULES)
+
+
+def test_repo_is_lint_clean():
+    """The acceptance gate: `make lint` exits 0 on the package."""
+    findings = lint_paths([os.path.join(REPO, "opensim_tpu")])
+    assert findings == [], render_human(findings)
+
+
+def test_strict_core_has_no_suppressions():
+    """engine/prepcache.py and encoding/state.py must be clean WITHOUT
+    suppression comments (ISSUE acceptance)."""
+    for rel in ("opensim_tpu/engine/prepcache.py", "opensim_tpu/encoding/state.py"):
+        with open(os.path.join(REPO, rel)) as fh:
+            assert "opensim-lint: disable" not in fh.read(), rel
+
+
+def test_typed_core_signatures_complete():
+    assert check_typed_core(REPO) == []
+
+
+def test_cli_main():
+    from opensim_tpu.analysis.__main__ import main
+
+    assert main(["--list-rules"]) == 0
+    assert main([os.path.join(REPO, "opensim_tpu", "analysis")]) == 0
+
+
+def test_pyproject_defaults_are_read():
+    from opensim_tpu.analysis.__main__ import pyproject_defaults
+
+    cfg = pyproject_defaults(os.path.join(REPO, "pyproject.toml"))
+    assert cfg.get("paths") == ["opensim_tpu"]
+    assert "jit-boundary" in cfg.get("rules", [])
+
+
+def test_cache_mutation_release_is_per_object():
+    # review fix: invalidate(cluster) must NOT silence the apps mutation
+    src = """
+    from opensim_tpu.engine.prepcache import fingerprint_cluster, fingerprint_apps
+
+    def partial_release(cluster, apps, cache, extra):
+        fingerprint_cluster(cluster)
+        fingerprint_apps(apps)
+        cluster.pods.append(extra)
+        apps.pods.append(extra)
+        cache.invalidate(cluster)      # covers cluster only
+    """
+    findings = lint_source(textwrap.dedent(src), rules=["cache-mutation"])
+    assert len(findings) == 1 and "apps" in findings[0].message
+
+
+def test_cache_mutation_argless_invalidate_releases_all():
+    src = """
+    from opensim_tpu.engine.prepcache import fingerprint_cluster, fingerprint_apps
+
+    def full_release(cluster, apps, cache, extra):
+        fingerprint_cluster(cluster)
+        fingerprint_apps(apps)
+        cluster.pods.append(extra)
+        apps.pods.append(extra)
+        cache.invalidate()             # drops everything
+    """
+    assert _codes(src, rules=["cache-mutation"]) == []
+
+
+def test_cache_mutation_touch_on_loop_alias_releases_its_root():
+    src = """
+    from opensim_tpu.engine.prepcache import fingerprint_cluster
+
+    def touched(cluster):
+        fingerprint_cluster(cluster)
+        for p in cluster.pods:
+            p.phase = "Running"
+            p.touch()                  # alias of cluster: releases it
+    """
+    assert _codes(src, rules=["cache-mutation"]) == []
+
+
+def test_cache_mutation_nested_function_reports_once():
+    src = """
+    from opensim_tpu.engine.prepcache import fingerprint_cluster
+
+    def outer():
+        def inner(cluster, extra):
+            fingerprint_cluster(cluster)
+            cluster.pods.append(extra)
+        return inner
+    """
+    assert _codes(src, rules=["cache-mutation"]) == ["OSL401"]
+
+
+def test_typed_core_catches_multiline_signature_ignore(tmp_path):
+    from opensim_tpu.analysis import typed_core
+
+    bad = tmp_path / "mod.py"
+    bad.write_text(
+        "def f(\n    x: int,\n) -> int:  # type: ignore[override]\n    return x\n"
+    )
+    orig = typed_core.STRICT_MODULES
+    typed_core.STRICT_MODULES = ("mod.py",)
+    try:
+        problems = typed_core.check_typed_core(str(tmp_path))
+    finally:
+        typed_core.STRICT_MODULES = orig
+    assert len(problems) == 1 and "type: ignore" in problems[0]
+
+
+def test_determinism_flags_sum_over_float_set():
+    src = """
+    def total(xs):
+        return sum({float(x) for x in xs})   # order-dependent in the last ulp
+    """
+    assert _codes(src, rules=["determinism"]) == ["OSL301"]
+
+
+def test_typed_core_catches_one_line_def_ignore(tmp_path):
+    from opensim_tpu.analysis import typed_core
+
+    bad = tmp_path / "mod.py"
+    bad.write_text("def f(x: int) -> int: return x  # type: ignore\n")
+    orig = typed_core.STRICT_MODULES
+    typed_core.STRICT_MODULES = ("mod.py",)
+    try:
+        problems = typed_core.check_typed_core(str(tmp_path))
+    finally:
+        typed_core.STRICT_MODULES = orig
+    assert len(problems) == 1 and "type: ignore" in problems[0]

@@ -191,3 +191,24 @@ def test_load_reference_examples():
     pods = expand.generate_pods_from_resources(app, rt.nodes)
     # 1 bare pod + 4 deployment + 2 replicaset + 2 job + 5 sts + 3 daemonset (all nodes tolerated)
     assert len(pods) == 17
+
+
+def test_touch_bumps_global_epoch_thread_safely():
+    import threading
+
+    from opensim_tpu.models.objects import Pod, touch_epoch
+
+    pods = [Pod() for _ in range(8)]
+    before = touch_epoch()
+
+    def hammer(p):
+        for _ in range(500):
+            p.touch()
+
+    threads = [threading.Thread(target=hammer, args=(p,)) for p in pods]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert touch_epoch() - before == 8 * 500  # no lost increments
+    assert all(p.local_version == 500 for p in pods)

@@ -345,3 +345,108 @@ def test_rest_scale_apps_uses_drop_mask_and_matches_legacy(monkeypatch):
 
     assert shape(resp1) == shape(resp2)
     assert shape(resp1b) == shape(resp2)
+
+
+# ---------------------------------------------------------------------------
+# stale-fingerprint guard (VersionedObject / invalidate(obj))
+# ---------------------------------------------------------------------------
+
+
+def test_touch_without_invalidate_raises_stale_error():
+    cluster, apps = _cluster(), _apps()
+    cache = prepcache.PrepareCache()
+    prepcache.simulate_cached(cluster, apps, cache)
+    cluster.nodes[0].touch()  # in-place mutation marker, no invalidation
+    with pytest.raises(prepcache.StaleFingerprintError, match="n000"):
+        prepcache.simulate_cached(cluster, apps, cache)
+
+
+def test_invalidate_object_drops_watching_entries():
+    cluster, apps = _cluster(), _apps()
+    cache = prepcache.PrepareCache()
+    prepcache.simulate_cached(cluster, apps, cache)
+    node = cluster.nodes[0]
+    node.unschedulable = True
+    node.touch()
+    assert cache.invalidate(node) == 1
+    assert len(cache) == 0
+    # rebuild is clean and records the new version
+    res = prepcache.simulate_cached(cluster, apps, cache)
+    assert res is not None
+    prepcache.simulate_cached(cluster, apps, cache)  # hit, no raise
+
+
+def test_invalidate_object_covers_app_objects_and_misses_strangers():
+    cluster, apps = _cluster(), _apps()
+    cache = prepcache.PrepareCache()
+    prepcache.simulate_cached(cluster, apps, cache)
+    stranger = fx.make_fake_node("stranger", "8", "16Gi")
+    assert cache.invalidate(stranger) == 0  # identity-keyed: not watched
+    dep = apps[0].resources.deployments[0]
+    dep.replicas += 1
+    dep.touch()
+    assert cache.invalidate(dep) == 1
+
+
+def test_invalidate_prefix_still_works():
+    cache = prepcache.PrepareCache()
+    cache.put("abc|1", prepcache.CacheEntry("abc|1", None))
+    cache.put("abd|2", prepcache.CacheEntry("abd|2", None))
+    assert cache.invalidate("abc") == 1
+    assert cache.invalidate() == 1  # '' drops the rest
+
+
+def test_stale_entry_is_evicted_so_next_call_recovers():
+    cluster, apps = _cluster(), _apps()
+    cache = prepcache.PrepareCache()
+    prepcache.simulate_cached(cluster, apps, cache)
+    cluster.nodes[0].touch()
+    with pytest.raises(prepcache.StaleFingerprintError):
+        prepcache.simulate_cached(cluster, apps, cache)
+    # the proven-stale entry was dropped: the same call now rebuilds
+    res = prepcache.simulate_cached(cluster, apps, cache)
+    assert res is not None
+    prepcache.simulate_cached(cluster, apps, cache)  # and hits cleanly
+
+
+def test_derived_entry_inherits_base_watch_list():
+    base = prepcache.CacheEntry("base", None)
+    base.watched = [(object(), 0)]
+    derived = prepcache.CacheEntry("derived", None, base=base)
+    assert derived.watched is base.watched
+
+
+def test_invalidate_object_reaches_derived_entries():
+    # REST-style topology: base entry watches the snapshot; the derived
+    # full-key entry shares the watch list, so invalidate(obj) drops both
+    cluster = _cluster()
+    cache = prepcache.PrepareCache()
+    base = cache.put(
+        "fp|base",
+        prepcache.CacheEntry("fp|base", None, watch=prepcache.watch_snapshot(cluster, [])),
+    )
+    cache.put("fp|deploy|x", prepcache.CacheEntry("fp|deploy|x", None, base=base))
+    assert cache.invalidate(cluster.nodes[0]) == 2
+
+
+def test_watch_snapshot_is_captured_before_build():
+    # a touch() landing between fingerprint and entry creation (i.e. while
+    # prepare() runs) must leave the entry provably stale, not fresh
+    cluster, apps = _cluster(), _apps()
+    snap = prepcache.watch_snapshot(cluster, apps)
+    cluster.nodes[0].touch()  # races "during the build"
+    entry = prepcache.CacheEntry("k", None, watch=snap)
+    with pytest.raises(prepcache.StaleFingerprintError):
+        entry.check_fresh()
+
+
+def test_raw_objects_are_watched_too():
+    from opensim_tpu.models.objects import RawObject
+
+    cluster, apps = _cluster(), _apps()
+    pdb = RawObject.from_dict({"kind": "PodDisruptionBudget", "metadata": {"name": "pdb1"}})
+    cluster.pdbs.append(pdb)
+    cache = prepcache.PrepareCache()
+    prepcache.simulate_cached(cluster, apps, cache)
+    pdb.touch()
+    assert cache.invalidate(pdb) == 1  # the protocol covers RawObject kinds

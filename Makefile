@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify
+.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos
 
 all: test
 
@@ -21,8 +21,14 @@ mypy:
 		python -m opensim_tpu.analysis --check-typed-core; \
 	fi
 
-# the CI gate: static analysis + types + tier-1 tests
-verify: lint mypy test-quick
+# fault-injection suite (docs/resilience.md): every OPENSIM_FAULTS point
+# must either recover (retry/fallback, placements identical to an
+# uninjected run) or fail closed with a typed error and intact /metrics
+chaos:
+	python -m pytest tests/test_chaos.py tests/test_resilience.py -q
+
+# the CI gate: static analysis + types + tier-1 tests + chaos suite
+verify: lint mypy test-quick chaos
 
 # run the moment the TPU tunnel opens (tools/tpu_probe_loop.sh writes
 # /tmp/opensim-tpu-watch.up): compiled-Mosaic parity suite + full bench

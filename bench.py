@@ -36,6 +36,17 @@ from opensim_tpu.engine.simulator import AppResource, simulate  # noqa: E402
 from opensim_tpu.models import ResourceTypes, fixtures as fx  # noqa: E402
 
 
+# failure contract (NOTES invariant: the driver parses exactly ONE JSON
+# line from stdout): every failure path must emit a single-line JSON error
+# object and exit nonzero — never a bare traceback. _STAGE tracks how far
+# the run got so the error line says which phase died.
+_STAGE = ["startup"]
+
+
+def _stage(name: str) -> None:
+    _STAGE[0] = name
+
+
 def _fmt(n: int) -> str:
     return f"{n // 1000}k" if n >= 1000 and n % 1000 == 0 else str(n)
 
@@ -350,6 +361,7 @@ def main() -> int:
     ap.add_argument("--scenarios", type=int, default=1000, help="defrag: number of drain scenarios")
     ap.add_argument("--repeats", type=int, default=10, help="steady: number of warm re-simulations")
     args = ap.parse_args()
+    _stage("measure")
 
     repo = os.path.dirname(os.path.abspath(__file__))
     if args.config == "steady":
@@ -389,10 +401,12 @@ def main() -> int:
 
     cold_s = None
     if args.warmup:
+        _stage("warmup")
         t0 = time.time()
         simulate(cluster, apps, node_pad=128)
         cold_s = round(time.time() - t0, 3)
 
+    _stage("measure")
     PREP_STATS.reset()
     t0 = time.time()
     result = simulate(cluster, apps, node_pad=128)
@@ -443,5 +457,24 @@ def main() -> int:
     return 0
 
 
+def _guarded_main() -> int:
+    """Top-level failure contract: one JSON line on stdout, nonzero exit.
+    argparse's own exits (usage errors print to stderr) are translated into
+    the same one-line shape so the driver never sees an empty stdout."""
+    try:
+        return main()
+    except SystemExit as e:
+        if e.code in (0, None):
+            return 0
+        print(json.dumps({"error": f"exited with status {e.code}", "stage": _STAGE[0]}))
+        return e.code if isinstance(e.code, int) else 1
+    except KeyboardInterrupt:
+        print(json.dumps({"error": "interrupted", "stage": _STAGE[0]}))
+        return 130
+    except BaseException as e:
+        print(json.dumps({"error": f"{type(e).__name__}: {e}", "stage": _STAGE[0]}))
+        return 1
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(_guarded_main())

@@ -10,6 +10,7 @@ Rules (short name = suppression id; see docs/static-analysis.md):
     OSL301 determinism        unordered iteration on ordered streams
     OSL401 cache-mutation     mutation of fingerprinted objects
     OSL501 exception-swallow  broad except without raise/log
+    OSL601 unbounded-retry    retry loop without a bound or backoff
 """
 
 from .core import (  # noqa: F401
@@ -31,4 +32,5 @@ from . import (  # noqa: F401,E402
     rules_dtype,
     rules_except,
     rules_jit,
+    rules_retry,
 )

@@ -1,0 +1,137 @@
+"""unbounded-retry (OSL601): retry loops without a bound or backoff.
+
+The resilience layer (``opensim_tpu/resilience/retry.py``) gives every
+network/device call site bounded attempts with jittered exponential backoff.
+Hand-rolled retry loops regress both properties in the two ways this rule
+detects:
+
+- **no bound** — a ``while True:`` loop that makes a network/device call and
+  contains an exception handler that neither re-raises nor escapes the loop
+  (no ``raise``/``return``/``break`` in the handler body): the failure is
+  swallowed and the call retried forever. A ``while True`` whose handler
+  escapes is fine — the first failure terminates the loop.
+- **no backoff** — ``time.sleep(<numeric constant>)`` lexically inside any
+  loop body: constant-interval retrying synchronizes clients into retry
+  storms exactly when the backend is least able to absorb them. Computed
+  sleeps (``sleep(delay)``) are not flagged.
+
+Fix either by calling :func:`opensim_tpu.resilience.retry.retry_call`, or by
+bounding the loop and deriving the sleep from the attempt number.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from .core import FileContext, Finding, Rule, dotted_name, register
+
+# call names that talk to a network or an accelerator device — the targets
+# an unbounded retry loop would hammer
+_NETWORK_SUFFIXES = {
+    "urlopen",
+    "urlretrieve",
+    "getaddrinfo",
+    "create_connection",
+    "connect",
+    "recv",
+    "send",
+    "sendall",
+    "request",
+    "device_put",
+    "block_until_ready",
+    "run_scan",
+    "cluster_from_kubeconfig",
+}
+_NETWORK_PREFIXES = ("urllib.", "socket.", "http.client.", "requests.")
+
+
+def _is_network_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    if name.startswith(_NETWORK_PREFIXES):
+        return True
+    leaf = name.rsplit(".", 1)[-1] if name else ""
+    if leaf in _NETWORK_SUFFIXES:
+        return True
+    return isinstance(node.func, ast.Attribute) and node.func.attr in _NETWORK_SUFFIXES
+
+
+def _handler_escapes(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+            return True
+    return False
+
+
+def _loop_body_walk(loop: ast.AST, stop_at_loops: bool = False) -> Iterable[ast.AST]:
+    """Walk a loop's body/orelse WITHOUT descending into nested function or
+    class definitions (their loops are visited on their own).
+    ``stop_at_loops`` also stops at nested loops (yielding the loop node but
+    not its body) — the constant-sleep check attributes each sleep to its
+    NEAREST enclosing loop only, so nesting never double-reports."""
+    stack: List[ast.AST] = list(getattr(loop, "body", [])) + list(getattr(loop, "orelse", []))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+            continue
+        if stop_at_loops and isinstance(node, (ast.While, ast.For)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_while_true(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.While)
+        and isinstance(node.test, ast.Constant)
+        and node.test.value is True
+    )
+
+
+def _constant_sleep(node: ast.AST) -> bool:
+    if not (isinstance(node, ast.Call) and node.args and not node.keywords):
+        return False
+    name = dotted_name(node.func)
+    if not (name.endswith("time.sleep") or name == "sleep"):
+        return False
+    arg = node.args[0]
+    return isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float))
+
+
+@register
+class UnboundedRetryRule(Rule):
+    name = "unbounded-retry"
+    code = "OSL601"
+    description = "retry loop without a bound or backoff"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.While, ast.For)):
+                continue
+            body = list(_loop_body_walk(loop))
+            if _is_while_true(loop):
+                swallowing = [
+                    h
+                    for h in body
+                    if isinstance(h, ast.ExceptHandler) and not _handler_escapes(h)
+                ]
+                has_net = any(isinstance(n, ast.Call) and _is_network_call(n) for n in body)
+                if swallowing and has_net:
+                    yield self.finding(
+                        ctx,
+                        loop,
+                        "`while True` retries a network/device call with no "
+                        "attempt bound (the except handler never escapes the "
+                        "loop); use resilience.retry.retry_call or bound the "
+                        "attempts",
+                    )
+            for n in _loop_body_walk(loop, stop_at_loops=True):
+                if _constant_sleep(n):
+                    yield self.finding(
+                        ctx,
+                        n,
+                        "constant time.sleep inside a loop is a backoff-less "
+                        "retry; use resilience.retry.retry_call's jittered "
+                        "exponential backoff or derive the delay from the "
+                        "attempt number",
+                    )

@@ -555,6 +555,12 @@ def schedule(
     Returns (chosen [P] i32, used_final [N, R], static_fail [U, 4],
     gpu_take [P, Gd], gpu_free [N, Gd], vg_free [N, Vg], dev_free [N, Dv]).
     `big_u=None` defers to the use_big_u heuristic."""
+    from ..resilience import faults
+
+    # stands in for a Mosaic compile failure (a construct passing interpret
+    # mode but not the real compiler) — simulate()'s ladder demotes, or
+    # fails hard under OPENSIM_REQUIRE_TPU=1 (chaos suite)
+    faults.fault_point("engine.compile")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     fi, meta = build_inputs(prep)

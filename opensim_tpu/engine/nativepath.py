@@ -118,7 +118,12 @@ def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=N
     reference's selectHost reservoir distribution). `st0` overrides the
     initial carry (segmented multi-profile runs chain scans)."""
     from .. import native
+    from ..resilience import faults
     from .scheduler import ScheduleOutput
+
+    # runtime-failure injection (chaos suite): a fault here stands in for
+    # ABI drift / a .so crash; simulate()'s ladder demotes to the XLA scan
+    faults.fault_point("engine.compile")
 
     cfg = config or DEFAULT_CONFIG
     ec = prep.ec_np

@@ -357,7 +357,12 @@ class PrepareCache:
         is by the offending OBJECT, dropping every entry it taints (e.g. a
         REST base entry and its derived full-key entries share one watch
         list) — recovery costs one failed request, not one per entry."""
+        from ..resilience import faults
+
         try:
+            # chaos injection point: a fault here (exc name ``stale``) lands
+            # exactly like a mid-flight touch() on a watched object
+            faults.fault_point("cache.stale")
             entry.check_fresh()
         except StaleFingerprintError as e:
             if e.obj is not None:

@@ -36,6 +36,9 @@ from ..models.objects import (
     ResourceTypes,
 )
 from ..ops import kernels
+from ..resilience import breaker as breakers
+from ..resilience import faults
+from ..resilience.deadline import Deadline, check_deadline, deadline_scope
 from . import queues
 from .scheduler import pad_pod_stream, scan_unroll, schedule_pods, to_device
 
@@ -362,6 +365,7 @@ def prepare(
     from ..utils.gcpause import gc_paused
     from ..utils.trace import PREP_STATS
 
+    check_deadline("prepare")
     t0 = time.monotonic()
     with gc_paused():
         prep = _prepare_inner(cluster, apps, use_greed, node_pad, patch_pods_fn)
@@ -398,6 +402,12 @@ def _prepare_inner(cluster, apps, use_greed, node_pad, patch_pods_fn):
 
     if not ordered:
         return None
+
+    # expansion is done; the encode pass below is the expensive half of a
+    # cold prepare — an exhausted deadline bails here rather than encoding
+    # tensors nobody will schedule (and chaos injects encode failures here)
+    check_deadline("encode")
+    faults.fault_point("prep.encode")
 
     # pods of one workload share a template: the hint short-circuits
     # canonical extraction (TemplateSet._hint_index) and the lazy selector
@@ -559,6 +569,7 @@ def simulate(
     prep: Optional["Prepared"] = None,
     node_valid: Optional[np.ndarray] = None,
     drop_pods: Optional[np.ndarray] = None,
+    deadline: Optional[Deadline] = None,
 ) -> SimulateResult:
     """One full simulation: cluster pods then apps in order. `sched_config`
     is an optional SchedulerConfig (the --default-scheduler-config merge);
@@ -582,8 +593,27 @@ def simulate(
     stream; marked pods are excluded from scheduling AND from the report,
     exactly as if the pods had never been in the input — the valid-mask
     flip that lets a cached Prepared serve a cluster whose pods shrank
-    (e.g. scale-apps removing a workload's existing pods)."""
+    (e.g. scale-apps removing a workload's existing pods).
+
+    `deadline` (resilience): a request time budget enforced at phase
+    boundaries (prepare/encode/schedule/decode) — exhaustion raises
+    ``DeadlineExceeded`` naming the phase instead of hanging. Callers may
+    equivalently install a ``resilience.deadline.deadline_scope``."""
     from ..utils.trace import Trace
+
+    if deadline is not None:
+        # install the request deadline as the ambient scope once, then run
+        # the body with deadline=None — phase checks (prepare/encode/
+        # schedule/decode) read the contextvar, so callers that already
+        # installed a scope (the REST server) compose with direct callers
+        with deadline_scope(deadline):
+            return simulate(
+                cluster, apps, use_greed=use_greed, node_pad=node_pad,
+                sched_config=sched_config, patch_pods_fn=patch_pods_fn,
+                extra_plugins=extra_plugins, enable_preemption=enable_preemption,
+                tie_seed=tie_seed, prep=prep, node_valid=node_valid,
+                drop_pods=drop_pods,
+            )
 
     _validate_extra_plugins(extra_plugins)
     if prep is not None and enable_preemption:
@@ -668,6 +698,7 @@ def simulate(
         import os as _os
 
         log = logging.getLogger("opensim_tpu")
+        check_deadline("schedule")
         out = None
         engine_name = "xla"
         skips: Dict[str, str] = {}
@@ -708,6 +739,21 @@ def simulate(
             if miss is not None:
                 skips["megakernel"] = miss
                 log.info("megakernel envelope miss: %s", miss)
+            elif (
+                not require_tpu
+                and not interpret
+                and not breakers.engine_breaker("megakernel").allow()
+            ):
+                # runtime-failure circuit breaker (resilience/breaker.py):
+                # after repeated compile/run failures the doomed attempt is
+                # skipped outright until the cooldown's half-open probe.
+                # Checked AFTER why_not so an envelope miss never consumes
+                # the probe slot (allow() marks it; only an actual attempt
+                # can release it). REQUIRE_TPU and the tests' interpret mode
+                # bypass gating — both demand the real attempt (and its hard
+                # failure) over a silent demotion.
+                skips["megakernel"] = breakers.engine_breaker("megakernel").describe_block()
+                log.warning("megakernel skipped: %s", skips["megakernel"])
             else:
                 # Pallas megakernel fast path: identical placements, ~4×
                 # the XLA scan's step rate. A Mosaic COMPILE failure (a
@@ -719,6 +765,11 @@ def simulate(
                     f_chosen, f_used, sf, f_take, f_gpu, f_vg, f_dev = fastpath.schedule(
                         prep, tmpl_ids, pod_valid, forced
                     )
+                    # a clean kernel RUN is a breaker success even if the
+                    # result is later discarded for mid-stream attribution —
+                    # and recording here releases a half-open probe slot no
+                    # matter which path the result takes
+                    breakers.engine_breaker("megakernel").record_success()
                 except Exception as e:
                     if interpret:
                         # test/CI mode: a broken megakernel contract must
@@ -730,6 +781,7 @@ def simulate(
                             f"compile/run ({type(e).__name__}: {e}); refusing "
                             "to silently fall back to a slower engine"
                         ) from e
+                    breakers.engine_breaker("megakernel").record_failure(e)
                     log.warning(
                         "megakernel failed (%s: %s); falling back to a "
                         "slower engine", type(e).__name__, e,
@@ -764,15 +816,32 @@ def simulate(
             from . import nativepath
 
             miss = nativepath.why_not(prep, sched_config, extra_plugins, tie_seed=tie_seed)
+            native_breaker = breakers.engine_breaker("native")
+            if miss is None and not native_breaker.allow():
+                miss = native_breaker.describe_block()
             if miss is None:
                 # C++ scan engine: identical placements to the XLA scan with
                 # exact in-stream failure attribution; the default on hosts
                 # without an accelerator (tests/test_native.py asserts parity).
-                out = nativepath.schedule(
-                    prep, pod_valid, config=sched_config, node_valid=nv_mask,
-                    tie_seed=tie_seed,
-                )
-                engine_name = "native"
+                # A RUNTIME failure (ABI drift past the size check, injected
+                # engine.compile fault, a crash in the .so) demotes this
+                # request to the XLA scan and counts against the breaker —
+                # the fallback ladder's bottom rung never silently loses work.
+                try:
+                    out = nativepath.schedule(
+                        prep, pod_valid, config=sched_config, node_valid=nv_mask,
+                        tie_seed=tie_seed,
+                    )
+                    native_breaker.record_success()
+                    engine_name = "native"
+                except Exception as e:
+                    native_breaker.record_failure(e)
+                    skips["native"] = f"{type(e).__name__}: {e}"
+                    log.warning(
+                        "native engine failed (%s: %s); falling back to the "
+                        "XLA scan", type(e).__name__, e,
+                    )
+                    out = None
             else:
                 skips["native"] = miss
                 log.info("native engine skipped: %s", miss)
@@ -789,6 +858,7 @@ def simulate(
             jax.block_until_ready(out.chosen)  # dispatch is async; trace real device time
         engine = EngineDecision(name=engine_name, skipped=skips)
         tr.step(f"schedule {len(ordered)} pods [engine={engine_name}]")
+    check_deadline("decode")
     out = out._replace(
         chosen=out.chosen[: len(ordered)],
         fail_counts=out.fail_counts[: len(ordered)],

@@ -19,7 +19,7 @@ import copy
 import os
 import sys
 from dataclasses import dataclass, field
-from typing import List, Optional, TextIO
+from typing import Callable, List, Optional, TextIO
 
 import numpy as np
 
@@ -154,6 +154,12 @@ class Applier:
         base = opts.base_dir or os.path.dirname(os.path.abspath(opts.simon_config))
         self.base = base
         self.out: TextIO = sys.stdout
+        # interactive-mode input source (VERDICT r4 weak #6): prompts render
+        # through self.out like every other line, and the line reader is
+        # injectable so scripted sessions/tests drive the survey loop without
+        # a real terminal. Must raise EOFError when the source is exhausted
+        # (the prompt loops treat EOF as Exit).
+        self.input_fn: Callable[[], str] = input
         from ..engine.simulator import parse_tie_break
 
         # sampled tie-break applies to the full simulations; the batched
@@ -448,17 +454,24 @@ class Applier:
     SURVEY_ADD = "Add nodes"
     SURVEY_EXIT = "Exit"
 
+    def _input(self, prompt: str) -> str:
+        """One interactive line: the prompt renders through ``self.out``
+        (like every other line of the session) and the reply comes from the
+        injectable ``self.input_fn``. EOFError propagates to the caller."""
+        print(prompt, end="", file=self.out, flush=True)
+        return self.input_fn()
+
     def _survey_select(self, message: str, options: List[str]) -> str:
         """A terminal stand-in for the reference's pterm/survey selection
         (apply.go:219-248): numbered options, accepting the number, a
         unique prefix of the label, or the legacy show/add/exit words."""
-        print(message)
+        print(message, file=self.out)
         for i, opt in enumerate(options, 1):
-            print(f"  {i}) {opt}")
+            print(f"  {i}) {opt}", file=self.out)
         legacy = {"show": self.SURVEY_SHOW, "add": self.SURVEY_ADD, "exit": self.SURVEY_EXIT}
         while True:
             try:
-                raw = input("> ").strip()
+                raw = self._input("> ").strip()
             except EOFError:
                 return self.SURVEY_EXIT
             if raw.isdigit() and 1 <= int(raw) <= len(options):
@@ -478,7 +491,7 @@ class Applier:
             matches = [o for o in options if o.lower().startswith(lowered)] if raw else []
             if len(matches) == 1:
                 return matches[0]
-            print(f"choose 1-{len(options)}")
+            print(f"choose 1-{len(options)}", file=self.out)
 
     def _survey_int(self, message: str) -> Optional[int]:
         """survey.Input for 'input node number' (apply.go:235-241)."""
@@ -488,16 +501,16 @@ class Applier:
             raw = str(pending)
         else:
             try:
-                raw = input(f"{message} > ").strip()
+                raw = self._input(f"{message} > ").strip()
             except EOFError:
                 return None
         try:
             num = int(raw)
         except ValueError:
-            print("not a number")
+            print("not a number", file=self.out)
             return None
         if num < 1:
-            print("node number must be >= 1")
+            print("node number must be >= 1", file=self.out)
             return None
         return num
 
@@ -530,11 +543,17 @@ class Applier:
                 )
                 if choice == self.SURVEY_SHOW:
                     for i, up in enumerate(result.unscheduled_pods):
-                        print(f"{i:4d} {up.pod.metadata.namespace}/{up.pod.metadata.name}: {up.reason}")
+                        print(
+                            f"{i:4d} {up.pod.metadata.namespace}/{up.pod.metadata.name}: {up.reason}",
+                            file=self.out,
+                        )
                     resimulate = False  # apply.go:204: Show re-prompts, no re-run
                 elif choice == self.SURVEY_ADD:
                     if template is None:
-                        print("no newNode template configured (spec.newNode); cannot add nodes")
+                        print(
+                            "no newNode template configured (spec.newNode); cannot add nodes",
+                            file=self.out,
+                        )
                         resimulate = False
                         continue
                     num = self._survey_int("input node number")
@@ -547,11 +566,14 @@ class Applier:
             else:
                 ok, reason = satisfy_resource_setting(result)
                 if not ok:
-                    print(reason)
+                    print(reason, file=self.out)
                     if template is None:
                         # nothing can improve occupancy without a newNode
                         # template; looping would re-simulate forever
-                        print("no newNode template configured (spec.newNode); cannot add nodes")
+                        print(
+                            "no newNode template configured (spec.newNode); cannot add nodes",
+                            file=self.out,
+                        )
                         return 1
                     choice = self._survey_select(
                         "resource occupancy exceeds the env caps, you can:",
@@ -570,7 +592,9 @@ class Applier:
         print("Simulation success!", file=self.out)
         # reportNodeInfo (apply.go:528-545) asks which nodes to detail
         try:
-            nodes = input("nodes to report pods for (comma-separated, empty = all, '-' = none) > ").strip()
+            nodes = self._input(
+                "nodes to report pods for (comma-separated, empty = all, '-' = none) > "
+            ).strip()
         except EOFError:
             nodes = "-"  # scripted stdin exhausted: skip the pod table
         pod_nodes = None if nodes == "-" else [n.strip() for n in nodes.split(",") if n.strip()]

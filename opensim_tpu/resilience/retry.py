@@ -1,0 +1,57 @@
+"""Bounded retry with jittered exponential backoff.
+
+One function, :func:`retry_call`, used wherever the repo talks to something
+that can transiently fail (the apiserver snapshot fetch). Policy follows the
+standard full-jitter scheme: attempt ``k`` (0-based) sleeps a uniform sample
+from ``[0, min(max_delay, base_delay * 2**k)]``, which decorrelates retry
+storms across clients while keeping the expected backoff exponential.
+
+Everything nondeterministic is injectable — ``sleep``, ``rng`` — so tests
+assert exact schedules without wall-clock time. The attempt bound is a hard
+parameter, never unlimited: opensim-lint rule OSL601 (unbounded-retry) flags
+hand-rolled ``while True`` retry loops and constant-sleep backoff; this is
+the sanctioned replacement.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type, TypeVar
+
+T = TypeVar("T")
+
+__all__ = ["retry_call"]
+
+
+def retry_call(
+    fn: Callable[[], T],
+    *,
+    attempts: int = 3,
+    base_delay: float = 0.1,
+    max_delay: float = 2.0,
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,),
+    sleep: Callable[[float], None] = time.sleep,
+    rng: Optional[random.Random] = None,
+    on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
+) -> T:
+    """Call ``fn`` up to ``attempts`` times; re-raise the last failure.
+
+    Only exceptions matching ``retry_on`` are retried — anything else
+    propagates immediately (an auth misconfiguration must not be hammered
+    three times). ``on_retry(attempt_index, exc, delay_s)`` fires before each
+    backoff sleep (metrics/log hook)."""
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    rng = rng if rng is not None else random.Random()
+    for k in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:
+            if k == attempts - 1:
+                raise
+            delay = rng.uniform(0.0, min(max_delay, base_delay * (2.0**k)))
+            if on_retry is not None:
+                on_retry(k, e, delay)
+            sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover

@@ -25,12 +25,36 @@ from typing import List, Optional
 
 from ..engine.simulator import AppResource, SimulateResult, simulate
 from ..models.objects import LABEL_APP_NAME, Node, ResourceTypes, object_from_dict
-from .snapshot import cluster_from_kubeconfig
+from ..resilience import breaker as breaker_mod
+from ..resilience import faults
+from ..resilience.deadline import Deadline, DeadlineExceeded, check_deadline, deadline_scope
+from ..resilience.retry import retry_call
+from .snapshot import (
+    SnapshotFetchError,
+    SnapshotUnavailable,
+    cluster_from_kubeconfig,
+    snapshot_retry_policy,
+)
 
 log = logging.getLogger("opensim_tpu.server")
 
 _deploy_lock = threading.Lock()
 _scale_lock = threading.Lock()
+
+# per-request state (one HTTP request = one handler thread): whether THIS
+# request's result was computed from a stale snapshot. Reading the shared
+# SimonServer flag at send time would mis-tag responses when a concurrent
+# request's refresh flips it mid-flight.
+_REQUEST_STATE = threading.local()
+
+
+def _mark_request_snapshot(stale: bool) -> None:
+    _REQUEST_STATE.snapshot_stale = stale
+
+
+def request_served_stale() -> bool:
+    """Did the current thread's request get served from a stale snapshot?"""
+    return getattr(_REQUEST_STATE, "snapshot_stale", False)
 
 
 class _Metrics:
@@ -45,6 +69,12 @@ class _Metrics:
         self.pods_scheduled = 0
         self.pods_unscheduled = 0
         self.simulate_seconds_total = 0.0
+        # resilience counters (docs/resilience.md): deadline 504s, snapshot
+        # fetch retries/degradations, stale-prep-cache internal retries
+        self.request_timeouts = 0
+        self.snapshot_retries = 0
+        self.snapshot_stale_served = 0
+        self.stale_prep_retries = 0
 
     def record(self, endpoint: str, result: SimulateResult, seconds: float) -> None:
         with self.lock:
@@ -53,6 +83,10 @@ class _Metrics:
             self.pods_scheduled += sum(len(ns.pods) for ns in result.node_status)
             self.pods_unscheduled += len(result.unscheduled_pods)
             self.simulate_seconds_total += seconds
+
+    def bump(self, counter: str, n: int = 1) -> None:
+        with self.lock:
+            setattr(self, counter, getattr(self, counter) + n)
 
     def render(self, prep_cache=None) -> str:
         from ..utils.trace import PREP_STATS
@@ -88,6 +122,38 @@ class _Metrics:
                 f"simon_prep_cache_misses_total {st.misses}",
                 "# TYPE simon_prep_cache_invalidations_total counter",
                 f"simon_prep_cache_invalidations_total {st.invalidations}",
+            ]
+        # resilience layer: deadline 504s, snapshot degradation, engine
+        # breaker state, fault injections (docs/resilience.md)
+        with self.lock:
+            lines += [
+                "# TYPE simon_request_timeouts_total counter",
+                f"simon_request_timeouts_total {self.request_timeouts}",
+                "# TYPE simon_snapshot_fetch_retries_total counter",
+                f"simon_snapshot_fetch_retries_total {self.snapshot_retries}",
+                "# TYPE simon_snapshot_stale_served_total counter",
+                f"simon_snapshot_stale_served_total {self.snapshot_stale_served}",
+                "# TYPE simon_stale_prep_retries_total counter",
+                f"simon_stale_prep_retries_total {self.stale_prep_retries}",
+            ]
+        breakers = sorted(breaker_mod.all_breakers().items())
+        lines += ["# TYPE simon_engine_breaker_trips_total counter"]
+        lines += [
+            f'simon_engine_breaker_trips_total{{engine="{name}"}} {br.trips_total}'
+            for name, br in breakers
+        ]
+        lines += ["# TYPE simon_engine_breaker_open gauge"]
+        lines += [
+            f'simon_engine_breaker_open{{engine="{name}"}} '
+            f'{int(br.state() != "closed")}'
+            for name, br in breakers
+        ]
+        fired = sorted(faults.fault_stats().items())
+        if fired:
+            lines += ["# TYPE simon_faults_injected_total counter"]
+            lines += [
+                f'simon_faults_injected_total{{point="{point}"}} {n}'
+                for point, n in fired
             ]
         return "\n".join(lines) + "\n"
 
@@ -174,6 +240,11 @@ class SimonServer:
         self._snapshot: Optional[ResourceTypes] = None
         self._snapshot_at = 0.0
         self._snapshot_fp: Optional[str] = None
+        # degradation state: when the apiserver stays down through every
+        # retry, requests are served from the last good snapshot and tagged
+        # with an X-Simon-Snapshot: stale response header
+        self.snapshot_stale = False
+        self._snapshot_fetched_at = 0.0
         # encode cache (incremental prepare): the snapshot's expanded+encoded
         # cluster is cached across requests keyed by content fingerprint, so
         # a request pays O(its own app) host work, not O(cluster). Opt out
@@ -201,12 +272,62 @@ class SimonServer:
         import time as _time
 
         now = _time.monotonic()
-        if self._snapshot is None or (
+        if self._snapshot is not None and not (
             self.snapshot_ttl_s <= 0 or now - self._snapshot_at > self.snapshot_ttl_s
         ):
-            self._snapshot = cluster_from_kubeconfig(self.kubeconfig, self.master)
-            self._snapshot_at = now
-            self._snapshot_fp = None  # re-fingerprint lazily
+            # within the TTL window after a degrade the cached snapshot is
+            # still the stale one: this request must be tagged too
+            _mark_request_snapshot(self.snapshot_stale)
+            return
+        check_deadline("snapshot")
+        attempts, base_delay = snapshot_retry_policy()
+
+        def _fetch() -> ResourceTypes:
+            faults.fault_point("snapshot.http")
+            return cluster_from_kubeconfig(self.kubeconfig, self.master)
+
+        def _note_retry(attempt: int, exc: BaseException, delay: float) -> None:
+            METRICS.bump("snapshot_retries")
+            log.warning(
+                "snapshot fetch attempt %d failed (%s: %s); retrying in %.3fs",
+                attempt + 1, type(exc).__name__, exc, delay,
+            )
+
+        try:
+            # the ONE retry layer for the snapshot fetch (the per-endpoint
+            # code raises typed single-attempt failures). Only the transient
+            # class retries — a missing kubeconfig or auth misconfiguration
+            # (plain OSError/RuntimeError) will not heal and surfaces now.
+            self._snapshot = retry_call(
+                _fetch,
+                attempts=attempts,
+                base_delay=base_delay,
+                retry_on=(SnapshotFetchError, TimeoutError),
+                on_retry=_note_retry,
+            )
+        except (SnapshotFetchError, TimeoutError) as e:
+            if self._snapshot is not None:
+                # degrade: serve the last good snapshot, tagged stale, and
+                # re-arm the TTL so a down apiserver is probed once per TTL
+                # window instead of hammered on every request
+                self.snapshot_stale = True
+                _mark_request_snapshot(True)
+                self._snapshot_at = now
+                METRICS.bump("snapshot_stale_served")
+                log.warning(
+                    "snapshot refresh failed after %d attempt(s) (%s: %s); "
+                    "serving stale snapshot (age %.1fs)",
+                    attempts, type(e).__name__, e, now - self._snapshot_fetched_at,
+                )
+                return
+            raise SnapshotUnavailable(
+                f"cluster snapshot unavailable after {attempts} attempt(s): {e}"
+            ) from e
+        self._snapshot_at = now
+        self._snapshot_fetched_at = now
+        self.snapshot_stale = False
+        _mark_request_snapshot(False)
+        self._snapshot_fp = None  # re-fingerprint lazily
 
     def _snapshot_for_cache(self) -> tuple:
         """(cluster, content fingerprint) for the encode-cache path — no
@@ -233,6 +354,24 @@ class SimonServer:
     # -- handlers -----------------------------------------------------------
 
     def _simulate_request(self, kind: str, payload: dict) -> SimulateResult:
+        """`_simulate_request_once` plus stale-entry recovery: a
+        ``StaleFingerprintError`` hit means a fingerprinted object was
+        ``touch()``ed behind the cache's back — ``PrepareCache.check_fresh``
+        already evicted everything the object taints, so ONE internal retry
+        re-prepares from the live objects. A REST client has no way to call
+        ``invalidate(obj)``; without this the client would eat a 500 for a
+        purely server-side cache condition. A second stale failure in the
+        same request propagates (typed 500) rather than looping."""
+        from ..engine.prepcache import StaleFingerprintError
+
+        try:
+            return self._simulate_request_once(kind, payload)
+        except StaleFingerprintError as e:
+            METRICS.bump("stale_prep_retries")
+            log.warning("stale prepare-cache entry (%s); retrying once after eviction", e)
+            return self._simulate_request_once(kind, payload)
+
+    def _simulate_request_once(self, kind: str, payload: dict) -> SimulateResult:
         """Shared deploy/scale simulation through the encode cache:
 
         1. identical repeated request → full-key hit: restore + simulate,
@@ -344,41 +483,53 @@ class SimonServer:
             finally:
                 entry.restore()
 
-    def deploy_apps(self, payload: dict) -> tuple:
-        if not _deploy_lock.acquire(blocking=False):
+    def _handle(self, endpoint: str, kind: str, lock: threading.Lock,
+                payload: dict, deadline: Optional[Deadline] = None) -> tuple:
+        """Shared endpoint shell: single-flight busy rejection, deadline
+        scope, and the failure-mode ladder (docs/resilience.md) — every
+        outcome is a typed JSON body, never a hang or a raw traceback:
+
+        - 200: simulation result
+        - 503 busy: TryLock rejection (server.go:167,:234)
+        - 504 + phase: request deadline exhausted at a phase boundary
+        - 503 + retryable: apiserver down through every retry, no snapshot
+          to degrade to
+        - 500 + type: everything else (engine/encoding failure after the
+          fallback ladder is exhausted)
+        """
+        if not lock.acquire(blocking=False):
             return 503, {"error": "the server is busy now, please try again later"}
+        _mark_request_snapshot(False)  # until a refresh says otherwise
         try:
             import time
 
             t0 = time.monotonic()
-            result = self._simulate_request("deploy", payload)
-            METRICS.record("deploy-apps", result, time.monotonic() - t0)
+            with deadline_scope(deadline):
+                result = self._simulate_request(kind, payload)
+            METRICS.record(endpoint, result, time.monotonic() - t0)
             return 200, _response(result)
+        except DeadlineExceeded as e:
+            METRICS.bump("request_timeouts")
+            log.warning("%s timed out: %s", endpoint, e)
+            return 504, {"error": str(e), "phase": e.phase}
+        except SnapshotUnavailable as e:
+            log.warning("%s snapshot unavailable: %s", endpoint, e)
+            return 503, {"error": str(e), "retryable": True}
         except Exception as e:  # surface as 500 like gin's error handler
-            log.warning("deploy-apps failed: %s: %s", type(e).__name__, e)
-            return 500, {"error": str(e)}
+            log.warning("%s failed: %s: %s", endpoint, type(e).__name__, e)
+            return 500, {"error": str(e), "type": type(e).__name__}
         finally:
-            _deploy_lock.release()
+            lock.release()
 
-    def scale_apps(self, payload: dict) -> tuple:
+    def deploy_apps(self, payload: dict, deadline: Optional[Deadline] = None) -> tuple:
+        return self._handle("deploy-apps", "deploy", _deploy_lock, payload, deadline)
+
+    def scale_apps(self, payload: dict, deadline: Optional[Deadline] = None) -> tuple:
         """scale-apps (server.go:233-312): remove the workload's existing
         pods from the cluster snapshot, then re-simulate at the new scale —
         on the cached path the removal is a valid-mask flip over the
         snapshot's cached encoding, not a re-encode."""
-        if not _scale_lock.acquire(blocking=False):
-            return 503, {"error": "the server is busy now, please try again later"}
-        try:
-            import time
-
-            t0 = time.monotonic()
-            result = self._simulate_request("scale", payload)
-            METRICS.record("scale-apps", result, time.monotonic() - t0)
-            return 200, _response(result)
-        except Exception as e:
-            log.warning("scale-apps failed: %s: %s", type(e).__name__, e)
-            return 500, {"error": str(e)}
-        finally:
-            _scale_lock.release()
+        return self._handle("scale-apps", "scale", _scale_lock, payload, deadline)
 
 
 def _owned_by(pod, scaled: set) -> bool:
@@ -403,16 +554,35 @@ def _with_new_nodes(cluster: ResourceTypes, nodes: List[Node]) -> ResourceTypes:
     return out
 
 
+def request_deadline(headers) -> Optional[Deadline]:
+    """Per-request deadline: the ``X-Simon-Timeout-S`` header wins, else
+    ``OPENSIM_REQUEST_TIMEOUT_S`` (unset/0 = no deadline — existing clients
+    keep today's unbounded behavior unless they or the operator opt in)."""
+    raw = headers.get("X-Simon-Timeout-S") if headers is not None else None
+    if raw is None:
+        raw = os.environ.get("OPENSIM_REQUEST_TIMEOUT_S", "")
+    if not raw:
+        return None
+    try:
+        budget = float(raw)
+    except ValueError:
+        log.warning("ignoring unparseable request timeout %r", raw)
+        return None
+    return Deadline.after(budget) if budget > 0 else None
+
+
 def make_handler(server: SimonServer):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):  # quiet by default
             pass
 
-        def _send(self, code: int, body: dict) -> None:
+        def _send(self, code: int, body: dict, extra_headers: Optional[dict] = None) -> None:
             data = json.dumps(body).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
 
@@ -448,13 +618,19 @@ def make_handler(server: SimonServer):
             except ValueError:
                 self._send(400, {"error": "invalid JSON body"})
                 return
+            deadline = request_deadline(self.headers)
             if self.path == "/api/deploy-apps":
-                code, body = server.deploy_apps(payload)
+                code, body = server.deploy_apps(payload, deadline=deadline)
             elif self.path == "/api/scale-apps":
-                code, body = server.scale_apps(payload)
+                code, body = server.scale_apps(payload, deadline=deadline)
             else:
                 code, body = 404, {"error": "not found"}
-            self._send(code, body)
+            # degraded-mode transparency: a result computed from a stale
+            # snapshot (apiserver down through every retry) says so. Read
+            # per-request (thread-local), not off the shared server flag —
+            # a concurrent refresh must not mis-tag this response.
+            extra = {"X-Simon-Snapshot": "stale"} if request_served_stale() else None
+            self._send(code, body, extra_headers=extra)
 
     return Handler
 

@@ -9,6 +9,7 @@ without the ``kubernetes`` package (it is absent from this base image)."""
 from __future__ import annotations
 
 import json
+import os
 import ssl
 import urllib.error
 import urllib.request
@@ -17,6 +18,35 @@ from typing import List, Optional
 import yaml
 
 from ..models.objects import Node, Pod, RawObject, ResourceTypes, Workload
+
+
+class SnapshotFetchError(RuntimeError):
+    """A *transient* snapshot list failure (connection refused/reset, DNS,
+    timeout, apiserver 5xx) — the retryable class. Config/auth problems
+    (bad kubeconfig, unsupported auth, 4xx) stay plain RuntimeError: they
+    will not heal by retrying and must surface immediately."""
+
+
+class SnapshotUnavailable(RuntimeError):
+    """The apiserver stayed down through every retry and no previous
+    snapshot exists to degrade to — the REST layer maps this to a typed 503
+    (retryable) instead of a raw 500."""
+
+
+def snapshot_retry_policy() -> tuple:
+    """(attempts, base_delay_s) for the whole-snapshot fetch retry in
+    ``SimonServer._refresh_snapshot`` — the ONE bounded retry layer — from
+    ``OPENSIM_SNAPSHOT_RETRIES`` (default 3 attempts total) and
+    ``OPENSIM_SNAPSHOT_BACKOFF_S`` (default 0.1; jittered exponential)."""
+    try:
+        attempts = max(1, int(os.environ.get("OPENSIM_SNAPSHOT_RETRIES", "3")))
+    except ValueError:
+        raise ValueError("OPENSIM_SNAPSHOT_RETRIES must be an integer") from None
+    try:
+        base = float(os.environ.get("OPENSIM_SNAPSHOT_BACKOFF_S", "0.1"))
+    except ValueError:
+        raise ValueError("OPENSIM_SNAPSHOT_BACKOFF_S must be a number") from None
+    return attempts, base
 
 
 def _pod_admissible(d: dict) -> bool:
@@ -107,15 +137,22 @@ def _cluster_via_rest(kubeconfig: str, master: Optional[str]) -> ResourceTypes:
     rt = ResourceTypes()
     for path, field, wrap in _REST_LISTS:
         req = urllib.request.Request(server + path, headers=headers)
+        # single attempt per endpoint, TYPED: transient failures become
+        # SnapshotFetchError so the one bounded retry layer — the caller's
+        # whole-snapshot retry_call (SimonServer._refresh_snapshot) — can
+        # retry them. Retrying here too would multiply the attempt budget
+        # to attempts² per endpoint.
         try:
             with urllib.request.urlopen(req, timeout=60, context=ssl_ctx) as resp:
                 body = json.load(resp)
         except urllib.error.HTTPError as e:
             if field in ("pdbs", "storage_classes", "pvcs") and e.code in (403, 404):
                 continue
+            if e.code >= 500:  # apiserver-side transient: retryable
+                raise SnapshotFetchError(f"list {path} failed: HTTP {e.code}") from e
             raise RuntimeError(f"list {path} failed: HTTP {e.code}") from e
         except (urllib.error.URLError, OSError, TimeoutError) as e:
-            raise RuntimeError(f"list {path} failed: {e}") from e
+            raise SnapshotFetchError(f"list {path} failed: {e}") from e
         items: List[dict] = body.get("items") or []
         dest = getattr(rt, field)
         for d in items:

@@ -44,7 +44,15 @@ def maybe_enable(default: bool = False, path: Optional[str] = None) -> Optional[
     ) or path or DEFAULT_DIR
     try:
         os.makedirs(cache_dir, exist_ok=True)
-    except OSError:
+    except OSError as e:
+        # an unwritable cache dir degrades to cold compiles, it must never
+        # fail the caller — but silently eating it hid real misconfiguration
+        # (a wrong OPENSIM_JIT_CACHE path looked identical to disabled)
+        import logging
+
+        logging.getLogger("opensim_tpu").warning(
+            "persistent jit cache disabled: cannot create %s (%s)", cache_dir, e
+        )
         return None
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
     try:  # jax may already be imported: set the config knobs directly too

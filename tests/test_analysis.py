@@ -467,3 +467,108 @@ def test_typed_core_catches_one_line_def_ignore(tmp_path):
     finally:
         typed_core.STRICT_MODULES = orig
     assert len(problems) == 1 and "type: ignore" in problems[0]
+
+
+# ---------------------------------------------------------------------------
+# OSL601 unbounded-retry
+# ---------------------------------------------------------------------------
+
+
+def test_unbounded_retry_flags_while_true_around_network_call():
+    src = """
+    import urllib.request
+
+    def fetch(url):
+        while True:
+            try:
+                return urllib.request.urlopen(url)
+            except OSError:
+                pass                      # swallow and hammer forever
+    """
+    assert _codes(src, rules=["unbounded-retry"]) == ["OSL601"]
+
+
+def test_unbounded_retry_flags_constant_sleep_in_loop():
+    src = """
+    import time
+
+    def poll(client):
+        for _ in range(10):
+            if client.ready():
+                break
+            time.sleep(5)                # constant interval: no backoff
+    """
+    assert _codes(src, rules=["unbounded-retry"]) == ["OSL601"]
+
+
+def test_unbounded_retry_accepts_bounded_backoff_and_escaping_handlers():
+    src = """
+    import time
+    import urllib.request
+
+    def fetch(url, attempts=3):
+        for k in range(attempts):
+            try:
+                return urllib.request.urlopen(url)
+            except OSError:
+                if k == attempts - 1:
+                    raise
+                time.sleep(0.1 * 2 ** k)   # computed: exponential backoff
+
+    def fail_fast(url):
+        while True:
+            try:
+                return urllib.request.urlopen(url)
+            except OSError:
+                raise RuntimeError("down")  # handler escapes: not a retry loop
+
+    def prompt_loop(ask):
+        while True:                          # no network/device call: fine
+            try:
+                return int(ask())
+            except ValueError:
+                pass
+    """
+    assert _codes(src, rules=["unbounded-retry"]) == []
+
+
+def test_unbounded_retry_suppression_and_device_calls():
+    src = """
+    import time, jax
+
+    def hammer(x):
+        while True:
+            try:
+                jax.device_put(x)  # opensim-lint: disable=unbounded-retry
+            except RuntimeError:
+                continue
+    """
+    # the loop finding anchors on the `while` line, which has no suppression
+    flagged = _codes(src, rules=["unbounded-retry"])
+    assert flagged == ["OSL601"]
+    src2 = """
+    import jax
+
+    def hammer(x):
+        # opensim-lint: disable=unbounded-retry
+        while True:
+            try:
+                jax.device_put(x)
+            except RuntimeError:
+                continue
+    """
+    assert _codes(src2, rules=["unbounded-retry"]) == []
+
+
+def test_unbounded_retry_nested_loops_report_sleep_once():
+    src = """
+    import time
+
+    def poll():
+        while running():
+            for _ in range(3):
+                time.sleep(2)
+    """
+    # the sleep belongs to its NEAREST enclosing loop only: one finding,
+    # not one per enclosing loop level
+    assert _codes(src, rules=["unbounded-retry"]) == ["OSL601"]

@@ -450,3 +450,55 @@ def test_raw_objects_are_watched_too():
     prepcache.simulate_cached(cluster, apps, cache)
     pdb.touch()
     assert cache.invalidate(pdb) == 1  # the protocol covers RawObject kinds
+
+
+def test_concurrent_requests_stale_self_eviction_one_failure_then_recovery():
+    """ISSUE 3 satellite: a touch() landing mid-flight under concurrent
+    requests. The first check_fresh to see the bumped version raises AND
+    evicts everything the object taints, so concurrent peers either fail the
+    same way (they raced the eviction) or rebuild cleanly — and the system
+    always recovers: the next sequential call succeeds with the same
+    placements as the pristine baseline."""
+    import threading as _threading
+
+    cluster, apps = _cluster(), _apps()
+    cache = prepcache.PrepareCache()
+    baseline = prepcache.simulate_cached(cluster, apps, cache)
+
+    def shape(res):
+        return (
+            sorted((ns.node.metadata.name, len(ns.pods)) for ns in res.node_status),
+            sorted(u.reason for u in res.unscheduled_pods),
+        )
+
+    cluster.nodes[0].touch()  # mid-flight mutation marker, no invalidation
+
+    n_threads = 4
+    barrier = _threading.Barrier(n_threads)
+    outcomes = [None] * n_threads
+
+    def request(i):
+        barrier.wait()
+        try:
+            outcomes[i] = ("ok", prepcache.simulate_cached(cluster, apps, cache))
+        except prepcache.StaleFingerprintError as e:
+            outcomes[i] = ("stale", e)
+
+    threads = [_threading.Thread(target=request, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    kinds = [k for k, _ in outcomes]
+    # the entry was provably stale: at least one request failed loudly...
+    assert kinds.count("stale") >= 1
+    # ...and every request either failed typed or returned a correct result
+    for kind, payload in outcomes:
+        if kind == "ok":
+            assert shape(payload) == shape(baseline)
+
+    # recovery: the eviction happened exactly once, the rebuilt entry serves
+    res = prepcache.simulate_cached(cluster, apps, cache)
+    assert shape(res) == shape(baseline)
+    prepcache.simulate_cached(cluster, apps, cache)  # and hits cleanly

@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos
+.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke
 
 all: test
 
@@ -27,8 +27,13 @@ mypy:
 chaos:
 	python -m pytest tests/test_chaos.py tests/test_resilience.py -q
 
-# the CI gate: static analysis + types + tier-1 tests + chaos suite
-verify: lint mypy test-quick chaos
+# perf gate (ISSUE 4): a small affinity workload must engage the C++
+# engine's incremental cache AND match the forced-generic path bit-for-bit
+perf-smoke:
+	python tools/perf_smoke.py
+
+# the CI gate: static analysis + types + tier-1 tests + chaos suite + perf gate
+verify: lint mypy test-quick chaos perf-smoke
 
 # run the moment the TPU tunnel opens (tools/tpu_probe_loop.sh writes
 # /tmp/opensim-tpu-watch.up): compiled-Mosaic parity suite + full bench
@@ -52,6 +57,7 @@ bench-all: bench
 	python bench.py --config gpushare
 	python bench.py --pods 10000 --nodes 1000
 	python bench.py --config affinity --pods 5000 --nodes 500
+	python bench.py --config affinity
 	python bench.py --config defrag --scenarios 64 --nodes 200 --pods 2000
 	python bench.py --config bigu --pods 50000 --nodes 5000
 	python bench.py --config forced --pods 50000 --nodes 5000

@@ -440,6 +440,19 @@ def main() -> int:
         record["engine"] = result.engine.name
         if result.engine.skipped:
             record["engine_skipped"] = result.engine.skipped
+        # C++ engine path attribution (ISSUE 4): incremental vs generic —
+        # a cache disengage must be visible in the record, never inferred
+        if result.engine.native_path is not None:
+            record["native_path"] = result.engine.native_path
+            record["native_steps"] = result.engine.native_steps
+    if os.environ.get("OPENSIM_NATIVE_PROFILE"):
+        # per-stage engine timings as structured data (still ONE JSON line);
+        # populated by the C++ engine when profiling is enabled
+        from opensim_tpu.engine import nativepath as _np_path
+
+        prof = _np_path.last_profile()
+        if prof is not None:
+            record["native_profile"] = prof
     serial, cxx = _serial_floors(
         args.config, scheduled + len(result.unscheduled_pods), args.nodes
     )

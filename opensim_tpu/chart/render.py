@@ -494,6 +494,10 @@ def _render_block(tokens, pos, ctx, stop) -> tuple:
             if _truthy(val):
                 sub = _child_scope(ctx)
                 sub["."] = val
+                # Go scoping: the with body's dot is the pivot value, so
+                # .Values/.Release/... resolve against IT (same rule as
+                # range bodies; the else branch keeps the outer dot)
+                sub["__scoped_dot__"] = True
                 body, _ = _render_block(tokens, i + 1, sub, stop={"else", "end"})
                 parts.append(body)
             elif else_pos is not None:
@@ -536,6 +540,10 @@ def _render_block(tokens, pos, ctx, stop) -> tuple:
                     else:
                         sub["__vars__"].declare(var_names[0], v)
                 sub["."] = v
+                # Go scoping: inside the body the dot IS the item, so
+                # .Values/.Release/... no longer reach the chart root
+                # (_eval_atom enforces it; $.Values stays available)
+                sub["__scoped_dot__"] = True
                 body, _ = _render_block(tokens, i + 1, sub, stop={"else", "end"})
                 parts.append(body)
             i = end_pos + 1
@@ -707,6 +715,20 @@ def _eval_atom(atom: str, ctx: dict) -> Any:
     if atom == ".":
         return ctx.get(".", ctx)
     if atom.startswith("."):
+        if _is_root_path(atom) and ctx.get("__scoped_dot__"):
+            # helm/Go scoping: inside a {{ range }}/{{ with }} body the dot
+            # is the item/pivot — .Values/.Release/... resolve against it,
+            # not the chart root ($.Values reaches the root). Go errors on
+            # a non-map dot; a map dot follows plain key lookup. Silently
+            # resolving from the root rendered manifests helm refuses.
+            dot = ctx.get(".", ctx)
+            if isinstance(dot, dict):
+                return _lookup(dot, atom[1:])
+            raise ChartError(
+                f"{atom} inside a range/with body resolves against the "
+                f"rebound dot ({type(dot).__name__}), not the chart root — "
+                f"use ${atom}"
+            )
         base = ctx.get(".", ctx) if "." in ctx and not _is_root_path(atom) else ctx
         return _lookup(ctx if _is_root_path(atom) else base, atom[1:])
     return None

@@ -121,6 +121,7 @@ def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=N
     from ..resilience import faults
     from .scheduler import ScheduleOutput
 
+    _LAST_PROFILE[0] = None  # never inherit a previous run's timings
     # runtime-failure injection (chaos suite): a fault here stands in for
     # ABI drift / a .so crash; simulate()'s ladder demotes to the XLA scan
     faults.fault_point("engine.compile")
@@ -163,6 +164,9 @@ def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=N
         "fail_counts": np.zeros((P, kernels.NUM_FILTERS - kernels.F_PORTS), np.int32),
         "insufficient": np.zeros((P, R), np.int32),
         "gpu_take": np.zeros((P, Gd), np.float32),
+        # path attribution + OPENSIM_NATIVE_PROFILE phase timings
+        "path_counts": np.zeros(3, np.int32),
+        "profile_out": np.zeros(12, np.float64),
     }
 
     dims = {
@@ -232,7 +236,55 @@ def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=N
         gpu_take=outputs["gpu_take"],
         static_fail=np.asarray(stat.static_fail),
         final_state=ScanState(**state),
+        native_stats=_path_stats(outputs["path_counts"], outputs["profile_out"]),
     )
+
+
+_PROFILE_PHASES = ("delta", "full_eval", "argmax", "bind", "fail", "generic")
+
+# most recent scan's per-phase timings (OPENSIM_NATIVE_PROFILE only) — read
+# by bench.py to put a structured `native_profile` field on its JSON line.
+# Cleared at the start of every schedule() call so a run that never reached
+# the C++ engine can't inherit a previous run's numbers; a segmented
+# multi-profile run leaves the LAST segment's scan here.
+_LAST_PROFILE: list = [None]
+
+
+def last_profile():
+    """Per-phase {seconds, steps} of the most recent C++ engine scan in
+    this process, or None when OPENSIM_NATIVE_PROFILE was not set or no
+    native scan has run since the last schedule() attempt."""
+    return _LAST_PROFILE[0]
+
+
+def _path_stats(path_counts: np.ndarray, profile_out: np.ndarray) -> dict:
+    """Engine path attribution (ISSUE 4 satellite: a silent incremental-cache
+    disengage must be visible): which evaluation path served the scheduled
+    steps, plus the per-phase OPENSIM_NATIVE_PROFILE timings when enabled."""
+    inc, gen, full = (int(x) for x in path_counts)
+    if inc and gen:
+        path = "mixed"
+    elif inc:
+        path = "incremental"
+    elif gen:
+        path = "generic"
+    else:
+        path = "none"  # every pod forced/invalid: no scheduling step ran
+    stats = {
+        "path": path,
+        "steps": {"incremental": inc, "generic": gen, "full_evals": full},
+    }
+    if profile_out.any():
+        stats["profile"] = {
+            _PROFILE_PHASES[k]: {
+                "seconds": round(float(profile_out[2 * k]), 6),
+                "steps": int(profile_out[2 * k + 1]),
+            }
+            for k in range(len(_PROFILE_PHASES))
+            if profile_out[2 * k + 1] > 0
+        }
+        _LAST_PROFILE[0] = stats["profile"]
+    return stats
 
 
 def sweep(prep, node_valid_masks, pod_valid_masks, forced_masks, config=None):

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import functools
 import os
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -54,6 +54,10 @@ class ScheduleOutput(NamedTuple):
     gpu_take: jnp.ndarray  # [P, Gd] f32 GPU slots packed per device
     static_fail: jnp.ndarray  # [U, 4] i32 — static filters (pin/unsched/taint/affinity)
     final_state: ScanState
+    # C++ engine only: which evaluation path ran ({"path", "steps",
+    # "profile"?}) — attribution so a silent incremental-cache disengage
+    # can never masquerade as a tuned number (None on the XLA/fast paths)
+    native_stats: Optional[dict] = None
 
 
 def _step(ec: EncodedCluster, stat, feat, cfg, extra, st: ScanState, x, select_key=None):

@@ -72,16 +72,25 @@ class EngineDecision:
     """Which scheduling engine actually ran and why the others were skipped
     (VERDICT r4 #3: no silent engine fallbacks). ``name`` is one of
     ``megakernel`` (Pallas), ``native`` (C++), ``xla`` (lax.scan);
-    ``skipped`` maps each engine that did NOT run to a one-line reason."""
+    ``skipped`` maps each engine that did NOT run to a one-line reason.
+
+    For the C++ engine, ``native_path`` names the evaluation path that
+    served the scheduled steps (``incremental`` / ``generic`` / ``mixed``)
+    and ``native_steps`` carries the per-path step counts — a silent
+    incremental-cache disengage is an attribution fact, not a guess from
+    wall-clock (ISSUE 4)."""
 
     name: str
     skipped: Dict[str, str] = field(default_factory=dict)
+    native_path: Optional[str] = None
+    native_steps: Optional[Dict[str, int]] = None
 
     def describe(self) -> str:
+        base = self.name if self.native_path is None else f"{self.name}/{self.native_path}"
         if not self.skipped:
-            return self.name
+            return base
         why = "; ".join(f"{k}: {v}" for k, v in sorted(self.skipped.items()))
-        return f"{self.name} (skipped {why})"
+        return f"{base} (skipped {why})"
 
 
 @dataclass
@@ -497,6 +506,7 @@ def _run_segments(
 
     st = prep.st0
     final_state = None
+    seg_stats = []
     for cfg, lo, hi in segments:
         seg_valid = np.zeros((P,), dtype=bool)
         seg_valid[lo:hi] = pod_valid[lo:hi]
@@ -505,6 +515,8 @@ def _run_segments(
                 prep, seg_valid, config=cfg, node_valid=nv_mask,
                 tie_seed=tie_seed, st0=st,
             )
+            if out.native_stats is not None:
+                seg_stats.append(out.native_stats)
         else:
             tmpl_p, valid_p, forced_p = pad_pod_stream(tmpl_ids, seg_valid, forced)
             ec_run = (
@@ -530,6 +542,16 @@ def _run_segments(
 
     from .scheduler import ScheduleOutput
 
+    merged_stats = None
+    if seg_stats:
+        counts = {"incremental": 0, "generic": 0, "full_evals": 0}
+        for st_ in seg_stats:
+            for k in counts:
+                counts[k] += int(st_["steps"].get(k, 0))
+        inc, gen = counts["incremental"], counts["generic"]
+        path = "mixed" if inc and gen else "incremental" if inc else "generic" if gen else "none"
+        merged_stats = {"path": path, "steps": counts}
+
     stitched = ScheduleOutput(
         chosen=chosen,
         fail_counts=fail_counts,
@@ -537,6 +559,7 @@ def _run_segments(
         gpu_take=gpu_take,
         static_fail=sf_pod,  # per POD, not per template (sf_rows=arange)
         final_state=final_state,
+        native_stats=merged_stats,
     )
     return stitched, ("native" if use_native else "xla")
 
@@ -856,8 +879,15 @@ def simulate(
                 unroll=scan_unroll(), tie_seed=tie_seed,
             )
             jax.block_until_ready(out.chosen)  # dispatch is async; trace real device time
-        engine = EngineDecision(name=engine_name, skipped=skips)
-        tr.step(f"schedule {len(ordered)} pods [engine={engine_name}]")
+        nstats = getattr(out, "native_stats", None)
+        engine = EngineDecision(
+            name=engine_name,
+            skipped=skips,
+            native_path=nstats["path"] if nstats else None,
+            native_steps=dict(nstats["steps"]) if nstats else None,
+        )
+        engine_label = engine_name if nstats is None else f"{engine_name}/{nstats['path']}"
+        tr.step(f"schedule {len(ordered)} pods [engine={engine_label}]")
     check_deadline("decode")
     out = out._replace(
         chosen=out.chosen[: len(ordered)],

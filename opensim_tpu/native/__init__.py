@@ -50,6 +50,7 @@ _WEIGHTS = [
 _U8 = ctypes.POINTER(ctypes.c_uint8)
 _I32 = ctypes.POINTER(ctypes.c_int32)
 _F32 = ctypes.POINTER(ctypes.c_float)
+_F64 = ctypes.POINTER(ctypes.c_double)
 _BUFFERS = [
     ("node_valid", _U8, "u8"), ("alloc", _F32, "f32"),
     ("node_domain", _I32, "i32"), ("domain_topo", _I32, "i32"),
@@ -81,9 +82,12 @@ _BUFFERS = [
     ("vg_free", _F32, "f32"), ("dev_free", _F32, "f32"),
     ("chosen", _I32, "i32"), ("fail_counts", _I32, "i32"),
     ("insufficient", _I32, "i32"), ("gpu_take", _F32, "f32"),
+    # path attribution ({incremental, generic, full_eval} step counts) and
+    # the OPENSIM_NATIVE_PROFILE per-phase {seconds, steps} pairs
+    ("path_counts", _I32, "i32"), ("profile_out", _F64, "f64"),
 ]
 
-_NP_DTYPES = {"u8": "uint8", "i32": "int32", "f32": "float32"}
+_NP_DTYPES = {"u8": "uint8", "i32": "int32", "f32": "float32", "f64": "float64"}
 
 
 class ScanArgs(ctypes.Structure):

@@ -130,9 +130,17 @@ struct ScanArgs {
   int32_t* fail_counts;   // [P,7] dynamic-filter first-fail counts
   int32_t* insufficient;  // [P,R]
   float* gpu_take;        // [P,Gd]
+  // path attribution: scheduled steps served by the incremental cache vs
+  // the generic full re-evaluation, plus incremental-path full_eval count
+  // (a silent cache disengage must be visible to callers, not inferred
+  // from wall-clock)
+  int32_t* path_counts;   // [3] {incremental steps, generic steps, full_evals}
+  // per-phase {seconds, steps} pairs in Prof order (delta, full_eval,
+  // argmax, bind, fail, generic); filled only under OPENSIM_NATIVE_PROFILE
+  double* profile_out;    // [12]
 };
 
-int64_t opensim_abi_version() { return 2; }
+int64_t opensim_abi_version() { return 3; }
 int64_t opensim_args_size() { return (int64_t)sizeof(ScanArgs); }
 
 }  // extern "C"
@@ -159,8 +167,10 @@ struct Scratch {
   // the label (trash row is shared across keys, so it needs per-key lists)
   std::vector<std::vector<int32_t>> dom_members;
   std::vector<std::vector<int32_t>> trash_members;
+  std::vector<std::vector<int32_t>> key_doms;  // [Tk] real domains per key
   std::vector<int32_t> visited;  // epoch stamps for member-union dedup
   std::vector<int32_t> touch;    // affected nodes collected this delta
+  std::vector<int32_t> flip_doms;  // hard-spread domains whose verdict flipped
   int32_t epoch = 0;
   // [N] dynamic gpu-count allocatable (-1 on device-less nodes); filled and
   // maintained only under ft_gc_dyn — gpu_free changes only at bind, so one
@@ -182,9 +192,35 @@ struct TmplCache {
   int32_t u = -1;
   bool valid = false;
   bool prev_failed = false;
-  std::vector<int32_t> pending;  // nodes bound since the cache was computed
+  // (node, binder template) bound since the cache was computed — the
+  // binder identifies which (domain, selector) counts a forced foreign
+  // bind could have moved
+  std::vector<std::pair<int32_t, int32_t>> pending;
   std::vector<uint8_t> feas;
   std::vector<uint8_t> ignored;
+  // interpod incremental state (round 9): per-node filter verdicts + score
+  // raws cached per template; a bind invalidates only the members of the
+  // domains it touched (counts-only-grow + feasibility-flip-bail, the same
+  // contract as the spread caches below)
+  bool ip_f_act = false;      // template carries filter-relevant terms
+  bool ip_s_act = false;      // template carries score-relevant terms
+  bool ip_any_at = false, ip_bootstrap = false;
+  bool ip_hi_stale = false, ip_lo_stale = false;
+  std::vector<uint8_t> ip_mask;  // [N] interpod filter verdict (ip_f_act)
+  std::vector<float> ip_raw;     // [N] interpod score raw (ip_s_act)
+  float ip_rhi = 0, ip_rlo = 0;  // reductions of the feas-masked raw
+  // hard-spread incremental state: every member of a topology domain
+  // shares one verdict (cnt + selfm - min_cnt <= skew), so a bind updates
+  // per-DOMAIN state and touches member nodes only on a verdict flip
+  struct HardSpread {
+    int32_t tk, sel;
+    float skew, selfm, min_cnt;
+    std::vector<uint8_t> elig;  // [Dp1] domain has an eligible member
+    std::vector<uint8_t> verd;  // [Dp1] per-domain verdict (trash stays 0)
+  };
+  std::vector<HardSpread> hards;
+  std::vector<uint8_t> sh_mask;  // [N] AND over hards (valid when any)
+  bool has_hard = false;
   std::vector<float> pre;         // bal+least+na+tt accumulated in pod_step order
   std::vector<float> spr_raw, share_term, av_term, score;
   float sh_lo = 0, sh_hi = 0, sh_rng = 0, na_max = 0, tt_max = 0;
@@ -426,11 +462,17 @@ void spread_mask(const ScanArgs& a, int32_t u, uint8_t* out) {
   }
 }
 
-void interpod_mask(const ScanArgs& a, const Scratch& s, int32_t u, uint8_t* out) {
-  const int64_t N = a.N, Tk = a.Tk, A = a.A, Ti = a.Ti, Tn = a.Tn, G = a.G;
-  const int32_t trash = (int32_t)a.Dp1 - 1;
-  // incoming required-affinity bookkeeping (filtering.go:347-374): the
-  // bootstrap needs the GLOBAL count map empty and a full self-match
+// Incoming required-affinity bookkeeping (filtering.go:347-374): the
+// bootstrap needs the GLOBAL count map empty and a full self-match.
+// Shared by the batch mask and the incremental delta path (the delta bails
+// on a bootstrap flip — it invalidates every node's verdict at once).
+struct IpBoot {
+  bool any_at;
+  bool bootstrap;
+};
+
+inline IpBoot ip_boot_of(const ScanArgs& a, const Scratch& s, int32_t u) {
+  const int64_t A = a.A, Ti = a.Ti;
   float total_active = 0.0f;
   bool all_self = true, any_at = false;
   for (int64_t t = 0; t < Ti; t++) {
@@ -440,39 +482,51 @@ void interpod_mask(const ScanArgs& a, const Scratch& s, int32_t u, uint8_t* out)
     total_active += s.key_sel_total[(int64_t)a.at_topo[u * Ti + t] * A + sel];
     if (!a.matches_sel[(int64_t)u * A + sel]) all_self = false;
   }
-  bool bootstrap = (total_active == 0.0f) && all_self && any_at;
+  return {any_at, (total_active == 0.0f) && all_self && any_at};
+}
 
-  for (int64_t n = 0; n < N; n++) {
-    const int32_t* nd = a.node_domain + n * Tk;
-    bool ok = true;
-    // (1) incoming pod's required anti-affinity terms
-    for (int64_t t = 0; t < Tn && ok; t++) {
-      int32_t sel = a.an_sel[u * Tn + t];
-      if (sel < 0) continue;
-      int32_t dom = nd[a.an_topo[u * Tn + t]];
-      if (dom < trash && a.dom_sel[(int64_t)dom * A + sel] > 0.0f) ok = false;
-    }
-    // (2) existing pods' anti terms matching the incoming pod (symmetric)
-    for (int64_t g = 0; g < G && ok; g++) {
-      if (!a.matches_sel[(int64_t)u * A + a.anti_g_sel[g]]) continue;
-      int32_t dom = nd[a.anti_g_topo[g]];
-      if (dom < trash && a.dom_anti[(int64_t)dom * G + g] > 0.0f) ok = false;
-    }
-    // (3) incoming required affinity
-    if (ok && any_at) {
-      bool per_ok = true, labels_ok = true;
-      for (int64_t t = 0; t < Ti; t++) {
-        int32_t sel = a.at_sel[u * Ti + t];
-        if (sel < 0) continue;
-        int32_t dom = nd[a.at_topo[u * Ti + t]];
-        bool has = dom < trash;
-        if (!has) labels_ok = false;
-        if (!(has && a.dom_sel[(int64_t)dom * A + sel] > 0.0f)) per_ok = false;
-      }
-      ok = per_ok || (labels_ok && bootstrap);
-    }
-    out[n] = ok;
+// Single-node interpod filter verdict — the loop body of interpod_mask,
+// shared with the incremental cache's affected-domain recomputation so
+// both produce identical verdicts.
+inline uint8_t ip_mask_at(const ScanArgs& a, int32_t u, int64_t n, bool any_at,
+                          bool bootstrap) {
+  const int64_t Tk = a.Tk, A = a.A, Ti = a.Ti, Tn = a.Tn, G = a.G;
+  const int32_t trash = (int32_t)a.Dp1 - 1;
+  const int32_t* nd = a.node_domain + n * Tk;
+  bool ok = true;
+  // (1) incoming pod's required anti-affinity terms
+  for (int64_t t = 0; t < Tn && ok; t++) {
+    int32_t sel = a.an_sel[u * Tn + t];
+    if (sel < 0) continue;
+    int32_t dom = nd[a.an_topo[u * Tn + t]];
+    if (dom < trash && a.dom_sel[(int64_t)dom * A + sel] > 0.0f) ok = false;
   }
+  // (2) existing pods' anti terms matching the incoming pod (symmetric)
+  for (int64_t g = 0; g < G && ok; g++) {
+    if (!a.matches_sel[(int64_t)u * A + a.anti_g_sel[g]]) continue;
+    int32_t dom = nd[a.anti_g_topo[g]];
+    if (dom < trash && a.dom_anti[(int64_t)dom * G + g] > 0.0f) ok = false;
+  }
+  // (3) incoming required affinity
+  if (ok && any_at) {
+    bool per_ok = true, labels_ok = true;
+    for (int64_t t = 0; t < Ti; t++) {
+      int32_t sel = a.at_sel[u * Ti + t];
+      if (sel < 0) continue;
+      int32_t dom = nd[a.at_topo[u * Ti + t]];
+      bool has = dom < trash;
+      if (!has) labels_ok = false;
+      if (!(has && a.dom_sel[(int64_t)dom * A + sel] > 0.0f)) per_ok = false;
+    }
+    ok = per_ok || (labels_ok && bootstrap);
+  }
+  return (uint8_t)ok;
+}
+
+void interpod_mask(const ScanArgs& a, const Scratch& s, int32_t u, uint8_t* out) {
+  const int64_t N = a.N;
+  IpBoot b = ip_boot_of(a, s, u);
+  for (int64_t n = 0; n < N; n++) out[n] = ip_mask_at(a, u, n, b.any_at, b.bootstrap);
 }
 
 void gpu_mask(const ScanArgs& a, int32_t u, uint8_t* out) {
@@ -523,28 +577,34 @@ void local_mask(const ScanArgs& a, int32_t u, uint8_t* out) {
 
 // ---- score raws ----
 
+// Single-node interpod score raw — the loop body of interpod_raw, shared
+// with the incremental cache (same float accumulation order, so cached
+// values are bit-identical to a full recomputation).
+inline float ip_raw_at(const ScanArgs& a, int32_t u, int64_t n) {
+  const int64_t Tk = a.Tk, A = a.A, Tpp = a.Tpp, Gp = a.Gp;
+  const int32_t trash = (int32_t)a.Dp1 - 1;
+  const int32_t* nd = a.node_domain + n * Tk;
+  float incoming = 0.0f;
+  for (int64_t t = 0; t < Tpp; t++) {
+    int32_t sel = a.pt_sel[u * Tpp + t];
+    int32_t dom = nd[a.pt_topo[u * Tpp + t]];
+    if (sel >= 0 && dom < trash)
+      incoming += a.dom_sel[(int64_t)dom * A + sel] * a.pt_w[u * Tpp + t];
+  }
+  float symmetric = 0.0f;
+  for (int64_t g = 0; g < Gp; g++) {
+    int32_t dom = nd[a.prefg_topo[g]];
+    if (dom < trash)
+      symmetric += a.dom_prefw[(int64_t)dom * Gp + g] *
+                   (float)a.matches_sel[(int64_t)u * A + a.prefg_sel[g]];
+  }
+  return incoming + symmetric;
+}
+
 void interpod_raw(const ScanArgs& a, int32_t u, float* out) {
   // interpod_score (scoring.go): incoming preferred terms + symmetric terms
-  const int64_t N = a.N, Tk = a.Tk, A = a.A, Tpp = a.Tpp, Gp = a.Gp;
-  const int32_t trash = (int32_t)a.Dp1 - 1;
-  for (int64_t n = 0; n < N; n++) {
-    const int32_t* nd = a.node_domain + n * Tk;
-    float incoming = 0.0f;
-    for (int64_t t = 0; t < Tpp; t++) {
-      int32_t sel = a.pt_sel[u * Tpp + t];
-      int32_t dom = nd[a.pt_topo[u * Tpp + t]];
-      if (sel >= 0 && dom < trash)
-        incoming += a.dom_sel[(int64_t)dom * A + sel] * a.pt_w[u * Tpp + t];
-    }
-    float symmetric = 0.0f;
-    for (int64_t g = 0; g < Gp; g++) {
-      int32_t dom = nd[a.prefg_topo[g]];
-      if (dom < trash)
-        symmetric += a.dom_prefw[(int64_t)dom * Gp + g] *
-                     (float)a.matches_sel[(int64_t)u * A + a.prefg_sel[g]];
-    }
-    out[n] = incoming + symmetric;
-  }
+  const int64_t N = a.N;
+  for (int64_t n = 0; n < N; n++) out[n] = ip_raw_at(a, u, n);
 }
 
 bool spread_raw(const ScanArgs& a, int32_t u, const uint8_t* feas, float* out,
@@ -760,9 +820,9 @@ void fail_accounting(ScanArgs& a, Scratch& s, const bool* act, int32_t u, int64_
 }
 
 struct EnvCtx {
-  bool act_fit;
-  bool use_spr, use_share, use_avoid;
-  float wsp, wshare, wav;
+  bool act_fit, act_spread, act_interpod;
+  bool use_spr, use_share, use_avoid, use_ip;
+  float wsp, wshare, wav, wip;
 };
 
 inline float recombine(const TmplCache& tc, const EnvCtx& e, int64_t n) {
@@ -774,8 +834,8 @@ inline float recombine(const TmplCache& tc, const EnvCtx& e, int64_t n) {
   return sc;
 }
 
-// Full per-template evaluation into the cache (incremental envelope only:
-// active dynamic masks ⊆ {fit}, no interpod/local score).
+// Full per-template evaluation into the cache (incremental envelope:
+// active dynamic masks ⊆ {fit, spread, interpod}, no local/gpu score).
 void full_eval_env(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e,
                    PreCtx& c, int32_t u) {
   const int64_t N = a.N;
@@ -783,6 +843,80 @@ void full_eval_env(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e,
   tc.valid = true;
   tc.prev_failed = false;
   tc.pending.clear();
+
+  // hard-spread constraints: one verdict per topology domain (all members
+  // share cnt + selfm - min_cnt <= skew); the per-node mask is a gather
+  const int32_t trash_d = (int32_t)a.Dp1 - 1;
+  tc.hards.clear();
+  if (e.act_spread) {
+    const uint8_t* am = a.aff_mask + (int64_t)u * N;
+    for (int64_t cc = 0; cc < a.Cs; cc++) {
+      int32_t tk = a.spr_topo[u * a.Cs + cc];
+      if (tk < 0 || !a.spr_hard[u * a.Cs + cc]) continue;
+      TmplCache::HardSpread hc;
+      hc.tk = tk;
+      hc.sel = a.spr_sel[u * a.Cs + cc];
+      hc.skew = (float)a.spr_skew[u * a.Cs + cc];
+      hc.selfm = (float)a.matches_sel[(int64_t)u * a.A + hc.sel];
+      hc.elig.assign(a.Dp1, 0);
+      for (int64_t n = 0; n < N; n++) {
+        int32_t d = a.node_domain[n * a.Tk + tk];
+        if (d < trash_d && am[n] && a.node_valid[n]) hc.elig[d] = 1;
+      }
+      float mn = BIG;
+      for (int32_t d : s.key_doms[tk])
+        if (hc.elig[d]) mn = std::min(mn, a.dom_sel[(int64_t)d * a.A + hc.sel]);
+      hc.min_cnt = mn;
+      hc.verd.assign(a.Dp1, 0);
+      for (int32_t d : s.key_doms[tk])
+        hc.verd[d] =
+            (uint8_t)(a.dom_sel[(int64_t)d * a.A + hc.sel] + hc.selfm - mn <= hc.skew);
+      tc.hards.push_back(std::move(hc));
+    }
+  }
+  tc.has_hard = !tc.hards.empty();
+  if (tc.has_hard) {
+    for (int64_t n = 0; n < N; n++) {
+      uint8_t m = 1;
+      for (const auto& hc : tc.hards) {
+        int32_t d = a.node_domain[n * a.Tk + hc.tk];
+        m &= (uint8_t)(d < trash_d && hc.verd[d]);
+      }
+      tc.sh_mask[n] = m;
+    }
+  }
+
+  // interpod filter: per-node verdicts cached; the bootstrap flag is a
+  // global-count fact re-checked (and bailed on) by every delta
+  tc.ip_f_act = false;
+  tc.ip_any_at = tc.ip_bootstrap = false;
+  if (e.act_interpod) {
+    for (int64_t t = 0; t < a.Ti && !tc.ip_f_act; t++)
+      if (a.at_sel[u * a.Ti + t] >= 0) tc.ip_f_act = true;
+    for (int64_t t = 0; t < a.Tn && !tc.ip_f_act; t++)
+      if (a.an_sel[u * a.Tn + t] >= 0) tc.ip_f_act = true;
+    for (int64_t g = 0; g < a.G && !tc.ip_f_act; g++)
+      if (a.matches_sel[(int64_t)u * a.A + a.anti_g_sel[g]]) tc.ip_f_act = true;
+    if (tc.ip_f_act) {
+      IpBoot b = ip_boot_of(a, s, u);
+      tc.ip_any_at = b.any_at;
+      tc.ip_bootstrap = b.bootstrap;
+      for (int64_t n = 0; n < N; n++)
+        tc.ip_mask[n] = ip_mask_at(a, u, n, b.any_at, b.bootstrap);
+    }
+  }
+
+  // interpod score: raw cached per node, min/max maintained across deltas
+  tc.ip_s_act = false;
+  tc.ip_hi_stale = tc.ip_lo_stale = false;
+  if (e.use_ip) {
+    for (int64_t t = 0; t < a.Tpp && !tc.ip_s_act; t++)
+      if (a.pt_sel[u * a.Tpp + t] >= 0) tc.ip_s_act = true;
+    for (int64_t g = 0; g < a.Gp && !tc.ip_s_act; g++)
+      if (a.matches_sel[(int64_t)u * a.A + a.prefg_sel[g]]) tc.ip_s_act = true;
+    // a term-less template's raw is identically 0 → range 0 → the
+    // normalized term is exactly 0 for every node: treat as inactive
+  }
 
   tc.any_soft = false;
   int n_soft = 0;
@@ -886,9 +1020,19 @@ void full_eval_env(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e,
   const uint8_t* sp = a.static_pass + (int64_t)u * N;
   const float* share = a.share_raw + (int64_t)u * N;
   float na_m = NEG, tt_m = NEG, shlo = BIG, shhi = NEG;
+  float iphi = NEG, iplo = BIG;
   for (int64_t n = 0; n < N; n++) {
     uint8_t f = sp[n] && (e.act_fit ? fit_at(a, u, n) : 1);
+    if (tc.has_hard) f = f && tc.sh_mask[n];
+    if (tc.ip_f_act) f = f && tc.ip_mask[n];
     tc.feas[n] = f;
+    if (tc.ip_s_act) {
+      float r = ip_raw_at(a, u, n);
+      tc.ip_raw[n] = r;
+      float v = f ? r : 0.0f;
+      iphi = std::max(iphi, v);
+      iplo = std::min(iplo, v);
+    }
     if (c.use_na) na_m = std::max(na_m, f ? c.na[n] : 0.0f);
     if (c.use_tt) tt_m = std::max(tt_m, f ? c.tt[n] : 0.0f);
     if (e.use_share && f) {
@@ -925,6 +1069,8 @@ void full_eval_env(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e,
   tc.sh_lo = shlo;
   tc.sh_hi = shhi;
   tc.sh_rng = shhi - shlo;
+  tc.ip_rhi = iphi;
+  tc.ip_rlo = iplo;
   if (e.use_spr && tc.any_soft) {
     float mn = BIG, mx = NEG;
     for (int64_t n = 0; n < N; n++) {
@@ -974,7 +1120,9 @@ void full_eval_env(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e,
     }
   }
   const float* avoid = a.avoid_score + (int64_t)u * N;
-  const bool lazy = e.use_spr && tc.any_soft;  // select combines on the fly
+  // select combines on the fly (lazy) whenever a score term's
+  // normalization scalars can move between binds (soft spread, interpod)
+  const bool lazy = (e.use_spr && tc.any_soft) || tc.ip_s_act;
   for (int64_t n = 0; n < N; n++) {
     tc.pre[n] = pre_at(a, c, n);
     if (e.use_share)
@@ -988,11 +1136,150 @@ void full_eval_env(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e,
 // Fold the pending binds into the cache. Returns false when something it
 // cannot prove unchanged shifted (feasible-set flip) — caller re-evaluates.
 bool apply_deltas(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e, PreCtx& c) {
-  const int64_t N = a.N, Tk = a.Tk, Cs = a.Cs;
+  const int64_t N = a.N, Tk = a.Tk, Cs = a.Cs, A = a.A;
   const int32_t u = tc.u;
+  const int32_t trash_d = (int32_t)a.Dp1 - 1;
+  if (tc.ip_f_act) {
+    // the affinity bootstrap is a fact about the GLOBAL count map: a flip
+    // (it only ever goes true → false; counts grow) moves every node's
+    // verdict at once — re-evaluate rather than patch
+    IpBoot b = ip_boot_of(a, s, u);
+    if (b.bootstrap != tc.ip_bootstrap) return false;
+  }
+  // combined feasibility of node n from the cached masks + a fresh fit
+  // probe (a pending bind may have changed n's own used row)
+  auto feas_of = [&](int64_t n) -> uint8_t {
+    uint8_t f = a.static_pass[(int64_t)u * N + n] && (e.act_fit ? fit_at(a, u, n) : 1);
+    if (tc.has_hard) f = f && tc.sh_mask[n];
+    if (tc.ip_f_act) f = f && tc.ip_mask[n];
+    return f;
+  };
   for (size_t pi = 0; pi < tc.pending.size(); pi++) {
-    int64_t j = tc.pending[pi];
-    uint8_t f = a.static_pass[(int64_t)u * N + j] && (e.act_fit ? fit_at(a, u, j) : 1);
+    const int64_t j = tc.pending[pi].first;
+    const int32_t bu = tc.pending[pi].second;  // binder's template
+    const uint8_t* bm = a.matches_sel + (int64_t)bu * A;
+
+    // --- hard spread: per-domain verdict maintenance ------------------
+    // The bind moved dom_sel[dj][sel] only when the bound pod matches the
+    // constraint's selector; a verdict flip touches exactly the flipped
+    // domain's member nodes (feasibility-flip-bail keeps reductions exact).
+    for (auto& hc : tc.hards) {
+      if (!bm[hc.sel]) continue;  // counts for this selector did not move
+      int32_t dj = a.node_domain[j * Tk + hc.tk];
+      if (dj == trash_d) continue;  // only the unread trash row grew
+      float mn = BIG;
+      for (int32_t d : s.key_doms[hc.tk])
+        if (hc.elig[d]) mn = std::min(mn, a.dom_sel[(int64_t)d * A + hc.sel]);
+      s.flip_doms.clear();
+      if (mn != hc.min_cnt) {
+        hc.min_cnt = mn;
+        for (int32_t d : s.key_doms[hc.tk]) {
+          uint8_t v =
+              (uint8_t)(a.dom_sel[(int64_t)d * A + hc.sel] + hc.selfm - mn <= hc.skew);
+          if (v != hc.verd[d]) {
+            hc.verd[d] = v;
+            s.flip_doms.push_back(d);
+          }
+        }
+      } else {
+        uint8_t v =
+            (uint8_t)(a.dom_sel[(int64_t)dj * A + hc.sel] + hc.selfm - mn <= hc.skew);
+        if (v != hc.verd[dj]) {
+          hc.verd[dj] = v;
+          s.flip_doms.push_back(dj);
+        }
+      }
+      for (int32_t d : s.flip_doms)
+        for (int32_t n : s.dom_members[d]) {
+          uint8_t m = 1;
+          for (const auto& h2 : tc.hards) {
+            int32_t dn = a.node_domain[(int64_t)n * Tk + h2.tk];
+            m &= (uint8_t)(dn < trash_d && h2.verd[dn]);
+          }
+          if (m == tc.sh_mask[n]) continue;
+          tc.sh_mask[n] = m;
+          if (feas_of(n) != tc.feas[n]) return false;  // feasible set shifted
+        }
+    }
+
+    // --- interpod filter: affected-domain member recomputation --------
+    if (tc.ip_f_act) {
+      s.epoch++;
+      bool bail = false;
+      auto visit_ipm = [&](int32_t d) {
+        for (int32_t n : s.dom_members[d]) {
+          if (s.visited[n] == s.epoch) continue;
+          s.visited[n] = s.epoch;
+          uint8_t m = ip_mask_at(a, u, n, tc.ip_any_at, tc.ip_bootstrap);
+          if (m == tc.ip_mask[n]) continue;
+          tc.ip_mask[n] = m;
+          if (feas_of(n) != tc.feas[n]) {
+            bail = true;
+            return;
+          }
+        }
+      };
+      for (int64_t t = 0; t < a.Ti && !bail; t++) {
+        int32_t sel = a.at_sel[u * a.Ti + t];
+        if (sel < 0 || !bm[sel]) continue;
+        int32_t d = a.node_domain[j * Tk + a.at_topo[u * a.Ti + t]];
+        if (d < trash_d) visit_ipm(d);
+      }
+      for (int64_t t = 0; t < a.Tn && !bail; t++) {
+        int32_t sel = a.an_sel[u * a.Tn + t];
+        if (sel < 0 || !bm[sel]) continue;
+        int32_t d = a.node_domain[j * Tk + a.an_topo[u * a.Tn + t]];
+        if (d < trash_d) visit_ipm(d);
+      }
+      for (int64_t g = 0; g < a.G && !bail; g++) {
+        if (!a.anti_g[(int64_t)bu * a.G + g]) continue;
+        if (!a.matches_sel[(int64_t)u * A + a.anti_g_sel[g]]) continue;
+        int32_t d = a.node_domain[j * Tk + a.anti_g_topo[g]];
+        if (d < trash_d) visit_ipm(d);
+      }
+      if (bail) return false;
+    }
+
+    // --- interpod score raw: affected members + min/max upkeep --------
+    // pt/prefg weights are SIGNED (preferred anti-affinity), so a raw can
+    // shrink: when a current extremum holder moves inward the reduction is
+    // recomputed exactly after the loop (stale flags), never approximated.
+    if (tc.ip_s_act) {
+      s.epoch++;
+      auto visit_ipr = [&](int32_t d) {
+        for (int32_t n : s.dom_members[d]) {
+          if (s.visited[n] == s.epoch) continue;
+          s.visited[n] = s.epoch;
+          float nr = ip_raw_at(a, u, n);
+          float orr = tc.ip_raw[n];
+          if (nr == orr) continue;
+          tc.ip_raw[n] = nr;
+          if (!tc.feas[n]) continue;  // masked value is 0 either way
+          if (orr == tc.ip_rhi && nr < orr)
+            tc.ip_hi_stale = true;
+          else if (nr > tc.ip_rhi)
+            tc.ip_rhi = nr;
+          if (orr == tc.ip_rlo && nr > orr)
+            tc.ip_lo_stale = true;
+          else if (nr < tc.ip_rlo)
+            tc.ip_rlo = nr;
+        }
+      };
+      for (int64_t t = 0; t < a.Tpp; t++) {
+        int32_t sel = a.pt_sel[u * a.Tpp + t];
+        if (sel < 0 || !bm[sel]) continue;
+        int32_t d = a.node_domain[j * Tk + a.pt_topo[u * a.Tpp + t]];
+        if (d < trash_d) visit_ipr(d);
+      }
+      for (int64_t g = 0; g < a.Gp; g++) {
+        if (a.prefg_w[(int64_t)bu * a.Gp + g] == 0.0f) continue;
+        if (!a.matches_sel[(int64_t)u * A + a.prefg_sel[g]]) continue;
+        int32_t d = a.node_domain[j * Tk + a.prefg_topo[g]];
+        if (d < trash_d) visit_ipr(d);
+      }
+    }
+
+    uint8_t f = feas_of(j);
     if (f != tc.feas[j]) return false;  // feasible set shifted: reductions stale
     tc.pre[j] = pre_at(a, c, j);
 
@@ -1116,7 +1403,21 @@ bool apply_deltas(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e, PreCt
       // unchanged). A moved normalization scalar therefore costs nothing
       // here, where it used to rewrite term+score over the node axis.
     }
-    if (!(e.use_spr && tc.any_soft) && tc.feas[j]) tc.score[j] = recombine(tc, e, j);
+    if (!(e.use_spr && tc.any_soft) && !tc.ip_s_act && tc.feas[j])
+      tc.score[j] = recombine(tc, e, j);
+  }
+  if (tc.ip_hi_stale || tc.ip_lo_stale) {
+    // an extremum holder moved inward: recompute the exact reduction over
+    // the (unchanged — we would have bailed) feasible set
+    float hi = NEG, lo = BIG;
+    for (int64_t n = 0; n < N; n++) {
+      float v = tc.feas[n] ? tc.ip_raw[n] : 0.0f;
+      hi = std::max(hi, v);
+      lo = std::min(lo, v);
+    }
+    tc.ip_rhi = hi;
+    tc.ip_rlo = lo;
+    tc.ip_hi_stale = tc.ip_lo_stale = false;
   }
   tc.pending.clear();
   return true;
@@ -1149,6 +1450,12 @@ struct Prof {
       if (c[k])
         std::fprintf(stderr, "[native] %-9s %8.3fs over %8lld steps (%.1f us/step)\n",
                      names[k], t[k], (long long)c[k], t[k] / c[k] * 1e6);
+  }
+  void dump(double* out) const {  // {seconds, steps} pairs, phase order above
+    for (int k = 0; k < 6; k++) {
+      out[2 * k] = t[k];
+      out[2 * k + 1] = (double)c[k];
+    }
   }
 };
 }  // namespace
@@ -1205,14 +1512,21 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
   const bool use_loc = a.ft_local && a.w_local != 0.0;
   const bool use_avoid = a.ft_prefer_avoid && a.w_prefer_avoid != 0.0;
 
-  // Incremental same-template envelope: the only active dynamic mask may be
-  // fit, and no score component may depend on usage beyond used/dom_sel
-  // (interpod reads dom_prefw, local reads vg/dev state).
-  const bool inc_ok = !act_ports && !act_spread && !act_interpod && !act_gpu &&
-                      !act_local && !use_ip && !use_loc && !a.ft_gc_dyn && a.Cs <= 16;
+  // Incremental same-template envelope: active dynamic masks ⊆ {fit,
+  // spread, interpod} and score components limited to those whose carry
+  // dependencies are tracked per domain (used/dom_sel/dom_anti/dom_prefw
+  // — local reads vg/dev state, gpu-share reads gpu_free).
+  // OPENSIM_NATIVE_FORCE_GENERIC=1 disables the envelope outright (parity
+  // harness + attribution: a tuned number must name the path that made it).
+  const char* fg_env = std::getenv("OPENSIM_NATIVE_FORCE_GENERIC");
+  const bool force_generic = fg_env && fg_env[0] && std::strcmp(fg_env, "0") != 0;
+  const bool inc_ok = !force_generic && !act_ports && !act_gpu && !act_local &&
+                      !use_loc && !a.ft_gc_dyn && a.Cs <= 16;
   constexpr size_t MAX_PENDING = 8;
   TmplCache tc;
-  EnvCtx env{act_fit, use_spr, use_share, use_avoid, wsp, wshare, wav};
+  EnvCtx env{act_fit, act_spread, act_interpod, use_spr, use_share,
+             use_avoid, use_ip, wsp, wshare, wav, wip};
+  int32_t n_inc = 0, n_gen = 0, n_full = 0;  // path attribution
   if (inc_ok) {
     tc.feas.resize(N);
     tc.ignored.resize(N);
@@ -1223,10 +1537,14 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
     tc.score.resize(N);
     tc.fail_row.resize(N_STAGES);
     tc.ins_row.resize(R);
+    if (act_interpod) tc.ip_mask.resize(N);
+    if (use_ip) tc.ip_raw.resize(N);
+    if (act_spread) tc.sh_mask.resize(N);
     // per-domain node lists for the delta path (a real domain belongs to
     // exactly one topology key; the shared trash row gets per-key lists)
     s.dom_members.resize(a.Dp1);
     s.trash_members.resize(Tk);
+    s.key_doms.resize(Tk);
     s.visited.assign(N, 0);
     const int32_t trash = (int32_t)a.Dp1 - 1;
     for (int64_t tk = 0; tk < Tk; tk++)
@@ -1237,6 +1555,10 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
         else
           s.dom_members[d].push_back((int32_t)n);
       }
+    for (int32_t d = 0; d < trash; d++) {
+      int32_t tk = a.domain_topo[d];
+      if (tk >= 0) s.key_doms[tk].push_back(d);
+    }
   }
 
   for (int64_t i = 0; i < P; i++) {
@@ -1253,7 +1575,7 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
         a.chosen[i] = p;
         for (int64_t d = 0; d < Gd; d++) a.gpu_take[i * Gd + d] = s.take[d];
         if (tc.valid) {
-          tc.pending.push_back(p);
+          tc.pending.push_back({p, u});
           if (tc.pending.size() > MAX_PENDING) tc.valid = false;
         }
       }
@@ -1261,6 +1583,7 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
     }
 
     if (inc_ok) {
+      n_inc++;
       PreCtx pc;
       pc.cpuq = 0;  // filled below
       pc.memq = 0;
@@ -1299,6 +1622,7 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
       if (!(tc.valid && tc.u == u)) {
         prof.start();
         full_eval_env(a, s, tc, env, pc, u);
+        n_full++;
         prof.stop(1);
       }
 
@@ -1312,6 +1636,8 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
       int32_t bi = -1;
       const uint8_t* fe = tc.feas.data();
       const bool lazy_spr = env.use_spr && tc.any_soft;
+      const bool uip = tc.ip_s_act;
+      const bool lazy = lazy_spr || uip;
       const bool dm = tc.dom_mode;
       const bool hm = tc.hier_mode;
       const bool hff = tc.hier_fine_first;
@@ -1330,20 +1656,35 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
       const float l_mx = tc.spr_mx, l_mn = tc.spr_mn;
       const float l_denom = std::max(l_mx, 1.0f);
       const bool ush = env.use_share, uav = env.use_avoid;
-      const float l_wsp = env.wsp;
+      const float l_wsp = env.wsp, l_wip = env.wip;
+      // interpod normalization scalars, exactly the generic path's
+      // ip_hi/ip_lo/ip_rng derivation from the raw reductions
+      const float* ipr = uip ? tc.ip_raw.data() : nullptr;
+      const float l_ip_hi = uip ? std::max(tc.ip_rhi, 0.0f) : 0.0f;
+      const float l_ip_lo = uip ? std::min(tc.ip_rlo, 0.0f) : 0.0f;
+      const float l_ip_rng = l_ip_hi - l_ip_lo;
+      auto ip_term = [&](int64_t n) -> float {
+        return l_wip * (l_ip_rng > 0.0f
+                            ? MAXS * (ipr[n] - l_ip_lo) / std::max(l_ip_rng, 1.0f)
+                            : 0.0f);
+      };
       auto sc_at = [&](int64_t n) -> float {
-        if (!lazy_spr) return sc[n];
-        float r;
-        if (dm)
-          r = dmV[dmD[n]];
-        else if (hm) {
-          float fv = hfV[hfD[n]], cv = hcV[hcD[n]];
-          r = hff ? fv + cv : cv + fv;
-        } else
-          r = raw[n];
-        float norm = (l_mx <= 0.0f) ? MAXS : MAXS * (l_mx + l_mn - r) / l_denom;
-        norm = ig[n] ? 0.0f : norm;
-        float v = pre[n] + l_wsp * norm;
+        if (!lazy) return sc[n];
+        float v = pre[n];
+        if (uip) v += ip_term(n);
+        if (lazy_spr) {
+          float r;
+          if (dm)
+            r = dmV[dmD[n]];
+          else if (hm) {
+            float fv = hfV[hfD[n]], cv = hcV[hcD[n]];
+            r = hff ? fv + cv : cv + fv;
+          } else
+            r = raw[n];
+          float norm = (l_mx <= 0.0f) ? MAXS : MAXS * (l_mx + l_mn - r) / l_denom;
+          norm = ig[n] ? 0.0f : norm;
+          v += l_wsp * norm;
+        }
         if (ush) v += sht[n];
         if (uav) v += avt[n];
         return v;
@@ -1396,12 +1737,14 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
         // ignored nodes may carry fine levels beyond the scored LUT range
         // (e.g. a zone-less host full of pods): never index T for them
         float t = ig[n] ? 0.0f : T[(int64_t)zi[n] * TL + (lv ? lv[n] : 0)];
-        float v = pre[n] + t;
+        float v = pre[n];
+        if (uip) v += ip_term(n);
+        v += t;
         if (ush) v += sht[n];
         if (uav) v += avt[n];
         return v;
       };
-      if (!a.tie_sample && lazy_spr) {
+      if (!a.tie_sample && lazy) {
         // gather-based lazy scoring doesn't vectorize, so the two-pass
         // max+find does double work: one strict-> pass yields the same
         // lowest-index argmax on the same float values
@@ -1455,7 +1798,12 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
     selected:
       if (bi < 0) {
         prof.start();
+        // fail_accounting reads every ACTIVE stage mask; under the widened
+        // envelope that can include spread/interpod (ports/gpu/local are
+        // excluded by inc_ok)
         if (act_fit) fit_mask(a, s.gc_dyn_ptr(), u, s.mask[S_FIT].data());
+        if (act_spread) spread_mask(a, u, s.mask[S_SPREAD].data());
+        if (act_interpod) interpod_mask(a, s, u, s.mask[S_INTERPOD].data());
         fail_accounting(a, s, act, u, i);
         tc.prev_failed = true;
         for (int k = 0; k < N_STAGES; k++) tc.fail_row[k] = a.fail_counts[i * N_STAGES + k];
@@ -1467,11 +1815,12 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
       prof.start();
       bind(a, s, u, bi, s.take.data());
       prof.stop(3);
-      tc.pending.push_back(bi);
+      tc.pending.push_back({bi, u});
       a.chosen[i] = bi;
       for (int64_t d = 0; d < Gd; d++) a.gpu_take[i * Gd + d] = s.take[d];
       continue;
     }
+    n_gen++;
     prof.start();
 
     // --- Filter: active dynamic masks over the full node axis ---
@@ -1632,5 +1981,11 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
     prof.stop(5);
   }
   prof.report();
+  if (a.path_counts) {
+    a.path_counts[0] = n_inc;
+    a.path_counts[1] = n_gen;
+    a.path_counts[2] = n_full;
+  }
+  if (prof.on && a.profile_out) prof.dump(a.profile_out);
   return 0;
 }

@@ -75,6 +75,10 @@ class _Metrics:
         self.snapshot_retries = 0
         self.snapshot_stale_served = 0
         self.stale_prep_retries = 0
+        # C++ engine path attribution (ISSUE 4): scheduled steps served by
+        # the incremental cache vs the generic re-evaluation — a silent
+        # cache disengage shows up here, not just in wall-clock
+        self.native_steps = {"incremental": 0, "generic": 0}
 
     def record(self, endpoint: str, result: SimulateResult, seconds: float) -> None:
         with self.lock:
@@ -83,6 +87,11 @@ class _Metrics:
             self.pods_scheduled += sum(len(ns.pods) for ns in result.node_status)
             self.pods_unscheduled += len(result.unscheduled_pods)
             self.simulate_seconds_total += seconds
+            if result.engine is not None and result.engine.native_steps:
+                for path in ("incremental", "generic"):
+                    self.native_steps[path] += int(
+                        result.engine.native_steps.get(path, 0)
+                    )
 
     def bump(self, counter: str, n: int = 1) -> None:
         with self.lock:
@@ -135,6 +144,11 @@ class _Metrics:
                 f"simon_snapshot_stale_served_total {self.snapshot_stale_served}",
                 "# TYPE simon_stale_prep_retries_total counter",
                 f"simon_stale_prep_retries_total {self.stale_prep_retries}",
+                "# TYPE simon_native_steps_total counter",
+                *(
+                    f'simon_native_steps_total{{path="{p}"}} {n}'
+                    for p, n in sorted(self.native_steps.items())
+                ),
             ]
         breakers = sorted(breaker_mod.all_breakers().items())
         lines += ["# TYPE simon_engine_breaker_trips_total counter"]

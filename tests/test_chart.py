@@ -328,6 +328,53 @@ def test_variable_scoping_go_semantics():
         render_template("{{ $nope = 1 }}", {"Values": {}})
 
 
+def test_range_scoped_values_follows_helm_scoping():
+    """Inside a {{ range }} or {{ with }} body the dot is the item/pivot
+    (Go scoping): `.Values` resolves against it — NOT silently against the
+    chart root — and a non-map dot fails loudly, exactly where helm
+    refuses the chart. `$.Values` stays the sanctioned route to the root
+    (round-5 rough edge in NOTES.md, now closed)."""
+    ctx = {"Values": {"l": [1, 2], "maps": [{"Values": {"x": "inner"}}], "tag": "root"}}
+    # non-map item: Go template execution errors — we must too
+    with pytest.raises(ChartError, match="range/with body"):
+        render_template(
+            "{{ range .Values.l }}{{ .Values.tag }}{{ end }}", dict(ctx)
+        )
+    # map item carrying its own Values key: plain map lookup on the item
+    assert (
+        render_template(
+            "{{ range .Values.maps }}{{ .Values.x }}{{ end }}", dict(ctx)
+        )
+        == "inner"
+    )
+    # $.Values reaches the root from inside the body (the helm idiom)
+    assert (
+        render_template(
+            "{{ range .Values.l }}{{ $.Values.tag }}{{ end }}", dict(ctx)
+        )
+        == "rootroot"
+    )
+    # with rebinds the dot the same way (a with nested in a range behaves
+    # identically to a top-level with — one rule, no nesting surprises)
+    with pytest.raises(ChartError, match="range/with body"):
+        render_template("{{ with .Values.tag }}{{ .Values.tag }}{{ end }}", dict(ctx))
+    assert (
+        render_template(
+            "{{ with .Values.maps }}{{ $.Values.tag }}{{ end }}", dict(ctx)
+        )
+        == "root"
+    )
+    # the with ELSE branch keeps the OUTER dot (Go): .Values still roots
+    assert (
+        render_template(
+            "{{ with .Values.absent }}x{{ else }}{{ .Values.tag }}{{ end }}", dict(ctx)
+        )
+        == "root"
+    )
+    # outside any range/with, .Values still resolves from the root as before
+    assert render_template("{{ .Values.tag }}", dict(ctx)) == "root"
+
+
 def test_checksum_and_secret_idioms():
     """The checksum/config and Secret-encoding idioms real charts rely on."""
     import hashlib

@@ -523,6 +523,370 @@ def test_native_hier_mode_feasibility_flip_rebuild():
     assert (chosen == -1).sum() == 3  # 12 fit, 3 fail
 
 
+# ---------------------------------------------------------------------------
+# interpod-aware incremental cache (ISSUE 4): the same-template envelope now
+# covers the interpod filter + score and hard topology spread; these tests
+# pin placements to the generic C++ path (via OPENSIM_NATIVE_FORCE_GENERIC),
+# the XLA scan, and the independent kube oracle.
+# ---------------------------------------------------------------------------
+
+
+def _ip_cluster(n_nodes=18, unlabeled_every=6):
+    """Zoned nodes plus a few zone-LESS ones (trash-domain members: their
+    interpod/spread reads must stay vacuous through the delta path)."""
+    cluster = ResourceTypes()
+    for i in range(n_nodes):
+        labels = {}
+        if unlabeled_every == 0 or i % unlabeled_every != unlabeled_every - 1:
+            labels["topology.kubernetes.io/zone"] = f"z{i % 3}"
+        cluster.nodes.append(
+            fx.make_fake_node(f"n{i:03d}", "8", "16Gi", "110", fx.with_labels(labels))
+        )
+    return cluster
+
+
+def _ip_apps():
+    """Required + preferred + anti-affinity terms MIXED with hard and soft
+    spread — the full surface the widened envelope must keep bit-exact."""
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("base", 30, "250m", "512Mi"))
+    # required affinity to base (zone) + preferred anti on itself (hostname):
+    # negative symmetric weights — the score raw SHRINKS as copies land
+    app.deployments.append(
+        fx.make_fake_deployment(
+            "follow", 40, "200m", "256Mi",
+            fx.with_affinity({
+                "podAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {"labelSelector": {"matchLabels": {"app": "base"}},
+                         "topologyKey": "topology.kubernetes.io/zone"}
+                    ]
+                },
+                "podAntiAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {"weight": 100, "podAffinityTerm": {
+                            "labelSelector": {"matchLabels": {"app": "follow"}},
+                            "topologyKey": "kubernetes.io/hostname"}}
+                    ]
+                },
+            }),
+        )
+    )
+    # required anti on ITSELF per hostname: every bind flips the bound
+    # node's filter verdict — the bail-heavy worst case for the cache
+    app.deployments.append(
+        fx.make_fake_deployment(
+            "excl", 12, "100m", "128Mi",
+            fx.with_affinity({
+                "podAntiAffinity": {
+                    "requiredDuringSchedulingIgnoredDuringExecution": [
+                        {"labelSelector": {"matchLabels": {"app": "excl"}},
+                         "topologyKey": "kubernetes.io/hostname"}
+                    ]
+                }
+            }),
+        )
+    )
+    # hard spread + preferred affinity (positive weights) + soft spread mix
+    app.deployments.append(
+        fx.make_fake_deployment(
+            "spread", 30, "150m", "256Mi",
+            fx.with_topology_spread([
+                {"maxSkew": 2, "topologyKey": "topology.kubernetes.io/zone",
+                 "whenUnsatisfiable": "DoNotSchedule",
+                 "labelSelector": {"matchLabels": {"app": "spread"}}},
+                {"maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+                 "whenUnsatisfiable": "ScheduleAnyway",
+                 "labelSelector": {"matchLabels": {"app": "spread"}}},
+            ]),
+            fx.with_affinity({
+                "podAffinity": {
+                    "preferredDuringSchedulingIgnoredDuringExecution": [
+                        {"weight": 50, "podAffinityTerm": {
+                            "labelSelector": {"matchLabels": {"app": "base"}},
+                            "topologyKey": "topology.kubernetes.io/zone"}}
+                    ]
+                }
+            }),
+        )
+    )
+    return app
+
+
+def _force_generic(monkeypatch):
+    monkeypatch.setenv("OPENSIM_NATIVE_FORCE_GENERIC", "1")
+
+
+def _assert_same_output(a, b):
+    np.testing.assert_array_equal(a.chosen, b.chosen)
+    np.testing.assert_array_equal(a.fail_counts, b.fail_counts)
+    np.testing.assert_array_equal(a.insufficient, b.insufficient)
+    np.testing.assert_array_equal(
+        np.asarray(a.final_state.used), np.asarray(b.final_state.used)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.final_state.dom_sel), np.asarray(b.final_state.dom_sel)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.final_state.dom_anti), np.asarray(b.final_state.dom_anti)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(a.final_state.dom_prefw), np.asarray(b.final_state.dom_prefw)
+    )
+
+
+def test_incremental_interpod_mixed_terms(monkeypatch):
+    """Required + preferred + anti terms mixed with hard/soft spread: the
+    incremental path must engage AND match the XLA scan and the forced
+    generic C++ path bit-for-bit (placements, attribution, final counts)."""
+    prep = prepare(_ip_cluster(), [AppResource("a", _ip_apps())], node_pad=128)
+    nout = _assert_match(prep)  # XLA parity (placements + state + attribution)
+    assert nout.native_stats is not None
+    assert nout.native_stats["path"] == "incremental"
+    assert nout.native_stats["steps"]["generic"] == 0
+    pv = np.ones(len(prep.ordered), bool)
+    _force_generic(monkeypatch)
+    gout = nativepath.schedule(prep, pv)
+    assert gout.native_stats["path"] == "generic"
+    _assert_same_output(nout, gout)
+
+
+def test_incremental_interpod_oracle_cross_check():
+    """Every incremental-path bind must be feasible per the independent
+    kube oracle (and every failure must have no oracle-feasible node) on
+    the mixed required+preferred+anti workload."""
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_k8s_oracle import Oracle
+
+    cluster = _ip_cluster()
+    prep = prepare(cluster, [AppResource("a", _ip_apps())], node_pad=128)
+    pv = np.ones(len(prep.ordered), bool)
+    out = nativepath.schedule(prep, pv)
+    assert out.native_stats["path"] == "incremental"
+    oracle = Oracle(cluster.nodes)
+    node_names = prep.meta.node_names
+    for i, pod in enumerate(prep.ordered):
+        c = int(out.chosen[i])
+        if c >= 0:
+            node = oracle.by_name[node_names[c]]
+            assert oracle.feasible(pod, node), (
+                f"incremental path bound {pod.metadata.name} to "
+                f"{node.metadata.name}; oracle says infeasible "
+                f"(interpod={oracle.interpod_ok(pod, node)} "
+                f"spread={oracle.spread_ok(pod, node)})"
+            )
+            oracle.bind(pod, node)
+        else:
+            feasible = [n.metadata.name for n in cluster.nodes if oracle.feasible(pod, n)]
+            assert not feasible, (
+                f"{pod.metadata.name} unscheduled but oracle finds {feasible}"
+            )
+
+
+def test_incremental_interpod_bind_heavy_segments(tmp_path, monkeypatch):
+    """Bind-heavy domain invalidation ACROSS SEGMENTS: two scheduler
+    profiles chain the carry through consecutive incremental scans; the
+    second segment's cache starts from the first segment's dom_sel/dom_anti
+    state. Placements must match the XLA segmented path exactly."""
+    from opensim_tpu.engine.schedconfig import load_scheduler_config
+
+    cfg_path = tmp_path / "profiles.yaml"
+    cfg_path.write_text(
+        "kind: KubeSchedulerConfiguration\n"
+        "profiles:\n"
+        "  - schedulerName: default-scheduler\n"
+        "  - schedulerName: lean\n"
+        "    plugins:\n"
+        "      score:\n"
+        "        disabled:\n"
+        "          - name: \"*\"\n"
+    )
+    cfg = load_scheduler_config(cfg_path)
+
+    def patch(app_name, pods):
+        # route the second workload's pods onto the lean profile
+        for p in pods:
+            if p.metadata.labels.get("app") == "excl":
+                p.spec.scheduler_name = "lean"
+                p.raw.setdefault("spec", {})["schedulerName"] = "lean"
+
+    def run():
+        return simulate(
+            _ip_cluster(12), [AppResource("a", _ip_apps())],
+            sched_config=cfg, patch_pods_fn=patch,
+        )
+
+    res_native = run()
+    assert res_native.engine.name == "native"
+    assert res_native.engine.native_path in ("incremental", "mixed")
+    shape_native = sorted(
+        (ns.node.metadata.name, len(ns.pods)) for ns in res_native.node_status
+    )
+    monkeypatch.setenv("OPENSIM_DISABLE_NATIVE", "1")
+    res_xla = run()
+    shape_xla = sorted(
+        (ns.node.metadata.name, len(ns.pods)) for ns in res_xla.node_status
+    )
+    assert shape_native == shape_xla
+    assert len(res_native.unscheduled_pods) == len(res_xla.unscheduled_pods)
+
+
+def test_force_generic_knob_and_attribution(monkeypatch):
+    """OPENSIM_NATIVE_FORCE_GENERIC=1 must disable the envelope and the
+    attribution must say so — through simulate() into EngineDecision."""
+    cluster = _run_cluster(8)
+    app = ResourceTypes()
+    app.deployments.append(fx.make_fake_deployment("d", 30, "500m", "1Gi"))
+    res = simulate(cluster, [AppResource("a", app)])
+    assert res.engine.name == "native"
+    assert res.engine.native_path == "incremental"
+    assert res.engine.native_steps["incremental"] == 30
+    assert "incremental" in res.engine.describe()
+    monkeypatch.setenv("OPENSIM_NATIVE_FORCE_GENERIC", "1")
+    res2 = simulate(_run_cluster(8), [AppResource("a", app)])
+    assert res2.engine.native_path == "generic"
+    assert sum(len(ns.pods) for ns in res2.node_status) == sum(
+        len(ns.pods) for ns in res.node_status
+    )
+
+
+def test_incremental_interpod_forced_foreign_interleaving(monkeypatch):
+    """Forced pins spliced INTO an interpod template run (patch_pods_fn sets
+    spec.nodeName on every 7th pod → a distinct pinned template): the cache
+    must fold the FOREIGN binder's selector matches through the pending
+    (node, binder) entries — dom_sel/dom_anti moved by a template that is
+    not the cached one. Incremental must equal forced-generic and XLA."""
+    cluster = _ip_cluster(12)
+
+    def patch(app_name, pods):
+        for i, p in enumerate(pods):
+            if i % 7 == 3:
+                p.spec.node_name = f"n{i % 12:03d}"
+
+    prep = prepare(
+        cluster, [AppResource("a", _ip_apps())], node_pad=128, patch_pods_fn=patch
+    )
+    assert prep.forced.sum() > 5
+    nout = _assert_match(prep)  # XLA parity incl. forced pins
+    pv = np.ones(len(prep.ordered), bool)
+    _force_generic(monkeypatch)
+    gout = nativepath.schedule(prep, pv)
+    _assert_same_output(nout, gout)
+
+
+def _ip_fuzz_case(rng):
+    """Interpod-rich random workloads that stay INSIDE the incremental
+    envelope (no gpu/local/ports): required/preferred affinity and anti
+    terms over zone/hostname/rack, mixed with hard/soft spread."""
+    cluster = ResourceTypes()
+    n_nodes = rng.randrange(10, 18)
+    for i in range(n_nodes):
+        labels = {}
+        if rng.random() < 0.85:
+            labels["topology.kubernetes.io/zone"] = f"z{rng.randrange(3)}"
+        if rng.random() < 0.4:
+            labels["topology.rack"] = f"k{rng.randrange(4)}"
+        cluster.nodes.append(
+            fx.make_fake_node(
+                f"n{i:03d}", str(rng.choice([8, 16])), "32Gi", "110",
+                fx.with_labels(labels),
+            )
+        )
+    app = ResourceTypes()
+    n_workloads = rng.randrange(3, 7)
+    for w in range(n_workloads):
+        opts = []
+        aff = {}
+        target = f"w{max(w - 1, 0)}" if rng.random() < 0.6 else f"w{w}"
+        key = rng.choice(
+            ["kubernetes.io/hostname", "topology.kubernetes.io/zone", "topology.rack"]
+        )
+        if rng.random() < 0.4:
+            kind = rng.choice(["podAffinity", "podAntiAffinity"])
+            aff.setdefault(kind, {})[
+                "requiredDuringSchedulingIgnoredDuringExecution"
+            ] = [{"labelSelector": {"matchLabels": {"app": target}}, "topologyKey": key}]
+        if rng.random() < 0.5:
+            kind = rng.choice(["podAffinity", "podAntiAffinity"])
+            aff.setdefault(kind, {})[
+                "preferredDuringSchedulingIgnoredDuringExecution"
+            ] = [
+                {"weight": rng.choice([10, 50, 100]), "podAffinityTerm": {
+                    "labelSelector": {"matchLabels": {"app": target}},
+                    "topologyKey": key}}
+            ]
+        if aff:
+            opts.append(fx.with_affinity(aff))
+        if rng.random() < 0.4:
+            opts.append(
+                fx.with_topology_spread([
+                    {"maxSkew": rng.choice([1, 2, 4]),
+                     "topologyKey": "topology.kubernetes.io/zone",
+                     "whenUnsatisfiable": rng.choice(["DoNotSchedule", "ScheduleAnyway"]),
+                     "labelSelector": {"matchLabels": {"app": f"w{w}"}}},
+                ])
+            )
+        app.deployments.append(
+            fx.make_fake_deployment(
+                f"w{w}", rng.randrange(5, 16),
+                f"{rng.choice([100, 250, 500])}m",
+                f"{rng.choice([128, 256, 512])}Mi", *opts,
+            )
+        )
+    return cluster, app
+
+
+@pytest.mark.parametrize("seed", [211, 223, 251])
+def test_incremental_vs_generic_interpod_fuzz(seed, monkeypatch):
+    """Differential fuzz: the incremental path forced against the generic
+    path (via the knob) AND the XLA scan on interpod-bearing templates."""
+    rng = random.Random(seed)
+    cluster, app = _ip_fuzz_case(rng)
+    prep = prepare(cluster, [AppResource("fuzz", app)], node_pad=128)
+    if prep is None:
+        pytest.skip("empty workload")
+    nout = _assert_match(prep)  # incremental vs XLA
+    assert nout.native_stats["steps"]["generic"] == 0
+    pv = np.ones(len(prep.ordered), bool)
+    _force_generic(monkeypatch)
+    gout = nativepath.schedule(prep, pv)
+    assert gout.native_stats["path"] == "generic"
+    _assert_same_output(nout, gout)
+
+
+def test_scanargs_struct_lockstep():
+    """The C++ ScanArgs struct and the ctypes mirror must agree FIELD BY
+    COUNT (ISSUE 4 satellite): opensim_args_size() catches size drift at
+    load time, this catches a same-size swap (e.g. one added + one removed)
+    and names the section that drifted."""
+    import re
+    from pathlib import Path
+
+    src = (Path(native.__file__).parent / "scan_engine.cc").read_text()
+    m = re.search(r"struct ScanArgs \{(.*?)\n\};", src, re.S)
+    assert m, "ScanArgs struct not found in scan_engine.cc"
+    body = re.sub(r"//[^\n]*", "", m.group(1))
+    n_int = n_dbl = n_ptr = 0
+    for decl in body.split(";"):
+        decl = decl.strip()
+        if not decl:
+            continue
+        if "*" in decl:
+            n_ptr += decl.count("*")
+        elif decl.startswith("int64_t"):
+            n_int += len(decl[len("int64_t"):].split(","))
+        elif decl.startswith("double"):
+            n_dbl += len(decl[len("double"):].split(","))
+    from opensim_tpu.native import (
+        _BUFFERS, _DIMS, _FEATURES, _FILTER_ENABLES, _SELECT, _WEIGHTS,
+    )
+
+    want_int = len(_DIMS) + len(_FEATURES) + len(_FILTER_ENABLES) + len(_SELECT)
+    assert n_int == want_int, f"int64 dims/flags: C++ {n_int} vs Python {want_int}"
+    assert n_dbl == len(_WEIGHTS), f"double weights: C++ {n_dbl} vs Python {len(_WEIGHTS)}"
+    assert n_ptr == len(_BUFFERS), f"buffer pointers: C++ {n_ptr} vs Python {len(_BUFFERS)}"
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", [500001, 500007, 500013, 500021, 500033])
 def test_native_fuzz_random_configs(seed):

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Perf smoke gate (`make perf-smoke`, wired into `make verify`).
+
+Runs a small affinity-heavy workload (the ISSUE-4 shape: required + preferred
+interpod terms plus hard topology spread) through the C++ scan engine twice:
+
+1. normally — asserting the INCREMENTAL same-template cache actually served
+   the scheduled steps (a silent disengage back to the generic path is the
+   failure mode this gate exists to catch, long before a 10 s bench run);
+2. with OPENSIM_NATIVE_FORCE_GENERIC=1 — asserting placements, failure
+   attribution and the final count tensors are bit-identical, so the cache
+   can never trade correctness for the speed it reports.
+
+Prints one JSON line and exits nonzero on any violation.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from opensim_tpu import native
+    from opensim_tpu.engine import nativepath
+    from opensim_tpu.engine.simulator import AppResource, prepare
+
+    import bench
+
+    if not native.available():
+        # match the test suites' behavior: environments without a C++
+        # toolchain skip native-dependent gates instead of failing verify
+        print(json.dumps({"skipped": f"native engine unavailable: {native.load_error()}"}))
+        return 0
+
+    # the knob under test must not leak in from (or stomp) the caller's env
+    prior_fg = os.environ.pop("OPENSIM_NATIVE_FORCE_GENERIC", None)
+
+    cluster = bench.synthetic_cluster(200)
+    apps = [AppResource("smoke", bench.affinity_apps(2000))]
+    prep = prepare(cluster, apps, node_pad=128)
+    pv = np.ones(len(prep.ordered), bool)
+
+    t0 = time.time()
+    out_inc = nativepath.schedule(prep, pv)
+    t_inc = time.time() - t0
+    stats = out_inc.native_stats or {}
+    steps = stats.get("steps", {})
+
+    os.environ["OPENSIM_NATIVE_FORCE_GENERIC"] = "1"
+    try:
+        t0 = time.time()
+        out_gen = nativepath.schedule(prep, pv)
+        t_gen = time.time() - t0
+    finally:
+        if prior_fg is None:
+            del os.environ["OPENSIM_NATIVE_FORCE_GENERIC"]
+        else:
+            os.environ["OPENSIM_NATIVE_FORCE_GENERIC"] = prior_fg
+
+    record = {
+        "metric": "perf-smoke (2k-pod/200-node affinity, incremental vs generic)",
+        "native_path": stats.get("path"),
+        "native_steps": steps,
+        "incremental_s": round(t_inc, 3),
+        "generic_s": round(t_gen, 3),
+        "forced_path": (out_gen.native_stats or {}).get("path"),
+    }
+
+    if stats.get("path") != "incremental":
+        record["error"] = (
+            "incremental cache did not engage on the affinity workload "
+            f"(path={stats.get('path')!r}, steps={steps})"
+        )
+    elif (out_gen.native_stats or {}).get("path") != "generic":
+        record["error"] = "OPENSIM_NATIVE_FORCE_GENERIC=1 did not force the generic path"
+    elif not np.array_equal(out_inc.chosen, out_gen.chosen):
+        mism = int((out_inc.chosen != out_gen.chosen).sum())
+        record["error"] = f"{mism} placement mismatches incremental vs generic"
+    elif not np.array_equal(out_inc.fail_counts, out_gen.fail_counts):
+        record["error"] = "failure attribution differs incremental vs generic"
+    elif not np.array_equal(out_inc.final_state.used, out_gen.final_state.used) or not np.array_equal(
+        out_inc.final_state.dom_sel, out_gen.final_state.dom_sel
+    ):
+        record["error"] = "final state differs incremental vs generic"
+
+    print(json.dumps(record))
+    return 1 if "error" in record else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

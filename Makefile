@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke
+.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke
 
 all: test
 
@@ -32,8 +32,14 @@ chaos:
 perf-smoke:
 	python tools/perf_smoke.py
 
-# the CI gate: static analysis + types + tier-1 tests + chaos suite + perf gate
-verify: lint mypy test-quick chaos perf-smoke
+# observability gate (ISSUE 5, docs/observability.md): a live server must
+# echo X-Simon-Request-Id, serve the request's span tree from the flight
+# recorder, and render phase latency histograms at /metrics
+obs-smoke:
+	python tools/obs_smoke.py
+
+# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs
+verify: lint mypy test-quick chaos perf-smoke obs-smoke
 
 # run the moment the TPU tunnel opens (tools/tpu_probe_loop.sh writes
 # /tmp/opensim-tpu-watch.up): compiled-Mosaic parity suite + full bench

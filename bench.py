@@ -360,6 +360,17 @@ def main() -> int:
     )
     ap.add_argument("--scenarios", type=int, default=1000, help="defrag: number of drain scenarios")
     ap.add_argument("--repeats", type=int, default=10, help="steady: number of warm re-simulations")
+    ap.add_argument(
+        "--trace",
+        default="",
+        metavar="FILE",
+        help=(
+            "write a Chrome-trace/Perfetto JSON of the measured run (plan-"
+            "family configs): every phase span — prepare, encode, engine "
+            "attempts, decode — with the C++ engine's profile attached. "
+            "Load at chrome://tracing or ui.perfetto.dev"
+        ),
+    )
     args = ap.parse_args()
     _stage("measure")
 
@@ -408,9 +419,18 @@ def main() -> int:
 
     _stage("measure")
     PREP_STATS.reset()
+    # --trace: span-trace the measured run (the explicit flag wins over
+    # OPENSIM_TRACE=0); the root span brackets exactly the timed region, so
+    # the exported trace's total time matches the reported wall time
+    from opensim_tpu.obs import trace as tracing
+
+    tr = tracing.start_trace("bench", force=True) if args.trace else None
     t0 = time.time()
-    result = simulate(cluster, apps, node_pad=128)
+    with tracing.trace_scope(tr):
+        result = simulate(cluster, apps, node_pad=128)
     dt = time.time() - t0
+    if tr is not None:
+        tr.finish()
     prep_last = PREP_STATS.snapshot()["last"]  # the measured run's prepare
 
     scheduled = sum(len(ns.pods) for ns in result.node_status)
@@ -464,6 +484,12 @@ def main() -> int:
         # compiled-serial (Go-cost stand-in) schedule time
         record["vs_serial_cxx"] = round(cxx["schedule_s"] / dt, 1)
         record["cxx_serial_schedule_s"] = cxx["schedule_s"]
+    if tr is not None:
+        tracing.write_chrome(tr, args.trace)
+        # the measured wall time and the trace's root span, side by side —
+        # the two must agree (acceptance: within 10%)
+        record["trace_file"] = args.trace
+        record["trace_span_s"] = round(tr.root.duration_s, 3)
     if BACKEND_NOTE:
         record["backend"] = BACKEND_NOTE
     print(json.dumps(record))

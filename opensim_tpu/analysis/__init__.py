@@ -11,6 +11,7 @@ Rules (short name = suppression id; see docs/static-analysis.md):
     OSL401 cache-mutation     mutation of fingerprinted objects
     OSL501 exception-swallow  broad except without raise/log
     OSL601 unbounded-retry    retry loop without a bound or backoff
+    OSL701 deadline-span      Deadline phase boundary without a trace span
 """
 
 from .core import (  # noqa: F401
@@ -32,5 +33,6 @@ from . import (  # noqa: F401,E402
     rules_dtype,
     rules_except,
     rules_jit,
+    rules_obs,
     rules_retry,
 )

@@ -70,6 +70,11 @@ def build_parser() -> argparse.ArgumentParser:
     apply_p.add_argument("--max-new-nodes", type=int, default=128, help="upper bound for the node sweep")
     apply_p.add_argument("--report-pods", action="store_true", help="include the per-node Pod Info table")
     apply_p.add_argument(
+        "--trace", default="", metavar="FILE",
+        help="write a Chrome-trace/Perfetto JSON of the run's span tree "
+        "(prepare/encode/engine/decode phases; docs/observability.md)",
+    )
+    apply_p.add_argument(
         "--tie-break", default="lowest", metavar="lowest|sample[:seed]",
         help="equal-score node selection: deterministic lowest index "
         "(default) or the reference's sampled tie-break, seeded for "
@@ -96,6 +101,11 @@ def build_parser() -> argparse.ArgumentParser:
     server_p.add_argument("--kubeconfig", default="", help="kubeconfig of the real cluster")
     server_p.add_argument("--master", default="", help="apiserver address override")
     server_p.add_argument("--port", type=int, default=8080, help="listen port")
+    server_p.add_argument(
+        "--access-log", action="store_true",
+        help="emit one JSON access-log line per request (request id, "
+        "endpoint, status, duration) — same as OPENSIM_ACCESS_LOG=1",
+    )
 
     sub.add_parser("version", help="print version", description="print version and commit id")
 
@@ -152,7 +162,28 @@ def main(argv: Optional[List[str]] = None) -> int:
             tie_break=args.tie_break,
         )
         try:
-            return Applier(opts).run()
+            if not args.trace:
+                return Applier(opts).run()
+            # span-trace the whole apply run and export Chrome-trace JSON
+            # (the explicit flag wins over OPENSIM_TRACE=0). The file is
+            # written in a finally: a FAILED run's partial trace is exactly
+            # the one worth inspecting
+            from ..obs import trace as tracing
+
+            tr = tracing.start_trace("apply", force=True)
+            rc = 1
+            try:
+                with tracing.trace_scope(tr):
+                    rc = Applier(opts).run()
+                return rc
+            finally:
+                tr.finish(status="ok" if rc == 0 else "error")
+                tracing.write_chrome(tr, args.trace)
+                print(
+                    f"trace written to {args.trace} "
+                    "(chrome://tracing or ui.perfetto.dev)",
+                    file=sys.stderr,
+                )
         except (OSError, ValueError) as e:
             print(f"simon apply: {e}", file=sys.stderr)
             return 1
@@ -203,6 +234,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         from .. import native
         from ..server.rest import serve
 
+        if args.access_log:
+            os.environ["OPENSIM_ACCESS_LOG"] = "1"
         native.available()  # warm the C++ engine build before the first request
         return serve(kubeconfig=args.kubeconfig, master=args.master, port=args.port)
     if args.command == "gen-doc":

@@ -229,6 +229,8 @@ def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=N
     }
     native.run_scan(dims, weights, buffers)
 
+    stats = _path_stats(outputs["path_counts"], outputs["profile_out"])
+    _attach_profile_spans(stats, P)
     return ScheduleOutput(
         chosen=outputs["chosen"],
         fail_counts=outputs["fail_counts"],
@@ -236,8 +238,35 @@ def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=N
         gpu_take=outputs["gpu_take"],
         static_fail=np.asarray(stat.static_fail),
         final_state=ScanState(**state),
-        native_stats=_path_stats(outputs["path_counts"], outputs["profile_out"]),
+        native_stats=stats,
     )
+
+
+def _attach_profile_spans(stats: dict, n_pods: int) -> None:
+    """OPENSIM_NATIVE_PROFILE phase timings as child spans of the ambient
+    engine span (ISSUE 5): the C++ scan's internal time lands in the same
+    request tree as host prep. The .so measures durations, not timestamps,
+    so the children are laid out sequentially from the span's start.
+
+    Only attaches when the ambient span IS an engine span: sweep callers
+    (``nativepath.sweep``, one schedule() per scenario) run with the trace
+    root ambient, and stamping hundreds of per-scenario stats/children onto
+    the root would mis-attribute the whole run to the last scenario."""
+    from ..obs import trace as obs
+
+    cur = obs.current_span()
+    if not getattr(cur, "name", "").startswith("engine."):
+        return
+    cur.set(
+        native_path=stats["path"],
+        steps_incremental=stats["steps"]["incremental"],
+        steps_generic=stats["steps"]["generic"],
+        pods=int(n_pods),
+    )
+    for phase, rec in (stats.get("profile") or {}).items():
+        cur.child_from_seconds(
+            f"native.{phase}", rec["seconds"], steps=rec["steps"]
+        )
 
 
 _PROFILE_PHASES = ("delta", "full_eval", "argmax", "bind", "fail", "generic")

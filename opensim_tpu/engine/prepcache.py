@@ -347,7 +347,13 @@ class PrepareCache:
             for k in doomed:
                 del self._entries[k]
             self.stats.invalidations += len(doomed)
-            return len(doomed)
+        if doomed:
+            # trace event outside the cache lock (the span sink shares the
+            # metrics recorder lock; never hold both)
+            from ..obs import trace as obs
+
+            obs.event("prepcache.invalidate", dropped=len(doomed))
+        return len(doomed)
 
     def check_fresh(self, entry: CacheEntry) -> None:
         """Entry freshness check that also EVICTS on staleness: once an
@@ -365,6 +371,9 @@ class PrepareCache:
             faults.fault_point("cache.stale")
             entry.check_fresh()
         except StaleFingerprintError as e:
+            from ..obs import trace as obs
+
+            obs.event("prepcache.stale", status="error", key=entry.key)
             if e.obj is not None:
                 self.invalidate(e.obj)
             self.invalidate(entry.key)

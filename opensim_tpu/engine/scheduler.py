@@ -178,14 +178,16 @@ def pad_pod_stream(tmpl_ids, pod_valid, forced, bucket: int = 256):
 
 def to_device(ec: EncodedCluster, st: ScanState):
     """Move numpy-built tensors to the accelerator once per simulation."""
+    from ..obs import trace as obs
     from ..resilience import faults
 
-    # chaos injection point for device loss / transfer failure: upstream a
-    # failed upload fails the request closed (typed 500) — there is no
-    # stale-tensor fallback that would be correct
-    faults.fault_point("engine.device_put")
-    dev = lambda a: jnp.asarray(a)
-    return (
-        EncodedCluster(*[dev(a) for a in ec]),
-        ScanState(*[dev(a) for a in st]),
-    )
+    with obs.span("engine.device_put"):
+        # chaos injection point for device loss / transfer failure: upstream
+        # a failed upload fails the request closed (typed 500) — there is no
+        # stale-tensor fallback that would be correct
+        faults.fault_point("engine.device_put")
+        dev = lambda a: jnp.asarray(a)
+        return (
+            EncodedCluster(*[dev(a) for a in ec]),
+            ScanState(*[dev(a) for a in st]),
+        )

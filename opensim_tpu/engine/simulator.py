@@ -84,6 +84,10 @@ class EngineDecision:
     skipped: Dict[str, str] = field(default_factory=dict)
     native_path: Optional[str] = None
     native_steps: Optional[Dict[str, int]] = None
+    # observability (ISSUE 5): the serving request's propagated
+    # X-Simon-Request-Id, stamped by the REST layer so a decision can be
+    # joined back to its flight-recorder trace; None for library callers
+    request_id: Optional[str] = None
 
     def describe(self) -> str:
         base = self.name if self.native_path is None else f"{self.name}/{self.native_path}"
@@ -371,12 +375,13 @@ def prepare(
 ) -> Optional[Prepared]:
     """Expand cluster + app workloads into an ordered pod stream and encode
     everything into device tensors. Returns None when there are no pods."""
+    from ..obs import trace as obs
     from ..utils.gcpause import gc_paused
     from ..utils.trace import PREP_STATS
 
     check_deadline("prepare")
     t0 = time.monotonic()
-    with gc_paused():
+    with obs.span("prepare"), gc_paused():
         prep = _prepare_inner(cluster, apps, use_greed, node_pad, patch_pods_fn)
     PREP_STATS.record("full", time.monotonic() - t0)
     return prep
@@ -412,31 +417,34 @@ def _prepare_inner(cluster, apps, use_greed, node_pad, patch_pods_fn):
     if not ordered:
         return None
 
+    from ..obs import trace as obs
+
     # expansion is done; the encode pass below is the expensive half of a
     # cold prepare — an exhausted deadline bails here rather than encoding
     # tensors nobody will schedule (and chaos injects encode failures here)
-    check_deadline("encode")
-    faults.fault_point("prep.encode")
+    with obs.span("encode", pods=len(ordered)):
+        check_deadline("encode")
+        faults.fault_point("prep.encode")
 
-    # pods of one workload share a template: the hint short-circuits
-    # canonical extraction (TemplateSet._hint_index) and the lazy selector
-    # callable skips the per-pod dict build on hint hits. patch_pods_fn may
-    # have mutated individual app pods, which the workload-identity hint
-    # cannot see — those pods take the content-keyed extraction path.
-    tmpl_ids = np.array(
-        [
-            enc.add_pod(
-                p,
-                (lambda p=p: _owner_selector(p)),
-                hint=None if (patch_pods_fn is not None and i >= n_cluster) else _tmpl_hint(p),
-            )
-            for i, p in enumerate(ordered)
-        ],
-        dtype=np.int32,
-    )
-    ec_np, st0, meta = enc.build()
-    features = kernels.features_of(ec_np)
-    ec, st0 = to_device(ec_np, st0)
+        # pods of one workload share a template: the hint short-circuits
+        # canonical extraction (TemplateSet._hint_index) and the lazy selector
+        # callable skips the per-pod dict build on hint hits. patch_pods_fn may
+        # have mutated individual app pods, which the workload-identity hint
+        # cannot see — those pods take the content-keyed extraction path.
+        tmpl_ids = np.array(
+            [
+                enc.add_pod(
+                    p,
+                    (lambda p=p: _owner_selector(p)),
+                    hint=None if (patch_pods_fn is not None and i >= n_cluster) else _tmpl_hint(p),
+                )
+                for i, p in enumerate(ordered)
+            ],
+            dtype=np.int32,
+        )
+        ec_np, st0, meta = enc.build()
+        features = kernels.features_of(ec_np)
+        ec, st0 = to_device(ec_np, st0)
     node_idx = {name: i for i, name in enumerate(meta.node_names)}
     # only DaemonSet expansion creates metadata.name matchFields pins; the
     # consumers (planner/defrag scenario masks) specifically want "DaemonSet
@@ -478,6 +486,7 @@ def _run_segments(
     the output's static_fail is PER POD ([P, n_static], callers index it
     with sf_rows=arange) because static filter tables are config-dependent
     and failure attribution resolves per segment."""
+    from ..obs import trace as obs
     from . import nativepath
     from .scheduler import pad_pod_stream, schedule_pods, scan_unroll
 
@@ -510,27 +519,30 @@ def _run_segments(
     for cfg, lo, hi in segments:
         seg_valid = np.zeros((P,), dtype=bool)
         seg_valid[lo:hi] = pod_valid[lo:hi]
-        if use_native:
-            out = nativepath.schedule(
-                prep, seg_valid, config=cfg, node_valid=nv_mask,
-                tie_seed=tie_seed, st0=st,
-            )
-            if out.native_stats is not None:
-                seg_stats.append(out.native_stats)
-        else:
-            tmpl_p, valid_p, forced_p = pad_pod_stream(tmpl_ids, seg_valid, forced)
-            ec_run = (
-                prep.ec._replace(node_valid=jnp.asarray(nv_mask))
-                if nv_mask is not None
-                else prep.ec
-            )
-            st_dev = ScanState(*[jnp.asarray(a) for a in st])
-            out = schedule_pods(
-                ec_run, st_dev, tmpl_p, valid_p, forced_p,
-                features=prep.features, config=cfg, extra_plugins=extra_plugins,
-                unroll=scan_unroll(), tie_seed=tie_seed,
-            )
-            jax.block_until_ready(out.chosen)
+        with obs.span(
+            "engine.native" if use_native else "engine.xla", segment=f"{lo}:{hi}"
+        ):
+            if use_native:
+                out = nativepath.schedule(
+                    prep, seg_valid, config=cfg, node_valid=nv_mask,
+                    tie_seed=tie_seed, st0=st,
+                )
+                if out.native_stats is not None:
+                    seg_stats.append(out.native_stats)
+            else:
+                tmpl_p, valid_p, forced_p = pad_pod_stream(tmpl_ids, seg_valid, forced)
+                ec_run = (
+                    prep.ec._replace(node_valid=jnp.asarray(nv_mask))
+                    if nv_mask is not None
+                    else prep.ec
+                )
+                st_dev = ScanState(*[jnp.asarray(a) for a in st])
+                out = schedule_pods(
+                    ec_run, st_dev, tmpl_p, valid_p, forced_p,
+                    features=prep.features, config=cfg, extra_plugins=extra_plugins,
+                    unroll=scan_unroll(), tie_seed=tie_seed,
+                )
+                jax.block_until_ready(out.chosen)
         chosen[lo:hi] = np.asarray(out.chosen)[lo:hi]
         fail_counts[lo:hi] = np.asarray(out.fail_counts)[lo:hi]
         insufficient[lo:hi] = np.asarray(out.insufficient)[lo:hi]
@@ -562,6 +574,183 @@ def _run_segments(
         native_stats=merged_stats,
     )
     return stitched, ("native" if use_native else "xla")
+
+
+def _run_engine_ladder(
+    prep, segments, sched_config, pod_valid, forced, tmpl_ids, extra_plugins,
+    tie_seed, nv_mask, ec, st0, log,
+):
+    """The engine fallback ladder (megakernel → C++ native → XLA scan) for
+    one prepared stream: selection pre-checks, breaker gating, runtime
+    demotion. Returns ``(out, engine_name, skips, sf_rows)``. Split out of
+    ``simulate`` so the whole ladder sits under one traced ``schedule``
+    span with a child span per engine actually *attempted* (ISSUE 5) — a
+    skipped rung gets a demotion event, not a span."""
+    import os as _os
+
+    from ..obs import trace as obs
+
+    out = None
+    engine_name = "xla"
+    skips: Dict[str, str] = {}
+    require_tpu = _os.environ.get("OPENSIM_REQUIRE_TPU") == "1"
+    interpret = _os.environ.get("OPENSIM_FASTPATH") == "interpret"
+    sf_rows = tmpl_ids  # decode: static_fail row per pod
+    if segments is not None:
+        skips["megakernel"] = (
+            f"segmented multi-profile stream ({len(segments)} segments)"
+        )
+        out, engine_name = _run_segments(
+            prep, segments, pod_valid, forced, tmpl_ids, extra_plugins,
+            tie_seed, nv_mask, skips, log,
+        )
+        sf_rows = np.arange(len(tmpl_ids), dtype=np.int32)
+    # importing the megakernel module costs ~1 s of pallas Python-module
+    # compile — only pay it where it can actually run (TPU backend, or
+    # the tests' interpret mode); CPU hosts go straight to the C++ path.
+    # These pre-import gates mirror the first checks of fastpath.why_not
+    # (which stays authoritative once the module is imported) — they
+    # exist only so the import itself can be skipped.
+    elif nv_mask is not None:
+        skips["megakernel"] = "masked re-simulation (planner prep reuse) runs on the C++/XLA engines"
+    elif sched_config is not None:
+        skips["megakernel"] = "non-default scheduler config"
+    elif extra_plugins:
+        skips["megakernel"] = "out-of-tree extra_plugins run on the XLA scan"
+    elif tie_seed is not None:
+        skips["megakernel"] = "sampled tie-break runs on the C++ engine or XLA scan"
+    elif jax.default_backend() != "tpu" and not interpret:
+        skips["megakernel"] = (
+            f"no TPU backend (jax.default_backend()={jax.default_backend()!r})"
+        )
+    else:
+        from . import fastpath
+
+        miss = fastpath.why_not(prep)
+        if miss is not None:
+            skips["megakernel"] = miss
+            log.info("megakernel envelope miss: %s", miss)
+        elif (
+            not require_tpu
+            and not interpret
+            and not breakers.engine_breaker("megakernel").allow()
+        ):
+            # runtime-failure circuit breaker (resilience/breaker.py):
+            # after repeated compile/run failures the doomed attempt is
+            # skipped outright until the cooldown's half-open probe.
+            # Checked AFTER why_not so an envelope miss never consumes
+            # the probe slot (allow() marks it; only an actual attempt
+            # can release it). REQUIRE_TPU and the tests' interpret mode
+            # bypass gating — both demand the real attempt (and its hard
+            # failure) over a silent demotion.
+            skips["megakernel"] = breakers.engine_breaker("megakernel").describe_block()
+            log.warning("megakernel skipped: %s", skips["megakernel"])
+        else:
+            # Pallas megakernel fast path: identical placements, ~4×
+            # the XLA scan's step rate. A Mosaic COMPILE failure (a
+            # construct that passes interpret mode but not the real
+            # compiler) must degrade to the slower engines — unless
+            # --backend tpu demanded the TPU engine, where silently
+            # benchmarking a fallback would be a lie (VERDICT r4 #3).
+            try:
+                with obs.span("engine.megakernel"):
+                    f_chosen, f_used, sf, f_take, f_gpu, f_vg, f_dev = fastpath.schedule(
+                        prep, tmpl_ids, pod_valid, forced
+                    )
+                # a clean kernel RUN is a breaker success even if the
+                # result is later discarded for mid-stream attribution —
+                # and recording here releases a half-open probe slot no
+                # matter which path the result takes
+                breakers.engine_breaker("megakernel").record_success()
+            except Exception as e:
+                if interpret:
+                    # test/CI mode: a broken megakernel contract must
+                    # FAIL, not silently validate the fallback engine
+                    raise
+                if require_tpu:
+                    raise RuntimeError(
+                        "--backend tpu: the Pallas megakernel failed to "
+                        f"compile/run ({type(e).__name__}: {e}); refusing "
+                        "to silently fall back to a slower engine"
+                    ) from e
+                breakers.engine_breaker("megakernel").record_failure(e)
+                log.warning(
+                    "megakernel failed (%s: %s); falling back to a "
+                    "slower engine", type(e).__name__, e,
+                )
+                skips["megakernel"] = f"{type(e).__name__}: {e}"
+                f_chosen = None
+            if f_chosen is not None:
+                failed = (f_chosen < 0) & pod_valid & ~forced
+                if not failed.any():
+                    out = _fast_output(f_chosen, f_used, sf, f_take, f_gpu, f_vg, f_dev, prep)
+                    engine_name = "megakernel"
+                else:
+                    # Failure reasons without a second full scan: exact
+                    # whenever nothing bound after the first failure (the
+                    # state a failed pod saw is then the final carry —
+                    # failed pods mutate nothing). Otherwise fall through
+                    # to the XLA scan for exact mid-stream attribution.
+                    first_fail = int(np.argmax(failed))
+                    if not (f_chosen[first_fail + 1 :] >= 0).any():
+                        out = _fast_output(
+                            f_chosen, f_used, sf, f_take, f_gpu, f_vg, f_dev, prep
+                        )
+                        out = _fast_failure_details(out, prep, np.nonzero(failed)[0])
+                        engine_name = "megakernel"
+                    else:
+                        skips["megakernel"] = (
+                            "mid-stream scheduling failures need exact "
+                            "in-stream attribution (full re-scan engine)"
+                        )
+                        log.info("megakernel result discarded: %s", skips["megakernel"])
+    if out is None:
+        from . import nativepath
+
+        miss = nativepath.why_not(prep, sched_config, extra_plugins, tie_seed=tie_seed)
+        native_breaker = breakers.engine_breaker("native")
+        if miss is None and not native_breaker.allow():
+            miss = native_breaker.describe_block()
+        if miss is None:
+            # C++ scan engine: identical placements to the XLA scan with
+            # exact in-stream failure attribution; the default on hosts
+            # without an accelerator (tests/test_native.py asserts parity).
+            # A RUNTIME failure (ABI drift past the size check, injected
+            # engine.compile fault, a crash in the .so) demotes this
+            # request to the XLA scan and counts against the breaker —
+            # the fallback ladder's bottom rung never silently loses work.
+            try:
+                with obs.span("engine.native"):
+                    out = nativepath.schedule(
+                        prep, pod_valid, config=sched_config, node_valid=nv_mask,
+                        tie_seed=tie_seed,
+                    )
+                native_breaker.record_success()
+                engine_name = "native"
+            except Exception as e:
+                native_breaker.record_failure(e)
+                skips["native"] = f"{type(e).__name__}: {e}"
+                log.warning(
+                    "native engine failed (%s: %s); falling back to the "
+                    "XLA scan", type(e).__name__, e,
+                )
+                out = None
+        else:
+            skips["native"] = miss
+            log.info("native engine skipped: %s", miss)
+    if out is None:
+        with obs.span("engine.xla"):
+            tmpl_p, valid_p, forced_p = pad_pod_stream(tmpl_ids, pod_valid, forced)
+            ec_run = (
+                ec._replace(node_valid=jnp.asarray(nv_mask)) if nv_mask is not None else ec
+            )
+            out = schedule_pods(
+                ec_run, st0, tmpl_p, valid_p, forced_p,
+                features=prep.features, config=sched_config, extra_plugins=extra_plugins,
+                unroll=scan_unroll(), tie_seed=tie_seed,
+            )
+            jax.block_until_ready(out.chosen)  # dispatch is async; trace real device time
+    return out, engine_name, skips, sf_rows
 
 
 def parse_tie_break(spec: str):
@@ -622,6 +811,7 @@ def simulate(
     boundaries (prepare/encode/schedule/decode) — exhaustion raises
     ``DeadlineExceeded`` naming the phase instead of hanging. Callers may
     equivalently install a ``resilience.deadline.deadline_scope``."""
+    from ..obs import trace as obs
     from ..utils.trace import Trace
 
     if deadline is not None:
@@ -718,229 +908,83 @@ def simulate(
                 ]
                 sched_config = None
         import logging
-        import os as _os
 
         log = logging.getLogger("opensim_tpu")
         check_deadline("schedule")
-        out = None
-        engine_name = "xla"
-        skips: Dict[str, str] = {}
-        require_tpu = _os.environ.get("OPENSIM_REQUIRE_TPU") == "1"
-        interpret = _os.environ.get("OPENSIM_FASTPATH") == "interpret"
-        sf_rows = tmpl_ids  # decode: static_fail row per pod
-        if segments is not None:
-            skips["megakernel"] = (
-                f"segmented multi-profile stream ({len(segments)} segments)"
+        with obs.span("schedule", pods=len(ordered)) as _sched_span:
+            out, engine_name, skips, sf_rows = _run_engine_ladder(
+                prep, segments, sched_config, pod_valid, forced, tmpl_ids,
+                extra_plugins, tie_seed, nv_mask, ec, st0, log,
             )
-            out, engine_name = _run_segments(
-                prep, segments, pod_valid, forced, tmpl_ids, extra_plugins,
-                tie_seed, nv_mask, skips, log,
+            nstats = getattr(out, "native_stats", None)
+            engine = EngineDecision(
+                name=engine_name,
+                skipped=skips,
+                native_path=nstats["path"] if nstats else None,
+                native_steps=dict(nstats["steps"]) if nstats else None,
             )
-            sf_rows = np.arange(len(ordered), dtype=np.int32)
-        # importing the megakernel module costs ~1 s of pallas Python-module
-        # compile — only pay it where it can actually run (TPU backend, or
-        # the tests' interpret mode); CPU hosts go straight to the C++ path.
-        # These pre-import gates mirror the first checks of fastpath.why_not
-        # (which stays authoritative once the module is imported) — they
-        # exist only so the import itself can be skipped.
-        elif nv_mask is not None:
-            skips["megakernel"] = "masked re-simulation (planner prep reuse) runs on the C++/XLA engines"
-        elif sched_config is not None:
-            skips["megakernel"] = "non-default scheduler config"
-        elif extra_plugins:
-            skips["megakernel"] = "out-of-tree extra_plugins run on the XLA scan"
-        elif tie_seed is not None:
-            skips["megakernel"] = "sampled tie-break runs on the C++ engine or XLA scan"
-        elif jax.default_backend() != "tpu" and not interpret:
-            skips["megakernel"] = (
-                f"no TPU backend (jax.default_backend()={jax.default_backend()!r})"
-            )
-        else:
-            from . import fastpath
-
-            miss = fastpath.why_not(prep)
-            if miss is not None:
-                skips["megakernel"] = miss
-                log.info("megakernel envelope miss: %s", miss)
-            elif (
-                not require_tpu
-                and not interpret
-                and not breakers.engine_breaker("megakernel").allow()
-            ):
-                # runtime-failure circuit breaker (resilience/breaker.py):
-                # after repeated compile/run failures the doomed attempt is
-                # skipped outright until the cooldown's half-open probe.
-                # Checked AFTER why_not so an envelope miss never consumes
-                # the probe slot (allow() marks it; only an actual attempt
-                # can release it). REQUIRE_TPU and the tests' interpret mode
-                # bypass gating — both demand the real attempt (and its hard
-                # failure) over a silent demotion.
-                skips["megakernel"] = breakers.engine_breaker("megakernel").describe_block()
-                log.warning("megakernel skipped: %s", skips["megakernel"])
-            else:
-                # Pallas megakernel fast path: identical placements, ~4×
-                # the XLA scan's step rate. A Mosaic COMPILE failure (a
-                # construct that passes interpret mode but not the real
-                # compiler) must degrade to the slower engines — unless
-                # --backend tpu demanded the TPU engine, where silently
-                # benchmarking a fallback would be a lie (VERDICT r4 #3).
-                try:
-                    f_chosen, f_used, sf, f_take, f_gpu, f_vg, f_dev = fastpath.schedule(
-                        prep, tmpl_ids, pod_valid, forced
-                    )
-                    # a clean kernel RUN is a breaker success even if the
-                    # result is later discarded for mid-stream attribution —
-                    # and recording here releases a half-open probe slot no
-                    # matter which path the result takes
-                    breakers.engine_breaker("megakernel").record_success()
-                except Exception as e:
-                    if interpret:
-                        # test/CI mode: a broken megakernel contract must
-                        # FAIL, not silently validate the fallback engine
-                        raise
-                    if require_tpu:
-                        raise RuntimeError(
-                            "--backend tpu: the Pallas megakernel failed to "
-                            f"compile/run ({type(e).__name__}: {e}); refusing "
-                            "to silently fall back to a slower engine"
-                        ) from e
-                    breakers.engine_breaker("megakernel").record_failure(e)
-                    log.warning(
-                        "megakernel failed (%s: %s); falling back to a "
-                        "slower engine", type(e).__name__, e,
-                    )
-                    skips["megakernel"] = f"{type(e).__name__}: {e}"
-                    f_chosen = None
-                if f_chosen is not None:
-                    failed = (f_chosen < 0) & pod_valid & ~forced
-                    if not failed.any():
-                        out = _fast_output(f_chosen, f_used, sf, f_take, f_gpu, f_vg, f_dev, prep)
-                        engine_name = "megakernel"
-                    else:
-                        # Failure reasons without a second full scan: exact
-                        # whenever nothing bound after the first failure (the
-                        # state a failed pod saw is then the final carry —
-                        # failed pods mutate nothing). Otherwise fall through
-                        # to the XLA scan for exact mid-stream attribution.
-                        first_fail = int(np.argmax(failed))
-                        if not (f_chosen[first_fail + 1 :] >= 0).any():
-                            out = _fast_output(
-                                f_chosen, f_used, sf, f_take, f_gpu, f_vg, f_dev, prep
-                            )
-                            out = _fast_failure_details(out, prep, np.nonzero(failed)[0])
-                            engine_name = "megakernel"
-                        else:
-                            skips["megakernel"] = (
-                                "mid-stream scheduling failures need exact "
-                                "in-stream attribution (full re-scan engine)"
-                            )
-                            log.info("megakernel result discarded: %s", skips["megakernel"])
-        if out is None:
-            from . import nativepath
-
-            miss = nativepath.why_not(prep, sched_config, extra_plugins, tie_seed=tie_seed)
-            native_breaker = breakers.engine_breaker("native")
-            if miss is None and not native_breaker.allow():
-                miss = native_breaker.describe_block()
-            if miss is None:
-                # C++ scan engine: identical placements to the XLA scan with
-                # exact in-stream failure attribution; the default on hosts
-                # without an accelerator (tests/test_native.py asserts parity).
-                # A RUNTIME failure (ABI drift past the size check, injected
-                # engine.compile fault, a crash in the .so) demotes this
-                # request to the XLA scan and counts against the breaker —
-                # the fallback ladder's bottom rung never silently loses work.
-                try:
-                    out = nativepath.schedule(
-                        prep, pod_valid, config=sched_config, node_valid=nv_mask,
-                        tie_seed=tie_seed,
-                    )
-                    native_breaker.record_success()
-                    engine_name = "native"
-                except Exception as e:
-                    native_breaker.record_failure(e)
-                    skips["native"] = f"{type(e).__name__}: {e}"
-                    log.warning(
-                        "native engine failed (%s: %s); falling back to the "
-                        "XLA scan", type(e).__name__, e,
-                    )
-                    out = None
-            else:
-                skips["native"] = miss
-                log.info("native engine skipped: %s", miss)
-        if out is None:
-            tmpl_p, valid_p, forced_p = pad_pod_stream(tmpl_ids, pod_valid, forced)
-            ec_run = (
-                ec._replace(node_valid=jnp.asarray(nv_mask)) if nv_mask is not None else ec
-            )
-            out = schedule_pods(
-                ec_run, st0, tmpl_p, valid_p, forced_p,
-                features=prep.features, config=sched_config, extra_plugins=extra_plugins,
-                unroll=scan_unroll(), tie_seed=tie_seed,
-            )
-            jax.block_until_ready(out.chosen)  # dispatch is async; trace real device time
-        nstats = getattr(out, "native_stats", None)
-        engine = EngineDecision(
-            name=engine_name,
-            skipped=skips,
-            native_path=nstats["path"] if nstats else None,
-            native_steps=dict(nstats["steps"]) if nstats else None,
-        )
-        engine_label = engine_name if nstats is None else f"{engine_name}/{nstats['path']}"
+            # every rung that did NOT run is an instant demotion span, so
+            # the flight-recorder tree carries exactly the attribution
+            # EngineDecision.skipped reports (tests assert they match)
+            for k, v in sorted(skips.items()):
+                obs.event(f"engine.{k}.skipped", status="demoted", engine=k, reason=v)
+            engine_label = engine_name if nstats is None else f"{engine_name}/{nstats['path']}"
+            _sched_span.set(engine=engine_label)
         tr.step(f"schedule {len(ordered)} pods [engine={engine_label}]")
     check_deadline("decode")
-    out = out._replace(
-        chosen=out.chosen[: len(ordered)],
-        fail_counts=out.fail_counts[: len(ordered)],
-        insufficient=out.insufficient[: len(ordered)],
-        gpu_take=out.gpu_take[: len(ordered)],
-    )
-    chosen = np.asarray(out.chosen)
-    fail_counts = np.asarray(out.fail_counts)
-    insufficient = np.asarray(out.insufficient)
-    gpu_take = np.asarray(out.gpu_take)
-    static_fail = np.asarray(out.static_fail)
-
-    victims_of: Dict[int, int] = {}
-    if enable_preemption and (chosen[~forced] < 0).any():
-        from . import preemption
-
-        fs = out.final_state
-        # np.asarray of a jax array is a read-only view — preemption mutates
-        gpu_take = np.array(gpu_take, copy=True)
-        used = np.array(np.asarray(fs.used), copy=True)
-        state = {
-            "port_used": np.array(np.asarray(fs.port_used), copy=True),
-            "gpu_free": np.array(np.asarray(fs.gpu_free), copy=True),
-            "vg_free": np.array(np.asarray(fs.vg_free), copy=True),
-            "dev_free": np.array(np.asarray(fs.dev_free), copy=True),
-        }
-        all_pdbs = tuple(cluster.pdbs) + tuple(
-            pdb for app in apps for pdb in app.resources.pdbs
+    with obs.span("decode", pods=len(ordered)):
+        out = out._replace(
+            chosen=out.chosen[: len(ordered)],
+            fail_counts=out.fail_counts[: len(ordered)],
+            insufficient=out.insufficient[: len(ordered)],
+            gpu_take=out.gpu_take[: len(ordered)],
         )
-        chosen, victims_of = preemption.preempt_pass(
-            prep, chosen, cluster.nodes, used, np.asarray(prep.ec_np.alloc),
-            gpu_take=gpu_take, pdbs=all_pdbs, eligible=pod_valid, **state,
-        )
-        out = out._replace(final_state=fs._replace(used=used, **state))
+        chosen = np.asarray(out.chosen)
+        fail_counts = np.asarray(out.fail_counts)
+        insufficient = np.asarray(out.insufficient)
+        gpu_take = np.asarray(out.gpu_take)
+        static_fail = np.asarray(out.static_fail)
 
-    from ..utils.gcpause import gc_paused
+        victims_of: Dict[int, int] = {}
+        if enable_preemption and (chosen[~forced] < 0).any():
+            from . import preemption
 
-    node_pods: Dict[str, List[Pod]] = {n.metadata.name: [] for n in cluster.nodes}
-    unscheduled: List[UnscheduledPod] = []
-    n_nodes = int(nv_mask.sum()) if nv_mask is not None else meta.n_real_nodes
-    node_names = meta.node_names
-    # masked runs: candidate nodes beyond the valid prefix have no report
-    # bucket (chosen never points at an invalid node)
-    pod_lists = [node_pods.get(n) for n in node_names]
-    gpu_any = gpu_take.sum(axis=1) > 0  # one vectorized pass, not per-pod sums
+            fs = out.final_state
+            # np.asarray of a jax array is a read-only view — preemption mutates
+            gpu_take = np.array(gpu_take, copy=True)
+            used = np.array(np.asarray(fs.used), copy=True)
+            state = {
+                "port_used": np.array(np.asarray(fs.port_used), copy=True),
+                "gpu_free": np.array(np.asarray(fs.gpu_free), copy=True),
+                "vg_free": np.array(np.asarray(fs.vg_free), copy=True),
+                "dev_free": np.array(np.asarray(fs.dev_free), copy=True),
+            }
+            all_pdbs = tuple(cluster.pdbs) + tuple(
+                pdb for app in apps for pdb in app.resources.pdbs
+            )
+            chosen, victims_of = preemption.preempt_pass(
+                prep, chosen, cluster.nodes, used, np.asarray(prep.ec_np.alloc),
+                gpu_take=gpu_take, pdbs=all_pdbs, eligible=pod_valid, **state,
+            )
+            out = out._replace(final_state=fs._replace(used=used, **state))
 
-    with gc_paused():
-        statuses = _decode(
-            ordered, chosen, forced, custom_reasons, victims_of, gpu_any, gpu_take,
-            sf_rows, static_fail, fail_counts, insufficient, meta, n_nodes,
-            node_names, pod_lists, node_pods, unscheduled, cluster, out, drops,
-        )
+        from ..utils.gcpause import gc_paused
+
+        node_pods: Dict[str, List[Pod]] = {n.metadata.name: [] for n in cluster.nodes}
+        unscheduled: List[UnscheduledPod] = []
+        n_nodes = int(nv_mask.sum()) if nv_mask is not None else meta.n_real_nodes
+        node_names = meta.node_names
+        # masked runs: candidate nodes beyond the valid prefix have no report
+        # bucket (chosen never points at an invalid node)
+        pod_lists = [node_pods.get(n) for n in node_names]
+        gpu_any = gpu_take.sum(axis=1) > 0  # one vectorized pass, not per-pod sums
+
+        with gc_paused():
+            statuses = _decode(
+                ordered, chosen, forced, custom_reasons, victims_of, gpu_any, gpu_take,
+                sf_rows, static_fail, fail_counts, insufficient, meta, n_nodes,
+                node_names, pod_lists, node_pods, unscheduled, cluster, out, drops,
+            )
     return SimulateResult(unscheduled_pods=unscheduled, node_status=statuses, engine=engine)
 
 

@@ -1,0 +1,174 @@
+"""Latency histograms + Prometheus text-format hardening (ISSUE 5).
+
+The serving path used to export only hand-maintained ``*_seconds_total``
+counters — totals hide tail behavior entirely. This module adds fixed-bucket
+latency *histograms* computed from the same spans the tracer records
+(``simon_phase_seconds_bucket{phase=,endpoint=}`` and
+``simon_request_seconds_bucket{endpoint=}``), rendered in the Prometheus
+exposition format at ``/metrics``.
+
+It also owns the ONE recording lock for the whole metrics surface: the REST
+layer's ``_Metrics`` counters, these histograms, and the span sink all
+record under :data:`RECORDER`'s RLock, closing the cross-thread bump races
+the old per-object locking left open (counters were bumped both from
+``_handle`` and from snapshot-retry callbacks).
+
+Label values are escaped per the exposition format (``\\`` → ``\\\\``,
+``"`` → ``\\"``, newline → ``\\n``) — a hostile endpoint/path string cannot
+corrupt a scrape.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "HistogramVec",
+    "MetricsRecorder",
+    "RECORDER",
+    "escape_label_value",
+]
+
+# fixed bucket upper bounds in seconds (the +Inf bucket is implicit):
+# sub-ms cache hits through multi-second cold 50k-pod plans
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus exposition-format label escaping (text format §label
+    values): backslash, double quote, and line feed."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _fmt_le(bound: float) -> str:
+    if math.isinf(bound):
+        return "+Inf"
+    s = f"{bound:g}"
+    return s
+
+
+class HistogramVec:
+    """One histogram family over a fixed label set. Not self-locking: every
+    mutation/read happens under the owning :class:`MetricsRecorder`'s lock
+    (the one-lock design is the point — see module docstring)."""
+
+    def __init__(
+        self,
+        name: str,
+        label_names: Sequence[str],
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ) -> None:
+        self.name = name
+        self.label_names = tuple(label_names)
+        self.buckets = tuple(buckets) + (math.inf,)
+        # label-values tuple -> [per-bucket counts..., count, sum]
+        self._series: Dict[Tuple[str, ...], list] = {}
+
+    def observe(self, seconds: float, labels: Tuple[str, ...]) -> None:
+        series = self._series.get(labels)
+        if series is None:
+            series = self._series[labels] = [0] * len(self.buckets) + [0, 0.0]
+        for i, bound in enumerate(self.buckets):
+            if seconds <= bound:
+                series[i] += 1
+                break
+        series[-2] += 1
+        series[-1] += seconds
+
+    def render_lines(self) -> List[str]:
+        if not self._series:
+            return []
+        lines = [f"# TYPE {self.name} histogram"]
+        for labels in sorted(self._series):
+            series = self._series[labels]
+            base = ",".join(
+                f'{k}="{escape_label_value(v)}"'
+                for k, v in zip(self.label_names, labels)
+            )
+            sep = "," if base else ""
+            cum = 0
+            for i, bound in enumerate(self.buckets):
+                cum += series[i]
+                lines.append(
+                    f'{self.name}_bucket{{{base}{sep}le="{_fmt_le(bound)}"}} {cum}'
+                )
+            lines.append(f"{self.name}_sum{{{base}}} {series[-1]:.6f}")
+            lines.append(f"{self.name}_count{{{base}}} {series[-2]}")
+        return lines
+
+    def reset(self) -> None:
+        self._series.clear()
+
+
+class MetricsRecorder:
+    """The locked recorder every metrics mutation routes through: phase and
+    request latency histograms fed from trace spans, plus the shared RLock
+    the REST counters borrow."""
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.phase_seconds = HistogramVec("simon_phase_seconds", ("phase", "endpoint"))
+        self.request_seconds = HistogramVec(
+            "simon_request_seconds", ("endpoint", "status")
+        )
+
+    def observe_request(self, endpoint: str, seconds: float, status: str = "ok") -> None:
+        """Whole-request latency — recorded for every outcome (labeled with
+        the trace status, so errors/timeouts have their own series), with or
+        without tracing enabled (the histogram must not go dark when
+        ``OPENSIM_TRACE=0``)."""
+        with self.lock:
+            self.request_seconds.observe(seconds, (endpoint, status))
+
+    def observe_phase(self, phase: str, endpoint: str, seconds: float) -> None:
+        with self.lock:
+            self.phase_seconds.observe(seconds, (phase, endpoint))
+
+    def observe_trace(self, trace) -> None:
+        """The span sink: fold a finished trace's phase spans into the
+        per-phase histograms. One recording path — the histograms and the
+        flight-recorder tree are computed from the SAME span objects."""
+        from .trace import PHASES
+
+        phases = set(PHASES)
+        with self.lock:
+            for sp in trace.walk():
+                if sp.name in phases:
+                    self.phase_seconds.observe(sp.duration_s, (sp.name, trace.endpoint))
+
+    def simulate_seconds_total(self) -> float:
+        """Continuity shim for the pre-histogram ``simon_simulate_seconds_total``
+        counter, derived from the one recording path instead of
+        hand-maintained. Sums the ``status="ok"`` series only — the old
+        counter accumulated successful simulations exclusively, and a
+        dashboard dividing it by ``simon_simulations_total`` (also
+        success-only) must not spike during an outage."""
+        with self.lock:
+            return sum(
+                s[-1]
+                for labels, s in self.request_seconds._series.items()
+                if labels[1] == "ok"
+            )
+
+    def render_lines(self) -> List[str]:
+        with self.lock:
+            return self.phase_seconds.render_lines() + self.request_seconds.render_lines()
+
+    def reset(self) -> None:
+        with self.lock:
+            self.phase_seconds.reset()
+            self.request_seconds.reset()
+
+
+RECORDER = MetricsRecorder()

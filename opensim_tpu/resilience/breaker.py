@@ -120,6 +120,7 @@ class CircuitBreaker:
             self.last_error = ""
 
     def record_failure(self, exc: BaseException) -> None:
+        trip_info = None
         with self._lock:
             self.consecutive_failures += 1
             self.failures_total += 1
@@ -133,6 +134,19 @@ class CircuitBreaker:
                 self._probing = False
                 if was_closed or was_probe:
                     self.trips_total += 1
+                    # snapshot the state that tripped THIS request while
+                    # still locked — a concurrent record_failure/reset must
+                    # not rewrite the event's attribution
+                    trip_info = (self.consecutive_failures, self.last_error)
+        if trip_info is not None:
+            # trace event OUTSIDE the breaker lock (the span sink shares one
+            # recorder lock with /metrics; never nest the two)
+            from ..obs import trace as _obs
+
+            _obs.event(
+                "breaker.trip", status="error", engine=self.name,
+                failures=trip_info[0], error=trip_info[1],
+            )
 
     def reset(self) -> None:
         with self._lock:

@@ -70,6 +70,16 @@ class Deadline:
     def check(self, phase: str) -> None:
         rem = self.remaining()
         if rem <= 0.0:
+            # observability (ISSUE 5): the exhaustion lands in the request's
+            # span tree as an instant event naming the phase; the enclosing
+            # phase span is marked deadline-exceeded by its own __exit__
+            from ..obs import trace as _obs
+
+            _obs.event(
+                "deadline.exceeded", status="deadline-exceeded",
+                phase=phase, budget_s=round(self.budget_s, 6),
+                over_by_s=round(-rem, 6),
+            )
             raise DeadlineExceeded(
                 f"request deadline exceeded at the {phase!r} phase "
                 f"(budget {self.budget_s:.3f}s, over by {-rem:.3f}s)",

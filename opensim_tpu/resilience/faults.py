@@ -184,4 +184,9 @@ def fault_point(name: str) -> None:
             del _ACTIVE[name]
         _FIRED[name] = _FIRED.get(name, 0) + 1
         factory = _EXCEPTIONS[spec.exc]
+    # injections are trace events too (ISSUE 5): a chaos run's span trees
+    # show exactly where each fault landed, next to the recovery it forced
+    from ..obs import trace as _obs
+
+    _obs.event("fault.injected", status="error", point=name, exc=spec.exc)
     raise factory(f"injected fault at {name}")

@@ -34,13 +34,18 @@ def retry_call(
     sleep: Callable[[float], None] = time.sleep,
     rng: Optional[random.Random] = None,
     on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
+    trace_name: str = "retry.backoff",
 ) -> T:
     """Call ``fn`` up to ``attempts`` times; re-raise the last failure.
 
     Only exceptions matching ``retry_on`` are retried — anything else
     propagates immediately (an auth misconfiguration must not be hammered
     three times). ``on_retry(attempt_index, exc, delay_s)`` fires before each
-    backoff sleep (metrics/log hook)."""
+    backoff sleep (metrics/log hook). Each retried failure also lands in the
+    ambient request trace as one instant event named ``trace_name`` — pass a
+    site-specific name (the snapshot fetch uses ``snapshot.retry``) so the
+    span tree attributes the backoff; callers must NOT emit their own event
+    from ``on_retry`` on top of it."""
     if attempts < 1:
         raise ValueError(f"attempts must be >= 1, got {attempts}")
     rng = rng if rng is not None else random.Random()
@@ -51,6 +56,14 @@ def retry_call(
             if k == attempts - 1:
                 raise
             delay = rng.uniform(0.0, min(max_delay, base_delay * (2.0**k)))
+            # retries land in the ambient request trace (ISSUE 5): each
+            # backed-off attempt is ONE instant event naming the failure
+            from ..obs import trace as _obs
+
+            _obs.event(
+                trace_name, status="error", attempt=k + 1,
+                error=f"{type(e).__name__}: {e}", delay_s=round(delay, 6),
+            )
             if on_retry is not None:
                 on_retry(k, e, delay)
             sleep(delay)

@@ -25,6 +25,9 @@ from typing import List, Optional
 
 from ..engine.simulator import AppResource, SimulateResult, simulate
 from ..models.objects import LABEL_APP_NAME, Node, ResourceTypes, object_from_dict
+from ..obs import trace as tracing
+from ..obs.metrics import RECORDER, escape_label_value
+from ..obs.recorder import FLIGHT_RECORDER
 from ..resilience import breaker as breaker_mod
 from ..resilience import faults
 from ..resilience.deadline import Deadline, DeadlineExceeded, check_deadline, deadline_scope
@@ -37,6 +40,8 @@ from .snapshot import (
 )
 
 log = logging.getLogger("opensim_tpu.server")
+# structured access log (OPENSIM_ACCESS_LOG=1): one JSON object per line
+_ACCESS_LOG = logging.getLogger("opensim_tpu.access")
 
 _deploy_lock = threading.Lock()
 _scale_lock = threading.Lock()
@@ -57,18 +62,32 @@ def request_served_stale() -> bool:
     return getattr(_REQUEST_STATE, "snapshot_stale", False)
 
 
+def last_request_id() -> str:
+    """The request id assigned to the current thread's request (honored from
+    ``X-Simon-Request-Id`` if the client sent one, generated otherwise) —
+    echoed back in the response header by the handler."""
+    return getattr(_REQUEST_STATE, "request_id", "")
+
+
 class _Metrics:
     """Process-local counters exposed at /metrics in Prometheus text format
     (the reference's vendored scheduler metrics exist but are never exposed;
-    SURVEY.md §5 — this closes that gap)."""
+    SURVEY.md §5 — this closes that gap).
+
+    Locking (ISSUE 5 bugfix): every mutation routes through the ONE
+    recorder RLock shared with the span sink and latency histograms
+    (``obs.metrics.RECORDER``) — counters are bumped both from ``_handle``
+    and from snapshot-retry callbacks on other code paths, and the old
+    per-object lock left render() assembling a scrape interleaved with
+    recordings. Label values are escaped per the exposition format so a
+    hostile endpoint/path string cannot corrupt the scrape."""
 
     def __init__(self) -> None:
-        self.lock = threading.Lock()
+        self.lock = RECORDER.lock  # the one metrics lock (an RLock)
         self.requests = {"deploy-apps": 0, "scale-apps": 0}
         self.simulations = 0
         self.pods_scheduled = 0
         self.pods_unscheduled = 0
-        self.simulate_seconds_total = 0.0
         # resilience counters (docs/resilience.md): deadline 504s, snapshot
         # fetch retries/degradations, stale-prep-cache internal retries
         self.request_timeouts = 0
@@ -80,13 +99,15 @@ class _Metrics:
         # cache disengage shows up here, not just in wall-clock
         self.native_steps = {"incremental": 0, "generic": 0}
 
-    def record(self, endpoint: str, result: SimulateResult, seconds: float) -> None:
+    def record(self, endpoint: str, result: SimulateResult) -> None:
+        # simulate wall time is no longer hand-summed here: the request
+        # latency histogram (RECORDER.observe_request, one recording path)
+        # carries both the distribution and the total
         with self.lock:
             self.requests[endpoint] = self.requests.get(endpoint, 0) + 1
             self.simulations += 1
             self.pods_scheduled += sum(len(ns.pods) for ns in result.node_status)
             self.pods_unscheduled += len(result.unscheduled_pods)
-            self.simulate_seconds_total += seconds
             if result.engine is not None and result.engine.native_steps:
                 for path in ("incremental", "generic"):
                     self.native_steps[path] += int(
@@ -100,11 +121,12 @@ class _Metrics:
     def render(self, prep_cache=None) -> str:
         from ..utils.trace import PREP_STATS
 
+        esc = escape_label_value
         with self.lock:
             lines = [
                 "# TYPE simon_requests_total counter",
                 *(
-                    f'simon_requests_total{{endpoint="{ep}"}} {n}'
+                    f'simon_requests_total{{endpoint="{esc(ep)}"}} {n}'
                     for ep, n in sorted(self.requests.items())
                 ),
                 "# TYPE simon_simulations_total counter",
@@ -114,7 +136,7 @@ class _Metrics:
                 "# TYPE simon_pods_unscheduled_total counter",
                 f"simon_pods_unscheduled_total {self.pods_unscheduled}",
                 "# TYPE simon_simulate_seconds_total counter",
-                f"simon_simulate_seconds_total {self.simulate_seconds_total:.6f}",
+                f"simon_simulate_seconds_total {RECORDER.simulate_seconds_total():.6f}",
             ]
         # host-side prepare attribution (incremental prepare): total seconds
         # spent producing Prepared inputs, and the encode-cache counters
@@ -146,19 +168,19 @@ class _Metrics:
                 f"simon_stale_prep_retries_total {self.stale_prep_retries}",
                 "# TYPE simon_native_steps_total counter",
                 *(
-                    f'simon_native_steps_total{{path="{p}"}} {n}'
+                    f'simon_native_steps_total{{path="{esc(p)}"}} {n}'
                     for p, n in sorted(self.native_steps.items())
                 ),
             ]
         breakers = sorted(breaker_mod.all_breakers().items())
         lines += ["# TYPE simon_engine_breaker_trips_total counter"]
         lines += [
-            f'simon_engine_breaker_trips_total{{engine="{name}"}} {br.trips_total}'
+            f'simon_engine_breaker_trips_total{{engine="{esc(name)}"}} {br.trips_total}'
             for name, br in breakers
         ]
         lines += ["# TYPE simon_engine_breaker_open gauge"]
         lines += [
-            f'simon_engine_breaker_open{{engine="{name}"}} '
+            f'simon_engine_breaker_open{{engine="{esc(name)}"}} '
             f'{int(br.state() != "closed")}'
             for name, br in breakers
         ]
@@ -166,9 +188,12 @@ class _Metrics:
         if fired:
             lines += ["# TYPE simon_faults_injected_total counter"]
             lines += [
-                f'simon_faults_injected_total{{point="{point}"}} {n}'
+                f'simon_faults_injected_total{{point="{esc(point)}"}} {n}'
                 for point, n in fired
             ]
+        # per-phase / per-endpoint latency histograms, computed from the
+        # same spans the flight recorder serves (obs/metrics.py)
+        lines += RECORDER.render_lines()
         return "\n".join(lines) + "\n"
 
 
@@ -301,42 +326,51 @@ class SimonServer:
             return cluster_from_kubeconfig(self.kubeconfig, self.master)
 
         def _note_retry(attempt: int, exc: BaseException, delay: float) -> None:
+            # the trace event comes from retry_call itself (trace_name below)
             METRICS.bump("snapshot_retries")
             log.warning(
                 "snapshot fetch attempt %d failed (%s: %s); retrying in %.3fs",
                 attempt + 1, type(exc).__name__, exc, delay,
             )
 
-        try:
-            # the ONE retry layer for the snapshot fetch (the per-endpoint
-            # code raises typed single-attempt failures). Only the transient
-            # class retries — a missing kubeconfig or auth misconfiguration
-            # (plain OSError/RuntimeError) will not heal and surfaces now.
-            self._snapshot = retry_call(
-                _fetch,
-                attempts=attempts,
-                base_delay=base_delay,
-                retry_on=(SnapshotFetchError, TimeoutError),
-                on_retry=_note_retry,
-            )
-        except (SnapshotFetchError, TimeoutError) as e:
-            if self._snapshot is not None:
-                # degrade: serve the last good snapshot, tagged stale, and
-                # re-arm the TTL so a down apiserver is probed once per TTL
-                # window instead of hammered on every request
-                self.snapshot_stale = True
-                _mark_request_snapshot(True)
-                self._snapshot_at = now
-                METRICS.bump("snapshot_stale_served")
-                log.warning(
-                    "snapshot refresh failed after %d attempt(s) (%s: %s); "
-                    "serving stale snapshot (age %.1fs)",
-                    attempts, type(e).__name__, e, now - self._snapshot_fetched_at,
+        with tracing.span("snapshot") as snap_span:
+            try:
+                # the ONE retry layer for the snapshot fetch (the per-endpoint
+                # code raises typed single-attempt failures). Only the transient
+                # class retries — a missing kubeconfig or auth misconfiguration
+                # (plain OSError/RuntimeError) will not heal and surfaces now.
+                self._snapshot = retry_call(
+                    _fetch,
+                    attempts=attempts,
+                    base_delay=base_delay,
+                    retry_on=(SnapshotFetchError, TimeoutError),
+                    on_retry=_note_retry,
+                    trace_name="snapshot.retry",
                 )
-                return
-            raise SnapshotUnavailable(
-                f"cluster snapshot unavailable after {attempts} attempt(s): {e}"
-            ) from e
+            except (SnapshotFetchError, TimeoutError) as e:
+                if self._snapshot is not None:
+                    # degrade: serve the last good snapshot, tagged stale, and
+                    # re-arm the TTL so a down apiserver is probed once per TTL
+                    # window instead of hammered on every request
+                    self.snapshot_stale = True
+                    _mark_request_snapshot(True)
+                    self._snapshot_at = now
+                    METRICS.bump("snapshot_stale_served")
+                    snap_span.mark(
+                        "demoted",
+                        reason="stale snapshot served",
+                        age_s=round(now - self._snapshot_fetched_at, 3),
+                        error=f"{type(e).__name__}: {e}",
+                    )
+                    log.warning(
+                        "snapshot refresh failed after %d attempt(s) (%s: %s); "
+                        "serving stale snapshot (age %.1fs)",
+                        attempts, type(e).__name__, e, now - self._snapshot_fetched_at,
+                    )
+                    return
+                raise SnapshotUnavailable(
+                    f"cluster snapshot unavailable after {attempts} attempt(s): {e}"
+                ) from e
         self._snapshot_at = now
         self._snapshot_fetched_at = now
         self.snapshot_stale = False
@@ -498,10 +532,12 @@ class SimonServer:
                 entry.restore()
 
     def _handle(self, endpoint: str, kind: str, lock: threading.Lock,
-                payload: dict, deadline: Optional[Deadline] = None) -> tuple:
+                payload: dict, deadline: Optional[Deadline] = None,
+                request_id: Optional[str] = None) -> tuple:
         """Shared endpoint shell: single-flight busy rejection, deadline
-        scope, and the failure-mode ladder (docs/resilience.md) — every
-        outcome is a typed JSON body, never a hang or a raw traceback:
+        scope, request-scoped trace, and the failure-mode ladder
+        (docs/resilience.md) — every outcome is a typed JSON body, never a
+        hang or a raw traceback:
 
         - 200: simulation result
         - 503 busy: TryLock rejection (server.go:167,:234)
@@ -510,40 +546,83 @@ class SimonServer:
           to degrade to
         - 500 + type: everything else (engine/encoding failure after the
           fallback ladder is exhausted)
+
+        Observability (ISSUE 5): every request gets an id (the client's
+        ``X-Simon-Request-Id`` honored when supplied, generated otherwise —
+        read it back via :func:`last_request_id`) and, when tracing is
+        enabled, a span tree recorded into the flight recorder and folded
+        into the /metrics latency histograms on the way out.
         """
+        import time
+
+        rid = tracing.sanitize_request_id(request_id) or tracing.new_request_id()
+        _REQUEST_STATE.request_id = rid
         if not lock.acquire(blocking=False):
+            # rejected traffic must still be visible in the histograms —
+            # overload is exactly what a latency dashboard is watching for
+            RECORDER.observe_request(endpoint, 0.0, status="busy")
             return 503, {"error": "the server is busy now, please try again later"}
         _mark_request_snapshot(False)  # until a refresh says otherwise
+        tr = tracing.start_trace(endpoint, request_id=rid)
+        t0 = time.monotonic()
+        status = "error"
+        code, body = 500, {"error": "unhandled"}
+        result: Optional[SimulateResult] = None
         try:
-            import time
-
-            t0 = time.monotonic()
-            with deadline_scope(deadline):
+            with deadline_scope(deadline), tracing.trace_scope(tr):
                 result = self._simulate_request(kind, payload)
-            METRICS.record(endpoint, result, time.monotonic() - t0)
-            return 200, _response(result)
+            status = "ok"
+            if result.engine is not None:
+                result.engine.request_id = rid
+                if tr is not None:
+                    tr.root.set(engine=result.engine.describe())
+            code, body = 200, _response(result)
         except DeadlineExceeded as e:
+            status = "deadline-exceeded"
             METRICS.bump("request_timeouts")
             log.warning("%s timed out: %s", endpoint, e)
-            return 504, {"error": str(e), "phase": e.phase}
+            code, body = 504, {"error": str(e), "phase": e.phase}
         except SnapshotUnavailable as e:
             log.warning("%s snapshot unavailable: %s", endpoint, e)
-            return 503, {"error": str(e), "retryable": True}
+            code, body = 503, {"error": str(e), "retryable": True}
         except Exception as e:  # surface as 500 like gin's error handler
             log.warning("%s failed: %s: %s", endpoint, type(e).__name__, e)
-            return 500, {"error": str(e), "type": type(e).__name__}
+            code, body = 500, {"error": str(e), "type": type(e).__name__}
         finally:
-            lock.release()
+            try:
+                seconds = time.monotonic() - t0
+                # one recording path for request latency, ONE critical
+                # section: the success counters and the histogram land
+                # atomically, so a scrape never sees simulations_total
+                # bumped with simulate_seconds_total still short a request
+                with RECORDER.lock:
+                    if status == "ok" and result is not None:
+                        METRICS.record(endpoint, result)
+                    RECORDER.observe_request(endpoint, seconds, status=status)
+                if tr is not None:
+                    tr.finish(status=status, http_status=code)
+                    FLIGHT_RECORDER.record(tr)
+                    RECORDER.observe_trace(tr)
+            finally:
+                # the single-flight lock must be released even if telemetry
+                # recording throws — a leaked lock would 503 the endpoint
+                # until restart
+                lock.release()
+        return code, body
 
-    def deploy_apps(self, payload: dict, deadline: Optional[Deadline] = None) -> tuple:
-        return self._handle("deploy-apps", "deploy", _deploy_lock, payload, deadline)
+    def deploy_apps(self, payload: dict, deadline: Optional[Deadline] = None,
+                    request_id: Optional[str] = None) -> tuple:
+        return self._handle("deploy-apps", "deploy", _deploy_lock, payload,
+                            deadline, request_id)
 
-    def scale_apps(self, payload: dict, deadline: Optional[Deadline] = None) -> tuple:
+    def scale_apps(self, payload: dict, deadline: Optional[Deadline] = None,
+                   request_id: Optional[str] = None) -> tuple:
         """scale-apps (server.go:233-312): remove the workload's existing
         pods from the cluster snapshot, then re-simulate at the new scale —
         on the cached path the removal is a valid-mask flip over the
         snapshot's cached encoding, not a re-encode."""
-        return self._handle("scale-apps", "scale", _scale_lock, payload, deadline)
+        return self._handle("scale-apps", "scale", _scale_lock, payload,
+                            deadline, request_id)
 
 
 def _owned_by(pod, scaled: set) -> bool:
@@ -590,6 +669,44 @@ def make_handler(server: SimonServer):
         def log_message(self, fmt, *args):  # quiet by default
             pass
 
+        def _begin_request(self) -> None:
+            # duration is request-scoped, stamped at dispatch: measuring
+            # from connection setup() would bill keep-alive idle and slow
+            # client uploads to the server. The thread-local request id is
+            # cleared too, so a GET's access-log line can never inherit the
+            # id of an earlier request served on the same thread.
+            import time
+
+            self._t0 = time.monotonic()
+            _REQUEST_STATE.request_id = ""
+
+        def _access_log(self, code: int) -> None:
+            """Opt-in structured access logging (``OPENSIM_ACCESS_LOG=1``):
+            one JSON object per request on the ``opensim_tpu.access``
+            logger — request id, endpoint, status, duration — keeping the
+            quiet-by-default behavior when unset (ISSUE 5 satellite)."""
+            if os.environ.get("OPENSIM_ACCESS_LOG") != "1":
+                return
+            import time
+
+            _ACCESS_LOG.info(
+                "%s",
+                json.dumps(
+                    {
+                        "ts": round(time.time(), 3),
+                        "request_id": last_request_id(),
+                        "method": self.command,
+                        "endpoint": self.path,
+                        "status": code,
+                        "duration_s": round(
+                            time.monotonic() - getattr(self, "_t0", time.monotonic()), 6
+                        ),
+                        "remote": self.client_address[0],
+                    },
+                    sort_keys=True,
+                ),
+            )
+
         def _send(self, code: int, body: dict, extra_headers: Optional[dict] = None) -> None:
             data = json.dumps(body).encode()
             self.send_response(code)
@@ -599,8 +716,10 @@ def make_handler(server: SimonServer):
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(data)
+            self._access_log(code)
 
         def do_GET(self):
+            self._begin_request()
             if self.path == "/healthz":
                 self._send(200, {"status": "ok"})
             elif self.path == "/metrics":
@@ -610,6 +729,21 @@ def make_handler(server: SimonServer):
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
+                self._access_log(200)
+            elif self.path == "/api/debug/requests":
+                # flight recorder (docs/observability.md): newest-first
+                # summaries of the last N request traces
+                self._send(200, {"requests": FLIGHT_RECORDER.summaries()})
+            elif self.path.startswith("/api/debug/requests/"):
+                # drop any query string before extracting the id segment
+                rid = tracing.sanitize_request_id(
+                    self.path.split("?", 1)[0].rsplit("/", 1)[1]
+                )
+                tr = FLIGHT_RECORDER.get(rid)
+                if tr is None:
+                    self._send(404, {"error": f"no recorded trace for request id {rid!r}"})
+                else:
+                    self._send(200, tr.tree())
             elif self.path.startswith("/debug/profiler"):
                 # pprof analogue (the reference registers pprof on gin,
                 # server.go:152): start the JAX profiler server and report
@@ -626,6 +760,7 @@ def make_handler(server: SimonServer):
                 self._send(404, {"error": "not found"})
 
         def do_POST(self):
+            self._begin_request()
             length = int(self.headers.get("Content-Length", 0))
             try:
                 payload = json.loads(self.rfile.read(length) or b"{}")
@@ -633,18 +768,30 @@ def make_handler(server: SimonServer):
                 self._send(400, {"error": "invalid JSON body"})
                 return
             deadline = request_deadline(self.headers)
+            # request-id propagation (ISSUE 5): honor the client's
+            # X-Simon-Request-Id (sanitized), generate one otherwise; the
+            # id is echoed below and keys the flight-recorder trace
+            request_id = self.headers.get("X-Simon-Request-Id")
             if self.path == "/api/deploy-apps":
-                code, body = server.deploy_apps(payload, deadline=deadline)
+                code, body = server.deploy_apps(
+                    payload, deadline=deadline, request_id=request_id
+                )
             elif self.path == "/api/scale-apps":
-                code, body = server.scale_apps(payload, deadline=deadline)
+                code, body = server.scale_apps(
+                    payload, deadline=deadline, request_id=request_id
+                )
             else:
                 code, body = 404, {"error": "not found"}
             # degraded-mode transparency: a result computed from a stale
             # snapshot (apiserver down through every retry) says so. Read
             # per-request (thread-local), not off the shared server flag —
             # a concurrent refresh must not mis-tag this response.
-            extra = {"X-Simon-Snapshot": "stale"} if request_served_stale() else None
-            self._send(code, body, extra_headers=extra)
+            extra = {}
+            if request_served_stale():
+                extra["X-Simon-Snapshot"] = "stale"
+            if last_request_id():
+                extra["X-Simon-Request-Id"] = last_request_id()
+            self._send(code, body, extra_headers=extra or None)
 
     return Handler
 

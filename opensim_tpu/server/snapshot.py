@@ -133,6 +133,8 @@ def _cluster_via_rest(kubeconfig: str, master: Optional[str]) -> ResourceTypes:
     already the wire form ``from_dict`` consumes (no client sanitization
     needed). A missing optional endpoint (404/403 on PDBs in a minimal
     cluster) yields an empty list rather than failing the snapshot."""
+    from ..obs import trace as obs
+
     server, headers, ssl_ctx = _load_kubeconfig(kubeconfig, master)
     rt = ResourceTypes()
     for path, field, wrap in _REST_LISTS:
@@ -143,8 +145,9 @@ def _cluster_via_rest(kubeconfig: str, master: Optional[str]) -> ResourceTypes:
         # retry them. Retrying here too would multiply the attempt budget
         # to attempts² per endpoint.
         try:
-            with urllib.request.urlopen(req, timeout=60, context=ssl_ctx) as resp:
-                body = json.load(resp)
+            with obs.span("snapshot.list", path=path):
+                with urllib.request.urlopen(req, timeout=60, context=ssl_ctx) as resp:
+                    body = json.load(resp)
         except urllib.error.HTTPError as e:
             if field in ("pdbs", "storage_classes", "pvcs") and e.code in (403, 404):
                 continue

@@ -82,6 +82,12 @@ class PrepStats:
             self.seconds[kind] = self.seconds.get(kind, 0.0) + seconds
             self.counts[kind] = self.counts.get(kind, 0) + 1
             self.last = (kind, seconds)
+        # host-prepare attribution as trace spans (ISSUE 5): every way a
+        # simulation obtained its Prepared appears in the request's span
+        # tree. No-op (one contextvar read) without an ambient trace.
+        from ..obs import trace as _obs
+
+        _obs.record_span(f"prep.{kind}", seconds, kind=kind)
 
     def total_seconds(self) -> float:
         with self._lock:

@@ -572,3 +572,86 @@ def test_unbounded_retry_nested_loops_report_sleep_once():
     # the sleep belongs to its NEAREST enclosing loop only: one finding,
     # not one per enclosing loop level
     assert _codes(src, rules=["unbounded-retry"]) == ["OSL601"]
+
+
+# ---------------------------------------------------------------------------
+# OSL701 deadline-span
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_span_fires_on_uninstrumented_phase_boundary():
+    src = """
+    from opensim_tpu.resilience.deadline import check_deadline
+
+    def prepare_things(cluster):
+        check_deadline("prepare")
+        return encode(cluster)
+    """
+    assert _codes(src, path="opensim_tpu/engine/fixture.py", rules=["deadline-span"]) == ["OSL701"]
+
+
+def test_deadline_span_fires_on_bare_deadline_scope():
+    src = """
+    from opensim_tpu.resilience.deadline import deadline_scope
+
+    def handle(req, deadline):
+        with deadline_scope(deadline):
+            return run(req)
+    """
+    assert _codes(src, path="opensim_tpu/server/fixture.py", rules=["deadline-span"]) == ["OSL701"]
+
+
+def test_deadline_span_silent_when_span_present():
+    src = """
+    from opensim_tpu.obs import trace as obs
+    from opensim_tpu.resilience.deadline import check_deadline
+
+    def prepare_things(cluster):
+        check_deadline("prepare")
+        with obs.span("prepare"):
+            return encode(cluster)
+
+    def measured(cluster):
+        check_deadline("encode")
+        t0 = now()
+        out = encode(cluster)
+        obs.record_span("encode", now() - t0)
+        return out
+    """
+    assert _codes(src, path="opensim_tpu/engine/fixture.py", rules=["deadline-span"]) == []
+
+
+def test_deadline_span_nested_def_does_not_credit_outer():
+    src = """
+    from opensim_tpu.obs import trace as obs
+    from opensim_tpu.resilience.deadline import check_deadline
+
+    def outer(cluster):
+        check_deadline("snapshot")
+
+        def callback():
+            with obs.span("snapshot"):
+                pass
+
+        return fetch(cluster, callback)
+    """
+    # the span lives in the nested function, not at the boundary itself
+    assert _codes(src, path="opensim_tpu/engine/fixture.py", rules=["deadline-span"]) == ["OSL701"]
+
+
+def test_deadline_span_suppression_and_exempt_paths():
+    src = """
+    from opensim_tpu.resilience.deadline import check_deadline
+
+    def quick(cluster):
+        check_deadline("decode")  # opensim-lint: disable=deadline-span
+        return decode(cluster)
+    """
+    assert _codes(src, path="opensim_tpu/engine/fixture.py", rules=["deadline-span"]) == []
+    # the deadline module itself (and tests) are exempt by path
+    bare = """
+    def helper():
+        check_deadline("decode")
+    """
+    assert _codes(bare, path="opensim_tpu/resilience/deadline.py", rules=["deadline-span"]) == []
+    assert _codes(bare, path="tests/test_x.py", rules=["deadline-span"]) == []

@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke
+.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke
 
 all: test
 
@@ -23,9 +23,11 @@ mypy:
 
 # fault-injection suite (docs/resilience.md): every OPENSIM_FAULTS point
 # must either recover (retry/fallback, placements identical to an
-# uninjected run) or fail closed with a typed error and intact /metrics
+# uninjected run) or fail closed with a typed error and intact /metrics.
+# test_watch.py drives the live twin's watch faults (disconnect/410/lost
+# event) against the canned stub apiserver mid-stream (docs/live-twin.md)
 chaos:
-	python -m pytest tests/test_chaos.py tests/test_resilience.py -q
+	python -m pytest tests/test_chaos.py tests/test_resilience.py tests/test_watch.py -q
 
 # perf gate (ISSUE 4): a small affinity workload must engage the C++
 # engine's incremental cache AND match the forced-generic path bit-for-bit
@@ -38,8 +40,15 @@ perf-smoke:
 obs-smoke:
 	python tools/obs_smoke.py
 
-# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs
-verify: lint mypy test-quick chaos perf-smoke obs-smoke
+# live-twin gate (ISSUE 6, docs/live-twin.md): stub apiserver + watch-mode
+# server + injected disconnect/410/lost-event storm; the twin must
+# reconverge with placements shape-equal to a fresh full relist, drift
+# detected, and events carried by delta re-encodes (no full prepare)
+twin-smoke:
+	python tools/twin_smoke.py
+
+# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin
+verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke
 
 # run the moment the TPU tunnel opens (tools/tpu_probe_loop.sh writes
 # /tmp/opensim-tpu-watch.up): compiled-Mosaic parity suite + full bench

@@ -12,6 +12,8 @@ Rules (short name = suppression id; see docs/static-analysis.md):
     OSL501 exception-swallow  broad except without raise/log
     OSL601 unbounded-retry    retry loop without a bound or backoff
     OSL701 deadline-span      Deadline phase boundary without a trace span
+    OSL801 unsupervised-watch-loop  `while True` watch/reconnect loop
+                              bypassing resilience.retry
 """
 
 from .core import (  # noqa: F401
@@ -35,4 +37,5 @@ from . import (  # noqa: F401,E402
     rules_jit,
     rules_obs,
     rules_retry,
+    rules_watch,
 )

@@ -1,0 +1,73 @@
+"""unsupervised-watch-loop (OSL801): `while True` watch/reconnect loops
+that bypass the resilience layer.
+
+Extends OSL601 (unbounded-retry) to the live twin's failure surface: a
+watch consumer that reconnects in a bare ``while True:`` loop has no
+attempt bound, no jittered backoff, and no path to the supervised
+``degraded`` state — exactly the crash-loop ``server/watch.py`` exists to
+prevent. The reflector contract is:
+
+- loops gated on a stop/supervision condition (``while not stop.is_set()``),
+  never a literal ``while True``, and
+- every (re)connect and relist wrapped in
+  :func:`opensim_tpu.resilience.retry.retry_call` (bounded attempts,
+  full-jitter backoff).
+
+This rule flags any ``while True:`` loop that calls a watch/stream-style
+API (a call whose dotted leaf is ``watch``, ``stream``, or ``reconnect``)
+without ``retry_call`` appearing anywhere in the loop body. Either fix
+satisfies it: route the connect through ``retry_call``, or restructure the
+loop under a supervised stop condition.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import FileContext, Finding, Rule, dotted_name, register
+from .rules_retry import _is_while_true, _loop_body_walk
+
+# call leaves that (re)establish an event stream — the operations a
+# supervised consumer must bound
+_WATCH_LEAVES = {"watch", "stream", "reconnect"}
+
+
+def _is_watch_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    leaf = name.rsplit(".", 1)[-1] if name else ""
+    if leaf in _WATCH_LEAVES:
+        return True
+    return isinstance(node.func, ast.Attribute) and node.func.attr in _WATCH_LEAVES
+
+
+def _calls_retry_call(body: Iterable[ast.AST]) -> bool:
+    for n in body:
+        if isinstance(n, ast.Call):
+            name = dotted_name(n.func)
+            if name.rsplit(".", 1)[-1] == "retry_call":
+                return True
+    return False
+
+
+@register
+class UnsupervisedWatchLoopRule(Rule):
+    name = "unsupervised-watch-loop"
+    code = "OSL801"
+    description = "`while True` watch/reconnect loop bypassing resilience.retry"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for loop in ast.walk(ctx.tree):
+            if not _is_while_true(loop):
+                continue
+            body = list(_loop_body_walk(loop))
+            has_watch = any(isinstance(n, ast.Call) and _is_watch_call(n) for n in body)
+            if has_watch and not _calls_retry_call(body):
+                yield self.finding(
+                    ctx,
+                    loop,
+                    "`while True` (re)establishes a watch/event stream with "
+                    "no attempt bound or backoff; wrap the connect in "
+                    "resilience.retry.retry_call and gate the loop on a "
+                    "supervision condition (see server/watch.py)",
+                )

@@ -102,6 +102,13 @@ def build_parser() -> argparse.ArgumentParser:
     server_p.add_argument("--master", default="", help="apiserver address override")
     server_p.add_argument("--port", type=int, default=8080, help="listen port")
     server_p.add_argument(
+        "--watch", default="auto", choices=["auto", "on", "off"],
+        help="live-twin mode (docs/live-twin.md): consume the cluster's "
+        "watch streams and keep an always-warm incremental snapshot. "
+        "auto = watch with graceful fallback to per-TTL polling; on = "
+        "require the twin to sync at startup; off = polling only",
+    )
+    server_p.add_argument(
         "--access-log", action="store_true",
         help="emit one JSON access-log line per request (request id, "
         "endpoint, status, duration) — same as OPENSIM_ACCESS_LOG=1",
@@ -237,7 +244,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.access_log:
             os.environ["OPENSIM_ACCESS_LOG"] = "1"
         native.available()  # warm the C++ engine build before the first request
-        return serve(kubeconfig=args.kubeconfig, master=args.master, port=args.port)
+        return serve(
+            kubeconfig=args.kubeconfig, master=args.master, port=args.port,
+            watch=args.watch,
+        )
     if args.command == "gen-doc":
         return gen_doc(parser, args.output_dir)
     parser.print_help()

@@ -238,6 +238,11 @@ class CacheEntry:
         self.lock = base.lock if base is not None else threading.RLock()
         self.bind_snap = snapshot_bind_state(prep) if prep is not None else []
         self._dev_map: Optional[dict] = None
+        # live-twin delta state (server/watch.py): pods DELETED by watch
+        # events stay in the cached stream with their valid-mask bit flipped
+        # here instead of forcing a full re-encode; the REST layer unions
+        # this into every simulate() drop mask derived from the entry
+        self.base_drop: Optional[np.ndarray] = None
         # (object, local_version at fingerprint time) — the stale-entry
         # guard; see VersionedObject (models/objects.py) and
         # watch_snapshot(). Derived entries share the base's list: their
@@ -582,6 +587,124 @@ def drop_mask_for_scaled(
         if owned_by(prep.ordered[i], scaled):
             mask[i] = True
     return mask
+
+
+def pad_drop_mask(mask: Optional[np.ndarray], n: int) -> Optional[np.ndarray]:
+    """Extend a base-entry drop mask to a longer derived stream. Safe for
+    every derive path in use: ``derive_with_apps`` appends at the end and
+    ``extend_with_nodes`` splices only above the bare-pod prefix, while twin
+    drop masks only ever flag bare pods — set bits never move."""
+    if mask is None:
+        return None
+    if len(mask) >= n:
+        return mask[:n]
+    out = np.zeros((n,), dtype=bool)
+    out[: len(mask)] = mask
+    return out
+
+
+def union_drop_masks(
+    a: Optional[np.ndarray], b: Optional[np.ndarray], n: int
+) -> Optional[np.ndarray]:
+    """Union of two (optional) drop masks, padded to stream length ``n``."""
+    a = pad_drop_mask(a, n)
+    b = pad_drop_mask(b, n)
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a | b
+
+
+def twin_pod_delta(
+    base_entry: CacheEntry,
+    key: str,
+    added: List[Pod],
+    removed_keys: set,
+    watch: Optional[WatchSnapshot] = None,
+) -> Optional[CacheEntry]:
+    """O(changes) base-entry maintenance for the live twin (server/watch.py):
+    derive a new base CacheEntry from the current one after a batch of pod
+    ADDED/DELETED watch events, without re-expanding or re-encoding the
+    cluster.
+
+    - ``added`` pods are encoded into a fork of the cached arenas and
+      inserted at the END OF THE BARE REGION — exactly where a fresh
+      ``prepare()`` of the re-listed cluster puts them (the twin appends new
+      pods to its pod list, mirroring event order).
+    - ``removed_keys`` — ``(namespace, name)`` pairs — become valid-mask
+      flips recorded in ``CacheEntry.base_drop``; the pods stay in the
+      stream but every engine skips them (the scale-apps drop-mask path,
+      proven placement-identical to re-encoding the shrunk cluster).
+
+    Returns None when the entry cannot express the delta (no encoder
+    provenance, a removed pod outside the bare region, or the accumulated
+    masked-row density past the compaction threshold below) — the caller
+    falls back to a full rebuild. MUST be called with ``base_entry.lock``
+    held and bind state restored."""
+    prep = base_entry.prep
+    if prep is None or prep.encoder is None or prep.ds_group_sizes is None:
+        return None
+    t0 = time.monotonic()
+    nb = prep.n_bare
+    drop = (
+        np.array(base_entry.base_drop, dtype=bool, copy=True)
+        if base_entry.base_drop is not None
+        else np.zeros((len(prep.ordered),), dtype=bool)
+    )
+    if removed_keys:
+        found = set()
+        for i in range(nb):
+            p = prep.ordered[i]
+            k = (p.metadata.namespace, p.metadata.name)
+            if k in removed_keys:
+                drop[i] = True
+                found.add(k)
+        missing = removed_keys - found
+        if missing:
+            # a deletion we cannot locate in the bare prefix (e.g. the pod
+            # was never admissible, or it lives in a workload expansion) —
+            # only the full rebuild knows how to express it
+            return None
+    if added:
+        enc = prep.encoder.fork()
+        ids_new = [
+            enc.add_pod(p, (lambda p=p: _owner_selector(p)), hint=_tmpl_hint(p))
+            for p in added
+        ]
+        new_prep = _assemble_delta(
+            base_entry,
+            enc,
+            ordered=list(prep.ordered[:nb]) + list(added) + list(prep.ordered[nb:]),
+            tmpl_parts=[
+                prep.tmpl_ids[:nb],
+                np.asarray(ids_new, dtype=np.int32),
+                prep.tmpl_ids[nb:],
+            ],
+            forced_parts=[
+                prep.forced[:nb],
+                np.asarray([bool(p.spec.node_name) for p in added], dtype=bool),
+                prep.forced[nb:],
+            ],
+            n_cluster=prep.n_cluster + len(added),
+            n_bare=nb + len(added),
+            ds_group_sizes=list(prep.ds_group_sizes),
+        )
+        drop = np.concatenate([drop[:nb], np.zeros((len(added),), bool), drop[nb:]])
+    else:
+        new_prep = prep  # drops alone never re-encode: the mask is the delta
+    # compaction threshold: deleted pods stay in the stream as masked rows,
+    # so pure add/delete churn would otherwise grow the stream (and every
+    # engine pass over it) without bound. Past the threshold the delta is
+    # refused and the caller's full rebuild re-prepares the compacted
+    # cluster — amortized O(cluster / threshold) per churned pod.
+    n_dropped = int(drop.sum())
+    if n_dropped > max(64, len(drop) // 4):
+        return None
+    entry = CacheEntry(key, new_prep, base=base_entry, watch=watch)
+    entry.base_drop = drop if n_dropped else None
+    PREP_STATS.record("twin_delta", time.monotonic() - t0)
+    return entry
 
 
 # ---------------------------------------------------------------------------

@@ -20,6 +20,16 @@ point                  fires inside
 ``engine.device_put``  ``engine/scheduler.to_device``
 ``cache.stale``        ``PrepareCache.check_fresh`` (raises
                        ``StaleFingerprintError`` like a mid-flight touch)
+``watch.disconnect``   the watch event read loop (``server/watch.py``) — the
+                       stream drops mid-flight and must reconnect
+``watch.gone``         the watch event read loop — the apiserver expires the
+                       resourceVersion (``410 Gone``) and the consumer must
+                       relist-and-rebase
+``watch.drop_event``   watch event dispatch — the event is LOST (not an
+                       exception: the consumer silently skips it), so only
+                       the anti-entropy pass can notice the drift
+``watch.reorder``      watch event dispatch — the event is delivered AFTER
+                       its successor (out-of-order stream)
 =====================  ======================================================
 
 Activation, either route:
@@ -58,6 +68,10 @@ FAULT_POINTS = (
     "engine.compile",
     "engine.device_put",
     "cache.stale",
+    "watch.disconnect",
+    "watch.gone",
+    "watch.drop_event",
+    "watch.reorder",
 )
 
 

@@ -118,7 +118,7 @@ class _Metrics:
         with self.lock:
             setattr(self, counter, getattr(self, counter) + n)
 
-    def render(self, prep_cache=None) -> str:
+    def render(self, prep_cache=None, watch=None) -> str:
         from ..utils.trace import PREP_STATS
 
         esc = escape_label_value
@@ -191,6 +191,10 @@ class _Metrics:
                 f'simon_faults_injected_total{{point="{esc(point)}"}} {n}'
                 for point, n in fired
             ]
+        # live-twin state machine + event/drift counters (server/watch.py):
+        # simon_watch_state one-hot, events by kind, reconnects, drift
+        if watch is not None:
+            lines += watch.metrics_lines()
         # per-phase / per-endpoint latency histograms, computed from the
         # same spans the flight recorder serves (obs/metrics.py)
         lines += RECORDER.render_lines()
@@ -267,10 +271,18 @@ class SimonServer:
         base_cluster: Optional[ResourceTypes] = None,
         snapshot_ttl_s: float = 30.0,
         prep_cache=None,
+        watch=None,
     ):
         self.kubeconfig = kubeconfig
         self.master = master
         self.base_cluster = base_cluster
+        # live twin (server/watch.py, ISSUE 6): when a WatchSupervisor is
+        # attached AND synced, requests serve from its event-maintained twin
+        # (tagged stale while degraded); until then — and whenever watch
+        # mode is off or its bootstrap keeps failing — the polling snapshot
+        # below is the graceful fallback, so watch mode has no regression
+        # path
+        self.watch = watch
         # live-cluster snapshots are cached between requests (the reference
         # serves every request from its always-warm informer cache,
         # pkg/server/server.go:97-137, instead of re-listing the cluster);
@@ -294,9 +306,35 @@ class SimonServer:
             prep_cache = PrepareCache()
         self.prep_cache = prep_cache if prep_cache is not False else None
 
+    def _twin_snapshot(self) -> Optional[tuple]:
+        """(cluster, cache key) from the synced live twin, or None when the
+        polling path must serve (no watch, or not yet synced). Tags the
+        request stale when the twin is degraded/resyncing."""
+        if self.watch is None:
+            return None
+        check_deadline("snapshot")
+        with tracing.span("snapshot", source="twin") as sp:
+            got = self.watch.serving_snapshot()
+            if got is None:
+                sp.set(synced=False)
+                return None
+            cluster, key, stale = got
+            sp.set(key=key, stale=stale, state=self.watch.state())
+            _mark_request_snapshot(stale)
+            if stale:
+                METRICS.bump("snapshot_stale_served")
+        return cluster, key
+
     def current_cluster(self) -> ResourceTypes:
         if self.base_cluster is not None:
             return self.base_cluster
+        got = self._twin_snapshot()
+        if got is not None:
+            import copy as _copy
+
+            # the legacy (cache-off) path mutates the cluster in place —
+            # the twin's objects must stay pristine
+            return _copy.deepcopy(got[0])
         if self.kubeconfig:
             import copy as _copy
 
@@ -389,6 +427,13 @@ class SimonServer:
             if self._snapshot_fp is None:
                 self._snapshot_fp = fingerprint_cluster(self.base_cluster)
             return self.base_cluster, self._snapshot_fp
+        got = self._twin_snapshot()
+        if got is not None:
+            # generation-keyed, not content-fingerprinted: every applied
+            # event bumps the twin's generation, and the watch supervisor —
+            # not this request path — owns base-entry invalidation (it
+            # replaces the base by O(changes) delta instead)
+            return got
         if self.kubeconfig:
             old_fp = self._snapshot_fp
             self._refresh_snapshot()
@@ -517,10 +562,15 @@ class SimonServer:
             )
             if derived is None:
                 return simulate(_filtered(), apps)
-            drop = (
+            # the simulate drop mask composes the scale request's removals
+            # with the live twin's event-deleted pods (CacheEntry.base_drop:
+            # watch DELETEDs stay in the cached stream, mask-flipped)
+            drop = prepcache.union_drop_masks(
+                base.base_drop,
                 prepcache.drop_mask_for_scaled(derived, _owned_by, scaled)
                 if scaled
-                else None
+                else None,
+                len(derived.ordered),
             )
             entry = prepcache.CacheEntry(full_key, derived, base=base)
             entry.drop_mask = drop
@@ -723,7 +773,9 @@ def make_handler(server: SimonServer):
             if self.path == "/healthz":
                 self._send(200, {"status": "ok"})
             elif self.path == "/metrics":
-                data = METRICS.render(prep_cache=server.prep_cache).encode()
+                data = METRICS.render(
+                    prep_cache=server.prep_cache, watch=server.watch
+                ).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(data)))
@@ -796,12 +848,52 @@ def make_handler(server: SimonServer):
     return Handler
 
 
-def serve(kubeconfig: str = "", master: str = "", port: int = 8080) -> int:
-    server = SimonServer(kubeconfig=kubeconfig, master=master)
+def serve(
+    kubeconfig: str = "", master: str = "", port: int = 8080, watch: str = "auto"
+) -> int:
+    """Start the REST server. ``watch`` selects the snapshot strategy when a
+    kubeconfig is configured (docs/live-twin.md):
+
+    - ``auto`` (default): start the live twin in the background and serve
+      from it once synced; until then — and if its bootstrap keeps
+      failing — requests fall back to the polling snapshot path;
+    - ``on``: require the twin to sync before accepting traffic (fail the
+      process if it cannot);
+    - ``off``: today's polling behavior only.
+    """
+    if watch == "on" and not kubeconfig:
+        # "require a synced twin" with nothing to sync FROM is an operator
+        # error that must not silently degrade to an empty polling server
+        print("simon server: --watch on requires --kubeconfig", flush=True)
+        return 1
+    supervisor = None
+    if kubeconfig and watch != "off":
+        from .watch import source_from_kubeconfig, watch_policy, WatchSupervisor
+
+        policy = watch_policy()
+        supervisor = WatchSupervisor(
+            source_from_kubeconfig(
+                kubeconfig, master or None, read_timeout_s=policy["stale_s"]
+            ),
+            policy=policy,
+        )
+    server = SimonServer(kubeconfig=kubeconfig, master=master, watch=supervisor)
+    if supervisor is not None:
+        supervisor.prep_cache = server.prep_cache
+        if watch == "on":
+            if not supervisor.start(wait_s=60.0):
+                print("simon server: --watch on but the twin could not sync", flush=True)
+                supervisor.stop()
+                return 1
+        else:
+            supervisor.start()
     httpd = ThreadingHTTPServer(("0.0.0.0", port), make_handler(server))
-    print(f"simon server listening on :{port}")
+    print(f"simon server listening on :{port}" + (" (live twin)" if supervisor else ""))
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
+    finally:
+        if supervisor is not None:
+            supervisor.stop()
     return 0

@@ -13,7 +13,8 @@ import os
 import ssl
 import urllib.error
 import urllib.request
-from typing import List, Optional
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
 
 import yaml
 
@@ -31,6 +32,21 @@ class SnapshotUnavailable(RuntimeError):
     """The apiserver stayed down through every retry and no previous
     snapshot exists to degrade to — the REST layer maps this to a typed 503
     (retryable) instead of a raw 500."""
+
+
+def snapshot_timeout_s() -> float:
+    """Per-list urllib timeout in seconds, from ``OPENSIM_SNAPSHOT_TIMEOUT_S``
+    (default 60 — the old hardcoded value). Validation matches
+    :func:`snapshot_retry_policy`: an unparseable value raises immediately
+    instead of silently restoring the default."""
+    raw = os.environ.get("OPENSIM_SNAPSHOT_TIMEOUT_S", "60")
+    try:
+        timeout = float(raw)
+    except ValueError:
+        raise ValueError("OPENSIM_SNAPSHOT_TIMEOUT_S must be a number") from None
+    if timeout <= 0:
+        raise ValueError("OPENSIM_SNAPSHOT_TIMEOUT_S must be positive")
+    return timeout
 
 
 def snapshot_retry_policy() -> tuple:
@@ -61,18 +77,38 @@ def _pod_admissible(d: dict) -> bool:
     return not any(o.get("kind") == "DaemonSet" for o in owners)
 
 
-# (endpoint path, ResourceTypes field, wrapper) — the list calls
-# CreateClusterResourceFromClient performs, as raw REST paths
-_REST_LISTS = [
-    ("/api/v1/nodes", "nodes", Node.from_dict),
-    ("/api/v1/pods?resourceVersion=0", "pods", Pod.from_dict),
-    ("/apis/apps/v1/daemonsets", "daemon_sets", Workload.from_dict),
-    ("/apis/policy/v1/poddisruptionbudgets", "pdbs", RawObject.from_dict),
-    ("/api/v1/services", "services", RawObject.from_dict),
-    ("/apis/storage.k8s.io/v1/storageclasses", "storage_classes", RawObject.from_dict),
-    ("/api/v1/persistentvolumeclaims", "pvcs", RawObject.from_dict),
-    ("/api/v1/configmaps", "config_maps", RawObject.from_dict),
-]
+@dataclass(frozen=True)
+class ResourceSpec:
+    """One listable (and watchable) resource: the REST path, the
+    ``ResourceTypes`` field it fills, the wire→model decoder, and whether a
+    minimal-RBAC cluster may legitimately refuse it (403) or not serve the
+    API group at all (404). The watch consumer (``server/watch.py``) and the
+    polling snapshot share this table — one code path for bootstrap lists
+    and per-refresh lists."""
+
+    path: str
+    field: str
+    wrap: Callable[[dict], object]
+    optional: bool = False
+
+
+# the list calls CreateClusterResourceFromClient performs, as raw REST
+# paths. pdbs/storage_classes/pvcs/services/config_maps are all optional:
+# minimal-RBAC clusters 403 them (services/config_maps included — a
+# read-only `nodes+pods` ServiceAccount is common) and old clusters may
+# 404 whole API groups.
+RESOURCES: Tuple[ResourceSpec, ...] = (
+    ResourceSpec("/api/v1/nodes", "nodes", Node.from_dict),
+    ResourceSpec("/api/v1/pods", "pods", Pod.from_dict),
+    ResourceSpec("/apis/apps/v1/daemonsets", "daemon_sets", Workload.from_dict),
+    ResourceSpec("/apis/policy/v1/poddisruptionbudgets", "pdbs", RawObject.from_dict, optional=True),
+    ResourceSpec("/api/v1/services", "services", RawObject.from_dict, optional=True),
+    ResourceSpec("/apis/storage.k8s.io/v1/storageclasses", "storage_classes", RawObject.from_dict, optional=True),
+    ResourceSpec("/api/v1/persistentvolumeclaims", "pvcs", RawObject.from_dict, optional=True),
+    ResourceSpec("/api/v1/configmaps", "config_maps", RawObject.from_dict, optional=True),
+)
+
+RESOURCE_BY_FIELD: Dict[str, ResourceSpec] = {spec.field: spec for spec in RESOURCES}
 
 
 def _load_kubeconfig(kubeconfig: str, master: Optional[str]) -> tuple:
@@ -128,48 +164,78 @@ def _load_kubeconfig(kubeconfig: str, master: Optional[str]) -> tuple:
     return server.rstrip("/"), headers, ssl_ctx
 
 
-def _cluster_via_rest(kubeconfig: str, master: Optional[str]) -> ResourceTypes:
-    """Stdlib fallback: GET the list endpoints directly. Endpoint JSON is
-    already the wire form ``from_dict`` consumes (no client sanitization
-    needed). A missing optional endpoint (404/403 on PDBs in a minimal
-    cluster) yields an empty list rather than failing the snapshot."""
+def list_resource(
+    server: str,
+    headers: dict,
+    ssl_ctx: Optional[ssl.SSLContext],
+    spec: ResourceSpec,
+) -> Optional[Tuple[List[dict], str]]:
+    """GET one list endpoint; returns ``(raw items, list resourceVersion)``
+    or None for a tolerated missing optional endpoint (403/404). EVERY list
+    passes ``resourceVersion=0`` (serve-from-cache semantics — the
+    apiserver answers from its watch cache instead of quorum-reading etcd,
+    exactly what the reference's informers request), and the returned
+    list-level resourceVersion is captured so a watch can resume from it —
+    the polling snapshot and the watch bootstrap are this one code path.
+
+    Single attempt, TYPED: transient failures become SnapshotFetchError so
+    the one bounded retry layer (the caller's retry_call) can retry them.
+    Retrying here too would multiply the attempt budget per endpoint."""
     from ..obs import trace as obs
 
+    sep = "&" if "?" in spec.path else "?"
+    req = urllib.request.Request(
+        f"{server}{spec.path}{sep}resourceVersion=0", headers=headers
+    )
+    try:
+        with obs.span("snapshot.list", path=spec.path):
+            with urllib.request.urlopen(
+                req, timeout=snapshot_timeout_s(), context=ssl_ctx
+            ) as resp:
+                body = json.load(resp)
+    except urllib.error.HTTPError as e:
+        if spec.optional and e.code in (403, 404):
+            return None
+        if e.code >= 500:  # apiserver-side transient: retryable
+            raise SnapshotFetchError(f"list {spec.path} failed: HTTP {e.code}") from e
+        raise RuntimeError(f"list {spec.path} failed: HTTP {e.code}") from e
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        raise SnapshotFetchError(f"list {spec.path} failed: {e}") from e
+    items: List[dict] = body.get("items") or []
+    rv = str((body.get("metadata") or {}).get("resourceVersion", ""))
+    return items, rv
+
+
+def _cluster_via_rest(
+    kubeconfig: str, master: Optional[str]
+) -> Tuple[ResourceTypes, Dict[str, str]]:
+    """Stdlib fallback: GET the list endpoints directly. Endpoint JSON is
+    already the wire form ``from_dict`` consumes (no client sanitization
+    needed). A missing optional endpoint (403/404 in a minimal-RBAC
+    cluster) yields an empty list rather than failing the snapshot.
+    Returns the cluster plus each list's resourceVersion keyed by field —
+    the watch bootstrap resumes streams from exactly these."""
     server, headers, ssl_ctx = _load_kubeconfig(kubeconfig, master)
     rt = ResourceTypes()
-    for path, field, wrap in _REST_LISTS:
-        req = urllib.request.Request(server + path, headers=headers)
-        # single attempt per endpoint, TYPED: transient failures become
-        # SnapshotFetchError so the one bounded retry layer — the caller's
-        # whole-snapshot retry_call (SimonServer._refresh_snapshot) — can
-        # retry them. Retrying here too would multiply the attempt budget
-        # to attempts² per endpoint.
-        try:
-            with obs.span("snapshot.list", path=path):
-                with urllib.request.urlopen(req, timeout=60, context=ssl_ctx) as resp:
-                    body = json.load(resp)
-        except urllib.error.HTTPError as e:
-            if field in ("pdbs", "storage_classes", "pvcs") and e.code in (403, 404):
-                continue
-            if e.code >= 500:  # apiserver-side transient: retryable
-                raise SnapshotFetchError(f"list {path} failed: HTTP {e.code}") from e
-            raise RuntimeError(f"list {path} failed: HTTP {e.code}") from e
-        except (urllib.error.URLError, OSError, TimeoutError) as e:
-            raise SnapshotFetchError(f"list {path} failed: {e}") from e
-        items: List[dict] = body.get("items") or []
-        dest = getattr(rt, field)
+    rvs: Dict[str, str] = {}
+    for spec in RESOURCES:
+        got = list_resource(server, headers, ssl_ctx, spec)
+        if got is None:
+            continue
+        items, rvs[spec.field] = got
+        dest = getattr(rt, spec.field)
         for d in items:
-            if field == "pods" and not _pod_admissible(d):
+            if spec.field == "pods" and not _pod_admissible(d):
                 continue
-            dest.append(wrap(d))
-    return rt
+            dest.append(spec.wrap(d))
+    return rt, rvs
 
 
 def cluster_from_kubeconfig(kubeconfig: str, master: Optional[str] = None) -> ResourceTypes:
     try:
         from kubernetes import client, config  # type: ignore
     except ImportError:
-        return _cluster_via_rest(kubeconfig, master)
+        return _cluster_via_rest(kubeconfig, master)[0]
 
     config.load_kube_config(config_file=kubeconfig)
     core = client.CoreV1Api()
@@ -182,24 +248,27 @@ def cluster_from_kubeconfig(kubeconfig: str, master: Optional[str] = None) -> Re
     def to_dict(obj) -> dict:
         return api.sanitize_for_serialization(obj)
 
+    # resourceVersion=0 on EVERY list (not just pods): serve-from-cache
+    # semantics, consistent with the REST path so watch bootstrap and
+    # polling share one list contract
     rt = ResourceTypes()
-    for n in core.list_node().items:
+    for n in core.list_node(resource_version="0").items:
         rt.nodes.append(Node.from_dict(to_dict(n)))
     for p in core.list_pod_for_all_namespaces(resource_version="0").items:
         d = to_dict(p)
         if not _pod_admissible(d):
             continue
         rt.pods.append(Pod.from_dict(d))
-    for ds in apps.list_daemon_set_for_all_namespaces().items:
+    for ds in apps.list_daemon_set_for_all_namespaces(resource_version="0").items:
         rt.daemon_sets.append(Workload.from_dict(to_dict(ds)))
-    for pdb in policy.list_pod_disruption_budget_for_all_namespaces().items:
+    for pdb in policy.list_pod_disruption_budget_for_all_namespaces(resource_version="0").items:
         rt.pdbs.append(RawObject.from_dict(to_dict(pdb)))
-    for svc in core.list_service_for_all_namespaces().items:
+    for svc in core.list_service_for_all_namespaces(resource_version="0").items:
         rt.services.append(RawObject.from_dict(to_dict(svc)))
-    for sc in storage.list_storage_class().items:
+    for sc in storage.list_storage_class(resource_version="0").items:
         rt.storage_classes.append(RawObject.from_dict(to_dict(sc)))
-    for pvc in core.list_persistent_volume_claim_for_all_namespaces().items:
+    for pvc in core.list_persistent_volume_claim_for_all_namespaces(resource_version="0").items:
         rt.pvcs.append(RawObject.from_dict(to_dict(pvc)))
-    for cm in core.list_config_map_for_all_namespaces().items:
+    for cm in core.list_config_map_for_all_namespaces(resource_version="0").items:
         rt.config_maps.append(RawObject.from_dict(to_dict(cm)))
     return rt

@@ -60,6 +60,8 @@ class PrepStats:
       ``full``        — a cold expand+encode of the whole cluster
       ``delta_apps``  — delta re-encode: pods appended to a cached base
       ``delta_nodes`` — delta re-encode: nodes added to a cached base
+      ``twin_delta``  — live-twin watch events folded into the warm base
+                        (pod insert / drop-mask flip, server/watch.py)
       ``hit``         — encode-cache hit (fingerprint + bind-state restore)
 
     ``bench.py`` emits these as ``host_prep_s``; the REST server exports

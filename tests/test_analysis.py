@@ -655,3 +655,62 @@ def test_deadline_span_suppression_and_exempt_paths():
     """
     assert _codes(bare, path="opensim_tpu/resilience/deadline.py", rules=["deadline-span"]) == []
     assert _codes(bare, path="tests/test_x.py", rules=["deadline-span"]) == []
+
+
+# ---------------------------------------------------------------------------
+# OSL801 unsupervised-watch-loop
+# ---------------------------------------------------------------------------
+
+
+def test_watch_loop_flags_while_true_reconnect():
+    src = """
+    def follow(client):
+        while True:
+            try:
+                for ev in client.watch("pods", rv):
+                    handle(ev)
+            except OSError:
+                continue                 # reconnect forever, no bound
+    """
+    assert _codes(src, rules=["unsupervised-watch-loop"]) == ["OSL801"]
+
+
+def test_watch_loop_flags_bare_stream_loop():
+    src = """
+    def tail(source):
+        while True:
+            consume(source.stream())
+    """
+    assert _codes(src, rules=["unsupervised-watch-loop"]) == ["OSL801"]
+
+
+def test_watch_loop_accepts_retry_call_and_supervised_loops():
+    src = """
+    from opensim_tpu.resilience.retry import retry_call
+
+    def follow(client, stop):
+        while not stop.is_set():          # supervised condition: fine
+            for ev in client.watch("pods", rv):
+                handle(ev)
+
+    def follow2(client):
+        while True:                       # bounded via retry_call: fine
+            stream = retry_call(lambda: client.watch("pods", rv), attempts=5)
+            for ev in stream:
+                handle(ev)
+
+    def spin():
+        while True:                       # no watch/stream call: OSL801 silent
+            work()
+    """
+    assert _codes(src, rules=["unsupervised-watch-loop"]) == []
+
+
+def test_watch_loop_suppression():
+    src = """
+    def follow(client):
+        # opensim-lint: disable=unsupervised-watch-loop
+        while True:
+            consume(client.watch("pods"))
+    """
+    assert _codes(src, rules=["unsupervised-watch-loop"]) == []

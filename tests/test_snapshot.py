@@ -36,34 +36,47 @@ class _L:
 
 
 def _install_fake_kubernetes(monkeypatch, store, calls):
+    def _note_rv(field, resource_version):
+        # every list passes resourceVersion=0 (serve-from-cache), not just
+        # pods — recorded per field so the test can assert the full set
+        calls.setdefault("resource_versions", {})[field] = resource_version
+        calls["resource_version"] = resource_version
+
     class CoreV1Api:
-        def list_node(self):
+        def list_node(self, resource_version=None):
+            _note_rv("nodes", resource_version)
             return _L(store.get("nodes", []))
 
         def list_pod_for_all_namespaces(self, resource_version=None):
-            calls["resource_version"] = resource_version
+            _note_rv("pods", resource_version)
             return _L(store.get("pods", []))
 
-        def list_service_for_all_namespaces(self):
+        def list_service_for_all_namespaces(self, resource_version=None):
+            _note_rv("services", resource_version)
             return _L(store.get("services", []))
 
-        def list_persistent_volume_claim_for_all_namespaces(self):
+        def list_persistent_volume_claim_for_all_namespaces(self, resource_version=None):
+            _note_rv("pvcs", resource_version)
             return _L(store.get("pvcs", []))
 
-        def list_config_map_for_all_namespaces(self):
+        def list_config_map_for_all_namespaces(self, resource_version=None):
+            _note_rv("config_maps", resource_version)
             return _L(store.get("config_maps", []))
 
     class AppsV1Api:
-        def list_daemon_set_for_all_namespaces(self):
+        def list_daemon_set_for_all_namespaces(self, resource_version=None):
+            _note_rv("daemon_sets", resource_version)
             return _L(store.get("daemon_sets", []))
 
     class PolicyV1Api:
-        def list_pod_disruption_budget_for_all_namespaces(self):
+        def list_pod_disruption_budget_for_all_namespaces(self, resource_version=None):
             calls["policy_api"] = "v1"
+            _note_rv("pdbs", resource_version)
             return _L(store.get("pdbs", []))
 
     class StorageV1Api:
-        def list_storage_class(self):
+        def list_storage_class(self, resource_version=None):
+            _note_rv("storage_classes", resource_version)
             return _L(store.get("storage_classes", []))
 
     class ApiClient:
@@ -131,6 +144,12 @@ def test_snapshot_filters_match_reference(monkeypatch):
     rt = cluster_from_kubeconfig("/tmp/kubeconfig")
     assert calls["kubeconfig"] == "/tmp/kubeconfig"
     assert calls["resource_version"] == "0"
+    # consistent list semantics: EVERY endpoint listed with resourceVersion=0
+    assert set(calls["resource_versions"]) == {
+        "nodes", "pods", "daemon_sets", "pdbs", "services",
+        "storage_classes", "pvcs", "config_maps",
+    }
+    assert all(v == "0" for v in calls["resource_versions"].values())
     assert calls["policy_api"] == "v1"
     assert [n.metadata.name for n in rt.nodes] == ["n1"]
     assert sorted(p.metadata.name for p in rt.pods) == [
@@ -355,3 +374,125 @@ def test_apply_against_stub_apiserver(tmp_path):
         assert "webapp" in text
     finally:
         httpd.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 6 satellites: minimal-RBAC tolerance, shared list path, timeout knob
+# ---------------------------------------------------------------------------
+
+
+def _minimal_stub(tmp_path, forbidden=()):
+    from opensim_tpu.server.stubapi import StubApiServer
+
+    stub = StubApiServer().start()
+    stub.seed("/api/v1/nodes", [fx.make_fake_node("n1", "8", "16Gi").raw])
+    stub.seed("/api/v1/pods", [_pod("p1", "Running", node="n1")])
+    for path in (
+        "/apis/apps/v1/daemonsets",
+        "/apis/policy/v1/poddisruptionbudgets",
+        "/api/v1/services",
+        "/apis/storage.k8s.io/v1/storageclasses",
+        "/api/v1/persistentvolumeclaims",
+        "/api/v1/configmaps",
+    ):
+        stub.seed(path, [])
+    stub.forbidden_paths.update(forbidden)
+    return stub, stub.kubeconfig(tmp_path)
+
+
+def test_minimal_rbac_403_on_optional_endpoints_tolerated(tmp_path):
+    """A read-only nodes+pods ServiceAccount 403s services and config maps
+    too — the whole optional-endpoint set yields empty lists instead of
+    failing the snapshot."""
+    from opensim_tpu.server.snapshot import _cluster_via_rest
+
+    stub, kc = _minimal_stub(
+        tmp_path,
+        forbidden=(
+            "/api/v1/services",
+            "/api/v1/configmaps",
+            "/apis/policy/v1/poddisruptionbudgets",
+            "/apis/storage.k8s.io/v1/storageclasses",
+            "/api/v1/persistentvolumeclaims",
+        ),
+    )
+    try:
+        rt, rvs = _cluster_via_rest(kc, None)
+        assert [n.metadata.name for n in rt.nodes] == ["n1"]
+        assert [p.metadata.name for p in rt.pods] == ["p1"]
+        assert rt.services == [] and rt.config_maps == []
+        assert rt.pdbs == [] and rt.storage_classes == [] and rt.pvcs == []
+        # forbidden endpoints record no list resourceVersion
+        assert "services" not in rvs and "config_maps" not in rvs
+        assert rvs["nodes"] and rvs["pods"]
+    finally:
+        stub.stop()
+
+
+def test_required_endpoint_403_still_fails(tmp_path):
+    """Only the OPTIONAL set is 403-tolerant: nodes/pods are load-bearing
+    and an RBAC hole there must surface, not serve an empty cluster."""
+    import pytest as _pytest
+
+    from opensim_tpu.server.snapshot import _cluster_via_rest
+
+    stub, kc = _minimal_stub(tmp_path, forbidden=("/api/v1/pods",))
+    try:
+        with _pytest.raises(RuntimeError, match="HTTP 403"):
+            _cluster_via_rest(kc, None)
+    finally:
+        stub.stop()
+
+
+def test_every_rest_list_passes_resource_version_zero(tmp_path):
+    """Consistent list semantics (one code path for polling and watch
+    bootstrap): every list endpoint is queried with resourceVersion=0 and
+    its list-level resourceVersion is captured."""
+    from opensim_tpu.server.snapshot import RESOURCES, _cluster_via_rest
+
+    stub, kc = _minimal_stub(tmp_path)
+    try:
+        rt, rvs = _cluster_via_rest(kc, None)
+        lists = [(p, q) for p, q in stub.requests_seen if "watch" not in q]
+        assert {p for p, _q in lists} == {spec.path for spec in RESOURCES}
+        assert all(q.get("resourceVersion") == ["0"] for _p, q in lists)
+        assert set(rvs) == {spec.field for spec in RESOURCES}
+        assert all(v.isdigit() for v in rvs.values())
+    finally:
+        stub.stop()
+
+
+def test_snapshot_timeout_knob_validated_and_plumbed(monkeypatch):
+    from opensim_tpu.server import snapshot as snap
+
+    assert snap.snapshot_timeout_s() == 60.0  # the old hardcoded default
+    monkeypatch.setenv("OPENSIM_SNAPSHOT_TIMEOUT_S", "7.5")
+    assert snap.snapshot_timeout_s() == 7.5
+
+    seen = {}
+
+    class _Resp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def read(self):
+            return b'{"items": [], "metadata": {"resourceVersion": "1"}}'
+
+    def fake_urlopen(req, timeout=None, context=None):
+        seen["timeout"] = timeout
+        return _Resp()
+
+    monkeypatch.setattr(snap.urllib.request, "urlopen", fake_urlopen)
+    got = snap.list_resource("http://x", {}, None, snap.RESOURCE_BY_FIELD["nodes"])
+    assert got == ([], "1")
+    assert seen["timeout"] == 7.5
+
+    monkeypatch.setenv("OPENSIM_SNAPSHOT_TIMEOUT_S", "a minute")
+    with pytest.raises(ValueError, match="OPENSIM_SNAPSHOT_TIMEOUT_S"):
+        snap.snapshot_timeout_s()
+    monkeypatch.setenv("OPENSIM_SNAPSHOT_TIMEOUT_S", "-1")
+    with pytest.raises(ValueError, match="positive"):
+        snap.snapshot_timeout_s()

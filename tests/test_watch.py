@@ -1,0 +1,636 @@
+"""Live-twin watch consumer coverage (ISSUE 6): event-sourced twin
+semantics (rv-monotonic application, tombstones, admissibility
+transitions), O(changes) prep-cache maintenance, and the supervised failure
+surface — disconnect/reconnect, 410 Gone relist-and-rebase, staleness
+degradation with stale-tagged responses, lost-event drift caught by
+anti-entropy — all driven end-to-end against the canned stub apiserver
+(``server/stubapi.py``) over the stdlib REST watch source. Part of
+``make chaos``."""
+
+import json
+import threading
+import time
+import urllib.request
+from contextlib import contextmanager
+
+import pytest
+
+from opensim_tpu.engine.prepcache import fingerprint_cluster
+from opensim_tpu.models import ResourceTypes, fixtures as fx
+from opensim_tpu.models.objects import Pod
+from opensim_tpu.resilience import faults
+from opensim_tpu.server import rest
+from opensim_tpu.server.snapshot import _cluster_via_rest
+from opensim_tpu.server.stubapi import StubApiServer
+from opensim_tpu.server.watch import (
+    ClusterTwin,
+    GoneError,
+    RestWatchSource,
+    WatchSupervisor,
+    watch_policy,
+)
+
+# small knobs so failure paths resolve in tens of milliseconds, not minutes
+FAST = {"stale_s": 3.0, "resync_s": 0.0, "reconnects": 3, "backoff_s": 0.01}
+
+LIST_PATHS = (
+    "/api/v1/nodes",
+    "/api/v1/pods",
+    "/apis/apps/v1/daemonsets",
+    "/apis/policy/v1/poddisruptionbudgets",
+    "/api/v1/services",
+    "/apis/storage.k8s.io/v1/storageclasses",
+    "/api/v1/persistentvolumeclaims",
+    "/api/v1/configmaps",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("OPENSIM_FAULTS", raising=False)
+    faults.clear_faults()
+    yield
+    faults.clear_faults()
+
+
+def _pod_dict(name, phase="Pending", node="", cpu="100m", rv=None):
+    d = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": cpu, "memory": "64Mi"}}}]},
+        "status": {"phase": phase},
+    }
+    if node:
+        d["spec"]["nodeName"] = node
+    if rv is not None:
+        d["metadata"]["resourceVersion"] = str(rv)
+    return d
+
+
+def _wait(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _seed(stub, n_nodes=4, pods=()):
+    stub.seed("/api/v1/nodes", [fx.make_fake_node(f"n{i}", "8", "16Gi").raw for i in range(n_nodes)])
+    stub.seed("/api/v1/pods", list(pods))
+    for p in LIST_PATHS[2:]:
+        stub.seed(p, [])
+
+
+@contextmanager
+def _twin_server(tmp_path, policy=None, bookmark_s=0.1, pods=(), wire_server=True):
+    """stub apiserver + synced supervisor (+ optionally a SimonServer whose
+    prep cache the supervisor maintains)."""
+    stub = StubApiServer(bookmark_interval_s=bookmark_s).start()
+    _seed(stub, pods=pods)
+    kc = stub.kubeconfig(tmp_path)
+    pol = dict(FAST, **(policy or {}))
+    sup = WatchSupervisor(
+        RestWatchSource(kc, read_timeout_s=max(pol["stale_s"], 3.0)), policy=pol
+    )
+    server = rest.SimonServer(kubeconfig=kc, watch=sup) if wire_server else None
+    if server is not None:
+        sup.prep_cache = server.prep_cache
+    try:
+        assert sup.start(wait_s=15.0), "twin failed to sync against the stub"
+        yield stub, sup, server, kc
+    finally:
+        sup.stop()
+        stub.stop()
+
+
+def _shape(resp):
+    """Placement shape (pod names embed a process-global expansion counter,
+    so recovery equality is shape-based — the chaos-suite idiom)."""
+    return (
+        sorted((e["node"], len(e["pods"])) for e in resp["nodeStatus"]),
+        sorted(u["reason"] for u in resp["unscheduledPods"]),
+    )
+
+
+def _payload():
+    return {"deployments": [fx.make_fake_deployment("web", 5, "500m", "1Gi").raw]}
+
+
+# ---------------------------------------------------------------------------
+# ClusterTwin unit semantics: duplicates, reordering, tombstones
+# ---------------------------------------------------------------------------
+
+
+def test_twin_event_application_is_rv_monotonic():
+    twin = ClusterTwin()
+    twin.rebase("pods", [_pod_dict("a", rv=5)])
+    gen0 = twin.generation
+
+    # duplicate delivery (same rv) is a no-op
+    assert twin.apply_event("pods", "ADDED", _pod_dict("a", rv=5)) is None
+    assert twin.generation == gen0
+    # reordered stale MODIFIED (older rv) is a no-op
+    assert twin.apply_event("pods", "MODIFIED", _pod_dict("a", rv=4)) is None
+    # a genuinely newer MODIFIED applies (and needs a rebuild, not a delta)
+    change = twin.apply_event("pods", "MODIFIED", _pod_dict("a", rv=9))
+    assert change[0] == "rebuild"
+
+    # new pod: delta-expressible add
+    change = twin.apply_event("pods", "ADDED", _pod_dict("b", rv=10))
+    assert change[0] == "pod_add" and change[1].metadata.name == "b"
+
+    # DELETED removes + tombstones; a reordered stale MODIFIED cannot
+    # resurrect the object
+    change = twin.apply_event("pods", "DELETED", _pod_dict("a", rv=12))
+    assert change == ("pod_del", ("default", "a"))
+    assert twin.apply_event("pods", "MODIFIED", _pod_dict("a", rv=11)) is None
+    assert [p.metadata.name for p in twin.materialize().pods] == ["b"]
+
+    # duplicate DELETED is a no-op
+    assert twin.apply_event("pods", "DELETED", _pod_dict("a", rv=12)) is None
+
+
+def test_twin_admissibility_transition_is_a_delete():
+    twin = ClusterTwin()
+    twin.rebase("pods", [_pod_dict("run", phase="Running", node="n1", rv=3)])
+    # Running -> Succeeded leaves the admissible set: the twin treats the
+    # MODIFIED as a deletion (snapshot filter parity)
+    change = twin.apply_event("pods", "MODIFIED", _pod_dict("run", phase="Succeeded", node="n1", rv=7))
+    assert change == ("pod_del", ("default", "run"))
+    assert twin.materialize().pods == []
+    # an inadmissible ADDED for an unknown pod is a full no-op
+    assert twin.apply_event("pods", "ADDED", _pod_dict("done", phase="Failed", rv=9)) is None
+
+
+def test_twin_node_events():
+    twin = ClusterTwin()
+    twin.rebase("nodes", [fx.make_fake_node("n0", "8", "16Gi").raw])
+    n1 = fx.make_fake_node("n1", "8", "16Gi").raw
+    n1["metadata"]["resourceVersion"] = "20"
+    change = twin.apply_event("nodes", "ADDED", n1)
+    assert change[0] == "node_add" and change[1].metadata.name == "n1"
+    n1b = json.loads(json.dumps(n1))
+    n1b["metadata"]["resourceVersion"] = "21"
+    n1b["spec"] = {"unschedulable": True}
+    assert twin.apply_event("nodes", "MODIFIED", n1b)[0] == "rebuild"
+    assert twin.apply_event("nodes", "DELETED", n1b)[0] == "rebuild"
+    assert [n.metadata.name for n in twin.materialize().nodes] == ["n0"]
+
+
+def test_twin_fingerprint_matches_equivalent_list():
+    twin = ClusterTwin()
+    nodes = [fx.make_fake_node(f"n{i}", "4", "8Gi").raw for i in range(3)]
+    twin.rebase("nodes", nodes)
+    twin.rebase("pods", [_pod_dict("a", rv=1), _pod_dict("b", rv=2)])
+    twin.apply_event("pods", "ADDED", _pod_dict("c", rv=9))
+    twin.apply_event("pods", "DELETED", _pod_dict("a", rv=10))
+
+    ref = ResourceTypes()
+    from opensim_tpu.models.objects import Node
+
+    ref.nodes.extend(Node.from_dict(d) for d in nodes)
+    ref.pods.append(Pod.from_dict(_pod_dict("b", rv=2)))
+    ref.pods.append(Pod.from_dict(_pod_dict("c", rv=9)))
+    assert twin.fingerprint() == fingerprint_cluster(ref)
+
+
+def test_reconcile_never_reverts_twin_ahead_of_listing():
+    """Anti-entropy races the event streams: objects the twin legitimately
+    advanced past the listing (newer rv, created-after-list, deleted-after-
+    list) are NOT drift and must not be reverted — only genuinely lost
+    events count and get repaired."""
+    twin = ClusterTwin()
+    twin.rebase("pods", [_pod_dict("stay", rv=5), _pod_dict("victim", rv=6)])
+
+    # twin moves ahead of a listing taken at list_rv=10: a MODIFIED to
+    # rv=12, a brand-new pod at rv=13, and a deletion at rv=14
+    assert twin.apply_event("pods", "MODIFIED", _pod_dict("stay", rv=12))
+    assert twin.apply_event("pods", "ADDED", _pod_dict("young", rv=13))
+    assert twin.apply_event("pods", "DELETED", _pod_dict("victim", rv=14))
+
+    listing = {
+        "pods": (
+            [_pod_dict("stay", rv=5), _pod_dict("victim", rv=6), _pod_dict("lost", rv=9)],
+            "10",
+        )
+    }
+    drift = twin.reconcile(listing)
+    # exactly ONE genuine drift: the 'lost' ADDED the stream never delivered
+    assert drift == 1
+    assert {p.metadata.name for p in twin.materialize().pods} == {"stay", "young", "lost"}
+    # and the ahead-of-list state survived untouched
+    stay = next(p for p in twin.materialize().pods if p.metadata.name == "stay")
+    assert stay.raw["metadata"]["resourceVersion"] == "12"
+
+    # a converged twin reconciles to zero against its own listing
+    again = {
+        "pods": (
+            [_pod_dict("stay", rv=12), _pod_dict("young", rv=13), _pod_dict("lost", rv=9)],
+            "15",
+        )
+    }
+    assert twin.reconcile(again) == 0
+
+
+def test_reconcile_repairs_lost_delete_and_lost_modify():
+    twin = ClusterTwin()
+    twin.rebase("pods", [_pod_dict("phantom", rv=3), _pod_dict("behind", rv=4)])
+    listing = {"pods": ([_pod_dict("behind", rv=8)], "9")}
+    drift = twin.reconcile(listing)
+    assert drift == 2  # phantom removed (lost DELETED) + behind replaced
+    pods = twin.materialize().pods
+    assert [p.metadata.name for p in pods] == ["behind"]
+    assert pods[0].raw["metadata"]["resourceVersion"] == "8"
+
+
+# ---------------------------------------------------------------------------
+# prep-cache delta: placements bit-equal to a fresh prepare
+# ---------------------------------------------------------------------------
+
+
+def test_twin_pod_delta_placements_bit_equal_to_fresh_prepare():
+    """A pod ADDED + a pod DELETED expressed as a base-entry delta schedule
+    byte-identically to a fresh full prepare of the re-listed cluster —
+    cluster pod names are stable, so equality is by name, not shape."""
+    from opensim_tpu.engine import prepcache
+    from opensim_tpu.engine.simulator import prepare, simulate
+
+    def cluster(with_new=False, without_dead=False):
+        rt = ResourceTypes()
+        for i in range(4):
+            rt.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+        if not without_dead:
+            rt.pods.append(Pod.from_dict(_pod_dict("dead", phase="Running", node="n0", cpu="300m")))
+        rt.pods.append(Pod.from_dict(_pod_dict("keep", phase="Pending", cpu="200m")))
+        if with_new:
+            rt.pods.append(Pod.from_dict(_pod_dict("new-a", cpu="450m")))
+            rt.pods.append(Pod.from_dict(_pod_dict("new-b", cpu="150m")))
+        return rt
+
+    base_cluster = cluster()
+    base = prepcache.CacheEntry("t|base", prepare(base_cluster, []))
+
+    added = [Pod.from_dict(_pod_dict("new-a", cpu="450m")), Pod.from_dict(_pod_dict("new-b", cpu="150m"))]
+    with base.lock:
+        base.restore()
+        entry = prepcache.twin_pod_delta(base, "t2|base", added, {("default", "dead")})
+    assert entry is not None and entry.base_drop is not None
+
+    live = cluster(with_new=True, without_dead=True)
+    res_delta = simulate(live, [], prep=entry.prep, drop_pods=entry.base_drop)
+    res_fresh = simulate(cluster(with_new=True, without_dead=True), [])
+
+    def placed(res):
+        return {
+            p.metadata.name: ns.node.metadata.name
+            for ns in res.node_status
+            for p in ns.pods
+        }
+
+    assert placed(res_delta) == placed(res_fresh)
+    assert "dead" not in placed(res_delta)
+    # the delta path never re-prepared the cluster: stream length is the
+    # base's plus exactly the added pods
+    assert len(entry.prep.ordered) == len(base.prep.ordered) + 2
+
+
+def test_twin_pod_delta_refuses_past_compaction_threshold():
+    """Pure add/delete churn must not grow the masked-row count without
+    bound: past the density threshold the delta is refused (None) so the
+    caller's full rebuild compacts the stream."""
+    from opensim_tpu.engine import prepcache
+    from opensim_tpu.engine.simulator import prepare
+
+    rt = ResourceTypes()
+    rt.nodes.append(fx.make_fake_node("n0", "64", "256Gi"))
+    for i in range(100):
+        rt.pods.append(Pod.from_dict(_pod_dict(f"churn-{i}", phase="Running", node="n0")))
+    base = prepcache.CacheEntry("c|base", prepare(rt, []))
+    with base.lock:
+        base.restore()
+        # 65 deletions of 100 bare pods: > max(64, len//4) masked rows
+        doomed = {("default", f"churn-{i}") for i in range(65)}
+        assert prepcache.twin_pod_delta(base, "c2|base", [], doomed) is None
+        # under the threshold the delta still engages
+        few = {("default", f"churn-{i}") for i in range(10)}
+        entry = prepcache.twin_pod_delta(base, "c3|base", [], few)
+        assert entry is not None and int(entry.base_drop.sum()) == 10
+
+
+# ---------------------------------------------------------------------------
+# end-to-end against the stub apiserver
+# ---------------------------------------------------------------------------
+
+
+def test_event_convergence_fingerprint_matches_full_relist(tmp_path):
+    """ADDED/DELETED watch events leave the twin bit-equal (content
+    fingerprint) to a fresh full relist — the bootstrap and the relist share
+    one list code path, so the comparison is exact."""
+    with _twin_server(tmp_path, pods=[_pod_dict("p1", phase="Running", node="n0")]) as (
+        stub, sup, server, kc,
+    ):
+        stub.upsert("/api/v1/pods", _pod_dict("p2"))
+        stub.upsert("/api/v1/pods", _pod_dict("p3", cpu="200m"))
+        stub.delete("/api/v1/pods", "p1")
+        _wait(
+            lambda: sorted(p.metadata.name for p in sup.twin.materialize().pods) == ["p2", "p3"],
+            msg="twin to apply ADDED+DELETED",
+        )
+        fresh, rvs = _cluster_via_rest(kc, None)
+        assert sup.twin.fingerprint() == fingerprint_cluster(fresh)
+        # every list captured its resourceVersion (satellite: shared list path)
+        assert rvs and all(v for v in rvs.values())
+
+
+def test_warm_path_single_event_is_delta_not_full_prepare(tmp_path):
+    """Warm-path proof: after the first request builds the base, a pod
+    ADDED/DELETED event costs one twin_delta re-encode (O(changes)) and the
+    next request pays only its own app delta — PREP_STATS shows no second
+    'full' prepare, and placements stay shape-equal to a polling server
+    that full-relists."""
+    from opensim_tpu.utils.trace import PREP_STATS
+
+    with _twin_server(tmp_path, pods=[_pod_dict("p1", phase="Running", node="n0")]) as (
+        stub, sup, server, kc,
+    ):
+        code, body1 = server.deploy_apps(_payload())
+        assert code == 200
+        full0 = PREP_STATS.counts.get("full", 0)
+        delta0 = PREP_STATS.counts.get("twin_delta", 0)
+
+        stub.upsert("/api/v1/pods", _pod_dict("p2"))
+        _wait(lambda: len(sup.twin.materialize().pods) == 2, msg="ADDED applied")
+        sup.flush_pending()  # deterministic maintenance (normally the tick)
+        assert PREP_STATS.counts.get("twin_delta", 0) == delta0 + 1
+
+        code, body2 = server.deploy_apps(_payload())
+        assert code == 200
+        assert PREP_STATS.counts.get("full", 0) == full0  # no full re-prepare
+
+        stub.delete("/api/v1/pods", "p2")
+        _wait(lambda: len(sup.twin.materialize().pods) == 1, msg="DELETED applied")
+        sup.flush_pending()
+        assert PREP_STATS.counts.get("twin_delta", 0) == delta0 + 2
+        code, body3 = server.deploy_apps(_payload())
+        assert code == 200
+        assert PREP_STATS.counts.get("full", 0) == full0
+
+        # a polling-mode server full-relisting the same cluster agrees
+        polling = rest.SimonServer(kubeconfig=kc)
+        code, ref = polling.deploy_apps(_payload())
+        assert code == 200
+        assert _shape(body3) == _shape(ref)
+
+
+def test_bookmark_keepalive_resets_staleness_deadline(tmp_path):
+    """BOOKMARK-only traffic keeps the twin live; silence past
+    OPENSIM_WATCH_STALE_S degrades it; the next event revives it."""
+    pol = {"stale_s": 0.4}
+    with _twin_server(tmp_path, policy=pol, bookmark_s=0.05) as (stub, sup, server, kc):
+        time.sleep(1.0)  # multiple staleness windows, bookmark traffic only
+        assert sup.state() == "live"
+        assert sup.events_total.get("BOOKMARK", 0) > 0
+
+        stub.bookmark_interval_s = 30.0  # silence the streams
+        _wait(lambda: sup.state() == "degraded", msg="staleness degradation")
+        assert sup.is_stale()
+
+        stub.upsert("/api/v1/pods", _pod_dict("wake"))
+        _wait(lambda: sup.state() == "live", msg="event-driven revival")
+
+
+def test_degraded_twin_tags_responses_stale(tmp_path):
+    """Requests served from a degraded twin carry the existing
+    X-Simon-Snapshot: stale header (same contract as the polling path's
+    stale-serve)."""
+    from http.server import ThreadingHTTPServer
+
+    pol = {"stale_s": 0.4}
+    with _twin_server(tmp_path, policy=pol, bookmark_s=0.05) as (stub, sup, server, kc):
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), rest.make_handler(server))
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            port = httpd.server_address[1]
+            body = json.dumps(_payload()).encode()
+
+            def post():
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/api/deploy-apps", data=body, method="POST"
+                )
+                return urllib.request.urlopen(req)
+
+            with post() as r:
+                assert r.headers.get("X-Simon-Snapshot") is None
+
+            stub.bookmark_interval_s = 30.0
+            _wait(lambda: sup.state() == "degraded", msg="staleness degradation")
+            with post() as r:
+                assert r.headers.get("X-Simon-Snapshot") == "stale"
+
+            # /metrics renders the state machine + stale-serve counters
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as r:
+                text = r.read().decode()
+            assert 'simon_watch_state{state="degraded"} 1' in text
+            assert "simon_watch_events_total" in text
+        finally:
+            httpd.shutdown()
+
+
+def test_disconnect_fault_reconnects_and_converges(tmp_path):
+    with _twin_server(tmp_path) as (stub, sup, server, kc):
+        faults.inject("watch.disconnect", count=1, exc="fault")
+        stub.upsert("/api/v1/pods", _pod_dict("after-drop"))
+        _wait(
+            lambda: any(p.metadata.name == "after-drop" for p in sup.twin.materialize().pods),
+            msg="convergence after injected disconnect",
+        )
+        _wait(lambda: sup.reconnects_total >= 1, msg="reconnect counted")
+        assert faults.fault_stats().get("watch.disconnect") == 1
+        fresh, _ = _cluster_via_rest(kc, None)
+        _wait(lambda: sup.state() == "live", msg="live after reconnect")
+        assert sup.twin.fingerprint() == fingerprint_cluster(fresh)
+
+
+def test_gone_fault_relists_and_rebases(tmp_path):
+    with _twin_server(tmp_path) as (stub, sup, server, kc):
+        faults.inject("watch.gone", count=1, exc="fault")
+        stub.upsert("/api/v1/pods", _pod_dict("post-gone"))
+        _wait(lambda: sup.gone_total >= 1, msg="410 noted")
+        _wait(lambda: sup.relists_total >= 1, msg="relist-and-rebase")
+        _wait(
+            lambda: any(p.metadata.name == "post-gone" for p in sup.twin.materialize().pods),
+            msg="convergence after rebase",
+        )
+        fresh, _ = _cluster_via_rest(kc, None)
+        assert sup.twin.fingerprint() == fingerprint_cluster(fresh)
+
+
+def test_watch_410_at_the_source_raises_gone(tmp_path):
+    """Protocol-level: a watch resuming from a compacted resourceVersion
+    gets the ERROR event with code 410, surfaced as GoneError."""
+    stub = StubApiServer().start()
+    _seed(stub)
+    try:
+        old_rv = stub.rv()
+        for i in range(3):
+            stub.upsert("/api/v1/pods", _pod_dict(f"fill-{i}"))
+        stub.compact()
+        stub.upsert("/api/v1/pods", _pod_dict("past-compaction"))
+        src = RestWatchSource(stub.kubeconfig(tmp_path), read_timeout_s=2.0)
+        with pytest.raises(GoneError):
+            for _ev in src.watch("pods", str(old_rv)):
+                pytest.fail("events must not be delivered across a compaction gap")
+    finally:
+        stub.stop()
+
+
+def test_dropped_event_drift_detected_and_rebased(tmp_path):
+    """A lost event (watch.drop_event) silently desyncs the twin — only the
+    anti-entropy pass can see it: drift is counted in simon_twin_drift_total
+    and the rebase reconverges the fingerprint."""
+    from opensim_tpu.obs.recorder import FLIGHT_RECORDER
+
+    with _twin_server(tmp_path) as (stub, sup, server, kc):
+        faults.inject("watch.drop_event", count=1, exc="fault")
+        stub.upsert("/api/v1/pods", _pod_dict("lost"))
+        _wait(lambda: faults.fault_stats().get("watch.drop_event") == 1, msg="event dropped")
+        time.sleep(0.2)
+        assert all(p.metadata.name != "lost" for p in sup.twin.materialize().pods)
+
+        drift = sup.anti_entropy()
+        assert drift >= 1
+        assert sup.drift_total >= 1
+        assert any(p.metadata.name == "lost" for p in sup.twin.materialize().pods)
+        fresh, _ = _cluster_via_rest(kc, None)
+        assert sup.twin.fingerprint() == fingerprint_cluster(fresh)
+        lines = "\n".join(sup.metrics_lines())
+        assert f"simon_twin_drift_total {sup.drift_total}" in lines
+        # the anti-entropy cycle is visible in the flight recorder
+        assert any(
+            s["request_id"].startswith("watch-anti-entropy-")
+            for s in FLIGHT_RECORDER.summaries()
+        )
+
+
+def test_reorder_fault_converges_by_rv(tmp_path):
+    """An out-of-order delivery (watch.reorder holds an event back past its
+    successor) must not desync the twin: rv-monotonic application converges
+    the object set, and anti-entropy confirms zero drift."""
+    with _twin_server(tmp_path) as (stub, sup, server, kc):
+        faults.inject("watch.reorder", count=1, exc="fault")
+        stub.upsert("/api/v1/pods", _pod_dict("first"))
+        stub.upsert("/api/v1/pods", _pod_dict("second"))
+        _wait(
+            lambda: {p.metadata.name for p in sup.twin.materialize().pods} == {"first", "second"},
+            msg="both events applied despite reordering",
+        )
+        assert faults.fault_stats().get("watch.reorder") == 1
+        assert sup.anti_entropy() == 0
+
+
+def test_bootstrap_failure_falls_back_to_polling(tmp_path, monkeypatch):
+    """Watch bootstrap that cannot list keeps the server fully functional on
+    the polling snapshot path (graceful --watch default-on)."""
+    stub = StubApiServer().start()
+    _seed(stub)
+    kc = stub.kubeconfig(tmp_path)
+    stub.stop()  # apiserver gone before the twin ever syncs
+
+    pol = dict(FAST, stale_s=1.0)
+    sup = WatchSupervisor(RestWatchSource(kc, read_timeout_s=1.0), policy=pol)
+    try:
+        assert sup.start(wait_s=0.5) is False
+        assert not sup.has_synced()
+
+        fetches = []
+
+        def fake_fetch(kubeconfig, master=None):
+            fetches.append(kubeconfig)
+            rt = ResourceTypes()
+            for i in range(3):
+                rt.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+            return rt
+
+        monkeypatch.setattr(rest, "cluster_from_kubeconfig", fake_fetch)
+        server = rest.SimonServer(kubeconfig="/tmp/kc", watch=sup)
+        sup.prep_cache = server.prep_cache
+        code, body = server.deploy_apps(_payload())
+        assert code == 200 and body["nodeStatus"]
+        assert fetches  # served by the polling path
+    finally:
+        sup.stop()
+
+
+def test_watch_on_requires_kubeconfig():
+    """--watch on with no kubeconfig must fail loudly (exit 1), not start a
+    polling/empty-cluster server the operator believes is a synced twin."""
+    assert rest.serve(kubeconfig="", watch="on") == 1
+
+
+def test_watch_policy_validation(monkeypatch):
+    assert watch_policy()["stale_s"] == 30.0
+    monkeypatch.setenv("OPENSIM_WATCH_STALE_S", "soon")
+    with pytest.raises(ValueError, match="OPENSIM_WATCH_STALE_S"):
+        watch_policy()
+    monkeypatch.setenv("OPENSIM_WATCH_STALE_S", "0")
+    with pytest.raises(ValueError, match="positive"):
+        watch_policy()
+    monkeypatch.setenv("OPENSIM_WATCH_STALE_S", "5")
+    monkeypatch.setenv("OPENSIM_WATCH_RECONNECTS", "0")
+    with pytest.raises(ValueError, match="OPENSIM_WATCH_RECONNECTS"):
+        watch_policy()
+
+
+# ---------------------------------------------------------------------------
+# chaos gate (make chaos): mid-stream fault storm, then convergence
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_watch_server_reconverges_shape_equal_to_full_relist(tmp_path):
+    """The ISSUE 6 chaos bar: with watch.disconnect, watch.gone AND a
+    dropped event injected mid-stream while the cluster mutates, the
+    watch-mode server's next simulate response is shape-equal to a
+    polling-mode server's answer after a fresh full relist, with the drift
+    counter showing detection."""
+    with _twin_server(tmp_path, pods=[_pod_dict("seed", phase="Running", node="n0")]) as (
+        stub, sup, server, kc,
+    ):
+        code, _ = server.deploy_apps(_payload())
+        assert code == 200
+
+        faults.inject("watch.disconnect", count=1, exc="fault")
+        stub.upsert("/api/v1/pods", _pod_dict("storm-a"))
+        _wait(lambda: faults.fault_stats().get("watch.disconnect") == 1, msg="disconnect fired")
+
+        faults.inject("watch.gone", count=1, exc="fault")
+        stub.upsert("/api/v1/pods", _pod_dict("storm-b", cpu="250m"))
+        _wait(lambda: faults.fault_stats().get("watch.gone") == 1, msg="gone fired")
+
+        faults.inject("watch.drop_event", count=1, exc="fault")
+        stub.upsert("/api/v1/pods", _pod_dict("storm-c", cpu="150m"))
+        _wait(lambda: faults.fault_stats().get("watch.drop_event") == 1, msg="event dropped")
+
+        drift = sup.anti_entropy()  # repairs whatever the drop lost
+        assert drift >= 0
+        _wait(
+            lambda: {"storm-a", "storm-b", "storm-c"}
+            <= {p.metadata.name for p in sup.twin.materialize().pods},
+            msg="twin reconverged on the full mutation set",
+        )
+        fresh, _ = _cluster_via_rest(kc, None)
+        assert sup.twin.fingerprint() == fingerprint_cluster(fresh)
+
+        code, twin_body = server.deploy_apps(_payload())
+        assert code == 200
+        polling = rest.SimonServer(kubeconfig=kc)
+        code, relist_body = polling.deploy_apps(_payload())
+        assert code == 200
+        assert _shape(twin_body) == _shape(relist_body)
+        # the storm left its fingerprints in the metrics surface
+        text = rest.METRICS.render(prep_cache=server.prep_cache, watch=sup)
+        assert "simon_watch_reconnects_total" in text
+        assert "simon_twin_drift_total" in text
+        assert 'simon_faults_injected_total{point="watch.disconnect"}' in text

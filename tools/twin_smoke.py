@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Live-twin smoke gate (``make twin-smoke``, part of ``make verify``).
+
+The ISSUE 6 chaos proof, end to end and in one process:
+
+1. start the canned stub apiserver (``server/stubapi.py``) and a watch-mode
+   REST server against it (stdlib ``?watch=1`` source, no kubernetes
+   package needed);
+2. serve one deploy-apps request (builds the warm base prep), then mutate
+   the cluster through watch events while injecting ``watch.disconnect``,
+   ``watch.gone`` and a ``watch.drop_event`` mid-stream;
+3. run an anti-entropy pass (repairs the dropped event, counts drift);
+4. assert the twin's content fingerprint equals a fresh full relist, the
+   watch server's next response is placement-shape-equal to a polling-mode
+   server's answer after that relist, the delta path (not a second full
+   prepare) carried the events, and ``/metrics`` shows the state machine,
+   drift and fault counters.
+
+Exit 0 on success; 1 with a one-line reason per failed check.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fail(msg: str) -> int:
+    print(f"twin-smoke: FAIL: {msg}")
+    return 1
+
+
+def _pod(name, phase="Pending", node="", cpu="100m"):
+    d = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": cpu}}}]},
+        "status": {"phase": phase},
+    }
+    if node:
+        d["spec"]["nodeName"] = node
+    return d
+
+
+def _shape(resp):
+    return (
+        sorted((e["node"], len(e["pods"])) for e in resp["nodeStatus"]),
+        sorted(u["reason"] for u in resp["unscheduledPods"]),
+    )
+
+
+def _wait(pred, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def main() -> int:
+    from http.server import ThreadingHTTPServer
+
+    from opensim_tpu.engine.prepcache import fingerprint_cluster
+    from opensim_tpu.models import fixtures as fx
+    from opensim_tpu.resilience import faults
+    from opensim_tpu.server import rest
+    from opensim_tpu.server.snapshot import _cluster_via_rest
+    from opensim_tpu.server.stubapi import StubApiServer
+    from opensim_tpu.server.watch import RestWatchSource, WatchSupervisor
+    from opensim_tpu.utils.trace import PREP_STATS
+
+    stub = StubApiServer(bookmark_interval_s=0.1).start()
+    stub.seed("/api/v1/nodes", [fx.make_fake_node(f"n{i}", "8", "16Gi").raw for i in range(4)])
+    stub.seed("/api/v1/pods", [_pod("seed", phase="Running", node="n0")])
+    for p in (
+        "/apis/apps/v1/daemonsets", "/apis/policy/v1/poddisruptionbudgets",
+        "/api/v1/services", "/apis/storage.k8s.io/v1/storageclasses",
+        "/api/v1/persistentvolumeclaims", "/api/v1/configmaps",
+    ):
+        stub.seed(p, [])
+    tmp = tempfile.mkdtemp(prefix="twin-smoke-")
+    kc = stub.kubeconfig(tmp)
+
+    policy = {"stale_s": 5.0, "resync_s": 0.0, "reconnects": 3, "backoff_s": 0.02}
+    sup = WatchSupervisor(RestWatchSource(kc, read_timeout_s=5.0), policy=policy)
+    server = rest.SimonServer(kubeconfig=kc, watch=sup)
+    sup.prep_cache = server.prep_cache
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), rest.make_handler(server))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    try:
+        if not sup.start(wait_s=15.0):
+            return fail("twin did not sync against the stub apiserver")
+
+        payload = json.dumps(
+            {"deployments": [fx.make_fake_deployment("smoke", 5, "500m", "1Gi").raw]}
+        ).encode()
+
+        def post():
+            req = urllib.request.Request(f"{base}/api/deploy-apps", data=payload, method="POST")
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.status, json.load(resp)
+
+        status, _first = post()
+        if status != 200:
+            return fail(f"warmup deploy-apps returned HTTP {status}")
+        full_prepares = PREP_STATS.counts.get("full", 0)
+
+        # --- fault storm while the cluster mutates --------------------------
+        faults.inject("watch.disconnect", count=1, exc="fault")
+        stub.upsert("/api/v1/pods", _pod("storm-a"))
+        if not _wait(lambda: faults.fault_stats().get("watch.disconnect") == 1):
+            return fail("watch.disconnect fault never fired")
+
+        faults.inject("watch.gone", count=1, exc="fault")
+        stub.upsert("/api/v1/pods", _pod("storm-b", cpu="250m"))
+        if not _wait(lambda: faults.fault_stats().get("watch.gone") == 1):
+            return fail("watch.gone fault never fired")
+        if not _wait(lambda: sup.relists_total >= 1):
+            return fail("410 Gone did not trigger a relist-and-rebase")
+
+        faults.inject("watch.drop_event", count=1, exc="fault")
+        stub.upsert("/api/v1/pods", _pod("storm-c", cpu="150m"))
+        if not _wait(lambda: faults.fault_stats().get("watch.drop_event") == 1):
+            return fail("watch.drop_event fault never fired")
+
+        drift = sup.anti_entropy()
+        if drift < 0:
+            return fail("anti-entropy relist failed")
+        if sup.drift_total < 1:
+            return fail("dropped event was not detected as drift")
+
+        names = {"storm-a", "storm-b", "storm-c"}
+        if not _wait(lambda: names <= {p.metadata.name for p in sup.twin.materialize().pods}):
+            return fail("twin did not reconverge on the full mutation set")
+
+        fresh, _rvs = _cluster_via_rest(kc, None)
+        if sup.twin.fingerprint() != fingerprint_cluster(fresh):
+            return fail("twin fingerprint != fresh full relist after the fault storm")
+
+        # --- convergence proof: watch server vs polling server --------------
+        status, twin_body = post()
+        if status != 200:
+            return fail(f"post-storm deploy-apps returned HTTP {status}")
+        polling = rest.SimonServer(kubeconfig=kc)
+        code, relist_body = polling.deploy_apps(
+            {"deployments": [fx.make_fake_deployment("smoke", 5, "500m", "1Gi").raw]}
+        )
+        if code != 200:
+            return fail(f"polling-mode server returned HTTP {code}")
+        if _shape(twin_body) != _shape(relist_body):
+            return fail(
+                f"placements diverged: twin {_shape(twin_body)} vs relist {_shape(relist_body)}"
+            )
+
+        # --- warm path: post-storm, a single event rides the delta
+        # re-encoder and the next request pays no full prepare (the storm
+        # itself legitimately drops the lineage: a rebase is a content jump)
+        full_before = PREP_STATS.counts.get("full", 0)
+        delta_before = PREP_STATS.counts.get("twin_delta", 0)
+        gen_before = sup.twin.generation
+        stub.upsert("/api/v1/pods", _pod("calm"))
+        if not _wait(lambda: sup.twin.generation > gen_before):
+            return fail("calm-phase ADDED event never reached the twin")
+        sup.flush_pending()
+        if PREP_STATS.counts.get("twin_delta", 0) != delta_before + 1:
+            return fail("calm-phase ADDED did not ride the twin_delta re-encoder")
+        status, _calm = post()
+        if status != 200:
+            return fail(f"calm-phase deploy-apps returned HTTP {status}")
+        if PREP_STATS.counts.get("full", 0) != full_before:
+            return fail("calm-phase request paid a full O(cluster) prepare")
+
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+            metrics = resp.read().decode()
+        for needle in (
+            'simon_watch_state{state="live"} 1',
+            "simon_watch_events_total",
+            "simon_watch_reconnects_total",
+            f"simon_twin_drift_total {sup.drift_total}",
+            'simon_faults_injected_total{point="watch.disconnect"} 1',
+            'simon_faults_injected_total{point="watch.gone"} 1',
+            'simon_faults_injected_total{point="watch.drop_event"} 1',
+        ):
+            if needle not in metrics:
+                return fail(f"/metrics missing {needle!r}")
+
+        print(
+            "twin-smoke: ok — disconnect/410/lost-event storm absorbed "
+            f"(drift {sup.drift_total}, reconnects {sup.reconnects_total}, "
+            f"relists {sup.relists_total}), placements shape-equal to a full "
+            f"relist, {PREP_STATS.counts.get('twin_delta', 0)} delta re-encode(s), "
+            f"{PREP_STATS.counts.get('full', 0) - full_prepares} extra full prepare(s)"
+        )
+        return 0
+    finally:
+        sup.stop()
+        httpd.shutdown()
+        stub.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke
+.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke
 
 all: test
 
@@ -47,8 +47,16 @@ obs-smoke:
 twin-smoke:
 	python tools/twin_smoke.py
 
-# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin
-verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke
+# decision-audit gate (ISSUE 7, docs/observability.md): `simon explain` on
+# an unschedulable pod must render a kube-style "0/N nodes are available"
+# breakdown whose per-filter counts are identical between the XLA and C++
+# generic engines, and the deep per-pod score breakdown must sum to the
+# winner's total
+explain-smoke:
+	python tools/explain_smoke.py
+
+# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain
+verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke
 
 # run the moment the TPU tunnel opens (tools/tpu_probe_loop.sh writes
 # /tmp/opensim-tpu-watch.up): compiled-Mosaic parity suite + full bench

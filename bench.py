@@ -361,6 +361,17 @@ def main() -> int:
     ap.add_argument("--scenarios", type=int, default=1000, help="defrag: number of drain scenarios")
     ap.add_argument("--repeats", type=int, default=10, help="steady: number of warm re-simulations")
     ap.add_argument(
+        "--explain",
+        action="store_true",
+        help=(
+            "run the measured simulation with the decision audit enabled "
+            "(plan-family configs): the JSON line gains filter_rejects (nodes "
+            "rejected per filter across all steps) and unschedulable_reasons. "
+            "Forces the C++ generic path / XLA count_all scan, so the wall "
+            "time measures the audited path, not the headline"
+        ),
+    )
+    ap.add_argument(
         "--trace",
         default="",
         metavar="FILE",
@@ -427,7 +438,7 @@ def main() -> int:
     tr = tracing.start_trace("bench", force=True) if args.trace else None
     t0 = time.time()
     with tracing.trace_scope(tr):
-        result = simulate(cluster, apps, node_pad=128)
+        result = simulate(cluster, apps, node_pad=128, explain=args.explain)
     dt = time.time() - t0
     if tr is not None:
         tr.finish()
@@ -465,6 +476,19 @@ def main() -> int:
         if result.engine.native_path is not None:
             record["native_path"] = result.engine.native_path
             record["native_steps"] = result.engine.native_steps
+        # decision audit (--explain): per-filter reject totals + pods by
+        # primary unschedulable reason, straight off the EngineDecision
+        if args.explain and result.engine.filter_rejects is not None:
+            record["filter_rejects"] = result.engine.filter_rejects
+            reason_hist = {}
+            for e in result.engine.explanations or []:
+                if e.status != "scheduled":
+                    from opensim_tpu.engine.reasons import primary_code
+
+                    code = primary_code(e.reasons)
+                    key = code.name.lower() if code is not None else e.status
+                    reason_hist[key] = reason_hist.get(key, 0) + 1
+            record["unschedulable_reasons"] = reason_hist
     if os.environ.get("OPENSIM_NATIVE_PROFILE"):
         # per-stage engine timings as structured data (still ONE JSON line);
         # populated by the C++ engine when profiling is enabled

@@ -14,6 +14,8 @@ Rules (short name = suppression id; see docs/static-analysis.md):
     OSL701 deadline-span      Deadline phase boundary without a trace span
     OSL801 unsupervised-watch-loop  `while True` watch/reconnect loop
                               bypassing resilience.retry
+    OSL901 reason-literal     inline unschedulable-reason string bypassing
+                              the reason-code registry (engine/reasons.py)
 """
 
 from .core import (  # noqa: F401
@@ -36,6 +38,7 @@ from . import (  # noqa: F401,E402
     rules_except,
     rules_jit,
     rules_obs,
+    rules_reasons,
     rules_retry,
     rules_watch,
 )

@@ -1,0 +1,82 @@
+"""reason-literal (OSL901): unschedulable reasons must come from the
+registered reason-code enum.
+
+The decision-audit layer (ISSUE 7) hangs everything — kube-parity message
+rendering, cross-engine reason equality, the ``simon_unschedulable_total``
+reason labels, ``simon explain`` — off ONE table of reason strings
+(``engine/reasons.py``). An inline literal handed to ``UnscheduledPod``
+bypasses that registry: it renders a string no reason code maps back to, so
+the aggregate counters, the explanations, and the report text silently
+disagree about the same pod.
+
+The rule flags ``UnscheduledPod(...)`` constructions whose reason argument
+(second positional, or ``reason=``) is an inline string: a constant, an
+f-string, a string concatenation, or ``"...".format(...)``. Reasons built
+by the registry helpers (``reasons.node_not_found(...)``,
+``reasons.render_unschedulable(...)``, …) or carried in variables pass.
+
+Fix by adding the phrasing to ``engine/reasons.py`` (a new ``Reason``
+member or helper) and constructing the string there.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from .core import FileContext, Finding, Rule, dotted_name, register
+
+
+def _literal_string(node: ast.AST) -> bool:
+    """Is this expression an inline string literal in any disguise?"""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return True
+    if isinstance(node, ast.JoinedStr):  # f-string
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add, ast.Mod)):
+        # "a" + x, "a %s" % x — literal on either side taints the expression
+        return _literal_string(node.left) or _literal_string(node.right)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+        if node.func.attr == "format":
+            return _literal_string(node.func.value)
+    return False
+
+
+def _reason_arg(call: ast.Call) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == "reason":
+            return kw.value
+    if len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+@register
+class ReasonLiteralRule(Rule):
+    name = "reason-literal"
+    code = "OSL901"
+    description = "inline unschedulable-reason string bypassing the reason-code registry"
+    # the registry module necessarily contains the literals; tests exercise
+    # arbitrary reason strings on purpose
+    exclude_paths = ("engine/reasons.py", "tests/")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            leaf = name.rsplit(".", 1)[-1] if name else ""
+            if leaf != "UnscheduledPod":
+                continue
+            arg = _reason_arg(node)
+            if arg is not None and _literal_string(arg):
+                yield self.finding(
+                    ctx,
+                    arg,
+                    "UnscheduledPod reason is an inline string literal; "
+                    "unschedulable reasons must come from the registered "
+                    "reason-code enum (engine/reasons.py helpers such as "
+                    "node_not_found/preempted/render_unschedulable) so "
+                    "every engine, counter, and report renders the same "
+                    "diagnostic",
+                )

@@ -81,6 +81,35 @@ def build_parser() -> argparse.ArgumentParser:
         "reproducible distribution-comparison runs (C++ engine or XLA "
         "scan; the Pallas megakernel stays lowest-index)",
     )
+    apply_p.add_argument(
+        "--explain", action="store_true",
+        help="decision audit (docs/observability.md): append the placement "
+        "audit to the report — per-filter reject totals plus a kube-style "
+        "'0/N nodes are available' breakdown for every unschedulable pod",
+    )
+
+    explain_p = sub.add_parser(
+        "explain", parents=[backend_parent],
+        help="explain why a pod landed where it did (or why it is unschedulable)",
+        description=(
+            "run the simulation with the decision audit enabled and print one "
+            "pod's full placement explanation: the winning node with its "
+            "per-plugin score breakdown and runner-up margin, or the kube-style "
+            "'0/N nodes are available' per-filter rejection counts. Without a "
+            "pod argument, prints the audit summary and every unschedulable "
+            "pod's breakdown"
+        ),
+    )
+    explain_p.add_argument("-f", "--simon-config", required=True, help="path of simon config (Config CR yaml)")
+    explain_p.add_argument(
+        "-d", "--default-scheduler-config", default="", help="path of kube-scheduler config overrides"
+    )
+    explain_p.add_argument(
+        "pod", nargs="?", default="",
+        help="pod to explain, as namespace/name (or bare name when unambiguous)",
+    )
+    explain_p.add_argument("--use-greed", action="store_true", help="use greed algorithm to sort pods")
+    explain_p.add_argument("--json", action="store_true", help="emit the explanation(s) as JSON")
 
     defrag_p = sub.add_parser(
         "defrag",
@@ -140,7 +169,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     backend = getattr(args, "backend", "auto")
     if backend != "auto":
         _select_backend(backend)
-    elif args.command in ("apply", "defrag", "server"):
+    elif args.command in ("apply", "defrag", "server", "explain"):
         # auto mode must not hang when the accelerator tunnel is dead: any
         # jax device op can block forever (utils/probe.py), so probe in a
         # subprocess first and fall back to the host CPU with a note
@@ -167,6 +196,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             report_pods=args.report_pods,
             max_new_nodes=args.max_new_nodes,
             tie_break=args.tie_break,
+            explain=args.explain,
         )
         try:
             if not args.trace:
@@ -237,6 +267,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         except (OSError, ValueError) as e:
             print(f"simon defrag: {e}", file=sys.stderr)
             return 1
+    if args.command == "explain":
+        try:
+            return run_explain(args)
+        except (OSError, ValueError) as e:
+            print(f"simon explain: {e}", file=sys.stderr)
+            return 1
     if args.command == "server":
         from .. import native
         from ..server.rest import serve
@@ -252,6 +288,110 @@ def main(argv: Optional[List[str]] = None) -> int:
         return gen_doc(parser, args.output_dir)
     parser.print_help()
     return 2
+
+
+def _render_explanation(e, out) -> None:
+    """Human rendering of one PlacementExplanation (``simon explain``)."""
+    print(f"pod {e.pod}: {e.status}"
+          + (f" on {e.node}" if e.node else "")
+          + (" (pre-bound; bypassed the scheduler)" if e.forced else ""),
+          file=out)
+    from ..engine import reasons as reasons_mod
+
+    if e.message:
+        print(f"  {e.message}", file=out)
+    for line in reasons_mod.count_lines(e.reasons):
+        print(f"  {line}", file=out)
+    if e.scores:
+        print(f"  per-plugin score breakdown on {e.node}:", file=out)
+        width = max(len(k) for k in e.scores)
+        for k, v in e.scores.items():
+            print(f"    {k:<{width}}  {v:10.4f}", file=out)
+        print(f"    {'total':<{width}}  {e.score:10.4f}", file=out)
+        if e.runner_up is not None:
+            print(f"  margin {e.margin:.4f} over runner-up {e.runner_up}", file=out)
+
+
+def run_explain(args) -> int:
+    """``simon explain``: one simulation with the decision audit on, then
+    print the named pod's deep explanation (score breakdown / kube-style
+    rejection counts) or, without a pod, the audit summary."""
+    import json as _json
+
+    from ..engine import explain as explain_mod
+    from ..engine.simulator import simulate
+    from ..planner.apply import Applier, Options
+
+    applier = Applier(
+        Options(
+            simon_config=args.simon_config,
+            default_scheduler_config=args.default_scheduler_config,
+            use_greed=args.use_greed,
+        )
+    )
+    cluster = applier.load_cluster()
+    apps = applier.load_apps()
+    result = simulate(
+        cluster, apps, use_greed=args.use_greed,
+        sched_config=applier.sched_config, explain=True,
+    )
+    engine = result.engine
+    if engine is None or engine.explain_ctx is None:
+        print("simon explain: the simulation produced no decisions (no pods)", file=sys.stderr)
+        return 1
+    ctx = engine.explain_ctx
+    out = sys.stdout
+    if args.pod:
+        idx = ctx.index_of(args.pod)
+        if idx is None:
+            known = sorted(
+                f"{p.metadata.namespace}/{p.metadata.name}" for p in ctx.prep.ordered
+            )
+            preview = ", ".join(known[:8]) + (", …" if len(known) > 8 else "")
+            print(
+                f"simon explain: no pod named {args.pod!r} in the simulated "
+                f"stream ({len(known)} pods: {preview})",
+                file=sys.stderr,
+            )
+            return 1
+        deep = explain_mod.explain_pod(ctx, idx)
+        if args.json:
+            print(_json.dumps(deep.to_dict(), indent=2))
+        else:
+            _render_explanation(deep, out)
+        return 0
+    # no pod named: summary + every non-scheduled pod's breakdown
+    if args.json:
+        print(
+            _json.dumps(
+                {
+                    "engine": engine.describe(),
+                    "filter_rejects": engine.filter_rejects or {},
+                    "unschedulable": [
+                        e.to_dict()
+                        for e in engine.explanations or []
+                        if e.status != "scheduled"
+                    ],
+                },
+                indent=2,
+            )
+        )
+        return 0
+    from ..engine import reasons as reasons_mod
+
+    print(f"engine: {engine.describe()}", file=out)
+    if engine.filter_rejects:
+        print(
+            "filter rejects (nodes rejected per filter, all steps): "
+            + reasons_mod.format_rejects(engine.filter_rejects),
+            file=out,
+        )
+    bad = [e for e in engine.explanations or [] if e.status != "scheduled"]
+    n_ok = len(engine.explanations or []) - len(bad)
+    print(f"{n_ok} pod(s) scheduled, {len(bad)} not", file=out)
+    for e in bad:
+        _render_explanation(e, out)
+    return 0
 
 
 def _select_backend(backend: str) -> None:

@@ -110,13 +110,16 @@ def _stat_np(prep, config, node_valid=None):
 
 
 def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=None,
-             tie_seed=None, st0=None):
+             tie_seed=None, st0=None, explain=False):
     """Run the whole pod stream through the C++ engine. Returns a
     ``ScheduleOutput`` (numpy arrays throughout). `node_valid`/`forced`
     override the prepared masks (scenario sweeps). `tie_seed` switches
     selection to seeded uniform sampling over the score maxima (the
     reference's selectHost reservoir distribution). `st0` overrides the
-    initial carry (segmented multi-profile runs chain scans)."""
+    initial carry (segmented multi-profile runs chain scans). `explain`
+    (decision audit, ISSUE 7) forces the generic path, fills the per-pod
+    fail rows for every step, and accumulates the 11-slot per-filter
+    reject totals in-engine (ScanArgs.filter_rejects, abi v4)."""
     from .. import native
     from ..resilience import faults
     from .scheduler import ScheduleOutput
@@ -167,6 +170,9 @@ def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=N
         # path attribution + OPENSIM_NATIVE_PROFILE phase timings
         "path_counts": np.zeros(3, np.int32),
         "profile_out": np.zeros(12, np.float64),
+        # decision audit (explain=1): per-filter reject totals, kernel
+        # filter-index order (always marshalled; only written under explain)
+        "filter_rejects": np.zeros(kernels.NUM_FILTERS, np.int64),
     }
 
     dims = {
@@ -191,6 +197,7 @@ def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=N
         "cf_ports": cfg.f_ports, "cf_fit": cfg.f_fit, "cf_spread": cfg.f_spread,
         "cf_interpod": cfg.f_interpod, "cf_gpu": cfg.f_gpu, "cf_local": cfg.f_local,
         "tie_sample": tie_seed is not None, "tie_seed": tie_seed or 0,
+        "explain": bool(explain),
     }
     weights = {k: getattr(cfg, k) for k in (
         "w_balanced", "w_least", "w_node_affinity", "w_taint_toleration",
@@ -224,6 +231,7 @@ def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=N
         "share_raw": f32(stat.share_raw), "spread_weight": f32(stat.spread_weight),
         "tmpl_ids": i32(prep.tmpl_ids), "forced": u8(forced_arr),
         "pod_valid": u8(pod_valid),
+        "static_fail": i32(stat.static_fail),
         **state,
         **outputs,
     }
@@ -239,6 +247,7 @@ def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=N
         static_fail=np.asarray(stat.static_fail),
         final_state=ScanState(**state),
         native_stats=stats,
+        filter_rejects=outputs["filter_rejects"] if explain else None,
     )
 
 

@@ -331,10 +331,9 @@ def _route_stream(sched_config, ordered, resource_names, forced=None):
             used[name] = None if profile is None else resolve_cols(profile)
         cfg = used[name]
         if cfg is None:
-            invalid[i] = (
-                f"no scheduler profile named {name!r} "
-                "(pod never enters any profile's scheduling queue)"
-            )
+            from .reasons import unknown_profile
+
+            invalid[i] = unknown_profile(name)
             continue  # never scheduled; extends the active segment
         if not have_cur:
             cur_cfg, have_cur = cfg, True

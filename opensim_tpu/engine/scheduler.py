@@ -58,9 +58,15 @@ class ScheduleOutput(NamedTuple):
     # "profile"?}) — attribution so a silent incremental-cache disengage
     # can never masquerade as a tuned number (None on the XLA/fast paths)
     native_stats: Optional[dict] = None
+    # decision audit (explain mode, ISSUE 7): 11-slot per-filter reject
+    # totals accumulated across every scheduled step. The C++ engine fills
+    # this in-engine (ScanArgs.filter_rejects); the XLA path derives it
+    # host-side from the count_all per-pod rows (simulator._audit_rejects)
+    filter_rejects: Optional[object] = None
 
 
-def _step(ec: EncodedCluster, stat, feat, cfg, extra, st: ScanState, x, select_key=None):
+def _step(ec: EncodedCluster, stat, feat, cfg, extra, st: ScanState, x, select_key=None,
+          count_all=False):
     u, pod_valid, forced = x
     # Pre-bound pods (spec.nodeName set) bypass the scheduler in the
     # reference (simulator.go:329-331 only waits for unbound pods): they
@@ -71,7 +77,7 @@ def _step(ec: EncodedCluster, stat, feat, cfg, extra, st: ScanState, x, select_k
     R = ec.alloc.shape[1]
 
     def run_pipeline(_):
-        res = kernels.pod_step(ec, stat, st, u, feat, cfg, extra)
+        res = kernels.pod_step(ec, stat, st, u, feat, cfg, extra, count_all=count_all)
         if select_key is None:
             return res.chosen, res.fail_counts, res.insufficient
         # --tie-break=sample: uniform choice among the score maxima — the
@@ -104,7 +110,8 @@ def _step(ec: EncodedCluster, stat, feat, cfg, extra, st: ScanState, x, select_k
 
 
 @functools.partial(
-    jax.jit, static_argnames=("features", "config", "extra_plugins", "unroll", "tie_seed")
+    jax.jit,
+    static_argnames=("features", "config", "extra_plugins", "unroll", "tie_seed", "explain"),
 )
 def schedule_pods(
     ec: EncodedCluster,
@@ -117,6 +124,7 @@ def schedule_pods(
     extra_plugins: tuple = (),
     unroll: int = 1,
     tie_seed=None,
+    explain: bool = False,
 ):
     """Run the bind scan. tmpl_ids [P] i32, pod_valid/forced [P] bool.
 
@@ -124,13 +132,18 @@ def schedule_pods(
     front; the scan body only evaluates usage-dependent kernels the
     workload's `features` actually exercise. `tie_seed` (an int) switches
     selectHost to the reference's sampled tie-break: a PRNG key rides the
-    scan carry and every step draws uniformly over its score maxima."""
+    scan carry and every step draws uniformly over its score maxima.
+    `explain` (decision audit, ISSUE 7) makes every step emit its per-filter
+    reject counts instead of only failed steps — a separate trace, so the
+    default compile is unchanged."""
     from .schedconfig import DEFAULT_CONFIG
 
     config = config or DEFAULT_CONFIG
     stat = kernels.precompute_static(ec, config)
     if tie_seed is None:
-        step = functools.partial(_step, ec, stat, features, config, extra_plugins)
+        step = functools.partial(
+            _step, ec, stat, features, config, extra_plugins, count_all=explain
+        )
         final_state, (chosen, fail_counts, insufficient, gpu_take) = jax.lax.scan(
             step, st0, (tmpl_ids, pod_valid, forced), unroll=unroll
         )
@@ -139,7 +152,8 @@ def schedule_pods(
             st, key = carry
             key, sub = jax.random.split(key)
             st_next, out = _step(
-                ec, stat, features, config, extra_plugins, st, x, select_key=sub
+                ec, stat, features, config, extra_plugins, st, x, select_key=sub,
+                count_all=explain,
             )
             return (st_next, key), out
 

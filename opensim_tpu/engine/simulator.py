@@ -39,7 +39,7 @@ from ..ops import kernels
 from ..resilience import breaker as breakers
 from ..resilience import faults
 from ..resilience.deadline import Deadline, check_deadline, deadline_scope
-from . import queues
+from . import queues, reasons
 from .scheduler import pad_pod_stream, scan_unroll, schedule_pods, to_device
 
 
@@ -88,6 +88,14 @@ class EngineDecision:
     # X-Simon-Request-Id, stamped by the REST layer so a decision can be
     # joined back to its flight-recorder trace; None for library callers
     request_id: Optional[str] = None
+    # decision audit (ISSUE 7, ``simulate(..., explain=True)``): one typed
+    # PlacementExplanation per pod, the per-filter reject totals across all
+    # audited steps ({reason_name: nodes rejected}), and the context object
+    # the on-demand deep evaluator (explain.explain_pod) consumes — the ctx
+    # references the full Prepared, so serializers must drop it
+    explanations: Optional[list] = None
+    filter_rejects: Optional[Dict[str, int]] = None
+    explain_ctx: Optional[object] = None
 
     def describe(self) -> str:
         base = self.name if self.native_path is None else f"{self.name}/{self.native_path}"
@@ -127,40 +135,11 @@ def _validate_extra_plugins(extra_plugins) -> None:
 def _rebuilt_counts(prep: "Prepared", chosen: np.ndarray):
     """Host-side reconstruction of the ScanState count tensors the
     megakernel tracks internally (port_used, dom_sel, dom_anti, dom_prefw)
-    from the final placements — the numpy mirror of ``kernels.bind_update``.
-    Keeps ``final_state`` fully populated after a fast-path run."""
-    ec = prep.ec_np
-    st0 = prep.st0
-    bound = chosen >= 0
-    us = prep.tmpl_ids[bound]
-    cs = chosen[bound].astype(np.int64)
+    from the final placements — shared with the decision-audit replay
+    (engine/explain.py owns the one implementation)."""
+    from .explain import rebuild_counts
 
-    port_used = np.array(st0.port_used, dtype=np.float32, copy=True)
-    ports = np.asarray(ec.ports)[us]  # [B, Hp]
-    pv = ports >= 0
-    if pv.any():
-        rows = np.repeat(cs, ports.shape[1])[pv.ravel()]
-        np.add.at(port_used, (rows, ports.ravel()[pv.ravel()]), 1.0)
-
-    dom_sel = np.array(st0.dom_sel, dtype=np.float32, copy=True)
-    matches = np.asarray(ec.matches_sel)[us].astype(np.float32)  # [B, A]
-    node_domain = np.asarray(ec.node_domain)
-    for tk in range(node_domain.shape[1]):
-        np.add.at(dom_sel, node_domain[cs, tk], matches)
-
-    dom_anti = np.array(st0.dom_anti, dtype=np.float32, copy=True)
-    anti_g_topo = np.asarray(ec.anti_g_topo)
-    anti_g = np.asarray(ec.anti_g)[us].astype(np.float32)
-    for g in range(anti_g_topo.shape[0]):
-        np.add.at(dom_anti[:, g], node_domain[cs, anti_g_topo[g]], anti_g[:, g])
-
-    dom_prefw = np.array(st0.dom_prefw, dtype=np.float32, copy=True)
-    prefg_topo = np.asarray(ec.prefg_topo)
-    prefg_w = np.asarray(ec.prefg_w)[us]
-    for g in range(prefg_topo.shape[0]):
-        np.add.at(dom_prefw[:, g], node_domain[cs, prefg_topo[g]], prefg_w[:, g])
-
-    return port_used, dom_sel, dom_anti, dom_prefw
+    return rebuild_counts(prep, chosen)
 
 
 def _fast_output(
@@ -305,27 +284,15 @@ def _reason_string(
     meta: ClusterMeta,
     n_nodes: int,
 ) -> str:
-    """Reconstruct the kube-scheduler FitError message format the reference
-    surfaces (e.g. '0/4 nodes are available: 3 node(s) had taints...').
-    static_fail covers the 4 template-static filters, fail_counts the 6
+    """The kube-scheduler FitError message the reference surfaces (e.g.
+    '0/4 nodes are available: 3 node(s) had taints...'), rendered through
+    the registered reason-code enum (engine/reasons.py, ISSUE 7).
+    static_fail covers the 4 template-static filters, fail_counts the
     usage-dependent ones."""
-    parts: List[Tuple[int, str]] = []
-    merged = list(static_fail) + list(fail_counts)
-    for k in range(kernels.NUM_FILTERS):
-        cnt = int(merged[k])
-        if cnt <= 0:
-            continue
-        if k == kernels.F_FIT:
-            for r, rname in enumerate(meta.resource_names):
-                rcnt = int(insufficient[r])
-                if rcnt > 0:
-                    parts.append((rcnt, f"Insufficient {rname}"))
-        else:
-            parts.append((cnt, kernels.FILTER_REASONS[k]))
-    if not parts:
-        return f"0/{n_nodes} nodes are available."
-    body = ", ".join(f"{cnt} {msg}" for cnt, msg in sorted(parts, key=lambda x: x[1]))
-    return f"0/{n_nodes} nodes are available: {body}."
+    counts = reasons.counts_from_rows(
+        static_fail, fail_counts, insufficient, meta.resource_names
+    )
+    return reasons.render_unschedulable(n_nodes, counts)
 
 
 @dataclass
@@ -475,7 +442,7 @@ def _prepare_inner(cluster, apps, use_greed, node_pad, patch_pods_fn):
 
 def _run_segments(
     prep, segments, pod_valid, forced, tmpl_ids, extra_plugins, tie_seed,
-    nv_mask, skips, log,
+    nv_mask, skips, log, explain=False,
 ):
     """Consecutive scans over contiguous same-profile segments, sharing the
     scheduling carry — the segmented multi-profile path
@@ -525,7 +492,7 @@ def _run_segments(
             if use_native:
                 out = nativepath.schedule(
                     prep, seg_valid, config=cfg, node_valid=nv_mask,
-                    tie_seed=tie_seed, st0=st,
+                    tie_seed=tie_seed, st0=st, explain=explain,
                 )
                 if out.native_stats is not None:
                     seg_stats.append(out.native_stats)
@@ -540,7 +507,7 @@ def _run_segments(
                 out = schedule_pods(
                     ec_run, st_dev, tmpl_p, valid_p, forced_p,
                     features=prep.features, config=cfg, extra_plugins=extra_plugins,
-                    unroll=scan_unroll(), tie_seed=tie_seed,
+                    unroll=scan_unroll(), tie_seed=tie_seed, explain=explain,
                 )
                 jax.block_until_ready(out.chosen)
         chosen[lo:hi] = np.asarray(out.chosen)[lo:hi]
@@ -578,7 +545,7 @@ def _run_segments(
 
 def _run_engine_ladder(
     prep, segments, sched_config, pod_valid, forced, tmpl_ids, extra_plugins,
-    tie_seed, nv_mask, ec, st0, log,
+    tie_seed, nv_mask, ec, st0, log, explain=False,
 ):
     """The engine fallback ladder (megakernel → C++ native → XLA scan) for
     one prepared stream: selection pre-checks, breaker gating, runtime
@@ -602,9 +569,14 @@ def _run_engine_ladder(
         )
         out, engine_name = _run_segments(
             prep, segments, pod_valid, forced, tmpl_ids, extra_plugins,
-            tie_seed, nv_mask, skips, log,
+            tie_seed, nv_mask, skips, log, explain=explain,
         )
         sf_rows = np.arange(len(tmpl_ids), dtype=np.int32)
+    # decision audit (ISSUE 7): explain mode needs every step's per-filter
+    # verdicts — only the C++ generic path and the XLA count_all scan
+    # produce them; the megakernel never materializes per-filter masks
+    elif explain:
+        skips["megakernel"] = "explain mode audits per-filter verdicts (C++/XLA engines)"
     # importing the megakernel module costs ~1 s of pallas Python-module
     # compile — only pay it where it can actually run (TPU backend, or
     # the tests' interpret mode); CPU hosts go straight to the C++ path.
@@ -723,7 +695,7 @@ def _run_engine_ladder(
                 with obs.span("engine.native"):
                     out = nativepath.schedule(
                         prep, pod_valid, config=sched_config, node_valid=nv_mask,
-                        tie_seed=tie_seed,
+                        tie_seed=tie_seed, explain=explain,
                     )
                 native_breaker.record_success()
                 engine_name = "native"
@@ -747,10 +719,60 @@ def _run_engine_ladder(
             out = schedule_pods(
                 ec_run, st0, tmpl_p, valid_p, forced_p,
                 features=prep.features, config=sched_config, extra_plugins=extra_plugins,
-                unroll=scan_unroll(), tie_seed=tie_seed,
+                unroll=scan_unroll(), tie_seed=tie_seed, explain=explain,
             )
             jax.block_until_ready(out.chosen)  # dispatch is async; trace real device time
     return out, engine_name, skips, sf_rows
+
+
+_REASON_EVENT_CAP = 8  # per-pod unschedulable events per schedule span
+
+
+def _schedule_reason_events(
+    obs, out, ordered, tmpl_ids, pod_valid, forced, sf_rows, meta, nv_mask,
+    chosen=None, exclude=frozenset(),
+):
+    """Decision telemetry on the span tree (ISSUE 7): one instant event per
+    unschedulable pod (capped at :data:`_REASON_EVENT_CAP`) plus a
+    primary-reason histogram event, so the flight recorder answers *what*
+    the scheduler decided, not only how long it took. Usually emitted under
+    the schedule span; preemption runs pass the post-preemption ``chosen``
+    (and the victim set to ``exclude`` — victims fail by eviction, not by a
+    filter) so the events never contradict the response. A no-op without an
+    ambient trace or without failures."""
+    if obs.current_trace() is None:
+        return
+    P = len(ordered)
+    if chosen is None:
+        chosen = np.asarray(out.chosen)[:P]
+    failed = pod_valid & ~forced & (np.asarray(chosen)[:P] < 0)
+    if not failed.any():
+        return
+    from . import explain as explain_mod
+
+    static_fail = np.asarray(out.static_fail)
+    fail_counts = np.asarray(out.fail_counts)[:P]
+    insufficient = np.asarray(out.insufficient)[:P]
+    n_nodes = int(nv_mask.sum()) if nv_mask is not None else meta.n_real_nodes
+    idx = np.array([i for i in np.nonzero(failed)[0] if int(i) not in exclude])
+    if not len(idx):
+        return
+    hist = explain_mod.primary_reason_histogram(static_fail, sf_rows, fail_counts, idx)
+    obs.event(
+        "placement.reasons",
+        unschedulable=int(len(idx)),
+        **{f"reason_{k}": v for k, v in sorted(hist.items())},
+    )
+    for i in idx[:_REASON_EVENT_CAP]:
+        pod = ordered[i]
+        obs.event(
+            "placement.unschedulable",
+            pod=f"{pod.metadata.namespace}/{pod.metadata.name}",
+            reason=_reason_string(
+                static_fail[int(sf_rows[i])], fail_counts[i], insufficient[i],
+                meta, n_nodes,
+            ),
+        )
 
 
 def parse_tie_break(spec: str):
@@ -782,6 +804,7 @@ def simulate(
     node_valid: Optional[np.ndarray] = None,
     drop_pods: Optional[np.ndarray] = None,
     deadline: Optional[Deadline] = None,
+    explain: bool = False,
 ) -> SimulateResult:
     """One full simulation: cluster pods then apps in order. `sched_config`
     is an optional SchedulerConfig (the --default-scheduler-config merge);
@@ -810,7 +833,15 @@ def simulate(
     `deadline` (resilience): a request time budget enforced at phase
     boundaries (prepare/encode/schedule/decode) — exhaustion raises
     ``DeadlineExceeded`` naming the phase instead of hanging. Callers may
-    equivalently install a ``resilience.deadline.deadline_scope``."""
+    equivalently install a ``resilience.deadline.deadline_scope``.
+
+    `explain` (decision audit, ISSUE 7): attach one typed
+    ``PlacementExplanation`` per pod plus the per-filter reject totals to
+    ``result.engine`` (``explanations`` / ``filter_rejects`` /
+    ``explain_ctx`` for the deep evaluator). Runs on the C++ generic path
+    or the XLA count_all scan — engine-consistent by the reason-parity
+    gate — and costs nothing when False (the default compiled scan and the
+    incremental C++ path are untouched)."""
     from ..obs import trace as obs
     from ..utils.trace import Trace
 
@@ -825,7 +856,7 @@ def simulate(
                 sched_config=sched_config, patch_pods_fn=patch_pods_fn,
                 extra_plugins=extra_plugins, enable_preemption=enable_preemption,
                 tie_seed=tie_seed, prep=prep, node_valid=node_valid,
-                drop_pods=drop_pods,
+                drop_pods=drop_pods, explain=explain,
             )
 
     _validate_extra_plugins(extra_plugins)
@@ -914,7 +945,7 @@ def simulate(
         with obs.span("schedule", pods=len(ordered)) as _sched_span:
             out, engine_name, skips, sf_rows = _run_engine_ladder(
                 prep, segments, sched_config, pod_valid, forced, tmpl_ids,
-                extra_plugins, tie_seed, nv_mask, ec, st0, log,
+                extra_plugins, tie_seed, nv_mask, ec, st0, log, explain=explain,
             )
             nstats = getattr(out, "native_stats", None)
             engine = EngineDecision(
@@ -930,6 +961,13 @@ def simulate(
                 obs.event(f"engine.{k}.skipped", status="demoted", engine=k, reason=v)
             engine_label = engine_name if nstats is None else f"{engine_name}/{nstats['path']}"
             _sched_span.set(engine=engine_label)
+            if not enable_preemption:
+                # preemption rewrites `chosen` in decode: emitting here
+                # would report pods the preempt pass later schedules
+                _schedule_reason_events(
+                    obs, out, ordered, tmpl_ids, pod_valid, forced, sf_rows,
+                    meta, nv_mask,
+                )
         tr.step(f"schedule {len(ordered)} pods [engine={engine_label}]")
     check_deadline("decode")
     with obs.span("decode", pods=len(ordered)):
@@ -967,6 +1005,13 @@ def simulate(
                 gpu_take=gpu_take, pdbs=all_pdbs, eligible=pod_valid, **state,
             )
             out = out._replace(final_state=fs._replace(used=used, **state))
+        if enable_preemption:
+            # post-preemption telemetry: the events reflect the FINAL
+            # outcome (victims are excluded — they fail by eviction)
+            _schedule_reason_events(
+                obs, out, ordered, tmpl_ids, pod_valid, forced, sf_rows, meta,
+                nv_mask, chosen=chosen, exclude=frozenset(victims_of),
+            )
 
         from ..utils.gcpause import gc_paused
 
@@ -985,7 +1030,76 @@ def simulate(
                 sf_rows, static_fail, fail_counts, insufficient, meta, n_nodes,
                 node_names, pod_lists, node_pods, unscheduled, cluster, out, drops,
             )
+        _record_decision_metrics(
+            chosen, pod_valid, forced, custom_reasons, victims_of, drops,
+            static_fail, sf_rows, fail_counts,
+        )
+        if explain:
+            from . import explain as explain_mod
+
+            ctx = explain_mod.ExplainContext(
+                prep=prep, chosen=chosen, gpu_take=gpu_take,
+                static_fail=static_fail, sf_rows=np.asarray(sf_rows),
+                fail_counts=fail_counts, insufficient=insufficient,
+                n_nodes=n_nodes, node_names=node_names,
+                resource_names=meta.resource_names, config=sched_config,
+                segments=segments, extra_plugins=extra_plugins,
+                engine=engine_name, node_valid=nv_mask,
+            )
+            engine.explain_ctx = ctx
+            engine.explanations = explain_mod.build_explanations(
+                ctx, custom_reasons, victims_of, drops
+            )
+            # per-filter reject totals across ALL audited steps: the C++
+            # engine accumulated them in-engine (ScanArgs.filter_rejects,
+            # abi v4); the XLA/segmented paths derive the identical vector
+            # from the count_all per-pod rows
+            rejects_vec = getattr(out, "filter_rejects", None)
+            if rejects_vec is None:
+                rejects_vec = explain_mod.audit_rejects(
+                    static_fail, sf_rows, fail_counts, pod_valid & ~forced
+                )
+            engine.filter_rejects = reasons.rejects_dict(rejects_vec)
     return SimulateResult(unscheduled_pods=unscheduled, node_status=statuses, engine=engine)
+
+
+def _record_decision_metrics(
+    chosen, pod_valid, forced, custom_reasons, victims_of, drops,
+    static_fail, sf_rows, fail_counts,
+):
+    """Always-on decision counters (ISSUE 7, /metrics):
+    ``simon_unschedulable_total{reason=}`` — pods by primary reason — and
+    ``simon_filter_reject_total{filter=}`` — node-level rejects from the
+    failure attribution every engine computes for unschedulable pods.
+    Independent of explain mode so dashboards see identical series either
+    way."""
+    from ..obs.metrics import RECORDER
+    from . import explain as explain_mod
+
+    failed = pod_valid & ~forced & (np.asarray(chosen) < 0)
+    attributed = [
+        int(i)
+        for i in np.nonzero(failed)[0]
+        if int(i) not in victims_of and int(i) not in custom_reasons
+    ]
+    hist = explain_mod.primary_reason_histogram(
+        static_fail, sf_rows, fail_counts, attributed
+    )
+    nnf = int((forced & (np.asarray(chosen) < 0) & pod_valid).sum())
+    if nnf:
+        hist["node_not_found"] = hist.get("node_not_found", 0) + nnf
+    n_unknown = sum(1 for i in custom_reasons if i not in drops)
+    if n_unknown:
+        hist["unknown_profile"] = hist.get("unknown_profile", 0) + n_unknown
+    if victims_of:
+        hist["preempted"] = hist.get("preempted", 0) + len(victims_of)
+    if hist:
+        RECORDER.count_unschedulable(hist)
+    if attributed:
+        mask = np.zeros(len(pod_valid), dtype=bool)
+        mask[attributed] = True
+        rejects = explain_mod.audit_rejects(static_fail, sf_rows, fail_counts, mask)
+        RECORDER.count_filter_rejects(reasons.rejects_dict(rejects))
 
 
 def snapshot_bind_state(prep: "Prepared") -> list:
@@ -1031,7 +1145,7 @@ def _decode(
             continue
         c = int(chosen[i])
         if forced[i] and c < 0:
-            unscheduled.append(UnscheduledPod(pod, f'node "{pod.spec.node_name}" not found'))
+            unscheduled.append(UnscheduledPod(pod, reasons.node_not_found(pod.spec.node_name)))
             continue
         if c >= 0:
             pod.spec.node_name = node_names[c]
@@ -1054,8 +1168,9 @@ def _decode(
             unscheduled.append(
                 UnscheduledPod(
                     pod,
-                    "preempted by higher-priority pod "
-                    f"{preemptor.metadata.namespace}/{preemptor.metadata.name}",
+                    reasons.preempted(
+                        preemptor.metadata.namespace, preemptor.metadata.name
+                    ),
                 )
             )
         else:

@@ -38,8 +38,9 @@ _FEATURES = [
     "ft_prefer_avoid", "ft_gc_dyn",
 ]
 _FILTER_ENABLES = ["cf_ports", "cf_fit", "cf_spread", "cf_interpod", "cf_gpu", "cf_local"]
-# sampled tie-break knobs (--tie-break=sample[:seed])
-_SELECT = ["tie_sample", "tie_seed"]
+# sampled tie-break knobs (--tie-break=sample[:seed]) + the decision-audit
+# flag (explain=1 forces the generic path and fills filter_rejects)
+_SELECT = ["tie_sample", "tie_seed", "explain"]
 _WEIGHTS = [
     "w_balanced", "w_least", "w_node_affinity", "w_taint_toleration",
     "w_interpod", "w_spread", "w_prefer_avoid", "w_simon", "w_gpu_share",
@@ -49,6 +50,7 @@ _WEIGHTS = [
 # scan_engine.cc — keep in sync
 _U8 = ctypes.POINTER(ctypes.c_uint8)
 _I32 = ctypes.POINTER(ctypes.c_int32)
+_I64 = ctypes.POINTER(ctypes.c_int64)
 _F32 = ctypes.POINTER(ctypes.c_float)
 _F64 = ctypes.POINTER(ctypes.c_double)
 _BUFFERS = [
@@ -85,9 +87,14 @@ _BUFFERS = [
     # path attribution ({incremental, generic, full_eval} step counts) and
     # the OPENSIM_NATIVE_PROFILE per-phase {seconds, steps} pairs
     ("path_counts", _I32, "i32"), ("profile_out", _F64, "f64"),
+    # decision audit (explain=1, abi v4): per-template static-filter fail
+    # counts in, 11-slot per-filter reject totals out
+    ("static_fail", _I32, "i32"), ("filter_rejects", _I64, "i64"),
 ]
 
-_NP_DTYPES = {"u8": "uint8", "i32": "int32", "f32": "float32", "f64": "float64"}
+_NP_DTYPES = {
+    "u8": "uint8", "i32": "int32", "i64": "int64", "f32": "float32", "f64": "float64",
+}
 
 
 class ScanArgs(ctypes.Structure):

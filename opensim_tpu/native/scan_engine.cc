@@ -58,6 +58,11 @@ struct ScanArgs {
   // score maxima per step — the distribution of the reference's selectHost
   // reservoir sampling (generic_scheduler.go:188-210)
   int64_t tie_sample, tie_seed;
+  // decision audit (ISSUE 7): 1 = run failure attribution on EVERY
+  // scheduled step (not only failures) and accumulate the per-filter
+  // reject totals into filter_rejects. Forces the generic path — the
+  // incremental cache never materializes full per-step verdict masks.
+  int64_t explain;
   // score weights (SchedulerConfig.w_*; double like the Python floats, cast
   // to f32 at the same point jnp's weak-type promotion does)
   double w_balanced, w_least, w_node_affinity, w_taint_toleration, w_interpod,
@@ -138,9 +143,16 @@ struct ScanArgs {
   // per-phase {seconds, steps} pairs in Prof order (delta, full_eval,
   // argmax, bind, fail, generic); filled only under OPENSIM_NATIVE_PROFILE
   double* profile_out;    // [12]
+  // --- decision audit (explain=1; ISSUE 7, abi v4) ---
+  // per-template static-filter first-fail counts (kernels.precompute_static
+  // static_fail) so the engine attributes the 4 static filters without
+  // recomputing them, and the 11-slot per-filter reject accumulator
+  // (kernel filter-index order; int64 — P×N node verdicts overflow i32)
+  const int32_t* static_fail;  // [U,4]
+  int64_t* filter_rejects;     // [11]
 };
 
-int64_t opensim_abi_version() { return 3; }
+int64_t opensim_abi_version() { return 4; }
 int64_t opensim_args_size() { return (int64_t)sizeof(ScanArgs); }
 
 }  // extern "C"
@@ -824,6 +836,18 @@ struct EnvCtx {
   bool use_spr, use_share, use_avoid, use_ip;
   float wsp, wshare, wav, wip;
 };
+
+// Decision audit (explain=1): fold one step's first-fail attribution into
+// the per-filter reject totals — static filters from the precomputed
+// per-template counts, dynamic stages from the row fail_accounting just
+// wrote. Kernel filter-index order: 4 static slots then N_STAGES dynamic.
+void accumulate_rejects(ScanArgs& a, int32_t u, int64_t i) {
+  if (!a.filter_rejects) return;
+  for (int k = 0; k < 4; k++)
+    a.filter_rejects[k] += (int64_t)a.static_fail[(int64_t)u * 4 + k];
+  for (int k = 0; k < N_STAGES; k++)
+    a.filter_rejects[4 + k] += (int64_t)a.fail_counts[i * N_STAGES + k];
+}
 
 inline float recombine(const TmplCache& tc, const EnvCtx& e, int64_t n) {
   // only called for templates WITHOUT an active soft spread (those
@@ -1520,8 +1544,11 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
   // harness + attribution: a tuned number must name the path that made it).
   const char* fg_env = std::getenv("OPENSIM_NATIVE_FORCE_GENERIC");
   const bool force_generic = fg_env && fg_env[0] && std::strcmp(fg_env, "0") != 0;
-  const bool inc_ok = !force_generic && !act_ports && !act_gpu && !act_local &&
-                      !use_loc && !a.ft_gc_dyn && a.Cs <= 16;
+  // explain mode audits every step's verdict masks — only the generic path
+  // materializes them (the incremental cache's whole point is NOT to)
+  const bool explain = a.explain != 0;
+  const bool inc_ok = !force_generic && !explain && !act_ports && !act_gpu &&
+                      !act_local && !use_loc && !a.ft_gc_dyn && a.Cs <= 16;
   constexpr size_t MAX_PENDING = 8;
   TmplCache tc;
   EnvCtx env{act_fit, act_spread, act_interpod, use_spr, use_share,
@@ -1843,7 +1870,14 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
 
     if (!any_feas) {
       fail_accounting(a, s, act, u, i);
+      if (explain) accumulate_rejects(a, u, i);
       continue;
+    }
+    if (explain) {
+      // audit the successful step too: per-pod rows + reject totals see
+      // the nodes each filter rejected even when the pod still lands
+      fail_accounting(a, s, act, u, i);
+      accumulate_rejects(a, u, i);
     }
 
     // --- Score: reductions over the feasible set, then fused accumulate ---

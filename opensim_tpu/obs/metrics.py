@@ -26,6 +26,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "CounterVec",
     "HistogramVec",
     "MetricsRecorder",
     "RECORDER",
@@ -38,6 +39,14 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0,
 )
+
+
+def exposition_headers(name: str, help_text: str, kind: str = "counter") -> List[str]:
+    """The ``# HELP``/``# TYPE`` header pair every rendered family carries
+    (exposition-format conformance, ISSUE 7 satellite) — the one place the
+    header layout lives, shared by the REST counters and the watch
+    supervisor's series."""
+    return [f"# HELP {name} {help_text}", f"# TYPE {name} {kind}"]
 
 
 def escape_label_value(value: str) -> str:
@@ -58,6 +67,39 @@ def _fmt_le(bound: float) -> str:
     return s
 
 
+class CounterVec:
+    """One counter family over a fixed label set, rendered with its
+    ``# HELP``/``# TYPE`` header. Not self-locking — mutations happen under
+    the owning :class:`MetricsRecorder`'s lock like everything else."""
+
+    def __init__(self, name: str, label_names: Sequence[str], help: str = "") -> None:
+        self.name = name
+        self.label_names = tuple(label_names)
+        self.help = help
+        self._series: Dict[Tuple[str, ...], int] = {}
+
+    def inc(self, labels: Tuple[str, ...], n: int = 1) -> None:
+        self._series[labels] = self._series.get(labels, 0) + n
+
+    def render_lines(self) -> List[str]:
+        if not self._series:
+            return []
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} counter")
+        for labels in sorted(self._series):
+            base = ",".join(
+                f'{k}="{escape_label_value(v)}"'
+                for k, v in zip(self.label_names, labels)
+            )
+            lines.append(f"{self.name}{{{base}}} {self._series[labels]}")
+        return lines
+
+    def reset(self) -> None:
+        self._series.clear()
+
+
 class HistogramVec:
     """One histogram family over a fixed label set. Not self-locking: every
     mutation/read happens under the owning :class:`MetricsRecorder`'s lock
@@ -68,10 +110,12 @@ class HistogramVec:
         name: str,
         label_names: Sequence[str],
         buckets: Sequence[float] = DEFAULT_BUCKETS,
+        help: str = "",
     ) -> None:
         self.name = name
         self.label_names = tuple(label_names)
         self.buckets = tuple(buckets) + (math.inf,)
+        self.help = help
         # label-values tuple -> [per-bucket counts..., count, sum]
         self._series: Dict[Tuple[str, ...], list] = {}
 
@@ -89,7 +133,10 @@ class HistogramVec:
     def render_lines(self) -> List[str]:
         if not self._series:
             return []
-        lines = [f"# TYPE {self.name} histogram"]
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} histogram")
         for labels in sorted(self._series):
             series = self._series[labels]
             base = ",".join(
@@ -118,9 +165,24 @@ class MetricsRecorder:
 
     def __init__(self) -> None:
         self.lock = threading.RLock()
-        self.phase_seconds = HistogramVec("simon_phase_seconds", ("phase", "endpoint"))
+        self.phase_seconds = HistogramVec(
+            "simon_phase_seconds", ("phase", "endpoint"),
+            help="Per-phase latency from the request span trees",
+        )
         self.request_seconds = HistogramVec(
-            "simon_request_seconds", ("endpoint", "status")
+            "simon_request_seconds", ("endpoint", "status"),
+            help="Whole-request latency by endpoint and outcome",
+        )
+        # decision audit (ISSUE 7): per-filter node rejects from the
+        # failure attribution, and unschedulable pods by primary reason —
+        # bumped by every simulate() regardless of explain mode
+        self.filter_rejects = CounterVec(
+            "simon_filter_reject_total", ("filter",),
+            help="Nodes rejected per filter plugin while attributing unschedulable pods",
+        )
+        self.unschedulable = CounterVec(
+            "simon_unschedulable_total", ("reason",),
+            help="Unschedulable pods by primary (most-rejecting) reason code",
         )
 
     def observe_request(self, endpoint: str, seconds: float, status: str = "ok") -> None:
@@ -161,14 +223,31 @@ class MetricsRecorder:
                 if labels[1] == "ok"
             )
 
+    def count_filter_rejects(self, by_filter: Dict[str, int]) -> None:
+        with self.lock:
+            for name, n in by_filter.items():
+                self.filter_rejects.inc((name,), int(n))
+
+    def count_unschedulable(self, by_reason: Dict[str, int]) -> None:
+        with self.lock:
+            for name, n in by_reason.items():
+                self.unschedulable.inc((name,), int(n))
+
     def render_lines(self) -> List[str]:
         with self.lock:
-            return self.phase_seconds.render_lines() + self.request_seconds.render_lines()
+            return (
+                self.filter_rejects.render_lines()
+                + self.unschedulable.render_lines()
+                + self.phase_seconds.render_lines()
+                + self.request_seconds.render_lines()
+            )
 
     def reset(self) -> None:
         with self.lock:
             self.phase_seconds.reset()
             self.request_seconds.reset()
+            self.filter_rejects.reset()
+            self.unschedulable.reset()
 
 
 RECORDER = MetricsRecorder()

@@ -49,19 +49,9 @@ F_LOCAL = 9
 F_EXTRA = 10  # out-of-tree plugins registered via extra_plugins
 NUM_FILTERS = 11
 
-FILTER_REASONS = [
-    "node(s) didn't match the requested hostname",
-    "node(s) were unschedulable",
-    "node(s) had taints that the pod didn't tolerate",
-    "node(s) didn't match Pod's node affinity",
-    "node(s) didn't have free ports for the requested pod ports",
-    "Insufficient resources",
-    "node(s) didn't match pod topology spread constraints",
-    "node(s) didn't satisfy inter-pod affinity rules",
-    "Insufficient GPU memory in 1 GPU device",
-    "node(s) didn't have enough local storage",
-    "node(s) were rejected by an out-of-tree plugin",
-]
+# the registered reason-code table (engine/reasons.py, ISSUE 7): one copy
+# of the kube FitError phrasings shared by every engine and report surface
+from ..engine.reasons import FILTER_MESSAGES as FILTER_REASONS  # noqa: E402
 
 
 # ---------------------------------------------------------------------------
@@ -924,8 +914,73 @@ class StepResult(NamedTuple):
     insufficient: jnp.ndarray  # [R] i32 nodes short of each resource
 
 
+def score_parts(
+    ec, stat: "StaticTables", st, u, feasible, feat: Features = ALL_FEATURES,
+    cfg=None, extra: tuple = (),
+):
+    """Per-plugin weighted score contributions for one pod over the node
+    axis, keyed by the kube plugin name, in the exact accumulation order of
+    ``pod_step``'s selectHost sum (insertion-ordered dict — summing the
+    values reproduces the engine's score bit-for-bit). This is the single
+    scoring source shared by the scan and the decision audit's per-plugin
+    breakdown (``simon explain``), so the two can never drift."""
+    from ..engine.schedconfig import DEFAULT_CONFIG
+
+    cfg = cfg or DEFAULT_CONFIG
+    parts = {}
+    if cfg.w_balanced:
+        parts["NodeResourcesBalancedAllocation"] = (
+            cfg.w_balanced * balanced_allocation_score(ec, st, u)
+        )
+    if cfg.w_least:
+        parts["NodeResourcesLeastAllocated"] = (
+            cfg.w_least * least_allocated_score(ec, st, u)
+        )
+    if feat.pref_node_affinity and cfg.w_node_affinity:
+        na_raw = stat.na_raw[u]
+        na_max = jnp.max(jnp.where(feasible, na_raw, 0.0))
+        parts["NodeAffinity"] = cfg.w_node_affinity * jnp.where(
+            na_max > 0, na_raw * MAX_NODE_SCORE / jnp.maximum(na_max, 1.0), na_raw
+        )
+    if feat.prefer_taints and cfg.w_taint_toleration:
+        tt_raw = stat.tt_raw[u]
+        tt_max = jnp.max(jnp.where(feasible, tt_raw, 0.0))
+        parts["TaintToleration"] = cfg.w_taint_toleration * jnp.where(
+            tt_max > 0,
+            MAX_NODE_SCORE - tt_raw * MAX_NODE_SCORE / jnp.maximum(tt_max, 1.0),
+            MAX_NODE_SCORE,
+        )
+    if (feat.prefg or feat.interpod) and cfg.w_interpod:
+        parts["InterPodAffinity"] = cfg.w_interpod * interpod_score(ec, st, u, feasible)
+    if feat.spread_soft and cfg.w_spread:
+        parts["PodTopologySpread"] = cfg.w_spread * spread_score(ec, stat, st, u, feasible)
+    if cfg.w_simon + cfg.w_gpu_share:
+        # Simon + Open-Gpu-Share share the same formula and normalization
+        share_row = stat.share_raw[u]
+        if feat.gc_dyn:
+            # add back the gpu-count column with the Reserve-updated value
+            # (share_raw zeroed it on device-bearing nodes); max mirrors the
+            # Go accumulator taking the largest per-resource share
+            share_row = jnp.maximum(share_row, gc_share_dyn(ec, st, u))
+        parts["Simon/GpuShare"] = (cfg.w_simon + cfg.w_gpu_share) * _minmax_normalize(
+            share_row, feasible
+        )
+    if feat.local and cfg.w_local:
+        parts["OpenLocal"] = cfg.w_local * _minmax_normalize(
+            local_score(ec, st, u), feasible
+        )
+    if feat.prefer_avoid and cfg.w_prefer_avoid:
+        # NodePreferAvoidPods (w=10000, no NormalizeScore): raw 0/100 table
+        parts["NodePreferAvoidPods"] = cfg.w_prefer_avoid * ec.avoid_score[u]
+    for k, entry in enumerate(extra):
+        if entry[0] == "score":
+            parts[f"Extra[{k}]"] = float(entry[2]) * entry[1](ec, st, u, feasible)
+    return parts
+
+
 def pod_step(
-    ec, stat: StaticTables, st, u, feat: Features = ALL_FEATURES, cfg=None, extra: tuple = ()
+    ec, stat: StaticTables, st, u, feat: Features = ALL_FEATURES, cfg=None, extra: tuple = (),
+    count_all: bool = False,
 ) -> StepResult:
     """One pod through the full pipeline. Mirrors scheduleOne
     (vendor/.../scheduler/scheduler.go:441) minus the bind goroutine.
@@ -996,51 +1051,26 @@ def pod_step(
         )
 
     any_feasible = jnp.any(feasible)
-    fail_counts, per_res_insufficient = jax.lax.cond(any_feasible, no_fails, count_fails, None)
+    if count_all:
+        # explain mode (ISSUE 7): per-filter reject counts for EVERY step,
+        # not just failures — the decision-audit aggregate needs to see
+        # filter pressure on successful binds too. Trace-time flag, so the
+        # default compile keeps the cond-skipped accounting below.
+        fail_counts, per_res_insufficient = count_fails(None)
+    else:
+        fail_counts, per_res_insufficient = jax.lax.cond(
+            any_feasible, no_fails, count_fails, None
+        )
 
-    # score plugins × weights (registry.go:119-132 + the three sim plugins).
-    # Normalization runs over the feasible set, matching the framework
-    # normalizing the filtered-node score list (framework.go:635).
+    # score plugins × weights (registry.go:119-132 + the three sim plugins):
+    # accumulated in score_parts order — the per-plugin breakdown IS the
+    # scoring code path, so the decision audit (engine/explain.py) reports
+    # exactly the terms selectHost summed. Normalization runs over the
+    # feasible set, matching the framework normalizing the filtered-node
+    # score list (framework.go:635).
     score = jnp.zeros_like(stat.share_raw[u])
-    if cfg.w_balanced:
-        score = score + cfg.w_balanced * balanced_allocation_score(ec, st, u)
-    if cfg.w_least:
-        score = score + cfg.w_least * least_allocated_score(ec, st, u)
-    if feat.pref_node_affinity and cfg.w_node_affinity:
-        na_raw = stat.na_raw[u]
-        na_max = jnp.max(jnp.where(feasible, na_raw, 0.0))
-        score = score + cfg.w_node_affinity * jnp.where(
-            na_max > 0, na_raw * MAX_NODE_SCORE / jnp.maximum(na_max, 1.0), na_raw
-        )
-    if feat.prefer_taints and cfg.w_taint_toleration:
-        tt_raw = stat.tt_raw[u]
-        tt_max = jnp.max(jnp.where(feasible, tt_raw, 0.0))
-        score = score + cfg.w_taint_toleration * jnp.where(
-            tt_max > 0, MAX_NODE_SCORE - tt_raw * MAX_NODE_SCORE / jnp.maximum(tt_max, 1.0), MAX_NODE_SCORE
-        )
-    if (feat.prefg or feat.interpod) and cfg.w_interpod:
-        score = score + cfg.w_interpod * interpod_score(ec, st, u, feasible)
-    if feat.spread_soft and cfg.w_spread:
-        score = score + cfg.w_spread * spread_score(ec, stat, st, u, feasible)
-    if cfg.w_simon + cfg.w_gpu_share:
-        # Simon + Open-Gpu-Share share the same formula and normalization
-        share_row = stat.share_raw[u]
-        if feat.gc_dyn:
-            # add back the gpu-count column with the Reserve-updated value
-            # (share_raw zeroed it on device-bearing nodes); max mirrors the
-            # Go accumulator taking the largest per-resource share
-            share_row = jnp.maximum(share_row, gc_share_dyn(ec, st, u))
-        score = score + (cfg.w_simon + cfg.w_gpu_share) * _minmax_normalize(
-            share_row, feasible
-        )
-    if feat.local and cfg.w_local:
-        score = score + cfg.w_local * _minmax_normalize(local_score(ec, st, u), feasible)
-    if feat.prefer_avoid and cfg.w_prefer_avoid:
-        # NodePreferAvoidPods (w=10000, no NormalizeScore): raw 0/100 table
-        score = score + cfg.w_prefer_avoid * ec.avoid_score[u]
-    for entry in extra:
-        if entry[0] == "score":
-            score = score + float(entry[2]) * entry[1](ec, st, u, feasible)
+    for term in score_parts(ec, stat, st, u, feasible, feat, cfg, extra).values():
+        score = score + term
     # ImageLocality: 0 (no images in sim)
 
     neg = jnp.float32(-1e30)

@@ -81,6 +81,7 @@ class Options:
     report_pods: bool = False  # include the per-node Pod Info table
     max_new_nodes: int = 128  # sweep upper bound (auto mode)
     tie_break: str = "lowest"  # lowest | sample[:seed] (see parse_tie_break)
+    explain: bool = False  # decision audit: append the placement audit to the report
     base_dir: str = ""  # paths in the config resolve relative to this
 
 
@@ -371,11 +372,13 @@ class Applier:
                 result = simulate(
                     cluster, apps, sched_config=self.sched_config,
                     tie_seed=self.tie_seed, prep=prep0,
+                    explain=self.opts.explain,
                 )
             else:
                 result = simulate(
                     cluster, apps, use_greed=self.opts.use_greed, sched_config=self.sched_config,
                     enable_preemption=self.opts.enable_preemption, tie_seed=self.tie_seed,
+                    explain=self.opts.explain,
                 )
         n_new = 0
         if result.unscheduled_pods or not satisfy_resource_setting(result)[0]:
@@ -423,7 +426,7 @@ class Applier:
                         sub, apps, use_greed=self.opts.use_greed,
                         sched_config=self.sched_config,
                         enable_preemption=self.opts.enable_preemption,
-                        tie_seed=self.tie_seed,
+                        tie_seed=self.tie_seed, explain=self.opts.explain,
                     )
                 else:
                     mask = np.zeros(
@@ -434,6 +437,7 @@ class Applier:
                         sub, apps, use_greed=self.opts.use_greed,
                         sched_config=self.sched_config, tie_seed=self.tie_seed,
                         prep=prep_full, node_valid=mask,
+                        explain=self.opts.explain,
                     )
         print("Simulation success!", file=self.out)
         if n_new:
@@ -447,7 +451,33 @@ class Applier:
         )
         if result.engine is not None:
             print(f"Scheduling engine: {result.engine.describe()}", file=self.out)
+        if self.opts.explain and result.engine is not None:
+            self._print_placement_audit(result.engine)
         return 0
+
+    def _print_placement_audit(self, engine) -> None:
+        """--explain (decision audit, ISSUE 7): per-filter reject totals
+        over every scheduled step plus a kube-style breakdown for each pod
+        that did not land."""
+        if engine.explanations is None:
+            # the final simulation ran without the audit (the interactive
+            # prompt loop's re-simulations do not thread explain=)
+            return
+        print("\nPlacement audit:", file=self.out)
+        if engine.filter_rejects:
+            print(
+                "  filter rejects (nodes rejected per filter, all steps): "
+                + ", ".join(f"{k}={v}" for k, v in sorted(engine.filter_rejects.items())),
+                file=self.out,
+            )
+        bad = [e for e in engine.explanations or [] if e.status != "scheduled"]
+        if not bad:
+            print("  every pod scheduled; no rejection breakdowns to report", file=self.out)
+            return
+        for e in bad:
+            print(f"  {e.pod}: {e.message}", file=self.out)
+            for c in e.reasons:
+                print(f"    {c.count:5d} \u00d7 {c.label}", file=self.out)
 
     # survey.Select option labels (apply.go SurveyShowResults/AddNode/Exit)
     SURVEY_SHOW = "Show unschedulable pods"
@@ -607,4 +637,6 @@ class Applier:
         )
         if result.engine is not None:
             print(f"Scheduling engine: {result.engine.describe()}", file=self.out)
+        if self.opts.explain and result.engine is not None:
+            self._print_placement_audit(result.engine)
         return 0

@@ -26,7 +26,7 @@ from typing import List, Optional
 from ..engine.simulator import AppResource, SimulateResult, simulate
 from ..models.objects import LABEL_APP_NAME, Node, ResourceTypes, object_from_dict
 from ..obs import trace as tracing
-from ..obs.metrics import RECORDER, escape_label_value
+from ..obs.metrics import RECORDER, escape_label_value, exposition_headers
 from ..obs.recorder import FLIGHT_RECORDER
 from ..resilience import breaker as breaker_mod
 from ..resilience import faults
@@ -122,63 +122,65 @@ class _Metrics:
         from ..utils.trace import PREP_STATS
 
         esc = escape_label_value
+        hdr = exposition_headers  # every family carries # HELP + # TYPE
+
         with self.lock:
             lines = [
-                "# TYPE simon_requests_total counter",
+                *hdr("simon_requests_total", "Requests served by endpoint"),
                 *(
                     f'simon_requests_total{{endpoint="{esc(ep)}"}} {n}'
                     for ep, n in sorted(self.requests.items())
                 ),
-                "# TYPE simon_simulations_total counter",
+                *hdr("simon_simulations_total", "Successful simulations"),
                 f"simon_simulations_total {self.simulations}",
-                "# TYPE simon_pods_scheduled_total counter",
+                *hdr("simon_pods_scheduled_total", "Pods placed across all simulations"),
                 f"simon_pods_scheduled_total {self.pods_scheduled}",
-                "# TYPE simon_pods_unscheduled_total counter",
+                *hdr("simon_pods_unscheduled_total", "Pods left unschedulable"),
                 f"simon_pods_unscheduled_total {self.pods_unscheduled}",
-                "# TYPE simon_simulate_seconds_total counter",
+                *hdr("simon_simulate_seconds_total", "Wall seconds in successful simulations"),
                 f"simon_simulate_seconds_total {RECORDER.simulate_seconds_total():.6f}",
             ]
         # host-side prepare attribution (incremental prepare): total seconds
         # spent producing Prepared inputs, and the encode-cache counters
         lines += [
-            "# TYPE simon_prepare_seconds_total counter",
+            *hdr("simon_prepare_seconds_total", "Host-side expand+encode seconds"),
             f"simon_prepare_seconds_total {PREP_STATS.total_seconds():.6f}",
         ]
         if prep_cache is not None:
             st = prep_cache.stats
             lines += [
-                "# TYPE simon_prep_cache_hits_total counter",
+                *hdr("simon_prep_cache_hits_total", "Encode-cache hits"),
                 f"simon_prep_cache_hits_total {st.hits}",
-                "# TYPE simon_prep_cache_misses_total counter",
+                *hdr("simon_prep_cache_misses_total", "Encode-cache misses"),
                 f"simon_prep_cache_misses_total {st.misses}",
-                "# TYPE simon_prep_cache_invalidations_total counter",
+                *hdr("simon_prep_cache_invalidations_total", "Encode-cache invalidations"),
                 f"simon_prep_cache_invalidations_total {st.invalidations}",
             ]
         # resilience layer: deadline 504s, snapshot degradation, engine
         # breaker state, fault injections (docs/resilience.md)
         with self.lock:
             lines += [
-                "# TYPE simon_request_timeouts_total counter",
+                *hdr("simon_request_timeouts_total", "Requests 504ed at a deadline boundary"),
                 f"simon_request_timeouts_total {self.request_timeouts}",
-                "# TYPE simon_snapshot_fetch_retries_total counter",
+                *hdr("simon_snapshot_fetch_retries_total", "Snapshot fetch retry attempts"),
                 f"simon_snapshot_fetch_retries_total {self.snapshot_retries}",
-                "# TYPE simon_snapshot_stale_served_total counter",
+                *hdr("simon_snapshot_stale_served_total", "Requests served from a stale snapshot"),
                 f"simon_snapshot_stale_served_total {self.snapshot_stale_served}",
-                "# TYPE simon_stale_prep_retries_total counter",
+                *hdr("simon_stale_prep_retries_total", "Stale prep-cache internal retries"),
                 f"simon_stale_prep_retries_total {self.stale_prep_retries}",
-                "# TYPE simon_native_steps_total counter",
+                *hdr("simon_native_steps_total", "C++ engine scheduled steps by evaluation path"),
                 *(
                     f'simon_native_steps_total{{path="{esc(p)}"}} {n}'
                     for p, n in sorted(self.native_steps.items())
                 ),
             ]
         breakers = sorted(breaker_mod.all_breakers().items())
-        lines += ["# TYPE simon_engine_breaker_trips_total counter"]
+        lines += hdr("simon_engine_breaker_trips_total", "Engine circuit-breaker trips")
         lines += [
             f'simon_engine_breaker_trips_total{{engine="{esc(name)}"}} {br.trips_total}'
             for name, br in breakers
         ]
-        lines += ["# TYPE simon_engine_breaker_open gauge"]
+        lines += hdr("simon_engine_breaker_open", "Engine breaker open (1) or closed (0)", "gauge")
         lines += [
             f'simon_engine_breaker_open{{engine="{esc(name)}"}} '
             f'{int(br.state() != "closed")}'
@@ -186,7 +188,7 @@ class _Metrics:
         ]
         fired = sorted(faults.fault_stats().items())
         if fired:
-            lines += ["# TYPE simon_faults_injected_total counter"]
+            lines += hdr("simon_faults_injected_total", "Chaos faults injected by point")
             lines += [
                 f'simon_faults_injected_total{{point="{esc(point)}"}} {n}'
                 for point, n in fired
@@ -244,14 +246,24 @@ def _decode_new_nodes(payload: dict) -> List[Node]:
     return nodes
 
 
-def _response(result: SimulateResult) -> dict:
+def _response(result: SimulateResult, explain: bool = False) -> dict:
     """getSimulateResponse (server.go:446-470): names only; node entries only
-    for nodes holding app pods."""
+    for nodes holding app pods. ``explain=1`` (ISSUE 7) upgrades each
+    unscheduled entry with its typed reason breakdown and adds the
+    per-filter reject totals — additive, so existing clients are
+    unaffected."""
+    expl_by_pod = {}
+    engine = result.engine
+    if explain and engine is not None and engine.explanations:
+        expl_by_pod = {e.pod: e for e in engine.explanations}
     out = {"unscheduledPods": [], "nodeStatus": []}
     for up in result.unscheduled_pods:
-        out["unscheduledPods"].append(
-            {"pod": f"{up.pod.metadata.namespace}/{up.pod.metadata.name}", "reason": up.reason}
-        )
+        name = f"{up.pod.metadata.namespace}/{up.pod.metadata.name}"
+        entry = {"pod": name, "reason": up.reason}
+        e = expl_by_pod.get(name)
+        if e is not None:
+            entry["explanation"] = e.to_dict()
+        out["unscheduledPods"].append(entry)
     for ns in result.node_status:
         pods = [
             f"{p.metadata.namespace}/{p.metadata.name}"
@@ -260,7 +272,44 @@ def _response(result: SimulateResult) -> dict:
         ]
         if pods:
             out["nodeStatus"].append({"node": ns.node.metadata.name, "pods": pods})
+    if explain and engine is not None and engine.filter_rejects is not None:
+        out["filterRejects"] = engine.filter_rejects
     return out
+
+
+# flight-recorder storage cap for explain-mode placement audits: the ring
+# holds N traces, and a 50k-pod audit would pin ~50k dicts per trace. A
+# typo'd knob degrades to the default with a warning (same contract as
+# OPENSIM_FLIGHT_RECORDER_N), never a startup crash.
+def _explain_store_n() -> int:
+    raw = os.environ.get("OPENSIM_EXPLAIN_STORE_N", "")
+    try:
+        return max(1, int(raw)) if raw else 512
+    except ValueError:
+        log.warning("ignoring unparseable OPENSIM_EXPLAIN_STORE_N=%r (using 512)", raw)
+        return 512
+
+
+_EXPLAIN_STORE_N = _explain_store_n()
+
+
+def _placements_payload(rid: str, result: SimulateResult) -> dict:
+    """The serialized decision audit stored on the request's trace for
+    ``GET /api/debug/placements/<request-id>``: unschedulable records first
+    (they are what the endpoint exists for), scheduled records filling the
+    remaining cap."""
+    engine = result.engine
+    explanations = engine.explanations or []
+    ranked = sorted(explanations, key=lambda e: e.status == "scheduled")
+    kept = ranked[:_EXPLAIN_STORE_N]
+    return {
+        "request_id": rid,
+        "engine": engine.describe(),
+        "filter_rejects": engine.filter_rejects or {},
+        "pods_total": len(explanations),
+        "truncated": max(0, len(explanations) - len(kept)),
+        "explanations": [e.to_dict() for e in kept],
+    }
 
 
 class SimonServer:
@@ -446,7 +495,8 @@ class SimonServer:
 
     # -- handlers -----------------------------------------------------------
 
-    def _simulate_request(self, kind: str, payload: dict) -> SimulateResult:
+    def _simulate_request(self, kind: str, payload: dict,
+                          explain: bool = False) -> SimulateResult:
         """`_simulate_request_once` plus stale-entry recovery: a
         ``StaleFingerprintError`` hit means a fingerprinted object was
         ``touch()``ed behind the cache's back — ``PrepareCache.check_fresh``
@@ -458,13 +508,14 @@ class SimonServer:
         from ..engine.prepcache import StaleFingerprintError
 
         try:
-            return self._simulate_request_once(kind, payload)
+            return self._simulate_request_once(kind, payload, explain=explain)
         except StaleFingerprintError as e:
             METRICS.bump("stale_prep_retries")
             log.warning("stale prepare-cache entry (%s); retrying once after eviction", e)
-            return self._simulate_request_once(kind, payload)
+            return self._simulate_request_once(kind, payload, explain=explain)
 
-    def _simulate_request_once(self, kind: str, payload: dict) -> SimulateResult:
+    def _simulate_request_once(self, kind: str, payload: dict,
+                               explain: bool = False) -> SimulateResult:
         """Shared deploy/scale simulation through the encode cache:
 
         1. identical repeated request → full-key hit: restore + simulate,
@@ -493,7 +544,7 @@ class SimonServer:
             cluster = _with_new_nodes(self.current_cluster(), new_nodes)
             if scaled:
                 cluster.pods = [p for p in cluster.pods if not _owned_by(p, scaled)]
-            return simulate(cluster, apps)
+            return simulate(cluster, apps, explain=explain)
 
         cluster0, fp = self._snapshot_for_cache()
         cluster = _with_new_nodes(cluster0, new_nodes)
@@ -527,6 +578,7 @@ class SimonServer:
                     return simulate(
                         cluster, apps, prep=entry.prep,
                         drop_pods=getattr(entry, "drop_mask", None),
+                        explain=explain,
                     )
                 finally:
                     entry.restore()
@@ -543,7 +595,7 @@ class SimonServer:
             )
         if base.prep is None:
             # snapshot with no schedulable pods: nothing worth caching
-            return simulate(_filtered(), apps)
+            return simulate(_filtered(), apps, explain=explain)
         self.prep_cache.check_fresh(base)
         with base.lock:
             base.restore()
@@ -561,7 +613,7 @@ class SimonServer:
                 else None
             )
             if derived is None:
-                return simulate(_filtered(), apps)
+                return simulate(_filtered(), apps, explain=explain)
             # the simulate drop mask composes the scale request's removals
             # with the live twin's event-deleted pods (CacheEntry.base_drop:
             # watch DELETEDs stay in the cached stream, mask-flipped)
@@ -577,13 +629,14 @@ class SimonServer:
             if not new_nodes:
                 self.prep_cache.put(full_key, entry)
             try:
-                return simulate(cluster, apps, prep=derived, drop_pods=drop)
+                return simulate(cluster, apps, prep=derived, drop_pods=drop,
+                                explain=explain)
             finally:
                 entry.restore()
 
     def _handle(self, endpoint: str, kind: str, lock: threading.Lock,
                 payload: dict, deadline: Optional[Deadline] = None,
-                request_id: Optional[str] = None) -> tuple:
+                request_id: Optional[str] = None, explain: bool = False) -> tuple:
         """Shared endpoint shell: single-flight busy rejection, deadline
         scope, request-scoped trace, and the failure-mode ladder
         (docs/resilience.md) — every outcome is a typed JSON body, never a
@@ -620,13 +673,19 @@ class SimonServer:
         result: Optional[SimulateResult] = None
         try:
             with deadline_scope(deadline), tracing.trace_scope(tr):
-                result = self._simulate_request(kind, payload)
+                result = self._simulate_request(kind, payload, explain=explain)
             status = "ok"
             if result.engine is not None:
                 result.engine.request_id = rid
                 if tr is not None:
                     tr.root.set(engine=result.engine.describe())
-            code, body = 200, _response(result)
+            code, body = 200, _response(result, explain=explain)
+            if explain and tr is not None and result.engine is not None:
+                # the decision audit joins the flight recorder: served later
+                # at GET /api/debug/placements/<request-id> (serialized and
+                # capped — the ctx holding the full Prepared is dropped).
+                # engine is None when the snapshot held no schedulable pods
+                tr.placements = _placements_payload(rid, result)
         except DeadlineExceeded as e:
             status = "deadline-exceeded"
             METRICS.bump("request_timeouts")
@@ -661,18 +720,18 @@ class SimonServer:
         return code, body
 
     def deploy_apps(self, payload: dict, deadline: Optional[Deadline] = None,
-                    request_id: Optional[str] = None) -> tuple:
+                    request_id: Optional[str] = None, explain: bool = False) -> tuple:
         return self._handle("deploy-apps", "deploy", _deploy_lock, payload,
-                            deadline, request_id)
+                            deadline, request_id, explain=explain)
 
     def scale_apps(self, payload: dict, deadline: Optional[Deadline] = None,
-                   request_id: Optional[str] = None) -> tuple:
+                   request_id: Optional[str] = None, explain: bool = False) -> tuple:
         """scale-apps (server.go:233-312): remove the workload's existing
         pods from the cluster snapshot, then re-simulate at the new scale —
         on the cached path the removal is a valid-mask flip over the
         snapshot's cached encoding, not a re-encode."""
         return self._handle("scale-apps", "scale", _scale_lock, payload,
-                            deadline, request_id)
+                            deadline, request_id, explain=explain)
 
 
 def _owned_by(pod, scaled: set) -> bool:
@@ -722,13 +781,19 @@ def make_handler(server: SimonServer):
         def _begin_request(self) -> None:
             # duration is request-scoped, stamped at dispatch: measuring
             # from connection setup() would bill keep-alive idle and slow
-            # client uploads to the server. The thread-local request id is
-            # cleared too, so a GET's access-log line can never inherit the
-            # id of an earlier request served on the same thread.
+            # client uploads to the server. EVERY request — GETs and 4xx
+            # paths included — gets an id here (the client's
+            # X-Simon-Request-Id honored, generated otherwise), so an
+            # access-log line always joins against the flight recorder and
+            # can never inherit the id of an earlier request served on the
+            # same thread (ISSUE 7 satellite).
             import time
 
             self._t0 = time.monotonic()
-            _REQUEST_STATE.request_id = ""
+            _REQUEST_STATE.request_id = (
+                tracing.sanitize_request_id(self.headers.get("X-Simon-Request-Id"))
+                or tracing.new_request_id()
+            )
 
         def _access_log(self, code: int) -> None:
             """Opt-in structured access logging (``OPENSIM_ACCESS_LOG=1``):
@@ -762,6 +827,10 @@ def make_handler(server: SimonServer):
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(data)))
+            # every response names its request id — GETs and error paths
+            # included — so any response joins the access log + recorder
+            if last_request_id() and "X-Simon-Request-Id" not in (extra_headers or {}):
+                self.send_header("X-Simon-Request-Id", last_request_id())
             for k, v in (extra_headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -779,6 +848,8 @@ def make_handler(server: SimonServer):
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(data)))
+                if last_request_id():
+                    self.send_header("X-Simon-Request-Id", last_request_id())
                 self.end_headers()
                 self.wfile.write(data)
                 self._access_log(200)
@@ -796,6 +867,24 @@ def make_handler(server: SimonServer):
                     self._send(404, {"error": f"no recorded trace for request id {rid!r}"})
                 else:
                     self._send(200, tr.tree())
+            elif self.path.startswith("/api/debug/placements/"):
+                # decision audit (ISSUE 7): the per-pod placement
+                # explanations of an explain=1 request, keyed by request id
+                rid = tracing.sanitize_request_id(
+                    self.path.split("?", 1)[0].rsplit("/", 1)[1]
+                )
+                tr = FLIGHT_RECORDER.get(rid)
+                placements = getattr(tr, "placements", None) if tr is not None else None
+                if placements is None:
+                    self._send(
+                        404,
+                        {
+                            "error": f"no recorded placements for request id {rid!r}",
+                            "hint": "POST /api/deploy-apps?explain=1 records them",
+                        },
+                    )
+                else:
+                    self._send(200, placements)
             elif self.path.startswith("/debug/profiler"):
                 # pprof analogue (the reference registers pprof on gin,
                 # server.go:152): start the JAX profiler server and report
@@ -820,17 +909,23 @@ def make_handler(server: SimonServer):
                 self._send(400, {"error": "invalid JSON body"})
                 return
             deadline = request_deadline(self.headers)
-            # request-id propagation (ISSUE 5): honor the client's
-            # X-Simon-Request-Id (sanitized), generate one otherwise; the
-            # id is echoed below and keys the flight-recorder trace
-            request_id = self.headers.get("X-Simon-Request-Id")
-            if self.path == "/api/deploy-apps":
+            # request-id propagation (ISSUE 5): _begin_request honored the
+            # client's X-Simon-Request-Id (sanitized) or generated one; the
+            # id is echoed by _send and keys the flight-recorder trace
+            request_id = last_request_id()
+            path, _, query = self.path.partition("?")
+            # explain=1 (decision audit, ISSUE 7): attach per-pod placement
+            # explanations to the response and the flight recorder
+            from urllib.parse import parse_qs
+
+            explain = parse_qs(query).get("explain", ["0"])[-1] not in ("", "0", "false")
+            if path == "/api/deploy-apps":
                 code, body = server.deploy_apps(
-                    payload, deadline=deadline, request_id=request_id
+                    payload, deadline=deadline, request_id=request_id, explain=explain
                 )
-            elif self.path == "/api/scale-apps":
+            elif path == "/api/scale-apps":
                 code, body = server.scale_apps(
-                    payload, deadline=deadline, request_id=request_id
+                    payload, deadline=deadline, request_id=request_id, explain=explain
                 )
             else:
                 code, body = 404, {"error": "not found"}
@@ -841,8 +936,6 @@ def make_handler(server: SimonServer):
             extra = {}
             if request_served_stale():
                 extra["X-Simon-Snapshot"] = "stale"
-            if last_request_id():
-                extra["X-Simon-Request-Id"] = last_request_id()
             self._send(code, body, extra_headers=extra or None)
 
     return Handler

@@ -66,7 +66,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..models.objects import ResourceTypes
 from ..obs import trace as tracing
-from ..obs.metrics import RECORDER, escape_label_value
+from ..obs.metrics import RECORDER, escape_label_value, exposition_headers
 from ..obs.recorder import FLIGHT_RECORDER
 from ..resilience import faults
 from ..resilience.retry import retry_call
@@ -321,7 +321,11 @@ class ClusterTwin:
 
         return fingerprint_cluster(self.materialize())
 
-    def reconcile(self, listing: Dict[str, Tuple[List[dict], str]]) -> int:
+    def reconcile(
+        self,
+        listing: Dict[str, Tuple[List[dict], str]],
+        per_resource: Optional[Dict[str, int]] = None,
+    ) -> int:
         """Anti-entropy: merge a fresh listing into the twin, returning the
         number of genuinely drifted objects repaired. The merge is
         **rv-aware** because the listing races the event streams — between
@@ -341,6 +345,7 @@ class ClusterTwin:
         drift = 0
         with self._lock:
             for field, (items, list_rv) in listing.items():
+                field_drift0 = drift
                 spec = RESOURCE_BY_FIELD[field]
                 store = self._stores[field]
                 rvs = self._rvs[field]
@@ -377,6 +382,10 @@ class ClusterTwin:
                     del store[k]
                     self._bury(field, k, rvs.pop(k, None))
                     drift += 1
+                if per_resource is not None and drift > field_drift0:
+                    per_resource[field] = (
+                        per_resource.get(field, 0) + drift - field_drift0
+                    )
             if drift:
                 self.generation += 1
         return drift
@@ -615,7 +624,7 @@ class _Reflector(threading.Thread):
             if ev_type == "BOOKMARK":
                 # progress marker only: advances rv, feeds the staleness
                 # deadline, carries no object payload
-                self.sup.count_event("BOOKMARK")
+                self.sup.count_event("BOOKMARK", self.field)
                 continue
             self.sup.dispatch(self.field, ev_type, obj)
 
@@ -665,12 +674,17 @@ class WatchSupervisor:
         self._dispatch_lock = threading.Lock()
         self._held: Dict[str, Tuple[str, dict]] = {}
         self._trace_seq = itertools.count(1)
-        # counters (rendered under the one metrics lock, RECORDER.lock)
-        self.events_total: Dict[str, int] = {}
+        # counters (rendered under the one metrics lock, RECORDER.lock).
+        # events and drift carry a {resource=} label (ISSUE 7 satellite) so
+        # drift is attributable — pods churn and nodes churn are different
+        # operational stories; the unlabeled totals stay as attributes for
+        # programmatic callers
+        self.events_total: Dict[Tuple[str, str], int] = {}  # (kind, resource)
         self.reconnects_total = 0
         self.relists_total = 0
         self.gone_total = 0
         self.drift_total = 0
+        self.drift_by_resource: Dict[str, int] = {}
         self.resyncs_total = 0
 
     # -- lifecycle -----------------------------------------------------------
@@ -761,12 +775,15 @@ class WatchSupervisor:
 
     # -- event path (reflector threads) --------------------------------------
 
-    def count_event(self, kind: str) -> None:
+    def count_event(self, kind: str, resource: str = "") -> None:
         with RECORDER.lock:
-            self.events_total[kind] = self.events_total.get(kind, 0) + 1
+            key = (kind, resource)
+            self.events_total[key] = self.events_total.get(key, 0) + 1
 
     def dispatch(self, field: str, ev_type: str, obj: dict) -> None:
-        self.count_event(ev_type if ev_type in ("ADDED", "MODIFIED", "DELETED") else "OTHER")
+        self.count_event(
+            ev_type if ev_type in ("ADDED", "MODIFIED", "DELETED") else "OTHER", field
+        )
         try:
             faults.fault_point("watch.drop_event")
         except Exception as e:
@@ -996,10 +1013,15 @@ class WatchSupervisor:
                 tracing.event("twin.antientropy", status="error", error=str(e))
                 return -1
             with self._dispatch_lock:
-                drift = self.twin.reconcile(listing)
+                per: Dict[str, int] = {}
+                drift = self.twin.reconcile(listing, per_resource=per)
                 if drift:
                     with RECORDER.lock:
                         self.drift_total += drift
+                        for res, n in per.items():
+                            self.drift_by_resource[res] = (
+                                self.drift_by_resource.get(res, 0) + n
+                            )
                         self.resyncs_total += 1
                     self._set_state("resyncing")
                     log.warning(
@@ -1042,27 +1064,40 @@ class WatchSupervisor:
         the one recorder lock)."""
         esc = escape_label_value
         state = self.state()
+        hdr = exposition_headers  # shared # HELP/# TYPE header layout
+
         with RECORDER.lock:
-            lines = ["# TYPE simon_watch_state gauge"]
+            lines = hdr("simon_watch_state", "Live-twin state machine (one-hot)", "gauge")
             lines += [
                 f'simon_watch_state{{state="{esc(s)}"}} {int(s == state)}'
                 for s in STATES
             ]
-            lines += ["# TYPE simon_watch_events_total counter"]
+            lines += hdr(
+                "simon_watch_events_total", "Watch events consumed by kind and resource"
+            )
             lines += [
-                f'simon_watch_events_total{{kind="{esc(k)}"}} {n}'
-                for k, n in sorted(self.events_total.items())
+                f'simon_watch_events_total{{kind="{esc(k)}",resource="{esc(res)}"}} {n}'
+                for (k, res), n in sorted(self.events_total.items())
             ]
             lines += [
-                "# TYPE simon_watch_reconnects_total counter",
+                *hdr("simon_watch_reconnects_total", "Watch stream reconnect attempts"),
                 f"simon_watch_reconnects_total {self.reconnects_total}",
-                "# TYPE simon_watch_relists_total counter",
+                *hdr("simon_watch_relists_total", "Full relists (bootstrap/410/anti-entropy)"),
                 f"simon_watch_relists_total {self.relists_total}",
-                "# TYPE simon_watch_gone_total counter",
+                *hdr("simon_watch_gone_total", "410 Gone resourceVersion expiries"),
                 f"simon_watch_gone_total {self.gone_total}",
-                "# TYPE simon_twin_drift_total counter",
-                f"simon_twin_drift_total {self.drift_total}",
-                "# TYPE simon_twin_resyncs_total counter",
+                *hdr("simon_twin_drift_total", "Drifted objects repaired, by resource"),
+            ]
+            # stable per-resource series from the first scrape: every
+            # watched resource renders (0 until drift is attributed to it)
+            drift_res = {res: 0 for res in self.watched}
+            drift_res.update(self.drift_by_resource)
+            lines += [
+                f'simon_twin_drift_total{{resource="{esc(res)}"}} {n}'
+                for res, n in sorted(drift_res.items())
+            ]
+            lines += [
+                *hdr("simon_twin_resyncs_total", "Anti-entropy passes that found drift"),
                 f"simon_twin_resyncs_total {self.resyncs_total}",
             ]
         return lines

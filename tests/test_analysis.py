@@ -714,3 +714,45 @@ def test_watch_loop_suppression():
             consume(client.watch("pods"))
     """
     assert _codes(src, rules=["unsupervised-watch-loop"]) == []
+
+
+# ---------------------------------------------------------------------------
+# OSL901 reason-literal
+# ---------------------------------------------------------------------------
+
+def test_reason_literal_flags_inline_strings():
+    src = """
+    def decode(pod, node):
+        ups = []
+        ups.append(UnscheduledPod(pod, "no nodes matched"))
+        ups.append(UnscheduledPod(pod, f'node "{node}" not found'))
+        ups.append(UnscheduledPod(pod, reason="0/%d nodes" % 3))
+        ups.append(UnscheduledPod(pod, "node {} gone".format(node)))
+        return ups
+    """
+    assert _codes(src, rules=["reason-literal"]) == ["OSL901"] * 4
+
+
+def test_reason_literal_accepts_registry_helpers_and_variables():
+    src = """
+    from opensim_tpu.engine import reasons
+
+    def decode(pod, node, msg, custom):
+        ups = [
+            UnscheduledPod(pod, reasons.node_not_found(node)),
+            UnscheduledPod(pod, reasons.preempted("ns", "hi")),
+            UnscheduledPod(pod, reasons.render_unschedulable(4, [])),
+            UnscheduledPod(pod, msg),
+            UnscheduledPod(pod, custom[3]),
+        ]
+        return ups
+    """
+    assert _codes(src, rules=["reason-literal"]) == []
+
+
+def test_reason_literal_repo_is_clean():
+    import os
+
+    root = os.path.join(os.path.dirname(__file__), "..", "opensim_tpu")
+    findings = [f for f in lint_paths([root]) if f.code == "OSL901"]
+    assert findings == [], [f"{f.path}:{f.line}" for f in findings]

@@ -6,6 +6,7 @@ fault injection the recorded span tree marks the failing phase with error
 status and carries demotion spans matching ``EngineDecision.skipped``."""
 
 import json
+import re
 import logging
 import threading
 import urllib.error
@@ -111,7 +112,7 @@ def test_engine_decision_stamped_with_request_id(monkeypatch):
 
     captured = []
     orig = rest._response
-    monkeypatch.setattr(rest, "_response", lambda r: (captured.append(r), orig(r))[1])
+    monkeypatch.setattr(rest, "_response", lambda r, **kw: (captured.append(r), orig(r, **kw))[1])
     server = rest.SimonServer(base_cluster=_cluster())
     code, _ = server.deploy_apps(_payload(), request_id="my-req-7")
     assert code == 200
@@ -318,7 +319,7 @@ def test_engine_compile_fault_demotion_spans_match_engine_decision(monkeypatch):
 
     captured = []
     orig = rest._response
-    monkeypatch.setattr(rest, "_response", lambda r: (captured.append(r), orig(r))[1])
+    monkeypatch.setattr(rest, "_response", lambda r, **kw: (captured.append(r), orig(r, **kw))[1])
     server = rest.SimonServer(base_cluster=_cluster())
     faults.inject("engine.compile", 1, "runtime")
     code, _ = server.deploy_apps(_payload())
@@ -555,3 +556,118 @@ def test_busy_rejection_lands_in_request_histogram():
     assert code == 503 and "busy" in body["error"]
     text = rest.METRICS.render()
     assert 'simon_request_seconds_count{endpoint="deploy-apps",status="busy"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# metrics-exposition conformance (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s(-?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?|Inf)|NaN|[+-]Inf)$"
+)
+_LABEL_RE = re.compile(r'^([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"$')
+
+
+def _split_labels(body: str):
+    """Split the inside of {...} into label assignments (quotes-aware)."""
+    out, cur, depth, in_q, esc = [], "", 0, False, False
+    for ch in body:
+        if esc:
+            cur += ch
+            esc = False
+            continue
+        if ch == "\\":
+            cur += ch
+            esc = True
+            continue
+        if ch == '"':
+            in_q = not in_q
+            cur += ch
+            continue
+        if ch == "," and not in_q:
+            out.append(cur)
+            cur = ""
+            continue
+        cur += ch
+    if cur:
+        out.append(cur)
+    return out
+
+
+def test_metrics_exposition_conformance():
+    """Every series in /metrics has # HELP/# TYPE, names and labels match
+    the Prometheus grammar, and no series is emitted twice — regression-
+    proofing the growing registry."""
+    from opensim_tpu.server import rest
+
+    server = rest.SimonServer(base_cluster=_cluster())
+    # traffic covering success + unschedulable so the decision counters,
+    # request histograms, and per-endpoint series all render
+    code, _ = server.deploy_apps(_payload())
+    assert code == 200
+    bad = {"deployments": [fx.make_fake_deployment("nope", 1, "640", "1Gi").raw]}
+    code, _ = server.deploy_apps(bad)
+    assert code == 200
+    text = rest.METRICS.render(prep_cache=server.prep_cache)
+    helped, typed, seen_series = set(), {}, set()
+    families_with_samples = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert name not in helped, f"duplicate HELP for {name}"
+            helped.add(name)
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            assert name not in typed, f"duplicate TYPE for {name}"
+            assert kind in ("counter", "gauge", "histogram", "summary"), line
+            typed[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"sample line fails the exposition grammar: {line!r}"
+        name, _, labels_body, _value = m.groups()
+        series_key = (name, labels_body or "")
+        assert series_key not in seen_series, f"duplicate series: {line!r}"
+        seen_series.add(series_key)
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base and typed.get(base) == "histogram":
+                family = base
+        families_with_samples.add(family)
+        assert family in typed, f"sample {name!r} has no # TYPE header"
+        assert family in helped, f"sample {name!r} has no # HELP header"
+        for part in _split_labels(labels_body or ""):
+            assert _LABEL_RE.match(part), f"bad label in {line!r}: {part!r}"
+    # the families this PR added are present and populated
+    for required in (
+        "simon_filter_reject_total",
+        "simon_unschedulable_total",
+        "simon_request_seconds",
+    ):
+        assert required in families_with_samples, f"{required} missing from /metrics"
+
+
+def test_watch_metrics_lines_conform(tmp_path):
+    """The live twin's labeled counters join the same conformance contract
+    (resource-labeled events and drift series)."""
+    from opensim_tpu.server.watch import WatchSupervisor
+
+    sup = WatchSupervisor.__new__(WatchSupervisor)
+    sup.watched = ("pods", "nodes")
+    sup.events_total = {("ADDED", "pods"): 3, ("BOOKMARK", "nodes"): 1}
+    sup.reconnects_total = sup.relists_total = sup.gone_total = 0
+    sup.drift_total = 2
+    sup.drift_by_resource = {"pods": 2}
+    sup.resyncs_total = 1
+    sup._state = "live"
+    sup._state_lock = threading.Lock()
+    lines = sup.metrics_lines()
+    text = "\n".join(lines)
+    assert 'simon_watch_events_total{kind="ADDED",resource="pods"} 3' in text
+    assert 'simon_twin_drift_total{resource="pods"} 2' in text
+    assert 'simon_twin_drift_total{resource="nodes"} 0' in text
+    assert "# HELP simon_twin_drift_total" in text

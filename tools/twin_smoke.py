@@ -186,7 +186,7 @@ def main() -> int:
             'simon_watch_state{state="live"} 1',
             "simon_watch_events_total",
             "simon_watch_reconnects_total",
-            f"simon_twin_drift_total {sup.drift_total}",
+            "simon_twin_drift_total{resource=",
             'simon_faults_injected_total{point="watch.disconnect"} 1',
             'simon_faults_injected_total{point="watch.gone"} 1',
             'simon_faults_injected_total{point="watch.drop_event"} 1',

@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke
+.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke
 
 all: test
 
@@ -55,8 +55,15 @@ twin-smoke:
 explain-smoke:
 	python tools/explain_smoke.py
 
-# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain
-verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke
+# serving-core gate (ISSUE 8, docs/serving.md): closed-loop loadgen against
+# two live stub-backed servers — the admission-queue server must sustain
+# more QPS than the single-flight baseline with a non-empty batch-size
+# histogram and bounded p99 (the full ≥4x number: bench.py --config serving)
+loadgen-smoke:
+	python tools/loadgen_smoke.py
+
+# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain + loadgen
+verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke
 
 # run the moment the TPU tunnel opens (tools/tpu_probe_loop.sh writes
 # /tmp/opensim-tpu-watch.up): compiled-Mosaic parity suite + full bench

@@ -280,6 +280,44 @@ def bench_reference_example(config_path: str, extended: str, warmup: bool, label
     return 0
 
 
+def bench_serving(concurrency: int, duration_s: float) -> int:
+    """ISSUE 8 acceptance run: the closed loop against two live stub-backed
+    twin servers (single-flight vs admission queue + request-axis
+    batching), BOTH numbers in the one JSON line. The bar is qps ≥ 4×
+    qps_single_flight at bounded p99 on the same box."""
+    from opensim_tpu.server.loadgen import run_stub_benchmark
+
+    _stage("serving")
+    report = run_stub_benchmark(
+        concurrency=concurrency, duration_s=duration_s, base_port=18980
+    )
+    record = {
+        "metric": (
+            f"serving closed loop ({concurrency} clients, "
+            f"{duration_s:.0f}s, stub-apiserver twin)"
+        ),
+        "value": report["qps"],
+        "unit": "req/s",
+        "config": "serving",
+        # the acceptance pair: batched QPS vs the seed's single-flight
+        "qps_single_flight": report["qps_single_flight"],
+        "vs_single_flight": report["speedup"],
+        "p50_s": report["p50_s"],
+        "p99_s": report["p99_s"],
+        "p99_single_flight_s": report["p99_single_flight_s"],
+        "batches": report["batches"],
+        "mean_batch_size": report["mean_batch_size"],
+        "shed": report["shed"],
+        "shed_single_flight": report["shed_single_flight"],
+        "errors": report["admission"]["errors"],
+        "queue_wait_p99_s": report["admission"]["queue_wait_p99_s"],
+    }
+    if BACKEND_NOTE:
+        record["backend_note"] = BACKEND_NOTE
+    print(json.dumps(record))
+    return 0
+
+
 def bench_steady(n_pods: int, n_nodes: int, repeats: int) -> int:
     """Steady-state re-simulation: N repeated simulates against ONE cluster
     through the encode cache (opensim_tpu/engine/prepcache.py). The metric
@@ -348,16 +386,20 @@ def main() -> int:
     ap.add_argument(
         "--config",
         default="plan",
-        choices=["plan", "defrag", "affinity", "example", "gpushare", "bigu", "forced", "steady"],
+        choices=["plan", "defrag", "affinity", "example", "gpushare", "bigu", "forced", "steady", "serving"],
         help=(
             "plan = capacity-plan wall-clock (headline); defrag = drain-scenario "
             "sweep; affinity = interpod+spread heavy; example/gpushare = the "
             "shipped example simon configs; bigu = 1000 distinct templates "
             "(big-U megakernel mode); forced = live-cluster replay (90%% "
             "pre-bound pods); steady = repeated re-simulation of one cluster "
-            "through the encode cache (host-side prepare trajectory)"
+            "through the encode cache (host-side prepare trajectory); serving "
+            "= closed-loop QPS of the live server, admission-batched vs "
+            "single-flight (docs/serving.md)"
         ),
     )
+    ap.add_argument("--concurrency", type=int, default=48, help="serving: closed-loop clients")
+    ap.add_argument("--duration", type=float, default=10.0, help="serving: measured seconds per mode")
     ap.add_argument("--scenarios", type=int, default=1000, help="defrag: number of drain scenarios")
     ap.add_argument("--repeats", type=int, default=10, help="steady: number of warm re-simulations")
     ap.add_argument(
@@ -386,6 +428,8 @@ def main() -> int:
     _stage("measure")
 
     repo = os.path.dirname(os.path.abspath(__file__))
+    if args.config == "serving":
+        return bench_serving(args.concurrency, args.duration)
     if args.config == "steady":
         return bench_steady(args.pods, args.nodes, args.repeats)
     if args.config == "defrag":

@@ -16,6 +16,8 @@ Rules (short name = suppression id; see docs/static-analysis.md):
                               bypassing resilience.retry
     OSL901 reason-literal     inline unschedulable-reason string bypassing
                               the reason-code registry (engine/reasons.py)
+    OSL1001 admission-lock-io blocking I/O while holding the admission/
+                              dispatch lock (server/admission.py)
 """
 
 from .core import (  # noqa: F401
@@ -32,6 +34,7 @@ from .core import (  # noqa: F401
 
 # importing the rule modules registers them
 from . import (  # noqa: F401,E402
+    rules_admission,
     rules_cache,
     rules_determinism,
     rules_dtype,

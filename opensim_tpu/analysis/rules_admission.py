@@ -1,0 +1,130 @@
+"""admission-lock-io (OSL1001): blocking I/O while holding the
+admission/dispatch lock.
+
+The admission queue's liveness contract (``server/admission.py``) is that
+its condition lock only ever guards queue mutations — O(1) pointer work.
+Any *blocking* operation inside that critical section (a window sleep, a
+socket read, a future/event wait, subprocess or file I/O) would stall every
+concurrent ``submit()``: admission latency becomes the blocked operation's
+latency, and the bounded queue turns into an unbounded convoy of HTTP
+handler threads parked on the lock. The dispatcher's coalescing window
+sleep famously belongs *outside* the lock — this rule keeps it (and every
+future refactor) honest.
+
+Flagged inside any ``with`` block whose context expression mentions a
+lock/condition attribute (a name ending in ``lock`` or ``cond``) in the
+admission/dispatch modules:
+
+- ``time.sleep`` / bare ``sleep``
+- ``.wait`` / ``.wait_for`` / ``.join`` / ``.result`` (event, future,
+  thread joins — blocking until *someone else* makes progress, the convoy
+  maker)
+- socket/HTTP I/O (``urlopen``, ``.recv``, ``.accept``, ``.connect``,
+  ``select.select``)
+- ``subprocess`` calls and ``open``
+
+``notify``/``notify_all`` and plain queue mutations stay legal, as do
+waits on the condition variable itself *when the with-block is the
+canonical ``while …: cond.wait()`` consumer loop* — a condition wait
+releases the lock while blocked, so it cannot convoy. The rule recognizes
+that one pattern (``<name>.wait()`` where ``<name>`` appears in the
+``with`` expression) and flags every other wait.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from .core import FileContext, Finding, Rule, dotted_name, register
+
+_BLOCKING_LEAVES = {
+    "sleep", "wait_for", "join", "result", "recv", "recv_into", "accept",
+    "connect", "urlopen", "select", "check_call", "check_output", "run",
+    "communicate",
+}
+# `.wait` handled separately: waiting on the held condition itself releases
+# the lock (the canonical consumer loop) and is exempt
+_WAIT_LEAVES = {"wait"}
+_BLOCKING_ROOTS = {"subprocess"}
+
+
+def _lock_names(with_node: ast.With) -> Set[str]:
+    """Names appearing in the with-items' context expressions, used both to
+    decide the rule applies (mentions a lock/cond) and to exempt waits on
+    the condition object itself."""
+    names: Set[str] = set()
+    for item in with_node.items:
+        for n in ast.walk(item.context_expr):
+            if isinstance(n, ast.Attribute):
+                names.add(n.attr)
+            elif isinstance(n, ast.Name):
+                names.add(n.id)
+    return names
+
+
+def _is_lock_with(with_node: ast.With) -> bool:
+    return any(
+        n.lower().endswith(("lock", "cond", "condition"))
+        for n in _lock_names(with_node)
+    )
+
+
+def _call_target(node: ast.Call) -> str:
+    name = dotted_name(node.func)
+    if name:
+        return name
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return ""
+
+
+def _body_walk(with_node: ast.With) -> Iterable[ast.AST]:
+    for stmt in with_node.body:
+        yield from ast.walk(stmt)
+
+
+@register
+class AdmissionLockIoRule(Rule):
+    name = "admission-lock-io"
+    code = "OSL1001"
+    description = "blocking I/O while holding the admission/dispatch lock"
+    paths = ("server/admission", "server/pool", "server/rest")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for with_node in ast.walk(ctx.tree):
+            if not isinstance(with_node, ast.With) or not _is_lock_with(with_node):
+                continue
+            held = _lock_names(with_node)
+            for node in _body_walk(with_node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = _call_target(node)
+                leaf = target.rsplit(".", 1)[-1]
+                root = target.split(".", 1)[0]
+                blocking = (
+                    leaf in _BLOCKING_LEAVES
+                    or root in _BLOCKING_ROOTS
+                    or (target == "open" and not _is_os_open(node))
+                )
+                if leaf in _WAIT_LEAVES:
+                    # cond.wait() on the HELD condition releases the lock
+                    # while blocked — the one legal wait
+                    owner = target.rsplit(".", 2)
+                    owner_name = owner[-2] if len(owner) >= 2 else ""
+                    blocking = owner_name not in held
+                if blocking:
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"blocking call `{target}` while holding the "
+                        "admission/dispatch lock; move the wait/sleep/I-O "
+                        "outside the critical section "
+                        "(server/admission.py locking discipline)",
+                    )
+
+
+def _is_os_open(node: ast.Call) -> bool:
+    # os.open (fd-level, nonblocking flags possible) is not the flagged
+    # buffered-file `open`
+    return dotted_name(node.func) == "os.open"

@@ -143,6 +143,33 @@ def build_parser() -> argparse.ArgumentParser:
         "endpoint, status, duration) — same as OPENSIM_ACCESS_LOG=1",
     )
 
+    loadgen_p = sub.add_parser(
+        "loadgen",
+        help="drive a live simon server at load and report QPS + latency",
+        description=(
+            "open/closed-loop load harness for the serving core "
+            "(docs/serving.md): drive the live server's /api/deploy-apps at a "
+            "target concurrency (closed loop) or arrival rate (open loop) and "
+            "report sustained QPS with p50/p99 latency read straight from the "
+            "server's simon_request_seconds_bucket histogram, plus batching "
+            "and shed statistics. Prints one JSON report"
+        ),
+    )
+    loadgen_p.add_argument("--url", required=True, help="base URL of the live server (http://host:port)")
+    loadgen_p.add_argument(
+        "--mode", default="closed", choices=["closed", "open"],
+        help="closed = each worker waits for its response (sustained-QPS "
+        "measurement); open = fire at --qps regardless of completions",
+    )
+    loadgen_p.add_argument("--concurrency", type=int, default=8, help="closed-loop workers / open-loop in-flight cap")
+    loadgen_p.add_argument("--qps", type=float, default=0.0, help="open loop: target arrival rate")
+    loadgen_p.add_argument("--duration", type=float, default=10.0, help="measured seconds")
+    loadgen_p.add_argument("--replicas", type=int, default=3, help="max replicas per generated deployment")
+    loadgen_p.add_argument("--cpu", default="500m", help="per-pod cpu request of the generated workload")
+    loadgen_p.add_argument("--mem", default="1Gi", help="per-pod memory request of the generated workload")
+    loadgen_p.add_argument("--timeout", type=float, default=60.0, help="per-request client timeout seconds")
+    loadgen_p.add_argument("-o", "--output-file", default="", help="also write the JSON report to a file")
+
     sub.add_parser("version", help="print version", description="print version and commit id")
 
     doc_p = sub.add_parser(
@@ -284,6 +311,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             kubeconfig=args.kubeconfig, master=args.master, port=args.port,
             watch=args.watch,
         )
+    if args.command == "loadgen":
+        import json as _json
+
+        from ..server.loadgen import run_loadgen
+
+        try:
+            report = run_loadgen(
+                args.url.rstrip("/"), mode=args.mode, concurrency=args.concurrency,
+                qps=args.qps, duration_s=args.duration, replicas=args.replicas,
+                cpu=args.cpu, mem=args.mem, timeout_s=args.timeout,
+            )
+        except (OSError, ValueError) as e:
+            print(f"simon loadgen: {e}", file=sys.stderr)
+            return 1
+        line = _json.dumps(report, sort_keys=True)
+        print(line)
+        if args.output_file:
+            with open(args.output_file, "w") as f:
+                f.write(line + "\n")
+        return 0
     if args.command == "gen-doc":
         return gen_doc(parser, args.output_dir)
     parser.print_help()

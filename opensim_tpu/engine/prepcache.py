@@ -473,16 +473,44 @@ def derive_with_apps(
     base was prepared from the same cluster with no apps. `base_entry`
     (when `base` is its prep) enables device-tensor reuse for unchanged
     leaves. Returns None when the result would be empty."""
+    got = derive_with_app_slices(
+        base, cluster, apps, use_greed=use_greed, base_entry=base_entry
+    )
+    return None if got is None else got[0]
+
+
+def derive_with_app_slices(
+    base: Prepared,
+    cluster: ResourceTypes,
+    apps: List[AppResource],
+    use_greed: bool = False,
+    base_entry: Optional[CacheEntry] = None,
+) -> Optional[Tuple[Prepared, List[Tuple[int, int]]]]:
+    """:func:`derive_with_apps` that also reports per-app stream slices.
+
+    Returns ``(prep, slices)`` where ``slices[k] = (lo, hi)`` is the
+    half-open index range app ``k``'s expanded pods occupy in
+    ``prep.ordered``. This is the share-safe handoff the request-axis
+    batcher (``engine/reqbatch.py``) builds on: N requests' apps are
+    appended onto ONE fork of the cached base arenas, and each request's
+    scenario mask enables exactly the base region plus its own slice —
+    masked foreign pods never touch engine state, so per-request
+    placements are bit-identical to a solo ``derive_with_apps`` of that
+    app alone (gated by tests/test_admission.py)."""
     if isinstance(base, CacheEntry):  # convenience: entry accepted directly
         base_entry, base = base, base.prep
     t0 = time.monotonic()
     enc = base.encoder.fork()
     new_pods: List = []
     forced_new: List[bool] = []
+    slices: List[Tuple[int, int]] = []
+    n_base = len(base.ordered)
     for app in apps:
+        lo = n_base + len(new_pods)
         for p in _expand_app(cluster, app, use_greed):
             new_pods.append(p)
             forced_new.append(bool(p.spec.node_name))
+        slices.append((lo, n_base + len(new_pods)))
     if not new_pods and not base.ordered:
         return None
     tmpl_new = [
@@ -500,7 +528,7 @@ def derive_with_apps(
         ds_group_sizes=list(base.ds_group_sizes or []),
     )
     PREP_STATS.record("delta_apps", time.monotonic() - t0)
-    return prep
+    return prep, slices
 
 
 def extend_with_nodes(

@@ -1,0 +1,253 @@
+"""Request-axis batched simulation — the engine half of the concurrent
+serving core (ISSUE 8).
+
+The per-scenario sweep machinery (``parallel/scenarios.py``,
+``fastpath.sweep``) proved the shape: S what-ifs over one ``Prepared``
+differ only in boolean masks, so the whole batch is one vmapped dispatch.
+This module lifts that batching from the *scenario* axis to the *request*
+axis: N compatible REST simulate requests, folded onto one shared warm
+prep (``prepcache.derive_with_app_slices`` appends every request's app
+onto ONE fork of the cached base arenas), run as a single batched schedule
+where request ``s``'s mask enables the base cluster region plus its own
+app slice. Foreign pods are mask-invalid and never touch engine state, so
+each demultiplexed result is bit-identical to running that request alone —
+the same mask-flip argument ``drop_pods`` and the scenario sweeps rest on,
+and gated end-to-end by ``tests/test_admission.py``.
+
+Engine routing mirrors ``scenarios.sweep_auto``: the default is the
+vmapped XLA scan (one compiled dispatch for the whole batch, request axis
+prepended by ``jax.vmap``); ``OPENSIM_BATCH_ENGINE=native`` routes through
+sequential C++ scans instead (accelerator-less hosts that want zero XLA
+compiles), and ``auto`` picks native only when the vmapped scan cannot run
+the stream. Either way the decode demultiplexes through the same
+``simulator.finish_decode`` tail the solo path uses, restoring bind state
+between requests so shared pod objects never leak one request's binds into
+another's report.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+import jax
+import numpy as np
+
+from ..models.objects import ResourceTypes
+from ..obs import trace as obs
+from .scheduler import ScheduleOutput, pad_pod_stream, scan_unroll, schedule_pods
+from .simulator import (
+    AppResource,
+    EngineDecision,
+    Prepared,
+    SimulateResult,
+    finish_decode,
+    restore_bind_state,
+    snapshot_bind_state,
+)
+
+__all__ = ["BatchItem", "run_request_batch", "batch_engine_mode"]
+
+# request-axis pad buckets: the batch size participates in the jit
+# signature, so S is padded up to a small fixed set of shapes (padded
+# scenarios are all-invalid and never bind) — the same reasoning as
+# pad_pod_stream's 256-pod buckets
+_S_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+@dataclass
+class BatchItem:
+    """One request's view of the shared batch stream."""
+
+    cluster: ResourceTypes  # the cluster this request simulates against
+    apps: List[AppResource]  # its own apps (already appended to the stream)
+    lo: int  # its app slice in prep.ordered (half-open)
+    hi: int
+    # report-level drops: scale-removed pods + the twin's event-deleted
+    # pods (CacheEntry.base_drop), as indices over the BATCH stream
+    drops: set = field(default_factory=set)
+    explain: bool = False
+
+
+def batch_engine_mode() -> str:
+    """``OPENSIM_BATCH_ENGINE``: ``auto`` (default) = the vmapped XLA scan,
+    falling back to sequential C++ scans when the stream cannot take the
+    XLA path; ``xla`` / ``native`` force a rung (native still requires the
+    C++ engine to be applicable)."""
+    raw = os.environ.get("OPENSIM_BATCH_ENGINE", "auto").strip().lower() or "auto"
+    if raw not in ("auto", "xla", "native"):
+        raise ValueError(
+            f"OPENSIM_BATCH_ENGINE must be auto|xla|native, got {raw!r}"
+        )
+    return raw
+
+
+@functools.partial(jax.jit, static_argnames=("features", "unroll"))
+def _batched_schedule(ec, st0, tmpl_ids, pod_valid_masks, forced, features, unroll):
+    """ALL requests in ONE compiled dispatch: ``jax.vmap`` over the
+    per-request pod-validity masks prepends a request axis to the scan
+    (shared EncodedCluster/ScanState operands are not duplicated). Module
+    level + jitted so repeat batch shapes hit the jit cache."""
+    return jax.vmap(
+        lambda pv: schedule_pods(
+            ec, st0, tmpl_ids, pv, forced, features=features, unroll=unroll
+        )
+    )(pod_valid_masks)
+
+
+def _pad_batch(pod_valid: np.ndarray) -> np.ndarray:
+    """Pad the request axis up to the next shape bucket with all-invalid
+    rows (they schedule nothing and are sliced off after the dispatch)."""
+    S = pod_valid.shape[0]
+    for b in _S_BUCKETS:
+        if S <= b:
+            pad = b - S
+            break
+    else:
+        pad = (-S) % _S_BUCKETS[-1]
+    if pad == 0:
+        return pod_valid
+    return np.concatenate([pod_valid, np.zeros((pad, pod_valid.shape[1]), bool)])
+
+
+def _request_masks(prep: Prepared, items: List[BatchItem]) -> np.ndarray:
+    """[S, P] bool: request s sees the base region plus its own app slice,
+    minus its report-level drops."""
+    P = len(prep.ordered)
+    n_base = min(i.lo for i in items) if items else P
+    valid = np.zeros((len(items), P), dtype=bool)
+    for s, it in enumerate(items):
+        valid[s, :n_base] = True
+        valid[s, it.lo : it.hi] = True
+        for i in it.drops:
+            valid[s, i] = False
+    return valid
+
+
+def _slice_output(batched: ScheduleOutput, s: int, P: int) -> ScheduleOutput:
+    """Request ``s``'s host-side view of the batched outputs."""
+    fs = batched.final_state
+    state = type(fs)(*[np.asarray(leaf)[s] for leaf in fs])
+    return ScheduleOutput(
+        chosen=np.asarray(batched.chosen)[s, :P],
+        fail_counts=np.asarray(batched.fail_counts)[s, :P],
+        insufficient=np.asarray(batched.insufficient)[s, :P],
+        gpu_take=np.asarray(batched.gpu_take)[s, :P],
+        static_fail=np.asarray(batched.static_fail)[s],
+        final_state=state,
+    )
+
+
+def run_request_batch(
+    prep: Prepared, items: List[BatchItem]
+) -> List[SimulateResult]:
+    """Schedule N requests' shared stream in one batched pass and
+    demultiplex one :class:`SimulateResult` per request.
+
+    The caller (``server/admission.py``) owns the base entry lock and the
+    derived prep; this function only reads ``prep`` and restores the bind
+    state it mutates. Results are bit-identical to solo runs of each
+    request (mask-invalid foreign pods never touch engine state)."""
+    from . import nativepath
+
+    P = len(prep.ordered)
+    pod_valid = _request_masks(prep, items)
+    mode = batch_engine_mode()
+    native_miss = nativepath.why_not(prep, None, ())
+    # auto routing mirrors scenarios.sweep_auto: on an accelerator-less
+    # single-device host — or under --backend native (OPENSIM_NATIVE=1) —
+    # the sequential C++ scans win (ms-scale per request, zero XLA
+    # compiles; the batch's saving is the ONE shared derive + assemble +
+    # upload); with an accelerator the whole batch is one vmapped dispatch
+    use_native = mode == "native" or (
+        mode == "auto"
+        and native_miss is None
+        and (
+            os.environ.get("OPENSIM_NATIVE") == "1"
+            or (len(jax.devices()) == 1 and jax.default_backend() != "tpu")
+        )
+    )
+    if use_native and native_miss is not None:
+        if mode == "native":
+            raise RuntimeError(
+                f"OPENSIM_BATCH_ENGINE=native but the C++ engine cannot run "
+                f"this stream: {native_miss}"
+            )
+        use_native = False
+
+    skips: Dict[str, str] = {
+        "megakernel": "request-axis batches run on the vmapped XLA scan "
+        "(or sequential C++ scans)",
+    }
+    outs: List[ScheduleOutput] = []
+    if use_native:
+        engine_name = "native"
+        skips["xla"] = "OPENSIM_BATCH_ENGINE routed the batch to the C++ engine"
+        with obs.span("engine.native", requests=len(items), pods=P):
+            for s in range(len(items)):
+                outs.append(nativepath.schedule(prep, pod_valid[s]))
+    else:
+        engine_name = "xla"
+        if native_miss is None:
+            skips["native"] = "request-axis batching dispatches ONE vmapped scan"
+        tmpl_p, _pv0, forced_p = pad_pod_stream(
+            prep.tmpl_ids, pod_valid[0], prep.forced
+        )
+        pv_all = np.zeros((pod_valid.shape[0], len(tmpl_p)), dtype=bool)
+        pv_all[:, :P] = pod_valid
+        pv_all = _pad_batch(pv_all)
+        with obs.span("engine.xla", requests=len(items), pods=P):
+            import jax.numpy as jnp
+
+            batched = _batched_schedule(
+                prep.ec, prep.st0, jnp.asarray(tmpl_p), jnp.asarray(pv_all),
+                jnp.asarray(forced_p), prep.features, scan_unroll(),
+            )
+            jax.block_until_ready(batched.chosen)
+        outs = [_slice_output(batched, s, P) for s in range(len(items))]
+
+    sf_rows = prep.tmpl_ids
+    snap = snapshot_bind_state(prep)
+    results: List[SimulateResult] = []
+    with obs.span("decode", pods=P, requests=len(items)):
+        for s, it in enumerate(items):
+            out = outs[s]
+            nstats = getattr(out, "native_stats", None)
+            engine = EngineDecision(
+                name=engine_name,
+                skipped=dict(skips),
+                native_path=nstats["path"] if nstats else None,
+                native_steps=dict(nstats["steps"]) if nstats else None,
+            )
+            try:
+                unsched, statuses = finish_decode(
+                    prep, out, it.cluster,
+                    np.asarray(out.chosen), np.asarray(out.gpu_take),
+                    np.asarray(out.fail_counts), np.asarray(out.insufficient),
+                    np.asarray(out.static_fail), sf_rows,
+                    pod_valid[s], np.asarray(prep.forced, dtype=bool),
+                    {}, {}, set(it.drops) | _foreign(items, s, P),
+                    None, None, None, (), engine, engine_name, it.explain,
+                )
+                results.append(
+                    SimulateResult(
+                        unscheduled_pods=unsched, node_status=statuses, engine=engine
+                    )
+                )
+            finally:
+                # shared pod objects: request s's binds must not leak into
+                # request s+1's decode (or the cached entry's pristine state)
+                restore_bind_state(prep, snap)
+    return results
+
+
+def _foreign(items: List[BatchItem], s: int, P: int) -> set:
+    """Indices of OTHER requests' app pods — excluded from request s's
+    report exactly as if they had never been in the input."""
+    out: set = set()
+    for k, it in enumerate(items):
+        if k != s:
+            out.update(range(it.lo, it.hi))
+    return out

@@ -317,6 +317,10 @@ class Prepared:
     n_cluster: int = 0
     n_bare: int = 0
     ds_group_sizes: Optional[List[int]] = None
+    # request-axis batching (engine/reqbatch.py): the half-open stream
+    # slice each app's expanded pods occupy, in `apps` order — lets the
+    # admission batcher mask per-request regions without re-deriving
+    app_slices: Optional[List[Tuple[int, int]]] = None
 
 
 def pinned_node_name(pod: Pod) -> str:
@@ -367,7 +371,9 @@ def _prepare_inner(cluster, apps, use_greed, node_pad, patch_pods_fn):
         forced.append(bool(p.spec.node_name))
     n_cluster = len(ordered)  # pods below went through patch_pods_fn
 
+    app_slices: List[Tuple[int, int]] = []
     for app in apps:
+        lo = len(ordered)
         app_pods = expand.generate_pods_from_resources(app.resources, cluster.nodes)
         for p in app_pods:
             p.metadata.labels.setdefault(LABEL_APP_NAME, app.name)
@@ -380,6 +386,7 @@ def _prepare_inner(cluster, apps, use_greed, node_pad, patch_pods_fn):
         for p in app_pods:
             ordered.append(p)
             forced.append(bool(p.spec.node_name))
+        app_slices.append((lo, len(ordered)))
 
     if not ordered:
         return None
@@ -437,6 +444,7 @@ def _prepare_inner(cluster, apps, use_greed, node_pad, patch_pods_fn):
         n_cluster=n_cluster,
         n_bare=n_bare,
         ds_group_sizes=ds_group_sizes,
+        app_slices=app_slices,
     )
 
 
@@ -1013,54 +1021,93 @@ def simulate(
                 nv_mask, chosen=chosen, exclude=frozenset(victims_of),
             )
 
-        from ..utils.gcpause import gc_paused
-
-        node_pods: Dict[str, List[Pod]] = {n.metadata.name: [] for n in cluster.nodes}
-        unscheduled: List[UnscheduledPod] = []
-        n_nodes = int(nv_mask.sum()) if nv_mask is not None else meta.n_real_nodes
-        node_names = meta.node_names
-        # masked runs: candidate nodes beyond the valid prefix have no report
-        # bucket (chosen never points at an invalid node)
-        pod_lists = [node_pods.get(n) for n in node_names]
-        gpu_any = gpu_take.sum(axis=1) > 0  # one vectorized pass, not per-pod sums
-
-        with gc_paused():
-            statuses = _decode(
-                ordered, chosen, forced, custom_reasons, victims_of, gpu_any, gpu_take,
-                sf_rows, static_fail, fail_counts, insufficient, meta, n_nodes,
-                node_names, pod_lists, node_pods, unscheduled, cluster, out, drops,
-            )
-        _record_decision_metrics(
-            chosen, pod_valid, forced, custom_reasons, victims_of, drops,
-            static_fail, sf_rows, fail_counts,
+        unscheduled, statuses = finish_decode(
+            prep, out, cluster, chosen, gpu_take, fail_counts, insufficient,
+            static_fail, sf_rows, pod_valid, forced, custom_reasons,
+            victims_of, drops, nv_mask, sched_config, segments, extra_plugins,
+            engine, engine_name, explain,
         )
-        if explain:
-            from . import explain as explain_mod
-
-            ctx = explain_mod.ExplainContext(
-                prep=prep, chosen=chosen, gpu_take=gpu_take,
-                static_fail=static_fail, sf_rows=np.asarray(sf_rows),
-                fail_counts=fail_counts, insufficient=insufficient,
-                n_nodes=n_nodes, node_names=node_names,
-                resource_names=meta.resource_names, config=sched_config,
-                segments=segments, extra_plugins=extra_plugins,
-                engine=engine_name, node_valid=nv_mask,
-            )
-            engine.explain_ctx = ctx
-            engine.explanations = explain_mod.build_explanations(
-                ctx, custom_reasons, victims_of, drops
-            )
-            # per-filter reject totals across ALL audited steps: the C++
-            # engine accumulated them in-engine (ScanArgs.filter_rejects,
-            # abi v4); the XLA/segmented paths derive the identical vector
-            # from the count_all per-pod rows
-            rejects_vec = getattr(out, "filter_rejects", None)
-            if rejects_vec is None:
-                rejects_vec = explain_mod.audit_rejects(
-                    static_fail, sf_rows, fail_counts, pod_valid & ~forced
-                )
-            engine.filter_rejects = reasons.rejects_dict(rejects_vec)
     return SimulateResult(unscheduled_pods=unscheduled, node_status=statuses, engine=engine)
+
+
+def finish_decode(
+    prep: "Prepared",
+    out,
+    cluster: ResourceTypes,
+    chosen: np.ndarray,
+    gpu_take: np.ndarray,
+    fail_counts: np.ndarray,
+    insufficient: np.ndarray,
+    static_fail: np.ndarray,
+    sf_rows: np.ndarray,
+    pod_valid: np.ndarray,
+    forced: np.ndarray,
+    custom_reasons: Dict[int, str],
+    victims_of: Dict[int, int],
+    drops: set,
+    nv_mask: Optional[np.ndarray],
+    sched_config,
+    segments,
+    extra_plugins: tuple,
+    engine: EngineDecision,
+    engine_name: str,
+    explain: bool,
+) -> Tuple[List[UnscheduledPod], List[NodeStatus]]:
+    """The host-side decode tail shared by :func:`simulate` and the
+    request-axis batch entry (``engine/reqbatch.py``): bind pods into node
+    buckets, render unschedulable reasons, write node usage annotations,
+    bump the always-on decision metrics, and attach the explain audit.
+    All array arguments are host numpy, already trimmed to
+    ``len(prep.ordered)``."""
+    from ..utils.gcpause import gc_paused
+
+    meta, ordered = prep.meta, prep.ordered
+    node_pods: Dict[str, List[Pod]] = {n.metadata.name: [] for n in cluster.nodes}
+    unscheduled: List[UnscheduledPod] = []
+    n_nodes = int(nv_mask.sum()) if nv_mask is not None else meta.n_real_nodes
+    node_names = meta.node_names
+    # masked runs: candidate nodes beyond the valid prefix have no report
+    # bucket (chosen never points at an invalid node)
+    pod_lists = [node_pods.get(n) for n in node_names]
+    gpu_any = gpu_take.sum(axis=1) > 0  # one vectorized pass, not per-pod sums
+
+    with gc_paused():
+        statuses = _decode(
+            ordered, chosen, forced, custom_reasons, victims_of, gpu_any, gpu_take,
+            sf_rows, static_fail, fail_counts, insufficient, meta, n_nodes,
+            node_names, pod_lists, node_pods, unscheduled, cluster, out, drops,
+        )
+    _record_decision_metrics(
+        chosen, pod_valid, forced, custom_reasons, victims_of, drops,
+        static_fail, sf_rows, fail_counts,
+    )
+    if explain:
+        from . import explain as explain_mod
+
+        ctx = explain_mod.ExplainContext(
+            prep=prep, chosen=chosen, gpu_take=gpu_take,
+            static_fail=static_fail, sf_rows=np.asarray(sf_rows),
+            fail_counts=fail_counts, insufficient=insufficient,
+            n_nodes=n_nodes, node_names=node_names,
+            resource_names=meta.resource_names, config=sched_config,
+            segments=segments, extra_plugins=extra_plugins,
+            engine=engine_name, node_valid=nv_mask,
+        )
+        engine.explain_ctx = ctx
+        engine.explanations = explain_mod.build_explanations(
+            ctx, custom_reasons, victims_of, drops
+        )
+        # per-filter reject totals across ALL audited steps: the C++
+        # engine accumulated them in-engine (ScanArgs.filter_rejects,
+        # abi v4); the XLA/segmented paths derive the identical vector
+        # from the count_all per-pod rows
+        rejects_vec = getattr(out, "filter_rejects", None)
+        if rejects_vec is None:
+            rejects_vec = explain_mod.audit_rejects(
+                static_fail, sf_rows, fail_counts, pod_valid & ~forced
+            )
+        engine.filter_rejects = reasons.rejects_dict(rejects_vec)
+    return unscheduled, statuses
 
 
 def _record_decision_metrics(

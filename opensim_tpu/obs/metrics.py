@@ -144,14 +144,17 @@ class HistogramVec:
                 for k, v in zip(self.label_names, labels)
             )
             sep = "," if base else ""
+            # label-less histograms (e.g. simon_batch_size) must not render
+            # empty `{}` braces — the exposition grammar rejects them
+            wrap = f"{{{base}}}" if base else ""
             cum = 0
             for i, bound in enumerate(self.buckets):
                 cum += series[i]
                 lines.append(
                     f'{self.name}_bucket{{{base}{sep}le="{_fmt_le(bound)}"}} {cum}'
                 )
-            lines.append(f"{self.name}_sum{{{base}}} {series[-1]:.6f}")
-            lines.append(f"{self.name}_count{{{base}}} {series[-2]}")
+            lines.append(f"{self.name}_sum{wrap} {series[-1]:.6f}")
+            lines.append(f"{self.name}_count{wrap} {series[-2]}")
         return lines
 
     def reset(self) -> None:

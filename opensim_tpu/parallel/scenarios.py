@@ -99,20 +99,30 @@ def sweep_auto(
     if forced_masks is None:
         forced_masks = np.broadcast_to(prep.forced, (S, len(prep.forced)))
     if config is not None:
-        # multi-profile config: same routing as simulate() — one effective
-        # config; unknown-profile pods are masked out of every scenario
-        # (they can never schedule, so capacity sweeps must not count them)
-        from ..engine.schedconfig import DEFAULT_CONFIG, resolve_profiles
+        # multi-profile config: same routing as simulate() — unknown-profile
+        # pods are masked out of every scenario (they can never schedule, so
+        # capacity sweeps must not count them). DIFFERING profiles used to
+        # raise here (the NOTES.md rough edge); they now route through
+        # per-segment scans sharing the scheduling carry (ISSUE 8
+        # satellite), exactly like simulate()'s segmented path — so the
+        # request-axis batcher and the planner can sweep mixed-profile
+        # streams.
+        from ..engine.schedconfig import DEFAULT_CONFIG, resolve_profile_segments
 
-        config, invalid = resolve_profiles(
+        segs, invalid = resolve_profile_segments(
             config, prep.ordered, prep.meta.resource_names, forced=prep.forced
         )
         if invalid:
             pod_valid_masks = np.array(pod_valid_masks, copy=True)
             for i in invalid:
                 pod_valid_masks[:, i] = False
-        if config == DEFAULT_CONFIG:
-            config = None
+        distinct = {c for c, _, _ in segs if c is not None and c != DEFAULT_CONFIG}
+        if len(segs) > 1 and distinct:
+            return sweep_segmented(
+                prep, segs, node_valid_masks, pod_valid_masks,
+                np.asarray(forced_masks, dtype=bool),
+            )
+        config = distinct.pop() if distinct else None
     from ..engine import nativepath
 
     if len(jax.devices()) == 1 and nativepath.applicable(prep, config):
@@ -182,6 +192,113 @@ def sweep_auto(
         features=prep.features,
         forced_masks=np.asarray(forced_masks),
         config=config,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("features", "config", "unroll"))
+def _sweep_segment_impl(
+    ec, st_batch, tmpl_ids, node_valid_masks, pod_valid_masks, forced_masks,
+    features, config=None, unroll=1,
+):
+    """One segment of a segmented sweep: vmap over scenarios with a
+    PER-SCENARIO carry (st_batch has a leading scenario axis — segment k's
+    final states seed segment k+1)."""
+
+    def one(st, nv, pv, fm):
+        out = schedule_pods(
+            ec._replace(node_valid=nv), st, tmpl_ids, pv, fm,
+            features=features, config=config, unroll=unroll,
+        )
+        return out.chosen, out.final_state
+
+    return jax.vmap(one)(st_batch, node_valid_masks, pod_valid_masks, forced_masks)
+
+
+def sweep_segmented(
+    prep,
+    segments,
+    node_valid_masks: np.ndarray,
+    pod_valid_masks: np.ndarray,
+    forced_masks: np.ndarray,
+) -> SweepResult:
+    """Scenario sweep over a MIXED-PROFILE stream: consecutive scans per
+    contiguous same-profile segment, sharing each scenario's scheduling
+    carry — ``simulate()``'s segmented path (``utils.go:304-381``) lifted
+    to the scenario axis. Out-of-segment pods are mask-invalid per scan, so
+    binds happen in exact stream order and placements per scenario equal a
+    solo segmented simulate of that scenario (gated by
+    tests/test_parallel.py). Routing matches ``sweep_auto``: sequential C++
+    scans on accelerator-less hosts (chaining ``st0`` between segments),
+    the vmapped XLA scan with a batched carry otherwise."""
+    from ..engine import nativepath
+    from ..engine.schedconfig import DEFAULT_CONFIG
+
+    S = node_valid_masks.shape[0]
+    P = len(prep.ordered)
+    segments = [
+        (None if c == DEFAULT_CONFIG else c, lo, hi) for c, lo, hi in segments
+    ]
+    chosen = np.full((S, P), -1, dtype=np.int32)
+    use_native = len(jax.devices()) == 1 and all(
+        nativepath.applicable(prep, cfg) for cfg, _, _ in segments
+    )
+    vg0 = np.asarray(prep.st0.vg_free)
+    nv_np = np.asarray(node_valid_masks, dtype=bool)
+    if use_native:
+        used = np.zeros((S,) + np.asarray(prep.st0.used).shape, np.float32)
+        vg_used = np.zeros((S,), np.float32)
+        for s in range(S):
+            st = prep.st0
+            pv_s = np.asarray(pod_valid_masks[s], dtype=bool)
+            for cfg, lo, hi in segments:
+                seg_valid = np.zeros((P,), dtype=bool)
+                seg_valid[lo:hi] = pv_s[lo:hi]
+                out = nativepath.schedule(
+                    prep, seg_valid, config=cfg, node_valid=nv_np[s],
+                    forced=np.asarray(forced_masks[s], bool), st0=st,
+                )
+                chosen[s, lo:hi] = np.asarray(out.chosen)[lo:hi]
+                st = out.final_state
+            used[s] = np.asarray(st.used)
+            vg_used[s] = float(
+                ((vg0 - np.asarray(st.vg_free)) * nv_np[s][:, None]).sum()
+            )
+        unscheduled = (
+            (chosen < 0) & np.asarray(pod_valid_masks, bool)
+        ).sum(axis=1).astype(np.int32)
+        return SweepResult(
+            unscheduled=jnp.asarray(unscheduled), used=jnp.asarray(used),
+            chosen=jnp.asarray(chosen), vg_used=jnp.asarray(vg_used),
+        )
+    # XLA path: batched carry across segments (each segment is one vmapped
+    # dispatch; S scenarios advance in lockstep through the profile chain)
+    st_batch = jax.tree_util.tree_map(
+        lambda a: jnp.broadcast_to(jnp.asarray(a), (S,) + jnp.asarray(a).shape),
+        prep.st0,
+    )
+    nv_dev = jnp.asarray(nv_np)
+    fm_dev = jnp.asarray(np.asarray(forced_masks, dtype=bool))
+    final = None
+    for cfg, lo, hi in segments:
+        seg = np.zeros((S, P), dtype=bool)
+        seg[:, lo:hi] = np.asarray(pod_valid_masks, bool)[:, lo:hi]
+        seg_chosen, st_batch = _sweep_segment_impl(
+            prep.ec, st_batch, jnp.asarray(prep.tmpl_ids), nv_dev,
+            jnp.asarray(seg), fm_dev,
+            features=prep.features, config=cfg, unroll=scan_unroll(),
+        )
+        chosen[:, lo:hi] = np.asarray(seg_chosen)[:, lo:hi]
+        final = st_batch
+    unscheduled = (
+        (chosen < 0) & np.asarray(pod_valid_masks, bool)
+    ).sum(axis=1).astype(np.int32)
+    used = np.asarray(final.used)
+    vg_used = (
+        (vg0[None] - np.asarray(final.vg_free)) * nv_np[:, :, None]
+    ).sum(axis=(1, 2)).astype(np.float32)
+    return SweepResult(
+        unscheduled=jnp.asarray(unscheduled), used=jnp.asarray(used),
+        chosen=jnp.asarray(chosen), vg_used=jnp.asarray(vg_used),
     )
 
 

@@ -1,0 +1,407 @@
+"""Admission queue + dispatcher — the concurrent serving core (ISSUE 8).
+
+The serving path used to be single-flight: one TryLock per endpoint, every
+concurrent request 503ed on the spot (the reference's gin behavior,
+``server.go:167,:234``). This module replaces that with a small queueing
+discipline in front of the engines:
+
+- **admission**: requests enter a bounded queue (``OPENSIM_QUEUE_BOUND``).
+  Past the bound they are *shed* with a typed 503 carrying ``Retry-After``
+  (:class:`QueueFull`) — overload degrades into fast, honest rejections,
+  never unbounded queueing. Shed counts land in
+  ``simon_shed_total{reason=}`` and the rejection latency is the real
+  elapsed time, not a fake 0.0.
+- **coalescing**: the dispatcher waits one short window
+  (``OPENSIM_BATCH_WINDOW_MS``) after the first arrival, then folds every
+  *batchable* queued request (no newnodes, no explain, prep cache on) onto
+  one shared warm prep and runs them as a single request-axis batched
+  schedule (``engine/reqbatch.py``) — concurrency multiplies throughput
+  instead of serializing behind one lock. A lone request takes the solo
+  path (full engine ladder, full span fidelity); batching only engages
+  when there is something to batch.
+- **worker pool**: unbatchable requests run concurrently through the
+  bounded :class:`server.pool.WorkerPool` instead of being rejected.
+- **load-shedding deadlines**: a ticket whose deadline expires *while
+  queued* is shed with a typed 504 naming the ``queue`` phase (and a
+  ``simon_shed_total{reason="deadline"}`` bump). A ticket that was already
+  expired at admission still executes — the first phase boundary raises
+  the classic typed 504 naming snapshot/prepare/..., preserving the
+  resilience layer's contract.
+
+Locking discipline (enforced by opensim-lint OSL1001): nothing blocking —
+no sleeps, no socket/file I/O, no future/event waits — happens while the
+queue condition lock is held. The window sleep, the engine work and the
+result waits all run outside it.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from ..obs.metrics import RECORDER, CounterVec, HistogramVec, exposition_headers
+from ..resilience.deadline import Deadline, DeadlineExceeded
+
+log = logging.getLogger("opensim_tpu.server")
+
+__all__ = [
+    "AdmissionController",
+    "QueueFull",
+    "Ticket",
+    "admission_enabled",
+    "batch_window_s",
+    "queue_bound",
+    "batch_max",
+]
+
+#: batch sizes are small integers; the latency bucket ladder would waste
+#: every bucket past 32 — count buckets instead
+BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
+
+
+def _env_float(name: str, default: float, lo: float = 0.0) -> float:
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return max(lo, float(raw))
+    except ValueError:
+        log.warning("ignoring unparseable %s=%r (using %s)", name, raw, default)
+        return default
+
+
+def admission_enabled() -> bool:
+    """``OPENSIM_ADMISSION``: ``on`` (default) routes requests through the
+    admission queue; ``off`` restores the single-flight TryLock path."""
+    return os.environ.get("OPENSIM_ADMISSION", "on").strip().lower() not in (
+        "off", "0", "false",
+    )
+
+
+def batch_window_s() -> float:
+    return _env_float("OPENSIM_BATCH_WINDOW_MS", 5.0) / 1000.0
+
+
+def queue_bound() -> int:
+    return int(_env_float("OPENSIM_QUEUE_BOUND", 64.0, lo=1.0))
+
+
+def batch_max() -> int:
+    return int(_env_float("OPENSIM_BATCH_MAX", 16.0, lo=1.0))
+
+
+class QueueFull(RuntimeError):
+    """Typed shed: the admission queue is at its bound. ``retry_after_s``
+    is the dispatcher's drain estimate, surfaced as the 503's
+    ``Retry-After`` header."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+@dataclass(eq=False)
+class Ticket:
+    """One queued simulate request and its completion slot."""
+
+    kind: str  # "deploy" | "scale"
+    payload: dict
+    explain: bool = False
+    deadline: Optional[Deadline] = None
+    trace: Optional[object] = None  # the request's TraceContext (or None)
+    request_id: str = ""
+    has_new_nodes: bool = False
+    enqueued: float = field(default_factory=time.monotonic)
+    # completion slot, written exactly once by the executor
+    done: threading.Event = field(default_factory=threading.Event)
+    result: Optional[object] = None  # SimulateResult on success
+    error: Optional[BaseException] = None
+    stale: bool = False  # request_served_stale() observed on the exec thread
+    queue_s: float = 0.0
+    batch_size: int = 0  # 0 = solo path
+
+    def batchable(self) -> bool:
+        # explain requests need the full solo audit plumbing; newnodes get
+        # per-request randomized fake node names (a shared node axis would
+        # replay one request's names into another's response)
+        return not self.has_new_nodes and not self.explain
+
+    def resolve(self, result=None, error: Optional[BaseException] = None,
+                stale: bool = False, batch_size: int = 0) -> None:
+        self.result, self.error, self.stale = result, error, stale
+        self.batch_size = batch_size
+        self.done.set()
+
+    def expired_in_queue(self) -> bool:
+        """Deadline ran out while waiting — but only if it was still alive
+        at admission (a pre-expired deadline keeps the legacy behavior:
+        execute, and let the first phase boundary raise its typed 504)."""
+        return (
+            self.deadline is not None
+            and not self._expired_at_admission
+            and self.deadline.expired()
+        )
+
+    def __post_init__(self) -> None:
+        self._expired_at_admission = (
+            self.deadline is not None and self.deadline.expired()
+        )
+
+
+class AdmissionController:
+    """The queue + dispatcher. ``solo_fn(ticket)`` and
+    ``batch_fn(tickets)`` are provided by the REST layer (they own the
+    snapshot/prep-cache internals); both MUST resolve every ticket they are
+    handed, success or error — an unresolved ticket would hang its client
+    until the wait backstop."""
+
+    def __init__(
+        self,
+        solo_fn: Callable[[Ticket], None],
+        batch_fn: Callable[[List[Ticket]], None],
+        pool=None,
+        window_s: Optional[float] = None,
+        bound: Optional[int] = None,
+        max_batch: Optional[int] = None,
+    ) -> None:
+        from .pool import WorkerPool
+
+        self.solo_fn = solo_fn
+        self.batch_fn = batch_fn
+        self.window_s = batch_window_s() if window_s is None else window_s
+        self.bound = queue_bound() if bound is None else bound
+        self.max_batch = batch_max() if max_batch is None else max_batch
+        self._pool = pool if pool is not None else WorkerPool()
+        self._queue: "collections.deque[Ticket]" = collections.deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        # telemetry (rendered into /metrics via metrics_lines): all
+        # mutations under the ONE recorder lock like every other family
+        self.shed = CounterVec(
+            "simon_shed_total", ("reason",),
+            help="Requests shed at the admission queue by reason",
+        )
+        self.batch_sizes = HistogramVec(
+            "simon_batch_size", (), buckets=BATCH_SIZE_BUCKETS,
+            help="Requests folded into one batched schedule dispatch",
+        )
+        self.queue_wait = HistogramVec(
+            "simon_queue_wait_seconds", (),
+            help="Real time-in-queue from admission to execution start",
+        )
+        self.batches_total = 0
+        self.ewma_service_s = 0.05  # drain-rate estimate for Retry-After
+
+    # -- client side --------------------------------------------------------
+
+    def submit(self, ticket: Ticket) -> Ticket:
+        """Admit (or shed) a ticket; starts the dispatcher on first use."""
+        with self._cond:
+            if self._closed:
+                raise QueueFull("the server is shutting down", retry_after_s=1.0)
+            if len(self._queue) >= self.bound:
+                depth = len(self._queue)
+                retry = max(0.05, depth * self.ewma_service_s / max(1, self.max_batch))
+                with RECORDER.lock:
+                    self.shed.inc(("queue_full",))
+                raise QueueFull(
+                    f"admission queue at bound ({depth}/{self.bound}); "
+                    "try again later",
+                    retry_after_s=retry,
+                )
+            self._queue.append(ticket)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="simon-dispatch", daemon=True
+                )
+                self._thread.start()
+            self._cond.notify()
+        return ticket
+
+    def wait(self, ticket: Ticket) -> Ticket:
+        """Block the REST handler thread until the ticket resolves. The
+        backstop bounds a lost ticket (a dispatcher bug) to a typed error
+        instead of a hung client."""
+        backstop = 600.0
+        if ticket.deadline is not None:
+            backstop = max(1.0, ticket.deadline.remaining() + 30.0)
+        if not ticket.done.wait(timeout=backstop):
+            raise RuntimeError(
+                "admission dispatcher unresponsive "
+                f"(ticket not resolved within {backstop:.0f}s)"
+            )
+        if ticket.error is not None:
+            raise ticket.error
+        return ticket
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def stop(self) -> None:
+        with self._cond:
+            self._closed = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+        for t in pending:
+            t.resolve(error=QueueFull("the server is shutting down"))
+        self._pool.shutdown()
+
+    # -- dispatcher ---------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                if self._closed:
+                    return
+                first_arrival = self._queue[0].enqueued
+            # coalescing window, measured from the FIRST waiter's arrival so
+            # a busy queue drains at window cadence instead of re-arming per
+            # arrival. Outside the lock: admission must never block on it.
+            delay = first_arrival + self.window_s - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            with self._cond:
+                if self._closed:
+                    return
+                drained, kept = [], []
+                while self._queue and len(drained) < self.max_batch:
+                    drained.append(self._queue.popleft())
+                # non-batchable tickets never consume batch slots
+                for t in list(drained):
+                    if not t.batchable():
+                        drained.remove(t)
+                        kept.append(t)
+            self._dispatch(drained, kept)
+
+    def _dispatch(self, batchable: List[Ticket], solos: List[Ticket]) -> None:
+        now = time.monotonic()
+        ready: List[Ticket] = []
+        for t in batchable + solos:
+            t.queue_s = now - t.enqueued
+            if t.expired_in_queue():
+                with RECORDER.lock:
+                    self.shed.inc(("deadline",))
+                    self.queue_wait.observe(t.queue_s, ())
+                t.resolve(
+                    error=DeadlineExceeded(
+                        "request deadline expired while queued "
+                        f"(waited {t.queue_s:.3f}s)",
+                        phase="queue",
+                    )
+                )
+            else:
+                ready.append(t)
+        batchable = [t for t in batchable if t in ready]
+        solos = [t for t in solos if t in ready]
+        # a ticket whose deadline is ALREADY dead (pre-expired at admission
+        # — kept for the legacy phase contract) must not ride a batch: the
+        # batch installs no deadline scope, so only the solo path can raise
+        # its typed 504 at the first phase boundary
+        dead = [t for t in batchable if t.deadline is not None and t.deadline.expired()]
+        if dead:
+            batchable = [t for t in batchable if t not in dead]
+            solos = solos + dead
+        with RECORDER.lock:
+            for t in ready:
+                self.queue_wait.observe(t.queue_s, ())
+        for t in solos:
+            self._pool.submit(self._run_solo, t)
+        if len(batchable) == 1:
+            # a batch of one is just overhead: the solo path keeps the full
+            # engine ladder (megakernel included) and per-phase span tree
+            self._pool.submit(self._run_solo, batchable[0])
+        elif batchable:
+            # INLINE, not pooled: one batch in flight at a time (groups
+            # would only serialize on the base-entry lock anyway), so new
+            # arrivals accumulate in the queue while this batch runs and
+            # the next drain folds them into one bigger batch — batch size
+            # adapts to the service rate under load (the classic serving-
+            # system dynamic-batching loop)
+            self._run_group(batchable)
+
+    def _run_solo(self, ticket: Ticket) -> None:
+        t0 = time.monotonic()
+        try:
+            self.solo_fn(ticket)
+        except BaseException as e:  # the backstop of last resort: the
+            # error is transported to the waiting client, not dropped
+            log.warning("solo executor raised %s: %s", type(e).__name__, e)
+            if not ticket.done.is_set():
+                ticket.resolve(error=e)
+        finally:
+            self._note_service(time.monotonic() - t0)
+        if not ticket.done.is_set():
+            ticket.resolve(
+                error=RuntimeError("solo executor returned without resolving")
+            )
+
+    def _run_group(self, tickets: List[Ticket]) -> None:
+        t0 = time.monotonic()
+        # recorded at batch START (size is known upfront): a client whose
+        # ticket just resolved must already see the batch in /metrics —
+        # recording after resolution races every scrape-after-response
+        with RECORDER.lock:
+            self.batches_total += 1
+            self.batch_sizes.observe(float(len(tickets)), ())
+        try:
+            self.batch_fn(tickets)
+        except BaseException as e:
+            # transported to every waiting client as a typed error
+            log.warning("batch executor raised %s: %s", type(e).__name__, e)
+            for t in tickets:
+                if not t.done.is_set():
+                    t.resolve(error=e)
+        finally:
+            self._note_service(time.monotonic() - t0)
+        for t in tickets:
+            if not t.done.is_set():
+                t.resolve(
+                    error=RuntimeError("batch executor returned without resolving")
+                )
+
+    def _note_service(self, seconds: float) -> None:
+        with RECORDER.lock:
+            self.ewma_service_s = 0.8 * self.ewma_service_s + 0.2 * max(
+                0.001, seconds
+            )
+
+    # -- /metrics -----------------------------------------------------------
+
+    def metrics_lines(self) -> List[str]:
+        lines = list(
+            exposition_headers(
+                "simon_admission_queue_depth",
+                "Requests waiting in the admission queue",
+                "gauge",
+            )
+        )
+        lines.append(f"simon_admission_queue_depth {self.depth()}")
+        with RECORDER.lock:
+            lines += exposition_headers(
+                "simon_batches_total", "Batched schedule dispatches"
+            )
+            lines.append(f"simon_batches_total {self.batches_total}")
+            shed = self.shed.render_lines()
+            if not shed:
+                # conformance: the family must exist from the first scrape,
+                # not only after the first shed
+                shed = [
+                    *exposition_headers(
+                        "simon_shed_total",
+                        "Requests shed at the admission queue by reason",
+                    ),
+                ]
+            lines += shed
+            lines += self.batch_sizes.render_lines()
+            lines += self.queue_wait.render_lines()
+        return lines

@@ -1,0 +1,569 @@
+"""``simon loadgen`` — open/closed-loop load harness for the live server
+(ISSUE 8).
+
+The success metric of the concurrent serving core is a CLOSED LOOP, not a
+microbench: drive the live server at a target concurrency (closed loop:
+each worker waits for its response before issuing the next request) or a
+target arrival rate (open loop: requests fire on a fixed schedule whether
+or not earlier ones returned), and read the latency distribution straight
+from the server's own ``simon_request_seconds_bucket`` histogram — the
+same series a production dashboard scrapes — rather than trusting
+client-side clocks alone. Both views are reported; disagreement between
+them is itself a finding (client-side queueing).
+
+Shed handling mirrors a well-behaved client: a 503 with ``Retry-After``
+backs off for the advertised interval (capped), and sheds are reported
+separately from errors — shedding under overload is the server WORKING,
+and the report says how much traffic it cost.
+
+Library surface: :func:`run_loadgen` returns the report dict (the smoke
+gate ``tools/loadgen_smoke.py`` and ``bench.py --config serving`` build on
+it); the CLI in ``cli/main.py`` prints it as JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import re
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger("opensim_tpu.loadgen")
+
+__all__ = [
+    "run_loadgen",
+    "run_stub_benchmark",
+    "parse_metrics",
+    "histogram_quantile",
+    "scrape_metrics",
+]
+
+# ---------------------------------------------------------------------------
+# Prometheus text-format reading (stdlib only)
+# ---------------------------------------------------------------------------
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+([0-9eE+.\-]+|\+Inf|NaN)$"
+)
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+MetricKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def parse_metrics(text: str) -> Dict[MetricKey, float]:
+    """Exposition text → ``{(name, sorted label items): value}``."""
+    out: Dict[MetricKey, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        if not m:
+            continue
+        name, labels_body, value = m.groups()
+        labels = tuple(sorted(
+            (k, v.replace('\\"', '"').replace("\\\\", "\\"))
+            for k, v in _LABEL.findall(labels_body or "")
+        ))
+        out[(name, labels)] = float(value)
+    return out
+
+
+def scrape_metrics(url: str, timeout_s: float = 10.0) -> Dict[MetricKey, float]:
+    with urllib.request.urlopen(f"{url}/metrics", timeout=timeout_s) as resp:
+        return parse_metrics(resp.read().decode())
+
+
+def _bucket_deltas(
+    before: Dict[MetricKey, float],
+    after: Dict[MetricKey, float],
+    family: str,
+    match: Dict[str, str],
+) -> List[Tuple[float, float]]:
+    """Sorted ``(le, cumulative delta)`` for one histogram family,
+    aggregated over every series whose labels are a superset of ``match``
+    (summing cumulative bucket counts across series is legal — they share
+    the bucket ladder)."""
+    sums: Dict[float, float] = {}
+    for (name, labels), v in after.items():
+        if name != f"{family}_bucket":
+            continue
+        ld = dict(labels)
+        if any(ld.get(k) != want for k, want in match.items()):
+            continue
+        le = math.inf if ld.get("le") == "+Inf" else float(ld.get("le", "inf"))
+        sums[le] = sums.get(le, 0.0) + v - before.get((name, labels), 0.0)
+    return sorted(sums.items())
+
+
+def histogram_quantile(
+    before: Dict[MetricKey, float],
+    after: Dict[MetricKey, float],
+    family: str,
+    q: float,
+    match: Optional[Dict[str, str]] = None,
+) -> Optional[float]:
+    """PromQL ``histogram_quantile`` over the scrape DELTA (so a long-lived
+    server's history does not pollute the run's distribution): linear
+    interpolation inside the target bucket. None when the delta is empty."""
+    buckets = _bucket_deltas(before, after, family, match or {})
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_le, prev_cum = 0.0, 0.0
+    for le, cum in buckets:
+        if cum >= target:
+            if math.isinf(le):
+                return prev_le  # tail bucket: the lower bound is the honest answer
+            if cum == prev_cum:
+                return le
+            return prev_le + (le - prev_le) * (target - prev_cum) / (cum - prev_cum)
+        prev_le, prev_cum = le, cum
+    return buckets[-1][0]
+
+
+def _counter_delta(before, after, name: str, match: Optional[Dict[str, str]] = None) -> float:
+    total = 0.0
+    for (n, labels), v in after.items():
+        if n != name:
+            continue
+        ld = dict(labels)
+        if match and any(ld.get(k) != want for k, want in match.items()):
+            continue
+        total += v - before.get((n, labels), 0.0)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# the loop
+# ---------------------------------------------------------------------------
+
+
+def _payload(worker: int, seq: int, replicas: int, cpu: str, mem: str) -> bytes:
+    """Distinct-per-request deploy payload: identical repeated payloads
+    would measure the full-key prep cache, not the serving core."""
+    name = f"lg-{worker}-{seq}"
+    reps = 1 + (seq % max(1, replicas))
+    return json.dumps(
+        {
+            "deployments": [
+                {
+                    "apiVersion": "apps/v1",
+                    "kind": "Deployment",
+                    "metadata": {"name": name, "namespace": "default"},
+                    "spec": {
+                        "replicas": reps,
+                        "selector": {"matchLabels": {"app": name}},
+                        "template": {
+                            "metadata": {"labels": {"app": name}},
+                            "spec": {
+                                "containers": [
+                                    {
+                                        "name": "c",
+                                        "resources": {
+                                            "requests": {"cpu": cpu, "memory": mem}
+                                        },
+                                    }
+                                ]
+                            },
+                        },
+                    },
+                }
+            ]
+        }
+    ).encode()
+
+
+class _Stats:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.ok = 0
+        self.shed = 0
+        self.errors = 0
+        self.latencies: List[float] = []
+
+    def record(self, outcome: str, seconds: float) -> None:
+        with self.lock:
+            if outcome == "ok":
+                self.ok += 1
+                self.latencies.append(seconds)
+            elif outcome == "shed":
+                self.shed += 1
+            else:
+                self.errors += 1
+
+
+class _Client:
+    """One worker's persistent HTTP/1.1 connection (keep-alive): connection
+    churn must not pollute the latency measurement — the server speaks
+    HTTP/1.1 with Content-Length on every response."""
+
+    def __init__(self, url: str, timeout_s: float) -> None:
+        import urllib.parse
+
+        parsed = urllib.parse.urlparse(url)
+        self.host = parsed.hostname or "127.0.0.1"
+        self.port = parsed.port or (443 if parsed.scheme == "https" else 80)
+        self.timeout_s = timeout_s
+        self.conn: Optional[object] = None
+
+    def _connect(self):
+        import http.client
+
+        self.conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=self.timeout_s
+        )
+        return self.conn
+
+    def request(self, body: bytes) -> Tuple[str, float, float]:
+        """POST one deploy; returns (outcome, latency_s, retry_after_s)."""
+        t0 = time.monotonic()
+        conn = self.conn or self._connect()
+        try:
+            conn.request(
+                "POST", "/api/deploy-apps", body=body,
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            lat = time.monotonic() - t0
+            if resp.status == 503:
+                try:
+                    retry = float(resp.headers.get("Retry-After") or 1.0)
+                except ValueError:
+                    retry = 1.0
+                return "shed", lat, retry
+            if resp.status != 200:
+                return "error", lat, 0.0
+            return "ok", lat, 0.0
+        except Exception as e:
+            # drop the (possibly wedged) connection; the next request dials
+            # fresh — a connection reset is an ERROR SAMPLE in the report,
+            # never a crash of the harness
+            log.debug("request failed: %s: %s", type(e).__name__, e)
+            try:
+                conn.close()
+            except OSError as ce:
+                log.debug("connection close failed: %s", ce)
+            self.conn = None
+            return "error", time.monotonic() - t0, 0.0
+
+    def close(self) -> None:
+        if self.conn is not None:
+            try:
+                self.conn.close()
+            except OSError as ce:
+                log.debug("connection close failed: %s", ce)
+            self.conn = None
+
+
+def _quantile(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, max(0, int(math.ceil(q * len(sorted_vals))) - 1))
+    return sorted_vals[idx]
+
+
+def run_loadgen(
+    url: str,
+    mode: str = "closed",
+    concurrency: int = 8,
+    qps: float = 0.0,
+    duration_s: float = 10.0,
+    replicas: int = 3,
+    cpu: str = "500m",
+    mem: str = "1Gi",
+    timeout_s: float = 60.0,
+    warmup_requests: int = 1,
+) -> dict:
+    """Drive the server and report sustained QPS + latency percentiles.
+
+    - ``closed``: ``concurrency`` workers, each issuing its next request
+      only after the previous response (or after the advertised
+      ``Retry-After`` on a shed) — throughput self-adjusts to the server's
+      capacity, the honest "sustained QPS at bounded p99" measurement.
+    - ``open``: requests fire every ``1/qps`` seconds regardless of
+      completions (up to ``concurrency`` in flight; arrivals past that are
+      counted ``dropped`` — client-side overload, reported, never silently
+      skipped).
+    """
+    if mode not in ("closed", "open"):
+        raise ValueError(f"mode must be closed|open, got {mode!r}")
+    if mode == "open" and qps <= 0:
+        raise ValueError("open loop needs --qps > 0")
+
+    # warmup outside the measured window: the first request pays the cold
+    # prepare + engine compile and would dominate a short run
+    wcli = _Client(url, timeout_s)
+    for i in range(max(0, warmup_requests)):
+        wcli.request(_payload(999, i, replicas, cpu, mem))
+    wcli.close()
+
+    before = scrape_metrics(url)
+    stats = _Stats()
+    stop = time.monotonic() + duration_s
+    dropped = [0]
+
+    def closed_worker(w: int) -> None:
+        cli = _Client(url, timeout_s)
+        seq = 0
+        try:
+            while time.monotonic() < stop:
+                outcome, lat, retry = cli.request(
+                    _payload(w, seq, replicas, cpu, mem)
+                )
+                stats.record(outcome, lat)
+                seq += 1
+                if outcome == "shed":
+                    time.sleep(min(retry, max(0.0, stop - time.monotonic()), 2.0))
+        finally:
+            cli.close()
+
+    def open_driver() -> None:
+        interval = 1.0 / qps
+        inflight = threading.Semaphore(concurrency)
+        seq = 0
+        next_at = time.monotonic()
+
+        def fire(s: int) -> None:
+            cli = _Client(url, timeout_s)
+            try:
+                outcome, lat, _ = cli.request(_payload(0, s, replicas, cpu, mem))
+            finally:
+                cli.close()
+            stats.record(outcome, lat)
+            inflight.release()
+
+        while time.monotonic() < stop:
+            now = time.monotonic()
+            if now < next_at:
+                time.sleep(min(interval, next_at - now))
+                continue
+            next_at += interval
+            if not inflight.acquire(blocking=False):
+                dropped[0] += 1
+                continue
+            threading.Thread(target=fire, args=(seq,), daemon=True).start()
+            seq += 1
+
+    t_start = time.monotonic()
+    if mode == "closed":
+        workers = [
+            threading.Thread(target=closed_worker, args=(w,), daemon=True)
+            for w in range(concurrency)
+        ]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+    else:
+        open_driver()
+        # drain stragglers briefly so the final scrape sees them
+        time.sleep(min(2.0, timeout_s))
+    measured_s = time.monotonic() - t_start
+    after = scrape_metrics(url)
+
+    lats = sorted(stats.latencies)
+    ok_match = {"endpoint": "deploy-apps", "status": "ok"}
+    batches = _counter_delta(before, after, "simon_batches_total")
+    batched_reqs = _counter_delta(before, after, "simon_batch_size_sum")
+    shed_by_reason = {}
+    for (name, labels), v in after.items():
+        if name == "simon_shed_total":
+            reason = dict(labels).get("reason", "")
+            shed_by_reason[reason] = int(v - before.get((name, labels), 0.0))
+    report = {
+        "mode": mode,
+        "duration_s": round(measured_s, 3),
+        "concurrency": concurrency,
+        "target_qps": qps if mode == "open" else None,
+        "requests": stats.ok + stats.shed + stats.errors,
+        "ok": stats.ok,
+        "shed": stats.shed,
+        "errors": stats.errors,
+        "dropped": dropped[0],
+        "qps": round(stats.ok / measured_s, 2) if measured_s > 0 else 0.0,
+        "client_p50_s": _quantile(lats, 0.50),
+        "client_p99_s": _quantile(lats, 0.99),
+        # straight from the server's own exposition (the closed loop's
+        # other half): simon_request_seconds_bucket over the run's delta
+        "server_p50_s": histogram_quantile(
+            before, after, "simon_request_seconds", 0.50, ok_match
+        ),
+        "server_p99_s": histogram_quantile(
+            before, after, "simon_request_seconds", 0.99, ok_match
+        ),
+        "queue_wait_p99_s": histogram_quantile(
+            before, after, "simon_queue_wait_seconds", 0.99
+        ),
+        "batches": int(batches),
+        "batched_requests": int(batched_reqs),
+        "mean_batch_size": round(batched_reqs / batches, 2) if batches else 0.0,
+        "shed_total": shed_by_reason,
+    }
+    return report
+
+
+# ---------------------------------------------------------------------------
+# the closed loop against the stub apiserver (the ISSUE 8 success metric)
+# ---------------------------------------------------------------------------
+
+
+def _seed_stub(n_nodes: int, n_pods: int):
+    """Stub apiserver seeded with a small live cluster (nodes + running
+    pods) so the twin's warm base prep is non-trivial — the shape the
+    request-axis batcher serves."""
+    from ..models import fixtures as fx
+    from .stubapi import StubApiServer
+
+    stub = StubApiServer(bookmark_interval_s=0.2).start()
+    stub.seed(
+        "/api/v1/nodes",
+        [fx.make_fake_node(f"n{i}", "16", "32Gi").raw for i in range(n_nodes)],
+    )
+    stub.seed(
+        "/api/v1/pods",
+        [
+            {
+                "apiVersion": "v1",
+                "kind": "Pod",
+                "metadata": {"name": f"seed-{i}", "namespace": "default"},
+                "spec": {
+                    "nodeName": f"n{i % n_nodes}",
+                    "containers": [
+                        {"name": "c", "resources": {"requests": {"cpu": "250m"}}}
+                    ],
+                },
+                "status": {"phase": "Running"},
+            }
+            for i in range(n_pods)
+        ],
+    )
+    for path in (
+        "/apis/apps/v1/daemonsets", "/apis/policy/v1/poddisruptionbudgets",
+        "/api/v1/services", "/apis/storage.k8s.io/v1/storageclasses",
+        "/api/v1/persistentvolumeclaims", "/api/v1/configmaps",
+    ):
+        stub.seed(path, [])
+    return stub
+
+
+def _boot_server(kubeconfig: str, port: int, admission: bool, batch_max: int):
+    """The simon server as a SUBPROCESS: the loadgen client and the server
+    must not share a GIL, or the measurement reports the client's
+    contention as server latency."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+        OPENSIM_ADMISSION="on" if admission else "off",
+        OPENSIM_BATCH_MAX=str(batch_max),
+        PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "opensim_tpu", "server",
+         "--kubeconfig", kubeconfig, "--port", str(port), "--watch", "auto"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    url = f"http://127.0.0.1:{port}"
+    deadline = time.monotonic() + 120.0
+    attempt = 0
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            out = (proc.stdout.read() or b"").decode(errors="replace")
+            raise RuntimeError(f"server exited at boot (rc={proc.returncode}): {out[-2000:]}")
+        try:
+            with urllib.request.urlopen(f"{url}/healthz", timeout=1.0):
+                return proc, url
+        except OSError as e:
+            log.debug("healthz probe %d: %s", attempt, e)
+            attempt += 1
+            time.sleep(min(0.5, 0.05 * attempt))
+    proc.kill()
+    raise RuntimeError("server did not become healthy within 120s")
+
+
+def _warm_concurrent(url: str, n: int, timeout_s: float) -> None:
+    """Concurrent warmup burst: a serial warmup never exercises the BATCH
+    path, whose first run pays its own caches."""
+    def one(i: int) -> None:
+        cli = _Client(url, timeout_s)
+        try:
+            cli.request(_payload(888, i, 3, "500m", "1Gi"))
+        finally:
+            cli.close()
+
+    threads = [threading.Thread(target=one, args=(i,), daemon=True) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def run_stub_benchmark(
+    concurrency: int = 32,
+    duration_s: float = 8.0,
+    n_nodes: int = 8,
+    n_pods: int = 16,
+    batch_max: int = 32,
+    base_port: int = 18180,
+) -> dict:
+    """The ISSUE 8 closed loop, end to end: stub apiserver → two live twin
+    servers in subprocesses (single-flight vs admission queue) → closed-
+    loop loadgen against each → one report carrying BOTH numbers. Used by
+    ``make loadgen-smoke`` and ``bench.py --config serving``."""
+    import tempfile
+
+    stub = _seed_stub(n_nodes, n_pods)
+    tmp = tempfile.mkdtemp(prefix="loadgen-")
+    kc = stub.kubeconfig(tmp)
+    try:
+        proc, url = _boot_server(kc, base_port, admission=False, batch_max=batch_max)
+        try:
+            _warm_concurrent(url, min(16, concurrency), 60.0)
+            single = run_loadgen(url, mode="closed", concurrency=concurrency,
+                                 duration_s=duration_s)
+        finally:
+            proc.terminate()
+            proc.wait()
+        proc, url = _boot_server(kc, base_port + 1, admission=True, batch_max=batch_max)
+        try:
+            _warm_concurrent(url, min(16, concurrency), 60.0)
+            batched = run_loadgen(url, mode="closed", concurrency=concurrency,
+                                  duration_s=duration_s)
+        finally:
+            proc.terminate()
+            proc.wait()
+    finally:
+        stub.stop()
+    speedup = (
+        batched["qps"] / single["qps"] if single["qps"] > 0 else float("inf")
+    )
+    return {
+        "concurrency": concurrency,
+        "duration_s": duration_s,
+        "nodes": n_nodes,
+        "cluster_pods": n_pods,
+        "qps_single_flight": single["qps"],
+        "qps": batched["qps"],
+        "speedup": round(speedup, 2),
+        "p50_s": batched["server_p50_s"],
+        "p99_s": batched["server_p99_s"],
+        "p99_single_flight_s": single["server_p99_s"],
+        "batches": batched["batches"],
+        "mean_batch_size": batched["mean_batch_size"],
+        "shed": batched["shed"],
+        "shed_single_flight": single["shed"],
+        "single_flight": single,
+        "admission": batched,
+    }

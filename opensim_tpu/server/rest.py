@@ -62,6 +62,13 @@ def request_served_stale() -> bool:
     return getattr(_REQUEST_STATE, "snapshot_stale", False)
 
 
+def response_extra_headers() -> dict:
+    """Extra response headers the current thread's request accumulated
+    (e.g. ``Retry-After`` on an admission shed) — reset per request by the
+    handler, merged into ``_send``."""
+    return getattr(_REQUEST_STATE, "extra_headers", {}) or {}
+
+
 def last_request_id() -> str:
     """The request id assigned to the current thread's request (honored from
     ``X-Simon-Request-Id`` if the client sent one, generated otherwise) —
@@ -118,7 +125,7 @@ class _Metrics:
         with self.lock:
             setattr(self, counter, getattr(self, counter) + n)
 
-    def render(self, prep_cache=None, watch=None) -> str:
+    def render(self, prep_cache=None, watch=None, admission=None) -> str:
         from ..utils.trace import PREP_STATS
 
         esc = escape_label_value
@@ -197,6 +204,11 @@ class _Metrics:
         # simon_watch_state one-hot, events by kind, reconnects, drift
         if watch is not None:
             lines += watch.metrics_lines()
+        # admission queue / batching / shedding telemetry (ISSUE 8,
+        # server/admission.py): queue depth gauge, batch-size histogram,
+        # shed counters, real time-in-queue
+        if admission is not None:
+            lines += admission.metrics_lines()
         # per-phase / per-endpoint latency histograms, computed from the
         # same spans the flight recorder serves (obs/metrics.py)
         lines += RECORDER.render_lines()
@@ -312,6 +324,12 @@ def _placements_payload(rid: str, result: SimulateResult) -> dict:
     }
 
 
+class _BatchUnroutable(Exception):
+    """Internal: the drained batch cannot run as one shared-prep batched
+    schedule (empty base prep, delta re-encode declined) — the group
+    degrades to solo execution, it does not fail."""
+
+
 class SimonServer:
     def __init__(
         self,
@@ -321,6 +339,7 @@ class SimonServer:
         snapshot_ttl_s: float = 30.0,
         prep_cache=None,
         watch=None,
+        admission=None,
     ):
         self.kubeconfig = kubeconfig
         self.master = master
@@ -340,6 +359,12 @@ class SimonServer:
         self._snapshot: Optional[ResourceTypes] = None
         self._snapshot_at = 0.0
         self._snapshot_fp: Optional[str] = None
+        # polling-snapshot state is mutated from pool-worker AND dispatcher
+        # threads under the admission path (the endpoint TryLocks that used
+        # to serialize it only guard the OPENSIM_ADMISSION=off path) — an
+        # RLock keeps (snapshot, fingerprint) pairs coherent and collapses
+        # concurrent refreshes into one apiserver fetch
+        self._snapshot_lock = threading.RLock()
         # degradation state: when the apiserver stays down through every
         # retry, requests are served from the last good snapshot and tagged
         # with an X-Simon-Snapshot: stale response header
@@ -354,6 +379,26 @@ class SimonServer:
 
             prep_cache = PrepareCache()
         self.prep_cache = prep_cache if prep_cache is not False else None
+        # concurrent serving core (ISSUE 8, server/admission.py): admission
+        # queue + cross-request batching + bounded worker pool. ``None``
+        # defers to OPENSIM_ADMISSION (default on); ``False`` restores the
+        # single-flight TryLock path; an AdmissionController instance is
+        # used as-is (tests inject tiny windows/bounds).
+        from . import admission as admission_mod
+
+        if admission is None:
+            admission = admission_mod.admission_enabled()
+        if admission is True:
+            admission = admission_mod.AdmissionController(
+                solo_fn=self._admitted_solo, batch_fn=self._admitted_batch
+            )
+        self.admission = admission or None
+
+    def close(self) -> None:
+        """Stop the admission dispatcher + worker pool (pending tickets are
+        resolved with a typed shutdown shed). Idempotent."""
+        if self.admission is not None:
+            self.admission.stop()
 
     def _twin_snapshot(self) -> Optional[tuple]:
         """(cluster, cache key) from the synced live twin, or None when the
@@ -395,6 +440,10 @@ class SimonServer:
         return ResourceTypes()
 
     def _refresh_snapshot(self) -> None:
+        with self._snapshot_lock:
+            self._refresh_snapshot_locked()
+
+    def _refresh_snapshot_locked(self) -> None:
         import time as _time
 
         now = _time.monotonic()
@@ -473,9 +522,10 @@ class SimonServer:
         from ..engine.prepcache import fingerprint_cluster
 
         if self.base_cluster is not None:
-            if self._snapshot_fp is None:
-                self._snapshot_fp = fingerprint_cluster(self.base_cluster)
-            return self.base_cluster, self._snapshot_fp
+            with self._snapshot_lock:
+                if self._snapshot_fp is None:
+                    self._snapshot_fp = fingerprint_cluster(self.base_cluster)
+                return self.base_cluster, self._snapshot_fp
         got = self._twin_snapshot()
         if got is not None:
             # generation-keyed, not content-fingerprinted: every applied
@@ -484,13 +534,17 @@ class SimonServer:
             # replaces the base by O(changes) delta instead)
             return got
         if self.kubeconfig:
-            old_fp = self._snapshot_fp
-            self._refresh_snapshot()
-            if self._snapshot_fp is None:
-                self._snapshot_fp = fingerprint_cluster(self._snapshot)
-                if old_fp is not None and old_fp != self._snapshot_fp:
-                    self.prep_cache.invalidate(old_fp)
-            return self._snapshot, self._snapshot_fp
+            # fetch + fingerprint + invalidation under ONE lock: a
+            # concurrent refresh swapping self._snapshot between the two
+            # reads would cache a prepare under the wrong fingerprint
+            with self._snapshot_lock:
+                old_fp = self._snapshot_fp
+                self._refresh_snapshot_locked()
+                if self._snapshot_fp is None:
+                    self._snapshot_fp = fingerprint_cluster(self._snapshot)
+                    if old_fp is not None and old_fp != self._snapshot_fp:
+                        self.prep_cache.invalidate(old_fp)
+                return self._snapshot, self._snapshot_fp
         return ResourceTypes(), "empty"
 
     # -- handlers -----------------------------------------------------------
@@ -634,6 +688,261 @@ class SimonServer:
             finally:
                 entry.restore()
 
+    # -- admission-path executors (ISSUE 8) --------------------------------
+    #
+    # Both run on dispatcher/worker-pool threads, never on the HTTP handler
+    # thread: they communicate exclusively through the ticket (result or
+    # error + the stale flag observed on the executing thread, since
+    # _REQUEST_STATE is thread-local and would not survive the handoff).
+
+    def _admitted_solo(self, ticket) -> None:
+        """Full-fidelity solo execution: the exact `_simulate_request` path
+        (engine ladder, prep cache, one stale retry), with the request's
+        deadline and trace installed on this worker thread so phase spans
+        and 504s land exactly as on the legacy path."""
+        _mark_request_snapshot(False)
+        _REQUEST_STATE.request_id = ticket.request_id
+        try:
+            with deadline_scope(ticket.deadline), tracing.trace_scope(ticket.trace):
+                result = self._simulate_request(
+                    ticket.kind, ticket.payload, explain=ticket.explain
+                )
+            ticket.resolve(result=result, stale=request_served_stale())
+        except BaseException as e:
+            # transported: the REST thread re-raises this into its typed
+            # failure ladder (504/503/500) and logs it there
+            log.debug("solo execution failed: %s: %s", type(e).__name__, e)
+            ticket.resolve(error=e, stale=request_served_stale())
+
+    def _admitted_batch(self, tickets) -> None:
+        """Batched execution with the solo path's stale-entry contract (one
+        internal retry after eviction) and a solo fallback when the stream
+        cannot batch (empty base prep, delta declined)."""
+        from ..engine.prepcache import StaleFingerprintError
+
+        try:
+            try:
+                self._run_batch_once(tickets)
+            except StaleFingerprintError as e:
+                METRICS.bump("stale_prep_retries")
+                log.warning(
+                    "stale prepare-cache entry in batch (%s); retrying once "
+                    "after eviction", e,
+                )
+                self._run_batch_once(tickets)
+        except _BatchUnroutable as e:
+            # the stream cannot batch (no schedulable base pods, delta
+            # declined): degrade to full-fidelity solo runs, never an error
+            log.info("batch of %d unroutable (%s); running solo", len(tickets), e)
+            for t in tickets:
+                self._admitted_solo(t)
+        except BaseException as e:
+            # one failure fails the whole group with the same typed error a
+            # solo run would surface — never a partial result
+            log.warning(
+                "batch of %d failed (%s: %s); failing the group",
+                len(tickets), type(e).__name__, e,
+            )
+            for t in tickets:
+                if not t.done.is_set():
+                    t.resolve(error=e)
+
+    def _run_batch_once(self, tickets) -> None:
+        """Fold N compatible requests onto one shared warm prep and run ONE
+        request-axis batched schedule (engine/reqbatch.py), demultiplexing
+        a per-request SimulateResult that is bit-identical to a solo run
+        (gated by tests/test_admission.py)."""
+        import time as _time
+
+        import numpy as np
+
+        from ..engine import prepcache, reqbatch
+        from ..engine.simulator import prepare
+
+        _mark_request_snapshot(False)
+        t0 = _time.monotonic()
+        cluster0, fp = self._snapshot_for_cache()
+        stale = request_served_stale()
+        apps: List[AppResource] = []
+        scaled_sets: List[set] = []
+        kept: List = []
+        for t in tickets:
+            # per-ticket decode: ONE malformed payload must fail only its
+            # own request (typed 500), never poison the whole batch
+            try:
+                app = _decode_app(t.payload)
+            except Exception as e:
+                log.warning(
+                    "batch rider payload failed to decode (%s: %s)",
+                    type(e).__name__, e,
+                )
+                t.resolve(error=e, stale=stale)
+                continue
+            kept.append(t)
+            apps.append(AppResource(t.kind, app))
+            scaled_sets.append(
+                {
+                    (w.kind, w.metadata.namespace, w.metadata.name)
+                    for w in app.deployments + app.daemon_sets + app.stateful_sets
+                }
+                if t.kind == "scale"
+                else set()
+            )
+        tickets = kept
+        if not tickets:
+            return
+        base_key = f"{fp}|base"
+        base = self.prep_cache.get(base_key)
+        if base is None:
+            watch = prepcache.watch_snapshot(cluster0, [])  # before the build
+            base = self.prep_cache.put(
+                base_key,
+                prepcache.CacheEntry(base_key, prepare(cluster0, []), watch=watch),
+            )
+        self.prep_cache.check_fresh(base)
+        with base.lock:
+            base.restore()
+            if base.prep is not None:
+                got = prepcache.derive_with_app_slices(
+                    base.prep, cluster0, apps, base_entry=base
+                )
+                if got is None:
+                    raise _BatchUnroutable("delta re-encode declined the stream")
+                derived, slices = got
+            else:
+                # snapshot with no schedulable pods: nothing cached to
+                # derive from — one fresh prepare of ALL the batch's apps
+                # still beats N solo full prepares (prepare() records the
+                # per-app stream slices for exactly this demultiplexing)
+                derived = prepare(cluster0, apps)
+                if derived is None or derived.app_slices is None:
+                    raise _BatchUnroutable("batch expanded to an empty stream")
+                slices = derived.app_slices
+            prep_s = _time.monotonic() - t0
+            items = []
+            for s in range(len(tickets)):
+                drop = prepcache.union_drop_masks(
+                    base.base_drop,
+                    prepcache.drop_mask_for_scaled(derived, _owned_by, scaled_sets[s])
+                    if scaled_sets[s]
+                    else None,
+                    len(derived.ordered),
+                )
+                drops = set(int(i) for i in np.nonzero(drop)[0]) if drop is not None else set()
+                items.append(
+                    reqbatch.BatchItem(
+                        cluster=cluster0, apps=[apps[s]],
+                        lo=slices[s][0], hi=slices[s][1], drops=drops,
+                    )
+                )
+            t1 = _time.monotonic()
+            try:
+                results = reqbatch.run_request_batch(derived, items)
+            finally:
+                base.restore()
+            run_s = _time.monotonic() - t1
+        for t, res in zip(tickets, results):
+            tr = t.trace
+            if tr is not None:
+                # synthetic phase spans: the shared batch work, attributed
+                # to every rider so per-phase histograms stay live for
+                # batched traffic (child_from_seconds exists for this)
+                tr.root.child_from_seconds(
+                    "prepare", prep_s, batched=True, batch=len(tickets)
+                )
+                tr.root.child_from_seconds(
+                    "schedule", run_s, batched=True, batch=len(tickets)
+                )
+            t.resolve(result=res, stale=stale, batch_size=len(tickets))
+
+    def _handle_admitted(self, endpoint: str, kind: str, payload: dict,
+                         deadline: Optional[Deadline] = None,
+                         request_id: Optional[str] = None,
+                         explain: bool = False) -> tuple:
+        """The admission-path endpoint shell: same typed failure ladder as
+        the legacy `_handle`, plus two shed outcomes —
+
+        - 503 + reason=queue_full + ``Retry-After``: the admission queue is
+          at its bound (load-shedding, server/admission.py);
+        - 504 + phase=queue: the request's deadline expired while queued.
+
+        Every outcome records the REAL elapsed time in the request
+        histogram (the ISSUE 8 satellite: rejected traffic must carry its
+        actual latency, not a fake 0.0)."""
+        import math
+        import time
+
+        from . import admission as admission_mod
+
+        rid = tracing.sanitize_request_id(request_id) or tracing.new_request_id()
+        _REQUEST_STATE.request_id = rid
+        _REQUEST_STATE.extra_headers = {}
+        _mark_request_snapshot(False)
+        tr = tracing.start_trace(endpoint, request_id=rid)
+        t0 = time.monotonic()
+        status = "error"
+        code, body = 500, {"error": "unhandled"}
+        result: Optional[SimulateResult] = None
+        ticket = None
+        try:
+            has_new_nodes = bool(payload.get("newnodes") or payload.get("NewNodes"))
+            ticket = admission_mod.Ticket(
+                kind=kind, payload=payload, explain=explain, deadline=deadline,
+                trace=tr, request_id=rid,
+                # with the cache off every request takes the legacy
+                # full-prepare path: solo through the pool, never batched
+                has_new_nodes=has_new_nodes or self.prep_cache is None,
+            )
+            self.admission.submit(ticket)
+            self.admission.wait(ticket)
+            result = ticket.result
+            _mark_request_snapshot(ticket.stale)
+            status = "ok"
+            if result.engine is not None:
+                result.engine.request_id = rid
+                if tr is not None:
+                    tr.root.set(engine=result.engine.describe())
+                    if ticket.batch_size:
+                        tr.root.set(batch_size=ticket.batch_size)
+            code, body = 200, _response(result, explain=explain)
+            if explain and tr is not None and result.engine is not None:
+                tr.placements = _placements_payload(rid, result)
+        except admission_mod.QueueFull as e:
+            status = "shed"
+            log.warning("%s shed: %s", endpoint, e)
+            _REQUEST_STATE.extra_headers = {
+                "Retry-After": str(max(1, int(math.ceil(e.retry_after_s))))
+            }
+            code, body = 503, {
+                "error": str(e), "reason": "queue_full", "retryable": True,
+            }
+        except DeadlineExceeded as e:
+            status = "deadline-exceeded"
+            METRICS.bump("request_timeouts")
+            log.warning("%s timed out: %s", endpoint, e)
+            code, body = 504, {"error": str(e), "phase": e.phase}
+        except SnapshotUnavailable as e:
+            log.warning("%s snapshot unavailable: %s", endpoint, e)
+            code, body = 503, {"error": str(e), "retryable": True}
+        except Exception as e:
+            log.warning("%s failed: %s: %s", endpoint, type(e).__name__, e)
+            code, body = 500, {"error": str(e), "type": type(e).__name__}
+        finally:
+            seconds = time.monotonic() - t0
+            with RECORDER.lock:
+                if status == "ok" and result is not None:
+                    METRICS.record(endpoint, result)
+                RECORDER.observe_request(endpoint, seconds, status=status)
+            if tr is not None:
+                if ticket is not None and ticket.queue_s:
+                    # real time-in-queue on the span tree (also histogrammed
+                    # as simon_queue_wait_seconds by the controller)
+                    tr.root.child_from_seconds("queue", ticket.queue_s)
+                tr.finish(status=status, http_status=code)
+                FLIGHT_RECORDER.record(tr)
+                RECORDER.observe_trace(tr)
+        return code, body
+
     def _handle(self, endpoint: str, kind: str, lock: threading.Lock,
                 payload: dict, deadline: Optional[Deadline] = None,
                 request_id: Optional[str] = None, explain: bool = False) -> tuple:
@@ -655,15 +964,29 @@ class SimonServer:
         read it back via :func:`last_request_id`) and, when tracing is
         enabled, a span tree recorded into the flight recorder and folded
         into the /metrics latency histograms on the way out.
+
+        With the admission queue enabled (the default — OPENSIM_ADMISSION,
+        ISSUE 8), requests route through ``_handle_admitted`` instead:
+        cross-request batching + bounded worker pool + load-shedding; this
+        single-flight shell remains the ``OPENSIM_ADMISSION=off`` path.
         """
         import time
 
+        if self.admission is not None:
+            return self._handle_admitted(
+                endpoint, kind, payload, deadline, request_id, explain=explain
+            )
+        t0 = time.monotonic()
         rid = tracing.sanitize_request_id(request_id) or tracing.new_request_id()
         _REQUEST_STATE.request_id = rid
         if not lock.acquire(blocking=False):
             # rejected traffic must still be visible in the histograms —
-            # overload is exactly what a latency dashboard is watching for
-            RECORDER.observe_request(endpoint, 0.0, status="busy")
+            # overload is exactly what a latency dashboard is watching for.
+            # Record the REAL elapsed time (ISSUE 8 satellite): a fake 0.0
+            # skewed every dashboard's busy-series percentiles.
+            RECORDER.observe_request(
+                endpoint, time.monotonic() - t0, status="busy"
+            )
             return 503, {"error": "the server is busy now, please try again later"}
         _mark_request_snapshot(False)  # until a refresh says otherwise
         tr = tracing.start_trace(endpoint, request_id=rid)
@@ -775,6 +1098,13 @@ def request_deadline(headers) -> Optional[Deadline]:
 
 def make_handler(server: SimonServer):
     class Handler(BaseHTTPRequestHandler):
+        # keep-alive (ISSUE 8): every response carries Content-Length, so
+        # HTTP/1.1 persistent connections are safe — a closed-loop client
+        # pays one TCP connect + one handler thread per WORKER instead of
+        # per request (the per-request connection churn dominated serving
+        # latency under load)
+        protocol_version = "HTTP/1.1"
+
         def log_message(self, fmt, *args):  # quiet by default
             pass
 
@@ -794,6 +1124,7 @@ def make_handler(server: SimonServer):
                 tracing.sanitize_request_id(self.headers.get("X-Simon-Request-Id"))
                 or tracing.new_request_id()
             )
+            _REQUEST_STATE.extra_headers = {}
 
         def _access_log(self, code: int) -> None:
             """Opt-in structured access logging (``OPENSIM_ACCESS_LOG=1``):
@@ -843,7 +1174,8 @@ def make_handler(server: SimonServer):
                 self._send(200, {"status": "ok"})
             elif self.path == "/metrics":
                 data = METRICS.render(
-                    prep_cache=server.prep_cache, watch=server.watch
+                    prep_cache=server.prep_cache, watch=server.watch,
+                    admission=server.admission,
                 ).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -933,7 +1265,7 @@ def make_handler(server: SimonServer):
             # snapshot (apiserver down through every retry) says so. Read
             # per-request (thread-local), not off the shared server flag —
             # a concurrent refresh must not mis-tag this response.
-            extra = {}
+            extra = dict(response_extra_headers())  # e.g. Retry-After on shed
             if request_served_stale():
                 extra["X-Simon-Snapshot"] = "stale"
             self._send(code, body, extra_headers=extra or None)
@@ -981,12 +1313,17 @@ def serve(
         else:
             supervisor.start()
     httpd = ThreadingHTTPServer(("0.0.0.0", port), make_handler(server))
-    print(f"simon server listening on :{port}" + (" (live twin)" if supervisor else ""))
+    mode = "admission queue" if server.admission is not None else "single-flight"
+    print(
+        f"simon server listening on :{port} [{mode}]"
+        + (" (live twin)" if supervisor else "")
+    )
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        server.close()
         if supervisor is not None:
             supervisor.stop()
     return 0

@@ -756,3 +756,74 @@ def test_reason_literal_repo_is_clean():
     root = os.path.join(os.path.dirname(__file__), "..", "opensim_tpu")
     findings = [f for f in lint_paths([root]) if f.code == "OSL901"]
     assert findings == [], [f"{f.path}:{f.line}" for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# OSL1001 admission-lock-io (ISSUE 8 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_admission_lock_io_flags_blocking_calls_under_lock():
+    src = """
+    import time, urllib.request
+
+    class Controller:
+        def submit(self, t):
+            with self._cond:
+                time.sleep(0.1)
+                self._queue.append(t)
+                self._cond.notify()
+
+        def drain(self):
+            with self.lock:
+                urllib.request.urlopen("http://x")
+
+        def join_under_lock(self, fut):
+            with self._lock:
+                fut.result(timeout=3)
+    """
+    codes = _codes(src, path="opensim_tpu/server/admission.py",
+                   rules=["admission-lock-io"])
+    assert codes == ["OSL1001"] * 3
+
+
+def test_admission_lock_io_allows_cond_wait_and_queue_work():
+    src = """
+    class Controller:
+        def consume(self):
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                item = self._queue.popleft()
+                self._cond.notify_all()
+            return item
+
+        def other_wait_is_flagged(self, ev):
+            with self._cond:
+                ev.wait()
+    """
+    codes = _codes(src, path="opensim_tpu/server/admission.py",
+                   rules=["admission-lock-io"])
+    # cond.wait() on the held condition is the one legal wait; ev.wait()
+    # under the lock is the convoy maker
+    assert codes == ["OSL1001"]
+
+
+def test_admission_lock_io_scoped_to_serving_modules():
+    src = """
+    import time
+
+    def elsewhere(self):
+        with self.lock:
+            time.sleep(1)
+    """
+    assert _codes(src, path="opensim_tpu/engine/simulator.py",
+                  rules=["admission-lock-io"]) == []
+
+
+def test_admission_lock_io_repo_is_clean():
+    import os
+
+    root = os.path.join(os.path.dirname(__file__), "..", "opensim_tpu")
+    findings = [f for f in lint_paths([root]) if f.code == "OSL1001"]
+    assert findings == [], [f"{f.path}:{f.line}" for f in findings]

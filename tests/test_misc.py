@@ -264,7 +264,9 @@ def test_server_busy_rejection():
     from opensim_tpu.server import rest as rest_mod
     from opensim_tpu.server.rest import SimonServer, make_handler
 
-    with _serve(SimonServer(base_cluster=ResourceTypes())) as port:
+    # admission=False: the TryLock busy path is the OPENSIM_ADMISSION=off
+    # mode (the default routes through the admission queue, ISSUE 8)
+    with _serve(SimonServer(base_cluster=ResourceTypes(), admission=False)) as port:
         # hold the deploy lock like an in-flight simulation would
         assert rest_mod._deploy_lock.acquire(blocking=False)
         try:

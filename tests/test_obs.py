@@ -547,7 +547,9 @@ def test_bench_trace_flag_emits_chrome_json(tmp_path):
 def test_busy_rejection_lands_in_request_histogram():
     from opensim_tpu.server import rest
 
-    server = rest.SimonServer(base_cluster=_cluster())
+    # single-flight mode (admission=False): the TryLock busy path is the
+    # OPENSIM_ADMISSION=off configuration (ISSUE 8)
+    server = rest.SimonServer(base_cluster=_cluster(), admission=False)
     assert rest._deploy_lock.acquire(blocking=False)
     try:
         code, body = server.deploy_apps(_payload())
@@ -608,7 +610,10 @@ def test_metrics_exposition_conformance():
     bad = {"deployments": [fx.make_fake_deployment("nope", 1, "640", "1Gi").raw]}
     code, _ = server.deploy_apps(bad)
     assert code == 200
-    text = rest.METRICS.render(prep_cache=server.prep_cache)
+    # admission families (ISSUE 8) join the same conformance contract
+    text = rest.METRICS.render(
+        prep_cache=server.prep_cache, admission=server.admission
+    )
     helped, typed, seen_series = set(), {}, set()
     families_with_samples = set()
     for line in text.splitlines():
@@ -647,6 +652,9 @@ def test_metrics_exposition_conformance():
         "simon_filter_reject_total",
         "simon_unschedulable_total",
         "simon_request_seconds",
+        "simon_admission_queue_depth",
+        "simon_queue_wait_seconds",
+        "simon_batches_total",
     ):
         assert required in families_with_samples, f"{required} missing from /metrics"
 

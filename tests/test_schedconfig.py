@@ -682,3 +682,74 @@ def test_differing_profiles_capacity_sweep(tmp_path):
     # 12 pods x 2 cpu = 24 cpu; n0 has 8 => at least 1 new 16-cpu node
     assert "(added" in text
     assert "segmented multi-profile" in text  # engine footer names the path
+
+
+def test_sweep_auto_mixed_profiles_matches_solo_segmented(tmp_path):
+    """The ISSUE 8 satellite: DIFFERING profiles no longer raise in a
+    scenario sweep — they route through per-segment scans sharing each
+    scenario's carry, and every scenario's placements equal a solo
+    segmented simulate of that sub-cluster."""
+    import numpy as np
+
+    from opensim_tpu.engine.simulator import (
+        prepare, restore_bind_state, snapshot_bind_state,
+    )
+    from opensim_tpu.parallel import scenarios
+
+    cfg = _two_profile_config(tmp_path)
+    cluster = ResourceTypes()
+    for i in range(6):
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+    rt = ResourceTypes()
+    d1 = fx.make_fake_deployment("default-app", 5, "500m", "1Gi")
+    d2 = fx.make_fake_deployment("packer-app", 5, "500m", "1Gi")
+    d2.template_spec.scheduler_name = "packer"
+    rt.deployments.extend([d1, d2])
+    prep = prepare(cluster, [AppResource("a", rt)], node_pad=8)
+    P = len(prep.ordered)
+    N = int(np.asarray(prep.ec_np.node_valid).shape[0])
+    ks = (3, 4, 6)
+    node_valid = np.zeros((len(ks), N), bool)
+    for s, k in enumerate(ks):
+        node_valid[s, :k] = True
+    res = scenarios.sweep_auto(prep, node_valid, np.ones((len(ks), P), bool), config=cfg)
+
+    snap = snapshot_bind_state(prep)
+    for s, k in enumerate(ks):
+        sub = ResourceTypes(nodes=cluster.nodes[:k])
+        solo = simulate(sub, [], prep=prep, node_valid=node_valid[s], sched_config=cfg)
+        restore_bind_state(prep, snap)
+        ch = np.asarray(res.chosen)[s]
+        assert len(solo.unscheduled_pods) == int(np.asarray(res.unscheduled)[s])
+        placed = {
+            f"{p.metadata.namespace}/{p.metadata.name}": ns.node.metadata.name
+            for ns in solo.node_status
+            for p in ns.pods
+        }
+        for i, pod in enumerate(prep.ordered):
+            key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+            got = prep.meta.node_names[ch[i]] if ch[i] >= 0 else None
+            assert placed.get(key) == got, (s, key)
+
+
+def test_sweep_auto_single_profile_still_routes_one_config(tmp_path):
+    """A multi-profile config whose referenced profiles RESOLVE identically
+    keeps the single-config sweep path (no segmented scans)."""
+    import numpy as np
+
+    from opensim_tpu.engine.simulator import prepare
+    from opensim_tpu.parallel import scenarios
+
+    cfg = _two_profile_config(tmp_path)
+    cluster = ResourceTypes()
+    for i in range(4):
+        cluster.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+    rt = ResourceTypes()
+    rt.deployments.append(fx.make_fake_deployment("only-default", 4, "500m", "1Gi"))
+    prep = prepare(cluster, [AppResource("a", rt)], node_pad=8)
+    P = len(prep.ordered)
+    N = int(np.asarray(prep.ec_np.node_valid).shape[0])
+    node_valid = np.zeros((2, N), bool)
+    node_valid[:, :4] = True
+    res = scenarios.sweep_auto(prep, node_valid, np.ones((2, P), bool), config=cfg)
+    assert list(np.asarray(res.unscheduled)) == [0, 0]

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Serving-core smoke gate (``make loadgen-smoke``, part of ``make verify``).
+
+The ISSUE 8 closed loop, shortened for CI:
+
+1. start the canned stub apiserver seeded with a small live cluster;
+2. boot TWO live-twin simon servers as subprocesses against it — one with
+   ``OPENSIM_ADMISSION=off`` (the seed's single-flight TryLock behavior),
+   one with the admission queue + cross-request batching (the default);
+3. drive each with the closed-loop load generator
+   (``opensim_tpu/server/loadgen.py``) at the same concurrency;
+4. assert the admission server sustains MORE QPS than the single-flight
+   baseline with zero errors, a bounded p99, and a non-empty
+   ``simon_batch_size`` histogram (batching actually engaged — a smoke
+   that passes with batching silently dead would gate nothing).
+
+The full-length run (the ≥4× acceptance number) is
+``python bench.py --config serving``; this gate uses shorter windows and a
+conservative margin so a loaded CI box never flakes.
+
+Exit 0 on success; 1 with a one-line reason per failed check.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fail(msg: str) -> int:
+    print(f"loadgen-smoke: FAIL: {msg}")
+    return 1
+
+
+def main() -> int:
+    from opensim_tpu.server.loadgen import run_stub_benchmark
+
+    report = run_stub_benchmark(
+        concurrency=16, duration_s=4.0, n_nodes=6, n_pods=12,
+        base_port=18850,
+    )
+    print(
+        "loadgen-smoke: single-flight "
+        f"{report['qps_single_flight']:.1f} qps vs admission "
+        f"{report['qps']:.1f} qps ({report['speedup']:.2f}x), "
+        f"{report['batches']} batches (mean size "
+        f"{report['mean_batch_size']:.1f}), p99 {report['p99_s'] or -1:.3f}s"
+    )
+    if report["admission"]["errors"]:
+        return fail(f"admission run had {report['admission']['errors']} errors")
+    if report["qps_single_flight"] <= 0:
+        return fail("single-flight baseline measured 0 qps")
+    # CI-safe margin: the acceptance-grade ≥4x number comes from the longer
+    # bench run; a loaded CI box still must show batching WINNING
+    if report["qps"] <= report["qps_single_flight"] * 1.1:
+        return fail(
+            f"admission qps {report['qps']} not above single-flight "
+            f"baseline {report['qps_single_flight']} (x1.1 margin)"
+        )
+    if report["batches"] < 1 or report["mean_batch_size"] < 2:
+        return fail(
+            "batch-size histogram empty or degenerate "
+            f"(batches={report['batches']}, mean={report['mean_batch_size']})"
+        )
+    if report["p99_s"] is None or report["p99_s"] > 5.0:
+        return fail(f"admission p99 unbounded: {report['p99_s']}")
+    print("loadgen-smoke: ok — " + json.dumps(
+        {k: report[k] for k in (
+            "qps_single_flight", "qps", "speedup", "mean_batch_size", "p99_s"
+        )}
+    ))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

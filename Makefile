@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke
+.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke
 
 all: test
 
@@ -62,8 +62,16 @@ explain-smoke:
 loadgen-smoke:
 	python tools/loadgen_smoke.py
 
-# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain + loadgen
-verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke
+# capacity-observatory gate (ISSUE 9, docs/observability.md): an event
+# storm against the stub apiserver must move the utilization/headroom
+# gauges with full-prepare count == bootstrap only (O(changes) refresh),
+# headroom bit-consistent with a fresh simulate probe, and the per-node
+# /metrics series capped at OPENSIM_CAPACITY_TOPK
+capacity-smoke:
+	python tools/capacity_smoke.py
+
+# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain + loadgen + capacity
+verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke
 
 # run the moment the TPU tunnel opens (tools/tpu_probe_loop.sh writes
 # /tmp/opensim-tpu-watch.up): compiled-Mosaic parity suite + full bench

@@ -18,6 +18,8 @@ Rules (short name = suppression id; see docs/static-analysis.md):
                               the reason-code registry (engine/reasons.py)
     OSL1001 admission-lock-io blocking I/O while holding the admission/
                               dispatch lock (server/admission.py)
+    OSL1101 metric-registry   metric-family registration outside
+                              obs/metrics.py's FAMILIES registry
 """
 
 from .core import (  # noqa: F401
@@ -40,6 +42,7 @@ from . import (  # noqa: F401,E402
     rules_dtype,
     rules_except,
     rules_jit,
+    rules_metrics,
     rules_obs,
     rules_reasons,
     rules_retry,

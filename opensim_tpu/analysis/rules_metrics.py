@@ -1,0 +1,73 @@
+"""metric-registry (OSL1101): metric-family registration stays in
+``obs/metrics.py``.
+
+The ``/metrics`` surface grew past thirty families across four modules
+(REST counters, watch supervisor, admission controller, capacity
+observatory). Cardinality governance — which families exist, what labels
+they carry, what a scrape can cost — only works if registration lives in
+ONE place: the ``FAMILIES`` registry in ``obs/metrics.py``. A family
+registered ad hoc elsewhere ships help text and label sets no reviewer of
+the registry ever sees, and the exposition-conformance test can pass while
+two modules render sibling families that drift apart.
+
+The rule flags, in any module other than ``obs/metrics.py``:
+
+- direct construction of ``CounterVec(...)`` / ``HistogramVec(...)`` —
+  use :func:`obs.metrics.make_counter` / :func:`obs.metrics.make_histogram`,
+  which force the family through the registry (and inherit its help text);
+- calls to ``exposition_headers(...)`` — use
+  :func:`obs.metrics.family_header`, which fails loudly on an unregistered
+  family name.
+
+Fix by adding the family to ``FAMILIES`` and constructing through the
+registry helpers; see docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import FileContext, Finding, Rule, dotted_name, register
+
+_BANNED_CALLS = {
+    "CounterVec": "make_counter",
+    "HistogramVec": "make_histogram",
+    "exposition_headers": "family_header",
+}
+
+
+def _leaf(node: ast.Call) -> str:
+    name = dotted_name(node.func)
+    if name:
+        return name.rsplit(".", 1)[-1]
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return ""
+
+
+@register
+class MetricRegistryRule(Rule):
+    name = "metric-registry"
+    code = "OSL1101"
+    description = "metric-family registration outside obs/metrics.py"
+    # the registry module necessarily constructs the primitives; tests
+    # exercise arbitrary families on purpose
+    exclude_paths = ("obs/metrics.py", "tests/")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = _leaf(node)
+            replacement = _BANNED_CALLS.get(leaf)
+            if replacement is None:
+                continue
+            yield self.finding(
+                ctx,
+                node,
+                f"{leaf}(...) registers a metric family outside "
+                f"obs/metrics.py; add the family to FAMILIES and use "
+                f"obs.metrics.{replacement}(...) so cardinality governance "
+                "stays in one place",
+            )

@@ -170,6 +170,40 @@ def build_parser() -> argparse.ArgumentParser:
     loadgen_p.add_argument("--timeout", type=float, default=60.0, help="per-request client timeout seconds")
     loadgen_p.add_argument("-o", "--output-file", default="", help="also write the JSON report to a file")
 
+    top_p = sub.add_parser(
+        "top",
+        help="live cluster capacity view (utilization, headroom, fragmentation)",
+        description=(
+            "render a live capacity view of the cluster a simon server "
+            "observes (docs/observability.md 'Watching cluster capacity'): "
+            "per-resource utilization/spread/fragmentation, headroom per "
+            "registered workload profile, the hottest nodes and pending "
+            "pressure — read from GET /api/cluster/report, the same "
+            "computation path as the text report tables. One shot by "
+            "default; --watch refreshes in place like kubectl top"
+        ),
+    )
+    top_p.add_argument("--url", required=True, help="base URL of the live server (http://host:port)")
+    top_p.add_argument("--json", action="store_true", help="print the raw report JSON instead of tables")
+    top_p.add_argument(
+        "--watch", action="store_true",
+        help="refresh the view in place until interrupted (Ctrl-C exits)",
+    )
+    top_p.add_argument(
+        "--interval", type=float, default=2.0,
+        help="--watch refresh interval in seconds (default 2)",
+    )
+    top_p.add_argument(
+        "--no-headroom", action="store_true",
+        help="skip the headroom probes (cheaper polling; utilization/"
+        "fragmentation only)",
+    )
+    top_p.add_argument(
+        "-e", "--extended-resources", default="",
+        help="comma-separated extended resource sections (gpu,open-local)",
+    )
+    top_p.add_argument("--timeout", type=float, default=60.0, help="per-request client timeout seconds")
+
     sub.add_parser("version", help="print version", description="print version and commit id")
 
     doc_p = sub.add_parser(
@@ -331,10 +365,68 @@ def main(argv: Optional[List[str]] = None) -> int:
             with open(args.output_file, "w") as f:
                 f.write(line + "\n")
         return 0
+    if args.command == "top":
+        try:
+            return run_top(args)
+        except KeyboardInterrupt:
+            return 0
     if args.command == "gen-doc":
         return gen_doc(parser, args.output_dir)
     parser.print_help()
     return 2
+
+
+def run_top(args) -> int:
+    """``simon top``: the capacity observatory's live view — fetch
+    ``/api/cluster/report`` and render the same numbers the report tables
+    carry (one shot, ``--json``, or a ``--watch`` refresh loop)."""
+    import json as _json
+    import time as _time
+    import urllib.error
+    import urllib.parse
+    import urllib.request
+
+    from ..obs.capacity import format_top
+
+    params = {}
+    if args.no_headroom:
+        params["headroom"] = "0"
+    extended = [e for e in args.extended_resources.split(",") if e]
+    if extended:
+        params["extended"] = ",".join(extended)
+    url = f"{args.url.rstrip('/')}/api/cluster/report"
+    if params:
+        url += "?" + urllib.parse.urlencode(params)
+
+    def fetch() -> dict:
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            return _json.load(resp)
+
+    while True:
+        try:
+            report = fetch()
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            if args.watch:
+                # a dashboard must survive server restarts and transient
+                # blips (watch(1)/kubectl top semantics): report the error
+                # in place and keep polling until Ctrl-C
+                print(f"\x1b[2J\x1b[Hsimon top: {url}: {e} (retrying)", flush=True)
+                _time.sleep(max(0.1, args.interval))
+                continue
+            print(f"simon top: {url}: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            rendered = _json.dumps(report, indent=2, sort_keys=True)
+        else:
+            rendered = format_top(report).rstrip("\n")
+        if args.watch:
+            # clear + home, like watch(1)/kubectl top: the view refreshes
+            # in place instead of scrolling the terminal
+            print(f"\x1b[2J\x1b[H{rendered}", flush=True)
+            _time.sleep(max(0.1, args.interval))
+        else:
+            print(rendered)
+            return 0
 
 
 def _render_explanation(e, out) -> None:

@@ -100,6 +100,16 @@ def preempted(namespace: str, name: str) -> str:
     return Reason.PREEMPTED.message.format(pod=f"{namespace}/{name}")
 
 
+# the capacity observatory's cluster report (obs/capacity.py) lists pods
+# OBSERVED pending — no simulation ran, so there is no FitError breakdown
+# to render; the registered phrasing keeps OSL901's one-registry contract
+PENDING_OBSERVED = "pod is pending in the observed cluster (no node assigned)"
+
+
+def pending_observed() -> str:
+    return PENDING_OBSERVED
+
+
 @dataclass
 class ReasonCount:
     """One line of a FitError breakdown: ``count`` nodes rejected for

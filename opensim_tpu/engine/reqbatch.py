@@ -30,13 +30,14 @@ from __future__ import annotations
 import functools
 import os
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional, Union
 
 import jax
 import numpy as np
 
 from ..models.objects import ResourceTypes
 from ..obs import trace as obs
+from ..resilience.deadline import Deadline, DeadlineExceeded
 from .scheduler import ScheduleOutput, pad_pod_stream, scan_unroll, schedule_pods
 from .simulator import (
     AppResource,
@@ -69,6 +70,12 @@ class BatchItem:
     # pods (CacheEntry.base_drop), as indices over the BATCH stream
     drops: set = field(default_factory=set)
     explain: bool = False
+    # the rider's request deadline (NOTES.md rough edge, ISSUE 9
+    # satellite): enforced BETWEEN sequential C++ rider scans, so an
+    # in-flight batch sheds expired riders with the typed 504 instead of
+    # running them to completion (the vmapped XLA path is one atomic
+    # dispatch and keeps queue-boundary-only enforcement)
+    deadline: Optional[Deadline] = None
 
 
 def batch_engine_mode() -> str:
@@ -142,14 +149,21 @@ def _slice_output(batched: ScheduleOutput, s: int, P: int) -> ScheduleOutput:
 
 def run_request_batch(
     prep: Prepared, items: List[BatchItem]
-) -> List[SimulateResult]:
+) -> List[Union[SimulateResult, BaseException]]:
     """Schedule N requests' shared stream in one batched pass and
     demultiplex one :class:`SimulateResult` per request.
 
     The caller (``server/admission.py``) owns the base entry lock and the
     derived prep; this function only reads ``prep`` and restores the bind
     state it mutates. Results are bit-identical to solo runs of each
-    request (mask-invalid foreign pods never touch engine state)."""
+    request (mask-invalid foreign pods never touch engine state).
+
+    Deadline shedding (ISSUE 9 satellite): on the sequential C++ path the
+    rider's :class:`Deadline` is re-checked between scans — an expired
+    rider's slot comes back as a typed :class:`DeadlineExceeded`
+    (``phase="schedule"``) instead of a result, and its scan never runs.
+    Riders already scanned are unaffected (their placements are exactly a
+    solo run's)."""
     from . import nativepath
 
     P = len(prep.ordered)
@@ -181,12 +195,30 @@ def run_request_batch(
         "megakernel": "request-axis batches run on the vmapped XLA scan "
         "(or sequential C++ scans)",
     }
-    outs: List[ScheduleOutput] = []
+    outs: List[Optional[ScheduleOutput]] = []
+    shed: Dict[int, BaseException] = {}
     if use_native:
         engine_name = "native"
         skips["xla"] = "OPENSIM_BATCH_ENGINE routed the batch to the C++ engine"
         with obs.span("engine.native", requests=len(items), pods=P):
             for s in range(len(items)):
+                dl = items[s].deadline
+                if dl is not None and dl.expired():
+                    # shed BEFORE this rider's scan: its deadline died while
+                    # earlier riders ran — same typed 504 a solo run's
+                    # schedule boundary raises, without the wasted scan
+                    shed[s] = DeadlineExceeded(
+                        "request deadline exceeded at the 'schedule' phase "
+                        f"(shed between batched rider scans, over by "
+                        f"{-dl.remaining():.3f}s)",
+                        phase="schedule",
+                    )
+                    obs.event(
+                        "batch.rider_shed", status="deadline-exceeded",
+                        rider=s, over_by_s=round(-dl.remaining(), 6),
+                    )
+                    outs.append(None)
+                    continue
                 outs.append(nativepath.schedule(prep, pod_valid[s]))
     else:
         engine_name = "xla"
@@ -210,9 +242,12 @@ def run_request_batch(
 
     sf_rows = prep.tmpl_ids
     snap = snapshot_bind_state(prep)
-    results: List[SimulateResult] = []
+    results: List[Union[SimulateResult, BaseException]] = []
     with obs.span("decode", pods=P, requests=len(items)):
         for s, it in enumerate(items):
+            if s in shed:
+                results.append(shed[s])
+                continue
             out = outs[s]
             nstats = getattr(out, "native_stats", None)
             engine = EngineDecision(

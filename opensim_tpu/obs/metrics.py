@@ -26,11 +26,15 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "FAMILIES",
     "CounterVec",
     "HistogramVec",
     "MetricsRecorder",
     "RECORDER",
     "escape_label_value",
+    "family_header",
+    "make_counter",
+    "make_histogram",
 ]
 
 # fixed bucket upper bounds in seconds (the +Inf bucket is implicit):
@@ -40,13 +44,138 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     1.0, 2.5, 5.0, 10.0, 30.0,
 )
 
+#: batch sizes are small integers; the latency bucket ladder would waste
+#: every bucket past 32 — count buckets instead (server/admission.py)
+BATCH_SIZE_BUCKETS: Tuple[float, ...] = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
+
+#: watch-event application is µs-scale dict surgery; the request bucket
+#: ladder would collapse the whole distribution into its first bucket
+WATCH_APPLY_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.5,
+)
+
+#: per-node utilization is a ratio in [0, 1+] (requests can legitimately
+#: exceed allocatable on over-committed nodes) — capacity-shaped buckets
+UTILIZATION_BUCKETS: Tuple[float, ...] = (
+    0.1, 0.25, 0.5, 0.625, 0.75, 0.875, 0.95, 1.0,
+)
+
+#: THE metric-family registry: ``name -> (help, type)`` for every family
+#: the process can render. Family registration — names, help text, types,
+#: and therefore cardinality governance — lives HERE and nowhere else
+#: (opensim-lint OSL1101 bans ``CounterVec``/``HistogramVec`` construction
+#: and ``exposition_headers`` calls outside this module); other modules
+#: render their series through :func:`family_header` /
+#: :func:`make_counter` / :func:`make_histogram`.
+FAMILIES: Dict[str, Tuple[str, str]] = {
+    # serving counters (server/rest.py)
+    "simon_requests_total": ("Requests served by endpoint", "counter"),
+    "simon_simulations_total": ("Successful simulations", "counter"),
+    "simon_pods_scheduled_total": ("Pods placed across all simulations", "counter"),
+    "simon_pods_unscheduled_total": ("Pods left unschedulable", "counter"),
+    "simon_simulate_seconds_total": ("Wall seconds in successful simulations", "counter"),
+    "simon_prepare_seconds_total": ("Host-side expand+encode seconds", "counter"),
+    "simon_prep_cache_hits_total": ("Encode-cache hits", "counter"),
+    "simon_prep_cache_misses_total": ("Encode-cache misses", "counter"),
+    "simon_prep_cache_invalidations_total": ("Encode-cache invalidations", "counter"),
+    # resilience (docs/resilience.md)
+    "simon_request_timeouts_total": ("Requests 504ed at a deadline boundary", "counter"),
+    "simon_snapshot_fetch_retries_total": ("Snapshot fetch retry attempts", "counter"),
+    "simon_snapshot_stale_served_total": ("Requests served from a stale snapshot", "counter"),
+    "simon_stale_prep_retries_total": ("Stale prep-cache internal retries", "counter"),
+    "simon_native_steps_total": ("C++ engine scheduled steps by evaluation path", "counter"),
+    "simon_engine_breaker_trips_total": ("Engine circuit-breaker trips", "counter"),
+    "simon_engine_breaker_open": ("Engine breaker open (1) or closed (0)", "gauge"),
+    "simon_faults_injected_total": ("Chaos faults injected by point", "counter"),
+    # live twin (server/watch.py, docs/live-twin.md)
+    "simon_watch_state": ("Live-twin state machine (one-hot)", "gauge"),
+    "simon_watch_events_total": ("Watch events consumed by kind and resource", "counter"),
+    "simon_watch_reconnects_total": ("Watch stream reconnect attempts", "counter"),
+    "simon_watch_relists_total": ("Full relists (bootstrap/410/anti-entropy)", "counter"),
+    "simon_watch_gone_total": ("410 Gone resourceVersion expiries", "counter"),
+    "simon_twin_drift_total": ("Drifted objects repaired, by resource", "counter"),
+    "simon_twin_resyncs_total": ("Anti-entropy passes that found drift", "counter"),
+    "simon_twin_generation": ("Live-twin generation (bumps on every applied event)", "gauge"),
+    "simon_watch_apply_seconds": ("Watch-pipeline latency: event receipt to twin applied", "histogram"),
+    # admission / batching (server/admission.py, docs/serving.md)
+    "simon_admission_queue_depth": ("Requests waiting in the admission queue", "gauge"),
+    "simon_batches_total": ("Batched schedule dispatches", "counter"),
+    "simon_shed_total": ("Requests shed at the admission queue by reason", "counter"),
+    "simon_batch_size": ("Requests folded into one batched schedule dispatch", "histogram"),
+    "simon_queue_wait_seconds": ("Real time-in-queue from admission to execution start", "histogram"),
+    # latency + decision audit (this module's RECORDER)
+    "simon_phase_seconds": ("Per-phase latency from the request span trees", "histogram"),
+    "simon_request_seconds": ("Whole-request latency by endpoint and outcome", "histogram"),
+    "simon_filter_reject_total": (
+        "Nodes rejected per filter plugin while attributing unschedulable pods", "counter",
+    ),
+    "simon_unschedulable_total": ("Unschedulable pods by primary (most-rejecting) reason code", "counter"),
+    # capacity observatory (obs/capacity.py, docs/observability.md) —
+    # cardinality contract: every family below is label-free or bounded
+    # (resource ∈ {cpu, memory, pods}; profile = registered headroom
+    # profiles; node series are capped at the top-K hottest nodes)
+    "simon_cluster_utilization": ("Per-node utilization distribution by resource", "histogram"),
+    "simon_cluster_node_utilization": (
+        "Top-K hottest node utilization by resource (cardinality-capped)", "gauge",
+    ),
+    "simon_cluster_utilization_ratio": ("Aggregate requested/allocatable by resource", "gauge"),
+    "simon_cluster_allocatable": ("Cluster-wide allocatable by resource", "gauge"),
+    "simon_cluster_requested": ("Cluster-wide requests of counted pods by resource", "gauge"),
+    "simon_cluster_spread": ("Allocation spread: stddev/mean of per-node utilization", "gauge"),
+    "simon_cluster_fragmentation": (
+        "Free-capacity fragmentation: 1 - largest free node / total free", "gauge",
+    ),
+    "simon_cluster_headroom": (
+        "Max additional replicas of a registered workload profile that still fit", "gauge",
+    ),
+    "simon_cluster_nodes": ("Nodes in the observed cluster", "gauge"),
+    "simon_cluster_pods_bound": ("Counted pods bound to a node", "gauge"),
+    "simon_cluster_pods_pending": ("Counted pods with no node (unschedulable pressure)", "gauge"),
+}
+
 
 def exposition_headers(name: str, help_text: str, kind: str = "counter") -> List[str]:
     """The ``# HELP``/``# TYPE`` header pair every rendered family carries
     (exposition-format conformance, ISSUE 7 satellite) — the one place the
-    header layout lives, shared by the REST counters and the watch
-    supervisor's series."""
+    header layout lives. Prefer :func:`family_header`, which also forces the
+    family through the registry above."""
     return [f"# HELP {name} {help_text}", f"# TYPE {name} {kind}"]
+
+
+def family_header(name: str) -> List[str]:
+    """``# HELP``/``# TYPE`` for a REGISTERED family — the only way modules
+    outside this file emit headers (OSL1101), so an unregistered family
+    fails loudly at render time instead of silently forking the registry."""
+    try:
+        help_text, kind = FAMILIES[name]
+    except KeyError:
+        raise KeyError(
+            f"metric family {name!r} is not registered in obs/metrics.py "
+            "FAMILIES; register it there (cardinality governance)"
+        ) from None
+    return exposition_headers(name, help_text, kind)
+
+
+def make_counter(name: str, label_names: Sequence[str]) -> "CounterVec":
+    """A :class:`CounterVec` for a registered family (help text comes from
+    the registry)."""
+    help_text, kind = FAMILIES[name]  # KeyError = unregistered family
+    if kind != "counter":
+        raise ValueError(f"{name} is registered as {kind}, not counter")
+    return CounterVec(name, label_names, help=help_text)
+
+
+def make_histogram(
+    name: str,
+    label_names: Sequence[str],
+    buckets: Sequence[float] = DEFAULT_BUCKETS,
+) -> "HistogramVec":
+    """A :class:`HistogramVec` for a registered family."""
+    help_text, kind = FAMILIES[name]  # KeyError = unregistered family
+    if kind != "histogram":
+        raise ValueError(f"{name} is registered as {kind}, not histogram")
+    return HistogramVec(name, label_names, buckets=buckets, help=help_text)
 
 
 def escape_label_value(value: str) -> str:
@@ -168,24 +297,17 @@ class MetricsRecorder:
 
     def __init__(self) -> None:
         self.lock = threading.RLock()
-        self.phase_seconds = HistogramVec(
-            "simon_phase_seconds", ("phase", "endpoint"),
-            help="Per-phase latency from the request span trees",
-        )
-        self.request_seconds = HistogramVec(
-            "simon_request_seconds", ("endpoint", "status"),
-            help="Whole-request latency by endpoint and outcome",
-        )
+        self.phase_seconds = make_histogram("simon_phase_seconds", ("phase", "endpoint"))
+        self.request_seconds = make_histogram("simon_request_seconds", ("endpoint", "status"))
         # decision audit (ISSUE 7): per-filter node rejects from the
         # failure attribution, and unschedulable pods by primary reason —
         # bumped by every simulate() regardless of explain mode
-        self.filter_rejects = CounterVec(
-            "simon_filter_reject_total", ("filter",),
-            help="Nodes rejected per filter plugin while attributing unschedulable pods",
-        )
-        self.unschedulable = CounterVec(
-            "simon_unschedulable_total", ("reason",),
-            help="Unschedulable pods by primary (most-rejecting) reason code",
+        self.filter_rejects = make_counter("simon_filter_reject_total", ("filter",))
+        self.unschedulable = make_counter("simon_unschedulable_total", ("reason",))
+        # watch-pipeline latency (ISSUE 9 satellite): event receipt → twin
+        # applied, fed from the supervisor's dispatch (server/watch.py)
+        self.watch_apply = make_histogram(
+            "simon_watch_apply_seconds", (), buckets=WATCH_APPLY_BUCKETS
         )
 
     def observe_request(self, endpoint: str, seconds: float, status: str = "ok") -> None:
@@ -199,6 +321,13 @@ class MetricsRecorder:
     def observe_phase(self, phase: str, endpoint: str, seconds: float) -> None:
         with self.lock:
             self.phase_seconds.observe(seconds, (phase, endpoint))
+
+    def observe_watch_apply(self, seconds: float) -> None:
+        """One watch event's receipt→applied latency (server/watch.py
+        dispatch — includes the injected-fault bookkeeping and the twin's
+        rv-monotonic store surgery, not the network read)."""
+        with self.lock:
+            self.watch_apply.observe(seconds, ())
 
     def observe_trace(self, trace) -> None:
         """The span sink: fold a finished trace's phase spans into the
@@ -243,6 +372,7 @@ class MetricsRecorder:
                 + self.unschedulable.render_lines()
                 + self.phase_seconds.render_lines()
                 + self.request_seconds.render_lines()
+                + self.watch_apply.render_lines()
             )
 
     def reset(self) -> None:
@@ -251,6 +381,7 @@ class MetricsRecorder:
             self.request_seconds.reset()
             self.filter_rejects.reset()
             self.unschedulable.reset()
+            self.watch_apply.reset()
 
 
 RECORDER = MetricsRecorder()

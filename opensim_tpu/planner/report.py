@@ -1,12 +1,19 @@
 """Report rendering — plain-text parity with the pterm tables of
 ``pkg/apply/apply.go:309-687`` (Node Info, Extended Resource Info, Pod Info,
-App Info)."""
+App Info).
+
+ONE computation path (ISSUE 9): every table is built by a ``*_rows``
+function returning the formatted cells (header row first), and both
+consumers — the text renderer below and the ``GET /api/cluster/report``
+JSON endpoint (``obs/capacity.build_report``) — print/serialize those rows
+verbatim. The report-parity test asserts the JSON cells are byte-equal to
+the text table's cells, so the two surfaces cannot drift."""
 
 from __future__ import annotations
 
 import json
 import sys
-from typing import List, TextIO
+from typing import Dict, List, Optional, TextIO
 
 from ..engine.simulator import SimulateResult
 from ..models.objects import (
@@ -51,14 +58,18 @@ def report(
     report_app_info(result, app_names, out)
 
 
-def report_node_info(
-    result: SimulateResult, extended: List[str], nodes: List[str], out: TextIO
-) -> None:
+# ---------------------------------------------------------------------------
+# row builders (header row first; cells pre-formatted)
+# ---------------------------------------------------------------------------
+
+
+def pod_info_rows(
+    result: SimulateResult, extended: List[str], nodes: List[str]
+) -> List[List[str]]:
     """Pod Info per node — reportNodeInfo (apply.go:528-597); the reference
     prompts for the node selection, here the caller passes it (empty list =
     every node)."""
     selected = set(nodes) if nodes else {ns.node.metadata.name for ns in result.node_status}
-    print("Pod Info", file=out)
     header = ["Node", "Pod", "App Name", "CPU Requests", "Memory Requests"]
     if contains_local_storage(extended):
         header.append("Volume Request")
@@ -86,12 +97,11 @@ def report_node_info(
             if contains_gpu(extended):
                 row.append(format_quantity(pod.gpu_mem_request() * pod.gpu_count_request()))
             rows.append(row)
-    _table(rows, out)
-    print("", file=out)
+    return rows
 
 
-def report_cluster_info(result: SimulateResult, extended: List[str], out: TextIO) -> None:
-    print("Node Info", file=out)
+def cluster_info_rows(result: SimulateResult, extended: List[str]) -> List[List[str]]:
+    """Node Info — the capacity report's headline table (apply.go:309-400)."""
     header = ["Node", "CPU Allocatable", "CPU Requests", "Memory Allocatable", "Memory Requests"]
     if contains_gpu(extended):
         header += ["GPU Mem Allocatable", "GPU Mem Requests"]
@@ -119,107 +129,103 @@ def report_cluster_info(result: SimulateResult, extended: List[str], out: TextIO
             ]
         row += [str(len(status.pods)), "√" if LABEL_NEW_NODE in node.metadata.labels else ""]
         rows.append(row)
-    _table(rows, out)
-    print("", file=out)
+    return rows
 
-    if contains_local_storage(extended):
-        print("Extended Resource Info", file=out)
-        print("Node Local Storage", file=out)
-        rows = [["Node", "Storage Kind", "Storage Name", "Storage Allocatable", "Storage Requests"]]
-        for status in result.node_status:
-            anno = status.node.metadata.annotations.get(ANNO_NODE_LOCAL_STORAGE)
-            if not anno:
-                continue
-            try:
-                storage = json.loads(anno)
-            except ValueError:
-                continue
-            for vg in storage.get("vgs") or []:
-                cap = float(vg.get("capacity", 0) or 0)
-                req = float(vg.get("requested", 0) or 0)
-                rows.append(
-                    [
-                        status.node.metadata.name,
-                        "VG",
-                        vg.get("name", ""),
-                        format_quantity(cap),
-                        f"{format_quantity(req)}({int(req / cap * 100) if cap else 0}%)",
-                    ]
-                )
-            for dev in storage.get("devices") or []:
-                rows.append(
-                    [
-                        status.node.metadata.name,
-                        f"Device({dev.get('mediaType', '')})",
-                        dev.get("device", ""),
-                        format_quantity(float(dev.get("capacity", 0) or 0)),
-                        "used" if dev.get("isAllocated") else "unused",
-                    ]
-                )
-        _table(rows, out)
-        print("", file=out)
 
-    if contains_gpu(extended):
-        print("GPU Node Resource", file=out)
-        rows = [["Node", "GPU ID", "GPU Request/Capacity", "Pod List"]]
-        pod_list = []
-        for status in result.node_status:
-            pod_list.extend(status.pods)
-            anno = status.node.metadata.annotations.get(ANNO_NODE_GPU_SHARE)
-            if not anno:
+def local_storage_rows(result: SimulateResult) -> List[List[str]]:
+    """Node Local Storage — Extended Resource Info (apply.go:402-470)."""
+    rows = [["Node", "Storage Kind", "Storage Name", "Storage Allocatable", "Storage Requests"]]
+    for status in result.node_status:
+        anno = status.node.metadata.annotations.get(ANNO_NODE_LOCAL_STORAGE)
+        if not anno:
+            continue
+        try:
+            storage = json.loads(anno)
+        except ValueError:
+            continue
+        for vg in storage.get("vgs") or []:
+            cap = float(vg.get("capacity", 0) or 0)
+            req = float(vg.get("requested", 0) or 0)
+            rows.append(
+                [
+                    status.node.metadata.name,
+                    "VG",
+                    vg.get("name", ""),
+                    format_quantity(cap),
+                    f"{format_quantity(req)}({int(req / cap * 100) if cap else 0}%)",
+                ]
+            )
+        for dev in storage.get("devices") or []:
+            rows.append(
+                [
+                    status.node.metadata.name,
+                    f"Device({dev.get('mediaType', '')})",
+                    dev.get("device", ""),
+                    format_quantity(float(dev.get("capacity", 0) or 0)),
+                    "used" if dev.get("isAllocated") else "unused",
+                ]
+            )
+    return rows
+
+
+def gpu_node_rows(result: SimulateResult) -> List[List[str]]:
+    """GPU Node Resource (apply.go:472-526)."""
+    rows = [["Node", "GPU ID", "GPU Request/Capacity", "Pod List"]]
+    for status in result.node_status:
+        anno = status.node.metadata.annotations.get(ANNO_NODE_GPU_SHARE)
+        if not anno:
+            continue
+        try:
+            info = json.loads(anno)
+        except ValueError:
+            continue
+        total = float(info.get("GpuTotalMemory", 0))
+        used = sum(float(d.get("GpuUsedMemory", 0)) for d in (info.get("DevsBrief") or {}).values())
+        rows.append(
+            [
+                f"{status.node.metadata.name} ({info.get('GpuModel', 'N/A')})",
+                f"{info.get('GpuCount', 0)} GPUs",
+                f"{format_quantity(used)}/{format_quantity(total)}({int(used / total * 100) if total else 0}%)",
+                f"{info.get('NumPods', 0)} Pods",
+            ]
+        )
+        for idx, dev in sorted((info.get("DevsBrief") or {}).items()):
+            dtot = float(dev.get("GpuTotalMemory", 0))
+            if dtot <= 0:
                 continue
-            try:
-                info = json.loads(anno)
-            except ValueError:
-                continue
-            total = float(info.get("GpuTotalMemory", 0))
-            used = sum(float(d.get("GpuUsedMemory", 0)) for d in (info.get("DevsBrief") or {}).values())
+            dused = float(dev.get("GpuUsedMemory", 0))
             rows.append(
                 [
                     f"{status.node.metadata.name} ({info.get('GpuModel', 'N/A')})",
-                    f"{info.get('GpuCount', 0)} GPUs",
-                    f"{format_quantity(used)}/{format_quantity(total)}({int(used / total * 100) if total else 0}%)",
-                    f"{info.get('NumPods', 0)} Pods",
+                    str(idx),
+                    f"{format_quantity(dused)}/{format_quantity(dtot)}({int(dused / dtot * 100) if dtot else 0}%)",
+                    str(dev.get("PodList") or []),
                 ]
             )
-            for idx, dev in sorted((info.get("DevsBrief") or {}).items()):
-                dtot = float(dev.get("GpuTotalMemory", 0))
-                if dtot <= 0:
-                    continue
-                dused = float(dev.get("GpuUsedMemory", 0))
-                rows.append(
-                    [
-                        f"{status.node.metadata.name} ({info.get('GpuModel', 'N/A')})",
-                        str(idx),
-                        f"{format_quantity(dused)}/{format_quantity(dtot)}({int(dused / dtot * 100) if dtot else 0}%)",
-                        str(dev.get("PodList") or []),
-                    ]
-                )
-        _table(rows, out)
-
-        print("\nPod -> Node Map", file=out)
-        rows = [["Pod", "CPU Req", "Mem Req", "GPU Req", "Host Node", "GPU IDX"]]
-        for pod in sorted(pod_list, key=lambda p: p.metadata.name):
-            req = pod.resource_requests()
-            rows.append(
-                [
-                    pod.metadata.name,
-                    format_milli(int(req.get("cpu", 0.0) * 1000)),
-                    format_quantity(req.get("memory", 0.0)),
-                    format_quantity(pod.gpu_mem_request() * pod.gpu_count_request()),
-                    pod.spec.node_name,
-                    pod.metadata.annotations.get(ANNO_GPU_INDEX, ""),
-                ]
-            )
-        _table(rows, out)
-        print("", file=out)
+    return rows
 
 
-def report_app_info(result: SimulateResult, app_names: List[str], out: TextIO) -> None:
+def gpu_pod_map_rows(result: SimulateResult) -> List[List[str]]:
+    """Pod -> Node Map (the GPU report's companion table)."""
+    pod_list = [p for status in result.node_status for p in status.pods]
+    rows = [["Pod", "CPU Req", "Mem Req", "GPU Req", "Host Node", "GPU IDX"]]
+    for pod in sorted(pod_list, key=lambda p: p.metadata.name):
+        req = pod.resource_requests()
+        rows.append(
+            [
+                pod.metadata.name,
+                format_milli(int(req.get("cpu", 0.0) * 1000)),
+                format_quantity(req.get("memory", 0.0)),
+                format_quantity(pod.gpu_mem_request() * pod.gpu_count_request()),
+                pod.spec.node_name,
+                pod.metadata.annotations.get(ANNO_GPU_INDEX, ""),
+            ]
+        )
+    return rows
+
+
+def app_info_rows(result: SimulateResult, app_names: List[str]) -> List[List[str]]:
     """App Info — pods per app per node (reportAppInfo, apply.go:598-687)."""
-    if not app_names:
-        return
-    print("App Info", file=out)
     rows = [["App", "Pod Count", "Nodes"]]
     for app in app_names:
         pods = [
@@ -230,5 +236,69 @@ def report_app_info(result: SimulateResult, app_names: List[str], out: TextIO) -
         ]
         nodes = sorted({p.spec.node_name for p in pods})
         rows.append([app, str(len(pods)), ",".join(nodes)])
-    _table(rows, out)
+    return rows
+
+
+def _table_dict(rows: List[List[str]]) -> Dict[str, object]:
+    return {"header": rows[0], "rows": rows[1:]}
+
+
+def report_data(
+    result: SimulateResult,
+    extended: List[str],
+    app_names: List[str],
+    pod_nodes: Optional[List[str]] = None,
+) -> dict:
+    """The structured report — the same rows the text tables print, keyed
+    by section (``GET /api/cluster/report`` serializes this verbatim)."""
+    out: dict = {"nodeInfo": _table_dict(cluster_info_rows(result, extended))}
+    if contains_local_storage(extended):
+        out["localStorage"] = _table_dict(local_storage_rows(result))
+    if contains_gpu(extended):
+        out["gpuNodes"] = _table_dict(gpu_node_rows(result))
+        out["gpuPodMap"] = _table_dict(gpu_pod_map_rows(result))
+    if app_names:
+        out["appInfo"] = _table_dict(app_info_rows(result, app_names))
+    if pod_nodes is not None:
+        out["podInfo"] = _table_dict(pod_info_rows(result, extended, pod_nodes))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# text renderers (print the SAME rows)
+# ---------------------------------------------------------------------------
+
+
+def report_node_info(
+    result: SimulateResult, extended: List[str], nodes: List[str], out: TextIO
+) -> None:
+    print("Pod Info", file=out)
+    _table(pod_info_rows(result, extended, nodes), out)
+    print("", file=out)
+
+
+def report_cluster_info(result: SimulateResult, extended: List[str], out: TextIO) -> None:
+    print("Node Info", file=out)
+    _table(cluster_info_rows(result, extended), out)
+    print("", file=out)
+
+    if contains_local_storage(extended):
+        print("Extended Resource Info", file=out)
+        print("Node Local Storage", file=out)
+        _table(local_storage_rows(result), out)
+        print("", file=out)
+
+    if contains_gpu(extended):
+        print("GPU Node Resource", file=out)
+        _table(gpu_node_rows(result), out)
+        print("\nPod -> Node Map", file=out)
+        _table(gpu_pod_map_rows(result), out)
+        print("", file=out)
+
+
+def report_app_info(result: SimulateResult, app_names: List[str], out: TextIO) -> None:
+    if not app_names:
+        return
+    print("App Info", file=out)
+    _table(app_info_rows(result, app_names), out)
     print("", file=out)

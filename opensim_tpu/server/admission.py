@@ -44,7 +44,13 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
-from ..obs.metrics import RECORDER, CounterVec, HistogramVec, exposition_headers
+from ..obs.metrics import (
+    BATCH_SIZE_BUCKETS,
+    RECORDER,
+    family_header,
+    make_counter,
+    make_histogram,
+)
 from ..resilience.deadline import Deadline, DeadlineExceeded
 
 log = logging.getLogger("opensim_tpu.server")
@@ -58,11 +64,6 @@ __all__ = [
     "queue_bound",
     "batch_max",
 ]
-
-#: batch sizes are small integers; the latency bucket ladder would waste
-#: every bucket past 32 — count buckets instead
-BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0)
-
 
 def _env_float(name: str, default: float, lo: float = 0.0) -> float:
     raw = os.environ.get(name, "")
@@ -181,20 +182,12 @@ class AdmissionController:
         self._cond = threading.Condition()
         self._closed = False
         self._thread: Optional[threading.Thread] = None
-        # telemetry (rendered into /metrics via metrics_lines): all
-        # mutations under the ONE recorder lock like every other family
-        self.shed = CounterVec(
-            "simon_shed_total", ("reason",),
-            help="Requests shed at the admission queue by reason",
-        )
-        self.batch_sizes = HistogramVec(
-            "simon_batch_size", (), buckets=BATCH_SIZE_BUCKETS,
-            help="Requests folded into one batched schedule dispatch",
-        )
-        self.queue_wait = HistogramVec(
-            "simon_queue_wait_seconds", (),
-            help="Real time-in-queue from admission to execution start",
-        )
+        # telemetry (rendered into /metrics via metrics_lines): families
+        # come from the obs/metrics.py registry (OSL1101), all mutations
+        # under the ONE recorder lock like every other family
+        self.shed = make_counter("simon_shed_total", ("reason",))
+        self.batch_sizes = make_histogram("simon_batch_size", (), buckets=BATCH_SIZE_BUCKETS)
+        self.queue_wait = make_histogram("simon_queue_wait_seconds", ())
         self.batches_total = 0
         self.ewma_service_s = 0.05  # drain-rate estimate for Retry-After
 
@@ -378,29 +371,16 @@ class AdmissionController:
     # -- /metrics -----------------------------------------------------------
 
     def metrics_lines(self) -> List[str]:
-        lines = list(
-            exposition_headers(
-                "simon_admission_queue_depth",
-                "Requests waiting in the admission queue",
-                "gauge",
-            )
-        )
+        lines = list(family_header("simon_admission_queue_depth"))
         lines.append(f"simon_admission_queue_depth {self.depth()}")
         with RECORDER.lock:
-            lines += exposition_headers(
-                "simon_batches_total", "Batched schedule dispatches"
-            )
+            lines += family_header("simon_batches_total")
             lines.append(f"simon_batches_total {self.batches_total}")
             shed = self.shed.render_lines()
             if not shed:
                 # conformance: the family must exist from the first scrape,
                 # not only after the first shed
-                shed = [
-                    *exposition_headers(
-                        "simon_shed_total",
-                        "Requests shed at the admission queue by reason",
-                    ),
-                ]
+                shed = family_header("simon_shed_total")
             lines += shed
             lines += self.batch_sizes.render_lines()
             lines += self.queue_wait.render_lines()

@@ -26,7 +26,7 @@ from typing import List, Optional
 from ..engine.simulator import AppResource, SimulateResult, simulate
 from ..models.objects import LABEL_APP_NAME, Node, ResourceTypes, object_from_dict
 from ..obs import trace as tracing
-from ..obs.metrics import RECORDER, escape_label_value, exposition_headers
+from ..obs.metrics import RECORDER, escape_label_value, family_header
 from ..obs.recorder import FLIGHT_RECORDER
 from ..resilience import breaker as breaker_mod
 from ..resilience import faults
@@ -125,69 +125,69 @@ class _Metrics:
         with self.lock:
             setattr(self, counter, getattr(self, counter) + n)
 
-    def render(self, prep_cache=None, watch=None, admission=None) -> str:
+    def render(self, prep_cache=None, watch=None, admission=None, capacity=None) -> str:
         from ..utils.trace import PREP_STATS
 
         esc = escape_label_value
-        hdr = exposition_headers  # every family carries # HELP + # TYPE
+        hdr = family_header  # every family comes from the obs/metrics.py registry
 
         with self.lock:
             lines = [
-                *hdr("simon_requests_total", "Requests served by endpoint"),
+                *hdr("simon_requests_total"),
                 *(
                     f'simon_requests_total{{endpoint="{esc(ep)}"}} {n}'
                     for ep, n in sorted(self.requests.items())
                 ),
-                *hdr("simon_simulations_total", "Successful simulations"),
+                *hdr("simon_simulations_total"),
                 f"simon_simulations_total {self.simulations}",
-                *hdr("simon_pods_scheduled_total", "Pods placed across all simulations"),
+                *hdr("simon_pods_scheduled_total"),
                 f"simon_pods_scheduled_total {self.pods_scheduled}",
-                *hdr("simon_pods_unscheduled_total", "Pods left unschedulable"),
+                *hdr("simon_pods_unscheduled_total"),
                 f"simon_pods_unscheduled_total {self.pods_unscheduled}",
-                *hdr("simon_simulate_seconds_total", "Wall seconds in successful simulations"),
+                *hdr("simon_simulate_seconds_total"),
                 f"simon_simulate_seconds_total {RECORDER.simulate_seconds_total():.6f}",
             ]
         # host-side prepare attribution (incremental prepare): total seconds
         # spent producing Prepared inputs, and the encode-cache counters
         lines += [
-            *hdr("simon_prepare_seconds_total", "Host-side expand+encode seconds"),
+            *hdr("simon_prepare_seconds_total"),
             f"simon_prepare_seconds_total {PREP_STATS.total_seconds():.6f}",
         ]
         if prep_cache is not None:
             st = prep_cache.stats
             lines += [
-                *hdr("simon_prep_cache_hits_total", "Encode-cache hits"),
+                *hdr("simon_prep_cache_hits_total"),
                 f"simon_prep_cache_hits_total {st.hits}",
-                *hdr("simon_prep_cache_misses_total", "Encode-cache misses"),
+                *hdr("simon_prep_cache_misses_total"),
                 f"simon_prep_cache_misses_total {st.misses}",
-                *hdr("simon_prep_cache_invalidations_total", "Encode-cache invalidations"),
+                *hdr("simon_prep_cache_invalidations_total"),
                 f"simon_prep_cache_invalidations_total {st.invalidations}",
             ]
         # resilience layer: deadline 504s, snapshot degradation, engine
         # breaker state, fault injections (docs/resilience.md)
         with self.lock:
             lines += [
-                *hdr("simon_request_timeouts_total", "Requests 504ed at a deadline boundary"),
+                *hdr("simon_request_timeouts_total"),
                 f"simon_request_timeouts_total {self.request_timeouts}",
-                *hdr("simon_snapshot_fetch_retries_total", "Snapshot fetch retry attempts"),
+                *hdr("simon_snapshot_fetch_retries_total"),
                 f"simon_snapshot_fetch_retries_total {self.snapshot_retries}",
-                *hdr("simon_snapshot_stale_served_total", "Requests served from a stale snapshot"),
+                *hdr("simon_snapshot_stale_served_total"),
                 f"simon_snapshot_stale_served_total {self.snapshot_stale_served}",
-                *hdr("simon_stale_prep_retries_total", "Stale prep-cache internal retries"),
+                *hdr("simon_stale_prep_retries_total"),
                 f"simon_stale_prep_retries_total {self.stale_prep_retries}",
-                *hdr("simon_native_steps_total", "C++ engine scheduled steps by evaluation path"),
+                *hdr("simon_native_steps_total"),
                 *(
                     f'simon_native_steps_total{{path="{esc(p)}"}} {n}'
                     for p, n in sorted(self.native_steps.items())
                 ),
             ]
         breakers = sorted(breaker_mod.all_breakers().items())
-        lines += hdr("simon_engine_breaker_trips_total", "Engine circuit-breaker trips")
+        lines += hdr("simon_engine_breaker_trips_total")
         lines += [
             f'simon_engine_breaker_trips_total{{engine="{esc(name)}"}} {br.trips_total}'
             for name, br in breakers
         ]
-        lines += hdr("simon_engine_breaker_open", "Engine breaker open (1) or closed (0)", "gauge")
+        lines += hdr("simon_engine_breaker_open")
         lines += [
             f'simon_engine_breaker_open{{engine="{esc(name)}"}} '
             f'{int(br.state() != "closed")}'
@@ -195,7 +195,7 @@ class _Metrics:
         ]
         fired = sorted(faults.fault_stats().items())
         if fired:
-            lines += hdr("simon_faults_injected_total", "Chaos faults injected by point")
+            lines += hdr("simon_faults_injected_total")
             lines += [
                 f'simon_faults_injected_total{{point="{esc(point)}"}} {n}'
                 for point, n in fired
@@ -209,6 +209,11 @@ class _Metrics:
         # shed counters, real time-in-queue
         if admission is not None:
             lines += admission.metrics_lines()
+        # capacity observatory (ISSUE 9, obs/capacity.py): per-node
+        # utilization distribution, top-K hottest nodes, spread/
+        # fragmentation gauges, headroom per registered profile
+        if capacity is not None:
+            lines += capacity.metrics_lines()
         # per-phase / per-endpoint latency histograms, computed from the
         # same spans the flight recorder serves (obs/metrics.py)
         lines += RECORDER.render_lines()
@@ -340,6 +345,7 @@ class SimonServer:
         prep_cache=None,
         watch=None,
         admission=None,
+        capacity=None,
     ):
         self.kubeconfig = kubeconfig
         self.master = master
@@ -393,6 +399,23 @@ class SimonServer:
                 solo_fn=self._admitted_solo, batch_fn=self._admitted_batch
             )
         self.admission = admission or None
+        # serializes headroom probes (they are expensive scans) and guards
+        # the published-generation watermark below
+        self._headroom_lock = threading.Lock()
+        self._headroom_pub_gen = -1
+        # capacity observatory (ISSUE 9, obs/capacity.py): always on —
+        # ``None`` builds the default engine, ``False`` disables. With a
+        # live twin the watch supervisor bootstraps and event-feeds it; on
+        # the polling/custom-cluster paths /api/cluster/report bootstraps
+        # it per snapshot key instead.
+        if capacity is None:
+            from ..obs.capacity import CapacityEngine
+
+            capacity = CapacityEngine()
+        self.capacity = capacity or None
+        if self.watch is not None and self.capacity is not None:
+            self.watch.capacity = self.capacity
+        self._headroom_key: Optional[str] = None
 
     def close(self) -> None:
         """Stop the admission dispatcher + worker pool (pending tickets are
@@ -546,6 +569,85 @@ class SimonServer:
                         self.prep_cache.invalidate(old_fp)
                 return self._snapshot, self._snapshot_fp
         return ResourceTypes(), "empty"
+
+    # -- capacity observatory (ISSUE 9) -------------------------------------
+
+    def _observed_cluster(self) -> tuple:
+        """(cluster, stable key) for the capacity view — the cache path's
+        (snapshot, fingerprint-or-generation) pair, or a content
+        fingerprint on the legacy cache-off path."""
+        if self.prep_cache is not None:
+            return self._snapshot_for_cache()
+        from ..engine.prepcache import fingerprint_cluster
+
+        cluster = self.current_cluster()
+        return cluster, fingerprint_cluster(cluster)
+
+    def _probe_headroom(self, cluster: ResourceTypes, key: str) -> dict:
+        """Headroom per registered profile, probed through the warm base
+        prep (one delta re-encode + batched mask-prefix scans — zero full
+        prepares once the base exists; creating a missing base IS the
+        serving path's bootstrap prepare). Keyed by the snapshot key: one
+        probe set per observed cluster state."""
+        from ..engine import prepcache
+        from ..obs import capacity as capacity_mod
+
+        if self.capacity is None:
+            return {}
+        # serialized: concurrent reports must not probe the same state
+        # twice, and a slow probe for an OLDER snapshot must not overwrite
+        # a newer probe's published gauges (the generation watermark below)
+        with self._headroom_lock:
+            if self._headroom_key == key:
+                return self.capacity.headroom()
+            gen0 = self.capacity.generation
+            profiles = capacity_mod.headroom_profiles()
+            base = None
+            if self.prep_cache is not None:
+                from ..engine.simulator import prepare
+
+                base_key = f"{key}|base"
+                base = self.prep_cache.get(base_key)
+                if base is None:
+                    watch = prepcache.watch_snapshot(cluster, [])  # before the build
+                    base = self.prep_cache.put(
+                        base_key,
+                        prepcache.CacheEntry(base_key, prepare(cluster, []), watch=watch),
+                    )
+                self.prep_cache.check_fresh(base)
+                if base.prep is None:
+                    base = None  # no schedulable pods cached; probe prepares fresh
+            out = {}
+            for profile in profiles:
+                out[profile.name] = capacity_mod.headroom_probe(
+                    cluster, profile, base=base,
+                    kmax=self.capacity.fit_upper_bound(profile),
+                )
+            if gen0 >= self._headroom_pub_gen:
+                self.capacity.set_headroom(out)
+                self._headroom_key = key
+                self._headroom_pub_gen = gen0
+            return out
+
+    def cluster_report(
+        self, extended: Optional[List[str]] = None, probe_headroom: bool = True
+    ) -> dict:
+        """The ``GET /api/cluster/report`` body: the capacity sample plus
+        the same table rows the text renderer prints
+        (``obs/capacity.build_report`` — one computation path, gated by the
+        report-parity test)."""
+        from ..obs import capacity as capacity_mod
+
+        if self.capacity is None:
+            raise RuntimeError("capacity observatory disabled (capacity=False)")
+        cluster, key = self._observed_cluster()
+        self.capacity.ensure_bootstrap(cluster, key)
+        if probe_headroom:
+            self._probe_headroom(cluster, key)
+        state = self.watch.state() if self.watch is not None else "polling"
+        return capacity_mod.build_report(
+            self.capacity, cluster, extended_resources=extended, state=state
+        )
 
     # -- handlers -----------------------------------------------------------
 
@@ -833,6 +935,9 @@ class SimonServer:
                     reqbatch.BatchItem(
                         cluster=cluster0, apps=[apps[s]],
                         lo=slices[s][0], hi=slices[s][1], drops=drops,
+                        # in-flight shedding (ISSUE 9 satellite): the C++
+                        # sequential path re-checks this between rider scans
+                        deadline=tickets[s].deadline,
                     )
                 )
             t1 = _time.monotonic()
@@ -842,6 +947,12 @@ class SimonServer:
                 base.restore()
             run_s = _time.monotonic() - t1
         for t, res in zip(tickets, results):
+            if isinstance(res, BaseException):
+                # a rider shed mid-batch (deadline expired between C++
+                # scans): transported like any executor error — the REST
+                # thread re-raises into its typed ladder (504 phase=schedule)
+                t.resolve(error=res, stale=stale)
+                continue
             tr = t.trace
             if tr is not None:
                 # synthetic phase spans: the shared batch work, attributed
@@ -1175,7 +1286,7 @@ def make_handler(server: SimonServer):
             elif self.path == "/metrics":
                 data = METRICS.render(
                     prep_cache=server.prep_cache, watch=server.watch,
-                    admission=server.admission,
+                    admission=server.admission, capacity=server.capacity,
                 ).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -1185,6 +1296,47 @@ def make_handler(server: SimonServer):
                 self.end_headers()
                 self.wfile.write(data)
                 self._access_log(200)
+            elif self.path.split("?", 1)[0] == "/api/cluster/report":
+                # capacity observatory (ISSUE 9, docs/observability.md):
+                # the live capacity report — SAME rows as the text renderer
+                from urllib.parse import parse_qs
+
+                q = parse_qs(self.path.partition("?")[2])
+                extended = [
+                    e for e in q.get("extended", [""])[-1].split(",") if e
+                ]
+                probe = q.get("headroom", ["1"])[-1] not in ("0", "false")
+                try:
+                    self._send(
+                        200,
+                        server.cluster_report(
+                            extended=extended, probe_headroom=probe
+                        ),
+                    )
+                except SnapshotUnavailable as e:
+                    self._send(503, {"error": str(e), "retryable": True})
+                except Exception as e:
+                    log.warning(
+                        "cluster report failed: %s: %s", type(e).__name__, e
+                    )
+                    self._send(500, {"error": str(e), "type": type(e).__name__})
+            elif self.path.split("?", 1)[0] == "/api/debug/capacity":
+                # the capacity timeline ring (obs/timeline.py): trend
+                # samples per twin generation for charting
+                if server.capacity is None:
+                    self._send(404, {"error": "capacity observatory disabled"})
+                else:
+                    server.capacity.sample()  # fold in the latest generation
+                    self._send(
+                        200,
+                        {
+                            "capacity": server.capacity.timeline.capacity,
+                            "samples": [
+                                s.to_dict()
+                                for s in server.capacity.timeline.snapshot()
+                            ],
+                        },
+                    )
             elif self.path == "/api/debug/requests":
                 # flight recorder (docs/observability.md): newest-first
                 # summaries of the last N request traces

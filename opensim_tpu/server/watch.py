@@ -66,7 +66,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 from ..models.objects import ResourceTypes
 from ..obs import trace as tracing
-from ..obs.metrics import RECORDER, escape_label_value, exposition_headers
+from ..obs.metrics import RECORDER, escape_label_value, family_header
 from ..obs.recorder import FLIGHT_RECORDER
 from ..resilience import faults
 from ..resilience.retry import retry_call
@@ -652,6 +652,11 @@ class WatchSupervisor:
             raise ValueError(f"unknown watch resource(s) {unknown}; known: {sorted(RESOURCE_BY_FIELD)}")
         self.source = source
         self.prep_cache = prep_cache
+        # capacity observatory (ISSUE 9, obs/capacity.py): when attached,
+        # the supervisor bootstraps it at sync/rebase and feeds it every
+        # ACCEPTED event — the O(1) aggregate update rides the same
+        # dispatch the prep delta does
+        self.capacity = None
         self.watched = tuple(watched)
         self.policy = policy or watch_policy()
         self.twin = ClusterTwin()
@@ -734,6 +739,14 @@ class WatchSupervisor:
                 # maintenance must never kill the supervisor; the request
                 # path rebuilds from scratch when the warm entry is missing
                 log.warning("twin prep maintenance failed: %s: %s", type(e).__name__, e)
+            if self.capacity is not None:
+                try:
+                    # generation-keyed and memoized: an idle tick is a dict
+                    # lookup, a busy one is one O(nodes) fold feeding the
+                    # capacity timeline (obs/timeline.py)
+                    self.capacity.sample()
+                except Exception as e:
+                    log.warning("capacity sampling failed: %s: %s", type(e).__name__, e)
             if time.monotonic() >= next_resync:
                 next_resync = time.monotonic() + self.policy["resync_s"]
                 try:
@@ -762,6 +775,7 @@ class WatchSupervisor:
                 self.twin.rebase_all(listing)
                 self._pending.clear()
                 self._prep_gen = self.twin.generation
+            self._capacity_rebase()
             self._boot_rvs = {f: rv for f, (_items, rv) in listing.items()}
             for field in self.watched:
                 self.note_traffic(field)
@@ -781,6 +795,7 @@ class WatchSupervisor:
             self.events_total[key] = self.events_total.get(key, 0) + 1
 
     def dispatch(self, field: str, ev_type: str, obj: dict) -> None:
+        t0 = time.monotonic()  # event receipt: the watch-apply clock starts
         self.count_event(
             ev_type if ev_type in ("ADDED", "MODIFIED", "DELETED") else "OTHER", field
         )
@@ -804,11 +819,27 @@ class WatchSupervisor:
             self._apply(field, ev_type, obj)
             if held is not None:
                 self._apply(field, *held)
+        # watch-pipeline latency (ISSUE 9 satellite): receipt → twin
+        # applied, for every event that reached application (dropped/held
+        # events never complete the pipeline on this call)
+        RECORDER.observe_watch_apply(time.monotonic() - t0)
 
     def _apply(self, field: str, ev_type: str, obj: dict) -> None:
         change = self.twin.apply_event(field, ev_type, obj)
         if change is None:
             return
+        if self.capacity is not None:
+            try:
+                self.capacity.on_twin_change(
+                    field, ev_type, obj, change, self.twin.generation
+                )
+            except Exception as e:
+                # observability must never break event application; the
+                # next bootstrap (rebase/anti-entropy) self-heals the view
+                log.warning(
+                    "capacity accounting failed (%s: %s); view may lag until "
+                    "the next rebase", type(e).__name__, e,
+                )
         with self._maint_lock:
             self._pending.append(change)
 
@@ -842,6 +873,7 @@ class WatchSupervisor:
                 self._pending.clear()
                 self._invalidate_prep()
                 self._prep_gen = self.twin.generation
+            self._capacity_rebase()
         self.note_traffic(field)  # a fresh list is proof of liveness
         self._down.discard(field)
         self._recompute_state()
@@ -903,6 +935,21 @@ class WatchSupervisor:
     def _invalidate_prep(self) -> None:
         if self.prep_cache is not None:
             self.prep_cache.invalidate(self.key_prefix)
+
+    def _capacity_rebase(self) -> None:
+        """Rebuild the capacity view from the twin after a list-shaped jump
+        (bootstrap, 410 rebase, anti-entropy repair) — the same moments the
+        prep lineage is dropped, and already O(cluster) paths."""
+        if self.capacity is None:
+            return
+        try:
+            with self.twin._lock:
+                cluster = self.twin.materialize()
+                gen = self.twin.generation
+            self.capacity.event_fed = True  # the supervisor owns the view now
+            self.capacity.bootstrap(cluster, gen)
+        except Exception as e:
+            log.warning("capacity rebase failed: %s: %s", type(e).__name__, e)
 
     def flush_pending(self) -> None:
         """Fold buffered twin changes into the warm prep-cache base entry —
@@ -1032,6 +1079,7 @@ class WatchSupervisor:
                         self._pending.clear()
                         self._invalidate_prep()
                         self._prep_gen = self.twin.generation
+                    self._capacity_rebase()
                     self._set_state("live")
                     self._recompute_state()
             if tr is not None:
@@ -1064,29 +1112,27 @@ class WatchSupervisor:
         the one recorder lock)."""
         esc = escape_label_value
         state = self.state()
-        hdr = exposition_headers  # shared # HELP/# TYPE header layout
+        hdr = family_header  # headers come from the obs/metrics.py registry
 
         with RECORDER.lock:
-            lines = hdr("simon_watch_state", "Live-twin state machine (one-hot)", "gauge")
+            lines = hdr("simon_watch_state")
             lines += [
                 f'simon_watch_state{{state="{esc(s)}"}} {int(s == state)}'
                 for s in STATES
             ]
-            lines += hdr(
-                "simon_watch_events_total", "Watch events consumed by kind and resource"
-            )
+            lines += hdr("simon_watch_events_total")
             lines += [
                 f'simon_watch_events_total{{kind="{esc(k)}",resource="{esc(res)}"}} {n}'
                 for (k, res), n in sorted(self.events_total.items())
             ]
             lines += [
-                *hdr("simon_watch_reconnects_total", "Watch stream reconnect attempts"),
+                *hdr("simon_watch_reconnects_total"),
                 f"simon_watch_reconnects_total {self.reconnects_total}",
-                *hdr("simon_watch_relists_total", "Full relists (bootstrap/410/anti-entropy)"),
+                *hdr("simon_watch_relists_total"),
                 f"simon_watch_relists_total {self.relists_total}",
-                *hdr("simon_watch_gone_total", "410 Gone resourceVersion expiries"),
+                *hdr("simon_watch_gone_total"),
                 f"simon_watch_gone_total {self.gone_total}",
-                *hdr("simon_twin_drift_total", "Drifted objects repaired, by resource"),
+                *hdr("simon_twin_drift_total"),
             ]
             # stable per-resource series from the first scrape: every
             # watched resource renders (0 until drift is attributed to it)
@@ -1097,7 +1143,12 @@ class WatchSupervisor:
                 for res, n in sorted(drift_res.items())
             ]
             lines += [
-                *hdr("simon_twin_resyncs_total", "Anti-entropy passes that found drift"),
+                *hdr("simon_twin_resyncs_total"),
                 f"simon_twin_resyncs_total {self.resyncs_total}",
+                # the generation gauge (ISSUE 9 satellite): every applied
+                # event bumps it — a flatlined generation under traffic is
+                # the "watch died" smoke signal dashboards alert on
+                *hdr("simon_twin_generation"),
+                f"simon_twin_generation {self.twin.generation}",
             ]
         return lines

@@ -610,9 +610,15 @@ def test_metrics_exposition_conformance():
     bad = {"deployments": [fx.make_fake_deployment("nope", 1, "640", "1Gi").raw]}
     code, _ = server.deploy_apps(bad)
     assert code == 200
+    # capacity families (ISSUE 9) render once the report has bootstrapped
+    # the observatory (headroom probes included)
+    server.cluster_report()
+    # watch-apply histogram (ISSUE 9 satellite) joins via the recorder
+    RECORDER.observe_watch_apply(0.0002)
     # admission families (ISSUE 8) join the same conformance contract
     text = rest.METRICS.render(
-        prep_cache=server.prep_cache, admission=server.admission
+        prep_cache=server.prep_cache, admission=server.admission,
+        capacity=server.capacity,
     )
     helped, typed, seen_series = set(), {}, set()
     families_with_samples = set()
@@ -655,14 +661,54 @@ def test_metrics_exposition_conformance():
         "simon_admission_queue_depth",
         "simon_queue_wait_seconds",
         "simon_batches_total",
+        # capacity observatory (ISSUE 9)
+        "simon_cluster_utilization",
+        "simon_cluster_utilization_ratio",
+        "simon_cluster_node_utilization",
+        "simon_cluster_allocatable",
+        "simon_cluster_requested",
+        "simon_cluster_spread",
+        "simon_cluster_fragmentation",
+        "simon_cluster_headroom",
+        "simon_cluster_nodes",
+        "simon_cluster_pods_bound",
+        "simon_cluster_pods_pending",
+        "simon_watch_apply_seconds",
     ):
         assert required in families_with_samples, f"{required} missing from /metrics"
+
+
+def test_capacity_node_series_capped_under_1k_node_twin():
+    """The per-node family stays cardinality-capped: a 1k-node cluster
+    renders exactly top-K node series per resource (ISSUE 9 acceptance),
+    and the whole capacity block stays exposition-conformant."""
+    from opensim_tpu.obs.capacity import RESOURCES, CapacityEngine
+
+    rt = ResourceTypes()
+    for i in range(1000):
+        rt.nodes.append(fx.make_fake_node(f"big{i:04d}", "16", "64Gi"))
+    for i in range(200):
+        rt.pods.append(
+            fx.make_fake_pod(f"p{i}", "500m", "1Gi", fx.with_node_name(f"big{i:04d}"))
+        )
+    engine = CapacityEngine(topk=10)
+    engine.bootstrap(rt, 1)
+    lines = engine.metrics_lines()
+    node_series = [l for l in lines if l.startswith("simon_cluster_node_utilization{")]
+    assert len(node_series) == 10 * len(RESOURCES)
+    # the cap keeps the HOTTEST nodes: every rendered node carries load
+    assert all("big0" in l for l in node_series)
+    for line in lines:
+        if line.startswith("#"):
+            assert line.startswith(("# HELP ", "# TYPE ")), line
+        else:
+            assert _SAMPLE_RE.match(line), f"bad sample line: {line!r}"
 
 
 def test_watch_metrics_lines_conform(tmp_path):
     """The live twin's labeled counters join the same conformance contract
     (resource-labeled events and drift series)."""
-    from opensim_tpu.server.watch import WatchSupervisor
+    from opensim_tpu.server.watch import ClusterTwin, WatchSupervisor
 
     sup = WatchSupervisor.__new__(WatchSupervisor)
     sup.watched = ("pods", "nodes")
@@ -673,9 +719,11 @@ def test_watch_metrics_lines_conform(tmp_path):
     sup.resyncs_total = 1
     sup._state = "live"
     sup._state_lock = threading.Lock()
+    sup.twin = ClusterTwin()
     lines = sup.metrics_lines()
     text = "\n".join(lines)
     assert 'simon_watch_events_total{kind="ADDED",resource="pods"} 3' in text
     assert 'simon_twin_drift_total{resource="pods"} 2' in text
     assert 'simon_twin_drift_total{resource="nodes"} 0' in text
     assert "# HELP simon_twin_drift_total" in text
+    assert "simon_twin_generation 0" in text

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Capacity-observatory smoke gate (``make capacity-smoke``, part of
+``make verify``) — the ISSUE 9 acceptance, end to end in one process:
+
+1. start the canned stub apiserver and a watch-mode REST server against it
+   (live twin + capacity engine attached);
+2. pull ``GET /api/cluster/report`` once — this bootstraps the warm base
+   prep (the ONLY full prepare the observatory is allowed) and probes
+   headroom through it;
+3. drive an event storm (pod binds, deletes, a node add) through the watch
+   stream and assert the utilization/pressure gauges move, the twin
+   generation advances, and the watch-apply histogram fills — with the
+   full-prepare count still at its post-bootstrap value (capacity refresh
+   is O(changes), never a rescan);
+4. re-probe headroom through the warm twin base and prove it bit-consistent
+   with a fresh cold ``simulate``-backed probe of the same cluster;
+5. sanity-check ``/metrics`` exposition (no duplicate series, per-node
+   series capped at OPENSIM_CAPACITY_TOPK) and the timeline export.
+
+Exit 0 on success; 1 with a one-line reason per failed check.
+"""
+
+import json
+import os
+import re
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("OPENSIM_CAPACITY_TOPK", "3")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fail(msg: str) -> int:
+    print(f"capacity-smoke: FAIL: {msg}")
+    return 1
+
+
+def _pod(name, node="", cpu="500m", mem="1Gi", phase="Running"):
+    d = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {
+            "containers": [
+                {"name": "c", "resources": {"requests": {"cpu": cpu, "memory": mem}}}
+            ]
+        },
+        "status": {"phase": phase},
+    }
+    if node:
+        d["spec"]["nodeName"] = node
+    return d
+
+
+def _wait(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _metric_value(text: str, needle: str):
+    for line in text.splitlines():
+        if line.startswith(needle):
+            return float(line.rsplit(None, 1)[1])
+    return None
+
+
+def main() -> int:
+    from http.server import ThreadingHTTPServer
+
+    from opensim_tpu.models import fixtures as fx
+    from opensim_tpu.obs import capacity as capacity_mod
+    from opensim_tpu.server import rest
+    from opensim_tpu.server.stubapi import StubApiServer
+    from opensim_tpu.server.watch import RestWatchSource, WatchSupervisor
+    from opensim_tpu.utils.trace import PREP_STATS
+
+    n_nodes = 6
+    stub = StubApiServer(bookmark_interval_s=0.1).start()
+    stub.seed(
+        "/api/v1/nodes",
+        [fx.make_fake_node(f"n{i}", "8", "16Gi").raw for i in range(n_nodes)],
+    )
+    stub.seed("/api/v1/pods", [_pod("seed-0", node="n0"), _pod("seed-1", node="n1")])
+    for p in (
+        "/apis/apps/v1/daemonsets", "/apis/policy/v1/poddisruptionbudgets",
+        "/api/v1/services", "/apis/storage.k8s.io/v1/storageclasses",
+        "/api/v1/persistentvolumeclaims", "/api/v1/configmaps",
+    ):
+        stub.seed(p, [])
+    tmp = tempfile.mkdtemp(prefix="capacity-smoke-")
+    kc = stub.kubeconfig(tmp)
+
+    policy = {"stale_s": 5.0, "resync_s": 0.0, "reconnects": 3, "backoff_s": 0.02}
+    sup = WatchSupervisor(RestWatchSource(kc, read_timeout_s=5.0), policy=policy)
+    server = rest.SimonServer(kubeconfig=kc, watch=sup)
+    sup.prep_cache = server.prep_cache
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), rest.make_handler(server))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def get(path):
+        with urllib.request.urlopen(f"{base}{path}", timeout=60) as resp:
+            raw = resp.read().decode()
+        return json.loads(raw) if path.startswith("/api") else raw
+
+    try:
+        if not sup.start(wait_s=15.0):
+            return fail("twin did not sync against the stub apiserver")
+
+        # --- bootstrap: the first report builds the warm base + probes ----
+        report0 = get("/api/cluster/report")
+        if report0["capacity"]["nodes"] != n_nodes:
+            return fail(f"report nodes {report0['capacity']['nodes']} != {n_nodes}")
+        if not report0["capacity"]["headroom"]:
+            return fail("bootstrap report carries no headroom probes")
+        metrics0 = get("/metrics")
+        util0 = _metric_value(metrics0, 'simon_cluster_utilization_ratio{resource="cpu"}')
+        bound0 = _metric_value(metrics0, "simon_cluster_pods_bound")
+        if util0 is None or bound0 != 2:
+            return fail(f"bootstrap gauges wrong (util={util0}, bound={bound0})")
+        full_after_bootstrap = PREP_STATS.counts.get("full", 0)
+        gen0 = sup.twin.generation
+
+        # --- event storm ---------------------------------------------------
+        # two delta-expressible waves (pod adds/deletes ride twin_pod_delta,
+        # the node add rides extend_with_nodes; a MIXED batch is the one
+        # shape that legitimately drops the warm lineage, so the storm
+        # flushes between waves exactly like the supervisor's tick would)
+        for i in range(12):
+            stub.upsert("/api/v1/pods", _pod(f"storm-{i}", node=f"n{i % n_nodes}", cpu="1"))
+        stub.delete("/api/v1/pods", "seed-0")
+        stub.upsert("/api/v1/pods", _pod("pending-0", cpu="250m"))
+        if not _wait(lambda: sup.twin.generation >= gen0 + 14):
+            return fail("pod storm never fully reached the twin")
+        sup.flush_pending()
+        gen1 = sup.twin.generation
+        stub.upsert("/api/v1/nodes", fx.make_fake_node(f"n{n_nodes}", "8", "16Gi").raw)
+        if not _wait(lambda: sup.twin.generation >= gen1 + 1):
+            return fail("node ADDED never reached the twin")
+        sup.flush_pending()
+
+        metrics1 = get("/metrics")
+        util1 = _metric_value(metrics1, 'simon_cluster_utilization_ratio{resource="cpu"}')
+        bound1 = _metric_value(metrics1, "simon_cluster_pods_bound")
+        pending1 = _metric_value(metrics1, "simon_cluster_pods_pending")
+        gen_gauge = _metric_value(metrics1, "simon_twin_generation")
+        applies = _metric_value(metrics1, "simon_watch_apply_seconds_count")
+        if bound1 != 13:  # 2 seed - 1 deleted + 12 storm
+            return fail(f"pods_bound gauge did not track the storm (got {bound1})")
+        if pending1 != 1:
+            return fail(f"pending gauge did not track the unbound pod (got {pending1})")
+        if util1 is None or util1 <= util0:
+            return fail(f"cpu utilization ratio did not rise ({util0} -> {util1})")
+        if gen_gauge != sup.twin.generation:
+            return fail(f"simon_twin_generation {gen_gauge} != twin {sup.twin.generation}")
+        if not applies or applies < 15:
+            return fail(f"simon_watch_apply_seconds saw only {applies} events")
+        if PREP_STATS.counts.get("full", 0) != full_after_bootstrap:
+            return fail(
+                "the event storm paid a full O(cluster) prepare "
+                f"({PREP_STATS.counts.get('full', 0)} != {full_after_bootstrap})"
+            )
+
+        # --- headroom: warm twin probe == fresh cold probe -----------------
+        report1 = get("/api/cluster/report")
+        warm = report1["capacity"]["headroom"]
+        if PREP_STATS.counts.get("full", 0) != full_after_bootstrap:
+            return fail("the post-storm report paid a full O(cluster) prepare")
+        # the cold verification probe below legitimately pays its own
+        # prepare — the serving-path accounting window is already closed
+        cluster = sup.twin.materialize()
+        for profile in capacity_mod.headroom_profiles():
+            cold = capacity_mod.headroom_probe(cluster, profile)
+            if warm.get(profile.name) != cold:
+                return fail(
+                    f"headroom[{profile.name}] warm={warm.get(profile.name)} "
+                    f"!= fresh simulate probe {cold}"
+                )
+
+        # --- exposition sanity + cardinality cap ---------------------------
+        sample_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})?\s\S+$")
+        seen = set()
+        for line in metrics1.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            if not sample_re.match(line):
+                return fail(f"/metrics line fails the exposition grammar: {line!r}")
+            key = line.rsplit(None, 1)[0]
+            if key in seen:
+                return fail(f"duplicate series in /metrics: {key!r}")
+            seen.add(key)
+        node_series = [
+            k for k in seen if k.startswith("simon_cluster_node_utilization{")
+        ]
+        cap = int(os.environ["OPENSIM_CAPACITY_TOPK"]) * len(capacity_mod.RESOURCES)
+        if len(node_series) != cap:
+            return fail(
+                f"per-node series cap broken: {len(node_series)} series "
+                f"(expected {cap} for topk={os.environ['OPENSIM_CAPACITY_TOPK']})"
+            )
+
+        # --- timeline export ----------------------------------------------
+        tl = get("/api/debug/capacity")
+        if not tl["samples"]:
+            return fail("timeline export is empty")
+        if tl["samples"][-1]["generation"] != sup.twin.generation:
+            return fail("timeline newest sample is not the current generation")
+
+        print(
+            "capacity-smoke: ok — storm of "
+            f"{int(applies)} events tracked at O(changes) "
+            f"(full prepares stayed at {full_after_bootstrap}), cpu utilization "
+            f"{util0:.3f} -> {util1:.3f}, headroom {warm} bit-consistent with "
+            f"fresh probes, {len(node_series)} capped node series, "
+            f"{len(tl['samples'])} timeline sample(s)"
+        )
+        return 0
+    finally:
+        sup.stop()
+        httpd.shutdown()
+        stub.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke
+.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke tsan
 
 all: test
 
@@ -70,8 +70,16 @@ loadgen-smoke:
 capacity-smoke:
 	python tools/capacity_smoke.py
 
-# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain + loadgen + capacity
-verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke
+# runtime lock-order sanitizer (docs/static-analysis.md#make-tsan): a
+# seeded A->B/B->A inversion must be caught (detector self-test), then the
+# threaded test modules run under instrumented locks — any observed
+# lock-order inversion or non-exempt >OPENSIM_LOCKWATCH_HOLD_MS hold fails;
+# skips gracefully when the threaded tests are excluded from the build
+tsan:
+	python tools/tsan.py
+
+# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain + loadgen + capacity + lock sanitizer
+verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke tsan
 
 # run the moment the TPU tunnel opens (tools/tpu_probe_loop.sh writes
 # /tmp/opensim-tpu-watch.up): compiled-Mosaic parity suite + full bench

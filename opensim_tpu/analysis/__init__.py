@@ -20,24 +20,39 @@ Rules (short name = suppression id; see docs/static-analysis.md):
                               dispatch lock (server/admission.py)
     OSL1101 metric-registry   metric-family registration outside
                               obs/metrics.py's FAMILIES registry
+    OSL1201 unguarded-shared-state  `# guarded-by:` attribute touched
+                              outside its lock's critical sections
+    OSL1202 lock-order-inversion    cycle in the whole-program static
+                              lock-acquisition graph
+    OSL1203 blocking-call-under-lock  OSL1001 generalized to every
+                              critical section in the repo
+    OSL1204 thread-unsafe-contextvar  ambient Deadline/Trace read in a
+                              thread entry without explicit handoff
+
+The OSL12xx family is whole-program (symbol table + call graph + lock
+graph across all linted files); its runtime counterpart is the lock-order
+sanitizer ``analysis/lockwatch.py`` (`make tsan`, ``OPENSIM_LOCKWATCH=1``).
 """
 
 from .core import (  # noqa: F401
     RULES,
     FileContext,
     Finding,
+    ProjectContext,
     Rule,
     lint_paths,
     lint_source,
     register,
     render_human,
     render_json,
+    render_sarif,
 )
 
 # importing the rule modules registers them
 from . import (  # noqa: F401,E402
     rules_admission,
     rules_cache,
+    rules_concurrency,
     rules_determinism,
     rules_dtype,
     rules_except,

@@ -8,7 +8,7 @@ import re
 import sys
 from typing import Dict, List, Optional
 
-from . import RULES, lint_paths, render_human, render_json
+from . import RULES, lint_paths, render_human, render_json, render_sarif
 
 
 def pyproject_defaults(path: str = "pyproject.toml") -> Dict[str, List[str]]:
@@ -52,6 +52,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     ap.add_argument("--json", action="store_true", help="emit findings as JSON")
     ap.add_argument(
+        "--format",
+        choices=("human", "json", "sarif"),
+        default=None,
+        help="output format (sarif emits SARIF 2.1.0 for CI/editor "
+        "annotation; --json is shorthand for --format json)",
+    )
+    ap.add_argument(
         "--rules",
         help="comma-separated rule names/codes to run (default: all)",
     )
@@ -88,8 +95,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         rules = cfg.get("rules") or None
     paths = args.paths or cfg.get("paths") or ["opensim_tpu"]
-    findings = lint_paths(paths, rules=rules)
-    print(render_json(findings) if args.json else render_human(findings))
+    fmt = args.format or ("json" if args.json else "human")
+    stats: dict = {}
+    findings = lint_paths(paths, rules=rules, stats=stats)
+    if fmt == "json":
+        print(render_json(findings))
+    elif fmt == "sarif":
+        print(render_sarif(findings))
+    else:
+        # total lint wall time rides the `make lint` output: every file is
+        # parsed once and the AST shared across all rules
+        print(render_human(findings, stats=stats))
     return 1 if findings else 0
 
 

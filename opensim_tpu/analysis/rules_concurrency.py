@@ -1,0 +1,411 @@
+"""OSL12xx — whole-program concurrency rules over the threaded serving core.
+
+The reference system leans on Go's race detector plus informer-pattern
+discipline to keep its concurrent scheduler honest; this family is the
+static half of our answer (the runtime half is ``analysis/lockwatch.py``,
+``make tsan``). All four rules consult the :class:`~.core.ProjectContext`
+built once per lint run — symbol table, call graph, named lock nodes,
+critical sections, and the static lock-acquisition graph — so a lock
+acquired in ``server/watch.py`` and a mutation in ``obs/capacity.py`` are
+finally visible to the same pass.
+
+OSL1201 ``unguarded-shared-state``
+    Instance attributes declared shared via a trailing ``# guarded-by:
+    <lock>`` comment on their ``__init__`` assignment must only be
+    read/mutated inside critical sections of that lock. A method whose
+    every intra-project call site sits inside the lock's critical
+    sections (directly or through attributed callers) counts as guarded —
+    the call-graph attribution that keeps ``CapacityEngine``'s locked
+    helper pyramid annotation-clean. ``__init__``/``__post_init__``
+    publication is exempt (happens-before thread start).
+
+    Guard tokens: a bare attr of the same class (``_lock``), a
+    module-resolved dotted path (``RECORDER.lock``, ``PrepareCache._lock``)
+    — resolution failures are findings too (a typo'd guard is worse than
+    no guard).
+
+OSL1202 ``lock-order-inversion``
+    A cycle in the static lock graph (lock A held while acquiring B,
+    attributed through up to two levels of direct calls) is a deadlock
+    waiting for the right interleaving. Runtime confirmation comes from
+    ``make tsan``.
+
+OSL1203 ``blocking-call-under-lock``
+    OSL1001 generalized beyond the admission/dispatch lock: no critical
+    section anywhere in the repo may make a blocking call — sleeps,
+    socket/HTTP reads, subprocess work, buffered ``open``, future/event
+    waits, thread joins, or device/JIT sync points (``block_until_ready``,
+    ``device_put``) — directly or through one level of project calls.
+    ``cond.wait()`` / ``cond.wait_for()`` on the HELD condition stays
+    legal (it releases the lock while blocked). The OSL1001 modules keep
+    their original rule and are excluded here.
+
+OSL1204 ``thread-unsafe-contextvar``
+    Deadline/Trace ambient state travels in :mod:`contextvars`, which do
+    NOT propagate to new threads: a function handed to
+    ``threading.Thread(target=...)`` / ``pool.submit(...)`` (or a
+    ``Thread`` subclass ``run``) that reads the ambient deadline/trace
+    (``current_deadline``, ``check_deadline``, ``tracing.current``)
+    without an explicit handoff (``deadline_scope(...)`` /
+    ``trace_scope(...)`` / ``copy_context``) silently sees None — request
+    deadlines stop being enforced and spans go dark exactly on the pooled
+    path. The fix is the ``rest._admitted_solo`` pattern: carry the
+    objects on the work item and re-install scopes in the worker.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import (
+    CallSite,
+    FileContext,
+    Finding,
+    ProjectContext,
+    Rule,
+    dotted_name,
+    register,
+)
+
+_COMMON_EXCLUDES = ("tests/", "tools/", "test_",)
+
+
+# ---------------------------------------------------------------------------
+# OSL1201 unguarded-shared-state
+# ---------------------------------------------------------------------------
+
+
+@register
+class UnguardedSharedStateRule(Rule):
+    name = "unguarded-shared-state"
+    code = "OSL1201"
+    description = "`# guarded-by:` attribute touched outside its lock"
+    exclude_paths = _COMMON_EXCLUDES
+    needs_project = True
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        p = ctx.project
+        if p is None:
+            return
+        # guard-token resolution failures, reported at the declaration
+        mi = p.modules.get(ctx.module)
+        guards: Dict[Tuple[str, str, str], str] = {}
+        if mi is not None:
+            for ci in mi.classes.values():
+                for info in ci.attrs.values():
+                    if not info.guarded_by:
+                        continue
+                    lock = p.resolve_guard(ctx.module, ci.name, info.guarded_by)
+                    if lock is None:
+                        yield Finding(
+                            rule=self.name, code=self.code, path=ctx.path,
+                            line=info.lineno, col=0,
+                            message=(
+                                f"`# guarded-by: {info.guarded_by}` on "
+                                f"{ci.name}.{info.name} does not resolve to a "
+                                "known lock (typo, or the lock is invisible to "
+                                "the symbol table)"
+                            ),
+                        )
+                    else:
+                        guards[(ctx.module, ci.name, info.name)] = lock
+        for acc in p.accesses_by_path.get(ctx.path, ()):
+            owner_mod, owner_cls = acc.owner
+            ci = p.classes.get((owner_mod, owner_cls))
+            if ci is None:
+                continue
+            info = ci.attrs.get(acc.attr)
+            if info is None or not info.guarded_by or info.kind == "lock":
+                continue
+            lock = guards.get((owner_mod, owner_cls, acc.attr))
+            if lock is None:
+                lock = p.resolve_guard(owner_mod, owner_cls, info.guarded_by)
+            if lock is None:
+                continue  # already reported at the declaration
+            if acc.in_init:
+                continue
+            if lock in acc.held:
+                continue
+            if p.attributed_to_lock(acc.func, lock):
+                continue
+            verb = {"load": "read", "store": "written", "mutate": "mutated"}[acc.kind]
+            yield self.finding(
+                ctx, acc.node,
+                f"{owner_cls}.{acc.attr} is guarded by "
+                f"{ProjectContext.short(lock)} but is {verb} here outside any "
+                f"of its critical sections (and {acc.func.rsplit('.', 1)[-1]} "
+                "is not attributable to the lock through its call sites); "
+                "hold the lock, or route through a locked accessor",
+            )
+
+
+# ---------------------------------------------------------------------------
+# OSL1202 lock-order-inversion
+# ---------------------------------------------------------------------------
+
+
+@register
+class LockOrderInversionRule(Rule):
+    name = "lock-order-inversion"
+    code = "OSL1202"
+    description = "cycle in the static lock-acquisition graph"
+    project_rule = True
+    exclude_paths = _COMMON_EXCLUDES
+
+    def project_check(self, project: ProjectContext) -> Iterable[Finding]:
+        # direct nesting edges were collected during the scan; add edges
+        # attributed through calls made while a lock is held (two levels)
+        edges: Dict[Tuple[str, str], Tuple[str, ast.AST, str]] = {}
+        for (a, b), e in project.lock_edges.items():
+            edges[(a, b)] = (e.path, e.node, e.via)
+        for caller, sites in project.calls_from.items():
+            for site in sites:
+                if not site.held or site.callee is None:
+                    continue
+                for lock, via in project.locks_within(site.callee, depth=1):
+                    for held_id, _names in site.held:
+                        if held_id != lock and (held_id, lock) not in edges:
+                            edges[(held_id, lock)] = (
+                                site.path, site.node, site.target or site.callee,
+                            )
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+        for cycle in _cycles(adj):
+            locs = []
+            for i, lock in enumerate(cycle):
+                nxt = cycle[(i + 1) % len(cycle)]
+                path, node, via = edges[(lock, nxt)]
+                locs.append(
+                    f"{ProjectContext.short(lock)} -> {ProjectContext.short(nxt)}"
+                    + (f" (via {via})" if via else "")
+                    + f" at {path}:{getattr(node, 'lineno', 1)}"
+                )
+            first = edges[(cycle[0], cycle[1 % len(cycle)])]
+            yield self.finding(
+                first[0], first[1],
+                "lock-order inversion: "
+                + " | ".join(locs)
+                + " — a cycle in the static lock graph deadlocks under the "
+                "right interleaving; pick one global order and stick to it",
+            )
+
+
+def _cycles(adj: Dict[str, Set[str]]) -> List[List[str]]:
+    """Elementary cycles, deduped by rotation (small graphs — DFS is fine)."""
+    seen_sigs: Set[Tuple[str, ...]] = set()
+    out: List[List[str]] = []
+
+    def dfs(start: str, node: str, path: List[str], visiting: Set[str]) -> None:
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start and len(path) > 1:
+                lo = path.index(min(path))
+                sig = tuple(path[lo:] + path[:lo])
+                if sig not in seen_sigs:
+                    seen_sigs.add(sig)
+                    out.append(list(sig))
+            elif nxt not in visiting and nxt > start:
+                # only explore nodes ordered after `start`: each cycle is
+                # found exactly once, from its smallest node
+                visiting.add(nxt)
+                dfs(start, nxt, path + [nxt], visiting)
+                visiting.discard(nxt)
+
+    for start in sorted(adj):
+        dfs(start, start, [start], {start})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# OSL1203 blocking-call-under-lock
+# ---------------------------------------------------------------------------
+
+_BLOCKING_LEAVES = {
+    "sleep", "recv", "recv_into", "accept", "connect", "urlopen", "select",
+    "communicate", "getresponse", "result", "block_until_ready", "device_put",
+}
+_WAIT_LEAVES = {"wait", "wait_for"}
+_BLOCKING_ROOTS = {"subprocess", "socket"}
+_THREADISH = ("thread", "proc", "worker", "pool", "future")
+
+
+def _call_target(node: ast.Call) -> str:
+    name = dotted_name(node.func)
+    if name:
+        return name
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return ""
+
+
+def _blocking_reason(site: CallSite, held_names: Set[str]) -> Optional[str]:
+    """Why this call blocks while a lock is held, or None. ``held_names``
+    are the raw names in the held with-expressions (the held-condition
+    ``wait``/``wait_for`` exemption)."""
+    target = _call_target(site.node)
+    if not target:
+        return None
+    leaf = target.rsplit(".", 1)[-1]
+    root = target.split(".", 1)[0]
+    if leaf in _WAIT_LEAVES:
+        owner = target.rsplit(".", 2)
+        owner_name = owner[-2] if len(owner) >= 2 else ""
+        if owner_name in held_names:
+            return None  # waiting on the HELD condition releases the lock
+        return f"`{target}` waits on an object that cannot release the held lock"
+    if leaf in _BLOCKING_LEAVES:
+        return f"`{target}` blocks"
+    if root in _BLOCKING_ROOTS:
+        return f"`{target}` does subprocess/socket I/O"
+    if target == "open":
+        return "buffered `open` does file I/O"
+    if leaf == "join":
+        owner = target.rsplit(".", 2)
+        owner_name = (owner[-2] if len(owner) >= 2 else "").lower()
+        if any(t in owner_name for t in _THREADISH):
+            return f"`{target}` joins a thread"
+    return None
+
+
+@register
+class BlockingCallUnderLockRule(Rule):
+    name = "blocking-call-under-lock"
+    code = "OSL1203"
+    description = "blocking call inside any critical section (repo-wide OSL1001)"
+    # the admission/dispatch modules keep OSL1001 (their original, stricter
+    # wording); everything else is this rule's territory
+    exclude_paths = _COMMON_EXCLUDES + (
+        "server/admission", "server/pool", "server/rest",
+    )
+    needs_project = True
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        p = ctx.project
+        if p is None:
+            return
+        for site in p.held_sites_by_path.get(ctx.path, ()):
+            held_names: Set[str] = set()
+            for _lid, names in site.held:
+                held_names |= set(names)
+            reason = _blocking_reason(site, held_names)
+            if reason is not None:
+                locks = ", ".join(
+                    ProjectContext.short(lid) for lid, _n in site.held
+                )
+                yield self.finding(
+                    ctx, site.node,
+                    f"{reason} while holding {locks}; move it outside the "
+                    "critical section (every waiter convoys behind this)",
+                )
+                continue
+            # one level through the project call graph
+            if site.callee is None:
+                continue
+            for sub in p.calls_from.get(site.callee, ()):
+                sub_names: Set[str] = set(held_names)
+                for _lid, names in sub.held:
+                    sub_names |= set(names)
+                sub_reason = _blocking_reason(sub, sub_names)
+                if sub_reason is not None:
+                    locks = ", ".join(
+                        ProjectContext.short(lid) for lid, _n in site.held
+                    )
+                    yield self.finding(
+                        ctx, site.node,
+                        f"call to {site.target or site.callee} while "
+                        f"holding {locks}: {sub_reason} (at "
+                        f"{sub.path}:{getattr(sub.node, 'lineno', 1)}); "
+                        "hoist the blocking work out of the lock",
+                    )
+                    break
+
+
+# ---------------------------------------------------------------------------
+# OSL1204 thread-unsafe-contextvar
+# ---------------------------------------------------------------------------
+
+_AMBIENT_READERS = {"current_deadline", "check_deadline"}
+_AMBIENT_MODULES = {"tracing", "trace", "obs", "deadline"}
+_HANDOFF_LEAVES = {"deadline_scope", "trace_scope", "use_trace", "copy_context"}
+
+
+def _reads_ambient(target: str) -> bool:
+    if not target:
+        return False
+    leaf = target.rsplit(".", 1)[-1]
+    if leaf in _AMBIENT_READERS:
+        return True
+    root = target.split(".", 1)[0]
+    return leaf == "current" and root in _AMBIENT_MODULES
+
+
+def _has_handoff(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            target = _call_target(sub)
+            if target and target.rsplit(".", 1)[-1] in _HANDOFF_LEAVES:
+                return True
+    return False
+
+
+@register
+class ThreadUnsafeContextvarRule(Rule):
+    name = "thread-unsafe-contextvar"
+    code = "OSL1204"
+    description = "ambient deadline/trace read in a thread entry without handoff"
+    exclude_paths = _COMMON_EXCLUDES + ("resilience/deadline", "obs/")
+    needs_project = True
+
+    def _ambient_reader_in(
+        self, p: ProjectContext, qual: str, depth: int = 1
+    ) -> Optional[str]:
+        for site in p.calls_from.get(qual, ()):
+            if _reads_ambient(site.target):
+                return site.target
+            if depth > 0 and site.callee is not None:
+                got = self._ambient_reader_in(p, site.callee, depth - 1)
+                if got:
+                    return f"{got} (via {site.target or site.callee})"
+        return None
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        p = ctx.project
+        if p is None:
+            return
+        # explicit spawns in this file
+        for _sctx, node, kind, entry in p.spawns_by_path.get(ctx.path, ()):
+            if entry is None:
+                continue
+            fi = p.functions.get(entry)
+            if fi is None or _has_handoff(fi.node):
+                continue
+            reader = self._ambient_reader_in(p, entry)
+            if reader:
+                what = "Thread target" if kind == "thread" else "submitted task"
+                yield self.finding(
+                    ctx, node,
+                    f"{what} {entry.rsplit('.', 1)[-1]} reads the ambient "
+                    f"deadline/trace ({reader}) but contextvars do not cross "
+                    "threads: the worker silently sees None. Carry the "
+                    "Deadline/TraceContext on the work item and re-install "
+                    "with deadline_scope(...)/trace_scope(...) in the worker",
+                )
+        # Thread subclasses defined in this file: `run` is the entry
+        mi = p.modules.get(ctx.module)
+        if mi is None:
+            return
+        for ci in mi.classes.values():
+            if not p.is_thread_subclass(ctx.module, ci.name):
+                continue
+            run = ci.methods.get("run")
+            if run is None or _has_handoff(run.node):
+                continue
+            reader = self._ambient_reader_in(p, run.qualname)
+            if reader:
+                yield self.finding(
+                    ctx, run.node,
+                    f"{ci.name}.run reads the ambient deadline/trace "
+                    f"({reader}) on a fresh thread where contextvars are "
+                    "empty; install scopes explicitly at thread entry",
+                )

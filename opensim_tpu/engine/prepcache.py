@@ -235,14 +235,14 @@ class CacheEntry:
         self.key = key
         self.prep = prep
         self.base = base
-        self.lock = base.lock if base is not None else threading.RLock()
+        self.lock = base.lock if base is not None else threading.RLock()  # lockwatch: hold-exempt — per-entry lock spans derive/encode by design
         self.bind_snap = snapshot_bind_state(prep) if prep is not None else []
-        self._dev_map: Optional[dict] = None
+        self._dev_map: Optional[dict] = None  # guarded-by: lock
         # live-twin delta state (server/watch.py): pods DELETED by watch
         # events stay in the cached stream with their valid-mask bit flipped
         # here instead of forcing a full re-encode; the REST layer unions
         # this into every simulate() drop mask derived from the entry
-        self.base_drop: Optional[np.ndarray] = None
+        self.base_drop: Optional[np.ndarray] = None  # guarded-by: lock
         # (object, local_version at fingerprint time) — the stale-entry
         # guard; see VersionedObject (models/objects.py) and
         # watch_snapshot(). Derived entries share the base's list: their
@@ -293,12 +293,16 @@ class CacheEntry:
         """{id(numpy leaf): device leaf} over the entry's EncodedCluster —
         delta assemblies reuse the already-uploaded tensors for every leaf
         the delta did not touch."""
-        if self._dev_map is None:
-            self._dev_map = {
-                id(np_leaf): dev_leaf
-                for np_leaf, dev_leaf in zip(self.prep.ec_np, self.prep.ec)
-            }
-        return self._dev_map
+        # a locked accessor: delta builders call this while already inside
+        # the entry lock (RLock — free re-entry), but the planner's
+        # lock-free extend_with_nodes path reaches here too
+        with self.lock:
+            if self._dev_map is None:
+                self._dev_map = {
+                    id(np_leaf): dev_leaf
+                    for np_leaf, dev_leaf in zip(self.prep.ec_np, self.prep.ec)
+                }
+            return self._dev_map
 
 
 class PrepareCache:
@@ -307,7 +311,7 @@ class PrepareCache:
     def __init__(self, capacity: int = 8) -> None:
         self.capacity = capacity
         self._lock = threading.Lock()
-        self._entries: "OrderedDict[str, CacheEntry]" = OrderedDict()
+        self._entries: "OrderedDict[str, CacheEntry]" = OrderedDict()  # guarded-by: _lock
         self.stats = CacheStats()
 
     def get(self, key: str) -> Optional[CacheEntry]:

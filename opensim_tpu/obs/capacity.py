@@ -52,7 +52,10 @@ import os
 import re
 import threading
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Tuple
+from typing import TYPE_CHECKING, Dict, List, Optional, Tuple
+
+if TYPE_CHECKING:
+    from ..engine.prepcache import CacheEntry
 
 from ..models.objects import LABEL_APP_NAME, Node, Pod, ResourceTypes
 from ..models.quantity import format_milli, format_quantity, parse_quantity
@@ -193,27 +196,28 @@ class CapacityEngine:
         self.topk = topk_nodes() if topk is None else max(0, topk)
         self.timeline = timeline if timeline is not None else Timeline()
         self._buckets = tuple(UTILIZATION_BUCKETS) + (math.inf,)
-        self._nodes: Dict[str, _NodeState] = {}
+        self._nodes: Dict[str, _NodeState] = {}  # guarded-by: _lock
         # requests accumulated per NODE NAME, independent of whether the
         # node object has been seen yet (a pod can be bound to a node whose
         # ADDED event arrives later; its contribution folds in on arrival)
-        self._node_req: Dict[str, List[float]] = {}
-        self._pods: Dict[Tuple[str, str], Tuple[str, float, float]] = {}
-        self._pending = 0
+        self._node_req: Dict[str, List[float]] = {}  # guarded-by: _lock
+        self._pods: Dict[Tuple[str, str], Tuple[str, float, float]] = {}  # guarded-by: _lock
+        self._pending = 0  # guarded-by: _lock
         # distribution state per resource: bucket counts + spread moments
-        self._dist = [[0] * len(self._buckets) for _ in RESOURCES]
-        self._sum_u = [0.0, 0.0, 0.0]
-        self._sum_u2 = [0.0, 0.0, 0.0]
-        self._n_util = [0, 0, 0]
-        self._alloc_total = [0.0, 0.0, 0.0]
-        self._req_total = [0.0, 0.0, 0.0]
-        self.generation = -1  # < 0: never bootstrapped, render nothing
-        self._boot_key: Optional[str] = None
-        self._headroom: Dict[str, int] = {}
-        self._sample: Optional[Sample] = None
+        self._dist = [[0] * len(self._buckets) for _ in RESOURCES]  # guarded-by: _lock
+        self._sum_u = [0.0, 0.0, 0.0]  # guarded-by: _lock
+        self._sum_u2 = [0.0, 0.0, 0.0]  # guarded-by: _lock
+        self._n_util = [0, 0, 0]  # guarded-by: _lock
+        self._alloc_total = [0.0, 0.0, 0.0]  # guarded-by: _lock
+        self._req_total = [0.0, 0.0, 0.0]  # guarded-by: _lock
+        # < 0: never bootstrapped, render nothing
+        self.generation = -1  # guarded-by: _lock
+        self._boot_key: Optional[str] = None  # guarded-by: _lock
+        self._headroom: Dict[str, int] = {}  # guarded-by: _lock
+        self._sample: Optional[Sample] = None  # guarded-by: _lock
         # set by the watch supervisor once it owns the view (bootstrap +
         # per-event feed): snapshot-keyed rebootstraps become no-ops
-        self.event_fed = False
+        self.event_fed = False  # guarded-by: _lock
 
     # -- bootstrap ----------------------------------------------------------
 
@@ -419,6 +423,13 @@ class CapacityEngine:
                 if total >= profile.max_replicas:
                     return profile.max_replicas
         return min(total, profile.max_replicas)
+
+    def claim_event_fed(self) -> None:
+        """The watch supervisor declares ownership of the view (it will
+        bootstrap and feed per-event updates): snapshot-keyed rebootstraps
+        via :meth:`ensure_bootstrap` become no-ops from here on."""
+        with self._lock:
+            self.event_fed = True
 
     def set_headroom(self, values: Dict[str, int]) -> None:
         """Record the latest probe verdicts (merged into samples and the
@@ -645,7 +656,7 @@ def _probe_max(prep, app_slice: Tuple[int, int], drop, kmax: int) -> int:
 def headroom_probe(
     cluster: ResourceTypes,
     profile: WorkloadProfile,
-    base=None,
+    base: Optional["CacheEntry"] = None,
     kmax: Optional[int] = None,
 ) -> int:
     """Max additional replicas of ``profile`` the cluster still schedules.

@@ -205,7 +205,7 @@ class CounterVec:
         self.name = name
         self.label_names = tuple(label_names)
         self.help = help
-        self._series: Dict[Tuple[str, ...], int] = {}
+        self._series: Dict[Tuple[str, ...], int] = {}  # guarded-by: RECORDER.lock
 
     def inc(self, labels: Tuple[str, ...], n: int = 1) -> None:
         self._series[labels] = self._series.get(labels, 0) + n
@@ -246,7 +246,7 @@ class HistogramVec:
         self.buckets = tuple(buckets) + (math.inf,)
         self.help = help
         # label-values tuple -> [per-bucket counts..., count, sum]
-        self._series: Dict[Tuple[str, ...], list] = {}
+        self._series: Dict[Tuple[str, ...], list] = {}  # guarded-by: RECORDER.lock
 
     def observe(self, seconds: float, labels: Tuple[str, ...]) -> None:
         series = self._series.get(labels)

@@ -44,8 +44,8 @@ class FlightRecorder:
     def __init__(self, capacity: Optional[int] = None) -> None:
         self.capacity = capacity if capacity is not None else _default_capacity()
         self._lock = threading.Lock()
-        self._ring: deque = deque()
-        self._by_id: Dict[str, object] = {}
+        self._ring: deque = deque()  # guarded-by: _lock
+        self._by_id: Dict[str, object] = {}  # guarded-by: _lock
 
     def record(self, trace) -> None:
         if not trace.finished:

@@ -91,7 +91,7 @@ class Timeline:
     def __init__(self, capacity: Optional[int] = None) -> None:
         self.capacity = timeline_capacity() if capacity is None else max(1, capacity)
         self._lock = threading.Lock()
-        self._ring: "collections.deque[Sample]" = collections.deque(maxlen=self.capacity)
+        self._ring: "collections.deque[Sample]" = collections.deque(maxlen=self.capacity)  # guarded-by: _lock
 
     def append(self, sample: Sample) -> None:
         with self._lock:

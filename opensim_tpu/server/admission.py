@@ -178,18 +178,19 @@ class AdmissionController:
         self.bound = queue_bound() if bound is None else bound
         self.max_batch = batch_max() if max_batch is None else max_batch
         self._pool = pool if pool is not None else WorkerPool()
-        self._queue: "collections.deque[Ticket]" = collections.deque()
         self._cond = threading.Condition()
-        self._closed = False
-        self._thread: Optional[threading.Thread] = None
+        self._queue: "collections.deque[Ticket]" = collections.deque()  # guarded-by: _cond
+        self._closed = False  # guarded-by: _cond
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _cond
         # telemetry (rendered into /metrics via metrics_lines): families
         # come from the obs/metrics.py registry (OSL1101), all mutations
         # under the ONE recorder lock like every other family
         self.shed = make_counter("simon_shed_total", ("reason",))
         self.batch_sizes = make_histogram("simon_batch_size", (), buckets=BATCH_SIZE_BUCKETS)
         self.queue_wait = make_histogram("simon_queue_wait_seconds", ())
-        self.batches_total = 0
-        self.ewma_service_s = 0.05  # drain-rate estimate for Retry-After
+        self.batches_total = 0  # guarded-by: RECORDER.lock
+        # drain-rate estimate for Retry-After
+        self.ewma_service_s = 0.05  # guarded-by: RECORDER.lock
 
     # -- client side --------------------------------------------------------
 
@@ -200,8 +201,10 @@ class AdmissionController:
                 raise QueueFull("the server is shutting down", retry_after_s=1.0)
             if len(self._queue) >= self.bound:
                 depth = len(self._queue)
-                retry = max(0.05, depth * self.ewma_service_s / max(1, self.max_batch))
                 with RECORDER.lock:
+                    retry = max(
+                        0.05, depth * self.ewma_service_s / max(1, self.max_batch)
+                    )
                     self.shed.inc(("queue_full",))
                 raise QueueFull(
                     f"admission queue at bound ({depth}/{self.bound}); "

@@ -82,6 +82,11 @@ class WorkerPool:
         want = mode if mode is not None else worker_mode()
         self.mode = "thread"
         self._proc_pool: Optional[concurrent.futures.Executor] = None
+        # deliberately unguarded (no `# guarded-by:`): a boolean one-shot
+        # flag whose worst-case race is a duplicate log line — the GIL
+        # makes the flip atomic, and the executors themselves are the
+        # stdlib's thread-safe objects (everything else here is
+        # init-published before the first submit())
         self._warned_unpicklable = False
         if want == "process":
             pool = self._try_process_pool()

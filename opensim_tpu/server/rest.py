@@ -21,7 +21,10 @@ import logging
 import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List, Optional
+from typing import TYPE_CHECKING, List, Optional
+
+if TYPE_CHECKING:
+    from ..engine.prepcache import PrepareCache
 
 from ..engine.simulator import AppResource, SimulateResult, simulate
 from ..models.objects import LABEL_APP_NAME, Node, ResourceTypes, object_from_dict
@@ -43,8 +46,8 @@ log = logging.getLogger("opensim_tpu.server")
 # structured access log (OPENSIM_ACCESS_LOG=1): one JSON object per line
 _ACCESS_LOG = logging.getLogger("opensim_tpu.access")
 
-_deploy_lock = threading.Lock()
-_scale_lock = threading.Lock()
+_deploy_lock = threading.Lock()  # lockwatch: hold-exempt — single-flight, spans engine work incl. first XLA compile
+_scale_lock = threading.Lock()  # lockwatch: hold-exempt — single-flight, spans engine work incl. first XLA compile
 
 # per-request state (one HTTP request = one handler thread): whether THIS
 # request's result was computed from a stale snapshot. Reading the shared
@@ -342,7 +345,7 @@ class SimonServer:
         master: str = "",
         base_cluster: Optional[ResourceTypes] = None,
         snapshot_ttl_s: float = 30.0,
-        prep_cache=None,
+        prep_cache: Optional["PrepareCache"] = None,  # False disables
         watch=None,
         admission=None,
         capacity=None,
@@ -401,7 +404,7 @@ class SimonServer:
         self.admission = admission or None
         # serializes headroom probes (they are expensive scans) and guards
         # the published-generation watermark below
-        self._headroom_lock = threading.Lock()
+        self._headroom_lock = threading.Lock()  # lockwatch: hold-exempt — probes span engine scans by design
         self._headroom_pub_gen = -1
         # capacity observatory (ISSUE 9, obs/capacity.py): always on —
         # ``None`` builds the default engine, ``False`` disables. With a

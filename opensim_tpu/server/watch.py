@@ -62,7 +62,10 @@ import time
 import urllib.error
 import urllib.request
 from collections import OrderedDict
-from typing import Dict, Iterator, List, Optional, Tuple
+from typing import TYPE_CHECKING, Dict, Iterator, List, Optional, Tuple
+
+if TYPE_CHECKING:
+    from ..engine.prepcache import PrepareCache
 
 from ..models.objects import ResourceTypes
 from ..obs import trace as tracing
@@ -186,19 +189,19 @@ class ClusterTwin:
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
-        self._stores: Dict[str, "OrderedDict[Tuple[str, str], object]"] = {
+        self._stores: Dict[str, "OrderedDict[Tuple[str, str], object]"] = {  # guarded-by: _lock
             spec.field: OrderedDict() for spec in RESOURCES
         }
-        self._rvs: Dict[str, Dict[Tuple[str, str], Optional[int]]] = {
+        self._rvs: Dict[str, Dict[Tuple[str, str], Optional[int]]] = {  # guarded-by: _lock
             spec.field: {} for spec in RESOURCES
         }
-        self._tombstones: Dict[str, "OrderedDict[Tuple[str, str], Optional[int]]"] = {
+        self._tombstones: Dict[str, "OrderedDict[Tuple[str, str], Optional[int]]"] = {  # guarded-by: _lock
             spec.field: OrderedDict() for spec in RESOURCES
         }
         self.generation = 0
         self.synced_fields: set = set()
-        self._mat: Optional[ResourceTypes] = None
-        self._mat_gen = -1
+        self._mat: Optional[ResourceTypes] = None  # guarded-by: _lock
+        self._mat_gen = -1  # guarded-by: _lock
 
     def _bury(self, field: str, k: Tuple[str, str], rv: Optional[int]) -> None:
         tomb = self._tombstones[field]
@@ -643,7 +646,7 @@ class WatchSupervisor:
     def __init__(
         self,
         source,
-        prep_cache=None,
+        prep_cache: Optional["PrepareCache"] = None,
         watched: Tuple[str, ...] = DEFAULT_WATCHED,
         policy: Optional[dict] = None,
     ) -> None:
@@ -665,32 +668,44 @@ class WatchSupervisor:
         self._synced = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._reflectors: List[_Reflector] = []
-        self._state = "syncing"
         self._state_lock = threading.Lock()
+        self._state = "syncing"  # guarded-by: _state_lock
+        # _down/_traffic are deliberately unguarded: set.add/discard and
+        # per-key dict stores are atomic under the GIL, the readers
+        # (_recompute_state, staleness checks) tolerate a stale view for
+        # one tick, and taking a lock on every received event would put a
+        # hot-path wait in front of twin application for a telemetry hint
         self._down: set = set()
         self._traffic: Dict[str, float] = {}
         self._maint_lock = threading.Lock()
-        self._pending: List[tuple] = []
-        self._prep_gen = -1
+        self._pending: List[tuple] = []  # guarded-by: _maint_lock
+        self._prep_gen = -1  # guarded-by: _maint_lock
+        # serializes flushers only (supervisor loop vs request threads) and
+        # is held across the delta re-encode — a first JIT compile can take
+        # seconds, and waiting here IS the warm-path contract (the request
+        # wants the folded base); _maint_lock is never held that long, so
+        # reflector dispatch keeps flowing
+        self._flush_lock = threading.Lock()  # lockwatch: hold-exempt — holds across delta re-encode by design
         self._boot_rvs: Dict[str, str] = {}
         # serializes event application against the anti-entropy merge (the
         # reflector threads vs the supervisor thread) and guards the
         # per-field reorder-fault holding slots
         self._dispatch_lock = threading.Lock()
-        self._held: Dict[str, Tuple[str, dict]] = {}
+        self._held: Dict[str, Tuple[str, dict]] = {}  # guarded-by: _dispatch_lock
         self._trace_seq = itertools.count(1)
         # counters (rendered under the one metrics lock, RECORDER.lock).
         # events and drift carry a {resource=} label (ISSUE 7 satellite) so
         # drift is attributable — pods churn and nodes churn are different
         # operational stories; the unlabeled totals stay as attributes for
         # programmatic callers
-        self.events_total: Dict[Tuple[str, str], int] = {}  # (kind, resource)
-        self.reconnects_total = 0
-        self.relists_total = 0
-        self.gone_total = 0
-        self.drift_total = 0
-        self.drift_by_resource: Dict[str, int] = {}
-        self.resyncs_total = 0
+        # (kind, resource)
+        self.events_total: Dict[Tuple[str, str], int] = {}  # guarded-by: RECORDER.lock
+        self.reconnects_total = 0  # guarded-by: RECORDER.lock
+        self.relists_total = 0  # guarded-by: RECORDER.lock
+        self.gone_total = 0  # guarded-by: RECORDER.lock
+        self.drift_total = 0  # guarded-by: RECORDER.lock
+        self.drift_by_resource: Dict[str, int] = {}  # guarded-by: RECORDER.lock
+        self.resyncs_total = 0  # guarded-by: RECORDER.lock
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -946,7 +961,7 @@ class WatchSupervisor:
             with self.twin._lock:
                 cluster = self.twin.materialize()
                 gen = self.twin.generation
-            self.capacity.event_fed = True  # the supervisor owns the view now
+            self.capacity.claim_event_fed()  # the supervisor owns the view now
             self.capacity.bootstrap(cluster, gen)
         except Exception as e:
             log.warning("capacity rebase failed: %s: %s", type(e).__name__, e)
@@ -965,11 +980,21 @@ class WatchSupervisor:
             return
         from ..engine import prepcache
 
-        with self._maint_lock:
-            gen_now = self.twin.generation
-            if gen_now == self._prep_gen and not self._pending:
-                return
-            changes, self._pending = self._pending, []
+        # the re-encode must NOT run under _maint_lock: reflector dispatch
+        # appends under it (while holding the dispatch lock), so holding it
+        # across a multi-second first compile stalls the whole event
+        # pipeline — `make tsan` catches exactly that as a hold outlier.
+        # Flushers serialize on _flush_lock; the pending swap and the
+        # publish are each a short _maint_lock critical section, and the
+        # publish re-checks the lineage generation so a concurrent
+        # relist/drift/bootstrap reset wins over a stale delta.
+        with self._flush_lock:
+            with self._maint_lock:
+                gen_now = self.twin.generation
+                old_gen = self._prep_gen
+                if gen_now == old_gen and not self._pending:
+                    return
+                changes, self._pending = self._pending, []
             added: List[object] = []
             removed: set = set()
             nodes_added: List[object] = []
@@ -992,7 +1017,7 @@ class WatchSupervisor:
                     nodes_added.append(change[1])
                 else:
                     rebuild = change[1]
-            old_key = f"{self.key_prefix}{self._prep_gen}|base"
+            old_key = f"{self.key_prefix}{old_gen}|base"
             new_key = f"{self.key_prefix}{gen_now}|base"
             base = self.prep_cache.get(old_key)
             entry = None
@@ -1019,19 +1044,25 @@ class WatchSupervisor:
                         entry = prepcache.twin_pod_delta(
                             base, new_key, added, removed, watch=watch
                         )
-            if entry is not None:
-                self.prep_cache.put(new_key, entry)
-                # trailing "|" so gen 5 cannot prefix-match gen 50's keys
-                self.prep_cache.invalidate(f"{self.key_prefix}{self._prep_gen}|")
-                tracing.event(
-                    "twin.delta",
-                    added=len(added), removed=len(removed), nodes=len(nodes_added),
-                )
-            else:
-                self._invalidate_prep()
-                if rebuild is not None:
-                    log.debug("twin prep lineage dropped: %s", rebuild)
-            self._prep_gen = gen_now
+            with self._maint_lock:
+                if self._prep_gen != old_gen:
+                    # a relist/drift repair/bootstrap reset the lineage
+                    # while the delta was encoding; its verdict supersedes
+                    # ours — the swapped changes belong to the dead lineage
+                    return
+                if entry is not None:
+                    self.prep_cache.put(new_key, entry)
+                    # trailing "|" so gen 5 cannot prefix-match gen 50's keys
+                    self.prep_cache.invalidate(f"{self.key_prefix}{old_gen}|")
+                    tracing.event(
+                        "twin.delta",
+                        added=len(added), removed=len(removed), nodes=len(nodes_added),
+                    )
+                else:
+                    self._invalidate_prep()
+                    if rebuild is not None:
+                        log.debug("twin prep lineage dropped: %s", rebuild)
+                self._prep_gen = gen_now
 
     # -- anti-entropy --------------------------------------------------------
 

@@ -1,0 +1,613 @@
+"""OSL12xx whole-program concurrency rules + the lockwatch runtime
+sanitizer: each rule fires on a known-bad fixture and stays silent on the
+disciplined twin, attribution sees through one call level and across
+modules, suppressions are honored, and a seeded A→B/B→A lock-order
+inversion is caught in-process by the runtime half (`make tsan`)."""
+
+import textwrap
+import threading
+import time
+
+import pytest
+
+from opensim_tpu.analysis import lint_paths, lint_source
+from opensim_tpu.analysis import lockwatch
+from opensim_tpu.analysis.lockwatch import LockWatch
+
+# rule path scoping: OSL12xx excludes tests/ and tools/, OSL1203
+# additionally excludes the OSL1001 modules (admission/pool/rest)
+FIX = "opensim_tpu/server/fixture.py"
+
+
+def _codes(src, path=FIX, rules=None):
+    return [f.code for f in lint_source(textwrap.dedent(src), path=path, rules=rules)]
+
+
+# ---------------------------------------------------------------------------
+# OSL1201 unguarded-shared-state
+# ---------------------------------------------------------------------------
+
+
+def test_unguarded_shared_state_fires_outside_the_lock():
+    src = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []  # guarded-by: _lock
+
+        def good(self, x):
+            with self._lock:
+                self._items.append(x)
+
+        def bad(self, x):
+            self._items.append(x)
+
+        def bad_read(self):
+            return len(self._items)
+    """
+    codes = _codes(src, rules=["unguarded-shared-state"])
+    assert codes == ["OSL1201", "OSL1201"]  # bad() mutate + bad_read() load
+
+
+def test_unguarded_shared_state_init_publication_is_exempt():
+    src = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []  # guarded-by: _lock
+            self._items.append(0)   # happens-before any thread start
+    """
+    assert _codes(src, rules=["unguarded-shared-state"]) == []
+
+
+def test_unguarded_shared_state_attributes_through_one_call_level():
+    # _append itself takes no lock, but its EVERY call site is inside the
+    # lock's critical section — the call-graph attribution keeps locked
+    # helper pyramids annotation-clean
+    src = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []  # guarded-by: _lock
+
+        def add(self, x):
+            with self._lock:
+                self._append(x)
+
+        def add2(self, x):
+            with self._lock:
+                self._append(x)
+
+        def _append(self, x):
+            self._items.append(x)
+    """
+    assert _codes(src, rules=["unguarded-shared-state"]) == []
+    # one unlocked call site breaks the attribution for the helper
+    leaky = src + """
+    def sneak(b: "Box"):
+        b._append(9)
+    """
+    codes = _codes(leaky, rules=["unguarded-shared-state"])
+    assert codes == ["OSL1201"]
+
+
+def test_unguarded_shared_state_unresolvable_guard_is_a_finding():
+    src = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []  # guarded-by: _lokc
+    """
+    findings = lint_source(
+        textwrap.dedent(src), path=FIX, rules=["unguarded-shared-state"]
+    )
+    assert [f.code for f in findings] == ["OSL1201"]
+    assert "does not resolve" in findings[0].message
+
+
+def test_unguarded_shared_state_suppression():
+    src = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []  # guarded-by: _lock
+
+        def bad(self, x):
+            self._items.append(x)  # opensim-lint: disable=unguarded-shared-state
+    """
+    assert _codes(src, rules=["unguarded-shared-state"]) == []
+
+
+def test_unguarded_shared_state_cross_module(tmp_path, monkeypatch):
+    # the whole point of the ProjectContext: the lock lives in one module,
+    # the undisciplined access in another
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "a.py").write_text(
+        textwrap.dedent(
+            """
+            import threading
+
+            class Store:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.items = []  # guarded-by: _lock
+
+            STORE = Store()
+            """
+        )
+    )
+    (pkg / "b.py").write_text(
+        textwrap.dedent(
+            """
+            from pkg.a import STORE
+
+            def poke():
+                STORE.items.append(1)
+
+            def polite():
+                with STORE._lock:
+                    STORE.items.append(2)
+            """
+        )
+    )
+    # function-level `from pkg import a` binds the submodule; resolution
+    # must see through the deferred-import idiom too
+    (pkg / "c.py").write_text(
+        textwrap.dedent(
+            """
+            def poke2():
+                from pkg import a
+                a.STORE.items.append(3)
+            """
+        )
+    )
+    monkeypatch.chdir(tmp_path)  # relative paths: no test_* fragment
+    findings = lint_paths(["pkg"], rules=["unguarded-shared-state"])
+    assert sorted((f.path, f.code) for f in findings) == [
+        ("pkg/b.py", "OSL1201"),
+        ("pkg/c.py", "OSL1201"),
+    ]
+
+
+def test_unguarded_shared_state_malformed_guard_token_is_a_finding():
+    # a one-keystroke typo (trailing dot) must yield the unresolved-guard
+    # finding, not a SyntaxError out of the analyzer
+    src = """
+    import threading
+
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []  # guarded-by: _lock.
+    """
+    findings = lint_source(
+        textwrap.dedent(src), path=FIX, rules=["unguarded-shared-state"]
+    )
+    assert [f.code for f in findings] == ["OSL1201"]
+    assert "does not resolve" in findings[0].message
+
+
+def test_unguarded_shared_state_guard_tokens_resolve_through_imports(tmp_path, monkeypatch):
+    # a bare token naming an imported module-global lock, and a dotted
+    # token resolved through `from . import locks` in a package __init__
+    # (whose module name already IS the package — one less level to strip)
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "locks.py").write_text("import threading\nGLOBAL_LOCK = threading.Lock()\n")
+    (pkg / "__init__.py").write_text(
+        textwrap.dedent(
+            """
+            from . import locks
+
+            class Reg:
+                def __init__(self):
+                    self.n = 0  # guarded-by: locks.GLOBAL_LOCK
+
+                def good(self):
+                    with locks.GLOBAL_LOCK:
+                        self.n += 1
+
+                def bad(self):
+                    self.n += 1
+            """
+        )
+    )
+    (pkg / "user.py").write_text(
+        textwrap.dedent(
+            """
+            from pkg.locks import GLOBAL_LOCK
+
+            class Counter:
+                def __init__(self):
+                    self.n = 0  # guarded-by: GLOBAL_LOCK
+
+                def good(self):
+                    with GLOBAL_LOCK:
+                        self.n += 1
+
+                def bad(self):
+                    self.n += 1
+            """
+        )
+    )
+    monkeypatch.chdir(tmp_path)
+    findings = lint_paths(["pkg"], rules=["unguarded-shared-state"])
+    # both guards resolve (no "does not resolve" noise), both bad() writes fire
+    assert all("does not resolve" not in f.message for f in findings)
+    assert sorted((f.path, f.code) for f in findings) == [
+        ("pkg/__init__.py", "OSL1201"),
+        ("pkg/user.py", "OSL1201"),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# OSL1202 lock-order-inversion
+# ---------------------------------------------------------------------------
+
+
+def test_lock_order_inversion_fires_on_directly_nested_cycle():
+    src = """
+    import threading
+
+    LOCK_A = threading.Lock()
+    LOCK_B = threading.Lock()
+
+    def ab():
+        with LOCK_A:
+            with LOCK_B:
+                pass
+
+    def ba():
+        with LOCK_B:
+            with LOCK_A:
+                pass
+    """
+    findings = lint_source(
+        textwrap.dedent(src), path=FIX, rules=["lock-order-inversion"]
+    )
+    assert [f.code for f in findings] == ["OSL1202"]
+    assert "cycle" in findings[0].message
+
+
+def test_lock_order_inversion_silent_on_consistent_order():
+    src = """
+    import threading
+
+    LOCK_A = threading.Lock()
+    LOCK_B = threading.Lock()
+
+    def one():
+        with LOCK_A:
+            with LOCK_B:
+                pass
+
+    def two():
+        with LOCK_A:
+            with LOCK_B:
+                pass
+    """
+    assert _codes(src, rules=["lock-order-inversion"]) == []
+
+
+def test_lock_order_inversion_attributed_through_a_call():
+    src = """
+    import threading
+
+    LOCK_A = threading.Lock()
+    LOCK_B = threading.Lock()
+
+    def ab():
+        with LOCK_A:
+            with LOCK_B:
+                pass
+
+    def helper():
+        with LOCK_A:
+            pass
+
+    def inverted():
+        with LOCK_B:
+            helper()
+    """
+    codes = _codes(src, rules=["lock-order-inversion"])
+    assert codes == ["OSL1202"]
+
+
+# ---------------------------------------------------------------------------
+# OSL1203 blocking-call-under-lock
+# ---------------------------------------------------------------------------
+
+
+def test_blocking_call_under_lock_fires_on_sleep_and_subprocess():
+    src = """
+    import subprocess
+    import threading
+    import time
+
+    _lock = threading.Lock()
+
+    def bad_sleep():
+        with _lock:
+            time.sleep(0.1)
+
+    def bad_subprocess():
+        with _lock:
+            subprocess.run(["true"])
+
+    def fine():
+        time.sleep(0.1)
+        with _lock:
+            pass
+    """
+    codes = _codes(src, rules=["blocking-call-under-lock"])
+    assert codes == ["OSL1203", "OSL1203"]
+
+
+def test_blocking_call_under_lock_sees_one_call_level_deep():
+    src = """
+    import threading
+    import time
+
+    _lock = threading.Lock()
+
+    def helper():
+        time.sleep(0.1)
+
+    def bad():
+        with _lock:
+            helper()
+    """
+    findings = lint_source(
+        textwrap.dedent(src), path=FIX, rules=["blocking-call-under-lock"]
+    )
+    assert [f.code for f in findings] == ["OSL1203"]
+    assert "helper" in findings[0].message
+
+
+def test_blocking_call_under_lock_exempts_wait_on_held_condition():
+    src = """
+    import threading
+
+    class Q:
+        def __init__(self):
+            self._cond = threading.Condition()
+            self._items = []  # guarded-by: _cond
+
+        def get(self):
+            with self._cond:
+                while not self._items:
+                    self._cond.wait()   # releases the held lock: legal
+                return self._items.pop()
+    """
+    assert _codes(src, rules=["blocking-call-under-lock"]) == []
+
+
+def test_blocking_call_under_lock_suppression():
+    src = """
+    import threading
+    import time
+
+    _lock = threading.Lock()
+
+    def justified():
+        with _lock:
+            time.sleep(0.01)  # opensim-lint: disable=blocking-call-under-lock
+    """
+    assert _codes(src, rules=["blocking-call-under-lock"]) == []
+
+
+# ---------------------------------------------------------------------------
+# OSL1204 thread-unsafe-contextvar
+# ---------------------------------------------------------------------------
+
+
+def test_thread_unsafe_contextvar_fires_on_ambient_read_in_thread_target():
+    src = """
+    import threading
+
+    from opensim_tpu.resilience.deadline import current_deadline
+
+    def worker():
+        d = current_deadline()   # contextvars do not cross threads: None
+        return d
+
+    def spawn():
+        threading.Thread(target=worker).start()
+    """
+    codes = _codes(src, rules=["thread-unsafe-contextvar"])
+    assert codes == ["OSL1204"]
+
+
+def test_thread_unsafe_contextvar_silent_with_explicit_handoff():
+    src = """
+    import threading
+
+    from opensim_tpu.resilience.deadline import current_deadline, deadline_scope
+
+    def worker(dl):
+        with deadline_scope(dl):
+            return current_deadline()
+
+    def spawn(dl):
+        threading.Thread(target=worker, args=(dl,)).start()
+    """
+    assert _codes(src, rules=["thread-unsafe-contextvar"]) == []
+
+
+def test_thread_unsafe_contextvar_fires_on_thread_subclass_run():
+    src = """
+    import threading
+
+    from opensim_tpu.resilience.deadline import check_deadline
+
+    class Worker(threading.Thread):
+        def run(self):
+            check_deadline("phase")
+    """
+    codes = _codes(src, rules=["thread-unsafe-contextvar"])
+    assert codes == ["OSL1204"]
+
+
+# ---------------------------------------------------------------------------
+# lockwatch — the runtime half
+# ---------------------------------------------------------------------------
+
+
+def test_lockwatch_self_test_catches_seeded_inversion():
+    assert lockwatch.self_test()
+
+
+def test_lockwatch_catches_inversion_across_real_threads():
+    # the seeded A→B/B→A pair, from two distinct threads: the order graph
+    # is process-global, so no interleaving (or deadlock) is needed
+    w = LockWatch(hold_ms=10_000)
+    a = w.wrap(threading.Lock(), "fixture.py:1")
+    b = w.wrap(threading.Lock(), "fixture.py:2")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    for fn in (ab, ba):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    rep = w.report()
+    assert len(rep["inversions"]) == 1
+    inv = rep["inversions"][0]
+    assert {inv["acquiring"], inv["held"]} == {"fixture.py:1", "fixture.py:2"}
+    assert "fixture.py:1" in inv["cycle"] and "fixture.py:2" in inv["cycle"]
+
+
+def test_lockwatch_same_creation_site_is_unordered():
+    # two cache entries' locks share one lock class: taking them in both
+    # orders is NOT an inversion (lockdep-style keying by creation site)
+    w = LockWatch(hold_ms=10_000)
+    a = w.wrap(threading.Lock(), "entry.py:7")
+    b = w.wrap(threading.Lock(), "entry.py:7")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert w.report()["inversions"] == []
+
+
+def test_lockwatch_hold_outlier_and_exemptions():
+    w = LockWatch(hold_ms=5.0, hold_exempt_sites=())
+    hot = w.wrap(threading.Lock(), "hot.py:1")
+    with hot:
+        time.sleep(0.02)
+    rep = w.report()
+    assert len(rep["hold_outliers"]) == 1
+    assert rep["hold_outliers"][0]["lock"] == "hot.py:1"
+    # site-substring exemption (OPENSIM_LOCKWATCH_HOLD_EXEMPT)
+    w2 = LockWatch(hold_ms=5.0, hold_exempt_sites=("hot.py",))
+    hot2 = w2.wrap(threading.Lock(), "hot.py:1")
+    with hot2:
+        time.sleep(0.02)
+    assert w2.report()["hold_outliers"] == []
+    # per-lock exemption (`# lockwatch: hold-exempt` creation-site marker)
+    w3 = LockWatch(hold_ms=5.0, hold_exempt_sites=())
+    hot3 = w3.wrap(threading.Lock(), "hot.py:1", hold_exempt=True)
+    with hot3:
+        time.sleep(0.02)
+    assert w3.report()["hold_outliers"] == []
+
+
+def test_lockwatch_cross_thread_release_clears_owner_stack():
+    # a plain Lock may legally be released by a thread other than the
+    # acquirer (handoff signaling); the owner's held-stack entry must be
+    # closed, not leaked into false order edges on everything it takes next
+    w = LockWatch(hold_ms=10_000)
+    lk = w.wrap(threading.Lock(), "handoff.py:1")
+    other = w.wrap(threading.Lock(), "other.py:1")
+    acquired = threading.Event()
+    released = threading.Event()
+
+    def owner():
+        lk.acquire()
+        acquired.set()
+        released.wait(2.0)  # main thread releases lk meanwhile
+        with other:  # must NOT record handoff.py:1 -> other.py:1
+            pass
+
+    t = threading.Thread(target=owner)
+    t.start()
+    assert acquired.wait(2.0)
+    lk.release()  # cross-thread release
+    released.set()
+    t.join()
+    assert ("handoff.py:1", "other.py:1") not in w.edges
+    assert w.report()["inversions"] == []
+    # the owner's reentrancy count was cleared too: a later acquire of the
+    # lock is first-level again (recorded, not mistaken for an RLock hold)
+    base = w.report()["acquisitions"]
+    with lk:
+        pass
+    assert w.report()["acquisitions"] == base + 1
+
+
+def test_lockwatch_condition_wait_releases_the_lock():
+    # a parked waiter must neither hold the lock (false inversions) nor be
+    # charged hold time across the wait (false outliers)
+    w = LockWatch(hold_ms=50.0, hold_exempt_sites=())
+    tl = w.wrap(threading.Lock(), "cond.py:1")
+    cond = threading.Condition(tl)
+    ready = []
+
+    def consumer():
+        with cond:
+            while not ready:
+                cond.wait(timeout=2.0)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.15)  # parked well past the hold threshold
+    with cond:
+        ready.append(1)
+        cond.notify()
+    t.join()
+    rep = w.report()
+    assert rep["inversions"] == []
+    assert rep["hold_outliers"] == []
+
+
+def test_lockwatch_install_instruments_repo_creations():
+    if lockwatch.current() is not None:
+        pytest.skip("a global lockwatch is already installed (tsan run)")
+    w = lockwatch.install(hold_ms=10_000)
+    try:
+        plain = threading.Lock()
+        exempt = threading.Lock()  # lockwatch: hold-exempt — fixture
+        assert isinstance(plain, lockwatch.TracedLock)
+        assert isinstance(exempt, lockwatch.TracedLock)
+        assert not plain.hold_exempt
+        assert exempt.hold_exempt
+        assert "test_analysis_concurrency.py" in plain.name
+        with plain:
+            pass
+        assert w.acquisitions >= 1
+    finally:
+        rep = lockwatch.uninstall()
+    assert rep is not None and rep["locks"] >= 2
+    assert not isinstance(threading.Lock(), lockwatch.TracedLock)

@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke tsan
+.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan
 
 all: test
 
@@ -27,7 +27,7 @@ mypy:
 # test_watch.py drives the live twin's watch faults (disconnect/410/lost
 # event) against the canned stub apiserver mid-stream (docs/live-twin.md)
 chaos:
-	python -m pytest tests/test_chaos.py tests/test_resilience.py tests/test_watch.py -q
+	python -m pytest tests/test_chaos.py tests/test_resilience.py tests/test_watch.py tests/test_journal.py -q
 
 # perf gate (ISSUE 4): a small affinity workload must engage the C++
 # engine's incremental cache AND match the forced-generic path bit-for-bit
@@ -70,6 +70,14 @@ loadgen-smoke:
 capacity-smoke:
 	python tools/capacity_smoke.py
 
+# durability gate (ISSUE 11, docs/live-twin.md "Durability & replay"):
+# record a stub storm into a journal, crash with a torn tail, recover —
+# fingerprint bit-equal to a fresh relist with ZERO relists and exactly the
+# restored lineage's one full prepare — then `simon replay --speed 10` and
+# `bench.py --config replay` must reproduce the final twin fingerprint
+replay-smoke:
+	python tools/replay_smoke.py
+
 # runtime lock-order sanitizer (docs/static-analysis.md#make-tsan): a
 # seeded A->B/B->A inversion must be caught (detector self-test), then the
 # threaded test modules run under instrumented locks — any observed
@@ -78,8 +86,8 @@ capacity-smoke:
 tsan:
 	python tools/tsan.py
 
-# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain + loadgen + capacity + lock sanitizer
-verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke tsan
+# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain + loadgen + capacity + replay + lock sanitizer
+verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan
 
 # run the moment the TPU tunnel opens (tools/tpu_probe_loop.sh writes
 # /tmp/opensim-tpu-watch.up): compiled-Mosaic parity suite + full bench

@@ -14,6 +14,7 @@ Usage: python bench.py [--pods N] [--nodes N] [--config NAME] [--scenarios N]
 import argparse
 import json
 import os
+import shutil
 import sys
 import time
 
@@ -318,6 +319,127 @@ def bench_serving(concurrency: int, duration_s: float) -> int:
     return 0
 
 
+def _synth_storm_journal(path: str, n_events: int, n_nodes: int) -> None:
+    """Record a synthetic event storm into a fresh journal: one checkpoint
+    anchoring a node fleet, then a pod churn stream (adds, node-bound adds,
+    and deletes — tombstones included) with monotonic resourceVersions, the
+    same wire shapes the live twin journals."""
+    from opensim_tpu.server.journal import Journal
+
+    cluster = synthetic_cluster(n_nodes)
+    journal = Journal(path, policy={"fsync": "off"})
+    try:
+        rv = 1000
+        journal.record_checkpoint(
+            {"nodes": [n.raw for n in cluster.nodes]},
+            generation=1,
+            resume_rvs={"nodes": str(rv), "pods": str(rv)},
+            why="bench",
+        )
+        gen = 1
+        for i in range(n_events):
+            rv += 1
+            gen += 1
+            if i % 10 == 9:
+                # a delete of an earlier add: replay must tombstone it
+                victim = i - 9 + (i % 3)
+                journal.record_event(
+                    "pods", "DELETED",
+                    {"metadata": {"name": f"storm-{victim:06d}", "namespace": "bench",
+                                  "resourceVersion": str(rv)}},
+                    gen,
+                )
+                continue
+            pod = {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"storm-{i:06d}", "namespace": "bench",
+                             "resourceVersion": str(rv)},
+                "spec": {"containers": [
+                    {"name": "c", "resources": {"requests": {
+                        "cpu": "100m", "memory": "256Mi"}}}
+                ]},
+                "status": {"phase": "Pending"},
+            }
+            if i % 3:
+                pod["spec"]["nodeName"] = f"node-{i % n_nodes:05d}"
+                pod["status"]["phase"] = "Running"
+            journal.record_event("pods", "ADDED", pod, gen)
+    finally:
+        journal.close()
+
+
+def bench_replay(journal_path: str, n_events: int, n_nodes: int, speed: float) -> int:
+    """ISSUE 11 benchmark row: stream a recorded (or synthesized) watch-event
+    journal through the twin's apply path + the capacity observatory at
+    ``speed``× (0 = as fast as possible) and report event throughput. The
+    random-access ``rebuild_twin`` view must land bit-equal to the streamed
+    replay — the determinism gate that makes recorded production traces a
+    repeatable scenario corpus (docs/live-twin.md 'Durability & replay')."""
+    import tempfile
+
+    _stage("replay")
+    label = journal_path
+    tmp = None
+    if not journal_path:
+        tmp = tempfile.mkdtemp(prefix="bench-replay-")
+        journal_path = os.path.join(tmp, "journal")
+        label = f"synthetic storm ({_fmt(n_events)} events, {_fmt(n_nodes)} nodes)"
+        _synth_storm_journal(journal_path, n_events, n_nodes)
+    try:
+        return _bench_replay_run(journal_path, label, speed)
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _bench_replay_run(journal_path: str, label: str, speed: float) -> int:
+    from opensim_tpu.obs.capacity import CapacityEngine
+    from opensim_tpu.server.journal import rebuild_twin, replay_events
+
+    capacity = CapacityEngine()
+    counts = {}
+    twin = None
+    t0 = time.time()
+    for rec, twin, change in replay_events(journal_path, speed=speed):
+        counts[rec["t"]] = counts.get(rec["t"], 0) + 1
+        capacity.on_replay(rec, twin, change)
+    wall = time.time() - t0
+    if twin is None:
+        raise RuntimeError(f"{journal_path}: no replayable records")
+    fp = twin.fingerprint()
+    rebuilt, meta = rebuild_twin(journal_path)
+    if rebuilt.fingerprint() != fp:
+        raise RuntimeError(
+            "rebuild_twin fingerprint diverged from the streamed replay "
+            f"({rebuilt.fingerprint()} != {fp})"
+        )
+    events = counts.get("ev", 0)
+    sample = capacity.sample()
+    record = {
+        "metric": f"journal replay event storm ({label})",
+        "value": round(wall, 3),
+        "unit": "s",
+        "config": "replay",
+        "events": events,
+        "rebases": counts.get("rb", 0),
+        "checkpoints": counts.get("ck", 0),
+        "events_per_s": round(events / wall, 1) if wall > 0 else 0.0,
+        "generation": twin.generation,
+        "fingerprint": fp,
+        "rebuild_bit_equal": True,
+        "speed": speed,
+    }
+    if sample is not None:
+        record["nodes"] = sample.nodes
+        record["pods_bound"] = sample.pods_bound
+        record["pods_pending"] = sample.pods_pending
+        record["cpu_utilization"] = round(sample.utilization.get("cpu", 0.0), 4)
+    if BACKEND_NOTE:
+        record["backend_note"] = BACKEND_NOTE
+    print(json.dumps(record))
+    return 0
+
+
 def bench_steady(n_pods: int, n_nodes: int, repeats: int) -> int:
     """Steady-state re-simulation: N repeated simulates against ONE cluster
     through the encode cache (opensim_tpu/engine/prepcache.py). The metric
@@ -386,7 +508,7 @@ def main() -> int:
     ap.add_argument(
         "--config",
         default="plan",
-        choices=["plan", "defrag", "affinity", "example", "gpushare", "bigu", "forced", "steady", "serving"],
+        choices=["plan", "defrag", "affinity", "example", "gpushare", "bigu", "forced", "steady", "serving", "replay"],
         help=(
             "plan = capacity-plan wall-clock (headline); defrag = drain-scenario "
             "sweep; affinity = interpod+spread heavy; example/gpushare = the "
@@ -395,8 +517,20 @@ def main() -> int:
             "pre-bound pods); steady = repeated re-simulation of one cluster "
             "through the encode cache (host-side prepare trajectory); serving "
             "= closed-loop QPS of the live server, admission-batched vs "
-            "single-flight (docs/serving.md)"
+            "single-flight (docs/serving.md); replay = stream a recorded "
+            "watch-event journal (--journal, or a synthesized storm) through "
+            "the twin + capacity observatory (docs/live-twin.md)"
         ),
+    )
+    ap.add_argument(
+        "--journal", default="",
+        help="replay: journal directory recorded by `simon server --journal` "
+        "(default: synthesize an event storm of --events events)",
+    )
+    ap.add_argument("--events", type=int, default=20000, help="replay: synthesized storm size")
+    ap.add_argument(
+        "--speed", type=float, default=0.0,
+        help="replay: pace at N× recorded gaps (0 = as fast as possible)",
     )
     ap.add_argument("--concurrency", type=int, default=48, help="serving: closed-loop clients")
     ap.add_argument("--duration", type=float, default=10.0, help="serving: measured seconds per mode")
@@ -430,6 +564,8 @@ def main() -> int:
     repo = os.path.dirname(os.path.abspath(__file__))
     if args.config == "serving":
         return bench_serving(args.concurrency, args.duration)
+    if args.config == "replay":
+        return bench_replay(args.journal, args.events, args.nodes, args.speed)
     if args.config == "steady":
         return bench_steady(args.pods, args.nodes, args.repeats)
     if args.config == "defrag":

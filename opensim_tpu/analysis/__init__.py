@@ -57,6 +57,7 @@ from . import (  # noqa: F401,E402
     rules_dtype,
     rules_except,
     rules_jit,
+    rules_journal,
     rules_metrics,
     rules_obs,
     rules_reasons,

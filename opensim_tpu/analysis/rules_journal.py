@@ -1,0 +1,124 @@
+"""journal-discipline (OSL1301): journal bytes are written in ONE place.
+
+The crash-safety story of the watch-event journal (``server/journal.py``,
+docs/live-twin.md "Durability & replay") rests on an invariant: every byte
+in a segment file is either the magic header or a CRC32-framed record, so
+recovery can classify ANY tail state — torn frame, short header, absurd
+length, flipped bit — as "truncate here, loudly". One unframed write from
+anywhere else and a corrupt journal stops degrading to a relist and starts
+crashing recovery.
+
+The rule flags:
+
+- outside ``server/journal.py``: ``open(path, mode)`` where the mode
+  writes/appends and the path expression mentions a journal (a literal
+  containing ``journal`` or ``.seg``, or a name/attribute spelled
+  ``*journal*``) — journal files are opened only by the journal module;
+- outside ``server/journal.py``: any ``os.fsync(...)`` — the fsync policy
+  knob (``OPENSIM_JOURNAL_FSYNC``) is only enforceable while the journal
+  module owns every fsync of its files, and nothing else in this repo has
+  durability semantics to fsync;
+- inside ``server/journal.py``: ``self._f.write(...)`` anywhere but the
+  framing helper (``_write_framed``) and the magic stamps
+  (``_open_for_append`` / ``_start_segment``) — an unchecksummed record
+  write is exactly the corruption the framing exists to rule out.
+
+Fix by routing writes through :meth:`Journal._write_framed` (or, outside
+the journal module, through the ``Journal`` API); see
+docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import FileContext, Finding, Rule, dotted_name, register
+
+#: functions in server/journal.py allowed to touch the segment file raw:
+#: the framing helper itself and the two magic-stamp sites
+_FRAMING_FUNCS = ("_write_framed", "_open_for_append", "_start_segment")
+
+_WRITE_MODES = ("a", "w", "x", "+")
+
+
+def _mentions_journal(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            low = sub.value.lower()
+            if "journal" in low or low.endswith(".seg"):
+                return True
+        elif isinstance(sub, ast.Name) and "journal" in sub.id.lower():
+            return True
+        elif isinstance(sub, ast.Attribute) and "journal" in sub.attr.lower():
+            return True
+    return False
+
+
+def _write_mode(node: ast.Call) -> bool:
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)):
+        return False
+    return any(ch in mode.value for ch in _WRITE_MODES)
+
+
+@register
+class JournalDisciplineRule(Rule):
+    name = "journal-discipline"
+    code = "OSL1301"
+    description = "journal bytes written outside server/journal.py's framing path"
+    # tests corrupt journals on purpose (that's what they test)
+    exclude_paths = ("tests/",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        in_journal = ctx.path.replace("\\", "/").endswith("server/journal.py")
+        if in_journal:
+            yield from self._check_journal_module(ctx)
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name == "os.fsync" or (
+                isinstance(node.func, ast.Attribute) and node.func.attr == "fsync"
+            ):
+                yield self.finding(
+                    ctx, node,
+                    "os.fsync outside server/journal.py: the journal module "
+                    "owns durability (OPENSIM_JOURNAL_FSYNC); route writes "
+                    "through the Journal API",
+                )
+            elif name == "open" and node.args and _write_mode(node) and _mentions_journal(node.args[0]):
+                yield self.finding(
+                    ctx, node,
+                    "journal file opened for writing outside "
+                    "server/journal.py: every journal byte must go through "
+                    "Journal._write_framed's CRC32 framing",
+                )
+
+    def _check_journal_module(self, ctx: FileContext) -> Iterable[Finding]:
+        # map each node to its enclosing function name
+        for func in ast.walk(ctx.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if func.name in _FRAMING_FUNCS:
+                continue
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                if (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "write"
+                    and dotted_name(node.func.value) == "self._f"
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        f"unchecksummed segment write in {func.name}(): only "
+                        "_write_framed (CRC32 framing) and the magic stamps "
+                        "may write journal bytes",
+                    )

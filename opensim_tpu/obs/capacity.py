@@ -285,6 +285,28 @@ class CapacityEngine:
             self.generation = generation
             self._boot_key = None  # event-fed: content key no longer applies
 
+    def on_replay(self, record: dict, twin, change: Optional[tuple]) -> None:
+        """Fold one replayed journal record (a ``server/journal.py``
+        :func:`~..server.journal.replay_events` triple) into the
+        aggregates: event records ride the same O(1) ``on_twin_change``
+        path the live dispatch uses, and list-shaped records (checkpoint
+        fast-forward, 410/anti-entropy rebases) rebootstrap from the
+        replay twin — exactly the live supervisor's ``_capacity_rebase``
+        moments, so a replayed timeline matches the recorded one."""
+        t = record.get("t")
+        if t == "ev" and change is not None:
+            self.on_twin_change(
+                str(record.get("f") or ""), str(record.get("k") or ""),
+                record.get("o") or {}, change, int(record.get("gen") or 0),
+            )
+            return
+        if t in ("rb", "ck"):
+            with twin._lock:
+                cluster = twin.materialize()
+                gen = twin.generation
+            self.claim_event_fed()
+            self.bootstrap(cluster, gen)
+
     # -- internal accounting -------------------------------------------------
 
     @staticmethod

@@ -132,6 +132,13 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
     "simon_cluster_nodes": ("Nodes in the observed cluster", "gauge"),
     "simon_cluster_pods_bound": ("Counted pods bound to a node", "gauge"),
     "simon_cluster_pods_pending": ("Counted pods with no node (unschedulable pressure)", "gauge"),
+    # watch-event journal (server/journal.py, docs/live-twin.md) — type ∈
+    # {ev, rb, ck}; outcome ∈ {restored, empty, corrupt}
+    "simon_journal_records_total": ("Journal records written by type (ev/rb/ck)", "counter"),
+    "simon_journal_bytes_total": ("Journal bytes written (framing included)", "counter"),
+    "simon_journal_dropped_total": ("Records dropped at the bounded writer queue", "counter"),
+    "simon_journal_fsync_seconds": ("Journal fsync latency", "histogram"),
+    "simon_journal_recoveries_total": ("Journal recovery attempts by outcome", "counter"),
 }
 
 

@@ -81,6 +81,31 @@ class Sample:
             ],
         }
 
+    @classmethod
+    def from_dict(cls, d: dict) -> "Sample":
+        """Inverse of :meth:`to_dict` (journal checkpoint restore,
+        ``server/journal.py``). Unknown/malformed fields degrade to the
+        dataclass defaults — a checkpoint written by an older build must
+        still restore the samples it does carry."""
+        s = cls(generation=int(d.get("generation") or 0))
+        s.ts = float(d.get("ts") or 0.0)
+        s.nodes = int(d.get("nodes") or 0)
+        s.pods_bound = int(d.get("pods_bound") or 0)
+        s.pods_pending = int(d.get("pods_pending") or 0)
+        for attr in ("allocatable", "requested", "utilization", "spread", "fragmentation"):
+            val = d.get(attr)
+            if isinstance(val, dict):
+                setattr(s, attr, {str(k): float(v) for k, v in val.items()})
+        if isinstance(d.get("headroom"), dict):
+            s.headroom = {str(k): int(v) for k, v in d["headroom"].items()}
+        if isinstance(d.get("hottest"), list):
+            s.hottest = [
+                (str(h.get("node") or ""), {str(k): float(v) for k, v in (h.get("utilization") or {}).items()})
+                for h in d["hottest"]
+                if isinstance(h, dict)
+            ]
+        return s
+
 
 class Timeline:
     """The bounded, generation-keyed sample ring. Appends under its own
@@ -108,6 +133,16 @@ class Timeline:
         """Oldest-first copy (the debug endpoint serializes it)."""
         with self._lock:
             return list(self._ring)
+
+    def restore(self, samples: List[Sample]) -> None:
+        """Seed the ring from a journal checkpoint (oldest first) — only
+        samples strictly newer than the current tail append, so a restore
+        can never rewind a ring that already has fresher generations."""
+        with self._lock:
+            for s in samples:
+                if self._ring and s.generation <= self._ring[-1].generation:
+                    continue
+                self._ring.append(s)
 
     def __len__(self) -> int:
         with self._lock:

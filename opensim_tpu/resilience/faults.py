@@ -30,6 +30,14 @@ point                  fires inside
                        the anti-entropy pass can notice the drift
 ``watch.reorder``      watch event dispatch — the event is delivered AFTER
                        its successor (out-of-order stream)
+``journal.write``      the journal writer thread's record write
+                       (``server/journal.py``) — the disk fails mid-append;
+                       the twin keeps serving, recording degrades loudly
+``journal.fsync``      the journal writer's fsync — same degradation
+                       contract as ``journal.write``
+``journal.corrupt``    ``Journal.recover`` — recovery from a poisoned
+                       journal must degrade to a full relist with a typed
+                       warning, never crash the server
 =====================  ======================================================
 
 Activation, either route:
@@ -72,6 +80,9 @@ FAULT_POINTS = (
     "watch.gone",
     "watch.drop_event",
     "watch.reorder",
+    "journal.write",
+    "journal.fsync",
+    "journal.corrupt",
 )
 
 

@@ -97,13 +97,20 @@ def batch_max() -> int:
 
 
 class QueueFull(RuntimeError):
-    """Typed shed: the admission queue is at its bound. ``retry_after_s``
-    is the dispatcher's drain estimate, surfaced as the 503's
-    ``Retry-After`` header."""
+    """Typed shed: the admission queue cannot take this request.
+    ``retry_after_s`` is the dispatcher's drain estimate, surfaced as the
+    503's ``Retry-After`` header; ``reason`` distinguishes overload
+    (``queue_full`` — retrying later helps) from graceful shutdown
+    (``shutting_down`` — retry against another replica) and is echoed in
+    the 503 body and ``simon_shed_total{reason=}``."""
 
-    def __init__(self, message: str, retry_after_s: float = 1.0) -> None:
+    def __init__(
+        self, message: str, retry_after_s: float = 1.0,
+        reason: str = "queue_full",
+    ) -> None:
         super().__init__(message)
         self.retry_after_s = retry_after_s
+        self.reason = reason
 
 
 @dataclass(eq=False)
@@ -198,7 +205,12 @@ class AdmissionController:
         """Admit (or shed) a ticket; starts the dispatcher on first use."""
         with self._cond:
             if self._closed:
-                raise QueueFull("the server is shutting down", retry_after_s=1.0)
+                with RECORDER.lock:
+                    self.shed.inc(("shutting_down",))
+                raise QueueFull(
+                    "the server is shutting down", retry_after_s=1.0,
+                    reason="shutting_down",
+                )
             if len(self._queue) >= self.bound:
                 depth = len(self._queue)
                 with RECORDER.lock:
@@ -240,14 +252,29 @@ class AdmissionController:
         with self._cond:
             return len(self._queue)
 
-    def stop(self) -> None:
+    def stop(self, drain_s: float = 30.0) -> None:
+        """Graceful drain (SIGTERM/SIGINT, docs/serving.md): queued tickets
+        shed typed 503 ``shutting_down``; the batch/solo already IN FLIGHT
+        completes (its clients get real results) before the worker pool
+        stops — the dispatcher thread is joined up to ``drain_s``."""
         with self._cond:
             self._closed = True
             pending = list(self._queue)
             self._queue.clear()
             self._cond.notify_all()
+            thread = self._thread
+        if pending:
+            with RECORDER.lock:
+                for _t in pending:
+                    self.shed.inc(("shutting_down",))
         for t in pending:
-            t.resolve(error=QueueFull("the server is shutting down"))
+            t.resolve(
+                error=QueueFull(
+                    "the server is shutting down", reason="shutting_down"
+                )
+            )
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=drain_s)
         self._pool.shutdown()
 
     # -- dispatcher ---------------------------------------------------------

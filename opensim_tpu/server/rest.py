@@ -128,7 +128,8 @@ class _Metrics:
         with self.lock:
             setattr(self, counter, getattr(self, counter) + n)
 
-    def render(self, prep_cache=None, watch=None, admission=None, capacity=None) -> str:
+    def render(self, prep_cache=None, watch=None, admission=None, capacity=None,
+               journal=None) -> str:
         from ..utils.trace import PREP_STATS
 
         esc = escape_label_value
@@ -217,6 +218,10 @@ class _Metrics:
         # fragmentation gauges, headroom per registered profile
         if capacity is not None:
             lines += capacity.metrics_lines()
+        # watch-event journal (ISSUE 11, server/journal.py): records/bytes
+        # written, writer-queue drops, fsync latency, recovery outcomes
+        if journal is not None:
+            lines += journal.metrics_lines()
         # per-phase / per-endpoint latency histograms, computed from the
         # same spans the flight recorder serves (obs/metrics.py)
         lines += RECORDER.render_lines()
@@ -349,6 +354,7 @@ class SimonServer:
         watch=None,
         admission=None,
         capacity=None,
+        journal=None,
     ):
         self.kubeconfig = kubeconfig
         self.master = master
@@ -418,13 +424,26 @@ class SimonServer:
         self.capacity = capacity or None
         if self.watch is not None and self.capacity is not None:
             self.watch.capacity = self.capacity
+        # watch-event journal (ISSUE 11, server/journal.py): attached to the
+        # watch supervisor, which restores the twin from its newest
+        # checkpoint + suffix replay at start() and records every accepted
+        # event after — crash-safe instead of merely self-healing. Kept on
+        # the server too for /metrics and the shutdown flush.
+        self.journal = journal
+        if journal is not None and self.watch is not None:
+            self.watch.attach_journal(journal)
         self._headroom_key: Optional[str] = None
 
     def close(self) -> None:
-        """Stop the admission dispatcher + worker pool (pending tickets are
-        resolved with a typed shutdown shed). Idempotent."""
+        """Graceful teardown (docs/serving.md "Shutting down"): stop the
+        admission dispatcher + worker pool (the in-flight batch completes,
+        queued tickets shed typed 503 ``shutting_down``), then flush, fsync
+        and close the journal so the on-disk history is complete up to the
+        last accepted event. Idempotent."""
         if self.admission is not None:
             self.admission.stop()
+        if self.journal is not None:
+            self.journal.close()
 
     def _twin_snapshot(self) -> Optional[tuple]:
         """(cluster, cache key) from the synced live twin, or None when the
@@ -1027,8 +1046,13 @@ class SimonServer:
             _REQUEST_STATE.extra_headers = {
                 "Retry-After": str(max(1, int(math.ceil(e.retry_after_s))))
             }
+            # reason distinguishes overload (queue_full) from graceful
+            # shutdown (shutting_down) — a client should retry the former
+            # against this replica and the latter against another
             code, body = 503, {
-                "error": str(e), "reason": "queue_full", "retryable": True,
+                "error": str(e),
+                "reason": getattr(e, "reason", "queue_full"),
+                "retryable": True,
             }
         except DeadlineExceeded as e:
             status = "deadline-exceeded"
@@ -1290,6 +1314,7 @@ def make_handler(server: SimonServer):
                 data = METRICS.render(
                     prep_cache=server.prep_cache, watch=server.watch,
                     admission=server.admission, capacity=server.capacity,
+                    journal=server.journal,
                 ).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -1429,7 +1454,8 @@ def make_handler(server: SimonServer):
 
 
 def serve(
-    kubeconfig: str = "", master: str = "", port: int = 8080, watch: str = "auto"
+    kubeconfig: str = "", master: str = "", port: int = 8080,
+    watch: str = "auto", journal: str = "",
 ) -> int:
     """Start the REST server. ``watch`` selects the snapshot strategy when a
     kubeconfig is configured (docs/live-twin.md):
@@ -1440,7 +1466,20 @@ def serve(
     - ``on``: require the twin to sync before accepting traffic (fail the
       process if it cannot);
     - ``off``: today's polling behavior only.
+
+    ``journal`` names a directory for the crash-safe watch-event journal
+    (docs/live-twin.md "Durability & replay"): the twin restores from its
+    newest checkpoint + suffix replay at startup and every accepted event
+    is recorded after. Requires the live twin (ignored, loudly, with
+    ``--watch off`` or no kubeconfig).
+
+    SIGTERM/SIGINT shut down gracefully: the listener stops, the admission
+    queue drains (in-flight batch completes, queued requests shed typed
+    503 ``shutting_down``), the reflectors stop, the journal is flushed +
+    fsynced, and the process exits 0.
     """
+    import signal
+
     if watch == "on" and not kubeconfig:
         # "require a synced twin" with nothing to sync FROM is an operator
         # error that must not silently degrade to an empty polling server
@@ -1457,7 +1496,26 @@ def serve(
             ),
             policy=policy,
         )
-    server = SimonServer(kubeconfig=kubeconfig, master=master, watch=supervisor)
+    jrnl = None
+    if journal:
+        if supervisor is None:
+            # a journal with no event stream to record is an operator
+            # mistake worth failing on, not silently ignoring
+            print(
+                "simon server: --journal requires the live twin "
+                "(--kubeconfig and --watch auto|on)", flush=True,
+            )
+            return 1
+        from .journal import Journal, JournalError
+
+        try:
+            jrnl = Journal(journal)
+        except JournalError as e:
+            print(f"simon server: {e}", flush=True)
+            return 1
+    server = SimonServer(
+        kubeconfig=kubeconfig, master=master, watch=supervisor, journal=jrnl
+    )
     if supervisor is not None:
         supervisor.prep_cache = server.prep_cache
         if watch == "on":
@@ -1468,17 +1526,46 @@ def serve(
         else:
             supervisor.start()
     httpd = ThreadingHTTPServer(("0.0.0.0", port), make_handler(server))
+    # graceful shutdown (ISSUE 11 satellite): the handler only nudges the
+    # serve loop from a helper thread (httpd.shutdown() deadlocks when
+    # called from the thread running serve_forever) — the drain sequence
+    # itself runs in the one finally block below, signal or not
+    def _graceful(signum, frame):
+        log.info(
+            "received %s; draining and shutting down",
+            signal.Signals(signum).name,
+        )
+        threading.Thread(
+            target=httpd.shutdown, name="simon-shutdown", daemon=True
+        ).start()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _graceful)
+        except ValueError:
+            # not the main thread (embedded/test use): skip the handlers;
+            # the finally-block drain still runs on loop exit
+            break
     mode = "admission queue" if server.admission is not None else "single-flight"
     print(
         f"simon server listening on :{port} [{mode}]"
         + (" (live twin)" if supervisor else "")
+        + (f" [journal {journal}]" if jrnl is not None else ""),
+        flush=True,
     )
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
-        server.close()
+        # drain order matters: stop admitting first (queued work sheds
+        # typed 503s, the in-flight batch completes), then the reflectors
+        # (no new events), then flush+fsync+close the journal (server
+        # .close()) so the recorded history is complete to the last event
+        if server.admission is not None:
+            server.admission.stop()
         if supervisor is not None:
             supervisor.stop()
+        server.close()
+        print("simon server: shutdown complete", flush=True)
     return 0

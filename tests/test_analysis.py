@@ -827,3 +827,58 @@ def test_admission_lock_io_repo_is_clean():
     root = os.path.join(os.path.dirname(__file__), "..", "opensim_tpu")
     findings = [f for f in lint_paths([root]) if f.code == "OSL1001"]
     assert findings == [], [f"{f.path}:{f.line}" for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# OSL1301 journal-discipline (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+
+def test_journal_discipline_flags_foreign_writes_and_fsync():
+    # a journal path opened for writing outside server/journal.py
+    assert _codes(
+        'f = open("state/journal-00000001.seg", "ab")\n',
+        rules=["journal-discipline"],
+    ) == ["OSL1301"]
+    assert _codes(
+        'f = open(self.journal_path, mode="w")\n',
+        rules=["journal-discipline"],
+    ) == ["OSL1301"]
+    # any os.fsync outside the journal module
+    assert _codes(
+        "import os\nos.fsync(fd)\n", rules=["journal-discipline"]
+    ) == ["OSL1301"]
+
+
+def test_journal_discipline_allows_ordinary_io():
+    # read-mode journal opens and unrelated writes stay legal
+    assert _codes(
+        'f = open("state/journal-00000001.seg", "rb")\n',
+        rules=["journal-discipline"],
+    ) == []
+    assert _codes('f = open("report.txt", "w")\n', rules=["journal-discipline"]) == []
+    # tests are excluded: they corrupt journals on purpose
+    assert _codes(
+        "import os\nos.fsync(3)\n",
+        path="tests/test_journal.py",
+        rules=["journal-discipline"],
+    ) == []
+
+
+def test_journal_discipline_unchecksummed_write_inside_journal_module():
+    src = """
+    class Journal:
+        def _write_framed(self, payload):
+            self._f.write(payload)  # THE framing path: legal
+
+        def _sneaky(self, b):
+            self._f.write(b)  # bypasses the crc framing
+    """
+    assert _codes(
+        src, path="opensim_tpu/server/journal.py", rules=["journal-discipline"]
+    ) == ["OSL1301"]
+
+
+def test_journal_discipline_suppression():
+    src = 'import os\nos.fsync(fd)  # opensim-lint: disable=journal-discipline\n'
+    assert _codes(src, rules=["journal-discipline"]) == []

@@ -596,11 +596,12 @@ def _split_labels(body: str):
     return out
 
 
-def test_metrics_exposition_conformance():
+def test_metrics_exposition_conformance(tmp_path):
     """Every series in /metrics has # HELP/# TYPE, names and labels match
     the Prometheus grammar, and no series is emitted twice — regression-
     proofing the growing registry."""
     from opensim_tpu.server import rest
+    from opensim_tpu.server.journal import Journal
 
     server = rest.SimonServer(base_cluster=_cluster())
     # traffic covering success + unschedulable so the decision counters,
@@ -615,10 +616,22 @@ def test_metrics_exposition_conformance():
     server.cluster_report()
     # watch-apply histogram (ISSUE 9 satellite) joins via the recorder
     RECORDER.observe_watch_apply(0.0002)
+    # journal families (ISSUE 11): records of every type, an fsync, and a
+    # recovery so each family renders populated
+    journal = Journal(str(tmp_path / "journal"), policy={"fsync": "always"})
+    journal.record_checkpoint({"pods": []}, generation=1, why="test")
+    journal.record_event(
+        "pods", "ADDED",
+        {"metadata": {"name": "p", "namespace": "default", "resourceVersion": "2"}}, 2,
+    )
+    journal.record_rebase("pods", [], 3, rv="3", why="test")
+    assert journal.flush(timeout=10.0)
+    assert journal.recover() is not None
+    journal.close()
     # admission families (ISSUE 8) join the same conformance contract
     text = rest.METRICS.render(
         prep_cache=server.prep_cache, admission=server.admission,
-        capacity=server.capacity,
+        capacity=server.capacity, journal=journal,
     )
     helped, typed, seen_series = set(), {}, set()
     families_with_samples = set()
@@ -674,6 +687,12 @@ def test_metrics_exposition_conformance():
         "simon_cluster_pods_bound",
         "simon_cluster_pods_pending",
         "simon_watch_apply_seconds",
+        # watch-event journal (ISSUE 11)
+        "simon_journal_records_total",
+        "simon_journal_bytes_total",
+        "simon_journal_dropped_total",
+        "simon_journal_fsync_seconds",
+        "simon_journal_recoveries_total",
     ):
         assert required in families_with_samples, f"{required} missing from /metrics"
 

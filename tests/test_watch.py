@@ -297,6 +297,99 @@ def test_twin_pod_delta_placements_bit_equal_to_fresh_prepare():
     assert len(entry.prep.ordered) == len(base.prep.ordered) + 2
 
 
+def test_mixed_node_and_pod_waves_bit_equal_to_fresh_prepare():
+    """ISSUE 11 satellite (NOTES round-14): a mixed node+pod batch applied
+    as node-wave-then-pod-wave — ``extend_with_nodes`` then
+    ``twin_pod_delta`` on the extended entry, exactly ``flush_pending``'s
+    decomposition — schedules byte-identically to a fresh full prepare of
+    the post-batch cluster."""
+    from opensim_tpu.engine import prepcache
+    from opensim_tpu.engine.simulator import prepare, simulate
+
+    def cluster(post=False):
+        rt = ResourceTypes()
+        for i in range(4):
+            rt.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+        if post:
+            rt.nodes.append(fx.make_fake_node("n9", "8", "16Gi"))
+        if not post:
+            rt.pods.append(Pod.from_dict(_pod_dict("dead", phase="Running", node="n0", cpu="300m")))
+        rt.pods.append(Pod.from_dict(_pod_dict("keep", phase="Pending", cpu="200m")))
+        if post:
+            rt.pods.append(Pod.from_dict(_pod_dict("new-a", cpu="450m")))
+        return rt
+
+    base_cluster = cluster()
+    base = prepcache.CacheEntry("m|base", prepare(base_cluster, []))
+    post = cluster(post=True)
+    new_nodes = [n for n in post.nodes if n.metadata.name == "n9"]
+    added = [Pod.from_dict(_pod_dict("new-a", cpu="450m"))]
+    with base.lock:
+        base.restore()
+        # node wave: arena extend keeps the lineage
+        new_prep = prepcache.extend_with_nodes(base.prep, new_nodes, post, [], base_entry=base)
+        assert new_prep is not None, "node wave must extend, not rebuild"
+        mid = prepcache.CacheEntry("m2|base", new_prep, base=base)
+        mid.base_drop = prepcache.pad_drop_mask(base.base_drop, len(new_prep.ordered))
+    with mid.lock:
+        # pod wave on top: bare-region insert + tombstone mask flip
+        entry = prepcache.twin_pod_delta(mid, "m3|base", added, {("default", "dead")})
+    assert entry is not None and entry.base_drop is not None
+
+    res_delta = simulate(post, [], prep=entry.prep, drop_pods=entry.base_drop)
+    res_fresh = simulate(cluster(post=True), [])
+
+    def placed(res):
+        return {
+            p.metadata.name: ns.node.metadata.name
+            for ns in res.node_status
+            for p in ns.pods
+        }
+
+    assert placed(res_delta) == placed(res_fresh)
+    assert "dead" not in placed(res_delta)
+
+
+def test_mixed_flush_keeps_lineage_warm_end_to_end(tmp_path):
+    """A node ADDED arriving in the same pending batch as pod churn used to
+    drop the warm prep lineage wholesale; the wave split keeps it: no second
+    full prepare, one delta_nodes + one twin_delta, and placements
+    shape-equal to a polling server's full relist."""
+    from opensim_tpu.utils.trace import PREP_STATS
+
+    with _twin_server(tmp_path, pods=[_pod_dict("p1", phase="Running", node="n0")]) as (
+        stub, sup, server, kc,
+    ):
+        code, _ = server.deploy_apps(_payload())
+        assert code == 200
+        full0 = PREP_STATS.counts.get("full", 0)
+        dn0 = PREP_STATS.counts.get("delta_nodes", 0)
+        td0 = PREP_STATS.counts.get("twin_delta", 0)
+
+        # one mixed batch: a node joins while pods churn
+        stub.upsert("/api/v1/nodes", fx.make_fake_node("n9", "8", "16Gi").raw)
+        stub.upsert("/api/v1/pods", _pod_dict("p2", cpu="250m"))
+        stub.delete("/api/v1/pods", "p1")
+        _wait(
+            lambda: len(sup.twin.materialize().nodes) == 5
+            and sorted(p.metadata.name for p in sup.twin.materialize().pods) == ["p2"],
+            msg="mixed batch applied to the twin",
+        )
+        sup.flush_pending()
+        assert PREP_STATS.counts.get("full", 0) == full0, "mixed flush dropped the lineage"
+        assert PREP_STATS.counts.get("delta_nodes", 0) == dn0 + 1  # node wave
+        assert PREP_STATS.counts.get("twin_delta", 0) == td0 + 1  # pod wave
+
+        code, body = server.deploy_apps(_payload())
+        assert code == 200
+        assert PREP_STATS.counts.get("full", 0) == full0
+
+        polling = rest.SimonServer(kubeconfig=kc)
+        code, ref = polling.deploy_apps(_payload())
+        assert code == 200
+        assert _shape(body) == _shape(ref)
+
+
 def test_twin_pod_delta_refuses_past_compaction_threshold():
     """Pure add/delete churn must not grow the masked-row count without
     bound: past the density threshold the delta is refused (None) so the

@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""Durability & replay smoke gate (``make replay-smoke``, part of
+``make verify``).
+
+The ISSUE 11 crash-recovery proof, end to end and in one process:
+
+1. start the canned stub apiserver and a journaled watch-mode server
+   (``simon server --journal`` wiring, in-process); serve one deploy-apps
+   request (builds the warm base prep), then mutate the cluster through an
+   event storm;
+2. "crash": abandon the supervisor WITHOUT a clean stop and scribble a torn
+   frame onto the newest segment (the on-disk shape a SIGKILL mid-write
+   leaves behind);
+3. recover: a fresh supervisor on the same journal must restore the twin
+   from checkpoint + suffix replay — fingerprint bit-equal to a fresh full
+   relist, ZERO relists spent, the torn tail truncated loudly, and
+   ``simon_journal_recoveries_total{outcome="restored"}`` counted;
+4. prove the restored lineage is warm: post-restore deploys pay exactly ONE
+   full prepare and a calm-phase event rides the twin_delta re-encoder;
+5. replay: ``simon replay <journal> --speed 10`` must reproduce the final
+   twin fingerprint, and ``bench.py --config replay --journal <journal>``
+   must emit a benchmark row with ``rebuild_bit_equal``.
+
+Exit 0 on success; 1 with a one-line reason per failed check.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fail(msg: str) -> int:
+    print(f"replay-smoke: FAIL: {msg}")
+    return 1
+
+
+def _pod(name, phase="Pending", node="", cpu="100m"):
+    d = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": cpu}}}]},
+        "status": {"phase": phase},
+    }
+    if node:
+        d["spec"]["nodeName"] = node
+    return d
+
+
+def _wait(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def main() -> int:
+    from http.server import ThreadingHTTPServer
+
+    from opensim_tpu.engine.prepcache import fingerprint_cluster
+    from opensim_tpu.models import fixtures as fx
+    from opensim_tpu.server import rest
+    from opensim_tpu.server.journal import Journal
+    from opensim_tpu.server.snapshot import _cluster_via_rest
+    from opensim_tpu.server.stubapi import StubApiServer
+    from opensim_tpu.server.watch import RestWatchSource, WatchSupervisor
+    from opensim_tpu.utils.trace import PREP_STATS
+
+    stub = StubApiServer(bookmark_interval_s=0.1).start()
+    stub.seed("/api/v1/nodes", [fx.make_fake_node(f"n{i}", "8", "16Gi").raw for i in range(4)])
+    stub.seed("/api/v1/pods", [_pod("seed", phase="Running", node="n0")])
+    for p in (
+        "/apis/apps/v1/daemonsets", "/apis/policy/v1/poddisruptionbudgets",
+        "/api/v1/services", "/apis/storage.k8s.io/v1/storageclasses",
+        "/api/v1/persistentvolumeclaims", "/api/v1/configmaps",
+    ):
+        stub.seed(p, [])
+    tmp = tempfile.mkdtemp(prefix="replay-smoke-")
+    kc = stub.kubeconfig(tmp)
+    jdir = os.path.join(tmp, "journal")
+
+    policy = {"stale_s": 5.0, "resync_s": 0.0, "reconnects": 3, "backoff_s": 0.02}
+    # fsync=always: the crash-test setting — every accepted event is on disk
+    # before the "crash" below
+    sup1 = WatchSupervisor(
+        RestWatchSource(kc, read_timeout_s=5.0), policy=policy,
+        journal=Journal(jdir, policy={"fsync": "always"}),
+    )
+    fp_crash = None
+    try:
+        if not sup1.start(wait_s=15.0):
+            return fail("recording twin did not sync against the stub apiserver")
+
+        for i in range(25):
+            stub.upsert("/api/v1/pods", _pod(f"storm-{i}", cpu="150m"))
+        stub.delete("/api/v1/pods", "storm-3")
+        want = {f"storm-{i}" for i in range(25)} - {"storm-3"} | {"seed"}
+        if not _wait(lambda: {p.metadata.name for p in sup1.twin.materialize().pods} == want):
+            return fail("recording twin did not converge on the storm")
+        if not sup1.journal.flush(timeout=10.0):
+            return fail("journal flush before the crash timed out")
+        fp_crash = sup1.twin.fingerprint()
+    finally:
+        # a failed recording phase ends the run; success "crashes" instead:
+        # no sup1.stop(), no journal.close() — the writer just stops being
+        # scheduled, exactly like a SIGKILL
+        if fp_crash is None:
+            stub.stop()
+    # --- the crash: halt sup1's threads (a SIGKILL would take them too —
+    # the true-subprocess version lives in tests/test_journal.py) but never
+    # close the journal, then scribble a torn half-frame onto the newest
+    # segment: the on-disk shape of dying mid-write
+    sup1.stop()
+    segs = sorted(f for f in os.listdir(jdir) if f.endswith(".seg"))
+    if not segs:
+        stub.stop()
+        return fail("no journal segments were written")
+    with open(os.path.join(jdir, segs[-1]), "ab") as f:
+        f.write(b"\x94\x00\x00\x00TORN")  # length says 148, bytes say crash
+
+    # --- recovery ----------------------------------------------------------
+    jr2 = Journal(jdir, policy={"fsync": "always"})
+    sup2 = WatchSupervisor(RestWatchSource(kc, read_timeout_s=5.0), policy=policy, journal=jr2)
+    server = rest.SimonServer(kubeconfig=kc, watch=sup2, journal=jr2)
+    sup2.prep_cache = server.prep_cache
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), rest.make_handler(server))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        PREP_STATS.reset()
+        if not sup2.start(wait_s=15.0):
+            return fail("recovery twin did not come up from the journal")
+        if sup2.relists_total != 0:
+            return fail(
+                f"recovery spent {sup2.relists_total} relist(s); the journal "
+                "restore path must resume the reflectors without one"
+            )
+        fresh, _rvs = _cluster_via_rest(kc, None)
+        if sup2.twin.fingerprint() != fingerprint_cluster(fresh):
+            return fail("restored fingerprint != fresh full relist")
+        if sup2.twin.fingerprint() != fp_crash:
+            return fail("restored fingerprint != the twin at crash time")
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+            metrics = resp.read().decode()
+        if 'simon_journal_recoveries_total{outcome="restored"} 1' not in metrics:
+            return fail("/metrics missing the restored-recovery counter")
+
+        # --- warm lineage: exactly ONE full prepare after recovery ---------
+        payload = json.dumps(
+            {"deployments": [fx.make_fake_deployment("smoke", 5, "500m", "1Gi").raw]}
+        ).encode()
+
+        def post():
+            req = urllib.request.Request(f"{base}/api/deploy-apps", data=payload, method="POST")
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.status, json.load(resp)
+
+        status, _ = post()
+        if status != 200:
+            return fail(f"post-recovery deploy-apps returned HTTP {status}")
+        if PREP_STATS.counts.get("full", 0) != 1:
+            return fail(
+                f"post-recovery deploy paid {PREP_STATS.counts.get('full', 0)} "
+                "full prepares (want the restored lineage's one)"
+            )
+        gen_before = sup2.twin.generation
+        stub.upsert("/api/v1/pods", _pod("calm"))
+        if not _wait(lambda: sup2.twin.generation > gen_before):
+            return fail("calm-phase event never reached the restored twin")
+        sup2.flush_pending()
+        status, _ = post()
+        if status != 200:
+            return fail(f"calm-phase deploy-apps returned HTTP {status}")
+        if PREP_STATS.counts.get("full", 0) != 1:
+            return fail("calm-phase request paid a second full prepare on the restored lineage")
+
+        # --- drift against the journal-restored twin is journaled as a
+        # rebase record, keeping the file a faithful history (the replay
+        # below must land on the post-repair state)
+        from opensim_tpu.resilience import faults
+
+        faults.inject("watch.drop_event", count=1, exc="fault")
+        stub.upsert("/api/v1/pods", _pod("dropped"))
+        if not _wait(lambda: faults.fault_stats().get("watch.drop_event") == 1):
+            return fail("watch.drop_event fault never fired")
+        if sup2.anti_entropy() < 0:
+            return fail("anti-entropy relist failed")
+        if sup2.drift_total < 1:
+            return fail("dropped event was not detected as drift")
+        fp_final = sup2.twin.fingerprint()
+    finally:
+        sup2.stop()
+        httpd.shutdown()
+        server.close()
+        stub.stop()
+
+    # --- replay at 10x reproduces the final twin ---------------------------
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo)
+    out = subprocess.run(
+        [sys.executable, "-m", "opensim_tpu", "replay", jdir, "--speed", "10"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+    )
+    if out.returncode != 0:
+        return fail(f"simon replay failed: {out.stderr.strip()[-300:]}")
+    summary = json.loads(out.stdout.strip().splitlines()[-1])
+    if summary["fingerprint"] != fp_final:
+        return fail(
+            f"replayed fingerprint {summary['fingerprint']} != live final {fp_final}"
+        )
+    if summary["rebases"] < 1:
+        return fail("the crash-time anti-entropy rebase was not journaled")
+
+    bench = subprocess.run(
+        [sys.executable, "bench.py", "--config", "replay", "--journal", jdir],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+    )
+    if bench.returncode != 0:
+        return fail(f"bench.py --config replay failed: {bench.stderr.strip()[-300:]}")
+    row = json.loads(bench.stdout.strip().splitlines()[-1])
+    if row.get("config") != "replay" or not row.get("rebuild_bit_equal"):
+        return fail(f"bench replay row malformed: {row}")
+
+    print(
+        "replay-smoke: ok — crash with torn tail restored bit-equal to a "
+        f"fresh relist with 0 relists and 1 full prepare; 10x replay of "
+        f"{summary['events']} event(s) + {summary['rebases']} rebase(s) "
+        f"reproduced fingerprint {fp_final}; bench row "
+        f"{row['events_per_s']} events/s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

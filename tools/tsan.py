@@ -8,7 +8,9 @@ Three phases, any failure exits non-zero:
 2. **Instrumented run**: installs the lockwatch wrapper (every
    ``threading.Lock``/``RLock`` created from repo code afterwards is
    traced), then runs the threaded test modules — ``test_watch.py``,
-   ``test_admission.py``, ``test_capacity.py`` — in-process under it.
+   ``test_admission.py``, ``test_capacity.py``, ``test_journal.py`` (the
+   journal's bounded writer must never convoy reflector dispatch; its
+   dispatch-side hold times are gated here) — in-process under it.
 3. **Verdict**: any lock-order inversion, any non-exempt hold-time
    outlier (> ``OPENSIM_LOCKWATCH_HOLD_MS``, default 500), or a test
    failure fails the gate. Both acquisition stacks are printed for
@@ -28,7 +30,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-THREADED_TESTS = ("test_watch.py", "test_admission.py", "test_capacity.py")
+THREADED_TESTS = ("test_watch.py", "test_admission.py", "test_capacity.py", "test_journal.py")
 
 
 def main() -> int:

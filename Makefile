@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan
+.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan mem-smoke perf-guard
 
 all: test
 
@@ -78,6 +78,23 @@ capacity-smoke:
 replay-smoke:
 	python tools/replay_smoke.py
 
+# memory-observatory gate (ISSUE 12, docs/observability.md "Memory &
+# profiles"): a request storm + twin-delta churn must move the simon_mem_*
+# gauges, prep-cache totals must reconcile exactly with the per-entry
+# arena attributions, delta lineage/drop density must be visible, and the
+# whole scrape must stay exposition-conformant with zero duplicate series
+mem-smoke:
+	python tools/mem_smoke.py
+
+# perf-regression sentinel (ISSUE 12, BENCH.md "Guarding the trajectory"):
+# every committed BENCH_BASELINE.json row must pass its own tolerances AND
+# a synthetically slowed copy must fail (detector-awake proof). Run in
+# tolerance-only mode under verify so wall-clock on a slow CI box cannot
+# flake the build while exact metrics (placement counts, error counts)
+# still gate. Fresh-row runs: tools/perf_guard.py --fresh --baseline KEY
+perf-guard:
+	python tools/perf_guard.py --tolerance-only
+
 # runtime lock-order sanitizer (docs/static-analysis.md#make-tsan): a
 # seeded A->B/B->A inversion must be caught (detector self-test), then the
 # threaded test modules run under instrumented locks — any observed
@@ -86,8 +103,8 @@ replay-smoke:
 tsan:
 	python tools/tsan.py
 
-# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain + loadgen + capacity + replay + lock sanitizer
-verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan
+# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain + loadgen + capacity + replay + lock sanitizer + memory + perf trajectory
+verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan mem-smoke perf-guard
 
 # run the moment the TPU tunnel opens (tools/tpu_probe_loop.sh writes
 # /tmp/opensim-tpu-watch.up): compiled-Mosaic parity suite + full bench
@@ -123,6 +140,7 @@ bench-serial:
 
 docs:
 	python -m opensim_tpu gen-doc --output-dir docs/commandline
+	python -m opensim_tpu.utils.envknobs > docs/env.md
 
 native:
 	python -c "from opensim_tpu import native; p = native.ensure_built(); print(p or native.load_error())"

@@ -28,6 +28,10 @@ Rules (short name = suppression id; see docs/static-analysis.md):
                               critical section in the repo
     OSL1204 thread-unsafe-contextvar  ambient Deadline/Trace read in a
                               thread entry without explicit handoff
+    OSL1301 journal-discipline  unchecksummed/foreign writes on journal
+                              paths (server/journal.py owns the format)
+    OSL1401 env-registry      raw os.environ read of an OPENSIM_* knob
+                              outside utils/envknobs.py
 
 The OSL12xx family is whole-program (symbol table + call graph + lock
 graph across all linted files); its runtime counterpart is the lock-order
@@ -55,6 +59,7 @@ from . import (  # noqa: F401,E402
     rules_concurrency,
     rules_determinism,
     rules_dtype,
+    rules_env,
     rules_except,
     rules_jit,
     rules_journal,

@@ -55,6 +55,8 @@ import time
 import traceback
 from typing import Dict, List, Optional, Tuple
 
+from ..utils import envknobs
+
 log = logging.getLogger("opensim_tpu.analysis")
 
 __all__ = ["LockWatch", "TracedLock", "enabled", "install", "uninstall", "current"]
@@ -63,14 +65,14 @@ __all__ = ["LockWatch", "TracedLock", "enabled", "install", "uninstall", "curren
 def enabled() -> bool:
     """``OPENSIM_LOCKWATCH=1`` switches the sanitizer on (tools/tsan.py
     sets it; production serving never pays the bookkeeping)."""
-    return os.environ.get("OPENSIM_LOCKWATCH", "").strip().lower() in ("1", "on", "true")
+    return envknobs.raw("OPENSIM_LOCKWATCH").strip().lower() in ("1", "on", "true")
 
 
 def hold_threshold_ms() -> float:
     """``OPENSIM_LOCKWATCH_HOLD_MS`` (default 500): ownership segments
     longer than this are reported as hold-time outliers. A typo degrades
     to the default with a warning (the env-knob contract)."""
-    raw = os.environ.get("OPENSIM_LOCKWATCH_HOLD_MS", "")
+    raw = envknobs.raw("OPENSIM_LOCKWATCH_HOLD_MS")
     if raw:
         try:
             return max(1.0, float(raw))
@@ -91,7 +93,7 @@ def hold_exempt() -> Tuple[str, ...]:
     lock, all of which span engine work whose latency is gated by
     perf-smoke/loadgen-smoke instead) are marked that way. Inversions
     are NEVER exempt either way."""
-    raw = os.environ.get("OPENSIM_LOCKWATCH_HOLD_EXEMPT", "")
+    raw = envknobs.raw("OPENSIM_LOCKWATCH_HOLD_EXEMPT")
     return tuple(s.strip() for s in raw.split(",") if s.strip())
 
 

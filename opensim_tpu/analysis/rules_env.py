@@ -1,0 +1,97 @@
+"""env-registry (OSL1401): ``OPENSIM_*`` environment reads go through
+``utils/envknobs.py``.
+
+The knob surface is ~45 variables; before the registry each one was read
+ad hoc (``os.environ.get`` + local parse + local default), so the surface
+was undiscoverable, a typo'd name silently read as unset, and the
+documented default could drift from the parsed one. ``utils/envknobs.py``
+is now the one read path: :func:`~opensim_tpu.utils.envknobs.raw` fails
+loudly on an unregistered name and the registry generates ``docs/env.md``.
+
+The rule flags, in any module other than ``utils/envknobs.py``:
+
+- ``os.environ.get("OPENSIM_…")`` / ``os.getenv("OPENSIM_…")`` calls;
+- ``os.environ["OPENSIM_…"]`` subscripts in read (Load) context;
+- ``"OPENSIM_…" in os.environ`` membership probes.
+
+WRITES stay legal (``os.environ["OPENSIM_X"] = v`` — the CLI's
+``--backend`` plumbing and tests arm knobs for downstream code);
+governance is about undeclared reads. Fix by registering the knob in
+``utils/envknobs.py`` (name, type, default, validator, doc) and reading it
+via ``envknobs.raw(...)`` / ``envknobs.value(...)``; see
+docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import FileContext, Finding, Rule, dotted_name, register
+
+_FIX = (
+    "read it through utils/envknobs.py (envknobs.raw/value) and register "
+    "the knob there so docs/env.md covers it"
+)
+
+
+def _is_environ(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    return bool(name) and (name == "environ" or name.endswith(".environ"))
+
+
+def _opensim_const(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        and node.value.startswith("OPENSIM_")
+    )
+
+
+@register
+class EnvRegistryRule(Rule):
+    name = "env-registry"
+    code = "OSL1401"
+    description = "raw os.environ read of an OPENSIM_* knob outside utils/envknobs.py"
+    # the registry module IS the read path; tests arm knobs on purpose
+    exclude_paths = ("utils/envknobs.py", "tests/")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func) or ""
+                leaf = name.rsplit(".", 1)[-1]
+                reads_env = (
+                    (leaf == "get" and isinstance(node.func, ast.Attribute)
+                     and _is_environ(node.func.value))
+                    or leaf == "getenv"
+                )
+                if reads_env and node.args and _opensim_const(node.args[0]):
+                    yield self.finding(
+                        ctx, node,
+                        f"{node.args[0].value} is read straight from the "
+                        f"environment; {_FIX}",
+                    )
+            elif isinstance(node, ast.Subscript):
+                if (
+                    isinstance(node.ctx, ast.Load)
+                    and _is_environ(node.value)
+                    and _opensim_const(node.slice)
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        f"{node.slice.value} is subscript-read straight from "
+                        f"the environment; {_FIX}",
+                    )
+            elif isinstance(node, ast.Compare):
+                if (
+                    _opensim_const(node.left)
+                    and len(node.ops) == 1
+                    and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                    and _is_environ(node.comparators[0])
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        f"{node.left.value} membership-probed straight on the "
+                        f"environment; {_FIX} (envknobs.is_set)",
+                    )

@@ -208,10 +208,57 @@ def build_parser() -> argparse.ArgumentParser:
         "fragmentation only)",
     )
     top_p.add_argument(
+        "--mem", action="store_true",
+        help="add the memory observatory block (process RSS, prep-cache "
+        "arena bytes, ring occupancy — docs/observability.md 'Memory & "
+        "profiles')",
+    )
+    top_p.add_argument(
         "-e", "--extended-resources", default="",
         help="comma-separated extended resource sections (gpu,open-local)",
     )
     top_p.add_argument("--timeout", type=float, default=60.0, help="per-request client timeout seconds")
+
+    mem_p = sub.add_parser(
+        "mem",
+        help="memory observatory: arena/cache footprint of a live server",
+        description=(
+            "read GET /api/debug/memory from a live simon server "
+            "(docs/observability.md 'Memory & profiles'): process RSS and "
+            "watermarks, per-device accelerator memory where available, the "
+            "prep cache's host arena bytes attributed per entry (by encoder "
+            "field and dtype, with lineage depth and drop-mask density), and "
+            "bounded-ring occupancy (flight recorder, capacity timeline, "
+            "journal writer queue). Totals count shared delta-entry leaves "
+            "once and reconcile exactly with the per-entry unique-bytes sum"
+        ),
+    )
+    mem_p.add_argument("--url", required=True, help="base URL of the live server (http://host:port)")
+    mem_p.add_argument("--json", action="store_true", help="print the raw debug JSON instead of tables")
+    mem_p.add_argument(
+        "--fields", action="store_true",
+        help="include the per-entry per-field arena breakdown (verbose)",
+    )
+    mem_p.add_argument("--timeout", type=float, default=60.0, help="per-request client timeout seconds")
+
+    profile_p = sub.add_parser(
+        "profile",
+        help="cumulative phase profiles + compile telemetry of a live server",
+        description=(
+            "read GET /api/debug/profile from a live simon server "
+            "(docs/observability.md 'Memory & profiles'): per-span cumulative "
+            "latency profiles folded from every recorded request trace "
+            "(count, inclusive/exclusive seconds, p50/p99) so 'where do "
+            "requests spend their time' is one query instead of N traces, "
+            "plus JIT compile telemetry — compiles and seconds per "
+            "instrumented boundary with recompile-cause attribution (shape "
+            "vs dtype vs static-flag change) and the persistent compile "
+            "cache's footprint"
+        ),
+    )
+    profile_p.add_argument("--url", required=True, help="base URL of the live server (http://host:port)")
+    profile_p.add_argument("--json", action="store_true", help="print the raw debug JSON instead of tables")
+    profile_p.add_argument("--timeout", type=float, default=60.0, help="per-request client timeout seconds")
 
     replay_p = sub.add_parser(
         "replay",
@@ -431,6 +478,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             return run_top(args)
         except KeyboardInterrupt:
             return 0
+    if args.command == "mem":
+        return run_mem(args)
+    if args.command == "profile":
+        return run_profile(args)
     if args.command == "gen-doc":
         return gen_doc(parser, args.output_dir)
     parser.print_help()
@@ -452,6 +503,8 @@ def run_top(args) -> int:
     params = {}
     if args.no_headroom:
         params["headroom"] = "0"
+    if args.mem:
+        params["mem"] = "1"
     extended = [e for e in args.extended_resources.split(",") if e]
     if extended:
         params["extended"] = ",".join(extended)
@@ -488,6 +541,167 @@ def run_top(args) -> int:
         else:
             print(rendered)
             return 0
+
+
+def _fetch_debug(url: str, timeout: float):
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return _json.load(resp), None
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        return None, f"{url}: {e}"
+
+
+def run_mem(args) -> int:
+    """``simon mem``: the memory observatory's live view — fetch
+    ``GET /api/debug/memory`` and render the footprint tables (or the raw
+    JSON with ``--json``)."""
+    import json as _json
+
+    from ..obs.footprint import fmt_bytes
+    from ..planner.report import _table
+
+    url = f"{args.url.rstrip('/')}/api/debug/memory"
+    if not args.fields:
+        url += "?fields=0"
+    payload, err = _fetch_debug(url, args.timeout)
+    if err:
+        print(f"simon mem: {err}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(_json.dumps(payload, indent=2, sort_keys=True))
+        return 0
+    out = sys.stdout
+    proc = payload.get("process") or {}
+    print(
+        f"process: RSS {fmt_bytes(int(proc.get('rss_bytes', 0)))} "
+        f"(peak {fmt_bytes(int(proc.get('rss_peak_bytes', 0)))})",
+        file=out,
+    )
+    for dev, stats in sorted((payload.get("devices") or {}).items()):
+        print(
+            f"device {dev}: {fmt_bytes(int(stats.get('in_use', 0)))} in use "
+            f"(peak {fmt_bytes(int(stats.get('peak', 0)))})",
+            file=out,
+        )
+    cache = payload.get("prepcache") or {}
+    entries = cache.get("entries") or []
+    print(
+        f"\nprep cache: {fmt_bytes(int(cache.get('total_bytes', 0)))} across "
+        f"{len(entries)} entr{'y' if len(entries) == 1 else 'ies'} "
+        f"({fmt_bytes(int(cache.get('shared_bytes', 0)))} shared between "
+        f"delta lineages), {cache.get('compactions', 0)} compaction(s)",
+        file=out,
+    )
+    dtypes = cache.get("dtypes") or {}
+    if dtypes:
+        print(
+            "arena bytes by dtype: "
+            + ", ".join(f"{k}={fmt_bytes(int(v))}" for k, v in sorted(dtypes.items())),
+            file=out,
+        )
+    if entries:
+        rows = [["Entry", "Bytes", "Unique", "Depth", "Pods", "Drop%"]]
+        for e in entries:
+            rows.append(
+                [
+                    e.get("key", "")[:40],
+                    fmt_bytes(int(e.get("bytes", 0))),
+                    fmt_bytes(int(e.get("unique_bytes", 0))),
+                    str(e.get("lineage_depth", 0)),
+                    str(e.get("pods", 0)),
+                    f"{float(e.get('drop_density', 0.0)) * 100:.1f}",
+                ]
+            )
+        print("", file=out)
+        _table(rows, out)
+    rings = payload.get("rings") or {}
+    if rings:
+        rows = [["Ring", "Occupancy"]]
+        for ring, occ in sorted(rings.items()):
+            rows.append([ring, f"{occ.get('entries', 0)}/{occ.get('capacity', 0)}"])
+        print("", file=out)
+        _table(rows, out)
+    return 0
+
+
+def run_profile(args) -> int:
+    """``simon profile``: cumulative per-phase latency profiles + compile
+    telemetry from ``GET /api/debug/profile``."""
+    import json as _json
+
+    from ..planner.report import _table
+
+    payload, err = _fetch_debug(
+        f"{args.url.rstrip('/')}/api/debug/profile", args.timeout
+    )
+    if err:
+        print(f"simon profile: {err}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(_json.dumps(payload, indent=2, sort_keys=True))
+        return 0
+    out = sys.stdout
+    phases = payload.get("phases") or {}
+    spans = phases.get("spans") or {}
+    print(f"phase profile over {phases.get('traces', 0)} recorded trace(s):", file=out)
+    rows = [["Span", "Calls", "Total s", "Exclusive s", "Mean s", "p50 s", "p99 s", "Max s"]]
+    for name, d in spans.items():
+        rows.append(
+            [
+                name,
+                str(d.get("count", 0)),
+                f"{d.get('seconds', 0.0):.3f}",
+                f"{d.get('exclusive_seconds', 0.0):.3f}",
+                f"{d.get('mean_s', 0.0):.4f}",
+                f"{d.get('p50_s', 0.0):.4f}",
+                f"{d.get('p99_s', 0.0):.4f}",
+                f"{d.get('max_s', 0.0):.4f}",
+            ]
+        )
+    _table(rows, out)
+    compiles = payload.get("compiles") or {}
+    backend = compiles.get("backend") or {}
+    print(
+        f"\nbackend compiles: {backend.get('compiles', 0)} "
+        f"({backend.get('seconds', 0.0):.2f}s)",
+        file=out,
+    )
+    boundaries = compiles.get("boundaries") or {}
+    if boundaries:
+        rows = [["Boundary", "Compiles", "Seconds", "Signatures", "Causes"]]
+        for name, fn in sorted(boundaries.items()):
+            causes = ", ".join(
+                f"{c}={n}" for c, n in sorted((fn.get("causes") or {}).items())
+            )
+            rows.append(
+                [
+                    name,
+                    str(fn.get("compiles", 0)),
+                    f"{fn.get('seconds', 0.0):.3f}",
+                    str(fn.get("distinct_signatures", 0)),
+                    causes,
+                ]
+            )
+        _table(rows, out)
+    pc = compiles.get("persistent_cache")
+    if pc:
+        print(
+            f"persistent jit cache: {pc.get('files', 0)} file(s), "
+            f"{pc.get('bytes', 0)} bytes at {pc.get('dir', '')}",
+            file=out,
+        )
+    events = compiles.get("cache_events") or {}
+    if events:
+        print(
+            "compilation-cache events: "
+            + ", ".join(f"{k}={v}" for k, v in sorted(events.items())),
+            file=out,
+        )
+    return 0
 
 
 def run_replay(args) -> int:

@@ -18,6 +18,7 @@ import numpy as np
 from ..encoding import vocab as V
 from ..ops import kernels
 from ..ops.pallas_scan import CHUNK, FastInputs, run_fast_scan
+from ..utils import envknobs
 from .schedconfig import DEFAULT_CONFIG
 
 HOSTNAME = "kubernetes.io/hostname"
@@ -99,13 +100,11 @@ def why_not(prep, config=None) -> Optional[str]:
             return "hostname domains are not node-identity (duplicate hostname labels)"
     # pallas compiled path only on TPU; elsewhere the interpreter would be
     # slower than the XLA scan (tests force it via OPENSIM_FASTPATH=interpret)
-    import os
-
-    if os.environ.get("OPENSIM_DISABLE_FASTPATH"):
+    if envknobs.raw("OPENSIM_DISABLE_FASTPATH"):
         return "disabled by --backend xla (OPENSIM_DISABLE_FASTPATH)"
-    if os.environ.get("OPENSIM_NATIVE") == "1":
+    if envknobs.raw("OPENSIM_NATIVE") == "1":
         return "disabled by --backend native (OPENSIM_NATIVE=1)"
-    if jax.default_backend() != "tpu" and os.environ.get("OPENSIM_FASTPATH") != "interpret":
+    if jax.default_backend() != "tpu" and envknobs.raw("OPENSIM_FASTPATH") != "interpret":
         return f"no TPU backend (jax.default_backend()={jax.default_backend()!r})"
     # VMEM budget. The pallas_call signature is generated per feature-flag
     # combination (_input_layout): a feature that is off contributes ZERO

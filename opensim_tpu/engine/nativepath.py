@@ -19,13 +19,13 @@ callables) force the XLA path.
 from __future__ import annotations
 
 import functools
-import os
 
 import numpy as np
 
 from ..encoding import vocab as V
 from ..encoding.state import ScanState
 from ..ops import kernels
+from ..utils import envknobs
 from .schedconfig import DEFAULT_CONFIG
 
 
@@ -56,11 +56,11 @@ def why_not(prep, config=None, extra_plugins: tuple = (), tie_seed=None):
         # NodeResourcesFitArgs ignored columns are an XLA-scan feature; the
         # C++ fit loop has no per-column skip (rare config — not worth ABI)
         return "NodeResourcesFitArgs ignoredResources need the XLA scan's per-column skip"
-    if os.environ.get("OPENSIM_DISABLE_NATIVE"):
+    if envknobs.raw("OPENSIM_DISABLE_NATIVE"):
         return "disabled by --backend xla (OPENSIM_DISABLE_NATIVE)"
     from .. import native
 
-    if os.environ.get("OPENSIM_NATIVE") == "1":
+    if envknobs.raw("OPENSIM_NATIVE") == "1":
         if not native.available():
             _warn_native_unavailable()
             return f"engine not built: {native.load_error() or 'unknown'}"

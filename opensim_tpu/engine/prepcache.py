@@ -218,6 +218,26 @@ class CacheStats:
         }
 
 
+# drop-mask compaction telemetry (ISSUE 12, obs/footprint.py): how many
+# times twin_pod_delta REFUSED a delta because the accumulated masked-row
+# density crossed the threshold, forcing the caller's full rebuild — the
+# event that re-compacts the stream. A process-global counter because the
+# refusal site has no cache handle (the caller owns the rebuild).
+_compaction_lock = threading.Lock()
+_compactions = 0  # guarded-by: _compaction_lock
+
+
+def note_compaction() -> None:
+    global _compactions
+    with _compaction_lock:
+        _compactions += 1
+
+
+def compactions_total() -> int:
+    with _compaction_lock:
+        return _compactions
+
+
 class CacheEntry:
     """One cached ``Prepared`` plus everything reuse needs: a pristine
     bind-state snapshot, a lock serializing uses of the (shared) pod
@@ -387,6 +407,13 @@ class PrepareCache:
                 self.invalidate(e.obj)
             self.invalidate(entry.key)
             raise
+
+    def entries_snapshot(self) -> List[CacheEntry]:
+        """Point-in-time list of resident entries, LRU-oldest first — the
+        memory observatory's walk (obs/footprint.py). The list is a copy;
+        per-entry reads still take each entry's own lock."""
+        with self._lock:
+            return list(self._entries.values())
 
     def __len__(self) -> int:
         with self._lock:
@@ -732,6 +759,7 @@ def twin_pod_delta(
     # cluster — amortized O(cluster / threshold) per churned pod.
     n_dropped = int(drop.sum())
     if n_dropped > max(64, len(drop) // 4):
+        note_compaction()
         return None
     entry = CacheEntry(key, new_prep, base=base_entry, watch=watch)
     entry.base_drop = drop if n_dropped else None

@@ -28,7 +28,6 @@ another's report.
 from __future__ import annotations
 
 import functools
-import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Union
 
@@ -38,6 +37,7 @@ import numpy as np
 from ..models.objects import ResourceTypes
 from ..obs import trace as obs
 from ..resilience.deadline import Deadline, DeadlineExceeded
+from ..utils import envknobs
 from .scheduler import ScheduleOutput, pad_pod_stream, scan_unroll, schedule_pods
 from .simulator import (
     AppResource,
@@ -83,7 +83,7 @@ def batch_engine_mode() -> str:
     falling back to sequential C++ scans when the stream cannot take the
     XLA path; ``xla`` / ``native`` force a rung (native still requires the
     C++ engine to be applicable)."""
-    raw = os.environ.get("OPENSIM_BATCH_ENGINE", "auto").strip().lower() or "auto"
+    raw = envknobs.raw("OPENSIM_BATCH_ENGINE", "auto").strip().lower() or "auto"
     if raw not in ("auto", "xla", "native"):
         raise ValueError(
             f"OPENSIM_BATCH_ENGINE must be auto|xla|native, got {raw!r}"
@@ -179,7 +179,7 @@ def run_request_batch(
         mode == "auto"
         and native_miss is None
         and (
-            os.environ.get("OPENSIM_NATIVE") == "1"
+            envknobs.raw("OPENSIM_NATIVE") == "1"
             or (len(jax.devices()) == 1 and jax.default_backend() != "tpu")
         )
     )

@@ -20,13 +20,13 @@ megakernel and C++ engines stay lowest-index).
 from __future__ import annotations
 
 import functools
-import os
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
 from ..encoding.state import EncodedCluster, ScanState
+from ..utils import envknobs
 from ..ops import kernels
 
 
@@ -35,7 +35,7 @@ def scan_unroll() -> int:
     per-iteration dispatch; neutral-to-negative on CPU). Positive integer,
     default 1. Resolved OUTSIDE jit by every scan entry point so the value
     participates in the jit cache key."""
-    raw = os.environ.get("OPENSIM_SCAN_UNROLL", "1")
+    raw = envknobs.raw("OPENSIM_SCAN_UNROLL", "1")
     try:
         val = int(raw)
     except ValueError:
@@ -113,7 +113,7 @@ def _step(ec: EncodedCluster, stat, feat, cfg, extra, st: ScanState, x, select_k
     jax.jit,
     static_argnames=("features", "config", "extra_plugins", "unroll", "tie_seed", "explain"),
 )
-def schedule_pods(
+def _schedule_pods_jit(
     ec: EncodedCluster,
     st0: ScanState,
     tmpl_ids,
@@ -168,6 +168,43 @@ def schedule_pods(
         gpu_take=gpu_take,
         static_fail=stat.static_fail,
         final_state=final_state,
+    )
+
+
+def schedule_pods(
+    ec: EncodedCluster,
+    st0: ScanState,
+    tmpl_ids,
+    pod_valid,
+    forced,
+    features: kernels.Features = kernels.ALL_FEATURES,
+    config=None,
+    extra_plugins: tuple = (),
+    unroll: int = 1,
+    tie_seed=None,
+    explain: bool = False,
+):
+    """:func:`_schedule_pods_jit` through the compile watch (ISSUE 12,
+    obs/profile.py): every host-side call records its abstract signature,
+    and a jit-cache miss records compile seconds with recompile-cause
+    attribution (shape vs dtype vs static-flag change). Calls arriving
+    UNDER tracing (the vmapped sweeps invoke this inside their own jit)
+    pass straight through — the outer sweep boundary is instrumented
+    instead."""
+    from ..obs.profile import observed_jit_call
+
+    return observed_jit_call(
+        "schedule_pods",
+        _schedule_pods_jit,
+        args=(ec, st0, tmpl_ids, pod_valid, forced),
+        static={
+            "features": features,
+            "config": config,
+            "extra_plugins": extra_plugins,
+            "unroll": unroll,
+            "tie_seed": tie_seed,
+            "explain": explain,
+        },
     )
 
 

@@ -36,6 +36,7 @@ from ..models.objects import (
     ResourceTypes,
 )
 from ..ops import kernels
+from ..utils import envknobs
 from ..resilience import breaker as breakers
 from ..resilience import faults
 from ..resilience.deadline import Deadline, check_deadline, deadline_scope
@@ -561,15 +562,13 @@ def _run_engine_ladder(
     ``simulate`` so the whole ladder sits under one traced ``schedule``
     span with a child span per engine actually *attempted* (ISSUE 5) — a
     skipped rung gets a demotion event, not a span."""
-    import os as _os
-
     from ..obs import trace as obs
 
     out = None
     engine_name = "xla"
     skips: Dict[str, str] = {}
-    require_tpu = _os.environ.get("OPENSIM_REQUIRE_TPU") == "1"
-    interpret = _os.environ.get("OPENSIM_FASTPATH") == "interpret"
+    require_tpu = envknobs.raw("OPENSIM_REQUIRE_TPU") == "1"
+    interpret = envknobs.raw("OPENSIM_FASTPATH") == "interpret"
     sf_rows = tmpl_ids  # decode: static_fail row per pod
     if segments is not None:
         skips["megakernel"] = (

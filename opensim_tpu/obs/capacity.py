@@ -48,7 +48,6 @@ import heapq
 import io
 import logging
 import math
-import os
 import re
 import threading
 from dataclasses import dataclass
@@ -59,6 +58,7 @@ if TYPE_CHECKING:
 
 from ..models.objects import LABEL_APP_NAME, Node, Pod, ResourceTypes
 from ..models.quantity import format_milli, format_quantity, parse_quantity
+from ..utils import envknobs
 from .metrics import UTILIZATION_BUCKETS, escape_label_value, family_header
 from .timeline import Sample, Timeline
 
@@ -94,7 +94,7 @@ def topk_nodes() -> int:
     ``simon_cluster_node_utilization`` — the cardinality governor that
     keeps a 100k-node twin from emitting 300k series per scrape. A typo
     degrades to the default with a warning."""
-    raw = os.environ.get("OPENSIM_CAPACITY_TOPK", "")
+    raw = envknobs.raw("OPENSIM_CAPACITY_TOPK")
     try:
         return max(0, int(raw)) if raw else 10
     except ValueError:
@@ -126,7 +126,7 @@ def headroom_profiles() -> List[WorkloadProfile]:
     """Parse ``OPENSIM_HEADROOM_PROFILES`` (``name=cpu:mem[:max],...``).
     Validated loudly like ``watch_policy`` — a silently-dropped typo would
     report headroom for profiles the operator never asked about."""
-    raw = os.environ.get("OPENSIM_HEADROOM_PROFILES", "").strip() or DEFAULT_PROFILES
+    raw = envknobs.raw("OPENSIM_HEADROOM_PROFILES").strip() or DEFAULT_PROFILES
     out: List[WorkloadProfile] = []
     for entry in raw.split(","):
         entry = entry.strip()
@@ -865,6 +865,13 @@ def format_top(report: dict) -> str:
                 ]
             )
         _table(rows, out)
+    memory = report.get("memory") or {}
+    if memory.get("rows"):
+        # the memory block (ISSUE 12, ?mem=1 / simon top --mem): rendered
+        # from the SAME rows the JSON carries (obs/footprint.memory_rows) —
+        # the byte-equal parity contract every report table follows
+        print("", file=out)
+        _table(memory["rows"], out)
     pending = report.get("pending") or []
     if pending:
         print("", file=out)

@@ -139,6 +139,42 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
     "simon_journal_dropped_total": ("Records dropped at the bounded writer queue", "counter"),
     "simon_journal_fsync_seconds": ("Journal fsync latency", "histogram"),
     "simon_journal_recoveries_total": ("Journal recovery attempts by outcome", "counter"),
+    # memory observatory (obs/footprint.py, ISSUE 12) — cardinality
+    # contract: dtype ∈ the encoder policy set (encoding/dtypes.py) plus
+    # "other"; ring ∈ {flight_recorder, capacity_timeline, journal_queue};
+    # device series are one per local accelerator, kind ∈ {in_use, peak}
+    "simon_mem_rss_bytes": ("Process resident set size", "gauge"),
+    "simon_mem_rss_peak_bytes": ("Process RSS high watermark (VmHWM)", "gauge"),
+    "simon_mem_device_bytes": ("Per-device accelerator memory by kind (in_use/peak)", "gauge"),
+    "simon_mem_prepcache_bytes": ("Prep-cache host arena bytes (shared leaves counted once)", "gauge"),
+    "simon_mem_prepcache_entries": ("Prep-cache entries resident", "gauge"),
+    "simon_mem_prepcache_evictions_total": ("Prep-cache LRU evictions", "counter"),
+    "simon_mem_prepcache_compactions_total": (
+        "Twin-delta refusals at the drop-mask density threshold (full rebuild follows)", "counter",
+    ),
+    "simon_mem_arena_bytes": ("Prep-cache host arena bytes by encoder-policy dtype", "gauge"),
+    "simon_mem_ring_entries": ("Bounded-ring occupancy by ring", "gauge"),
+    "simon_mem_ring_capacity": ("Bounded-ring capacity by ring", "gauge"),
+    # compile telemetry (obs/profile.py, ISSUE 12) — fn is a fixed set of
+    # instrumented jit boundaries; cause ∈ {first, shape, dtype, static,
+    # new}; event is the jax compilation-cache event leaf name
+    "simon_compile_total": ("JIT compiles observed at instrumented boundaries", "counter"),
+    "simon_compile_seconds_total": ("Wall seconds inside observed JIT compiles", "counter"),
+    "simon_compile_cause_total": ("Recompiles by attributed cause (shape/dtype/static/new)", "counter"),
+    "simon_backend_compile_seconds_total": (
+        "Backend (XLA) compile seconds from jax monitoring, all call sites", "counter",
+    ),
+    "simon_backend_compile_total": ("Backend (XLA) compiles from jax monitoring", "counter"),
+    "simon_jitcache_persistent_files": ("Entries in the persistent XLA compile cache dir", "gauge"),
+    "simon_jitcache_persistent_bytes": ("Bytes in the persistent XLA compile cache dir", "gauge"),
+    "simon_jitcache_events_total": ("jax compilation-cache monitoring events by leaf name", "counter"),
+    # aggregate phase profiles (obs/profile.py) — span names are the fixed
+    # instrumentation vocabulary (phases, engine rungs, native sub-phases)
+    "simon_phase_profile_calls_total": ("Spans folded into the cumulative profile, by span name", "counter"),
+    "simon_phase_profile_seconds_total": ("Cumulative inclusive span seconds by span name", "counter"),
+    "simon_phase_profile_exclusive_seconds_total": (
+        "Cumulative exclusive span seconds (children subtracted) by span name", "counter",
+    ),
 }
 
 

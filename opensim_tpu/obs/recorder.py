@@ -15,10 +15,11 @@ after ``finish()``, so everything the endpoints read is immutable.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 from collections import deque
 from typing import Dict, List, Optional
+
+from ..utils import envknobs
 
 __all__ = ["FlightRecorder", "FLIGHT_RECORDER"]
 
@@ -27,7 +28,7 @@ def _default_capacity() -> int:
     # the module-level singleton is constructed at import time, and obs is
     # imported from simulate()'s hot path: a typo'd debug knob must degrade
     # to the default with a warning, never take down CLI/library use
-    raw = os.environ.get("OPENSIM_FLIGHT_RECORDER_N", "")
+    raw = envknobs.raw("OPENSIM_FLIGHT_RECORDER_N")
     try:
         return max(1, int(raw)) if raw else 64
     except ValueError:
@@ -50,6 +51,12 @@ class FlightRecorder:
     def record(self, trace) -> None:
         if not trace.finished:
             raise ValueError("only finished traces are recordable (call finish() first)")
+        # the cumulative phase profiles (ISSUE 12, obs/profile.py) fold in
+        # every recorded trace — ONE sink for the ring and the aggregates,
+        # outside this ring's lock (PROFILE locks itself)
+        from .profile import PROFILE
+
+        PROFILE.observe_trace(trace)
         with self._lock:
             self._ring.append(trace)
             self._by_id[trace.request_id] = trace

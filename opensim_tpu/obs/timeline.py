@@ -18,11 +18,12 @@ from __future__ import annotations
 
 import collections
 import logging
-import os
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
+
+from ..utils import envknobs
 
 log = logging.getLogger("opensim_tpu.obs")
 
@@ -33,7 +34,7 @@ def timeline_capacity() -> int:
     """``OPENSIM_CAPACITY_TIMELINE_N`` (default 512). A typo degrades to
     the default with a warning — same contract as
     ``OPENSIM_FLIGHT_RECORDER_N``, never a startup crash."""
-    raw = os.environ.get("OPENSIM_CAPACITY_TIMELINE_N", "")
+    raw = envknobs.raw("OPENSIM_CAPACITY_TIMELINE_N")
     try:
         return max(1, int(raw)) if raw else 512
     except ValueError:

@@ -30,11 +30,12 @@ Exporters: :meth:`TraceContext.to_chrome` (Chrome-trace / Perfetto JSON for
 from __future__ import annotations
 
 import contextvars
-import os
 import re
 import time
 import uuid
 from typing import Any, Dict, Iterator, List, Optional
+
+from ..utils import envknobs
 
 __all__ = [
     "PHASES",
@@ -294,7 +295,7 @@ _REQUEST_ID_OK = re.compile(r"[^A-Za-z0-9._:\-]")
 def enabled() -> bool:
     """Tracing is on unless ``OPENSIM_TRACE=0`` (the dormant mode whose whole
     cost is one contextvar read per instrumentation point)."""
-    return os.environ.get("OPENSIM_TRACE", "1") != "0"
+    return envknobs.raw("OPENSIM_TRACE", "1") != "0"
 
 
 def new_request_id() -> str:

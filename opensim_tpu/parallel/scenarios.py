@@ -21,6 +21,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..encoding.state import EncodedCluster, ScanState
+from ..utils import envknobs
 from ..engine.scheduler import scan_unroll, schedule_pods
 
 
@@ -136,14 +137,12 @@ def sweep_auto(
             unscheduled=jnp.asarray(unscheduled), used=jnp.asarray(used),
             chosen=jnp.asarray(chosen), vg_used=jnp.asarray(vg_used),
         )
-    import os as _os
-
     if (
         len(jax.devices()) == 1
         and config is None
         and (
             jax.default_backend() == "tpu"
-            or _os.environ.get("OPENSIM_FASTPATH") == "interpret"
+            or envknobs.raw("OPENSIM_FASTPATH") == "interpret"
         )
     ):
         from ..engine import fastpath
@@ -163,9 +162,9 @@ def sweep_auto(
                 # unless --backend tpu explicitly demanded the TPU engine
                 import logging
 
-                if _os.environ.get("OPENSIM_FASTPATH") == "interpret":
+                if envknobs.raw("OPENSIM_FASTPATH") == "interpret":
                     raise  # test/CI mode: fail loudly, don't validate the fallback
-                if _os.environ.get("OPENSIM_REQUIRE_TPU") == "1":
+                if envknobs.raw("OPENSIM_REQUIRE_TPU") == "1":
                     raise RuntimeError(
                         "--backend tpu: the batched megakernel sweep failed "
                         f"({type(e).__name__}: {e}); refusing to silently "
@@ -282,10 +281,16 @@ def sweep_segmented(
     for cfg, lo, hi in segments:
         seg = np.zeros((S, P), dtype=bool)
         seg[:, lo:hi] = np.asarray(pod_valid_masks, bool)[:, lo:hi]
-        seg_chosen, st_batch = _sweep_segment_impl(
-            prep.ec, st_batch, jnp.asarray(prep.tmpl_ids), nv_dev,
-            jnp.asarray(seg), fm_dev,
-            features=prep.features, config=cfg, unroll=scan_unroll(),
+        from ..obs.profile import observed_jit_call
+
+        seg_chosen, st_batch = observed_jit_call(
+            "sweep_segment",
+            _sweep_segment_impl,
+            args=(
+                prep.ec, st_batch, jnp.asarray(prep.tmpl_ids), nv_dev,
+                jnp.asarray(seg), fm_dev,
+            ),
+            static={"features": prep.features, "config": cfg, "unroll": scan_unroll()},
         )
         chosen[:, lo:hi] = np.asarray(seg_chosen)[:, lo:hi]
         final = st_batch
@@ -369,14 +374,13 @@ def sweep(
             )
         out = jax.tree_util.tree_map(lambda a: a[:S], out)
     else:
-        out = _sweep_impl(
-            ec,
-            st0,
-            jnp.asarray(tmpl_ids),
-            *(jnp.asarray(a) for a in arrays),
-            features=features,
-            config=config,
-            unroll=scan_unroll(),
+        from ..obs.profile import observed_jit_call
+
+        out = observed_jit_call(
+            "sweep",
+            _sweep_impl,
+            args=(ec, st0, jnp.asarray(tmpl_ids), *(jnp.asarray(a) for a in arrays)),
+            static={"features": features, "config": config, "unroll": scan_unroll()},
         )
     return SweepResult(*out)
 

@@ -211,7 +211,7 @@ class Applier:
         new_cluster.nodes = list(cluster.nodes) + expand.new_fake_nodes(template, count)
         return new_cluster
 
-    def find_min_nodes_batched(self, prep, n_real: int, fallback_ctx=None) -> Optional[int]:
+    def find_min_nodes_batched(self, prep, n_real: int) -> Optional[int]:
         """Evaluate candidate new-node counts 0..max as one sharded scenario
         sweep over an existing Prepared (the cluster plus `max_new_nodes`
         candidates); return the minimal feasible count (caps included), or
@@ -227,7 +227,7 @@ class Applier:
         # caps can make it non-monotone — so a coarse pass with no feasible
         # point falls back to sweeping every unprobed count.
         coarse = sorted({0, kmax} | {2**i for i in range(kmax.bit_length()) if 2**i <= kmax})
-        ok = self._feasible_counts(prep, n_real, coarse, fallback_ctx)
+        ok = self._feasible_counts(prep, n_real, coarse)
         feasible_ks = [k for k, good in zip(coarse, ok) if good]
         if not feasible_ks:
             # non-monotone corner (DaemonSet load × occupancy caps): probe the
@@ -240,7 +240,7 @@ class Applier:
             chunk = 32
             for lo in range(0, len(rest), chunk):
                 batch = rest[lo : lo + chunk]
-                ok = self._feasible_counts(prep, n_real, batch, fallback_ctx)
+                ok = self._feasible_counts(prep, n_real, batch)
                 feasible_rest = [k for k, good in zip(batch, ok) if good]
                 if feasible_rest:
                     return min(feasible_rest)
@@ -250,32 +250,23 @@ class Applier:
         if hi == 0 or hi == lo + 1:
             return int(hi)
         fine = list(range(lo + 1, hi))
-        ok = self._feasible_counts(prep, n_real, fine, fallback_ctx)
+        ok = self._feasible_counts(prep, n_real, fine)
         for k, good in zip(fine, ok):
             if good:
                 return int(k)
         return int(hi)
 
-    def _feasible_counts(
-        self, prep, n_real: int, ks: List[int], fallback_ctx=None
-    ) -> List[bool]:
+    def _feasible_counts(self, prep, n_real: int, ks: List[int]) -> List[bool]:
         """One sharded sweep over candidate new-node counts; a count is
-        feasible when everything schedules within the env caps."""
-        try:
-            res, node_valid = scenarios.sweep_counts(
-                prep, n_real, ks, config=self.sched_config
-            )
-        except ValueError as e:
-            if "differing plugin configurations" not in str(e):
-                raise
-            # differing scheduler profiles: the batched sweep runs ONE
-            # compiled pipeline, but the segmented masked simulate handles
-            # per-profile streams — probe each candidate count sequentially
-            # (the reference's interactive loop does exactly this,
-            # apply.go:203-259)
-            if fallback_ctx is None:
-                raise
-            return self._feasible_counts_sequential(prep, n_real, ks, fallback_ctx)
+        feasible when everything schedules within the env caps. DIFFERING
+        scheduler profiles no longer need a sequential per-count fallback:
+        ``sweep_auto`` routes mixed-profile streams through
+        ``sweep_segmented`` (per-segment scans sharing each scenario's
+        carry, ISSUE 8) — the NOTES.md round-5 rough edge is closed, gated
+        against the segmented simulate in tests/test_planner.py."""
+        res, node_valid = scenarios.sweep_counts(
+            prep, n_real, ks, config=self.sched_config
+        )
         S = len(ks)
         unscheduled = np.asarray(res.unscheduled)
         used = np.asarray(res.used)  # [S, N, R]
@@ -299,37 +290,6 @@ class Applier:
             tot_vg = float(vg_caps[nv].sum())
             vg_occ = int(vg_used[s] / tot_vg * 100) if tot_vg else 0
             out.append(cpu_occ <= max_cpu and mem_occ <= max_mem and vg_occ <= max_vg)
-        return out
-
-    def _feasible_counts_sequential(
-        self, prep, n_real: int, ks: List[int], fallback_ctx
-    ) -> List[bool]:
-        """Differing-profile fallback: one segmented masked simulate per
-        candidate count, sharing the single Prepared. A count is feasible
-        when nothing is unschedulable (unknown-profile pods excepted — the
-        batched sweep masks those out of every scenario too) and the env
-        caps hold."""
-        from ..engine.simulator import restore_bind_state, snapshot_bind_state
-
-        full, apps = fallback_ctx
-        N = np.asarray(prep.ec_np.node_valid).shape[0]
-        snap = snapshot_bind_state(prep)
-        out = []
-        for k in ks:
-            sub = copy.copy(full)
-            sub.nodes = full.nodes[: n_real + k]
-            mask = np.zeros(N, dtype=bool)
-            mask[: n_real + k] = True
-            result = simulate(
-                sub, apps, use_greed=self.opts.use_greed,
-                sched_config=self.sched_config, prep=prep, node_valid=mask,
-            )
-            real_unscheduled = [
-                u for u in result.unscheduled_pods
-                if "no scheduler profile named" not in u.reason
-            ]
-            out.append(not real_unscheduled and satisfy_resource_setting(result)[0])
-            restore_bind_state(prep, snap)  # decode mutated the shared pods
         return out
 
     # -- run ----------------------------------------------------------------
@@ -406,7 +366,7 @@ class Applier:
                 if prep_full is None:
                     prep_full = prepare(full, apps, use_greed=self.opts.use_greed)
                 n_new = self.find_min_nodes_batched(
-                    prep_full, len(cluster.nodes), fallback_ctx=(full, apps)
+                    prep_full, len(cluster.nodes)
                 )
             if n_new is None:
                 print(

@@ -23,16 +23,17 @@ sleeping.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Callable, Dict, Optional
+
+from ..utils import envknobs
 
 __all__ = ["CircuitBreaker", "engine_breaker", "all_breakers", "reset_breakers"]
 
 
 def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name, "")
+    raw = envknobs.raw(name)
     try:
         return int(raw) if raw else default
     except ValueError:
@@ -40,7 +41,7 @@ def _env_int(name: str, default: int) -> int:
 
 
 def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name, "")
+    raw = envknobs.raw(name)
     try:
         return float(raw) if raw else default
     except ValueError:

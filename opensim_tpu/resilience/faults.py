@@ -57,9 +57,10 @@ invalidate the whole chaos suite.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Callable, Dict, Optional
+
+from ..utils import envknobs
 
 __all__ = [
     "FAULT_POINTS",
@@ -163,7 +164,7 @@ def parse_spec(raw: str) -> Dict[str, _FaultSpec]:
 
 def _sync_env_locked() -> None:
     global _ENV_RAW
-    raw = os.environ.get("OPENSIM_FAULTS", "")
+    raw = envknobs.raw("OPENSIM_FAULTS")
     if raw == _ENV_RAW:
         return
     _ENV_RAW = raw
@@ -185,7 +186,7 @@ def clear_faults() -> None:
     with _LOCK:
         _ACTIVE.clear()
         _FIRED.clear()
-        _ENV_RAW = os.environ.get("OPENSIM_FAULTS", "")
+        _ENV_RAW = envknobs.raw("OPENSIM_FAULTS")
 
 
 def fault_stats() -> Dict[str, int]:
@@ -197,7 +198,7 @@ def fault_stats() -> Dict[str, int]:
 
 def fault_point(name: str) -> None:
     """The per-site hook. Inert (one env read + dict lookup) unless armed."""
-    if _ENV_RAW == "" and not _ACTIVE and not os.environ.get("OPENSIM_FAULTS"):
+    if _ENV_RAW == "" and not _ACTIVE and not envknobs.raw("OPENSIM_FAULTS"):
         return  # fast path: nothing armed, nothing in the environment
     with _LOCK:
         _sync_env_locked()

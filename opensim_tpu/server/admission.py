@@ -38,7 +38,6 @@ from __future__ import annotations
 
 import collections
 import logging
-import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -52,6 +51,7 @@ from ..obs.metrics import (
     make_histogram,
 )
 from ..resilience.deadline import Deadline, DeadlineExceeded
+from ..utils import envknobs
 
 log = logging.getLogger("opensim_tpu.server")
 
@@ -66,7 +66,7 @@ __all__ = [
 ]
 
 def _env_float(name: str, default: float, lo: float = 0.0) -> float:
-    raw = os.environ.get(name, "")
+    raw = envknobs.raw(name)
     if not raw:
         return default
     try:
@@ -79,7 +79,7 @@ def _env_float(name: str, default: float, lo: float = 0.0) -> float:
 def admission_enabled() -> bool:
     """``OPENSIM_ADMISSION``: ``on`` (default) routes requests through the
     admission queue; ``off`` restores the single-flight TryLock path."""
-    return os.environ.get("OPENSIM_ADMISSION", "on").strip().lower() not in (
+    return envknobs.raw("OPENSIM_ADMISSION", "on").strip().lower() not in (
         "off", "0", "false",
     )
 

@@ -74,6 +74,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from ..obs.metrics import RECORDER, family_header, make_counter, make_histogram
 from ..resilience import faults
+from ..utils import envknobs
 
 log = logging.getLogger("opensim_tpu.server.journal")
 
@@ -118,7 +119,7 @@ def journal_policy() -> dict:
     - ``OPENSIM_JOURNAL_QUEUE`` (default 65536): writer queue bound — past
       it records are dropped (counted) and the next checkpoint re-anchors.
     """
-    fsync = os.environ.get("OPENSIM_JOURNAL_FSYNC", "interval").strip().lower()
+    fsync = envknobs.raw("OPENSIM_JOURNAL_FSYNC", "interval").strip().lower()
     if fsync not in ("always", "interval", "off"):
         raise ValueError(
             "OPENSIM_JOURNAL_FSYNC must be always|interval|off, got "
@@ -132,7 +133,7 @@ def journal_policy() -> dict:
         ("keep", "OPENSIM_JOURNAL_KEEP", 2, int),
         ("queue", "OPENSIM_JOURNAL_QUEUE", 65536, int),
     ):
-        raw = os.environ.get(env, str(default))
+        raw = envknobs.raw(env, str(default))
         try:
             out[key] = cast(raw)
         except ValueError:
@@ -731,6 +732,12 @@ class Journal:
             state.stores = _twin_stores_raw(twin)
             state.records_replayed = len(suffix)
         return state
+
+    def queue_occupancy(self) -> Tuple[int, int]:
+        """``(depth, bound)`` of the bounded writer queue — the memory
+        observatory's ring-occupancy view (obs/footprint.py)."""
+        with self._cond:
+            return len(self._queue), int(self.policy["queue"])
 
     # -- /metrics ------------------------------------------------------------
 

@@ -33,6 +33,8 @@ import multiprocessing
 import os
 from typing import Callable, Optional
 
+from ..utils import envknobs
+
 log = logging.getLogger("opensim_tpu.server")
 
 __all__ = ["WorkerPool", "worker_count", "worker_mode"]
@@ -44,7 +46,7 @@ def worker_count() -> int:
     engine runs without oversubscribing the box the engines compute on. A
     typo degrades to the default with a warning (the env-knob contract
     every server knob follows), never a startup crash."""
-    raw = os.environ.get("OPENSIM_WORKERS", "")
+    raw = envknobs.raw("OPENSIM_WORKERS")
     if raw:
         try:
             return max(1, int(raw))
@@ -54,7 +56,7 @@ def worker_count() -> int:
 
 
 def worker_mode() -> str:
-    raw = os.environ.get("OPENSIM_WORKERS_MODE", "auto").strip().lower() or "auto"
+    raw = envknobs.raw("OPENSIM_WORKERS_MODE", "auto").strip().lower() or "auto"
     if raw not in ("auto", "thread", "process"):
         log.warning("ignoring unknown OPENSIM_WORKERS_MODE=%r (using auto)", raw)
         return "auto"

@@ -18,7 +18,6 @@ from __future__ import annotations
 import hashlib
 import json
 import logging
-import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import TYPE_CHECKING, List, Optional
@@ -35,6 +34,7 @@ from ..resilience import breaker as breaker_mod
 from ..resilience import faults
 from ..resilience.deadline import Deadline, DeadlineExceeded, check_deadline, deadline_scope
 from ..resilience.retry import retry_call
+from ..utils import envknobs
 from .snapshot import (
     SnapshotFetchError,
     SnapshotUnavailable,
@@ -129,7 +129,7 @@ class _Metrics:
             setattr(self, counter, getattr(self, counter) + n)
 
     def render(self, prep_cache=None, watch=None, admission=None, capacity=None,
-               journal=None) -> str:
+               journal=None, memory=None) -> str:
         from ..utils.trace import PREP_STATS
 
         esc = escape_label_value
@@ -222,6 +222,16 @@ class _Metrics:
         # written, writer-queue drops, fsync latency, recovery outcomes
         if journal is not None:
             lines += journal.metrics_lines()
+        # memory observatory (ISSUE 12, obs/footprint.py): RSS/device
+        # watermarks, prep-cache arena bytes, ring occupancy
+        if memory is not None:
+            lines += memory.metrics_lines()
+        # compile telemetry + cumulative phase profiles (ISSUE 12,
+        # obs/profile.py) — process singletons, rendered on every scrape
+        from ..obs.profile import COMPILES, PROFILE
+
+        lines += COMPILES.metrics_lines()
+        lines += PROFILE.metrics_lines()
         # per-phase / per-endpoint latency histograms, computed from the
         # same spans the flight recorder serves (obs/metrics.py)
         lines += RECORDER.render_lines()
@@ -307,7 +317,7 @@ def _response(result: SimulateResult, explain: bool = False) -> dict:
 # typo'd knob degrades to the default with a warning (same contract as
 # OPENSIM_FLIGHT_RECORDER_N), never a startup crash.
 def _explain_store_n() -> int:
-    raw = os.environ.get("OPENSIM_EXPLAIN_STORE_N", "")
+    raw = envknobs.raw("OPENSIM_EXPLAIN_STORE_N")
     try:
         return max(1, int(raw)) if raw else 512
     except ValueError:
@@ -389,7 +399,7 @@ class SimonServer:
         # cluster is cached across requests keyed by content fingerprint, so
         # a request pays O(its own app) host work, not O(cluster). Opt out
         # with OPENSIM_PREP_CACHE=0 (restores per-request full prepare).
-        if prep_cache is None and os.environ.get("OPENSIM_PREP_CACHE", "1") != "0":
+        if prep_cache is None and envknobs.raw("OPENSIM_PREP_CACHE", "1") != "0":
             from ..engine.prepcache import PrepareCache
 
             prep_cache = PrepareCache()
@@ -433,6 +443,17 @@ class SimonServer:
         if journal is not None and self.watch is not None:
             self.watch.attach_journal(journal)
         self._headroom_key: Optional[str] = None
+        # memory observatory (ISSUE 12, obs/footprint.py): arena/cache
+        # footprint accounting + RSS/device watermarks over the structures
+        # THIS server owns. Always on — every view is computed on demand;
+        # only serve() starts the low-rate watermark ticker.
+        from ..obs.footprint import MemoryObservatory
+
+        self.memory = MemoryObservatory(
+            prep_cache=self.prep_cache,
+            timeline=self.capacity.timeline if self.capacity is not None else None,
+            journal=journal,
+        )
 
     def close(self) -> None:
         """Graceful teardown (docs/serving.md "Shutting down"): stop the
@@ -444,6 +465,7 @@ class SimonServer:
             self.admission.stop()
         if self.journal is not None:
             self.journal.close()
+        self.memory.stop()
 
     def _twin_snapshot(self) -> Optional[tuple]:
         """(cluster, cache key) from the synced live twin, or None when the
@@ -652,12 +674,16 @@ class SimonServer:
             return out
 
     def cluster_report(
-        self, extended: Optional[List[str]] = None, probe_headroom: bool = True
+        self, extended: Optional[List[str]] = None, probe_headroom: bool = True,
+        include_memory: bool = False,
     ) -> dict:
         """The ``GET /api/cluster/report`` body: the capacity sample plus
         the same table rows the text renderer prints
         (``obs/capacity.build_report`` — one computation path, gated by the
-        report-parity test)."""
+        report-parity test). ``include_memory`` (``?mem=1``) adds the
+        memory observatory block — summary plus the SAME rows ``simon top
+        --mem`` renders (``obs/footprint.memory_rows``, byte-equal parity
+        like every other report table)."""
         from ..obs import capacity as capacity_mod
 
         if self.capacity is None:
@@ -667,9 +693,15 @@ class SimonServer:
         if probe_headroom:
             self._probe_headroom(cluster, key)
         state = self.watch.state() if self.watch is not None else "polling"
-        return capacity_mod.build_report(
+        report = capacity_mod.build_report(
             self.capacity, cluster, extended_resources=extended, state=state
         )
+        if include_memory:
+            from ..obs.footprint import memory_rows
+
+            summary = self.memory.summary()
+            report["memory"] = {"summary": summary, "rows": memory_rows(summary)}
+        return report
 
     # -- handlers -----------------------------------------------------------
 
@@ -1223,7 +1255,7 @@ def request_deadline(headers) -> Optional[Deadline]:
     keep today's unbounded behavior unless they or the operator opt in)."""
     raw = headers.get("X-Simon-Timeout-S") if headers is not None else None
     if raw is None:
-        raw = os.environ.get("OPENSIM_REQUEST_TIMEOUT_S", "")
+        raw = envknobs.raw("OPENSIM_REQUEST_TIMEOUT_S")
     if not raw:
         return None
     try:
@@ -1269,7 +1301,7 @@ def make_handler(server: SimonServer):
             one JSON object per request on the ``opensim_tpu.access``
             logger — request id, endpoint, status, duration — keeping the
             quiet-by-default behavior when unset (ISSUE 5 satellite)."""
-            if os.environ.get("OPENSIM_ACCESS_LOG") != "1":
+            if envknobs.raw("OPENSIM_ACCESS_LOG") != "1":
                 return
             import time
 
@@ -1314,7 +1346,7 @@ def make_handler(server: SimonServer):
                 data = METRICS.render(
                     prep_cache=server.prep_cache, watch=server.watch,
                     admission=server.admission, capacity=server.capacity,
-                    journal=server.journal,
+                    journal=server.journal, memory=server.memory,
                 ).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -1334,11 +1366,13 @@ def make_handler(server: SimonServer):
                     e for e in q.get("extended", [""])[-1].split(",") if e
                 ]
                 probe = q.get("headroom", ["1"])[-1] not in ("0", "false")
+                mem = q.get("mem", ["0"])[-1] not in ("", "0", "false")
                 try:
                     self._send(
                         200,
                         server.cluster_report(
-                            extended=extended, probe_headroom=probe
+                            extended=extended, probe_headroom=probe,
+                            include_memory=mem,
                         ),
                     )
                 except SnapshotUnavailable as e:
@@ -1365,6 +1399,29 @@ def make_handler(server: SimonServer):
                             ],
                         },
                     )
+            elif self.path.split("?", 1)[0] == "/api/debug/memory":
+                # memory observatory (ISSUE 12, docs/observability.md
+                # "Memory & profiles"): per-entry arena byte attribution,
+                # ring occupancy, RSS/device watermarks. ?fields=0 drops
+                # the per-field breakdown for cheap polling.
+                from urllib.parse import parse_qs as _parse_qs
+
+                q = _parse_qs(self.path.partition("?")[2])
+                fields = q.get("fields", ["1"])[-1] not in ("0", "false")
+                try:
+                    self._send(200, server.memory.debug_payload(include_fields=fields))
+                except Exception as e:
+                    log.warning("memory debug failed: %s: %s", type(e).__name__, e)
+                    self._send(500, {"error": str(e), "type": type(e).__name__})
+            elif self.path.split("?", 1)[0] == "/api/debug/profile":
+                # compile telemetry + cumulative phase profiles (ISSUE 12)
+                from ..obs import profile as profile_mod
+
+                try:
+                    self._send(200, profile_mod.debug_payload())
+                except Exception as e:
+                    log.warning("profile debug failed: %s: %s", type(e).__name__, e)
+                    self._send(500, {"error": str(e), "type": type(e).__name__})
             elif self.path == "/api/debug/requests":
                 # flight recorder (docs/observability.md): newest-first
                 # summaries of the last N request traces
@@ -1516,6 +1573,10 @@ def serve(
     server = SimonServer(
         kubeconfig=kubeconfig, master=master, watch=supervisor, journal=jrnl
     )
+    # low-rate RSS/device watermark sampler (OPENSIM_MEM_TICKER_S): only
+    # the long-lived server process runs it — library/test constructions
+    # of SimonServer sample on demand instead
+    server.memory.start_ticker()
     if supervisor is not None:
         supervisor.prep_cache = server.prep_cache
         if watch == "on":

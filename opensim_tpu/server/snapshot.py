@@ -9,7 +9,6 @@ without the ``kubernetes`` package (it is absent from this base image)."""
 from __future__ import annotations
 
 import json
-import os
 import ssl
 import urllib.error
 import urllib.request
@@ -19,6 +18,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import yaml
 
 from ..models.objects import Node, Pod, RawObject, ResourceTypes, Workload
+from ..utils import envknobs
 
 
 class SnapshotFetchError(RuntimeError):
@@ -39,7 +39,7 @@ def snapshot_timeout_s() -> float:
     (default 60 — the old hardcoded value). Validation matches
     :func:`snapshot_retry_policy`: an unparseable value raises immediately
     instead of silently restoring the default."""
-    raw = os.environ.get("OPENSIM_SNAPSHOT_TIMEOUT_S", "60")
+    raw = envknobs.raw("OPENSIM_SNAPSHOT_TIMEOUT_S", "60")
     try:
         timeout = float(raw)
     except ValueError:
@@ -55,11 +55,11 @@ def snapshot_retry_policy() -> tuple:
     ``OPENSIM_SNAPSHOT_RETRIES`` (default 3 attempts total) and
     ``OPENSIM_SNAPSHOT_BACKOFF_S`` (default 0.1; jittered exponential)."""
     try:
-        attempts = max(1, int(os.environ.get("OPENSIM_SNAPSHOT_RETRIES", "3")))
+        attempts = max(1, int(envknobs.raw("OPENSIM_SNAPSHOT_RETRIES", "3")))
     except ValueError:
         raise ValueError("OPENSIM_SNAPSHOT_RETRIES must be an integer") from None
     try:
-        base = float(os.environ.get("OPENSIM_SNAPSHOT_BACKOFF_S", "0.1"))
+        base = float(envknobs.raw("OPENSIM_SNAPSHOT_BACKOFF_S", "0.1"))
     except ValueError:
         raise ValueError("OPENSIM_SNAPSHOT_BACKOFF_S must be a number") from None
     return attempts, base

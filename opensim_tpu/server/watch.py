@@ -56,7 +56,6 @@ import contextlib
 import itertools
 import json
 import logging
-import os
 import threading
 import time
 import urllib.error
@@ -69,6 +68,7 @@ if TYPE_CHECKING:
 
 from ..models.objects import ResourceTypes
 from ..obs import trace as tracing
+from ..utils import envknobs
 from ..obs.metrics import RECORDER, escape_label_value, family_header
 from ..obs.recorder import FLIGHT_RECORDER
 from ..resilience import faults
@@ -131,7 +131,7 @@ def watch_policy() -> dict:
         ("reconnects", "OPENSIM_WATCH_RECONNECTS", 5, int),
         ("backoff_s", "OPENSIM_WATCH_BACKOFF_S", 0.2, float),
     ):
-        raw = os.environ.get(env, str(default))
+        raw = envknobs.raw(env, str(default))
         try:
             out[key] = cast(raw)
         except ValueError:

@@ -21,11 +21,39 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from . import envknobs
+
 DEFAULT_DIR = os.path.join(
     os.path.expanduser(os.environ.get("XDG_CACHE_HOME", "~/.cache")),
     "opensim-tpu",
     "jit",
 )
+
+#: the directory maybe_enable() actually activated (None = disabled) —
+#: cache_stats() reports it to the compile-telemetry surface (obs/profile)
+_ACTIVE_DIR: Optional[str] = None
+
+
+def cache_stats() -> Optional[dict]:
+    """Footprint of the persistent compilation cache directory, or None
+    when disabled. O(entries) directory scan — called from debug/metrics
+    reads, never the serving hot path."""
+    cache_dir = _ACTIVE_DIR or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return None
+    files = total = 0
+    try:
+        with os.scandir(cache_dir) as it:
+            for entry in it:
+                try:
+                    if entry.is_file():
+                        files += 1
+                        total += entry.stat().st_size
+                except OSError:
+                    continue  # entry raced away mid-scan
+    except OSError:
+        return None
+    return {"dir": cache_dir, "files": files, "bytes": total}
 
 
 def maybe_enable(default: bool = False, path: Optional[str] = None) -> Optional[str]:
@@ -34,7 +62,7 @@ def maybe_enable(default: bool = False, path: Optional[str] = None) -> Optional[
     Returns the cache directory in effect, or None when disabled. `default`
     is the behavior with OPENSIM_JIT_CACHE unset: benches/CLIs that always
     benefited from a warm cache pass True."""
-    raw = os.environ.get("OPENSIM_JIT_CACHE", "")
+    raw = envknobs.raw("OPENSIM_JIT_CACHE")
     if raw == "0":
         return None
     if not raw and not default and not path:
@@ -55,6 +83,8 @@ def maybe_enable(default: bool = False, path: Optional[str] = None) -> Optional[
         )
         return None
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    global _ACTIVE_DIR
+    _ACTIVE_DIR = cache_dir
     try:  # jax may already be imported: set the config knobs directly too
         import jax
 

@@ -18,6 +18,8 @@ import sys
 import tempfile
 import time
 
+from . import envknobs
+
 
 def _default_cache_path() -> str:
     """Per-user verdict cache. A world-shared fixed path would let another
@@ -29,7 +31,7 @@ def _default_cache_path() -> str:
     return os.path.join(base, f"opensim-tpu-probe-{uid}")
 
 
-_PROBE_CACHE = os.environ.get("OPENSIM_PROBE_CACHE") or _default_cache_path()
+_PROBE_CACHE = envknobs.raw("OPENSIM_PROBE_CACHE") or _default_cache_path()
 _PROBE_TTL_S = 600
 
 

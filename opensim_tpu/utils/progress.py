@@ -11,17 +11,18 @@ stderr so piped reports stay clean; ``OPENSIM_NO_PROGRESS=1`` force-disables.
 
 from __future__ import annotations
 
-import os
 import sys
 import threading
 import time
 from typing import Optional, TextIO
 
+from . import envknobs
+
 _FRAMES = "⠋⠙⠹⠸⠼⠴⠦⠧⠇⠏"
 
 
 def enabled_by_default(stream: TextIO) -> bool:
-    if os.environ.get("OPENSIM_NO_PROGRESS"):
+    if envknobs.raw("OPENSIM_NO_PROGRESS"):
         return False
     try:
         return bool(stream.isatty())

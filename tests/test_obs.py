@@ -628,10 +628,11 @@ def test_metrics_exposition_conformance(tmp_path):
     assert journal.flush(timeout=10.0)
     assert journal.recover() is not None
     journal.close()
-    # admission families (ISSUE 8) join the same conformance contract
+    # admission families (ISSUE 8) and the memory observatory (ISSUE 12)
+    # join the same conformance contract
     text = rest.METRICS.render(
         prep_cache=server.prep_cache, admission=server.admission,
-        capacity=server.capacity, journal=journal,
+        capacity=server.capacity, journal=journal, memory=server.memory,
     )
     helped, typed, seen_series = set(), {}, set()
     families_with_samples = set()
@@ -693,6 +694,21 @@ def test_metrics_exposition_conformance(tmp_path):
         "simon_journal_dropped_total",
         "simon_journal_fsync_seconds",
         "simon_journal_recoveries_total",
+        # memory observatory + compile telemetry + phase profiles (ISSUE 12)
+        "simon_mem_rss_bytes",
+        "simon_mem_rss_peak_bytes",
+        "simon_mem_prepcache_bytes",
+        "simon_mem_prepcache_entries",
+        "simon_mem_prepcache_evictions_total",
+        "simon_mem_prepcache_compactions_total",
+        "simon_mem_arena_bytes",
+        "simon_mem_ring_entries",
+        "simon_mem_ring_capacity",
+        "simon_backend_compile_total",
+        "simon_backend_compile_seconds_total",
+        "simon_phase_profile_calls_total",
+        "simon_phase_profile_seconds_total",
+        "simon_phase_profile_exclusive_seconds_total",
     ):
         assert required in families_with_samples, f"{required} missing from /metrics"
 

@@ -323,3 +323,109 @@ spec:
     rc = applier.run()
     assert rc == 1  # EOF selects Exit
     assert "can not be scheduled" in out.getvalue()
+
+
+def test_capacity_sweep_with_differing_profiles_matches_segmented_simulate(tmp_path):
+    """NOTES.md round-5 rough edge, closed (ISSUE 12 satellite): a capacity
+    sweep whose pod stream references DIFFERING scheduler profiles used to
+    raise out of the batched pipeline (the planner kept a sequential
+    per-count fallback). ``sweep_auto`` now routes mixed-profile streams
+    through ``sweep_segmented`` — this gates the planner path against the
+    segmented masked simulate, count for count, placement for placement."""
+    import numpy as np
+    import yaml
+
+    from opensim_tpu.engine.simulator import (
+        prepare,
+        restore_bind_state,
+        snapshot_bind_state,
+    )
+    from opensim_tpu.models import expand
+    from opensim_tpu.parallel import scenarios
+
+    cluster_dir = tmp_path / "cluster"
+    app_dir = tmp_path / "app"
+    newnode_dir = tmp_path / "newnode"
+    for d in (cluster_dir, app_dir, newnode_dir):
+        d.mkdir()
+    (cluster_dir / "node.yaml").write_text(
+        yaml.safe_dump(fx.make_fake_node("n0", "4", "16Gi").raw)
+    )
+    # two deployments on DIFFERING profiles: default-scheduler plus a
+    # score-disabled "lean" profile (contiguous segments in stream order)
+    default_dep = fx.make_fake_deployment("plain", 4, "1", "256Mi")
+    lean_dep = fx.make_fake_deployment("lean", 4, "1", "256Mi")
+    lean_dep.raw["spec"]["template"]["spec"]["schedulerName"] = "lean"
+    (app_dir / "a-plain.yaml").write_text(yaml.safe_dump(default_dep.raw))
+    (app_dir / "b-lean.yaml").write_text(yaml.safe_dump(lean_dep.raw))
+    (newnode_dir / "node.yaml").write_text(
+        yaml.safe_dump(fx.make_fake_node("tmpl", "4", "16Gi").raw)
+    )
+    sched = tmp_path / "sched.yaml"
+    sched.write_text(
+        """kind: KubeSchedulerConfiguration
+profiles:
+  - schedulerName: default-scheduler
+  - schedulerName: lean
+    plugins:
+      score:
+        disabled:
+          - name: "*"
+"""
+    )
+    opts = Options(
+        simon_config=_write_config(tmp_path, cluster_dir, app_dir, newnode_dir),
+        default_scheduler_config=str(sched),
+        max_new_nodes=4,
+    )
+    applier = Applier(opts)
+    cluster = applier.load_cluster()
+    apps = applier.load_apps()
+    template = applier.load_new_node()
+    candidates = expand.new_fake_nodes(template, 4)
+    full = ResourceTypes()
+    full.nodes = list(cluster.nodes) + candidates
+    full.pods = list(cluster.pods)
+    prep = prepare(full, apps)
+    assert prep is not None
+    n_real = len(cluster.nodes)
+    ks = [0, 1, 2, 3, 4]
+
+    # the planner's batched verdicts (would have raised before the fix)
+    ok = applier._feasible_counts(prep, n_real, ks)
+    # 8 one-cpu pods vs one 4-cpu node: infeasible at k=0, feasible with
+    # one 4-cpu candidate enabled
+    assert ok[0] is False or ok[0] == np.False_
+    assert bool(ok[1]) and bool(ok[4])
+
+    # count-for-count oracle: the segmented masked simulate of the SAME
+    # prep (the old sequential fallback, now the gating reference)
+    res, node_valid = scenarios.sweep_counts(
+        prep, n_real, ks, config=applier.sched_config
+    )
+    chosen = np.asarray(res.chosen)
+    N = np.asarray(prep.ec_np.node_valid).shape[0]
+    name_to_idx = {name: i for i, name in enumerate(prep.meta.node_names)}
+    snap = snapshot_bind_state(prep)
+    for s, k in enumerate(ks):
+        sub = ResourceTypes()
+        sub.nodes = full.nodes[: n_real + k]
+        sub.pods = list(full.pods)
+        mask = np.zeros(N, dtype=bool)
+        mask[: n_real + k] = True
+        solo = simulate(
+            sub, apps, sched_config=applier.sched_config, prep=prep, node_valid=mask
+        )
+        solo_chosen = {}
+        for ns in solo.node_status:
+            for p in ns.pods:
+                solo_chosen[(p.metadata.namespace, p.metadata.name)] = name_to_idx[
+                    ns.node.metadata.name
+                ]
+        restore_bind_state(prep, snap)
+        for i, pod in enumerate(prep.ordered):
+            key = (pod.metadata.namespace, pod.metadata.name)
+            assert int(chosen[s, i]) == solo_chosen.get(key, -1), (
+                f"scenario k={k} pod {key}: sweep chose {int(chosen[s, i])}, "
+                f"segmented simulate chose {solo_chosen.get(key, -1)}"
+            )

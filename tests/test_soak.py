@@ -1,0 +1,140 @@
+"""Soak / leak tripwire (ISSUE 12 satellite).
+
+Drives repeated watch-storm + serve iterations against one process and
+asserts every bounded structure actually stays bounded — prep-cache
+entries (LRU capacity), the flight-recorder/timeline rings, the journal's
+segment set (checkpoint pruning) — and that the process RSS delta over
+the soak stays inside a generous envelope (a real per-iteration leak of
+even a few MB would blow it; allocator noise and warm jit caches do not).
+
+Tier-1 runs the small-N variant; the slow tier runs a longer soak with
+the same assertions.
+"""
+
+import os
+
+import pytest
+
+from opensim_tpu.models import ResourceTypes
+from opensim_tpu.models import fixtures as fx
+from opensim_tpu.obs.capacity import CapacityEngine
+from opensim_tpu.obs.footprint import process_memory
+from opensim_tpu.server import rest
+from opensim_tpu.server.journal import Journal
+from opensim_tpu.server.watch import ClusterTwin
+
+
+def _cluster(nodes=6, bound=10):
+    rt = ResourceTypes()
+    for i in range(nodes):
+        rt.nodes.append(fx.make_fake_node(f"n{i}", "16", "64Gi"))
+    for i in range(bound):
+        rt.pods.append(
+            fx.make_fake_pod(f"b{i:02d}", "250m", "512Mi", fx.with_node_name(f"n{i % nodes}"))
+        )
+    return rt
+
+
+def _storm_iteration(i, twin, capacity, journal, rv):
+    """One watch-storm wave: pod adds, node-bound adds, deletes (tombstones
+    included) through the twin's apply path, the capacity feed, and the
+    journal — the live dispatch pipeline without sockets."""
+    for j in range(20):
+        rv += 1
+        name = f"storm-{i:04d}-{j:02d}"
+        obj = {
+            "metadata": {"name": name, "namespace": "soak", "resourceVersion": str(rv)},
+            "spec": {
+                "containers": [
+                    {"name": "c", "resources": {"requests": {"cpu": "100m", "memory": "128Mi"}}}
+                ],
+                "nodeName": f"n{j % 4}" if j % 2 else "",
+            },
+            "status": {"phase": "Running" if j % 2 else "Pending"},
+        }
+        change = twin.apply_event("pods", "ADDED", obj)
+        if change is not None:
+            capacity.on_twin_change("pods", "ADDED", obj, change, twin.generation)
+        journal.record_event("pods", "ADDED", obj, twin.generation)
+    for j in range(20):  # delete the whole wave: net-zero state per iteration
+        rv += 1
+        name = f"storm-{i:04d}-{j:02d}"
+        obj = {"metadata": {"name": name, "namespace": "soak", "resourceVersion": str(rv)}}
+        change = twin.apply_event("pods", "DELETED", obj)
+        if change is not None:
+            capacity.on_twin_change("pods", "DELETED", obj, change, twin.generation)
+        journal.record_event("pods", "DELETED", obj, twin.generation)
+    capacity.sample()  # fold the timeline ring like the supervisor tick
+    return rv
+
+
+def _soak(tmp_path, iterations, rss_budget_mb):
+    server = rest.SimonServer(base_cluster=_cluster())
+    twin = ClusterTwin()
+    capacity = CapacityEngine(timeline=None)
+    capacity.claim_event_fed()
+    capacity.bootstrap(_cluster(), 0)
+    journal = Journal(
+        str(tmp_path / "journal"),
+        policy={"fsync": "off", "segment_mb": 0.05, "checkpoint_every": 64, "keep": 2},
+    )
+    journal.checkpoint_source = lambda: ({"pods": []}, twin.generation, [])
+    rv = 100
+
+    def one(i):
+        nonlocal rv
+        rv = _storm_iteration(i, twin, capacity, journal, rv)
+        # serve: alternating payloads exercise full-key + base-entry churn
+        code, _ = server.deploy_apps(
+            {"deployments": [
+                fx.make_fake_deployment(f"soak-{i % 3}", 2 + (i % 2), "100m", "128Mi").raw
+            ]}
+        )
+        assert code == 200
+
+    try:
+        one(0)  # warmup: first-compile + first-prepare allocations are not a leak
+        journal.flush(timeout=30.0)
+        rss0 = process_memory()["rss_bytes"]
+        cache_cap = server.prep_cache.capacity
+        for i in range(1, iterations + 1):
+            one(i)
+        journal.flush(timeout=30.0)
+        rss1 = process_memory()["rss_bytes"]
+
+        # bounded structures stayed bounded
+        assert len(server.prep_cache) <= cache_cap
+        from opensim_tpu.obs.recorder import FLIGHT_RECORDER
+
+        assert len(FLIGHT_RECORDER) <= FLIGHT_RECORDER.capacity
+        assert len(capacity.timeline) <= capacity.timeline.capacity
+        # journal pruning holds the segment set down despite constant churn
+        segments = [n for n in os.listdir(journal.path) if n.endswith(".seg")]
+        assert len(segments) <= 8, f"journal segments unbounded: {segments}"
+        # net-zero churn must not accumulate twin state (tombstones are a
+        # capped LRU; the materialized view must be empty again)
+        mat = twin.materialize()
+        assert len(mat.pods) == 0, "twin leaked storm pods past their deletes"
+
+        delta_mb = (rss1 - rss0) / (1 << 20)
+        assert delta_mb < rss_budget_mb, (
+            f"RSS grew {delta_mb:.1f} MiB over {iterations} iterations "
+            f"(budget {rss_budget_mb} MiB) — leak tripwire"
+        )
+    finally:
+        journal.close()
+        server.close()
+
+
+def test_soak_small_bounded_growth(tmp_path):
+    """Tier-1 tripwire: a handful of storm+serve iterations must not grow
+    the bounded structures or the RSS envelope."""
+    _soak(tmp_path, iterations=8, rss_budget_mb=256)
+
+
+@pytest.mark.slow
+def test_soak_long_bounded_growth(tmp_path):
+    """Nightly tier: a longer soak with the same budget — a real
+    per-iteration leak scales with N and trips here even if the small run
+    hides under allocator noise."""
+    _soak(tmp_path, iterations=60, rss_budget_mb=320)

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Memory-observatory gate (ISSUE 12, `make mem-smoke`).
+
+Drives a request storm against an in-process server plus a twin-delta
+churn against its warm base entry, then asserts the contracts the memory
+surface ships under (docs/observability.md "Memory & profiles"):
+
+1. the gauges MOVE: prep-cache bytes/entries climb from the storm, RSS is
+   nonzero, ring occupancy reflects the recorded traces;
+2. the totals RECONCILE: `simon mem`'s prep-cache total equals the sum of
+   per-entry unique-bytes attributions exactly, and stays within 1% of an
+   independent distinct-leaf walk (the ISSUE 12 acceptance criterion);
+3. the scrape stays CONFORMANT: every simon_mem_*/simon_compile_*/
+   simon_phase_profile_* family renders # HELP/# TYPE once, with zero
+   duplicate series;
+4. the delta lineage is visible: a twin pod churn produces an entry with
+   lineage_depth > 0 and a nonzero drop density.
+
+Run directly (used by `make verify`); exits nonzero with a reason on any
+violation.
+"""
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"mem-smoke: FAIL: {msg}")
+    raise SystemExit(1)
+
+
+def main() -> int:
+    from opensim_tpu.engine import prepcache
+    from opensim_tpu.models import ResourceTypes, fixtures as fx
+    from opensim_tpu.obs.footprint import prepcache_footprint
+    from opensim_tpu.server import rest
+
+    # -- a cluster with bound pods so the base prep has a real stream ------
+    rt = ResourceTypes()
+    for i in range(12):
+        rt.nodes.append(fx.make_fake_node(f"n{i:02d}", "32", "128Gi"))
+    for i in range(40):
+        rt.pods.append(
+            fx.make_fake_pod(f"bound-{i:03d}", "500m", "1Gi",
+                             fx.with_node_name(f"n{i % 12:02d}"))
+        )
+    server = rest.SimonServer(base_cluster=rt)
+
+    empty = prepcache_footprint(server.prep_cache)
+    if empty["total_bytes"] != 0 or empty["entries"]:
+        fail("prep cache not empty before the storm")
+
+    # -- storm: distinct deploy payloads populate base + derived entries ---
+    for k in range(4):
+        payload = {
+            "deployments": [
+                fx.make_fake_deployment(f"storm-{k}", 3 + k, "250m", "512Mi").raw
+            ]
+        }
+        code, _body = server.deploy_apps(payload)
+        if code != 200:
+            fail(f"deploy {k} returned {code}")
+
+    mem = server.memory.debug_payload()
+    cache = mem["prepcache"]
+    if not cache["entries"]:
+        fail("storm produced no cache entries")
+    if cache["total_bytes"] <= 0:
+        fail("prep-cache bytes did not move under the storm")
+    if mem["process"]["rss_bytes"] <= 0:
+        fail("process RSS reads zero")
+    rings = mem["rings"]
+    if rings["flight_recorder"]["entries"] < 4:
+        fail(f"flight recorder did not record the storm: {rings}")
+
+    # -- reconciliation: totals == Σ per-entry unique bytes (±1% vs an
+    #    independent distinct-leaf walk) ------------------------------------
+    total = cache["total_bytes"]
+    entry_sum = sum(e["unique_bytes"] for e in cache["entries"])
+    if total != entry_sum:
+        fail(f"total_bytes {total} != Σ unique_bytes {entry_sum}")
+    seen, independent = set(), 0
+    from opensim_tpu.obs.footprint import entry_host_leaves
+
+    for entry in server.prep_cache.entries_snapshot():
+        with entry.lock:
+            for _name, arr in entry_host_leaves(entry):
+                if id(arr) not in seen:
+                    seen.add(id(arr))
+                    independent += int(arr.nbytes)
+    if abs(independent - total) > 0.01 * max(1, independent):
+        fail(f"independent walk {independent} vs reported total {total} off by >1%")
+    dtype_sum = sum(cache["dtypes"].values())
+    if abs(dtype_sum - total) > 0.01 * max(1, total):
+        fail(f"dtype breakdown {dtype_sum} does not reconcile with total {total}")
+
+    # -- twin-delta lineage: churn the base entry, depth + drop density ----
+    base_key = [
+        e["key"] for e in cache["entries"] if e["key"].endswith("|base")
+    ]
+    if not base_key:
+        fail("no base entry in the cache after the storm")
+    base = server.prep_cache.get(base_key[0])
+    added = [fx.make_fake_pod("twin-new-0", "250m", "512Mi")]
+    removed = {("default", "bound-000"), ("default", "bound-001")}
+    with base.lock:
+        base.restore()
+        derived = prepcache.twin_pod_delta(base, base.key + "|churn", added, removed)
+    if derived is None:
+        fail("twin_pod_delta declined a small churn")
+    server.prep_cache.put(derived.key, derived)
+    churn = prepcache_footprint(server.prep_cache)
+    churn_entry = next(e for e in churn["entries"] if e["key"].endswith("|churn"))
+    if churn_entry["lineage_depth"] < 1:
+        fail(f"churn entry lineage_depth {churn_entry['lineage_depth']} < 1")
+    if churn_entry["drop_density"] <= 0:
+        fail("churn entry drop density is zero despite deletions")
+    if churn["total_bytes"] != sum(e["unique_bytes"] for e in churn["entries"]):
+        fail("reconciliation broke after the twin delta")
+
+    # -- exposition conformance over the whole scrape ----------------------
+    text = rest.METRICS.render(
+        prep_cache=server.prep_cache, admission=server.admission,
+        capacity=server.capacity, memory=server.memory,
+    )
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s(-?[0-9.eE+-]+|NaN|[+-]?Inf)$"
+    )
+    helped, typed, series = set(), set(), set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            if name in helped:
+                fail(f"duplicate HELP for {name}")
+            helped.add(name)
+            continue
+        if line.startswith("# TYPE "):
+            name = line.split()[2]
+            if name in typed:
+                fail(f"duplicate TYPE for {name}")
+            typed.add(name)
+            continue
+        m = sample_re.match(line)
+        if not m:
+            fail(f"non-conformant sample line: {line!r}")
+        key = (m.group(1), m.group(2) or "")
+        if key in series:
+            fail(f"duplicate series: {line!r}")
+        series.add(key)
+    for family in (
+        "simon_mem_rss_bytes", "simon_mem_prepcache_bytes",
+        "simon_mem_prepcache_entries", "simon_mem_arena_bytes",
+        "simon_mem_ring_entries", "simon_mem_ring_capacity",
+        "simon_backend_compile_total", "simon_phase_profile_calls_total",
+    ):
+        if family not in helped:
+            fail(f"{family} missing from the scrape")
+    reported = int(
+        next(l for l in text.splitlines()
+             if l.startswith("simon_mem_prepcache_bytes ")).split()[-1]
+    )
+    if reported != churn["total_bytes"]:
+        fail(
+            f"scrape gauge {reported} disagrees with the debug payload "
+            f"{churn['total_bytes']}"
+        )
+
+    server.close()
+    print(
+        "mem-smoke: OK — "
+        f"{len(churn['entries'])} entries, {churn['total_bytes']} arena bytes "
+        f"({churn['shared_bytes']} shared), totals reconcile, "
+        f"{len(series)} series conformant"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

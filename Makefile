@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan mem-smoke perf-guard
+.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan mem-smoke perf-guard campaign-smoke
 
 all: test
 
@@ -95,6 +95,15 @@ mem-smoke:
 perf-guard:
 	python tools/perf_guard.py --tolerance-only
 
+# campaign-engine gate (ISSUE 13, docs/campaigns.md): a 3-step lifecycle
+# campaign (PDB-aware drain wave + reclaim storm + scale-down check) POSTed
+# to /api/campaign on the stub-apiserver twin must run with EXACTLY ONE
+# full prepare, move the capacity scores, charge the PDB ledger, keep
+# text/JSON table parity, and a small `bench.py --config campaign` row must
+# parse with its in-row warm-vs-cold fingerprint gate green
+campaign-smoke:
+	python tools/campaign_smoke.py
+
 # runtime lock-order sanitizer (docs/static-analysis.md#make-tsan): a
 # seeded A->B/B->A inversion must be caught (detector self-test), then the
 # threaded test modules run under instrumented locks — any observed
@@ -103,8 +112,8 @@ perf-guard:
 tsan:
 	python tools/tsan.py
 
-# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain + loadgen + capacity + replay + lock sanitizer + memory + perf trajectory
-verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan mem-smoke perf-guard
+# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain + loadgen + capacity + replay + lock sanitizer + memory + perf trajectory + campaigns
+verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan mem-smoke perf-guard campaign-smoke
 
 # run the moment the TPU tunnel opens (tools/tpu_probe_loop.sh writes
 # /tmp/opensim-tpu-watch.up): compiled-Mosaic parity suite + full bench

@@ -192,6 +192,122 @@ def bench_defrag(n_scenarios: int, n_nodes: int, n_pods: int, warmup: bool) -> i
     return 0
 
 
+def _campaign_inputs(n_nodes: int, n_pods: int):
+    """Synthetic lifecycle-campaign scenario (ISSUE 13): the bench cluster
+    owns the workloads (campaigns drain/reschedule cluster pods), a quarter
+    of them guarded by PDBs, and the campaign mixes the four acceptance
+    step shapes: PDB-aware drain wave, reclaim storm, deploy, scale-down
+    check."""
+    from opensim_tpu.models.objects import PodDisruptionBudget
+
+    cluster = synthetic_cluster(n_nodes)
+    cluster.deployments.extend(synthetic_apps(n_pods).deployments)
+    for w in cluster.deployments[:5]:
+        cluster.pdbs.append(
+            PodDisruptionBudget.from_dict(
+                {
+                    "apiVersion": "policy/v1",
+                    "kind": "PodDisruptionBudget",
+                    "metadata": {"name": f"{w.metadata.name}-pdb", "namespace": "default"},
+                    "spec": {
+                        "maxUnavailable": "25%",
+                        "selector": {"matchLabels": {"app": w.metadata.name}},
+                    },
+                }
+            )
+        )
+    drain_n = max(2, n_nodes // 10)
+    storm_n = max(1, n_nodes // 20)
+    steps = [
+        {"name": "upgrade", "type": "drain-wave", "count": drain_n, "wave": max(1, drain_n // 4)},
+        {"name": "spot-storm", "type": "reclaim-storm", "count": storm_n},
+        {
+            "name": "push",
+            "type": "deploy",
+            "app": {"name": "push"},
+            "resources": [
+                {
+                    "apiVersion": "apps/v1",
+                    "kind": "Deployment",
+                    "metadata": {"name": "push", "namespace": "default"},
+                    "spec": {
+                        "replicas": max(4, n_pods // 20),
+                        "selector": {"matchLabels": {"app": "push"}},
+                        "template": {
+                            "metadata": {"labels": {"app": "push"}},
+                            "spec": {
+                                "containers": [
+                                    {
+                                        "name": "c",
+                                        "resources": {
+                                            "requests": {"cpu": "250m", "memory": "512Mi"}
+                                        },
+                                    }
+                                ]
+                            },
+                        },
+                    },
+                }
+            ],
+        },
+        {"name": "shrink-check", "type": "scale-down-check", "count": 8},
+    ]
+    return cluster, steps
+
+
+def bench_campaign(n_nodes: int, n_pods: int, warmup: bool) -> int:
+    """Campaign-engine throughput (ISSUE 13): a 4-step mixed lifecycle
+    campaign (drain wave w/ PDBs + reclaim storm + deploy + scale-down
+    check) on the warm delta path. Metrics: steps/s and pods rescheduled/s;
+    at small sizes the row also gates warm-vs-cold fingerprint equality
+    in-row (the delta-execution proof)."""
+    from opensim_tpu.planner import campaign as campaign_mod
+
+    cluster, steps_raw = _campaign_inputs(n_nodes, n_pods)
+    if warmup:
+        campaign_mod.run_campaign(cluster, campaign_mod.parse_steps(steps_raw), mode="warm")
+    t0 = time.time()
+    res = campaign_mod.run_campaign(
+        cluster, campaign_mod.parse_steps(steps_raw), mode="warm", name="bench"
+    )
+    dt = time.time() - t0
+    n_steps = len(res.steps)
+    rescheduled = sum(s.rescheduled for s in res.steps)
+    record = {
+        "metric": f"campaign ({n_steps} scored steps, {_fmt(n_pods)} pods/{_fmt(n_nodes)} nodes)",
+        "value": round(dt, 3),
+        "unit": "s",
+        "vs_baseline": round(10.0 / dt, 2) if dt > 0 else 0.0,
+        "config": "campaign",
+        "steps": n_steps,
+        "steps_per_s": round(n_steps / dt, 2) if dt > 0 else 0.0,
+        "rescheduled": rescheduled,
+        "rescheduled_per_s": round(rescheduled / dt, 1) if dt > 0 else 0.0,
+        "evicted": sum(s.evicted for s in res.steps),
+        "blocked": sum(len(s.blocked) for s in res.steps),
+        # pods still pending at campaign end (the capacity sample, not the
+        # last step's scan report — a what-if final step never scans)
+        "unschedulable": int((res.steps[-1].capacity or {}).get("pods_pending", 0)),
+        "full_prepares": res.full_prepares,
+        "fingerprint": res.fingerprint,
+    }
+    if n_pods <= 5000:
+        # the delta-execution gate, in-row: the warm campaign's per-step
+        # fingerprints must be bit-identical to cold per-step prepares
+        cold = campaign_mod.run_campaign(
+            cluster, campaign_mod.parse_steps(steps_raw), mode="cold", name="bench"
+        )
+        record["verified_vs_cold"] = bool(
+            [s.fingerprint for s in res.steps] == [s.fingerprint for s in cold.steps]
+        )
+        if not record["verified_vs_cold"]:
+            raise RuntimeError("campaign warm-delta fingerprints diverged from cold per-step prepares")
+    if BACKEND_NOTE:
+        record["backend"] = BACKEND_NOTE
+    print(json.dumps(record))
+    return 0
+
+
 def affinity_apps(n_pods: int) -> ResourceTypes:
     """BASELINE.md config 4: InterPodAffinity + PodTopologySpread heavy."""
     rt = ResourceTypes()
@@ -508,7 +624,7 @@ def main() -> int:
     ap.add_argument(
         "--config",
         default="plan",
-        choices=["plan", "defrag", "affinity", "example", "gpushare", "bigu", "forced", "steady", "serving", "replay"],
+        choices=["plan", "defrag", "affinity", "example", "gpushare", "bigu", "forced", "steady", "serving", "replay", "campaign"],
         help=(
             "plan = capacity-plan wall-clock (headline); defrag = drain-scenario "
             "sweep; affinity = interpod+spread heavy; example/gpushare = the "
@@ -568,6 +684,8 @@ def main() -> int:
         return bench_replay(args.journal, args.events, args.nodes, args.speed)
     if args.config == "steady":
         return bench_steady(args.pods, args.nodes, args.repeats)
+    if args.config == "campaign":
+        return bench_campaign(args.nodes, args.pods, args.warmup)
     if args.config == "defrag":
         return bench_defrag(args.scenarios, args.nodes, args.pods, args.warmup)
     if args.config == "example":

@@ -32,6 +32,8 @@ Rules (short name = suppression id; see docs/static-analysis.md):
                               paths (server/journal.py owns the format)
     OSL1401 env-registry      raw os.environ read of an OPENSIM_* knob
                               outside utils/envknobs.py
+    OSL1501 campaign-step-registry  campaign step-type dispatch outside
+                              planner/campaign.py's STEP_TYPES registry
 
 The OSL12xx family is whole-program (symbol table + call graph + lock
 graph across all linted files); its runtime counterpart is the lock-order
@@ -56,6 +58,7 @@ from .core import (  # noqa: F401
 from . import (  # noqa: F401,E402
     rules_admission,
     rules_cache,
+    rules_campaign,
     rules_concurrency,
     rules_determinism,
     rules_dtype,

@@ -1,0 +1,93 @@
+"""campaign-step-registry (OSL1501): campaign step types live in the
+central ``STEP_TYPES`` registry (``planner/campaign.py``).
+
+The campaign DSL (ISSUE 13) dispatches lifecycle steps — drain waves,
+reclaim storms, journal replays — through one registered table, the same
+single-place-of-declaration discipline as the metric-family registry
+(OSL1101) and the journal format ownership (OSL1301). A step type handled
+by ad-hoc ``if step == "drain-wave"`` dispatch in some other module ships
+behavior the registry's reviewer never sees: it bypasses the typed
+``parse``/``run`` contract, the strict-field validation, and the
+``docs/campaigns.md`` step catalog generated from the registry.
+
+The rule flags, in any module other than ``planner/campaign.py``:
+
+- calls to ``register_step(...)`` — step registration happens ONLY in the
+  registry module, where every step's parse/run contract is reviewed
+  together;
+- equality/membership comparisons against the campaign-specific step-type
+  literals (``"drain-wave"``, ``"reclaim-storm"``, ``"add-nodes"``,
+  ``"scale-down-check"``, ``"from-journal"``) — the ad-hoc dispatch
+  pattern. (The short generic names ``deploy``/``scale``/``defrag`` are
+  legitimately compared elsewhere — REST request kinds, CLI subcommands —
+  so only the unambiguous hyphenated types trigger; their dispatch is
+  still registry-owned because only ``campaign.py`` defines their
+  handlers.)
+
+Fix by declaring the step in ``STEP_TYPES`` via ``@register_step`` and
+routing behavior through the step's ``run``; see docs/static-analysis.md.
+``tests/test_campaign.py`` gates :data:`DISPATCH_LITERALS` against the
+live registry so the rule cannot drift from the DSL.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import FileContext, Finding, Rule, dotted_name, register
+
+#: campaign-specific step-type literals whose comparison IS step dispatch
+#: (kept a subset of planner.campaign.STEP_TYPES by the sync test)
+DISPATCH_LITERALS = frozenset(
+    {"drain-wave", "reclaim-storm", "add-nodes", "scale-down-check", "from-journal"}
+)
+
+
+def _literal_strings(node: ast.AST) -> Iterable[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                yield elt.value
+
+
+@register
+class CampaignStepRegistryRule(Rule):
+    name = "campaign-step-registry"
+    code = "OSL1501"
+    description = "campaign step-type dispatch outside planner/campaign.py's STEP_TYPES registry"
+    # the registry module necessarily compares and registers step types;
+    # tests exercise arbitrary specs on purpose
+    exclude_paths = ("planner/campaign.py", "tests/")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func) or (
+                    node.func.attr if isinstance(node.func, ast.Attribute) else ""
+                )
+                if name.rsplit(".", 1)[-1] == "register_step":
+                    yield self.finding(
+                        ctx,
+                        node,
+                        "register_step(...) outside planner/campaign.py: campaign "
+                        "step types are declared ONLY in the central STEP_TYPES "
+                        "registry so every step's parse/run contract is reviewed "
+                        "in one place",
+                    )
+            elif isinstance(node, ast.Compare):
+                if not any(isinstance(op, (ast.Eq, ast.NotEq, ast.In, ast.NotIn)) for op in node.ops):
+                    continue
+                hits = set()
+                for side in [node.left] + list(node.comparators):
+                    hits.update(s for s in _literal_strings(side) if s in DISPATCH_LITERALS)
+                for lit in sorted(hits):
+                    yield self.finding(
+                        ctx,
+                        node,
+                        f"ad-hoc dispatch on campaign step type {lit!r}: route through "
+                        "planner/campaign.py's STEP_TYPES registry (the step's "
+                        "parse/run contract) instead of string comparison",
+                    )

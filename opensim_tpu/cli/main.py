@@ -113,6 +113,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     defrag_p = sub.add_parser(
         "defrag",
+        aliases=["drain"],
         parents=[backend_parent],
         help="evaluate node-drain what-ifs (the README's Pods Migration feature, batch-evaluated)",
         description="evaluate node-drain what-ifs (Pods Migration), batch-evaluated as scenarios",
@@ -121,7 +122,44 @@ def build_parser() -> argparse.ArgumentParser:
     defrag_p.add_argument(
         "--candidates", default="", help="comma-separated node names to evaluate (default: all)"
     )
+    defrag_p.add_argument(
+        "--json", action="store_true",
+        help="emit the drain plan as JSON (the same table rows the text "
+        "renderer prints — byte-parity via planner/report.py)",
+    )
     defrag_p.add_argument("-o", "--output-file", default="", help="redirect the report to a file")
+
+    campaign_p = sub.add_parser(
+        "campaign",
+        parents=[backend_parent],
+        help="run a cluster-lifecycle campaign (drain waves, reclaim storms, scored what-ifs)",
+        description=(
+            "execute a declarative lifecycle campaign (docs/campaigns.md): an "
+            "ordered list of typed steps — PDB-aware drain waves, spot reclaim "
+            "storms, deploys/scales, add-nodes, scale-down safety checks, "
+            "defrag plans, journal-sourced event ranges — evaluated against "
+            "the spec's cluster (or a live server with --url) with every step "
+            "scored by the capacity observatory: placements delta, disruption "
+            "budget consumed, utilization/fragmentation/headroom movement, and "
+            "a bit-stable step fingerprint"
+        ),
+    )
+    campaign_p.add_argument("spec", help="campaign spec yaml (kind: Campaign)")
+    campaign_p.add_argument("--json", action="store_true", help="print the full result JSON instead of tables")
+    campaign_p.add_argument(
+        "--exec", dest="exec_mode", default="", choices=["", "warm", "cold"],
+        help="execution mode override (default OPENSIM_CAMPAIGN_EXEC): warm = "
+        "one full prepare + prepcache deltas; cold = per-step full prepare "
+        "(the verification mode)",
+    )
+    campaign_p.add_argument(
+        "--url", default="",
+        help="POST the campaign's steps to a live server's /api/campaign and "
+        "evaluate against its observed cluster (live twin) instead of the "
+        "spec's cluster section",
+    )
+    campaign_p.add_argument("--timeout", type=float, default=600.0, help="--url request timeout seconds")
+    campaign_p.add_argument("-o", "--output-file", default="", help="also write the result to a file")
 
     server_p = sub.add_parser(
         "server", parents=[backend_parent], help="start the simon REST server",
@@ -332,7 +370,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     backend = getattr(args, "backend", "auto")
     if backend != "auto":
         _select_backend(backend)
-    elif args.command in ("apply", "defrag", "server", "explain"):
+    elif args.command in ("apply", "defrag", "drain", "server", "explain") or (
+        args.command == "campaign" and not args.url
+    ):  # --url campaigns are pure HTTP: no local engine, skip the probe
         # auto mode must not hang when the accelerator tunnel is dead: any
         # jax device op can block forever (utils/probe.py), so probe in a
         # subprocess first and fall back to the host CPU with a note
@@ -387,48 +427,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         except (OSError, ValueError) as e:
             print(f"simon apply: {e}", file=sys.stderr)
             return 1
-    if args.command == "defrag":
-        from ..planner.apply import Applier, Options
-
+    if args.command in ("defrag", "drain"):
         try:
-            applier = Applier(Options(simon_config=args.simon_config))
-            cluster = applier.load_cluster()
-            apps = applier.load_apps()
-            from ..planner.defrag import plan_drains
-
-            candidates = [c.strip() for c in args.candidates.split(",") if c.strip()] or None
-            if candidates:
-                known = {n.metadata.name for n in cluster.nodes}
-                unknown = [c for c in candidates if c not in known]
-                if unknown:
-                    print(f"simon defrag: unknown node(s): {', '.join(unknown)}", file=sys.stderr)
-                    return 1
-            result = plan_drains(cluster, apps, candidates=candidates)
-            out = open(args.output_file, "w") if args.output_file else sys.stdout
-            try:
-                print("Drain Plan", file=out)
-                from ..models.quantity import format_milli, format_quantity
-                from ..planner.report import _table
-
-                rows = [["Node", "Drainable", "Unscheduled", "Freed CPU", "Freed Memory"]]
-                for p in result.plans:
-                    rows.append(
-                        [
-                            p.node,
-                            "√" if p.feasible else "",
-                            str(p.unscheduled),
-                            format_milli(int(p.freed_cpu_milli)),
-                            format_quantity(p.freed_memory),
-                        ]
-                    )
-                _table(rows, out)
-                print(f"\n{len(result.drainable())}/{len(result.plans)} node(s) drainable", file=out)
-            finally:
-                if args.output_file:
-                    out.close()
-            return 0
+            return run_defrag(args)
         except (OSError, ValueError) as e:
             print(f"simon defrag: {e}", file=sys.stderr)
+            return 1
+    if args.command == "campaign":
+        try:
+            return run_campaign_cmd(args)
+        except (OSError, ValueError) as e:
+            print(f"simon campaign: {e}", file=sys.stderr)
             return 1
     if args.command == "explain":
         try:
@@ -541,6 +550,113 @@ def run_top(args) -> int:
         else:
             print(rendered)
             return 0
+
+
+def run_defrag(args) -> int:
+    """``simon defrag`` / ``simon drain``: batch-evaluated node-drain
+    what-ifs. Text and ``--json`` both serialize the SAME rows
+    (``planner/report.drain_plan_rows`` — the byte-parity contract every
+    report table follows)."""
+    import json as _json
+
+    from ..planner.apply import Applier, Options
+    from ..planner.defrag import plan_drains
+    from ..planner.report import _table, drain_plan_rows
+
+    applier = Applier(Options(simon_config=args.simon_config))
+    cluster = applier.load_cluster()
+    apps = applier.load_apps()
+
+    candidates = [c.strip() for c in args.candidates.split(",") if c.strip()] or None
+    if candidates:
+        known = {n.metadata.name for n in cluster.nodes}
+        unknown = [c for c in candidates if c not in known]
+        if unknown:
+            print(f"simon defrag: unknown node(s): {', '.join(unknown)}", file=sys.stderr)
+            return 1
+    result = plan_drains(cluster, apps, candidates=candidates)
+    rows = drain_plan_rows(result.plans)
+    out = open(args.output_file, "w") if args.output_file else sys.stdout
+    try:
+        if args.json:
+            print(
+                _json.dumps(
+                    {
+                        "table": {"header": rows[0], "rows": rows[1:]},
+                        "drainable": len(result.drainable()),
+                        "total": len(result.plans),
+                    },
+                    sort_keys=True,
+                ),
+                file=out,
+            )
+        else:
+            print("Drain Plan", file=out)
+            _table(rows, out)
+            print(f"\n{len(result.drainable())}/{len(result.plans)} node(s) drainable", file=out)
+    finally:
+        if args.output_file:
+            out.close()
+    return 0
+
+
+def run_campaign_cmd(args) -> int:
+    """``simon campaign <spec.yaml>``: execute a lifecycle campaign locally
+    against the spec's cluster, or — with ``--url`` — POST its steps to a
+    live server's ``/api/campaign`` (evaluated against the live twin).
+    Text and ``--json`` both serialize the same table rows."""
+    import json as _json
+
+    from ..planner import campaign as campaign_mod
+    from ..planner.report import render_campaign
+
+    spec = campaign_mod.load_campaign(args.spec)
+    if args.url:
+        import urllib.error
+        import urllib.request
+        import yaml as _yaml
+
+        with open(args.spec) as fh:
+            doc = _yaml.safe_load(fh) or {}
+        body = _json.dumps(
+            {
+                "name": spec.name,
+                "steps": (doc.get("spec") or {}).get("steps") or [],
+                **({"mode": args.exec_mode} if args.exec_mode else {}),
+            }
+        ).encode()
+        req = urllib.request.Request(
+            f"{args.url.rstrip('/')}/api/campaign",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=args.timeout) as resp:
+                result = _json.load(resp)
+        except urllib.error.HTTPError as e:
+            try:
+                detail = _json.load(e)
+            except ValueError:
+                detail = {"error": str(e)}
+            print(f"simon campaign: HTTP {e.code}: {detail.get('error', e)}", file=sys.stderr)
+            return 1
+    else:
+        cluster = campaign_mod.load_campaign_cluster(spec)
+        result = campaign_mod.run_campaign(
+            cluster, spec, mode=args.exec_mode or None
+        ).to_dict()
+    out = sys.stdout
+    if args.json:
+        rendered = _json.dumps(result, indent=2, sort_keys=True)
+        print(rendered, file=out)
+    else:
+        render_campaign(result, out)
+    if args.output_file:
+        with open(args.output_file, "w") as fh:
+            fh.write(_json.dumps(result, sort_keys=True) + "\n")
+    # a campaign that left evictions blocked or pods unschedulable is a
+    # finding, not a failure: exit 0 with the verdict in the report
+    return 0
 
 
 def _fetch_debug(url: str, timeout: float):
@@ -940,7 +1056,14 @@ def gen_doc(parser: argparse.ArgumentParser, output_dir: str) -> int:
     (cmd/doc/generate_markdown.go:33 → docs/commandline/simon_apply.md …)."""
     os.makedirs(output_dir, exist_ok=True)
     sub_actions = [a for a in parser._actions if isinstance(a, argparse._SubParsersAction)]
-    commands = [(name, sp) for action in sub_actions for name, sp in action.choices.items()]
+    commands = []
+    seen_parsers = set()  # aliases map to the same parser: document once
+    for action in sub_actions:
+        for name, sp in action.choices.items():
+            if id(sp) in seen_parsers:
+                continue
+            seen_parsers.add(id(sp))
+            commands.append((name, sp))
     written = []
     with open(os.path.join(output_dir, "simon.md"), "w") as f:
         f.write(f"# simon\n\n{parser.description}\n\n```\n{parser.format_help()}```\n\n")

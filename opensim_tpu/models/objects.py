@@ -469,8 +469,7 @@ class Workload(VersionedObject):
 @dataclass
 class RawObject(VersionedObject):
     """Kinds carried through but not interpreted beyond a few fields:
-    Service, PodDisruptionBudget, StorageClass, PersistentVolumeClaim,
-    ConfigMap."""
+    Service, StorageClass, PersistentVolumeClaim, ConfigMap."""
 
     kind: str = ""
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
@@ -479,6 +478,84 @@ class RawObject(VersionedObject):
     @classmethod
     def from_dict(cls, d: dict) -> "RawObject":
         return cls(kind=d.get("kind", ""), metadata=ObjectMeta.from_dict(d.get("metadata")), raw=d)
+
+
+@dataclass
+class PodDisruptionBudget(VersionedObject):
+    """Typed ``policy/v1`` PodDisruptionBudget (ISSUE 13): the campaign
+    engine tracks per-step disruption budgets, so the spec fields the
+    disruption controller reads — ``minAvailable`` / ``maxUnavailable``
+    (absolute or percentage) and the pod ``selector`` — are parsed once
+    here instead of being re-dug out of ``raw`` at every eviction check.
+    ``raw`` still round-trips the full object (the preemption pass and the
+    twin keep reading it like any other resource)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    #: int, ``"N%"`` string, or None — exactly one of the two is normally set
+    min_available: Optional[object] = None
+    max_unavailable: Optional[object] = None
+    selector: Optional[dict] = None
+    raw: dict = field(default_factory=dict)
+
+    kind = "PodDisruptionBudget"
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PodDisruptionBudget":
+        spec = d.get("spec") or {}
+        return cls(
+            metadata=ObjectMeta.from_dict(d.get("metadata")),
+            min_available=spec.get("minAvailable"),
+            max_unavailable=spec.get("maxUnavailable"),
+            selector=copy.deepcopy(spec.get("selector")),
+            raw=d,
+        )
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace or 'default'}/{self.metadata.name}"
+
+    @staticmethod
+    def _resolve(value, basis: int) -> int:
+        """An absolute count, or ``ceil(pct · basis)`` for ``"N%"`` — the
+        disruption controller's ``GetScaledValueFromIntOrPercent`` with
+        round-up semantics."""
+        import math
+
+        if isinstance(value, str) and value.strip().endswith("%"):
+            return int(math.ceil(float(value.strip()[:-1]) / 100.0 * basis))
+        return int(value)
+
+    def selects(self) -> bool:
+        """Nil/empty selectors match nothing (``filterPodsWithPDBViolation``
+        semantics — same rule the preemption pass applies)."""
+        sel = self.selector or {}
+        return bool(sel.get("matchLabels") or sel.get("matchExpressions"))
+
+    def matches(self, pod: "Pod") -> bool:
+        from . import selectors
+
+        return (
+            self.selects()
+            and pod.metadata.namespace == (self.metadata.namespace or "default")
+            and bool(pod.metadata.labels)
+            and selectors.match_label_selector(self.selector, pod.metadata.labels)
+        )
+
+    def disruptions_allowed(self, healthy: int, expected: int) -> int:
+        """``status.disruptionsAllowed`` from the current healthy matching
+        count and the expected count (the owning workloads' declared
+        replicas) — the disruption controller's arithmetic, clamped at 0.
+        A PDB with neither field set allows unlimited disruptions."""
+        if self.min_available is not None:
+            allowed = healthy - self._resolve(self.min_available, expected)
+        elif self.max_unavailable is not None:
+            allowed = healthy - (expected - self._resolve(self.max_unavailable, expected))
+        else:
+            return 1 << 30
+        return max(int(allowed), 0)
 
 
 @dataclass
@@ -494,7 +571,7 @@ class ResourceTypes:
     jobs: List[Workload] = field(default_factory=list)
     cron_jobs: List[Workload] = field(default_factory=list)
     services: List[RawObject] = field(default_factory=list)
-    pdbs: List[RawObject] = field(default_factory=list)
+    pdbs: List[PodDisruptionBudget] = field(default_factory=list)
     storage_classes: List[RawObject] = field(default_factory=list)
     pvcs: List[RawObject] = field(default_factory=list)
     config_maps: List[RawObject] = field(default_factory=list)
@@ -523,7 +600,7 @@ class ResourceTypes:
 
 
 WORKLOAD_KINDS = {"Deployment", "ReplicaSet", "StatefulSet", "DaemonSet", "Job", "CronJob"}
-RAW_KINDS = {"Service", "PodDisruptionBudget", "StorageClass", "PersistentVolumeClaim", "ConfigMap"}
+RAW_KINDS = {"Service", "StorageClass", "PersistentVolumeClaim", "ConfigMap"}
 
 
 def object_from_dict(d: dict):
@@ -538,6 +615,8 @@ def object_from_dict(d: dict):
         return Node.from_dict(d)
     if kind in WORKLOAD_KINDS:
         return Workload.from_dict(d)
+    if kind == "PodDisruptionBudget":
+        return PodDisruptionBudget.from_dict(d)
     if kind in RAW_KINDS:
         return RawObject.from_dict(d)
     return None

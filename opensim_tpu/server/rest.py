@@ -443,6 +443,10 @@ class SimonServer:
         if journal is not None and self.watch is not None:
             self.watch.attach_journal(journal)
         self._headroom_key: Optional[str] = None
+        # campaign engine (ISSUE 13): one campaign at a time PER SERVER —
+        # each builds its own prep lineage; an instance lock keeps
+        # unrelated servers (tests, smokes) from serializing each other
+        self._campaign_lock = threading.Lock()  # lockwatch: hold-exempt — a campaign spans many engine scans by design
         # memory observatory (ISSUE 12, obs/footprint.py): arena/cache
         # footprint accounting + RSS/device watermarks over the structures
         # THIS server owns. Always on — every view is computed on demand;
@@ -702,6 +706,40 @@ class SimonServer:
             summary = self.memory.summary()
             report["memory"] = {"summary": summary, "rows": memory_rows(summary)}
         return report
+
+    # -- campaign engine (ISSUE 13) -----------------------------------------
+
+    def run_campaign(self, payload: dict, deadline: Optional[Deadline] = None) -> tuple:
+        """``POST /api/campaign`` (docs/campaigns.md): evaluate a
+        lifecycle campaign — the request body's ``steps`` list, the same
+        shape as a campaign file's ``spec.steps`` — against the observed
+        cluster (the live twin when synced, the polling snapshot
+        otherwise). Campaigns are serialized: each builds its own prep
+        lineage (exactly one full prepare) and never mutates the snapshot
+        objects. Returns ``(status, body)``."""
+        from ..planner import campaign as campaign_mod
+
+        try:
+            steps = campaign_mod.parse_steps(payload.get("steps"))
+        except campaign_mod.CampaignError as e:
+            return 400, {"error": str(e), "step": e.step, "field": e.field}
+        name = str(payload.get("name") or "campaign")
+        mode = payload.get("mode") or None
+        try:
+            with deadline_scope(deadline):
+                with self._campaign_lock:
+                    with tracing.span("campaign", steps=len(steps)):
+                        cluster, _key = self._observed_cluster()
+                        result = campaign_mod.run_campaign(
+                            cluster, steps, mode=mode, name=name
+                        )
+            return 200, result.to_dict()
+        except DeadlineExceeded as e:
+            return 504, {"error": str(e), "phase": e.phase, "retryable": True}
+        except SnapshotUnavailable as e:
+            return 503, {"error": str(e), "retryable": True}
+        except campaign_mod.CampaignError as e:
+            return 400, {"error": str(e), "step": e.step, "field": e.field}
 
     # -- handlers -----------------------------------------------------------
 
@@ -1496,6 +1534,11 @@ def make_handler(server: SimonServer):
                 code, body = server.scale_apps(
                     payload, deadline=deadline, request_id=request_id, explain=explain
                 )
+            elif path == "/api/campaign":
+                # campaign engine (ISSUE 13, docs/campaigns.md): a what-if
+                # analysis like the cluster report — runs inline on the
+                # handler thread, serialized across requests
+                code, body = server.run_campaign(payload, deadline=deadline)
             else:
                 code, body = 404, {"error": "not found"}
             # degraded-mode transparency: a result computed from a stale

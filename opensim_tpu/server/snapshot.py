@@ -17,7 +17,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import yaml
 
-from ..models.objects import Node, Pod, RawObject, ResourceTypes, Workload
+from ..models.objects import Node, Pod, PodDisruptionBudget, RawObject, ResourceTypes, Workload
 from ..utils import envknobs
 
 
@@ -101,7 +101,10 @@ RESOURCES: Tuple[ResourceSpec, ...] = (
     ResourceSpec("/api/v1/nodes", "nodes", Node.from_dict),
     ResourceSpec("/api/v1/pods", "pods", Pod.from_dict),
     ResourceSpec("/apis/apps/v1/daemonsets", "daemon_sets", Workload.from_dict),
-    ResourceSpec("/apis/policy/v1/poddisruptionbudgets", "pdbs", RawObject.from_dict, optional=True),
+    # PDBs decode TYPED (models.PodDisruptionBudget) so live-twin campaigns
+    # see real disruption budgets (ISSUE 13); still optional — minimal-RBAC
+    # clusters 403 the policy group like services/config_maps
+    ResourceSpec("/apis/policy/v1/poddisruptionbudgets", "pdbs", PodDisruptionBudget.from_dict, optional=True),
     ResourceSpec("/api/v1/services", "services", RawObject.from_dict, optional=True),
     ResourceSpec("/apis/storage.k8s.io/v1/storageclasses", "storage_classes", RawObject.from_dict, optional=True),
     ResourceSpec("/api/v1/persistentvolumeclaims", "pvcs", RawObject.from_dict, optional=True),
@@ -262,7 +265,7 @@ def cluster_from_kubeconfig(kubeconfig: str, master: Optional[str] = None) -> Re
     for ds in apps.list_daemon_set_for_all_namespaces(resource_version="0").items:
         rt.daemon_sets.append(Workload.from_dict(to_dict(ds)))
     for pdb in policy.list_pod_disruption_budget_for_all_namespaces(resource_version="0").items:
-        rt.pdbs.append(RawObject.from_dict(to_dict(pdb)))
+        rt.pdbs.append(PodDisruptionBudget.from_dict(to_dict(pdb)))
     for svc in core.list_service_for_all_namespaces(resource_version="0").items:
         rt.services.append(RawObject.from_dict(to_dict(svc)))
     for sc in storage.list_storage_class(resource_version="0").items:

@@ -223,6 +223,12 @@ _OBSERVABILITY = [
     Knob("OPENSIM_MEM_TICKER_S", "float", "10", "Low-rate memory watermark sampling cadence in seconds (0 disables the ticker).", _float(lo=0.0), section="observability"),
 ]
 
+_PLANNER = [
+    Knob("OPENSIM_CAMPAIGN_EXEC", "enum", "warm", "Campaign execution mode (docs/campaigns.md): `warm` = one full prepare + prepcache deltas; `cold` = per-step full prepare (the verification mode the delta-equality gate compares against).", _enum("warm", "cold"), on_error="raise", section="planner"),
+    Knob("OPENSIM_CAMPAIGN_MAX_STEPS", "int", "256", "Campaign spec safety bound: specs with more steps are rejected at parse time.", _int(lo=1), on_error="raise", section="planner"),
+    Knob("OPENSIM_CAMPAIGN_MAX_WAVES", "int", "64", "Drain-wave runaway bound: cordon/evict/reschedule passes per drain step (blocked-eviction retries included).", _int(lo=1), on_error="raise", section="planner"),
+]
+
 _DEBUG = [
     Knob("OPENSIM_LOCKWATCH", "flag", "", "`1`/`on`/`true` enables the runtime lock-order sanitizer (`make tsan` arms it in-process).", _flag, section="debug"),
     Knob("OPENSIM_LOCKWATCH_HOLD_MS", "float", "500", "Lockwatch hold-time outlier threshold in ms (floor 1; a typo degrades to the default with a warning).", _float(lo=1.0), section="debug"),
@@ -231,7 +237,7 @@ _DEBUG = [
     Knob("OPENSIM_PROBE_CACHE", "path", "", "Accelerator-probe verdict cache file (default: under XDG_RUNTIME_DIR/tmp).", None, section="debug"),
 ]
 
-for _knob in _ENGINE + _RESILIENCE + _SERVER + _OBSERVABILITY + _DEBUG:
+for _knob in _ENGINE + _RESILIENCE + _SERVER + _OBSERVABILITY + _PLANNER + _DEBUG:
     register(_knob)
 
 
@@ -244,6 +250,7 @@ _SECTIONS = (
     ("resilience", "Resilience (deadlines, breakers, faults, snapshot retry)"),
     ("server", "Serving (admission, workers, live twin, journal)"),
     ("observability", "Observability (tracing, capacity, memory)"),
+    ("planner", "Planner (campaigns)"),
     ("debug", "Debug & development"),
 )
 

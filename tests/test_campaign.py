@@ -1,0 +1,596 @@
+"""Campaign engine tests (ISSUE 13): spec validation, PDB carry semantics,
+warm-delta vs cold-prepare fingerprint equality, determinism across runs,
+step behavior, report parity, the REST surface, and lint rule OSL1501."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from opensim_tpu.models import fixtures as fx
+from opensim_tpu.models.objects import (
+    PodDisruptionBudget,
+    ResourceTypes,
+    object_from_dict,
+)
+from opensim_tpu.planner import campaign as cp
+from opensim_tpu.planner import report as report_mod
+
+
+def make_cluster(n_nodes=5, web=6, api=3, pdb_min_available=None, pdb_selector=None):
+    rt = ResourceTypes()
+    for i in range(n_nodes):
+        rt.nodes.append(fx.make_fake_node(f"n{i}", "8", "16Gi"))
+    if web:
+        rt.deployments.append(fx.make_fake_deployment("web", web, "1", "2Gi"))
+    if api:
+        rt.deployments.append(fx.make_fake_deployment("api", api, "500m", "1Gi"))
+    if pdb_min_available is not None:
+        rt.pdbs.append(
+            PodDisruptionBudget.from_dict(
+                {
+                    "apiVersion": "policy/v1",
+                    "kind": "PodDisruptionBudget",
+                    "metadata": {"name": "web-pdb", "namespace": "default"},
+                    "spec": {
+                        "minAvailable": pdb_min_available,
+                        "selector": pdb_selector or {"matchLabels": {"app": "web"}},
+                    },
+                }
+            )
+        )
+    return rt
+
+
+MIXED_STEPS = [
+    {"name": "upgrade", "type": "drain-wave", "nodes": ["n0", "n1"], "wave": 1},
+    {"name": "storm", "type": "reclaim-storm", "nodes": ["n2"]},
+    {
+        "name": "push",
+        "type": "deploy",
+        "app": {"name": "canary"},
+        "resources": [
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": "canary", "namespace": "default"},
+                "spec": {
+                    "replicas": 3,
+                    "selector": {"matchLabels": {"app": "canary"}},
+                    "template": {
+                        "metadata": {"labels": {"app": "canary"}},
+                        "spec": {
+                            "containers": [
+                                {
+                                    "name": "c",
+                                    "resources": {
+                                        "requests": {"cpu": "250m", "memory": "512Mi"}
+                                    },
+                                }
+                            ]
+                        },
+                    },
+                },
+            }
+        ],
+    },
+    {"name": "shrink", "type": "scale-down-check"},
+]
+
+
+# ---------------------------------------------------------------------------
+# PodDisruptionBudget model
+# ---------------------------------------------------------------------------
+
+
+def test_pdb_model_parses_and_computes_budgets():
+    pdb = PodDisruptionBudget.from_dict(
+        {
+            "kind": "PodDisruptionBudget",
+            "metadata": {"name": "p", "namespace": "ns"},
+            "spec": {"minAvailable": "50%", "selector": {"matchLabels": {"a": "b"}}},
+        }
+    )
+    assert pdb.key() == "ns/p"
+    assert pdb.selects()
+    assert pdb.disruptions_allowed(healthy=4, expected=4) == 2
+    assert pdb.disruptions_allowed(healthy=2, expected=4) == 0  # never negative
+    assert pdb.matches(
+        fx.make_fake_pod("x", "100m", "128Mi", fx.with_namespace("ns"), fx.with_labels({"a": "b"}))
+    )
+    assert not pdb.matches(
+        fx.make_fake_pod("x", "100m", "128Mi", fx.with_labels({"a": "b"}))
+    )  # wrong namespace
+
+    mu = PodDisruptionBudget.from_dict(
+        {"kind": "PodDisruptionBudget", "metadata": {"name": "m"},
+         "spec": {"maxUnavailable": 1, "selector": {"matchLabels": {"a": "b"}}}}
+    )
+    assert mu.disruptions_allowed(healthy=4, expected=4) == 1
+    # empty selector matches nothing; no spec fields = unlimited
+    empty = PodDisruptionBudget.from_dict(
+        {"kind": "PodDisruptionBudget", "metadata": {"name": "e"}, "spec": {}}
+    )
+    assert not empty.selects()
+    assert empty.disruptions_allowed(0, 0) > 1_000_000
+
+
+def test_pdb_typed_decode_everywhere():
+    # object_from_dict routes the kind to the typed model
+    obj = object_from_dict({"kind": "PodDisruptionBudget", "metadata": {"name": "x"}})
+    assert isinstance(obj, PodDisruptionBudget)
+    # the snapshot table decodes PDBs typed (live-twin campaigns see real
+    # budgets), still optional (403-tolerant) like services/config_maps
+    from opensim_tpu.server.snapshot import RESOURCE_BY_FIELD
+
+    spec = RESOURCE_BY_FIELD["pdbs"]
+    assert spec.optional
+    assert isinstance(spec.wrap({"kind": "PodDisruptionBudget"}), PodDisruptionBudget)
+
+
+# ---------------------------------------------------------------------------
+# spec validation: typed errors naming the step and field
+# ---------------------------------------------------------------------------
+
+
+def test_spec_validation_unknown_type():
+    with pytest.raises(cp.CampaignError) as ei:
+        cp.parse_steps([{"type": "explode"}])
+    # 1-based, matching the executed report's indices (baseline = 0)
+    assert ei.value.step == "1"
+    assert ei.value.field == "type"
+    assert "drain-wave" in str(ei.value)  # names the known types
+
+
+def test_spec_validation_unknown_field_names_step_and_field():
+    with pytest.raises(cp.CampaignError) as ei:
+        cp.parse_steps([{"name": "d", "type": "drain-wave", "nodes": ["n0"], "wavee": 2}])
+    assert ei.value.step == "1 (d)"
+    assert ei.value.field == "wavee"
+
+
+def test_step_numbers_match_report_indices():
+    """Spec step N's validation errors and its report row agree on N."""
+    steps = cp.parse_steps(MIXED_STEPS)
+    res = cp.run_campaign(make_cluster(), steps, mode="warm")
+    for step, rep in zip(steps, res.steps[1:]):
+        assert step.index == rep.index
+
+
+def test_drain_wave_cap_is_typed_error(monkeypatch):
+    """More planned waves than OPENSIM_CAMPAIGN_MAX_WAVES is a loud typed
+    error up front — never a silently-abandoned target tail."""
+    monkeypatch.setenv("OPENSIM_CAMPAIGN_MAX_WAVES", "2")
+    steps = cp.parse_steps(
+        [{"name": "big", "type": "drain-wave", "nodes": ["n0", "n1", "n2"], "wave": 1}]
+    )
+    with pytest.raises(cp.CampaignError) as ei:
+        cp.run_campaign(make_cluster(), steps, mode="warm")
+    assert ei.value.field == "wave"
+    assert "MAX_WAVES" in str(ei.value)
+
+
+def test_spec_validation_field_shapes():
+    with pytest.raises(cp.CampaignError) as ei:
+        cp.parse_steps([{"type": "drain-wave", "nodes": ["n0"], "wave": 0}])
+    assert ei.value.field == "wave"
+    with pytest.raises(cp.CampaignError) as ei:
+        cp.parse_steps([{"type": "drain-wave"}])
+    assert ei.value.field == "nodes"
+    with pytest.raises(cp.CampaignError) as ei:
+        cp.parse_steps([{"type": "scale", "workload": {"name": "w"}, "replicas": "many"}])
+    assert ei.value.field == "replicas"
+    with pytest.raises(cp.CampaignError) as ei:
+        cp.parse_steps([{"type": "add-nodes", "count": 2}])
+    assert ei.value.field == "template"
+    with pytest.raises(cp.CampaignError) as ei:
+        cp.parse_steps("not-a-list")
+    assert ei.value.field == "steps"
+
+
+def test_spec_validation_unknown_node_at_run_time():
+    steps = cp.parse_steps([{"type": "drain-wave", "nodes": ["ghost"]}])
+    with pytest.raises(cp.CampaignError) as ei:
+        cp.run_campaign(make_cluster(), steps, mode="warm")
+    assert ei.value.field == "nodes"
+    assert "ghost" in str(ei.value)
+
+
+def test_spec_max_steps_bound(monkeypatch):
+    monkeypatch.setenv("OPENSIM_CAMPAIGN_MAX_STEPS", "2")
+    with pytest.raises(cp.CampaignError) as ei:
+        cp.parse_steps([{"type": "scale-down-check"}] * 3)
+    assert ei.value.field == "steps"
+
+
+# ---------------------------------------------------------------------------
+# determinism + warm-vs-cold delta gate
+# ---------------------------------------------------------------------------
+
+
+def test_campaign_deterministic_across_runs():
+    steps = cp.parse_steps(MIXED_STEPS)
+    r1 = cp.run_campaign(make_cluster(pdb_min_available=4), steps, mode="warm")
+    r2 = cp.run_campaign(make_cluster(pdb_min_available=4), cp.parse_steps(MIXED_STEPS), mode="warm")
+    assert [s.fingerprint for s in r1.steps] == [s.fingerprint for s in r2.steps]
+    assert r1.fingerprint == r2.fingerprint
+
+
+def test_campaign_warm_delta_equals_cold_prepare():
+    """The delta-execution acceptance gate: a mixed 4-step campaign's step
+    fingerprints are bit-identical between warm (one full prepare +
+    prepcache deltas) and cold (per-step full prepare) execution."""
+    steps = cp.parse_steps(MIXED_STEPS)
+    warm = cp.run_campaign(make_cluster(pdb_min_available=4), steps, mode="warm")
+    cold = cp.run_campaign(
+        make_cluster(pdb_min_available=4), cp.parse_steps(MIXED_STEPS), mode="cold"
+    )
+    assert warm.full_prepares == 1  # the contract: ONE full prepare per campaign
+    assert cold.full_prepares > 1
+    assert [s.fingerprint for s in warm.steps] == [s.fingerprint for s in cold.steps]
+    assert warm.fingerprint == cold.fingerprint
+    # the campaign actually did lifecycle work
+    assert warm.steps[1].evicted > 0
+    assert warm.steps[3].pods_added == 3
+    assert len(warm.steps) == 5
+
+
+def test_campaign_warm_cold_with_daemonsets_and_add_nodes():
+    """DaemonSet splice order (warm extend_with_nodes) must match the cold
+    expansion order, and added nodes get run-stable ids."""
+    def cluster():
+        rt = make_cluster(n_nodes=4, web=4, api=0)
+        rt.daemon_sets.append(fx.make_fake_daemon_set("agent", "100m", "128Mi"))
+        return rt
+
+    raw = [
+        {"type": "reclaim-storm", "nodes": ["n1"]},
+        {"type": "add-nodes", "count": 2, "template": {"node": "n0"}},
+    ]
+    warm = cp.run_campaign(cluster(), cp.parse_steps(raw), mode="warm")
+    cold = cp.run_campaign(cluster(), cp.parse_steps(raw), mode="cold")
+    assert [s.fingerprint for s in warm.steps] == [s.fingerprint for s in cold.steps]
+    add = warm.steps[2]
+    assert add.nodes_added == ["added#0", "added#1"]  # run-stable ids
+    # the new nodes' DaemonSet pods landed (one per added node)
+    assert add.rescheduled >= 2
+
+
+# ---------------------------------------------------------------------------
+# PDB carry semantics
+# ---------------------------------------------------------------------------
+
+
+def test_pdb_blocked_eviction_never_dropped():
+    """minAvailable == replicas: zero disruptions allowed, ever. The drain
+    must report the blocked eviction loudly and leave the node cordoned —
+    never silently drop the eviction or the pod."""
+    cluster = make_cluster(n_nodes=3, web=3, api=0, pdb_min_available=3)
+    steps = cp.parse_steps([{"type": "drain-wave", "nodes": ["n0"], "wave": 1}])
+    res = cp.run_campaign(cluster, steps, mode="warm")
+    s = res.steps[1]
+    assert s.evicted == 0
+    assert s.blocked, "blocked eviction must be reported"
+    assert s.blocked[0]["pdb"] == "default/web-pdb"
+    assert s.nodes_cordoned == ["n0"]
+    assert s.nodes_drained == []  # the node never emptied
+    assert s.pdb_allowed["default/web-pdb"] == 0
+    # the pod is still alive and still placed (phase never lost)
+    cap = s.capacity
+    assert cap["pods_bound"] == 3 and cap["pods_pending"] == 0
+
+
+def test_pdb_budget_recovers_across_waves():
+    """minAvailable N-1: one disruption at a time. Draining two nodes must
+    proceed wave by wave, deferring blocked evictions to the next wave as
+    the budget recovers (the rescheduled pod turns healthy again)."""
+    cluster = make_cluster(n_nodes=4, web=4, api=0, pdb_min_available=3)
+    steps = cp.parse_steps([{"type": "drain-wave", "nodes": ["n0", "n1"], "wave": 1}])
+    res = cp.run_campaign(cluster, steps, mode="warm")
+    s = res.steps[1]
+    assert not s.blocked  # everything eventually evicted
+    assert sorted(s.nodes_drained) == ["n0", "n1"]
+    assert s.pdb_spent["default/web-pdb"] == s.evicted
+    assert s.waves >= 2  # the carry forced extra passes
+    cold = cp.run_campaign(
+        make_cluster(n_nodes=4, web=4, api=0, pdb_min_available=3),
+        cp.parse_steps([{"type": "drain-wave", "nodes": ["n0", "n1"], "wave": 1}]),
+        mode="cold",
+    )
+    assert res.fingerprint == cold.fingerprint
+
+
+def test_reclaim_storm_ignores_pdbs():
+    """Budgets guard voluntary evictions, not node failure: a reclaim storm
+    displaces PDB-guarded pods regardless."""
+    cluster = make_cluster(n_nodes=3, web=3, api=0, pdb_min_available=3)
+    steps = cp.parse_steps([{"type": "reclaim-storm", "nodes": ["n0"]}])
+    res = cp.run_campaign(cluster, steps, mode="warm")
+    s = res.steps[1]
+    assert s.evicted >= 1 and not s.blocked
+    assert s.nodes_removed == ["n0"]
+
+
+# ---------------------------------------------------------------------------
+# step behavior
+# ---------------------------------------------------------------------------
+
+
+def test_scale_step_down_and_up():
+    cluster = make_cluster(n_nodes=4, web=6, api=0)
+    raw = [
+        {"type": "scale", "workload": {"kind": "Deployment", "name": "web"}, "replicas": 2},
+        {"type": "scale", "workload": {"kind": "Deployment", "name": "web"}, "replicas": 5},
+    ]
+    res = cp.run_campaign(cluster, cp.parse_steps(raw), mode="warm")
+    down, up = res.steps[1], res.steps[2]
+    assert down.deleted == 4 and down.capacity["pods_bound"] == 2
+    assert up.pods_added == 3 and up.capacity["pods_bound"] == 5
+    cold = cp.run_campaign(
+        make_cluster(n_nodes=4, web=6, api=0), cp.parse_steps(raw), mode="cold"
+    )
+    assert res.fingerprint == cold.fingerprint
+
+
+def test_scale_up_workload_deployed_in_campaign():
+    """A later scale step can grow an app a deploy step introduced (the
+    deployed workloads join the scale lookup book)."""
+    raw = list(MIXED_STEPS[2:3]) + [  # the canary deploy (3 replicas)
+        {"type": "scale", "workload": {"kind": "Deployment", "name": "canary"}, "replicas": 6}
+    ]
+    res = cp.run_campaign(make_cluster(n_nodes=4, web=2, api=0), cp.parse_steps(raw), mode="warm")
+    assert res.steps[1].pods_added == 3
+    assert res.steps[2].pods_added == 3  # scale 3 -> 6
+    assert res.steps[2].capacity["pods_bound"] == 2 + 6
+
+
+def test_scale_unknown_workload_is_typed_error():
+    steps = cp.parse_steps(
+        [{"type": "scale", "workload": {"kind": "Deployment", "name": "ghost"}, "replicas": 9}]
+    )
+    with pytest.raises(cp.CampaignError) as ei:
+        cp.run_campaign(make_cluster(), steps, mode="warm")
+    assert ei.value.field == "workload"
+
+
+def test_add_nodes_recovers_pending_pods():
+    """Storm shrinks the cluster below fit; add-nodes must re-place the
+    pending pods (the autoscaler-response scenario)."""
+    cluster = make_cluster(n_nodes=3, web=9, api=0)  # ~3 per node at 1 cpu... fits
+    raw = [
+        {"type": "reclaim-storm", "nodes": ["n1", "n2"]},
+        {"type": "add-nodes", "count": 2, "template": {"node": "n0"}},
+    ]
+    res = cp.run_campaign(cluster, cp.parse_steps(raw), mode="warm")
+    storm, grow = res.steps[1], res.steps[2]
+    assert storm.unschedulable, "the storm must overflow the remaining node"
+    assert grow.capacity["pods_pending"] == 0, "add-nodes must re-place the pending pods"
+    assert not grow.unschedulable
+
+
+def test_scale_down_check_is_pure():
+    cluster = make_cluster(n_nodes=4, web=4, api=2, pdb_min_available=4)
+    raw = [{"type": "scale-down-check"}, {"type": "scale-down-check"}]
+    res = cp.run_campaign(cluster, cp.parse_steps(raw), mode="warm")
+    s1, s2 = res.steps[1], res.steps[2]
+    assert s1.fingerprint == res.steps[0].fingerprint == s2.fingerprint  # no mutation
+    assert s1.checks and [c["node"] for c in s1.checks] == [c["node"] for c in s2.checks]
+    assert all(set(c) >= {"node", "removable", "pods", "unschedulable", "pdbBlocked"} for c in s1.checks)
+    # web pods are pinned at minAvailable: their nodes must be pdb-blocked
+    assert any(c["pdbBlocked"] for c in s1.checks)
+
+
+def test_defrag_step_executes_removable_plan():
+    # half-empty cluster: defrag should find and drain at least one node
+    cluster = make_cluster(n_nodes=5, web=3, api=0)
+    res = cp.run_campaign(
+        cluster, cp.parse_steps([{"type": "defrag", "maxNodes": 2, "wave": 1}]), mode="warm"
+    )
+    s = res.steps[1]
+    assert s.checks  # the plan's verdicts are reported
+    assert s.nodes_drained, "an underloaded cluster must yield at least one drain"
+    assert s.capacity["nodes"] == 5 - len(s.nodes_drained)
+    assert not s.unschedulable
+
+
+def test_from_journal_step(tmp_path):
+    """A recorded generation range replays through the campaign apply path:
+    bound adds force-bind, unbound adds schedule, deletes free capacity."""
+    from opensim_tpu.server.journal import Journal
+
+    jdir = str(tmp_path / "journal")
+    j = Journal(jdir, policy={"fsync": "off"})
+    try:
+        rv = 100
+        gen = 10
+        for i in range(4):
+            rv += 1
+            gen += 1
+            pod = {
+                "apiVersion": "v1", "kind": "Pod",
+                "metadata": {"name": f"j-{i}", "namespace": "default",
+                             "resourceVersion": str(rv)},
+                "spec": {"containers": [
+                    {"name": "c", "resources": {"requests": {"cpu": "500m", "memory": "1Gi"}}}
+                ]},
+                "status": {"phase": "Pending"},
+            }
+            if i < 2:
+                pod["spec"]["nodeName"] = "n1"
+                pod["status"]["phase"] = "Running"
+            j.record_event("pods", "ADDED", pod, gen)
+        rv += 1
+        gen += 1
+        j.record_event(
+            "pods", "DELETED",
+            {"metadata": {"name": "j-0", "namespace": "default", "resourceVersion": str(rv)}},
+            gen,
+        )
+    finally:
+        j.close()
+
+    cluster = make_cluster(n_nodes=3, web=2, api=0)
+    raw = [{"type": "from-journal", "journal": jdir, "fromGeneration": 10, "toGeneration": 15}]
+    res = cp.run_campaign(cluster, cp.parse_steps(raw), mode="warm")
+    s = res.steps[1]
+    assert s.journal_events == 5
+    # NET effect of the range: j-0 was added then deleted inside the
+    # window, so it never materializes (3 admissions, no deletion of a
+    # pre-existing pod)
+    assert s.pods_added == 3 and s.deleted == 0
+    # 3 journal pods survive: j-1 bound to its recorded node, j-2/j-3 scheduled
+    assert s.capacity["pods_bound"] == 2 + 3 and not s.unschedulable
+    cold = cp.run_campaign(
+        make_cluster(n_nodes=3, web=2, api=0), cp.parse_steps(raw), mode="cold"
+    )
+    assert res.fingerprint == cold.fingerprint
+
+
+def test_from_journal_node_modify_reported_not_silent(tmp_path):
+    """A MODIFIED event for a node the campaign already tracks is outside
+    the delta envelope (in-place capacity change): it must be reported
+    loudly in the step output, never silently replayed with stale alloc."""
+    from opensim_tpu.server.journal import Journal
+
+    jdir = str(tmp_path / "journal")
+    j = Journal(jdir, policy={"fsync": "off"})
+    try:
+        j.record_event(
+            "nodes", "MODIFIED",
+            fx.make_fake_node("n0", "4", "8Gi").raw | {"metadata": {"name": "n0", "resourceVersion": "7"}},
+            11,
+        )
+    finally:
+        j.close()
+    steps = cp.parse_steps([{"type": "from-journal", "journal": jdir, "fromGeneration": 10}])
+    res = cp.run_campaign(make_cluster(n_nodes=2, web=1, api=0), steps, mode="warm")
+    s = res.steps[1]
+    assert s.journal_events == 1
+    assert any("MODIFIED skipped" in u["reason"] for u in s.unschedulable)
+
+
+def test_from_journal_generation_window():
+    steps = cp.parse_steps(
+        [{"type": "from-journal", "journal": "/nonexistent", "fromGeneration": 1}]
+    )
+    with pytest.raises(cp.CampaignError) as ei:
+        cp.run_campaign(make_cluster(n_nodes=2, web=1, api=0), steps, mode="warm")
+    assert ei.value.field == "journal"
+
+
+# ---------------------------------------------------------------------------
+# report parity + surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_campaign_report_parity():
+    """The JSON ``table`` section and the text renderer serialize the SAME
+    rows (the byte-parity contract every report table follows)."""
+    import io
+
+    res = cp.run_campaign(
+        make_cluster(pdb_min_available=4), cp.parse_steps(MIXED_STEPS), mode="warm"
+    )
+    d = res.to_dict()
+    rows = report_mod.campaign_step_rows(d["steps"])
+    assert [d["table"]["header"]] + d["table"]["rows"] == rows
+    out = io.StringIO()
+    report_mod.render_campaign(d, out)
+    text = out.getvalue()
+    # every cell of every row appears verbatim in the rendered table
+    for row in rows:
+        for cell in row:
+            assert cell == "" or cell in text
+    assert d["fingerprint"] in text
+    # round-trips as JSON
+    json.loads(json.dumps(d))
+
+
+def test_drain_plan_rows_parity():
+    from opensim_tpu.planner.defrag import DrainPlan
+
+    plans = [
+        DrainPlan(node="n0", feasible=True, unscheduled=0, freed_cpu_milli=8000, freed_memory=2**34),
+        DrainPlan(node="n1", feasible=False, unscheduled=3, freed_cpu_milli=4000, freed_memory=2**33),
+    ]
+    rows = report_mod.drain_plan_rows(plans)
+    assert rows[0] == ["Node", "Drainable", "Unscheduled", "Freed CPU", "Freed Memory"]
+    assert rows[1][0] == "n0" and rows[1][1] == "√"
+    assert rows[2][1] == "" and rows[2][2] == "3"
+
+
+def test_rest_campaign_endpoint():
+    from opensim_tpu.server.rest import SimonServer
+
+    server = SimonServer(base_cluster=make_cluster(pdb_min_available=4))
+    code, body = server.run_campaign({"name": "t", "steps": MIXED_STEPS})
+    assert code == 200
+    assert body["fullPrepares"] == 1
+    assert len(body["steps"]) == 5
+    assert body["table"]["rows"]
+    # typed validation errors surface as 400 naming the step/field
+    code, body = server.run_campaign({"steps": [{"type": "explode"}]})
+    assert code == 400 and body["field"] == "type" and body["step"] == "1"
+    code, body = server.run_campaign({"steps": MIXED_STEPS, "mode": "tepid"})
+    assert code == 400 and body["field"] == "mode"
+
+
+def test_campaign_env_knobs_registered():
+    from opensim_tpu.utils import envknobs
+
+    for name in (
+        "OPENSIM_CAMPAIGN_EXEC",
+        "OPENSIM_CAMPAIGN_MAX_STEPS",
+        "OPENSIM_CAMPAIGN_MAX_WAVES",
+    ):
+        assert name in envknobs.KNOBS
+        envknobs.value(name)  # default parses through its validator
+
+
+# ---------------------------------------------------------------------------
+# OSL1501 campaign-step-registry
+# ---------------------------------------------------------------------------
+
+
+def _codes(src, path="opensim_tpu/server/rest.py"):
+    from opensim_tpu.analysis import lint_source
+
+    return [f.code for f in lint_source(textwrap.dedent(src), path=path, rules=["campaign-step-registry"])]
+
+
+def test_osl1501_fires_on_adhoc_dispatch():
+    assert _codes('if step == "drain-wave":\n    go()\n') == ["OSL1501"]
+    assert _codes('if kind in ("reclaim-storm", "scale-down-check"):\n    go()\n') == [
+        "OSL1501",
+        "OSL1501",
+    ]
+    assert _codes("register_step('mine')(cls)\n") == ["OSL1501"]
+
+
+def test_osl1501_quiet_on_legit_uses():
+    # dict literals (specs under test, bench scenarios) are not dispatch
+    assert _codes('spec = {"type": "drain-wave", "wave": 1}\n') == []
+    # the generic short names stay usable for REST kinds / CLI commands
+    assert _codes('if kind == "deploy" or cmd == "defrag":\n    go()\n') == []
+    # the registry module itself is excluded
+    assert (
+        _codes('if t == "drain-wave":\n    pass\n', path="opensim_tpu/planner/campaign.py") == []
+    )
+
+
+def test_osl1501_suppression_and_sync():
+    assert _codes('if s == "from-journal":  # opensim-lint: disable=campaign-step-registry\n    go()\n') == []
+    from opensim_tpu.analysis.rules_campaign import DISPATCH_LITERALS
+
+    # the rule's literal set tracks the live registry (subset: the short
+    # generic names are deliberately excluded from literal matching)
+    assert DISPATCH_LITERALS <= set(cp.STEP_TYPES)
+
+
+def test_repo_swept_clean_for_osl1501():
+    from opensim_tpu.analysis import lint_paths
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings = lint_paths([os.path.join(repo, "opensim_tpu")], rules=["campaign-step-registry"])
+    assert findings == []

@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Campaign-engine smoke gate (``make campaign-smoke``, part of
+``make verify``) — the ISSUE 13 acceptance, end to end in one process:
+
+1. start the canned stub apiserver and a watch-mode REST server against it
+   (live twin + capacity engine + a real PodDisruptionBudget);
+2. POST a 3-step campaign (PDB-aware drain wave + reclaim storm +
+   scale-down check) to ``/api/campaign`` and assert it runs against the
+   twin with EXACTLY ONE full prepare (the campaign's own; the event
+   stream and scoring stay O(changes)/host-side);
+3. assert the capacity scores move across steps (nodes drop, utilization
+   rises), the PDB ledger charged the drain's evictions, and the
+   scale-down verdicts carry PDB blocking;
+4. assert report text/JSON parity: the response's ``table`` section is
+   byte-equal to the shared ``planner/report.campaign_step_rows`` builder
+   re-run over the serialized steps;
+5. run ``bench.py --config campaign`` at a small size and assert the row
+   parses with ``verified_vs_cold`` true (the warm-delta vs cold-prepare
+   fingerprint gate, computed in-row).
+
+Exit 0 on success; 1 with a one-line reason per failed check.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fail(msg: str) -> int:
+    print(f"campaign-smoke: FAIL: {msg}")
+    return 1
+
+
+def _pod(name, node="", cpu="1", mem="2Gi", labels=None):
+    d = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default", "labels": labels or {}},
+        "spec": {
+            "containers": [
+                {"name": "c", "resources": {"requests": {"cpu": cpu, "memory": mem}}}
+            ]
+        },
+        "status": {"phase": "Running"},
+    }
+    if node:
+        d["spec"]["nodeName"] = node
+    return d
+
+
+def main() -> int:
+    from http.server import ThreadingHTTPServer
+
+    from opensim_tpu.models import fixtures as fx
+    from opensim_tpu.planner import report as report_mod
+    from opensim_tpu.server import rest
+    from opensim_tpu.server.stubapi import StubApiServer
+    from opensim_tpu.server.watch import RestWatchSource, WatchSupervisor
+    from opensim_tpu.utils.trace import PREP_STATS
+
+    stub = StubApiServer(bookmark_interval_s=0.1).start()
+    stub.seed(
+        "/api/v1/nodes",
+        [fx.make_fake_node(f"n{i}", "8", "16Gi").raw for i in range(4)],
+    )
+    # web pods guarded by a PDB (minAvailable 2 of 3 -> one disruption at a
+    # time), plus unguarded fillers
+    stub.seed(
+        "/api/v1/pods",
+        [
+            _pod("web-0", node="n0", labels={"app": "web"}),
+            _pod("web-1", node="n0", labels={"app": "web"}),
+            _pod("web-2", node="n1", labels={"app": "web"}),
+            _pod("fill-0", node="n1", cpu="500m"),
+            _pod("fill-1", node="n2", cpu="500m"),
+        ],
+    )
+    stub.seed(
+        "/apis/policy/v1/poddisruptionbudgets",
+        [
+            {
+                "apiVersion": "policy/v1",
+                "kind": "PodDisruptionBudget",
+                "metadata": {"name": "web-pdb", "namespace": "default"},
+                "spec": {"minAvailable": 2, "selector": {"matchLabels": {"app": "web"}}},
+            }
+        ],
+    )
+    for p in (
+        "/apis/apps/v1/daemonsets", "/api/v1/services",
+        "/apis/storage.k8s.io/v1/storageclasses",
+        "/api/v1/persistentvolumeclaims", "/api/v1/configmaps",
+    ):
+        stub.seed(p, [])
+    tmp = tempfile.mkdtemp(prefix="campaign-smoke-")
+    kc = stub.kubeconfig(tmp)
+
+    policy = {"stale_s": 5.0, "resync_s": 0.0, "reconnects": 3, "backoff_s": 0.02}
+    sup = WatchSupervisor(RestWatchSource(kc, read_timeout_s=5.0), policy=policy)
+    server = rest.SimonServer(kubeconfig=kc, watch=sup)
+    sup.prep_cache = server.prep_cache
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), rest.make_handler(server))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def post(path, body):
+        req = urllib.request.Request(
+            f"{base}{path}", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return json.load(resp)
+
+    try:
+        if not sup.start(wait_s=15.0):
+            return fail("twin did not sync against the stub apiserver")
+        # settle the serving-path bootstrap prepares before accounting
+        with urllib.request.urlopen(f"{base}/api/cluster/report", timeout=120) as resp:
+            json.load(resp)
+
+        full0 = PREP_STATS.counts.get("full", 0)
+        t0 = time.monotonic()
+        result = post(
+            "/api/campaign",
+            {
+                "name": "smoke",
+                "steps": [
+                    {"name": "upgrade-n0", "type": "drain-wave", "nodes": ["n0"], "wave": 1},
+                    {"name": "spot", "type": "reclaim-storm", "nodes": ["n2"]},
+                    {"name": "shrink?", "type": "scale-down-check"},
+                ],
+            },
+        )
+        wall = time.monotonic() - t0
+        full_delta = PREP_STATS.counts.get("full", 0) - full0
+
+        # --- exactly one full prepare for the whole campaign ---------------
+        if full_delta != 1:
+            return fail(f"campaign paid {full_delta} full prepares (contract: exactly 1)")
+        if result.get("fullPrepares") != 1:
+            return fail(f"result reports fullPrepares={result.get('fullPrepares')} != 1")
+
+        steps = result.get("steps") or []
+        if len(steps) != 4:  # baseline + 3 spec steps
+            return fail(f"expected 4 scored steps, got {len(steps)}")
+
+        # --- capacity gauges move across steps ------------------------------
+        caps = [s.get("capacity") or {} for s in steps]
+        if caps[0].get("nodes") != 4 or caps[-1].get("nodes") != 2:
+            return fail(
+                f"node trajectory wrong: {[c.get('nodes') for c in caps]} "
+                "(expected 4 -> ... -> 2 after drain + storm)"
+            )
+        u0 = (caps[0].get("utilization") or {}).get("cpu", 0.0)
+        u2 = (caps[2].get("utilization") or {}).get("cpu", 0.0)
+        if not u2 > u0:
+            return fail(f"cpu utilization did not rise across the drain+storm ({u0} -> {u2})")
+        if any("fragmentation" not in c or "spread" not in c for c in caps):
+            return fail("per-step capacity samples missing fragmentation/spread scores")
+        if any(not s.get("headroomFit") for s in steps):
+            return fail("per-step headroom scores missing")
+
+        # --- PDB ledger charged the drain -----------------------------------
+        drain = steps[1]
+        if drain.get("pdbSpent", {}).get("default/web-pdb", 0) < 1:
+            return fail(f"drain wave consumed no PDB budget: {drain.get('pdbSpent')}")
+        if drain.get("evicted", 0) < 2:
+            return fail(f"drain wave evicted {drain.get('evicted')} pods (expected >= 2)")
+        if drain.get("blocked"):
+            return fail(f"drain left blocked evictions unexpectedly: {drain['blocked']}")
+        checks = steps[3].get("checks") or []
+        if not checks:
+            return fail("scale-down-check produced no verdicts")
+        if not any(c.get("pdbBlocked") for c in checks) and not all(
+            c.get("removable") is not None for c in checks
+        ):
+            return fail(f"scale-down verdicts malformed: {checks}")
+
+        # --- text/JSON parity ------------------------------------------------
+        rows = report_mod.campaign_step_rows(steps)
+        table = result.get("table") or {}
+        if [table.get("header")] + list(table.get("rows") or []) != rows:
+            return fail("response table is not byte-equal to campaign_step_rows over the steps")
+
+        # --- bench row -------------------------------------------------------
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        bench = subprocess.run(
+            [sys.executable, "bench.py", "--config", "campaign",
+             "--pods", "300", "--nodes", "24", "--no-warmup"],
+            capture_output=True, text=True, timeout=560, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        if bench.returncode != 0:
+            return fail(f"bench.py --config campaign failed: {bench.stderr[-500:]}")
+        row = json.loads(bench.stdout.strip().splitlines()[-1])
+        for key in ("steps_per_s", "rescheduled_per_s", "full_prepares", "fingerprint"):
+            if key not in row:
+                return fail(f"bench campaign row missing {key!r}: {row}")
+        if row.get("full_prepares") != 1:
+            return fail(f"bench campaign paid {row.get('full_prepares')} full prepares")
+        if row.get("verified_vs_cold") is not True:
+            return fail("bench campaign row did not verify warm-delta vs cold fingerprints")
+
+        print(
+            "campaign-smoke: ok — 3-step campaign on the live twin in "
+            f"{wall:.2f}s with exactly 1 full prepare, nodes "
+            f"{[c.get('nodes') for c in caps]}, cpu util {u0:.3f} -> {u2:.3f}, "
+            f"PDB spend {drain.get('pdbSpent')}, {len(checks)} scale-down "
+            f"verdict(s), table parity ok, bench row "
+            f"{row['steps_per_s']} steps/s verified vs cold"
+        )
+        return 0
+    finally:
+        sup.stop()
+        httpd.shutdown()
+        stub.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -571,6 +571,56 @@ def gen_configs():
     })
 
 
+def gen_campaign():
+    """A demo lifecycle campaign (docs/campaigns.md): deploy a PDB-guarded
+    canary, drain a worker one wave at a time, lose the spot pool at once,
+    regrow from the newnode template, then ask whether the cluster could
+    shrink back down safely."""
+    write("campaign.yaml", {
+        "apiVersion": "simon/v1alpha1", "kind": "Campaign",
+        "metadata": {"name": "demo-lifecycle"},
+        "spec": {
+            "cluster": {"customConfig": "cluster/demo"},
+            "steps": [
+                {
+                    "name": "canary", "type": "deploy",
+                    "app": {"name": "canary"},
+                    "resources": [
+                        {
+                            "apiVersion": "apps/v1", "kind": "Deployment",
+                            "metadata": {"name": "canary", "namespace": "default"},
+                            "spec": {
+                                "replicas": 4,
+                                "selector": {"matchLabels": {"app": "canary"}},
+                                "template": {
+                                    "metadata": {"labels": {"app": "canary"}},
+                                    "spec": {"containers": [{
+                                        "name": "web",
+                                        "image": "registry.example.com/canary:1.0",
+                                        "resources": {"requests": {"cpu": "500m", "memory": "1Gi"}},
+                                    }]},
+                                },
+                            },
+                        },
+                        {
+                            "apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+                            "metadata": {"name": "canary-pdb", "namespace": "default"},
+                            "spec": {
+                                "minAvailable": 3,
+                                "selector": {"matchLabels": {"app": "canary"}},
+                            },
+                        },
+                    ],
+                },
+                {"name": "upgrade-workers", "type": "drain-wave", "nodes": ["worker-1"], "wave": 1},
+                {"name": "spot-storm", "type": "reclaim-storm", "nodes": ["worker-2"]},
+                {"name": "regrow", "type": "add-nodes", "count": 2, "template": {"path": "newnode/demo"}},
+                {"name": "shrink-check", "type": "scale-down-check"},
+            ],
+        },
+    })
+
+
 def main():
     gen_cluster_demo()
     gen_cluster_gpushare()
@@ -582,6 +632,7 @@ def main():
     gen_chart()
     gen_newnode()
     gen_configs()
+    gen_campaign()
     print(f"example tree regenerated under {os.path.abspath(ROOT)}", file=sys.stderr)
 
 

@@ -6,9 +6,14 @@ all: test
 test:
 	python -m pytest tests/ -q
 
-# opensim-lint: repo-specific AST correctness analyzer (docs/static-analysis.md)
+# opensim-lint: repo-specific static analyzer (docs/static-analysis.md) —
+# 22 rules incl. the interprocedural dataflow pack (OSL16xx), result-cached
+# by content hash (.lint/cache.json: unchanged files skip their rules), a
+# SARIF artifact at a stable path for CI upload, and the detector-awake
+# corpus gate (every rule must fire on its fixture, stay quiet on the
+# clean twin). `simon lint` is the same engine without make.
 lint:
-	python -m opensim_tpu.analysis opensim_tpu
+	python -m opensim_tpu.analysis opensim_tpu --cache .lint/cache.json --sarif-out .lint/opensim-lint.sarif --corpus tests/lint_corpus
 
 # strict on the typed core (engine/prepcache, encoding/state, models/quantity);
 # skipped with a notice when mypy is not in the image — the CI gate still

@@ -34,10 +34,24 @@ Rules (short name = suppression id; see docs/static-analysis.md):
                               outside utils/envknobs.py
     OSL1501 campaign-step-registry  campaign step-type dispatch outside
                               planner/campaign.py's STEP_TYPES registry
+    OSL1601 jit-impurity      side effect (I/O, clock/RNG, host sync,
+                              state write) in a function transitively
+                              reachable from a jit-traced region
+    OSL1602 tracer-leak       traced value stored into state that
+                              outlives the trace
+    OSL1603 input-taint       untrusted input (HTTP/CLI/YAML) reaches a
+                              filesystem/subprocess sink without a
+                              registered @sanitizer validator
+    OSL1604 abi-parity        C++/Python ABI declarations drifted
+                              (ScanArgs layout, abi version, serial wire)
 
 The OSL12xx family is whole-program (symbol table + call graph + lock
 graph across all linted files); its runtime counterpart is the lock-order
 sanitizer ``analysis/lockwatch.py`` (`make tsan`, ``OPENSIM_LOCKWATCH=1``).
+The OSL16xx family runs on the interprocedural dataflow engine
+(``analysis/dataflow.py``: per-function CFGs + reaching definitions,
+call-graph effect fixpoint, forward taint lattice) and the cross-language
+ABI parser (``analysis/abi.py``); see docs/static-analysis.md.
 """
 
 from .core import (  # noqa: F401
@@ -60,6 +74,7 @@ from . import (  # noqa: F401,E402
     rules_cache,
     rules_campaign,
     rules_concurrency,
+    rules_dataflow,
     rules_determinism,
     rules_dtype,
     rules_env,

@@ -38,6 +38,19 @@ def pyproject_defaults(path: str = "pyproject.toml") -> Dict[str, List[str]]:
     return out
 
 
+def _checked_flag_paths(args):
+    """Validate the path-valued flags (registered validators, OSL1603);
+    raises ValueError with the usual one-liner text."""
+    from ..utils.validate import user_path
+
+    cache_path = None
+    if args.cache and not args.no_cache:
+        cache_path = user_path(args.cache, label="--cache")
+    sarif_out = user_path(args.sarif_out or "", label="--sarif-out", allow_empty=True)
+    corpus_dir = user_path(args.corpus or "", label="--corpus", allow_empty=True)
+    return cache_path, sarif_out, corpus_dir
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="opensim-lint",
@@ -63,6 +76,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="comma-separated rule names/codes to run (default: all)",
     )
     ap.add_argument("--list-rules", action="store_true", help="list rules and exit")
+    ap.add_argument(
+        "--cache",
+        metavar="PATH",
+        default=None,
+        help="content-hash result cache (unchanged files skip their rules; "
+        "default .lint/cache.json under make lint, off otherwise)",
+    )
+    ap.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="disable the result cache even if --cache was given",
+    )
+    ap.add_argument(
+        "--sarif-out",
+        metavar="PATH",
+        default=None,
+        help="ALSO write SARIF 2.1.0 to this path (stable artifact for CI "
+        "upload), independent of --format",
+    )
+    ap.add_argument(
+        "--corpus",
+        metavar="DIR",
+        default=None,
+        help="after linting, run the detector-awake corpus gate over DIR "
+        "(every registered rule must fire on its fixture and stay quiet "
+        "on the clean variant)",
+    )
     ap.add_argument(
         "--check-typed-core",
         action="store_true",
@@ -96,17 +136,45 @@ def main(argv: Optional[List[str]] = None) -> int:
         rules = cfg.get("rules") or None
     paths = args.paths or cfg.get("paths") or ["opensim_tpu"]
     fmt = args.format or ("json" if args.json else "human")
+    try:
+        cache_path, sarif_out, corpus_dir = _checked_flag_paths(args)
+    except ValueError as e:
+        print(f"opensim-lint: {e}", file=sys.stderr)
+        return 2
     stats: dict = {}
-    findings = lint_paths(paths, rules=rules, stats=stats)
+    findings = lint_paths(paths, rules=rules, stats=stats, cache_path=cache_path)
+    if sarif_out:
+        out_dir = os.path.dirname(sarif_out)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+        with open(sarif_out, "w", encoding="utf-8") as fh:
+            fh.write(render_sarif(findings))
     if fmt == "json":
         print(render_json(findings))
     elif fmt == "sarif":
         print(render_sarif(findings))
     else:
         # total lint wall time rides the `make lint` output: every file is
-        # parsed once and the AST shared across all rules
+        # parsed once and the AST shared across all rules (and, with
+        # --cache, unchanged files skip their rules entirely)
         print(render_human(findings, stats=stats))
-    return 1 if findings else 0
+    rc = 1 if findings else 0
+    if corpus_dir:
+        from .corpus import check_corpus, corpus_inventory
+
+        problems = check_corpus(corpus_dir)
+        if problems:
+            for p in problems:
+                print(f"lint-corpus: {p}")
+            rc = 1
+        else:
+            inv = corpus_inventory(corpus_dir)
+            n_fix = sum(len(v) for e in inv.values() for v in e.values())
+            print(
+                f"lint-corpus: {len(RULES)} rules, {n_fix} fixtures, "
+                "all detectors awake"
+            )
+    return rc
 
 
 if __name__ == "__main__":

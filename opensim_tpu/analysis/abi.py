@@ -1,0 +1,338 @@
+"""Cross-language ABI layout parsing for the abi-parity pass (OSL1604).
+
+The C++ scan engine's ``ScanArgs`` struct and the ctypes mirror in
+``native/__init__.py`` used to be kept in sync by a comment
+(``// keep order in sync with native/__init__.py``). This module turns
+that comment into a machine check: it parses BOTH declarations —
+
+- the C++ side straight out of ``scan_engine.cc`` (member declarations of
+  ``struct ScanArgs`` between the ``// abi-begin: ScanArgs`` /
+  ``// abi-end: ScanArgs`` anchors, falling back to brace matching), plus
+  the ``opensim_abi_version()`` constant;
+- the Python side out of the ``native/__init__.py`` AST: the packing
+  lists (``_DIMS``/``_FEATURES``/…/``_BUFFERS``) and, crucially, the
+  ``ScanArgs._fields_`` *composition expression*, so the concatenation
+  order is read from the code instead of being hardcoded here;
+- the serial engine's wire tag: ``WIRE_MAGIC``/``WIRE_VERSION`` in
+  ``native/serial.py`` against the ``r.u32() != 0x…`` guards in
+  ``serial_engine.cc``.
+
+Every field is normalized to a small width vocabulary (``i64``/``f64``
+scalars, ``ptr:u8``/``ptr:i32``/``ptr:i64``/``ptr:f32``/``ptr:f64``
+pointers) and compared for count, order, and width;
+:func:`compare_layouts` names the exact drifted field.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "parse_cc_struct",
+    "parse_cc_abi_version",
+    "parse_cc_serial_wire",
+    "parse_py_layout",
+    "parse_py_abi_version",
+    "parse_py_serial_wire",
+    "compare_layouts",
+]
+
+Field = Tuple[str, str]  # (name, normalized kind)
+
+_CC_SCALARS = {"int64_t": "i64", "double": "f64", "int32_t": "i32", "uint8_t": "u8", "float": "f32"}
+_CC_PTRS = {
+    "uint8_t": "ptr:u8", "int32_t": "ptr:i32", "int64_t": "ptr:i64",
+    "float": "ptr:f32", "double": "ptr:f64",
+}
+_CTYPES_SCALARS = {"c_int64": "i64", "c_double": "f64", "c_int32": "i32", "c_uint8": "u8", "c_float": "f32"}
+
+_ABI_BEGIN_RE = re.compile(r"//\s*abi-begin:\s*(\w+)")
+_ABI_END_RE = re.compile(r"//\s*abi-end:\s*(\w+)")
+_ABI_VERSION_RE = re.compile(r"opensim_abi_version\s*\(\s*\)\s*\{\s*return\s+(\d+)\s*;")
+
+
+def _strip_line_comments(text: str) -> str:
+    return "\n".join(line.split("//", 1)[0] for line in text.splitlines())
+
+
+def _struct_body(text: str, struct: str) -> Optional[str]:
+    """Member text of ``struct <name> { ... };`` — the anchored span when
+    ``// abi-begin:/abi-end:`` markers are present, else brace matching."""
+    begin = end = None
+    for i, line in enumerate(text.splitlines()):
+        m = _ABI_BEGIN_RE.search(line)
+        if m and m.group(1) == struct:
+            begin = i + 1
+        m = _ABI_END_RE.search(line)
+        if m and m.group(1) == struct:
+            end = i
+    if begin is not None and end is not None and end > begin:
+        span = "\n".join(text.splitlines()[begin:end])
+        # the anchored span still contains the `struct X {` / `};` lines
+        # when the anchors sit outside them; cut to the braces if present
+        if "{" in span:
+            span = span.split("{", 1)[1]
+        if "}" in span:
+            span = span.rsplit("}", 1)[0]
+        return span
+    m = re.search(r"struct\s+" + re.escape(struct) + r"\s*\{", text)
+    if m is None:
+        return None
+    depth = 0
+    start = m.end()
+    for i in range(m.end() - 1, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start:i]
+    return None
+
+
+def parse_cc_struct(text: str, struct: str = "ScanArgs") -> Tuple[List[Field], List[str]]:
+    """(ordered fields, problems) from a C++ struct declaration."""
+    body = _struct_body(text, struct)
+    if body is None:
+        return [], [f"struct {struct} not found in C++ source"]
+    body = _strip_line_comments(body)
+    body = re.sub(r"/\*.*?\*/", " ", body, flags=re.S)
+    fields: List[Field] = []
+    problems: List[str] = []
+    for raw in body.split(";"):
+        decl = " ".join(raw.split())
+        if not decl:
+            continue
+        decl = decl.replace("const ", "")
+        is_ptr = "*" in decl
+        decl = decl.replace("*", " ")
+        parts = [p for p in decl.split() if p]
+        if len(parts) < 2:
+            problems.append(f"unparsable member declaration: {raw.strip()!r}")
+            continue
+        ctype, names = parts[0], " ".join(parts[1:])
+        table = _CC_PTRS if is_ptr else _CC_SCALARS
+        kind = table.get(ctype)
+        if kind is None:
+            problems.append(f"unknown C type {ctype!r} in {raw.strip()!r}")
+            continue
+        for name in (n.strip() for n in names.split(",")):
+            if name:
+                fields.append((name, kind))
+    return fields, problems
+
+
+def parse_cc_abi_version(text: str) -> Optional[int]:
+    m = _ABI_VERSION_RE.search(text)
+    return int(m.group(1)) if m else None
+
+
+def parse_cc_serial_wire(text: str) -> Tuple[Optional[int], Optional[int]]:
+    """(magic, version) expected by the C++ serial parser: the first two
+    ``r.u32() != <const>`` guards."""
+    guards = re.findall(r"r\.u32\(\)\s*!=\s*(0x[0-9A-Fa-f]+|\d+)", text)
+    magic = int(guards[0], 0) if len(guards) >= 1 else None
+    version = int(guards[1], 0) if len(guards) >= 2 else None
+    return magic, version
+
+
+# ---------------------------------------------------------------------------
+# python side
+# ---------------------------------------------------------------------------
+
+
+def _module_lists(tree: ast.Module) -> Dict[str, list]:
+    """Module-level list literals: name -> evaluated list. String lists
+    evaluate to strings; ``_BUFFERS``-style tuple lists evaluate to
+    (name, kind) using the third tuple element (the dtype tag)."""
+    out: Dict[str, list] = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        t = node.targets[0]
+        if not isinstance(t, ast.Name) or not isinstance(node.value, ast.List):
+            continue
+        items: list = []
+        ok = True
+        for el in node.value.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                items.append(el.value)
+            elif isinstance(el, ast.Tuple) and len(el.elts) >= 3:
+                name_el, kind_el = el.elts[0], el.elts[2]
+                if (
+                    isinstance(name_el, ast.Constant)
+                    and isinstance(name_el.value, str)
+                    and isinstance(kind_el, ast.Constant)
+                    and isinstance(kind_el.value, str)
+                ):
+                    items.append((name_el.value, kind_el.value))
+                else:
+                    ok = False
+                    break
+            else:
+                ok = False
+                break
+        if ok:
+            out[t.id] = items
+    return out
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _name_chain(expr: ast.AST) -> Optional[List[str]]:
+    """``A + B + C`` as ['A', 'B', 'C'] (or a single name)."""
+    if isinstance(expr, ast.Name):
+        return [expr.id]
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        left = _name_chain(expr.left)
+        right = _name_chain(expr.right)
+        if left is not None and right is not None:
+            return left + right
+    return None
+
+
+def parse_py_layout(
+    tree: ast.Module, struct: str = "ScanArgs"
+) -> Tuple[List[Field], List[str]]:
+    """(ordered fields, problems) from the ctypes mirror: evaluates the
+    packing lists and walks the ``_fields_`` composition expression so the
+    concatenation order comes from the code under test."""
+    lists = _module_lists(tree)
+    cls: Optional[ast.ClassDef] = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == struct:
+            cls = node
+            break
+    if cls is None:
+        return [], [f"class {struct} not found in Python source"]
+    fields_expr: Optional[ast.AST] = None
+    for node in cls.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "_fields_" for t in node.targets
+        ):
+            fields_expr = node.value
+    if fields_expr is None:
+        return [], [f"{struct}._fields_ assignment not found"]
+
+    problems: List[str] = []
+    fields: List[Field] = []
+
+    def expand(expr: ast.AST) -> None:
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+            expand(expr.left)
+            expand(expr.right)
+            return
+        if isinstance(expr, ast.ListComp):
+            elt = expr.elt
+            gen = expr.generators[0]
+            names = _name_chain(gen.iter)
+            if names is None:
+                problems.append(
+                    f"unsupported _fields_ comprehension iterable at line {expr.lineno}"
+                )
+                return
+            # tuple-unpack target => the (name, ptr, dtype) buffer list
+            if isinstance(gen.target, ast.Tuple):
+                for lname in names:
+                    for item in lists.get(lname, []):
+                        if isinstance(item, tuple):
+                            fields.append((item[0], f"ptr:{item[1]}"))
+                        else:
+                            problems.append(
+                                f"{lname}: expected (name, ptr, dtype) tuples"
+                            )
+                return
+            if not isinstance(elt, ast.Tuple) or len(elt.elts) != 2:
+                problems.append(f"unsupported _fields_ element at line {expr.lineno}")
+                return
+            ctype_leaf = _dotted(elt.elts[1]).rsplit(".", 1)[-1]
+            kind = _CTYPES_SCALARS.get(ctype_leaf)
+            if kind is None:
+                problems.append(f"unknown ctypes scalar {ctype_leaf!r}")
+                return
+            for lname in names:
+                if lname not in lists:
+                    problems.append(f"packing list {lname} not found at module level")
+                    continue
+                for item in lists[lname]:
+                    if isinstance(item, str):
+                        fields.append((item, kind))
+                    else:
+                        problems.append(f"{lname}: expected field-name strings")
+            return
+        problems.append(f"unsupported _fields_ expression node {type(expr).__name__}")
+
+    expand(fields_expr)
+    return fields, problems
+
+
+def _module_int(tree: ast.Module, name: str) -> Optional[int]:
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == name
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, int)
+        ):
+            return node.value.value
+    return None
+
+
+def parse_py_abi_version(tree: ast.Module) -> Optional[int]:
+    return _module_int(tree, "ABI_VERSION")
+
+
+def parse_py_serial_wire(tree: ast.Module) -> Tuple[Optional[int], Optional[int]]:
+    return _module_int(tree, "WIRE_MAGIC"), _module_int(tree, "WIRE_VERSION")
+
+
+# ---------------------------------------------------------------------------
+# comparison
+# ---------------------------------------------------------------------------
+
+
+def compare_layouts(
+    cc: List[Field], py: List[Field], limit: int = 8
+) -> List[str]:
+    """Human-oriented mismatch list (empty == byte-identical layouts).
+    Every message names the exact field so the fix is one hop away."""
+    out: List[str] = []
+    if len(cc) != len(py):
+        out.append(
+            f"field count drift: C++ declares {len(cc)} ScanArgs members, "
+            f"Python packs {len(py)}"
+        )
+    for i, ((cn, ck), (pn, pk)) in enumerate(zip(cc, py)):
+        if len(out) >= limit:
+            out.append("... further field drift suppressed")
+            break
+        if cn != pn:
+            out.append(
+                f"field {i}: order drift — C++ declares `{cn}` ({ck}) where "
+                f"Python packs `{pn}` ({pk})"
+            )
+            # after one order drift every later pair mismatches; stop at
+            # the first so the message points at the actual edit
+            break
+        if ck != pk:
+            out.append(
+                f"field {i} `{cn}`: width drift — C++ {ck} vs Python {pk}"
+            )
+    if len(cc) != len(py) and not any("order drift" in m for m in out):
+        extra = cc[len(py):] or py[len(cc):]
+        side = "C++" if len(cc) > len(py) else "Python"
+        names = ", ".join(n for n, _k in extra[:4])
+        out.append(f"unmatched trailing fields on the {side} side: {names}")
+    return out

@@ -1,0 +1,147 @@
+"""Incremental lint cache — flat ``make lint`` wall time as the repo grows.
+
+Content-hash cache for lint results, two buckets:
+
+- **per-file**: findings of the *local* rules (per-file, no project
+  context) keyed by the file's sha256 + the selected local rule set.
+  An unchanged file re-runs nothing and — when the project pass is also
+  cached — is never even re-parsed.
+- **project**: findings of the whole-program pass (``project_rule`` rules
+  plus per-file rules with ``needs_project``) keyed by a digest over
+  EVERY file's hash. Any edit anywhere rebuilds the ProjectContext (the
+  symbol table/call graph/dataflow fixpoints are global), but the
+  unchanged files' local-rule results still come from cache.
+
+Both buckets are salted with an **analyzer fingerprint** — a hash over
+the analysis package's own sources — so editing a rule invalidates
+everything without a version constant to forget to bump.
+
+The cache degrades to a no-op on any I/O or decode problem: lint results
+are always recomputable, so corruption is handled by ignoring the file
+and rewriting it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional
+
+__all__ = ["LintCache", "analyzer_fingerprint", "DEFAULT_CACHE_PATH"]
+
+DEFAULT_CACHE_PATH = os.path.join(".lint", "cache.json")
+
+_FINGERPRINT: Optional[str] = None
+
+
+def analyzer_fingerprint() -> str:
+    """sha256 over the analysis package's own ``.py`` sources: a rule or
+    engine edit invalidates every cached result automatically."""
+    global _FINGERPRINT
+    if _FINGERPRINT is None:
+        h = hashlib.sha256()
+        here = os.path.dirname(os.path.abspath(__file__))
+        for name in sorted(os.listdir(here)):
+            if not name.endswith(".py"):
+                continue
+            h.update(name.encode())
+            try:
+                with open(os.path.join(here, name), "rb") as fh:
+                    h.update(fh.read())
+            except OSError:
+                h.update(b"<unreadable>")
+        _FINGERPRINT = h.hexdigest()
+    return _FINGERPRINT
+
+
+class LintCache:
+    """JSON-backed result cache. All lookups verify the analyzer
+    fingerprint; mismatches read as a cold cache."""
+
+    VERSION = 1
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.data: dict = {"version": self.VERSION, "analyzer": analyzer_fingerprint(),
+                           "files": {}, "project": {}}
+        self._loaded_ok = False
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                got = json.load(fh)
+            if (
+                isinstance(got, dict)
+                and got.get("version") == self.VERSION
+                and got.get("analyzer") == analyzer_fingerprint()
+            ):
+                self.data = got
+                self._loaded_ok = True
+        except (OSError, ValueError):
+            pass
+
+    # -- per-file bucket -----------------------------------------------------
+
+    def file_findings(self, path: str, sha: str, rules_key: str) -> Optional[List[dict]]:
+        entry = self.data["files"].get(path)
+        if not isinstance(entry, dict) or entry.get("sha") != sha:
+            return None
+        got = (entry.get("local") or {}).get(rules_key)
+        return got if isinstance(got, list) else None
+
+    def put_file(self, path: str, sha: str, rules_key: str, findings: List[dict]) -> None:
+        entry = self.data["files"].get(path)
+        if not isinstance(entry, dict) or entry.get("sha") != sha:
+            entry = {"sha": sha, "local": {}}
+            self.data["files"][path] = entry
+        entry.setdefault("local", {})[rules_key] = findings
+
+    # -- project bucket ------------------------------------------------------
+
+    #: project results kept per distinct path-set digest, so a scoped
+    #: `simon lint <subdir> --cache` run cannot clobber the full-repo slot
+    PROJECT_SLOTS = 4
+
+    def project_findings(self, digest: str) -> Optional[List[dict]]:
+        proj = self.data.get("project") or {}
+        entry = proj.get(digest) if isinstance(proj, dict) else None
+        if not isinstance(entry, dict):
+            return None
+        got = entry.get("findings")
+        return got if isinstance(got, list) else None
+
+    def put_project(self, digest: str, findings: List[dict]) -> None:
+        proj = self.data.get("project")
+        if not isinstance(proj, dict) or "findings" in proj:
+            proj = {}  # fresh store (or legacy single-slot layout)
+        seq = 1 + max((e.get("seq", 0) for e in proj.values() if isinstance(e, dict)),
+                      default=0)
+        proj[digest] = {"findings": findings, "seq": seq}
+        while len(proj) > self.PROJECT_SLOTS:
+            oldest = min(proj, key=lambda d: proj[d].get("seq", 0))
+            del proj[oldest]
+        self.data["project"] = proj
+
+    # -- persistence ---------------------------------------------------------
+
+    def prune(self, live_paths) -> None:
+        """Drop entries whose file is GONE from disk. Entries merely
+        outside the current lint set survive — a scoped
+        `simon lint <subdir>` run must not evict the full-repo results."""
+        live = set(live_paths)
+        self.data["files"] = {
+            p: e
+            for p, e in self.data["files"].items()
+            if p in live or os.path.isfile(p)
+        }
+
+    def save(self) -> None:
+        try:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = f"{self.path}.tmp{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(self.data, fh)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # cache is best-effort; next run recomputes

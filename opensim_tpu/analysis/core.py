@@ -1221,6 +1221,35 @@ def _make_context(source: str, path: str) -> Tuple[Optional[FileContext], Option
     return ctx, None
 
 
+def _check_file(ctx: FileContext, rules: Sequence[Rule]) -> List[Finding]:
+    """Per-file rules over one context, suppression applied. Shared by the
+    plain and cached lint flows so their results stay byte-identical."""
+    out: List[Finding] = []
+    for rule in rules:
+        if rule.project_rule or not rule.applies_to(ctx.path):
+            continue
+        for f in rule.check(ctx):
+            if not _suppressed(f, ctx.suppress_line, ctx.suppress_file):
+                out.append(f)
+    return out
+
+
+def _check_project(project: ProjectContext, rules: Sequence[Rule]) -> List[Finding]:
+    """Project-rule pass, path filters and suppression applied. Shared by
+    the plain and cached lint flows."""
+    out: List[Finding] = []
+    for rule in rules:
+        if not rule.project_rule:
+            continue
+        for f in rule.project_check(project):
+            fctx = project.by_path.get(f.path)
+            if fctx is not None and not rule.applies_to(f.path):
+                continue
+            if fctx is None or not _suppressed(f, fctx.suppress_line, fctx.suppress_file):
+                out.append(f)
+    return out
+
+
 def _run(
     contexts: List[FileContext],
     parse_errors: List[Finding],
@@ -1233,21 +1262,9 @@ def _run(
     findings: List[Finding] = list(parse_errors)
     for ctx in contexts:
         ctx.project = project
-        for rule in selected:
-            if rule.project_rule or not rule.applies_to(ctx.path):
-                continue
-            for f in rule.check(ctx):
-                if not _suppressed(f, ctx.suppress_line, ctx.suppress_file):
-                    findings.append(f)
-    for rule in selected:
-        if not rule.project_rule or project is None:
-            continue
-        for f in rule.project_check(project):
-            fctx = project.by_path.get(f.path)
-            if fctx is not None and not rule.applies_to(f.path):
-                continue
-            if fctx is None or not _suppressed(f, fctx.suppress_line, fctx.suppress_file):
-                findings.append(f)
+        findings.extend(_check_file(ctx, selected))
+    if project is not None:
+        findings.extend(_check_project(project, selected))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     return findings
 
@@ -1281,27 +1298,177 @@ def lint_paths(
     paths: Sequence[str],
     rules: Optional[Sequence[str]] = None,
     stats: Optional[dict] = None,
+    cache_path: Optional[str] = None,
 ) -> List[Finding]:
     """Lint files/directories; directories are walked for ``.py`` files.
     Every file is parsed ONCE and the AST shared across all rules; pass a
     ``stats`` dict to receive ``{"files", "rules", "seconds"}`` for the
-    `make lint` wall-time report."""
+    `make lint` wall-time report.
+
+    With ``cache_path``, lint results are cached by content hash
+    (``analysis/cache.py``): unchanged files skip their per-file rules
+    (and, when nothing in the project changed, everything skips — no
+    parses at all). ``stats`` then also carries ``cache_hits``,
+    ``cache_misses`` and ``project_pass`` ("reused"/"rebuilt"/"n/a")."""
     t0 = time.perf_counter()
-    contexts: List[FileContext] = []
-    parse_errors: List[Finding] = []
+    if cache_path is None:
+        contexts: List[FileContext] = []
+        parse_errors: List[Finding] = []
+        for fpath in _iter_py_files(paths):
+            with open(fpath, "r", encoding="utf-8") as fh:
+                source = fh.read()
+            ctx, err = _make_context(source, fpath)
+            if ctx is not None:
+                contexts.append(ctx)
+            elif err is not None:
+                parse_errors.append(err)
+        findings = _run(contexts, parse_errors, rules)
+        if stats is not None:
+            stats["files"] = len(contexts) + len(parse_errors)
+            stats["rules"] = len(_select_rules(rules))
+            stats["seconds"] = time.perf_counter() - t0
+        return findings
+    findings = _lint_paths_cached(paths, rules, stats, cache_path)
+    if stats is not None:
+        stats["seconds"] = time.perf_counter() - t0
+    return findings
+
+
+def _companion_files(py_paths: Sequence[str]) -> List[str]:
+    """Non-Python inputs whole-program rules consult (today: the ``.cc``
+    engine sources living beside linted files), sorted for stable
+    digests."""
+    dirs = sorted({os.path.dirname(p) for p in py_paths})
+    out: List[str] = []
+    for d in dirs:
+        try:
+            names = os.listdir(d or ".")
+        except OSError:
+            continue
+        out.extend(os.path.join(d, n) for n in sorted(names) if n.endswith(".cc"))
+    return out
+
+
+def _lint_paths_cached(
+    paths: Sequence[str],
+    rules: Optional[Sequence[str]],
+    stats: Optional[dict],
+    cache_path: str,
+) -> List[Finding]:
+    """The content-hash-cached lint flow (see :mod:`analysis.cache`).
+
+    Rule split: *local* rules (per-file, no whole-program context) cache
+    per file; *global* rules (``project_rule`` or ``needs_project``) cache
+    as one unit keyed by a digest over every file hash — the symbol
+    table/call graph they consult is global, so any edit rebuilds them."""
+    import hashlib
+
+    from .cache import LintCache, analyzer_fingerprint
+
+    selected = _select_rules(rules)
+    local_rules = [r for r in selected if not (r.project_rule or r.needs_project)]
+    global_rules = [r for r in selected if r.project_rule or r.needs_project]
+    local_key = ",".join(sorted(r.code for r in local_rules))
+    cache = LintCache(cache_path)
+
+    sources: Dict[str, str] = {}
+    shas: Dict[str, str] = {}
+    order: List[str] = []
     for fpath in _iter_py_files(paths):
         with open(fpath, "r", encoding="utf-8") as fh:
-            source = fh.read()
-        ctx, err = _make_context(source, fpath)
+            src = fh.read()
+        order.append(fpath)
+        sources[fpath] = src
+        shas[fpath] = hashlib.sha256(src.encode()).hexdigest()
+
+    local_findings: List[Finding] = []
+    misses: List[str] = []
+    hits = 0
+    for fpath in order:
+        got = cache.file_findings(fpath, shas[fpath], local_key)
+        if got is not None:
+            hits += 1
+            local_findings.extend(Finding(**d) for d in got)
+        else:
+            misses.append(fpath)
+
+    h = hashlib.sha256()
+    for fpath in order:
+        h.update(fpath.encode())
+        h.update(shas[fpath].encode())
+    # companion sources the project rules read but the walker does not
+    # lint: the abi-parity pass (OSL1604) parses the C++ engine sources
+    # next to the native package, so a C++-only ABI edit must invalidate
+    # the cached project pass too
+    for comp in _companion_files(order):
+        h.update(comp.encode())
+        try:
+            with open(comp, "rb") as fh:
+                h.update(hashlib.sha256(fh.read()).hexdigest().encode())
+        except OSError:
+            h.update(b"<unreadable>")
+    h.update(",".join(sorted(r.code for r in global_rules)).encode())
+    h.update(analyzer_fingerprint().encode())
+    project_digest = h.hexdigest()
+
+    project_findings: List[Finding] = []
+    project_state = "n/a"
+    cached_project = cache.project_findings(project_digest) if global_rules else None
+    if global_rules and cached_project is not None:
+        project_findings = [Finding(**d) for d in cached_project]
+        project_state = "reused"
+
+    # parse what we must: cache-missed files always; every file when the
+    # project pass has to rebuild
+    need_parse = set(misses)
+    if global_rules and cached_project is None:
+        need_parse = set(order)
+        project_state = "rebuilt"
+    pos = {p: i for i, p in enumerate(order)}
+    contexts: Dict[str, FileContext] = {}
+    parse_errors: Dict[str, Finding] = {}
+    for fpath in sorted(need_parse, key=pos.__getitem__):
+        ctx, err = _make_context(sources[fpath], fpath)
         if ctx is not None:
-            contexts.append(ctx)
+            contexts[fpath] = ctx
         elif err is not None:
-            parse_errors.append(err)
-    findings = _run(contexts, parse_errors, rules)
+            parse_errors[fpath] = err
+
+    # per-file rules over the cache misses (same dispatch as _run)
+    for fpath in misses:
+        out: List[Finding] = []
+        err = parse_errors.get(fpath)
+        if err is not None:
+            out.append(err)
+        ctx = contexts.get(fpath)
+        if ctx is not None:
+            out.extend(_check_file(ctx, local_rules))
+        cache.put_file(fpath, shas[fpath], local_key, [f.as_dict() for f in out])
+        local_findings.extend(out)
+
+    # whole-program pass when anything changed (same dispatch as _run)
+    if global_rules and project_state == "rebuilt":
+        ordered_ctx = [contexts[p] for p in order if p in contexts]
+        project = ProjectContext(ordered_ctx)
+        for ctx in ordered_ctx:
+            ctx.project = project
+        out = []
+        for ctx in ordered_ctx:
+            out.extend(_check_file(ctx, global_rules))
+        out.extend(_check_project(project, global_rules))
+        project_findings = out
+        cache.put_project(project_digest, [f.as_dict() for f in out])
+
+    cache.prune(order)
+    cache.save()
+    findings = local_findings + project_findings
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
     if stats is not None:
-        stats["files"] = len(contexts) + len(parse_errors)
-        stats["rules"] = len(_select_rules(rules))
-        stats["seconds"] = time.perf_counter() - t0
+        stats["files"] = len(order)
+        stats["rules"] = len(selected)
+        stats["cache_hits"] = hits
+        stats["cache_misses"] = len(misses)
+        stats["project_pass"] = project_state
     return findings
 
 
@@ -1315,6 +1482,12 @@ def render_human(findings: List[Finding], stats: Optional[dict] = None) -> str:
             f" ({stats.get('files', 0)} files parsed once, "
             f"{stats.get('rules', 0)} rules, {stats.get('seconds', 0.0):.2f}s)"
         )
+        if "cache_hits" in stats:
+            tail += (
+                f" [cache: {stats['cache_hits']} hit / "
+                f"{stats.get('cache_misses', 0)} miss, project pass "
+                f"{stats.get('project_pass', 'n/a')}]"
+            )
     lines.append(tail)
     return "\n".join(lines)
 

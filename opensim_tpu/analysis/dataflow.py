@@ -1,0 +1,1554 @@
+"""Interprocedural dataflow engine over the :class:`ProjectContext`.
+
+PR 10's whole-program pass gave the analyzer a symbol table and a call
+graph; this module grows it into real dataflow — the class of tooling the
+reference gets for free from Go's type system and ``go vet``:
+
+- **Per-function CFGs** (:func:`build_cfg`) at statement granularity with
+  classic **reaching definitions** (:meth:`CFG.reaching_defs`), plus a
+  generic forward worklist (:func:`forward_analyze`) shared by every
+  abstract interpretation below.
+
+- **Function discovery beyond the symbol table** (:class:`FnUnit`): the
+  ProjectContext only records top-level functions and class methods, but
+  the serving stack hides code in nested scopes (``make_handler``'s
+  ``Handler.do_GET``). The engine enumerates *every* def — nested
+  functions, methods of classes defined inside functions — and resolves
+  calls through lexical scope chains, ``self``, ``functools.partial``, and
+  the ProjectContext's import-aware resolver.
+
+- **Effect inference** (:meth:`DataflowEngine.direct_effects` /
+  :meth:`transitive_effects`): per-function effect sets — mutates
+  module/instance state, performs I/O, reads clock/RNG, forces a
+  host-device sync — with transitive effects computed as a fixpoint over
+  the call graph (monotone union, so recursion converges).
+
+- **JIT region tracking** (:meth:`jit_roots` / :meth:`jit_reachable`):
+  trace roots from ``@jax.jit``-family decorators, function references
+  passed to ``lax.scan``/``vmap``/``pallas_call``-family entry points
+  (through ``functools.partial`` and lambdas), and two explicit markers
+  for regions the resolver cannot see syntactically::
+
+      def step(carry, x):  # opensim-lint: jit-region
+      # opensim-lint: jit-region-module   (first 10 lines: whole module)
+
+- **Forward taint lattice** (:class:`TaintEngine`): untrusted inputs
+  (HTTP query/body, CLI args, YAML documents, stdin) are tainted at the
+  source; taint propagates flow-sensitively through the CFG and
+  interprocedurally through per-function summaries (param→sink,
+  param→return, return-taint) iterated to fixpoint over the call graph.
+  Calls to a **registered validator** — any function carrying a
+  ``@sanitizer``-named decorator (``utils/validate.py``) or listed in
+  ``EXTRA_SANITIZERS`` — return clean values; numeric coercions
+  (``int``/``float``/``bool``/``len``) sanitize structurally.
+
+The lattice is sets-of-tags with union join: every transfer function is
+monotone and the tag universe per function is finite, so all fixpoints
+terminate. Limitations (documented in docs/static-analysis.md): taint is
+not tracked through object attributes across methods (validate at the
+boundary instead), and calls that resolve to nothing propagate taint from
+arguments to result conservatively but produce no findings inside the
+callee.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import FileContext, ProjectContext, dotted_name
+
+__all__ = [
+    "Atom",
+    "Block",
+    "CFG",
+    "build_cfg",
+    "forward_analyze",
+    "Effect",
+    "FnUnit",
+    "Tag",
+    "TaintEngine",
+    "SinkHit",
+    "DataflowEngine",
+]
+
+_FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# ---------------------------------------------------------------------------
+# control-flow graphs
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Atom:
+    """One transfer-function unit inside a basic block: either a simple
+    statement (``role="stmt"``) or the evaluated fragment of a compound
+    statement (an ``if``/``while`` test, a ``for`` iterable + target bind,
+    a ``with`` item, an except-handler name bind)."""
+
+    node: ast.AST
+    role: str = "stmt"  # stmt | test | iter | withitem | except | return
+
+
+@dataclass
+class Block:
+    id: int
+    atoms: List[Atom] = field(default_factory=list)
+    succ: List[int] = field(default_factory=list)
+
+
+class CFG:
+    """Intraprocedural control-flow graph for one function body.
+
+    ``entry``/``exit`` are block ids; ``blocks[exit]`` is always empty.
+    Nested function/class bodies are NOT inlined — a nested ``def`` is a
+    single defining atom (the nested body belongs to its own
+    :class:`FnUnit`)."""
+
+    def __init__(self) -> None:
+        self.blocks: List[Block] = []
+        self.entry = self._new()
+        self.exit = self._new()
+
+    def _new(self) -> int:
+        b = Block(id=len(self.blocks))
+        self.blocks.append(b)
+        return b.id
+
+    def _edge(self, src: int, dst: int) -> None:
+        if dst not in self.blocks[src].succ:
+            self.blocks[src].succ.append(dst)
+
+    def preds(self) -> Dict[int, List[int]]:
+        out: Dict[int, List[int]] = {b.id: [] for b in self.blocks}
+        for b in self.blocks:
+            for s in b.succ:
+                out[s].append(b.id)
+        return out
+
+    # -- reaching definitions ------------------------------------------------
+
+    def reaching_defs(self) -> Dict[int, Dict[str, Set[int]]]:
+        """Classic may-reach definitions: for each block, the map
+        ``var -> {lineno of defs that reach block entry}``. Parameters and
+        imports count as definitions at their own line."""
+        gen: Dict[int, Dict[str, Set[int]]] = {}
+        for b in self.blocks:
+            g: Dict[str, Set[int]] = {}
+            for atom in b.atoms:
+                for name, node in atom_defs(atom):
+                    g[name] = {getattr(node, "lineno", 0)}  # strong update
+            gen[b.id] = g
+        in_: Dict[int, Dict[str, Set[int]]] = {b.id: {} for b in self.blocks}
+        preds = self.preds()
+        work = [b.id for b in self.blocks]
+        while work:
+            bid = work.pop(0)
+            state: Dict[str, Set[int]] = {}
+            for p in preds[bid]:
+                out_p = dict(in_[p])
+                for name, lines in gen[p].items():
+                    out_p[name] = set(lines)
+                for name, lines in out_p.items():
+                    state.setdefault(name, set()).update(lines)
+            if state != in_[bid]:
+                in_[bid] = state
+                for s in self.blocks[bid].succ:
+                    if s not in work:
+                        work.append(s)
+        return in_
+
+
+def atom_defs(atom: Atom) -> List[Tuple[str, ast.AST]]:
+    """Names an atom (re)defines, with the defining node."""
+    node = atom.node
+    out: List[Tuple[str, ast.AST]] = []
+
+    def targets(t: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            out.append((t.id, t))
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                targets(el)
+        elif isinstance(t, ast.Starred):
+            targets(t.value)
+
+    if atom.role == "iter" and isinstance(node, (ast.For, ast.AsyncFor)):
+        targets(node.target)
+    elif atom.role == "withitem" and isinstance(node, ast.withitem):
+        if node.optional_vars is not None:
+            targets(node.optional_vars)
+    elif atom.role == "except" and isinstance(node, ast.ExceptHandler):
+        if node.name:
+            out.append((node.name, node))
+    elif isinstance(node, ast.Assign):
+        for t in node.targets:
+            targets(t)
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        targets(node.target)
+    elif isinstance(node, _FuncNode + (ast.ClassDef,)):
+        out.append((node.name, node))
+    elif isinstance(node, ast.Import):
+        for alias in node.names:
+            out.append(((alias.asname or alias.name.split(".")[0]), node))
+    elif isinstance(node, ast.ImportFrom):
+        for alias in node.names:
+            if alias.name != "*":
+                out.append((alias.asname or alias.name, node))
+    # walrus targets anywhere in the atom's expressions
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.NamedExpr) and isinstance(sub.target, ast.Name):
+            out.append((sub.target.id, sub.target))
+    return out
+
+
+class _CFGBuilder:
+    def __init__(self) -> None:
+        self.cfg = CFG()
+        self.loop_stack: List[Tuple[int, int]] = []  # (head, after)
+
+    def build(self, body: Sequence[ast.stmt]) -> CFG:
+        end = self._stmts(body, self.cfg.entry)
+        if end is not None:
+            self.cfg._edge(end, self.cfg.exit)
+        return self.cfg
+
+    def _stmts(self, body: Sequence[ast.stmt], cur: Optional[int]) -> Optional[int]:
+        for stmt in body:
+            if cur is None:
+                return None  # unreachable code after return/raise/break
+            cur = self._stmt(stmt, cur)
+        return cur
+
+    def _stmt(self, stmt: ast.stmt, cur: int) -> Optional[int]:
+        cfg = self.cfg
+        add = cfg.blocks[cur].atoms.append
+        if isinstance(stmt, ast.If):
+            add(Atom(stmt, "test"))
+            then = cfg._new()
+            cfg._edge(cur, then)
+            t_end = self._stmts(stmt.body, then)
+            after = cfg._new()
+            if stmt.orelse:
+                els = cfg._new()
+                cfg._edge(cur, els)
+                e_end = self._stmts(stmt.orelse, els)
+                if e_end is not None:
+                    cfg._edge(e_end, after)
+            else:
+                cfg._edge(cur, after)
+            if t_end is not None:
+                cfg._edge(t_end, after)
+            return after
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            head = cfg._new()
+            cfg._edge(cur, head)
+            cfg.blocks[head].atoms.append(
+                Atom(stmt, "test" if isinstance(stmt, ast.While) else "iter")
+            )
+            body_b = cfg._new()
+            after = cfg._new()
+            cfg._edge(head, body_b)
+            cfg._edge(head, after)
+            self.loop_stack.append((head, after))
+            b_end = self._stmts(stmt.body, body_b)
+            self.loop_stack.pop()
+            if b_end is not None:
+                cfg._edge(b_end, head)
+            if stmt.orelse:
+                els = cfg._new()
+                cfg._edge(head, els)
+                o_end = self._stmts(stmt.orelse, els)
+                if o_end is not None:
+                    cfg._edge(o_end, after)
+            return after
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                add(Atom(item, "withitem"))
+            return self._stmts(stmt.body, cur)
+        if isinstance(stmt, ast.Try):
+            body_b = cfg._new()
+            cfg._edge(cur, body_b)
+            first = len(cfg.blocks) - 1
+            b_end = self._stmts(stmt.body, body_b)
+            body_blocks = [b.id for b in cfg.blocks[first:]]
+            after = cfg._new()
+            o_end = b_end
+            if stmt.orelse and b_end is not None:
+                o_end = self._stmts(stmt.orelse, b_end)
+            # any statement inside the try may transfer to any handler
+            ends: List[Optional[int]] = [o_end]
+            for handler in stmt.handlers:
+                h = cfg._new()
+                cfg.blocks[h].atoms.append(Atom(handler, "except"))
+                for bid in body_blocks:
+                    cfg._edge(bid, h)
+                ends.append(self._stmts(handler.body, h))
+            if stmt.finalbody:
+                fin = cfg._new()
+                for e in ends:
+                    if e is not None:
+                        cfg._edge(e, fin)
+                f_end = self._stmts(stmt.finalbody, fin)
+                if f_end is not None:
+                    cfg._edge(f_end, after)
+                return after
+            for e in ends:
+                if e is not None:
+                    cfg._edge(e, after)
+            return after
+        if isinstance(stmt, ast.Return):
+            add(Atom(stmt, "return"))
+            cfg._edge(cur, cfg.exit)
+            return None
+        if isinstance(stmt, ast.Raise):
+            add(Atom(stmt))
+            cfg._edge(cur, cfg.exit)
+            return None
+        if isinstance(stmt, ast.Break):
+            if self.loop_stack:
+                cfg._edge(cur, self.loop_stack[-1][1])
+            return None
+        if isinstance(stmt, ast.Continue):
+            if self.loop_stack:
+                cfg._edge(cur, self.loop_stack[-1][0])
+            return None
+        add(Atom(stmt))
+        return cur
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG for a FunctionDef/AsyncFunctionDef (or any statement list
+    wrapped in an object with ``body``)."""
+    return _CFGBuilder().build(getattr(fn, "body", fn))
+
+
+def forward_analyze(cfg: CFG, init, transfer, join):
+    """Generic forward worklist over ``cfg``. ``init`` is the entry state;
+    ``transfer(atom, state) -> state`` must be monotone; ``join(a, b)``
+    the lattice union. Returns ``{block id: in-state}``. States must
+    support ``==``."""
+    in_: Dict[int, object] = {cfg.entry: init}
+    preds = cfg.preds()
+    order = [b.id for b in cfg.blocks]
+    work = list(order)
+    out_cache: Dict[int, object] = {}
+
+    def block_out(bid: int) -> object:
+        state = in_.get(bid)
+        if state is None:
+            return None
+        for atom in cfg.blocks[bid].atoms:
+            state = transfer(atom, state)
+        return state
+
+    while work:
+        bid = work.pop(0)
+        if bid != cfg.entry:
+            merged = None
+            for p in preds[bid]:
+                o = out_cache.get(p)
+                if o is None:
+                    continue
+                merged = o if merged is None else join(merged, o)
+            if merged is None:
+                continue
+            if bid in in_ and merged == in_[bid]:
+                out_cache.setdefault(bid, block_out(bid))
+                continue
+            in_[bid] = merged
+        new_out = block_out(bid)
+        if out_cache.get(bid) != new_out:
+            out_cache[bid] = new_out
+            for s in cfg.blocks[bid].succ:
+                if s not in work:
+                    work.append(s)
+    return in_
+
+
+# ---------------------------------------------------------------------------
+# function units: every def in the project, nested scopes included
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FnUnit:
+    """One analyzable function anywhere in a module (top-level, method,
+    nested def, method of a class defined inside a function)."""
+
+    qual: str  # module.outer.Class.meth (full lexical path)
+    module: str
+    cls: Optional[str]  # innermost class name when a method
+    node: ast.AST
+    ctx: FileContext
+    params: List[str] = field(default_factory=list)
+    visible: Dict[str, str] = field(default_factory=dict)  # name -> unit qual
+    class_scope: Dict[str, str] = field(default_factory=dict)  # method -> qual
+
+
+_JIT_DECOR = {"jax.jit", "jit"}
+_TRACING_CALLS = _JIT_DECOR | {
+    "jax.vmap", "vmap", "jax.pmap", "pmap", "jax.checkpoint",
+    "jax.lax.scan", "lax.scan", "jax.lax.cond", "lax.cond",
+    "jax.lax.while_loop", "lax.while_loop", "jax.lax.fori_loop",
+    "lax.fori_loop", "jax.lax.switch", "lax.switch", "jax.lax.map",
+    "lax.map", "pl.pallas_call", "pallas_call", "shard_map",
+    "jax.experimental.shard_map.shard_map",
+}
+_PARTIAL = {"functools.partial", "partial"}
+
+_JIT_MARK_RE = re.compile(r"#\s*opensim-lint:\s*jit-region\b")
+_JIT_MODULE_MARK_RE = re.compile(r"#\s*opensim-lint:\s*jit-region-module\b")
+
+# -- effect tables -----------------------------------------------------------
+
+_IO_EXACT = {
+    "open", "io.open", "os.system", "os.popen", "os.urandom",
+    "os.remove", "os.unlink", "os.replace", "os.rename", "os.makedirs",
+    "os.mkdir", "os.rmdir", "os.fsync", "os.fdatasync", "os.open",
+    "os.write", "os.read", "os.truncate", "os.chmod", "input", "print",
+}
+_IO_PREFIX = ("subprocess.", "shutil.", "socket.", "urllib.request.")
+_CLOCK_EXACT = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "time.monotonic_ns", "time.perf_counter_ns", "time.sleep",
+    "datetime.datetime.now", "datetime.now", "datetime.datetime.utcnow",
+    "datetime.utcnow",
+}
+_RNG_PREFIX = ("random.", "np.random.", "numpy.random.", "secrets.")
+_RNG_EXACT = {"os.urandom", "uuid.uuid1", "uuid.uuid4", "random"}
+_SYNC_EXACT = {
+    "jax.device_get", "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+}
+_SYNC_METHODS = {"item", "block_until_ready", "tolist"}
+
+
+def _src_of(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except (ValueError, RecursionError, AttributeError):
+        return type(node).__name__
+
+
+@dataclass(frozen=True)
+class Effect:
+    """One inferred side effect at a concrete site."""
+
+    kind: str  # "io" | "clock" | "rng" | "host-sync" | "state-write"
+    desc: str
+    line: int
+    col: int
+
+    def __str__(self) -> str:  # compact for messages/tests
+        return f"{self.kind}:{self.desc}"
+
+
+# ---------------------------------------------------------------------------
+# taint tags
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Tag:
+    """One taint provenance: a real untrusted source (``kind`` names it),
+    a function parameter placeholder (``kind="param"``), or a traced
+    value (``kind="traced"``/``"traced-param"``) for the tracer-leak
+    pass."""
+
+    kind: str
+    desc: str = ""
+    line: int = 0
+    index: int = -1  # param index for kind == "param"/"traced-param"
+
+    @property
+    def is_param(self) -> bool:
+        return self.kind in ("param", "traced-param")
+
+
+TagSet = FrozenSet[Tag]
+_EMPTY: TagSet = frozenset()
+
+#: dotted-name leaves whose call RESULT is untrusted input
+_SOURCE_LEAVES = {
+    "parse_qs": "http-query",
+    "parse_qsl": "http-query",
+    "parse_args": "cli-arg",
+    "parse_known_args": "cli-arg",
+    "safe_load": "yaml-field",
+    "full_load": "yaml-field",
+    "unsafe_load": "yaml-field",
+    "input": "stdin",
+}
+#: dotted names (exact) whose VALUE is untrusted input
+_SOURCE_NAMES = {"sys.argv": "cli-arg"}
+#: attribute-chain fragments marking HTTP request internals
+_HTTP_BODY_RE = re.compile(r"(^|\.)rfile\.read$")
+
+#: calls that return sanitized values regardless of argument taint
+_COERCION_SANITIZERS = {"int", "float", "bool", "len", "ord", "hash", "id", "isinstance"}
+
+#: recognized even when the callee does not resolve (partial-project lint
+#: runs — e.g. `make lint opensim_tpu/analysis` — cannot see
+#: utils/validate.py): the shared validator module's convention is part
+#: of the rule contract, so `validate.<fn>(...)` and the two canonical
+#: validator names always read as registered sanitizers
+_SANITIZER_MODULE = "validate"
+_SANITIZER_LEAVES = {"user_path", "child_path"}
+
+#: sink table: dotted-name leaf (or exact) -> human label. ``args`` says
+#: which positional arguments are sensitive ("all" or a set of indexes).
+_SINKS_EXACT = {
+    "open": ("open()", "all"),
+    "io.open": ("open()", "all"),
+    "os.remove": ("os.remove()", "all"),
+    "os.unlink": ("os.unlink()", "all"),
+    "os.replace": ("os.replace()", "all"),
+    "os.rename": ("os.rename()", "all"),
+    "os.makedirs": ("os.makedirs()", "all"),
+    "os.mkdir": ("os.mkdir()", "all"),
+    "os.rmdir": ("os.rmdir()", "all"),
+    "os.listdir": ("os.listdir()", "all"),
+    "os.chmod": ("os.chmod()", "all"),
+    "os.path.join": ("os.path.join()", "all"),
+    "os.system": ("os.system()", "all"),
+    "os.popen": ("os.popen()", "all"),
+    "shutil.rmtree": ("shutil.rmtree()", "all"),
+    "shutil.copy": ("shutil.copy()", "all"),
+    "shutil.move": ("shutil.move()", "all"),
+}
+_SINK_PREFIXES = (
+    ("subprocess.", "subprocess"),
+)
+#: bare-callable leaves that construct filesystem paths
+_SINK_CTOR_LEAVES = {"Path": "pathlib.Path()"}
+
+
+@dataclass(frozen=True)
+class SinkHit:
+    """A tainted value reaching a sink. ``tags`` carries provenance; param
+    tags mean 'when the enclosing function's parameter is tainted'."""
+
+    unit: str
+    sink: str
+    tags: TagSet
+    line: int
+    col: int
+    desc: str
+
+
+@dataclass
+class FnSummary:
+    """Interprocedural taint summary for one unit."""
+
+    param_sinks: Dict[int, str] = field(default_factory=dict)  # index -> sink label
+    param_to_ret: Set[int] = field(default_factory=set)
+    ret_tags: TagSet = _EMPTY  # real source tags flowing to the return value
+
+    def key(self) -> Tuple:
+        return (
+            tuple(sorted(self.param_sinks.items())),
+            tuple(sorted(self.param_to_ret)),
+            self.ret_tags,
+        )
+
+
+def _is_sanitizer_def(node: ast.AST) -> bool:
+    for dec in getattr(node, "decorator_list", ()):
+        name = dotted_name(dec) or (
+            dotted_name(dec.func) if isinstance(dec, ast.Call) else ""
+        )
+        if name.rsplit(".", 1)[-1] == "sanitizer":
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+
+class DataflowEngine:
+    """Lazy, memoized dataflow facade built over a ProjectContext. Rules
+    grab it via :func:`get_engine` so every OSL16xx rule in one run shares
+    the unit table, CFGs, effect fixpoint, and taint summaries."""
+
+    #: qualname suffixes treated as registered sanitizers even without a
+    #: decorator (external or generated code the AST cannot mark)
+    EXTRA_SANITIZERS: Tuple[str, ...] = ()
+
+    def __init__(self, project: ProjectContext) -> None:
+        self.project = project
+        self.units: Dict[str, FnUnit] = {}
+        self._module_globals: Dict[str, Set[str]] = {}
+        self._cfgs: Dict[str, CFG] = {}
+        self._edges: Optional[Dict[str, List[Tuple[str, ast.Call]]]] = None
+        self._direct_eff: Dict[str, Tuple[Effect, ...]] = {}
+        self._trans_eff: Optional[Dict[str, Dict[Effect, str]]] = None
+        self._roots: Optional[Dict[str, str]] = None
+        self._reach: Optional[Dict[str, Tuple[str, Tuple[str, ...]]]] = None
+        self._sanitizers: Set[str] = set()
+        self._discover()
+
+    # -- discovery -----------------------------------------------------------
+
+    def _discover(self) -> None:
+        for ctx in self.project.contexts:
+            mod = ctx.module
+            tops: Set[str] = set()
+            for stmt in ctx.tree.body:
+                for name, _node in atom_defs(Atom(stmt)):
+                    tops.add(name)
+            self._module_globals[mod] = tops
+            self._walk_scope(ctx, ctx.tree.body, mod, None, {}, {})
+            # module-level "unit" for tracing calls / sinks in init code
+            body = [
+                s for s in ctx.tree.body if not isinstance(s, _FuncNode + (ast.ClassDef,))
+            ]
+            unit = FnUnit(
+                qual=f"{mod}.<module>", module=mod, cls=None,
+                node=ast.Module(body=list(body), type_ignores=[]), ctx=ctx,
+            )
+            unit.visible = {
+                n: f"{mod}.{n}"
+                for n in tops
+                if f"{mod}.{n}" in self.units
+            }
+            self.units[unit.qual] = unit
+
+    def _walk_scope(
+        self,
+        ctx: FileContext,
+        body: Sequence[ast.stmt],
+        prefix: str,
+        cls: Optional[str],
+        enclosing: Dict[str, str],
+        class_scope: Dict[str, str],
+    ) -> None:
+        local: Dict[str, str] = dict(enclosing)
+        for stmt in body:
+            if isinstance(stmt, _FuncNode):
+                qual = f"{prefix}.{stmt.name}"
+                if cls is not None:
+                    # methods are reached via self.m(), not as bare names
+                    class_scope[stmt.name] = qual
+                else:
+                    local[stmt.name] = qual
+        for stmt in body:
+            if isinstance(stmt, _FuncNode):
+                qual = f"{prefix}.{stmt.name}"
+                a = stmt.args
+                params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+                # the function body also sees its own immediately-nested defs
+                child_visible = dict(local)
+                for inner in stmt.body:
+                    if isinstance(inner, _FuncNode):
+                        child_visible[inner.name] = f"{qual}.{inner.name}"
+                unit = FnUnit(
+                    qual=qual, module=ctx.module, cls=cls, node=stmt, ctx=ctx,
+                    params=params, visible=child_visible,
+                    class_scope=class_scope if cls is not None else {},
+                )
+                self.units[qual] = unit
+                if _is_sanitizer_def(stmt):
+                    self._sanitizers.add(qual)
+                self._walk_scope(ctx, stmt.body, qual, None, child_visible, {})
+            elif isinstance(stmt, ast.ClassDef):
+                self._walk_scope(
+                    ctx, stmt.body, f"{prefix}.{stmt.name}", stmt.name, local, {}
+                )
+            elif isinstance(stmt, (ast.If, ast.Try, ast.With, ast.AsyncWith,
+                                   ast.For, ast.AsyncFor, ast.While)):
+                # defs under conditionals (TYPE_CHECKING guards, try/except
+                # import fallbacks) still bind names in this scope
+                inner: List[ast.stmt] = list(getattr(stmt, "body", []))
+                for part in ("orelse", "finalbody"):
+                    inner.extend(getattr(stmt, part, []) or [])
+                for h in getattr(stmt, "handlers", []) or []:
+                    inner.extend(h.body)
+                self._walk_scope(ctx, inner, prefix, cls, local, class_scope)
+
+    def cfg(self, qual: str) -> CFG:
+        got = self._cfgs.get(qual)
+        if got is None:
+            got = self._cfgs[qual] = build_cfg(self.units[qual].node)
+        return got
+
+    def is_sanitizer(self, qual: Optional[str]) -> bool:
+        if not qual:
+            return False
+        if qual in self._sanitizers:
+            return True
+        return any(qual.endswith(sfx) for sfx in self.EXTRA_SANITIZERS)
+
+    # -- call resolution -----------------------------------------------------
+
+    def resolve_call(self, unit: FnUnit, call: ast.Call) -> Optional[str]:
+        """Unit qualname a call lands in, or None for external/unresolved.
+        Sees through lexical scope, ``self.m()``, module imports (via the
+        ProjectContext resolver), and class construction (-> __init__)."""
+        return self._resolve_func_expr(unit, call.func)
+
+    def _resolve_func_expr(self, unit: FnUnit, fexpr: ast.AST) -> Optional[str]:
+        if isinstance(fexpr, ast.Name):
+            got = unit.visible.get(fexpr.id)
+            if got is not None:
+                return got
+        if (
+            isinstance(fexpr, ast.Attribute)
+            and isinstance(fexpr.value, ast.Name)
+            and fexpr.value.id == "self"
+            and fexpr.attr in unit.class_scope
+        ):
+            return unit.class_scope[fexpr.attr]
+        got = self.project.resolve_value(fexpr, unit.module, unit.cls, {})
+        if got is not None:
+            if got[0] == "func":
+                return self._unit_for_symbol(got[1])
+            if got[0] == "class":
+                return self._unit_for_symbol(f"{got[1]}.{got[2]}.__init__")
+        return None
+
+    def _unit_for_symbol(self, qual: str) -> Optional[str]:
+        return qual if qual in self.units else None
+
+    def edges(self) -> Dict[str, List[Tuple[str, ast.Call]]]:
+        """caller unit -> [(callee unit, call node)]; ``functools.partial``
+        references contribute a reachability edge at the partial site."""
+        if self._edges is not None:
+            return self._edges
+        out: Dict[str, List[Tuple[str, ast.Call]]] = {}
+        for qual, unit in self.units.items():
+            lst: List[Tuple[str, ast.Call]] = []
+            for call in self._own_calls(unit):
+                callee = self.resolve_call(unit, call)
+                if callee is not None:
+                    lst.append((callee, call))
+                name = dotted_name(call.func)
+                if name in _PARTIAL and call.args:
+                    ref = self._resolve_func_expr(unit, call.args[0])
+                    if ref is not None:
+                        lst.append((ref, call))
+            out[qual] = lst
+        self._edges = out
+        return out
+
+    def _own_calls(self, unit: FnUnit) -> Iterable[ast.Call]:
+        """Call nodes in a unit's own body (nested defs excluded; lambda
+        bodies included — they execute in this frame's dynamic extent
+        often enough, and over-approximation is safe for reachability)."""
+        for stmt in self._own_stmts(unit):
+            for sub in self._walk_skip_defs(stmt):
+                if isinstance(sub, ast.Call):
+                    yield sub
+
+    def _own_stmts(self, unit: FnUnit) -> List[ast.stmt]:
+        return list(getattr(unit.node, "body", []))
+
+    @staticmethod
+    def _walk_skip_defs(root: ast.AST) -> Iterable[ast.AST]:
+        stack = [root]
+        first = True
+        while stack:
+            node = stack.pop()
+            if not first and isinstance(node, _FuncNode + (ast.ClassDef,)):
+                continue  # nested scope: its own unit
+            first = False
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- effect inference ----------------------------------------------------
+
+    def direct_effects(self, qual: str) -> Tuple[Effect, ...]:
+        got = self._direct_eff.get(qual)
+        if got is not None:
+            return got
+        unit = self.units[qual]
+        effects: List[Effect] = []
+        globals_ = self._module_globals.get(unit.module, set())
+        declared_global: Set[str] = set()
+        for stmt in self._own_stmts(unit):
+            for sub in self._walk_skip_defs(stmt):
+                if isinstance(sub, (ast.Global, ast.Nonlocal)):
+                    declared_global.update(sub.names)
+        params = set(unit.params)
+        for stmt in self._own_stmts(unit):
+            for sub in self._walk_skip_defs(stmt):
+                eff = self._effect_of_node(sub, unit, params, globals_, declared_global)
+                if eff is not None:
+                    effects.append(eff)
+        got = tuple(effects)
+        self._direct_eff[qual] = got
+        return got
+
+    def _effect_of_node(
+        self,
+        node: ast.AST,
+        unit: FnUnit,
+        params: Set[str],
+        globals_: Set[str],
+        declared_global: Set[str],
+    ) -> Optional[Effect]:
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name in _CLOCK_EXACT:
+                return Effect("clock", name, line, col)
+            if name in _RNG_EXACT or name.startswith(_RNG_PREFIX):
+                return Effect("rng", name, line, col)
+            if name in _IO_EXACT or name.startswith(_IO_PREFIX):
+                return Effect("io", name, line, col)
+            if name in _SYNC_EXACT:
+                # np coercion is legal on static trace-time values; like
+                # OSL101, flag it only on function parameters (tracers)
+                if name.endswith(("asarray", "array")):
+                    if not (
+                        node.args
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in params
+                    ):
+                        return None
+                return Effect("host-sync", name, line, col)
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_METHODS
+                and not node.args
+            ):
+                return Effect("host-sync", f".{node.func.attr}()", line, col)
+            # in-place mutation of a parameter's or global's container
+            if isinstance(node.func, ast.Attribute):
+                from .core import MUTATOR_METHODS
+
+                if node.func.attr in MUTATOR_METHODS:
+                    base = node.func.value
+                    root = base
+                    while isinstance(root, (ast.Attribute, ast.Subscript)):
+                        root = root.value
+                    if isinstance(root, ast.Name) and (
+                        root.id in globals_ or root.id in declared_global
+                        or (root.id == "self" and unit.cls is not None)
+                    ):
+                        return Effect(
+                            "state-write", f"{dotted_name(base)}.{node.func.attr}()",
+                            line, col,
+                        )
+            return None
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in tgts:
+                if isinstance(t, ast.Name) and t.id in declared_global:
+                    return Effect("state-write", f"global {t.id}", line, col)
+                root = t
+                while isinstance(root, (ast.Attribute, ast.Subscript)):
+                    root = root.value
+                if root is t:
+                    continue
+                if isinstance(root, ast.Name):
+                    fname = unit.node.name if isinstance(unit.node, _FuncNode) else ""
+                    if root.id == "self" and unit.cls is not None:
+                        if fname in ("__init__", "__post_init__", "__new__"):
+                            continue
+                        return Effect("state-write", _src_of(t), line, col)
+                    if root.id in globals_ and root.id not in params:
+                        return Effect("state-write", _src_of(t), line, col)
+        return None
+
+    def transitive_effects(self, qual: str) -> Dict[Effect, str]:
+        """Every effect a call to ``qual`` can reach, mapped to the unit
+        that performs it directly. Fixpoint over the call graph; cycles
+        converge because the union only grows."""
+        if self._trans_eff is None:
+            eff: Dict[str, Dict[Effect, str]] = {
+                q: {e: q for e in self.direct_effects(q)} for q in self.units
+            }
+            edges = self.edges()
+            changed = True
+            while changed:
+                changed = False
+                for q, outs in edges.items():
+                    mine = eff[q]
+                    for callee, _node in outs:
+                        for e, origin in eff.get(callee, {}).items():
+                            if e not in mine:
+                                mine[e] = origin
+                                changed = True
+            self._trans_eff = eff
+        return self._trans_eff.get(qual, {})
+
+    # -- jit regions ---------------------------------------------------------
+
+    def jit_roots(self) -> Dict[str, str]:
+        """Unit qual -> reason string ('@jax.jit', 'passed to lax.scan at
+        path:line', 'jit-region marker', 'jit-region-module marker')."""
+        if self._roots is not None:
+            return self._roots
+        roots: Dict[str, str] = {}
+        for qual, unit in self.units.items():
+            node = unit.node
+            if isinstance(node, _FuncNode):
+                for dec in node.decorator_list:
+                    if self._is_jit_decorator(dec):
+                        roots.setdefault(qual, f"@{dotted_name(dec) or 'jax.jit'}")
+                lines = unit.ctx.lines
+                for ln in (node.lineno, node.lineno - 1):
+                    if 1 <= ln <= len(lines) and _JIT_MARK_RE.search(lines[ln - 1]):
+                        roots.setdefault(qual, "jit-region marker")
+        for ctx in self.project.contexts:
+            if any(_JIT_MODULE_MARK_RE.search(l) for l in ctx.lines[:10]):
+                for qual, unit in self.units.items():
+                    if unit.module == ctx.module and isinstance(unit.node, _FuncNode):
+                        roots.setdefault(qual, "jit-region-module marker")
+        # function references handed to tracing entry points
+        for qual, unit in self.units.items():
+            local_assigns: Dict[str, ast.AST] = {}
+            for stmt in self._own_stmts(unit):
+                for sub in self._walk_skip_defs(stmt):
+                    if (
+                        isinstance(sub, ast.Assign)
+                        and len(sub.targets) == 1
+                        and isinstance(sub.targets[0], ast.Name)
+                    ):
+                        local_assigns[sub.targets[0].id] = sub.value
+            for call in self._own_calls(unit):
+                name = dotted_name(call.func)
+                if name not in _TRACING_CALLS:
+                    continue
+                where = f"{name} at {unit.ctx.path}:{getattr(call, 'lineno', 0)}"
+                for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                    self._root_from_ref(unit, arg, where, roots, local_assigns)
+        self._roots = roots
+        return roots
+
+    def _root_from_ref(
+        self,
+        unit: FnUnit,
+        arg: ast.AST,
+        where: str,
+        roots: Dict[str, str],
+        local_assigns: Optional[Dict[str, ast.AST]] = None,
+        _depth: int = 0,
+    ) -> None:
+        if _depth > 4:
+            return
+        if isinstance(arg, ast.Lambda):
+            # the lambda body runs traced: its resolved callees are roots
+            for sub in ast.walk(arg.body):
+                if isinstance(sub, ast.Call):
+                    got = self.resolve_call(unit, sub)
+                    if got is not None:
+                        roots.setdefault(got, f"lambda body, {where}")
+            return
+        if isinstance(arg, ast.Call) and dotted_name(arg.func) in _PARTIAL and arg.args:
+            self._root_from_ref(unit, arg.args[0], where, roots, local_assigns, _depth + 1)
+            return
+        got = self._resolve_func_expr(unit, arg)
+        if got is not None:
+            roots.setdefault(got, f"passed to {where}")
+            return
+        # a local bound earlier in the same body (step = partial(_step, ...))
+        if (
+            isinstance(arg, ast.Name)
+            and local_assigns is not None
+            and arg.id in local_assigns
+        ):
+            self._root_from_ref(
+                unit, local_assigns[arg.id], where, roots, local_assigns, _depth + 1
+            )
+
+    @staticmethod
+    def _is_jit_decorator(dec: ast.AST) -> bool:
+        if dotted_name(dec) in _JIT_DECOR:
+            return True
+        if isinstance(dec, ast.Call):
+            fn = dotted_name(dec.func)
+            if fn in _JIT_DECOR:
+                return True
+            if fn in _PARTIAL:
+                return any(dotted_name(a) in _JIT_DECOR for a in dec.args)
+        return False
+
+    def jit_reachable(self) -> Dict[str, Tuple[str, Tuple[str, ...]]]:
+        """Unit -> (root unit, call chain root..unit exclusive). BFS over
+        the unit call graph from every jit root."""
+        if self._reach is not None:
+            return self._reach
+        edges = self.edges()
+        reach: Dict[str, Tuple[str, Tuple[str, ...]]] = {}
+        from collections import deque
+
+        queue: deque = deque()
+        for root in sorted(self.jit_roots()):
+            if root not in reach:
+                reach[root] = (root, ())
+                queue.append(root)
+        while queue:
+            q = queue.popleft()
+            root, chain = reach[q]
+            for callee, _node in edges.get(q, ()):  # noqa: B007
+                if callee not in reach:
+                    reach[callee] = (root, chain + (q,))
+                    queue.append(callee)
+        self._reach = reach
+        return reach
+
+
+# ---------------------------------------------------------------------------
+# taint engine
+# ---------------------------------------------------------------------------
+
+
+class TaintEngine:
+    """Forward taint over every unit, interprocedural via summaries."""
+
+    MAX_ROUNDS = 8
+
+    def __init__(self, engine: DataflowEngine) -> None:
+        self.df = engine
+        self.summaries: Dict[str, FnSummary] = {}
+
+    def run(self) -> List[SinkHit]:
+        units = self.df.units
+        for _round in range(self.MAX_ROUNDS):
+            changed = False
+            for qual in units:
+                new = self._analyze(qual, collect=False)
+                old = self.summaries.get(qual)
+                if old is None or old.key() != new.key():
+                    self.summaries[qual] = new
+                    changed = True
+            if not changed:
+                break
+        hits: List[SinkHit] = []
+        for qual in units:
+            self._analyze(qual, collect=True, hits=hits)
+        return hits
+
+    # -- per-unit abstract interpretation ------------------------------------
+
+    def _analyze(
+        self,
+        qual: str,
+        collect: bool,
+        hits: Optional[List[SinkHit]] = None,
+    ) -> FnSummary:
+        unit = self.df.units[qual]
+        cfg = self.df.cfg(unit.qual)
+        summary = FnSummary()
+        init: Dict[str, TagSet] = {
+            p: frozenset({Tag("param", p, 0, i)}) for i, p in enumerate(unit.params)
+        }
+        pass_ = _TaintPass(self, unit, summary, collect, hits)
+        forward_analyze(
+            cfg,
+            init,
+            pass_.transfer,
+            _join_states,
+        )
+        return summary
+
+
+def _join_states(a: Dict[str, TagSet], b: Dict[str, TagSet]) -> Dict[str, TagSet]:
+    if a == b:
+        return a
+    out = dict(a)
+    for k, v in b.items():
+        cur = out.get(k)
+        out[k] = v if cur is None else (cur | v)
+    return out
+
+
+class _TaintPass:
+    def __init__(
+        self,
+        engine: TaintEngine,
+        unit: FnUnit,
+        summary: FnSummary,
+        collect: bool,
+        hits: Optional[List[SinkHit]],
+    ) -> None:
+        self.te = engine
+        self.df = engine.df
+        self.unit = unit
+        self.summary = summary
+        self.collect = collect
+        self.hits = hits
+        self._seen_hits: Set[Tuple[int, int, str]] = set()
+
+    # -- transfer ------------------------------------------------------------
+
+    def transfer(self, atom: Atom, state: Dict[str, TagSet]) -> Dict[str, TagSet]:
+        node = atom.node
+        new = state
+        if atom.role == "test":
+            self.eval(node.test if hasattr(node, "test") else node, state)
+            return new
+        if atom.role == "iter" and isinstance(node, (ast.For, ast.AsyncFor)):
+            tags = self.eval(node.iter, state)
+            return self._bind_target(node.target, tags, new)
+        if atom.role == "withitem" and isinstance(node, ast.withitem):
+            tags = self.eval(node.context_expr, state)
+            if node.optional_vars is not None:
+                # file handles etc. do not carry path taint into content
+                return self._bind_target(node.optional_vars, _EMPTY, new)
+            return new
+        if atom.role == "except":
+            return new
+        if atom.role == "return" and isinstance(node, ast.Return):
+            if node.value is not None:
+                tags = self.eval(node.value, state)
+                self._note_return(tags)
+            return new
+        if isinstance(node, ast.Assign):
+            tags = self.eval(node.value, state)
+            for t in node.targets:
+                new = self._bind_target(t, tags, new, state)
+            return new
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            tags = self.eval(node.value, state)
+            return self._bind_target(node.target, tags, new, state)
+        if isinstance(node, ast.AugAssign):
+            tags = self.eval(node.value, state)
+            if isinstance(node.target, ast.Name):
+                prev = state.get(node.target.id, _EMPTY)
+                new = dict(new)
+                new[node.target.id] = prev | tags
+            return new
+        if isinstance(node, ast.Expr):
+            self.eval(node.value, state)
+            return new
+        if isinstance(node, (ast.Assert, ast.Raise)):
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, ast.expr):
+                    self.eval(sub, state)
+            return new
+        if isinstance(node, ast.Delete):
+            new = dict(new)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    new.pop(t.id, None)
+            return new
+        return new
+
+    def _bind_target(
+        self,
+        target: ast.AST,
+        tags: TagSet,
+        new: Dict[str, TagSet],
+        state: Optional[Dict[str, TagSet]] = None,
+    ) -> Dict[str, TagSet]:
+        if isinstance(target, ast.Name):
+            out = dict(new)
+            out[target.id] = tags
+            return out
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out = new
+            for el in target.elts:
+                out = self._bind_target(el, tags, out, state)
+            return out
+        if isinstance(target, ast.Starred):
+            return self._bind_target(target.value, tags, new, state)
+        if isinstance(target, ast.Subscript) and tags:
+            # weak update: d[k] = tainted marks the container
+            root = target.value
+            while isinstance(root, (ast.Attribute, ast.Subscript)):
+                root = root.value
+            if isinstance(root, ast.Name):
+                out = dict(new)
+                out[root.id] = out.get(root.id, _EMPTY) | tags
+                return out
+        return new
+
+    def _note_return(self, tags: TagSet) -> None:
+        for tag in tags:
+            if tag.kind == "param":
+                self.summary.param_to_ret.add(tag.index)
+            elif tag.kind not in ("traced", "traced-param"):
+                self.summary.ret_tags = self.summary.ret_tags | {tag}
+
+    # -- expression evaluation ----------------------------------------------
+
+    def eval(self, expr: ast.AST, state: Dict[str, TagSet]) -> TagSet:
+        if isinstance(expr, ast.Name):
+            got = _SOURCE_NAMES.get(expr.id)
+            if got:
+                return frozenset({Tag(got, expr.id, getattr(expr, "lineno", 0))})
+            return state.get(expr.id, _EMPTY)
+        if isinstance(expr, ast.Constant):
+            return _EMPTY
+        if isinstance(expr, ast.Attribute):
+            name = dotted_name(expr)
+            if name in _SOURCE_NAMES:
+                return frozenset(
+                    {Tag(_SOURCE_NAMES[name], name, getattr(expr, "lineno", 0))}
+                )
+            return self.eval(expr.value, state)
+        if isinstance(expr, ast.Subscript):
+            tags = self.eval(expr.value, state)
+            if isinstance(expr.slice, ast.expr):
+                tags = tags | self.eval(expr.slice, state)
+            return tags
+        if isinstance(expr, ast.Call):
+            return self._eval_call(expr, state)
+        if isinstance(expr, ast.BoolOp):
+            out = _EMPTY
+            for v in expr.values:
+                out = out | self.eval(v, state)
+            return out
+        if isinstance(expr, ast.BinOp):
+            return self.eval(expr.left, state) | self.eval(expr.right, state)
+        if isinstance(expr, ast.UnaryOp):
+            return self.eval(expr.operand, state)
+        if isinstance(expr, ast.IfExp):
+            self.eval(expr.test, state)
+            return self.eval(expr.body, state) | self.eval(expr.orelse, state)
+        if isinstance(expr, ast.Compare):
+            self.eval(expr.left, state)
+            for c in expr.comparators:
+                self.eval(c, state)
+            return _EMPTY  # booleans are clean
+        if isinstance(expr, ast.JoinedStr):
+            out = _EMPTY
+            for v in expr.values:
+                if isinstance(v, ast.FormattedValue):
+                    out = out | self.eval(v.value, state)
+            return out
+        if isinstance(expr, ast.FormattedValue):
+            return self.eval(expr.value, state)
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            out = _EMPTY
+            for el in expr.elts:
+                out = out | self.eval(el, state)
+            return out
+        if isinstance(expr, ast.Dict):
+            out = _EMPTY
+            for k, v in zip(expr.keys, expr.values):
+                if k is not None:
+                    out = out | self.eval(k, state)
+                out = out | self.eval(v, state)
+            return out
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return self._eval_comp(expr, [expr.elt], state)
+        if isinstance(expr, ast.DictComp):
+            return self._eval_comp(expr, [expr.key, expr.value], state)
+        if isinstance(expr, ast.NamedExpr):
+            tags = self.eval(expr.value, state)
+            if isinstance(expr.target, ast.Name):
+                state[expr.target.id] = tags  # in-place: walrus binds here
+            return tags
+        if isinstance(expr, ast.Starred):
+            return self.eval(expr.value, state)
+        if isinstance(expr, ast.Lambda):
+            return _EMPTY
+        if isinstance(expr, ast.Await):
+            return self.eval(expr.value, state)
+        return _EMPTY
+
+    def _eval_comp(self, comp: ast.AST, elts: List[ast.AST], state: Dict[str, TagSet]) -> TagSet:
+        local = dict(state)
+        for gen in comp.generators:
+            tags = self.eval(gen.iter, local)
+            local = self._bind_target(gen.target, tags, local)
+            for cond in gen.ifs:
+                self.eval(cond, local)
+        out = _EMPTY
+        for e in elts:
+            out = out | self.eval(e, local)
+        return out
+
+    # -- calls: sources, sanitizers, sinks, summaries ------------------------
+
+    def _eval_call(self, call: ast.Call, state: Dict[str, TagSet]) -> TagSet:
+        name = dotted_name(call.func)
+        leaf = name.rsplit(".", 1)[-1] if name else ""
+        arg_tags = [self.eval(a, state) for a in call.args]
+        kw_tags = [(kw.arg, self.eval(kw.value, state)) for kw in call.keywords]
+        all_args: TagSet = _EMPTY
+        for t in arg_tags:
+            all_args = all_args | t
+        for _k, t in kw_tags:
+            all_args = all_args | t
+
+        # sinks first: the sink fires on the PRE-call taint of its args
+        self._check_sink(call, name, leaf, arg_tags, kw_tags)
+
+        # sources
+        src_kind = _SOURCE_LEAVES.get(leaf)
+        if src_kind == "stdin" and name != "input":
+            src_kind = None  # x.input(...) is not the builtin
+        if src_kind is not None:
+            return frozenset({Tag(src_kind, name or leaf, getattr(call, "lineno", 0))})
+        if _HTTP_BODY_RE.search(name or ""):
+            return frozenset({Tag("http-body", name, getattr(call, "lineno", 0))})
+
+        # sanitizers
+        if leaf in _COERCION_SANITIZERS:
+            return _EMPTY
+        if leaf in _SANITIZER_LEAVES or (
+            "." in name and name.rsplit(".", 2)[-2] == _SANITIZER_MODULE
+        ):
+            return _EMPTY
+        callee = self.df.resolve_call(self.unit, call)
+        if self.df.is_sanitizer(callee):
+            return _EMPTY
+
+        # interprocedural: apply the callee's summary
+        if callee is not None:
+            return self._apply_summary(call, callee, arg_tags, kw_tags)
+
+        # unresolved call: taint flows args -> result (str(x), x.strip(), json.loads)
+        recv = _EMPTY
+        if isinstance(call.func, ast.Attribute):
+            recv = self.eval(call.func.value, state)
+        return all_args | recv
+
+    def _apply_summary(
+        self,
+        call: ast.Call,
+        callee: str,
+        arg_tags: List[TagSet],
+        kw_tags: List[Tuple[Optional[str], TagSet]],
+    ) -> TagSet:
+        cunit = self.df.units[callee]
+        summ = self.te.summaries.get(callee)
+        if summ is None:
+            # not yet analyzed this round: conservative args->result
+            out = _EMPTY
+            for t in arg_tags:
+                out = out | t
+            for _k, t in kw_tags:
+                out = out | t
+            return out
+        offset = 0
+        if cunit.cls is not None and cunit.params and cunit.params[0] in ("self", "cls"):
+            if isinstance(call.func, ast.Attribute) or callee.endswith(".__init__"):
+                offset = 1
+        index_tags: Dict[int, TagSet] = {}
+        for i, t in enumerate(arg_tags):
+            index_tags[i + offset] = t
+        for k, t in kw_tags:
+            if k is None:
+                continue
+            if k in cunit.params:
+                index_tags[cunit.params.index(k)] = t
+        result: TagSet = frozenset(summ.ret_tags)
+        for idx, tags in index_tags.items():
+            if not tags:
+                continue
+            if idx in summ.param_sinks:
+                self._record_hit(call, summ.param_sinks[idx], tags,
+                                 f"via {callee.rsplit('.', 1)[-1]}()")
+            if idx in summ.param_to_ret:
+                result = result | tags
+        return result
+
+    def _check_sink(
+        self,
+        call: ast.Call,
+        name: str,
+        leaf: str,
+        arg_tags: List[TagSet],
+        kw_tags: List[Tuple[Optional[str], TagSet]],
+    ) -> None:
+        label = None
+        if name in _SINKS_EXACT:
+            label = _SINKS_EXACT[name][0]
+        else:
+            for prefix, lab in _SINK_PREFIXES:
+                if name.startswith(prefix):
+                    label = f"{lab} ({name})"
+                    break
+        if label is None and leaf in _SINK_CTOR_LEAVES:
+            label = _SINK_CTOR_LEAVES[leaf]
+        if label is None:
+            return
+        tainted: TagSet = _EMPTY
+        for t in arg_tags:
+            tainted = tainted | t
+        for _k, t in kw_tags:
+            tainted = tainted | t
+        if tainted:
+            self._record_hit(call, label, tainted, "")
+
+    def _record_hit(self, call: ast.Call, sink: str, tags: TagSet, how: str) -> None:
+        real = frozenset(t for t in tags if not t.is_param)
+        line = getattr(call, "lineno", 0)
+        col = getattr(call, "col_offset", 0)
+        for tag in tags:
+            if tag.kind == "param":
+                prev = self.summary.param_sinks.get(tag.index)
+                if prev is None:
+                    self.summary.param_sinks[tag.index] = sink
+        if not self.collect or not real or self.hits is None:
+            return
+        key = (line, col, sink)
+        if key in self._seen_hits:
+            return
+        self._seen_hits.add(key)
+        srcs = sorted({f"{t.kind}:{t.desc}" + (f"@{t.line}" if t.line else "") for t in real})
+        self.hits.append(
+            SinkHit(
+                unit=self.unit.qual,
+                sink=sink,
+                tags=real,
+                line=line,
+                col=col,
+                desc=(how + " " if how else "") + "sources: " + ", ".join(srcs),
+            )
+        )
+
+
+# ---------------------------------------------------------------------------
+# tracer-leak pass (OSL1602): traced values stored into outliving state
+# ---------------------------------------------------------------------------
+
+_TRACED_PREFIXES = ("jnp.", "jax.numpy.", "lax.", "jax.lax.", "jax.random.", "jax.nn.")
+
+
+class _TracerPass(_TaintPass):
+    """Taint variant for jit-reachable functions: every parameter and
+    every ``jnp.``/``lax.``-family result is a *traced* value; storing one
+    into state that outlives the trace (``self.attr``, a module global, a
+    ``nonlocal``) bakes a tracer into host state — it escapes the trace
+    and either leaks (UnexpectedTracerError later) or goes silently
+    stale."""
+
+    def __init__(self, engine: TaintEngine, unit: FnUnit, hits: List[SinkHit],
+                 globals_: Set[str]) -> None:
+        super().__init__(engine, unit, FnSummary(), True, hits)
+        self.globals_ = globals_
+        self.declared: Set[str] = set()
+        self._assigned: Set[str] = set(unit.params)
+        for stmt in self.df._own_stmts(unit):
+            for sub in self.df._walk_skip_defs(stmt):
+                if isinstance(sub, (ast.Global, ast.Nonlocal)):
+                    self.declared.update(sub.names)
+                elif isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                                      ast.Import, ast.ImportFrom)):
+                    for name, _node in atom_defs(Atom(sub)):
+                        self._assigned.add(name)
+                elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                    for name, _node in atom_defs(Atom(sub, "iter")):
+                        self._assigned.add(name)
+                elif isinstance(sub, ast.withitem):
+                    for name, _node in atom_defs(Atom(sub, "withitem")):
+                        self._assigned.add(name)
+
+    def _outlives(self, target: ast.AST) -> Optional[str]:
+        """Non-None (a label) when a store to ``target`` outlives the
+        trace frame."""
+        root = target
+        while isinstance(root, (ast.Attribute, ast.Subscript)):
+            root = root.value
+        if not isinstance(root, ast.Name):
+            return None
+        if root.id == "self" and self.unit.cls is not None and root is not target:
+            return f"instance state `{_src_of(target)}`"
+        if root.id in self.declared:
+            return f"nonlocal/global `{root.id}`"
+        if root.id in self.globals_ and root.id not in self._assigned:
+            # a module-level name never rebound locally: stores/mutations
+            # through it reach module state (X[k] = v, X.append(v))
+            return f"module state `{_src_of(target)}`"
+        return None
+
+    def _record_leak(self, node: ast.AST, label: str, tags: TagSet) -> None:
+        if not tags or self.hits is None:
+            return
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        key = (line, col, label)
+        if key in self._seen_hits:
+            return
+        self._seen_hits.add(key)
+        srcs = sorted({t.desc or t.kind for t in tags})
+        self.hits.append(
+            SinkHit(unit=self.unit.qual, sink=label, tags=tags, line=line,
+                    col=col, desc="traced value from " + ", ".join(srcs))
+        )
+
+    def transfer(self, atom: Atom, state: Dict[str, TagSet]) -> Dict[str, TagSet]:
+        node = atom.node
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)) and getattr(
+            node, "value", None
+        ) is not None:
+            tags = self.eval(node.value, state)
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                label = self._outlives(t)
+                if label:
+                    self._record_leak(node, label, tags)
+        return super().transfer(atom, state)
+
+    def _eval_call(self, call: ast.Call, state: Dict[str, TagSet]) -> TagSet:
+        from .core import MUTATOR_METHODS
+
+        name = dotted_name(call.func)
+        arg_tags = [self.eval(a, state) for a in call.args]
+        kw_tags = [self.eval(kw.value, state) for kw in call.keywords]
+        all_args: TagSet = _EMPTY
+        for t in arg_tags + kw_tags:
+            all_args = all_args | t
+        if (
+            isinstance(call.func, ast.Attribute)
+            and call.func.attr in MUTATOR_METHODS
+        ):
+            label = self._outlives(call.func.value)
+            if label:
+                self._record_leak(call, f"{label} (.{call.func.attr}())", all_args)
+        if name.startswith(_TRACED_PREFIXES):
+            return frozenset({Tag("traced", name, getattr(call, "lineno", 0))})
+        leaf = name.rsplit(".", 1)[-1] if name else ""
+        if leaf in _COERCION_SANITIZERS:
+            return _EMPTY
+        recv = _EMPTY
+        if isinstance(call.func, ast.Attribute):
+            recv = self.eval(call.func.value, state)
+        return all_args | recv
+
+
+# ---------------------------------------------------------------------------
+# shared per-run instances
+# ---------------------------------------------------------------------------
+
+
+def get_engine(project: ProjectContext) -> DataflowEngine:
+    """One DataflowEngine per ProjectContext (rules in the same run share
+    unit tables, CFGs, effect fixpoints and taint summaries)."""
+    eng = getattr(project, "_dataflow_engine", None)
+    if eng is None:
+        eng = DataflowEngine(project)
+        project._dataflow_engine = eng  # type: ignore[attr-defined]
+    return eng
+
+
+def get_taint_hits(project: ProjectContext) -> List[SinkHit]:
+    """Memoized interprocedural taint run over the whole project."""
+    hits = getattr(project, "_taint_hits", None)
+    if hits is None:
+        hits = TaintEngine(get_engine(project)).run()
+        project._taint_hits = hits  # type: ignore[attr-defined]
+    return hits
+
+
+def get_tracer_leaks(project: ProjectContext) -> List[SinkHit]:
+    """Memoized tracer-leak sweep over every jit-reachable unit."""
+    leaks = getattr(project, "_tracer_leaks", None)
+    if leaks is None:
+        df = get_engine(project)
+        te = TaintEngine(df)
+        leaks = []
+        for qual in sorted(df.jit_reachable()):
+            unit = df.units[qual]
+            if not isinstance(unit.node, _FuncNode):
+                continue
+            pass_ = _TracerPass(te, unit, leaks, df._module_globals.get(unit.module, set()))
+            init = {
+                p: frozenset({Tag("traced-param", p, 0, i)})
+                for i, p in enumerate(unit.params)
+                if not (i == 0 and p in ("self", "cls"))
+            }
+            forward_analyze(df.cfg(qual), init, pass_.transfer, _join_states)
+        project._tracer_leaks = leaks  # type: ignore[attr-defined]
+    return leaks

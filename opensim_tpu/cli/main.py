@@ -1,7 +1,9 @@
 """simon CLI — parity with ``cmd/simon/simon.go``: ``simon {apply, server,
 version, gen-doc}`` with the same flags (``cmd/apply/apply.go:27-36``,
 ``cmd/server/options.go:14``). Log level comes from the ``LogLevel`` env
-(``cmd/simon/simon.go:46-66``)."""
+(``cmd/simon/simon.go:46-66``). Beyond the reference: ``simon lint``
+exposes the opensim-lint static analyzer (docs/static-analysis.md)
+without make."""
 
 from __future__ import annotations
 
@@ -344,6 +346,41 @@ def build_parser() -> argparse.ArgumentParser:
     )
     replay_p.add_argument("-o", "--output-file", default="", help="also write the JSON summary to a file")
 
+    lint_p = sub.add_parser(
+        "lint",
+        help="run the opensim-lint static analyzer (22 OSL rules)",
+        description=(
+            "repo-specific static analyzer (docs/static-analysis.md): AST "
+            "rules, whole-program lock-discipline checks, and the "
+            "interprocedural dataflow pack (jit-impurity, tracer-leak, "
+            "input-taint, C++/Python abi-parity). Exit 1 on findings."
+        ),
+    )
+    lint_p.add_argument(
+        "lint_paths", nargs="*", metavar="PATH",
+        help="files/directories to lint (default: [tool.opensim-lint] "
+        "paths in ./pyproject.toml, else opensim_tpu)",
+    )
+    lint_p.add_argument("--rules", default="", help="comma-separated rule names/codes (default: all)")
+    lint_p.add_argument(
+        "--format", default="", choices=["", "human", "json", "sarif"],
+        help="output format (sarif = SARIF 2.1.0 for CI/editor annotation)",
+    )
+    lint_p.add_argument("--list-rules", action="store_true", help="list registered rules and exit")
+    lint_p.add_argument(
+        "--cache", default="", metavar="PATH",
+        help="content-hash result cache (unchanged files skip their rules)",
+    )
+    lint_p.add_argument("--no-cache", action="store_true", help="disable the result cache")
+    lint_p.add_argument(
+        "--sarif-out", default="", metavar="PATH",
+        help="also write SARIF to this path (stable CI artifact)",
+    )
+    lint_p.add_argument(
+        "--corpus", default="", metavar="DIR",
+        help="run the detector-awake fixture gate over DIR after linting",
+    )
+
     sub.add_parser("version", help="print version", description="print version and commit id")
 
     doc_p = sub.add_parser(
@@ -387,21 +424,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     if args.command == "apply":
         from ..planner.apply import Applier, Options
+        from ..utils import validate
 
-        opts = Options(
-            simon_config=args.simon_config,
-            default_scheduler_config=args.default_scheduler_config,
-            output_file=args.output_file,
-            use_greed=args.use_greed,
-            enable_preemption=args.enable_preemption,
-            interactive=args.interactive,
-            extended_resources=[r for r in args.extended_resources.split(",") if r],
-            report_pods=args.report_pods,
-            max_new_nodes=args.max_new_nodes,
-            tie_break=args.tie_break,
-            explain=args.explain,
-        )
         try:
+            # validator rejections render the same one-liner as run errors
+            opts = Options(
+                simon_config=validate.user_path(args.simon_config, label="--simon-config"),
+                default_scheduler_config=validate.user_path(
+                    args.default_scheduler_config, label="--default-scheduler-config",
+                    allow_empty=True,
+                ),
+                output_file=validate.user_path(
+                    args.output_file, label="--output-file", allow_empty=True
+                ),
+                use_greed=args.use_greed,
+                enable_preemption=args.enable_preemption,
+                interactive=args.interactive,
+                extended_resources=[r for r in args.extended_resources.split(",") if r],
+                report_pods=args.report_pods,
+                max_new_nodes=args.max_new_nodes,
+                tie_break=args.tie_break,
+                explain=args.explain,
+            )
             if not args.trace:
                 return Applier(opts).run()
             # span-trace the whole apply run and export Chrome-trace JSON
@@ -452,10 +496,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.access_log:
             os.environ["OPENSIM_ACCESS_LOG"] = "1"
         native.available()  # warm the C++ engine build before the first request
-        return serve(
-            kubeconfig=args.kubeconfig, master=args.master, port=args.port,
-            watch=args.watch, journal=args.journal,
-        )
+        try:
+            return serve(
+                kubeconfig=args.kubeconfig, master=args.master, port=args.port,
+                watch=args.watch, journal=args.journal,
+            )
+        except ValueError as e:
+            # serve()'s path validators reject control characters
+            print(f"simon server: {e}", file=sys.stderr)
+            return 1
     if args.command == "replay":
         try:
             return run_replay(args)
@@ -479,8 +528,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         line = _json.dumps(report, sort_keys=True)
         print(line)
         if args.output_file:
-            with open(args.output_file, "w") as f:
-                f.write(line + "\n")
+            from ..utils import validate
+
+            try:
+                with open(validate.user_path(args.output_file, label="--output-file"), "w") as f:
+                    f.write(line + "\n")
+            except (OSError, ValueError) as e:
+                print(f"simon loadgen: {e}", file=sys.stderr)
+                return 1
         return 0
     if args.command == "top":
         try:
@@ -491,8 +546,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         return run_mem(args)
     if args.command == "profile":
         return run_profile(args)
+    if args.command == "lint":
+        # same engine as `python -m opensim_tpu.analysis` / `make lint`:
+        # forward the flags so the analyzer stays reachable without make
+        from ..analysis.__main__ import main as lint_main
+
+        argv2: List[str] = list(args.lint_paths)
+        if args.rules:
+            argv2 += ["--rules", args.rules]
+        if args.format:
+            argv2 += ["--format", args.format]
+        if args.list_rules:
+            argv2.append("--list-rules")
+        if args.cache:
+            argv2 += ["--cache", args.cache]
+        if args.no_cache:
+            argv2.append("--no-cache")
+        if args.sarif_out:
+            argv2 += ["--sarif-out", args.sarif_out]
+        if args.corpus:
+            argv2 += ["--corpus", args.corpus]
+        return lint_main(argv2)
     if args.command == "gen-doc":
-        return gen_doc(parser, args.output_dir)
+        try:
+            return gen_doc(parser, args.output_dir)
+        except (OSError, ValueError) as e:
+            print(f"simon gen-doc: {e}", file=sys.stderr)
+            return 1
     parser.print_help()
     return 2
 
@@ -562,8 +642,11 @@ def run_defrag(args) -> int:
     from ..planner.apply import Applier, Options
     from ..planner.defrag import plan_drains
     from ..planner.report import _table, drain_plan_rows
+    from ..utils import validate
 
-    applier = Applier(Options(simon_config=args.simon_config))
+    applier = Applier(
+        Options(simon_config=validate.user_path(args.simon_config, label="--simon-config"))
+    )
     cluster = applier.load_cluster()
     apps = applier.load_apps()
 
@@ -576,7 +659,11 @@ def run_defrag(args) -> int:
             return 1
     result = plan_drains(cluster, apps, candidates=candidates)
     rows = drain_plan_rows(result.plans)
-    out = open(args.output_file, "w") if args.output_file else sys.stdout
+    out = (
+        open(validate.user_path(args.output_file, label="--output-file"), "w")
+        if args.output_file
+        else sys.stdout
+    )
     try:
         if args.json:
             print(
@@ -609,14 +696,16 @@ def run_campaign_cmd(args) -> int:
 
     from ..planner import campaign as campaign_mod
     from ..planner.report import render_campaign
+    from ..utils import validate
 
-    spec = campaign_mod.load_campaign(args.spec)
+    spec_path = validate.user_path(args.spec, label="spec")
+    spec = campaign_mod.load_campaign(spec_path)
     if args.url:
         import urllib.error
         import urllib.request
         import yaml as _yaml
 
-        with open(args.spec) as fh:
+        with open(spec_path) as fh:
             doc = _yaml.safe_load(fh) or {}
         body = _json.dumps(
             {
@@ -652,7 +741,7 @@ def run_campaign_cmd(args) -> int:
     else:
         render_campaign(result, out)
     if args.output_file:
-        with open(args.output_file, "w") as fh:
+        with open(validate.user_path(args.output_file, label="--output-file"), "w") as fh:
             fh.write(_json.dumps(result, sort_keys=True) + "\n")
     # a campaign that left evictions blocked or pods unschedulable is a
     # finding, not a failure: exit 0 with the verdict in the report
@@ -898,7 +987,9 @@ def run_replay(args) -> int:
     line = _json.dumps(summary, sort_keys=True)
     print(line)
     if args.output_file:
-        with open(args.output_file, "w") as f:
+        from ..utils import validate
+
+        with open(validate.user_path(args.output_file, label="--output-file"), "w") as f:
             f.write(line + "\n")
     return 0
 
@@ -935,11 +1026,16 @@ def run_explain(args) -> int:
     from ..engine.simulator import simulate
     from ..planner.apply import Applier, Options
 
+    from ..utils import validate
+
     applier = Applier(
         Options(
-            simon_config=args.simon_config,
-            default_scheduler_config=args.default_scheduler_config,
-            use_greed=args.use_greed,
+            simon_config=validate.user_path(args.simon_config, label="--simon-config"),
+            default_scheduler_config=validate.user_path(
+                args.default_scheduler_config, label="--default-scheduler-config",
+                allow_empty=True,
+            ),
+            use_greed=bool(args.use_greed),
         )
     )
     cluster = applier.load_cluster()
@@ -1054,6 +1150,9 @@ def gen_doc(parser: argparse.ArgumentParser, output_dir: str) -> int:
     """Markdown CLI docs — one file per subcommand plus a root index, the
     same tree cobra/doc emits for the reference
     (cmd/doc/generate_markdown.go:33 → docs/commandline/simon_apply.md …)."""
+    from ..utils import validate
+
+    output_dir = validate.user_path(output_dir, label="--output-dir")
     os.makedirs(output_dir, exist_ok=True)
     sub_actions = [a for a in parser._actions if isinstance(a, argparse._SubParsersAction)]
     commands = []

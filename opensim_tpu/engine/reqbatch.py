@@ -38,7 +38,12 @@ from ..models.objects import ResourceTypes
 from ..obs import trace as obs
 from ..resilience.deadline import Deadline, DeadlineExceeded
 from ..utils import envknobs
-from .scheduler import ScheduleOutput, pad_pod_stream, scan_unroll, schedule_pods
+from .scheduler import (
+    ScheduleOutput,
+    _schedule_pods_jit as _schedule_pods_traced,
+    pad_pod_stream,
+    scan_unroll,
+)
 from .simulator import (
     AppResource,
     EngineDecision,
@@ -96,9 +101,16 @@ def _batched_schedule(ec, st0, tmpl_ids, pod_valid_masks, forced, features, unro
     """ALL requests in ONE compiled dispatch: ``jax.vmap`` over the
     per-request pod-validity masks prepends a request axis to the scan
     (shared EncodedCluster/ScanState operands are not duplicated). Module
-    level + jitted so repeat batch shapes hit the jit cache."""
+    level + jitted so repeat batch shapes hit the jit cache.
+
+    The vmapped body calls the raw jit entry, not the observed
+    ``schedule_pods`` wrapper: inside this trace the compile watch's
+    host-side bookkeeping (locks, clocks, signature dicts) must not run —
+    OSL1601 gates that statically. THIS boundary is the one the compile
+    watch instruments instead (the ``observed_jit_call`` at the dispatch
+    site below)."""
     return jax.vmap(
-        lambda pv: schedule_pods(
+        lambda pv: _schedule_pods_traced(
             ec, st0, tmpl_ids, pv, forced, features=features, unroll=unroll
         )
     )(pod_valid_masks)
@@ -233,9 +245,18 @@ def run_request_batch(
         with obs.span("engine.xla", requests=len(items), pods=P):
             import jax.numpy as jnp
 
-            batched = _batched_schedule(
-                prep.ec, prep.st0, jnp.asarray(tmpl_p), jnp.asarray(pv_all),
-                jnp.asarray(forced_p), prep.features, scan_unroll(),
+            from ..obs.profile import observed_jit_call
+
+            # the batch dispatch is the outer jit boundary: the compile
+            # watch observes it HERE, on the host, never under the trace
+            batched = observed_jit_call(
+                "batched_schedule",
+                _batched_schedule,
+                args=(
+                    prep.ec, prep.st0, jnp.asarray(tmpl_p), jnp.asarray(pv_all),
+                    jnp.asarray(forced_p),
+                ),
+                static={"features": prep.features, "unroll": scan_unroll()},
             )
             jax.block_until_ready(batched.chosen)
         outs = [_slice_output(batched, s, P) for s in range(len(items))]

@@ -65,7 +65,7 @@ class ScheduleOutput(NamedTuple):
     filter_rejects: Optional[object] = None
 
 
-def _step(ec: EncodedCluster, stat, feat, cfg, extra, st: ScanState, x, select_key=None,
+def _step(ec: EncodedCluster, stat, feat, cfg, extra, st: ScanState, x, select_key=None,  # opensim-lint: jit-region
           count_all=False):
     u, pod_valid, forced = x
     # Pre-bound pods (spec.nodeName set) bypass the scheduler in the

@@ -28,6 +28,11 @@ from typing import Optional
 _HERE = Path(__file__).resolve().parent
 _SRC = _HERE / "scan_engine.cc"
 
+#: expected ``opensim_abi_version()`` — the machine-readable anchor the
+#: OSL1604 abi-parity pass checks against scan_engine.cc, and the runtime
+#: load gate below checks against the compiled library
+ABI_VERSION = 4
+
 _DIMS = [
     "N", "R", "U", "P", "Tk", "Dp1", "A", "Hp", "Hports", "Cs", "Ti", "Tn",
     "Tpp", "G", "Gp", "Gd", "Vg", "Dv", "Mv", "res_cpu", "res_mem", "res_gc",
@@ -191,6 +196,12 @@ def load() -> Optional[ctypes.CDLL]:
         return None
     lib.opensim_args_size.restype = ctypes.c_int64
     lib.opensim_abi_version.restype = ctypes.c_int64
+    if lib.opensim_abi_version() != ABI_VERSION:
+        _lib_error = (
+            f"ABI version mismatch: library reports v{lib.opensim_abi_version()} "
+            f"but this binding expects v{ABI_VERSION}"
+        )
+        return None
     if lib.opensim_args_size() != ctypes.sizeof(ScanArgs):
         _lib_error = (
             f"ABI mismatch: C sizeof(ScanArgs)={lib.opensim_args_size()} vs "

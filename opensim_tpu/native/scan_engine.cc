@@ -43,8 +43,12 @@ static inline uint64_t sm64_next(uint64_t* x) {
   return z ^ (z >> 31);
 }
 
+// abi-begin: ScanArgs
+// Field count, order, and widths are gated against the ctypes mirror in
+// native/__init__.py by the OSL1604 abi-parity pass (make lint): drift on
+// either side fails the build naming the exact field.
 struct ScanArgs {
-  // --- dims (all int64; keep order in sync with native/__init__.py) ---
+  // --- dims (all int64; mirrored by native/__init__.py _DIMS) ---
   int64_t N, R, U, P, Tk, Dp1, A, Hp, Hports, Cs, Ti, Tn, Tpp, G, Gp, Gd, Vg, Dv, Mv;
   int64_t res_cpu, res_mem;
   int64_t res_gc;  // resource row of alibabacloud.com/gpu-count (-1 absent)
@@ -151,6 +155,7 @@ struct ScanArgs {
   const int32_t* static_fail;  // [U,4]
   int64_t* filter_rejects;     // [11]
 };
+// abi-end: ScanArgs
 
 int64_t opensim_abi_version() { return 4; }
 int64_t opensim_args_size() { return (int64_t)sizeof(ScanArgs); }

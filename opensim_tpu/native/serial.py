@@ -40,6 +40,11 @@ _NODE_OPS = {**_LABEL_OPS, "Gt": 4, "Lt": 5}
 HOSTNAME = "kubernetes.io/hostname"
 ZONE = "topology.kubernetes.io/zone"
 
+#: wire-format tag ("SRL1", version 1) — machine-readable anchors the
+#: OSL1604 abi-parity pass checks against serial_engine.cc's header guards
+WIRE_MAGIC = 0x53524C31
+WIRE_VERSION = 1
+
 
 class _Buf:
     def __init__(self):
@@ -309,8 +314,8 @@ def marshal(nodes, stream: List[Tuple[Pod, bool]]) -> bytes:
     from ..engine.simulator import _tmpl_hint
 
     b = _Buf()
-    b.u32(0x53524C31)  # "SRL1"
-    b.u32(1)
+    b.u32(WIRE_MAGIC)  # "SRL1"
+    b.u32(WIRE_VERSION)
     b.u32(len(nodes))
     for n in nodes:
         _put_node(b, n)

@@ -510,7 +510,7 @@ class StaticTables(NamedTuple):
     spread_weight: jnp.ndarray  # [Tk] f32 log(domain count + 2) per topology key
 
 
-def precompute_static(ec, cfg=None) -> StaticTables:
+def precompute_static(ec, cfg=None) -> StaticTables:  # opensim-lint: jit-region
     """NodeName pinning is handled by the forced-bind path in the scan step
     (pods with spec.nodeName never reach the scheduler, reference
     simulator.go:329-331), so the pin filter is NOT part of static_pass —
@@ -978,7 +978,7 @@ def score_parts(
     return parts
 
 
-def pod_step(
+def pod_step(  # opensim-lint: jit-region
     ec, stat: StaticTables, st, u, feat: Features = ALL_FEATURES, cfg=None, extra: tuple = (),
     count_all: bool = False,
 ) -> StepResult:
@@ -1085,7 +1085,7 @@ def pod_step(
     )
 
 
-def bind_update(ec, st, u, node, apply, feat: Features = ALL_FEATURES):
+def bind_update(ec, st, u, node, apply, feat: Features = ALL_FEATURES):  # opensim-lint: jit-region
     """State transition on bind — the tensorized equivalent of the Reserve +
     Bind plugin chain writing back into the fake clientset
     (plugin/simon.go:104-126, open-gpu-share.go:147-245, open-local.go:175-254).

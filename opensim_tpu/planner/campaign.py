@@ -35,6 +35,7 @@ this module). See docs/campaigns.md for the spec schema and step catalog.
 
 from __future__ import annotations
 
+import contextlib
 import copy
 import hashlib
 import logging
@@ -59,7 +60,7 @@ from ..models.objects import (
     Workload,
 )
 from ..models.selectors import match_label_selector
-from ..utils import envknobs
+from ..utils import envknobs, validate
 
 log = logging.getLogger("opensim_tpu.planner")
 
@@ -317,10 +318,47 @@ def load_campaign(path: str) -> CampaignSpec:
 #: base dir for relative paths inside step specs (set while parsing a file)
 _BASE_DIR: List[str] = [""]
 
+#: False while evaluating a campaign submitted over the REST API: a remote
+#: caller must not make the SERVER dereference filesystem paths (the paths
+#: are client-local anyway) — see :func:`remote_spec_context`
+_ALLOW_PATHS: List[bool] = [True]
 
+
+@contextlib.contextmanager
+def remote_spec_context():
+    """Evaluate a remotely-submitted campaign: any step field that names a
+    filesystem path is rejected with a typed :class:`CampaignError`
+    instead of being opened server-side (arbitrary-file-read hardening;
+    REST campaigns inline their manifests)."""
+    prev = _ALLOW_PATHS[0]
+    _ALLOW_PATHS[0] = False
+    try:
+        yield
+    finally:
+        _ALLOW_PATHS[0] = prev
+
+
+@validate.sanitizer
 def _resolve_path(p: str) -> str:
-    base = _BASE_DIR[0]
-    return p if os.path.isabs(p) or not base else os.path.join(base, p)
+    """The campaign planner's registered validator (OSL1603): every path
+    a campaign YAML names passes through here — remote campaigns may not
+    name server paths at all, control characters are rejected, and
+    relative paths resolve against (and must stay under) the spec's
+    directory. Rejections surface as :class:`CampaignError` so the
+    CLI/REST surfaces keep the typed one-liner (400, not a generic 500)."""
+    if not _ALLOW_PATHS[0]:
+        raise CampaignError(
+            "file paths are not allowed in campaigns submitted over the "
+            "REST API (the server will not dereference them); inline the "
+            "manifests instead",
+            field="path",
+        )
+    try:
+        return validate.child_path(_BASE_DIR[0], p, label="campaign path")
+    except CampaignError:
+        raise
+    except ValueError as e:
+        raise CampaignError(str(e), field="path") from e
 
 
 # ---------------------------------------------------------------------------
@@ -1703,20 +1741,25 @@ def run_campaign(
         _BASE_DIR[0] = prev
 
 
+def _cluster_path(base: str, p: str, field: str) -> str:
+    try:
+        return validate.child_path(base, p, label=field)
+    except ValueError as e:
+        raise CampaignError(str(e), field="cluster") from e
+
+
 def load_campaign_cluster(spec: CampaignSpec) -> ResourceTypes:
     """The cluster a file-based campaign runs against (``spec.cluster``:
     ``customConfig`` yaml dir or ``kubeConfig``)."""
     custom = spec.cluster.get("customConfig", "")
     kube = spec.cluster.get("kubeConfig", "")
     if custom:
-        base = spec.base_dir
-        path = custom if os.path.isabs(custom) or not base else os.path.join(base, custom)
+        path = _cluster_path(spec.base_dir, custom, "spec.cluster.customConfig")
         return expand.load_cluster_from_dir(path)
     if kube:
         from ..server.snapshot import cluster_from_kubeconfig
 
-        base = spec.base_dir
-        path = kube if os.path.isabs(kube) or not base else os.path.join(base, kube)
+        path = _cluster_path(spec.base_dir, kube, "spec.cluster.kubeConfig")
         return cluster_from_kubeconfig(path)
     raise CampaignError(
         "spec.cluster needs customConfig or kubeConfig (or run the campaign "

@@ -720,7 +720,8 @@ class SimonServer:
         from ..planner import campaign as campaign_mod
 
         try:
-            steps = campaign_mod.parse_steps(payload.get("steps"))
+            with campaign_mod.remote_spec_context():
+                steps = campaign_mod.parse_steps(payload.get("steps"))
         except campaign_mod.CampaignError as e:
             return 400, {"error": str(e), "step": e.step, "field": e.field}
         name = str(payload.get("name") or "campaign")
@@ -730,9 +731,14 @@ class SimonServer:
                 with self._campaign_lock:
                     with tracing.span("campaign", steps=len(steps)):
                         cluster, _key = self._observed_cluster()
-                        result = campaign_mod.run_campaign(
-                            cluster, steps, mode=mode, name=name
-                        )
+                        # remote spec: step run() must not dereference
+                        # server-side paths either (deploy _load at run
+                        # time, from-journal reads) — the same gate holds
+                        # for the whole evaluation
+                        with campaign_mod.remote_spec_context():
+                            result = campaign_mod.run_campaign(
+                                cluster, steps, mode=mode, name=name
+                            )
             return 200, result.to_dict()
         except DeadlineExceeded as e:
             return 504, {"error": str(e), "phase": e.phase, "retryable": True}
@@ -1579,6 +1585,13 @@ def serve(
     fsynced, and the process exits 0.
     """
     import signal
+
+    from ..utils import validate
+
+    # registered validators (OSL1603): the CLI hands these straight from
+    # argv; reject control characters before they reach open()/makedirs
+    kubeconfig = validate.user_path(kubeconfig, label="--kubeconfig", allow_empty=True)
+    journal = validate.user_path(journal, label="--journal", allow_empty=True)
 
     if watch == "on" and not kubeconfig:
         # "require a synced twin" with nothing to sync FROM is an operator

@@ -1,0 +1,9 @@
+# lint-corpus-path: opensim_tpu/server/admission.py
+class Controller:
+    def consume(self):
+        with self._cond:
+            while not self._queue and not self._closed:
+                self._cond.wait()  # the one legal wait: on the held cond
+            item = self._queue.popleft()
+            self._cond.notify_all()
+        return item

@@ -1,0 +1,10 @@
+# lint-corpus-path: opensim_tpu/server/admission.py
+import time
+
+
+class Controller:
+    def submit(self, t):
+        with self._cond:
+            time.sleep(0.1)  # blocking I/O while holding the dispatch lock
+            self._queue.append(t)
+            self._cond.notify()

@@ -1,0 +1,14 @@
+# lint-corpus-path: opensim_tpu/engine/fixture.py
+import time
+
+import jax
+
+
+@jax.jit
+def step(x):
+    return x + 1
+
+
+def host_driver(xs):
+    t0 = time.monotonic()  # fine: not traced
+    return step(xs), time.monotonic() - t0

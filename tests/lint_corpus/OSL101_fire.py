@@ -1,0 +1,10 @@
+# lint-corpus-path: opensim_tpu/engine/fixture.py
+import time
+
+import jax
+
+
+@jax.jit
+def step(x):
+    t = time.monotonic()  # host clock baked in at trace time
+    return x + t

@@ -1,0 +1,4 @@
+# lint-corpus-path: opensim_tpu/obs/capacity_fixture.py
+from opensim_tpu.obs.metrics import CounterVec
+
+REQS = CounterVec("simon_fixture_total", "ad-hoc family off the registry")

@@ -1,0 +1,15 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  # guarded-by: _lock
+
+    def good(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def bad(self, x):
+        self._items.append(x)  # touched outside the critical section

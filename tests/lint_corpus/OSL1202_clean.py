@@ -1,0 +1,17 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+def one():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+
+
+def two():
+    with LOCK_A:
+        with LOCK_B:  # same order everywhere: no cycle
+            pass

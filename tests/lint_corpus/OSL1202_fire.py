@@ -1,0 +1,17 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+def ab():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+
+
+def ba():
+    with LOCK_B:
+        with LOCK_A:  # A->B and B->A: inversion cycle
+            pass

@@ -1,0 +1,11 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def fine():
+    time.sleep(0.1)  # outside the critical section
+    with _lock:
+        pass

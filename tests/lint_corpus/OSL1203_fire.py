@@ -1,0 +1,10 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def bad_sleep():
+    with _lock:
+        time.sleep(0.1)  # blocks every waiter of _lock

@@ -1,0 +1,13 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+import threading
+
+from opensim_tpu.resilience.deadline import current_deadline, deadline_scope
+
+
+def worker(dl):
+    with deadline_scope(dl):  # explicit handoff
+        return current_deadline()
+
+
+def spawn(dl):
+    threading.Thread(target=worker, args=(dl,)).start()

@@ -1,0 +1,9 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+import threading
+
+from opensim_tpu.resilience.deadline import check_deadline
+
+
+class Worker(threading.Thread):
+    def run(self):
+        check_deadline("phase")  # ambient contextvar read in a new thread

@@ -1,0 +1,3 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+def inspect():
+    return open("state/journal-00000001.seg", "rb")  # read-only: legal

@@ -1,0 +1,3 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+def grab():
+    return open("state/journal-00000001.seg", "ab")  # foreign journal write

@@ -1,0 +1,7 @@
+# lint-corpus-path: opensim_tpu/engine/fixture.py
+import os
+
+from opensim_tpu.utils import envknobs
+
+FLAG = envknobs.raw("OPENSIM_EAGER_PREPARE", "0")  # the registry read path
+os.environ["OPENSIM_FIXTURE_FLAG"] = "1"  # writes stay legal

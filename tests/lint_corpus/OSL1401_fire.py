@@ -1,0 +1,4 @@
+# lint-corpus-path: opensim_tpu/engine/fixture.py
+import os
+
+FLAG = os.environ.get("OPENSIM_FIXTURE_FLAG", "0")  # unregistered knob read

@@ -1,0 +1,5 @@
+# lint-corpus-path: opensim_tpu/planner/campaign.py
+def dispatch(step, drain, other):
+    if step == "drain-wave":  # the registry module owns step dispatch
+        return drain()
+    return other()

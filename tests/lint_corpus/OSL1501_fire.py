@@ -1,0 +1,5 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+def dispatch(step, drain, other):
+    if step == "drain-wave":  # ad-hoc step dispatch outside the registry
+        return drain()
+    return other()

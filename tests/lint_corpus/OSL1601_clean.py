@@ -1,0 +1,21 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+import time
+
+import jax
+
+
+def helper(c):
+    return c * 2
+
+
+def body(carry, x):
+    return helper(carry), x
+
+
+def outer(xs):
+    return jax.lax.scan(body, 0, xs)
+
+
+def host_driver(xs):
+    t0 = time.time()  # not reachable from the traced region
+    return outer(xs), time.time() - t0

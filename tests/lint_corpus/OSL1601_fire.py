@@ -1,0 +1,16 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+import time
+
+import jax
+
+
+def helper(c):
+    return c * time.time()  # clock read two call levels under the trace
+
+
+def body(carry, x):
+    return helper(carry), x
+
+
+def outer(xs):
+    return jax.lax.scan(body, 0, xs)

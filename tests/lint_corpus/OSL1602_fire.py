@@ -1,0 +1,11 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+import jax
+import jax.numpy as jnp
+
+
+class Recorder:
+    @jax.jit
+    def step(self, x):
+        y = jnp.sum(x)
+        self.last = y  # tracer stored into state that outlives the trace
+        return y

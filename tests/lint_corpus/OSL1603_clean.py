@@ -1,0 +1,17 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+from urllib.parse import parse_qs
+
+from opensim_tpu.utils.validate import sanitizer
+
+
+@sanitizer
+def report_name(raw):
+    if not raw.isidentifier():
+        raise ValueError(f"invalid report name {raw!r}")
+    return raw
+
+
+def handler(query):
+    name = report_name(parse_qs(query).get("f", [""])[-1])
+    with open(name) as fh:  # validated first: clean
+        return fh.read()

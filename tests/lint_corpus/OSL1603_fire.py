@@ -1,0 +1,8 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+from urllib.parse import parse_qs
+
+
+def handler(query):
+    name = parse_qs(query).get("f", [""])[-1]
+    with open(name) as fh:  # http-query taint straight into open()
+        return fh.read()

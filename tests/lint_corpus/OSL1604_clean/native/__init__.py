@@ -1,0 +1,15 @@
+import ctypes
+
+_DIMS = ["N", "R"]
+_WEIGHTS = ["w_x"]
+_U8 = ctypes.POINTER(ctypes.c_uint8)
+_BUFFERS = [("node_valid", _U8, "u8")]
+ABI_VERSION = 4
+
+
+class ScanArgs(ctypes.Structure):
+    _fields_ = (
+        [(n, ctypes.c_int64) for n in _DIMS]
+        + [(n, ctypes.c_double) for n in _WEIGHTS]
+        + [(n, t) for n, t, _ in _BUFFERS]
+    )

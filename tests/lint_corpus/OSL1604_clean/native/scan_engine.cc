@@ -1,0 +1,9 @@
+// faithful copy: layouts byte-agree
+// abi-begin: ScanArgs
+struct ScanArgs {
+  int64_t N, R;
+  double w_x;
+  const uint8_t* node_valid;
+};
+// abi-end: ScanArgs
+int64_t opensim_abi_version() { return 4; }

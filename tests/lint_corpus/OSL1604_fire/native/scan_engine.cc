@@ -1,0 +1,9 @@
+// mutated copy: the dims order drifted (R before N) vs the ctypes mirror
+// abi-begin: ScanArgs
+struct ScanArgs {
+  int64_t R, N;
+  double w_x;
+  const uint8_t* node_valid;
+};
+// abi-end: ScanArgs
+int64_t opensim_abi_version() { return 4; }

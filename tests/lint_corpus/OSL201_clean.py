@@ -1,0 +1,8 @@
+# lint-corpus-path: opensim_tpu/encoding/fixture.py
+import numpy as np
+
+from opensim_tpu.encoding.dtypes import FLOAT_DTYPE
+
+
+def build(n):
+    return np.zeros((n,), dtype=FLOAT_DTYPE)

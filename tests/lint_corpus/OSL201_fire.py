@@ -1,0 +1,6 @@
+# lint-corpus-path: opensim_tpu/encoding/fixture.py
+import numpy as np
+
+
+def build(n):
+    return np.zeros((n,))  # default dtype drifts off the Go parity policy

@@ -1,0 +1,9 @@
+# lint-corpus-path: opensim_tpu/engine/fixture.py
+import hashlib
+
+
+def fingerprint(d):
+    h = hashlib.blake2b()
+    for k in sorted(d.items()):
+        h.update(str(k).encode())
+    return h.hexdigest()

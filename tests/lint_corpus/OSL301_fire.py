@@ -1,0 +1,9 @@
+# lint-corpus-path: opensim_tpu/engine/fixture.py
+import hashlib
+
+
+def fingerprint(d):
+    h = hashlib.blake2b()
+    for k, v in d.items():  # dict order feeds the hash
+        h.update(str((k, v)).encode())
+    return h.hexdigest()

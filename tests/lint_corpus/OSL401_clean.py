@@ -1,0 +1,9 @@
+# lint-corpus-path: opensim_tpu/engine/fixture.py
+from opensim_tpu.engine.prepcache import fingerprint_cluster
+
+
+def fixed(cluster, cache, extra_pod):
+    fp = fingerprint_cluster(cluster)
+    cluster.pods.append(extra_pod)
+    cache.invalidate(cluster)  # the sanctioned escape
+    return fp

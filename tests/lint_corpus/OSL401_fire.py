@@ -1,0 +1,8 @@
+# lint-corpus-path: opensim_tpu/engine/fixture.py
+from opensim_tpu.engine.prepcache import fingerprint_cluster
+
+
+def bad(cluster, extra_pod):
+    fp = fingerprint_cluster(cluster)
+    cluster.pods.append(extra_pod)  # mutation after the content was keyed
+    return fp

@@ -1,0 +1,6 @@
+# lint-corpus-path: opensim_tpu/engine/fixture.py
+def translated(risky):
+    try:
+        risky()
+    except Exception as e:
+        raise RuntimeError(str(e)) from e

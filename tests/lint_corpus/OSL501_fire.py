@@ -1,0 +1,6 @@
+# lint-corpus-path: opensim_tpu/engine/fixture.py
+def swallow(risky):
+    try:
+        risky()
+    except Exception:
+        pass

@@ -1,0 +1,13 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+import time
+import urllib.request
+
+
+def fetch(url, attempts=3):
+    for k in range(attempts):
+        try:
+            return urllib.request.urlopen(url)
+        except OSError:
+            if k == attempts - 1:
+                raise
+            time.sleep(0.1 * 2 ** k)  # bounded + exponential backoff

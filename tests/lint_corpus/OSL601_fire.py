@@ -1,0 +1,10 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+import urllib.request
+
+
+def fetch(url):
+    while True:
+        try:
+            return urllib.request.urlopen(url)
+        except OSError:
+            pass  # swallow and hammer forever

@@ -1,0 +1,9 @@
+# lint-corpus-path: opensim_tpu/engine/fixture.py
+from opensim_tpu.obs import trace as obs
+from opensim_tpu.resilience.deadline import check_deadline
+
+
+def prepare_things(cluster, encode):
+    check_deadline("prepare")
+    with obs.span("prepare"):
+        return encode(cluster)

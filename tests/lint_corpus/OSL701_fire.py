@@ -1,0 +1,7 @@
+# lint-corpus-path: opensim_tpu/engine/fixture.py
+from opensim_tpu.resilience.deadline import check_deadline
+
+
+def prepare_things(cluster, encode):
+    check_deadline("prepare")  # phase boundary with no span
+    return encode(cluster)

@@ -1,0 +1,5 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+def follow(client, rv, handle, stop):
+    while not stop.is_set():  # supervised condition
+        for ev in client.watch("pods", rv):
+            handle(ev)

@@ -1,0 +1,8 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+def follow(client, rv, handle):
+    while True:
+        try:
+            for ev in client.watch("pods", rv):
+                handle(ev)
+        except OSError:
+            continue  # reconnect forever, no supervision

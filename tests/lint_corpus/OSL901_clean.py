@@ -1,0 +1,6 @@
+# lint-corpus-path: opensim_tpu/engine/fixture.py
+from opensim_tpu.engine import reasons
+
+
+def decode(UnscheduledPod, pod, node):
+    return [UnscheduledPod(pod, reasons.node_not_found(node))]

@@ -1,0 +1,3 @@
+# lint-corpus-path: opensim_tpu/engine/fixture.py
+def decode(UnscheduledPod, pod):
+    return [UnscheduledPod(pod, "no nodes matched")]  # inline reason string

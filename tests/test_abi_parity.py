@@ -1,0 +1,121 @@
+"""OSL1604 ABI-drift regression matrix (detector-awake for the parity
+pass): copies of the REAL abi-v4 native sources are mutated one axis at a
+time — field order, pointer width, abi version, serial wire tag — and the
+rule must fire naming the exact drifted field; the unmutated copies must
+stay green."""
+
+import os
+import re
+import shutil
+
+from opensim_tpu.analysis import lint_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "opensim_tpu", "native")
+
+
+def _stage(tmp_path, mutate=None):
+    """Copy the real native sources into tmp; ``mutate(path)->None`` edits
+    them. Returns the staged native/ dir."""
+    dst = os.path.join(str(tmp_path), "native")
+    os.makedirs(dst)
+    for name in ("__init__.py", "serial.py", "scan_engine.cc", "serial_engine.cc"):
+        shutil.copy(os.path.join(NATIVE, name), os.path.join(dst, name))
+    if mutate is not None:
+        mutate(dst)
+    return dst
+
+
+def _findings(dst):
+    return lint_paths([dst], rules=["abi-parity"])
+
+
+def _edit(dst, name, old, new, count=1):
+    path = os.path.join(dst, name)
+    with open(path) as fh:
+        src = fh.read()
+    assert old in src, f"mutation anchor {old!r} missing from {name}"
+    with open(path, "w") as fh:
+        fh.write(src.replace(old, new, count))
+
+
+def test_real_abi_v4_sources_are_green(tmp_path):
+    assert _findings(_stage(tmp_path)) == []
+
+
+def test_field_order_swap_fires_naming_the_field(tmp_path):
+    # swap Hp and Hports in the C++ dims declaration
+    dst = _stage(tmp_path)
+    _edit(dst, "scan_engine.cc", "Hp, Hports,", "Hports, Hp,")
+    findings = _findings(dst)
+    assert [f.code for f in findings] == ["OSL1604"]
+    msg = findings[0].message
+    assert "order drift" in msg and "`Hports`" in msg and "`Hp`" in msg
+
+
+def test_python_packing_order_swap_fires(tmp_path):
+    dst = _stage(tmp_path)
+    _edit(dst, "__init__.py", '"node_valid", _U8, "u8"), ("alloc", _F32, "f32"',
+          '"alloc", _F32, "f32"), ("node_valid", _U8, "u8"')
+    findings = _findings(dst)
+    assert [f.code for f in findings] == ["OSL1604"]
+    assert "alloc" in findings[0].message
+
+
+def test_pointer_width_drift_fires_naming_the_field(tmp_path):
+    dst = _stage(tmp_path)
+    _edit(dst, "scan_engine.cc", "int32_t* chosen;", "int64_t* chosen;")
+    findings = _findings(dst)
+    assert [f.code for f in findings] == ["OSL1604"]
+    msg = findings[0].message
+    assert "width drift" in msg and "`chosen`" in msg
+    assert "ptr:i64" in msg and "ptr:i32" in msg
+
+
+def test_dropped_field_fires_with_count(tmp_path):
+    dst = _stage(tmp_path)
+    _edit(dst, "scan_engine.cc", "  const float* avoid_score;", "")
+    findings = _findings(dst)
+    assert findings and all(f.code == "OSL1604" for f in findings)
+    assert any("count drift" in f.message for f in findings)
+
+
+def test_abi_version_drift_fires(tmp_path):
+    dst = _stage(tmp_path)
+    _edit(dst, "scan_engine.cc", "opensim_abi_version() { return 4; }",
+          "opensim_abi_version() { return 5; }")
+    findings = _findings(dst)
+    assert [f.code for f in findings] == ["OSL1604"]
+    assert "version drift" in findings[0].message
+
+
+def test_serial_wire_version_drift_fires(tmp_path):
+    dst = _stage(tmp_path)
+    _edit(dst, "serial.py", "WIRE_VERSION = 1", "WIRE_VERSION = 2")
+    findings = _findings(dst)
+    assert [f.code for f in findings] == ["OSL1604"]
+    assert "serial wire version drift" in findings[0].message
+
+
+def test_missing_anchor_constant_fires(tmp_path):
+    dst = _stage(tmp_path)
+    _edit(dst, "__init__.py", "ABI_VERSION = 4", "_NOT_THE_ANCHOR = 4")
+    findings = _findings(dst)
+    assert [f.code for f in findings] == ["OSL1604"]
+    assert "ABI_VERSION constant missing" in findings[0].message
+
+
+def test_unparsable_packing_list_fails_loud_not_quiet(tmp_path):
+    # review regression: a mirror whose packing list stops being resolvable
+    # must FAIL the gate (parse problem finding), never silently skip it
+    dst = _stage(tmp_path)
+    _edit(dst, "__init__.py", "_DIMS = [", "_DIMS_RENAMED = [")
+    findings = _findings(dst)
+    assert findings and all(f.code == "OSL1604" for f in findings)
+    assert any("_DIMS" in f.message and "parse problem" in f.message for f in findings)
+
+
+def test_cc_anchors_present_in_real_source():
+    src = open(os.path.join(NATIVE, "scan_engine.cc")).read()
+    assert re.search(r"//\s*abi-begin:\s*ScanArgs", src)
+    assert re.search(r"//\s*abi-end:\s*ScanArgs", src)

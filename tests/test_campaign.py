@@ -594,3 +594,38 @@ def test_repo_swept_clean_for_osl1501():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     findings = lint_paths([os.path.join(repo, "opensim_tpu")], rules=["campaign-step-registry"])
     assert findings == []
+
+
+def test_resolve_path_rejects_control_characters_as_typed_error():
+    # PR 14: _resolve_path is the campaign's registered taint validator
+    # (OSL1603); rejections must stay CampaignError so the REST surface
+    # renders the typed 400, never a generic 500
+    with pytest.raises(cp.CampaignError):
+        cp._resolve_path("bad\tpath")
+    with pytest.raises(cp.CampaignError):
+        cp.load_campaign_cluster(
+            cp.CampaignSpec(name="x", steps=[], cluster={"customConfig": "a\nb"})
+        )
+    assert cp._resolve_path("plain/relative.yaml") == "plain/relative.yaml"
+
+
+def test_remote_campaigns_reject_server_side_paths():
+    # review hardening: a REST campaign naming a filesystem path must get a
+    # typed 400-shaped CampaignError, never a server-side open(). Deploy
+    # steps resolve their path at RUN time, so the gate guards the whole
+    # evaluation (rest.py wraps parse AND run in remote_spec_context).
+    with cp.remote_spec_context():
+        with pytest.raises(cp.CampaignError) as ei:
+            cp._resolve_path("/etc/passwd")
+    assert "REST" in str(ei.value)
+    # file-mode (trusted CLI) resolution still works
+    assert cp._resolve_path("apps/app.yaml") == "apps/app.yaml"
+
+
+def test_child_path_rejects_spec_dir_escape():
+    from opensim_tpu.utils import validate
+
+    with pytest.raises(ValueError):
+        validate.child_path("/specs/dir", "../../etc/passwd")
+    assert validate.child_path("/specs/dir", "sub/app.yaml") == "/specs/dir/sub/app.yaml"
+    assert validate.child_path("/specs/dir", "/abs/path.yaml") == "/abs/path.yaml"

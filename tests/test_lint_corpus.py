@@ -1,0 +1,62 @@
+"""The detector-awake corpus gate (analysis/corpus.py): every registered
+rule has a firing fixture and a paired clean variant, the gate passes on
+the shipped corpus, and the gate itself catches asleep detectors, stale
+fixtures, and precision regressions."""
+
+import os
+import textwrap
+
+from opensim_tpu.analysis import RULES
+from opensim_tpu.analysis.corpus import check_corpus, corpus_inventory, run_fixture
+
+CORPUS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "lint_corpus")
+
+
+def test_shipped_corpus_passes():
+    assert check_corpus(CORPUS) == []
+
+
+def test_every_registered_rule_has_fire_and_clean_fixtures():
+    inv = corpus_inventory(CORPUS)
+    for rule in RULES.values():
+        entry = inv.get(rule.code, {})
+        assert entry.get("fire"), f"{rule.code} has no fire fixture"
+        assert entry.get("clean"), f"{rule.code} has no clean fixture"
+
+
+def test_gate_catches_missing_fixture(tmp_path):
+    problems = check_corpus(str(tmp_path))
+    # an empty corpus dir: every rule reports both missing fixtures
+    assert len(problems) == 2 * len(RULES)
+    assert any("OSL101" in p and "no firing fixture" in p for p in problems)
+
+
+def test_gate_catches_asleep_detector_and_stale_code(tmp_path):
+    (tmp_path / "OSL501_fire.py").write_text("x = 1\n")  # does not fire
+    (tmp_path / "OSL9999_fire.py").write_text("x = 1\n")  # no such rule
+    problems = check_corpus(str(tmp_path))
+    assert any("detector asleep" in p and "OSL501" in p for p in problems)
+    assert any("OSL9999" in p and "no such rule" in p for p in problems)
+
+
+def test_gate_catches_precision_regression(tmp_path):
+    (tmp_path / "OSL501_clean.py").write_text(
+        textwrap.dedent(
+            """
+            def swallow(risky):
+                try:
+                    risky()
+                except Exception:
+                    pass
+            """
+        )
+    )
+    problems = check_corpus(str(tmp_path))
+    assert any("precision regression" in p for p in problems)
+
+
+def test_run_fixture_honors_virtual_path():
+    # OSL201 is scoped to encoding/: without the virtual-path header the
+    # fixture would lint under tests/ and never fire
+    codes, err = run_fixture(os.path.join(CORPUS, "OSL201_fire.py"), "OSL201")
+    assert err is None and codes == ["OSL201"]

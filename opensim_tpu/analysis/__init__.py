@@ -44,6 +44,9 @@ Rules (short name = suppression id; see docs/static-analysis.md):
                               registered @sanitizer validator
     OSL1604 abi-parity        C++/Python ABI declarations drifted
                               (ScanArgs layout, abi version, serial wire)
+    OSL1701 shm-discipline    shared-memory segment create/attach/unlink
+                              outside server/fleet.py (the fleet's
+                              /dev/shm hygiene owner)
 
 The OSL12xx family is whole-program (symbol table + call graph + lock
 graph across all linted files); its runtime counterpart is the lock-order
@@ -79,6 +82,7 @@ from . import (  # noqa: F401,E402
     rules_dtype,
     rules_env,
     rules_except,
+    rules_fleet,
     rules_jit,
     rules_journal,
     rules_metrics,

@@ -1,0 +1,94 @@
+"""shm-discipline (OSL1701): shared-memory segments are created, attached
+and unlinked ONLY in ``server/fleet.py``.
+
+The fleet's whole-of-/dev/shm hygiene story (ISSUE 15, docs/serving.md
+"Scaling past one process") rests on one module owning every segment
+lifecycle: the publisher's close/atexit/resource-tracker chain unlinks
+exactly the set it created, readers unregister their attachments so an
+exiting worker never destroys the owner's live segments, and the seqlock
+retry bounds the attach path. One ``SharedMemory(...)`` constructed
+anywhere else and a segment exists that no owner unlinks, no reader
+unregisters, and no retry loop protects — the classic leaked-/dev/shm
+failure mode the tests pin down.
+
+The rule flags, in any module other than ``server/fleet.py``:
+
+- imports of ``multiprocessing.shared_memory`` (``import`` or
+  ``from ... import``), including ``from multiprocessing import
+  shared_memory``;
+- any call whose callee is spelled ``SharedMemory(...)`` (dotted or
+  bare) — construction IS both create and attach;
+- ``.unlink()`` calls on a receiver whose name mentions ``shm`` or
+  ``segment`` (destroying a segment from outside the owner).
+
+Fix by routing through ``server/fleet.py``'s publisher/reader API
+(``TwinPublisher`` / ``FleetReader``); see docs/static-analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import FileContext, Finding, Rule, dotted_name, register
+
+_FIX = (
+    "shared-memory create/attach/unlink lives in server/fleet.py "
+    "(TwinPublisher/FleetReader own the segment lifecycle)"
+)
+
+
+@register
+class ShmDisciplineRule(Rule):
+    name = "shm-discipline"
+    code = "OSL1701"
+    description = "shared-memory segment lifecycle outside server/fleet.py"
+    # tests exercise leak/crash scenarios on purpose
+    exclude_paths = ("server/fleet.py", "tests/")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.startswith("multiprocessing.shared_memory"):
+                        yield self.finding(
+                            ctx, node,
+                            f"import of {alias.name} outside server/fleet.py; {_FIX}",
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod.startswith("multiprocessing.shared_memory"):
+                    yield self.finding(
+                        ctx, node,
+                        f"import from {mod} outside server/fleet.py; {_FIX}",
+                    )
+                elif mod == "multiprocessing" and any(
+                    a.name == "shared_memory" for a in node.names
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        "from multiprocessing import shared_memory outside "
+                        f"server/fleet.py; {_FIX}",
+                    )
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func) or ""
+                leaf = name.rsplit(".", 1)[-1]
+                if leaf == "SharedMemory":
+                    yield self.finding(
+                        ctx, node,
+                        "SharedMemory construction (create/attach) outside "
+                        f"server/fleet.py; {_FIX}",
+                    )
+                elif (
+                    leaf == "unlink"
+                    and isinstance(node.func, ast.Attribute)
+                    and any(
+                        tag in (dotted_name(node.func.value) or "").lower()
+                        for tag in ("shm", "segment")
+                    )
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        "shared-memory unlink outside server/fleet.py "
+                        f"(only the owner destroys segments); {_FIX}",
+                    )

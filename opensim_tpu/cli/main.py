@@ -183,6 +183,16 @@ def build_parser() -> argparse.ArgumentParser:
         "endpoint, status, duration) — same as OPENSIM_ACCESS_LOG=1",
     )
     server_p.add_argument(
+        "--workers", type=int, default=0,
+        help="serve through N worker PROCESSES sharing the port "
+        "(docs/serving.md 'Scaling past one process'): a twin-owner "
+        "process publishes arena deltas over shared memory and N workers "
+        "attach zero-copy and run the full admission/batching ladder "
+        "past the GIL. Requires the live twin (--kubeconfig, --watch "
+        "auto|on). 0/1 = single process; OPENSIM_WORKERS_FLEET is the "
+        "env default",
+    )
+    server_p.add_argument(
         "--journal", default="",
         help="directory for the crash-safe watch-event journal "
         "(docs/live-twin.md 'Durability & replay'): every accepted twin "
@@ -499,7 +509,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         try:
             return serve(
                 kubeconfig=args.kubeconfig, master=args.master, port=args.port,
-                watch=args.watch, journal=args.journal,
+                watch=args.watch, journal=args.journal, workers=args.workers,
             )
         except ValueError as e:
             # serve()'s path validators reject control characters

@@ -768,6 +768,75 @@ def twin_pod_delta(
 
 
 # ---------------------------------------------------------------------------
+# attach-from-shm (multi-process serving fleet, server/fleet.py)
+# ---------------------------------------------------------------------------
+
+
+def publication_parts(entry: CacheEntry) -> Optional[dict]:
+    """The host-side pieces of a warm base entry a twin owner publishes
+    over shared memory (server/fleet.py): everything a worker process
+    needs to rebuild an equivalent :class:`CacheEntry` EXCEPT the device
+    tensors (each attaching process re-uploads once per generation) and
+    the per-entry lock (locks are process-local by definition). MUST be
+    called with ``entry.lock`` held and bind state restored, like every
+    other reader of the shared pod objects. Returns None for a no-prep
+    entry (a cluster with no schedulable pods — nothing to publish)."""
+    prep = entry.prep
+    if prep is None:
+        return None
+    st0_np = ScanState(*[np.asarray(a) for a in prep.st0])
+    return {
+        "ec_np": prep.ec_np,
+        "st0_np": st0_np,
+        "meta": prep.meta,
+        "ordered": prep.ordered,
+        "tmpl_ids": prep.tmpl_ids,
+        "forced": prep.forced,
+        "ds_target": prep.ds_target,
+        "features": prep.features,
+        "encoder": prep.encoder,
+        "n_cluster": prep.n_cluster,
+        "n_bare": prep.n_bare,
+        "ds_group_sizes": prep.ds_group_sizes,
+        "base_drop": entry.base_drop,
+    }
+
+
+def entry_from_publication(key: str, parts: dict) -> CacheEntry:
+    """Rebuild a warm base :class:`CacheEntry` from published parts — the
+    worker-process half of the fleet's attach-from-shm path. The numpy
+    leaves in ``parts`` may be zero-copy read-only views over shared
+    memory; nothing here (or on any serving path over the entry) writes
+    through them — deltas fork the encoder and drop masks are copied
+    before mutation. The one per-attach cost is the device upload of the
+    encoded cluster (each process owns its device buffers; later derives
+    reuse them leaf-by-leaf through ``CacheEntry.dev_map``)."""
+    ec_np: EncodedCluster = parts["ec_np"]
+    st0_np: ScanState = parts["st0_np"]
+    ec = EncodedCluster(*[jnp.asarray(a) for a in ec_np])
+    st0 = ScanState(*[jnp.asarray(a) for a in st0_np])
+    prep = Prepared(
+        ec=ec,
+        st0=st0,
+        meta=parts["meta"],
+        ordered=parts["ordered"],
+        tmpl_ids=parts["tmpl_ids"],
+        forced=parts["forced"],
+        ds_target=parts["ds_target"],
+        features=parts["features"],
+        ec_np=ec_np,
+        encoder=parts["encoder"],
+        n_cluster=parts["n_cluster"],
+        n_bare=parts["n_bare"],
+        ds_group_sizes=parts["ds_group_sizes"],
+    )
+    entry = CacheEntry(key, prep)
+    with entry.lock:  # fresh and unpublished, but base_drop is guarded-by it
+        entry.base_drop = parts.get("base_drop")
+    return entry
+
+
+# ---------------------------------------------------------------------------
 # steady-state entry point
 # ---------------------------------------------------------------------------
 

@@ -96,12 +96,19 @@ def batch_engine_mode() -> str:
     return raw
 
 
-@functools.partial(jax.jit, static_argnames=("features", "unroll"))
-def _batched_schedule(ec, st0, tmpl_ids, pod_valid_masks, forced, features, unroll):
+@functools.partial(jax.jit, static_argnames=("features", "unroll", "explain"))
+def _batched_schedule(ec, st0, tmpl_ids, pod_valid_masks, forced, features, unroll,
+                      explain=False):
     """ALL requests in ONE compiled dispatch: ``jax.vmap`` over the
     per-request pod-validity masks prepends a request axis to the scan
     (shared EncodedCluster/ScanState operands are not duplicated). Module
     level + jitted so repeat batch shapes hit the jit cache.
+
+    ``explain`` (batched decision audit, ISSUE 15 satellite) runs the
+    count_all scan variant so every rider's per-pod fail rows are filled
+    — the shared carry is untouched, so non-explain riders' placements
+    are unchanged and each explain rider's rows are bit-identical to its
+    solo count_all run.
 
     The vmapped body calls the raw jit entry, not the observed
     ``schedule_pods`` wrapper: inside this trace the compile watch's
@@ -111,7 +118,8 @@ def _batched_schedule(ec, st0, tmpl_ids, pod_valid_masks, forced, features, unro
     site below)."""
     return jax.vmap(
         lambda pv: _schedule_pods_traced(
-            ec, st0, tmpl_ids, pv, forced, features=features, unroll=unroll
+            ec, st0, tmpl_ids, pv, forced, features=features, unroll=unroll,
+            explain=explain,
         )
     )(pod_valid_masks)
 
@@ -170,12 +178,22 @@ def run_request_batch(
     state it mutates. Results are bit-identical to solo runs of each
     request (mask-invalid foreign pods never touch engine state).
 
-    Deadline shedding (ISSUE 9 satellite): on the sequential C++ path the
-    rider's :class:`Deadline` is re-checked between scans — an expired
-    rider's slot comes back as a typed :class:`DeadlineExceeded`
-    (``phase="schedule"``) instead of a result, and its scan never runs.
-    Riders already scanned are unaffected (their placements are exactly a
-    solo run's)."""
+    Deadline shedding (ISSUE 9 satellite + ISSUE 15 satellite): on the
+    sequential C++ path the rider's :class:`Deadline` is re-checked
+    between scans — an expired rider's slot comes back as a typed
+    :class:`DeadlineExceeded` (``phase="schedule"``) instead of a result,
+    and its scan never runs. On the vmapped XLA path, riders already
+    expired BEFORE the dispatch are dropped from the request mask the
+    same way (their lane schedules nothing), so one slow queue wait can
+    never ride a whole batch; a batch already IN FLIGHT stays atomic by
+    design — the vmapped scan is one compiled dispatch with no host
+    boundary to shed at (the C++ sequential path has those boundaries and
+    sheds there).
+
+    Batched explain (ISSUE 15 satellite): a rider with ``explain=True``
+    rides the shared dispatch like any other — the batch runs the
+    count_all scan variant (or the C++ generic path) so its per-pod fail
+    rows exist, and only that rider's decode pays the audit build."""
     from . import nativepath
 
     P = len(prep.ordered)
@@ -207,6 +225,19 @@ def run_request_batch(
         "megakernel": "request-axis batches run on the vmapped XLA scan "
         "(or sequential C++ scans)",
     }
+
+    def _shed_rider(s: int, dl: Deadline) -> DeadlineExceeded:
+        obs.event(
+            "batch.rider_shed", status="deadline-exceeded",
+            rider=s, over_by_s=round(-dl.remaining(), 6),
+        )
+        return DeadlineExceeded(
+            "request deadline exceeded at the 'schedule' phase "
+            f"(shed between batched rider scans, over by "
+            f"{-dl.remaining():.3f}s)",
+            phase="schedule",
+        )
+
     outs: List[Optional[ScheduleOutput]] = []
     shed: Dict[int, BaseException] = {}
     if use_native:
@@ -219,23 +250,33 @@ def run_request_batch(
                     # shed BEFORE this rider's scan: its deadline died while
                     # earlier riders ran — same typed 504 a solo run's
                     # schedule boundary raises, without the wasted scan
-                    shed[s] = DeadlineExceeded(
-                        "request deadline exceeded at the 'schedule' phase "
-                        f"(shed between batched rider scans, over by "
-                        f"{-dl.remaining():.3f}s)",
-                        phase="schedule",
-                    )
-                    obs.event(
-                        "batch.rider_shed", status="deadline-exceeded",
-                        rider=s, over_by_s=round(-dl.remaining(), 6),
-                    )
+                    shed[s] = _shed_rider(s, dl)
                     outs.append(None)
                     continue
-                outs.append(nativepath.schedule(prep, pod_valid[s]))
+                outs.append(
+                    nativepath.schedule(prep, pod_valid[s], explain=items[s].explain)
+                )
     else:
         engine_name = "xla"
         if native_miss is None:
             skips["native"] = "request-axis batching dispatches ONE vmapped scan"
+        # pre-dispatch deadline shedding (ISSUE 15 satellite): an already-
+        # expired rider never enters the compiled dispatch — its lane's
+        # mask is all-invalid (it schedules nothing and cannot perturb the
+        # others, whose masks never included its pods anyway). Once the
+        # dispatch is running the batch is atomic by design: the vmapped
+        # scan has no host boundary to shed at.
+        for s, it in enumerate(items):
+            dl = it.deadline
+            if dl is not None and dl.expired():
+                shed[s] = _shed_rider(s, dl)
+                pod_valid[s, :] = False
+        # computed AFTER shedding: a shed rider's audit has no consumer,
+        # and the count_all variant is its own jit cache entry — an
+        # expired explain rider must not force that compile on the batch
+        explain_any = any(
+            it.explain for s, it in enumerate(items) if s not in shed
+        )
         tmpl_p, _pv0, forced_p = pad_pod_stream(
             prep.tmpl_ids, pod_valid[0], prep.forced
         )
@@ -256,7 +297,10 @@ def run_request_batch(
                     prep.ec, prep.st0, jnp.asarray(tmpl_p), jnp.asarray(pv_all),
                     jnp.asarray(forced_p),
                 ),
-                static={"features": prep.features, "unroll": scan_unroll()},
+                static={
+                    "features": prep.features, "unroll": scan_unroll(),
+                    "explain": explain_any,
+                },
             )
             jax.block_until_ready(batched.chosen)
         outs = [_slice_output(batched, s, P) for s in range(len(items))]

@@ -104,6 +104,24 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
     "simon_shed_total": ("Requests shed at the admission queue by reason", "counter"),
     "simon_batch_size": ("Requests folded into one batched schedule dispatch", "histogram"),
     "simon_queue_wait_seconds": ("Real time-in-queue from admission to execution start", "histogram"),
+    # multi-process serving fleet (server/fleet.py, docs/serving.md
+    # "Scaling past one process") — owner-side families are label-free;
+    # worker-side attach counters are label-free too
+    "simon_fleet_workers": ("Fleet worker processes currently alive", "gauge"),
+    "simon_fleet_workers_target": ("Fleet worker processes configured", "gauge"),
+    "simon_fleet_respawns_total": ("Fleet worker respawns after a crash", "counter"),
+    "simon_fleet_publishes_total": ("Twin publications over shared memory", "counter"),
+    "simon_fleet_generation": ("Last twin generation published over shared memory", "gauge"),
+    "simon_fleet_shm_segments": ("Live shared-memory segments the publisher owns", "gauge"),
+    "simon_fleet_shm_bytes": ("Bytes across live shared-memory segments", "gauge"),
+    "simon_fleet_publish_seconds": ("Twin publication latency (delta segments + control swap)", "histogram"),
+    "simon_fleet_attaches_total": ("Worker attaches to a published generation", "counter"),
+    "simon_fleet_attach_retries_total": ("Seqlock retries during worker attach (torn reads)", "counter"),
+    "simon_fleet_attach_retries_exhausted_total": (
+        "Worker attaches abandoned after exhausting seqlock retries", "counter",
+    ),
+    "simon_fleet_attach_generation": ("Twin generation this worker last attached", "gauge"),
+    "simon_fleet_segment_reuse_total": ("Segments reused across generations at attach (content-keyed delta hits)", "counter"),
     # latency + decision audit (this module's RECORDER)
     "simon_phase_seconds": ("Per-phase latency from the request span trees", "histogram"),
     "simon_request_seconds": ("Whole-request latency by endpoint and outcome", "histogram"),

@@ -134,10 +134,14 @@ class Ticket:
     batch_size: int = 0  # 0 = solo path
 
     def batchable(self) -> bool:
-        # explain requests need the full solo audit plumbing; newnodes get
-        # per-request randomized fake node names (a shared node axis would
-        # replay one request's names into another's response)
-        return not self.has_new_nodes and not self.explain
+        # newnodes get per-request randomized fake node names (a shared
+        # node axis would replay one request's names into another's
+        # response). explain requests batch like any other (ISSUE 15
+        # satellite): the batch runs the count_all scan variant and only
+        # the explain rider's decode pays the audit build — per-rider
+        # fail rows over the shared derive, bit-identical to solo explain
+        # (gated by tests/test_admission.py).
+        return not self.has_new_nodes
 
     def resolve(self, result=None, error: Optional[BaseException] = None,
                 stale: bool = False, batch_size: int = 0) -> None:

@@ -1,0 +1,1174 @@
+"""Multi-process serving fleet: shared-memory twin publication + worker
+processes past the GIL (ISSUE 15, docs/serving.md "Scaling past one
+process").
+
+PR 8's admission/batching core multiplied throughput inside ONE Python
+process; this module multiplies processes over ONE warm twin. The roles:
+
+- **twin owner** (the parent, :func:`serve_fleet`): runs the watch
+  supervisor + journal exactly like the single-process server, and after
+  every twin generation change publishes the warm base prep's arenas over
+  POSIX shared memory (``multiprocessing.shared_memory``):
+
+    * one **content-keyed segment per numpy buffer** — the
+      ``EncodedCluster``/``ScanState`` field buffers, template ids, masks.
+      Segment names are derived from the buffer's content hash, so a
+      generation that changed 2 of 75 arrays re-publishes 2 segments and
+      the workers re-attach 2 (the arenas are already content-keyed and
+      immutable-once-built, which is what makes this delta publication
+      sound);
+    * one **blob segment** holding the pickled host-side state (twin
+      cluster objects, pod stream, encoder provenance, decode tables);
+      its pickler externalizes every numpy leaf into the segments above,
+      so arrays cross the process boundary exactly once, by name;
+    * a small **control block** with a seqlock: ``seq`` goes odd, the
+      generation/fingerprint/segment-directory payload is swapped, ``seq``
+      goes even. Readers retry on an odd or changed ``seq`` — a worker can
+      NEVER observe a torn generation (gated by tests/test_fleet.py).
+
+- **N server workers** (:func:`run_worker`, spawned as fresh ``simon
+  server`` subprocesses with ``OPENSIM_FLEET_ATTACH`` set): attach the
+  segments read-only, reconstruct the numpy views zero-copy via
+  ``np.frombuffer``, rebuild a warm base ``CacheEntry`` through
+  ``prepcache.entry_from_publication`` (the one device upload per
+  generation per worker), and serve the FULL admission → reqbatch →
+  simulate ladder independently — placements are bit-identical to the
+  single-process server (gated). Workers share the public port via
+  ``SO_REUSEPORT`` (the kernel load-balances accepted connections) and
+  each binds a loopback listener the owner scrapes for aggregation.
+
+- **supervision**: a crashed worker is respawned with the resilience
+  layer's full-jitter backoff (``resilience.retry.backoff_delay``) and
+  reattaches at the CURRENT generation. SIGTERM drains the fleet in
+  order: workers first (each drains its admission queue), owner last
+  (reflectors stopped, journal flushed + fsynced, segments unlinked).
+
+Shared-memory discipline (opensim-lint OSL1701): segments are created,
+attached and unlinked ONLY in this module. Leak story: the owner unlinks
+everything on close/atexit, and the stdlib resource tracker — a separate
+process that survives even SIGKILL of the owner — unlinks whatever an
+owner crash leaves behind, so ``/dev/shm`` never accumulates garbage.
+Workers deliberately unregister their attachments from their own tracker:
+an exiting worker must never destroy the owner's live segments.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import hashlib
+import io
+import json
+import logging
+import os
+import pickle
+import secrets
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..obs.metrics import (
+    FAMILIES,
+    RECORDER,
+    escape_label_value,
+    family_header,
+    make_histogram,
+)
+from ..resilience.retry import backoff_delay
+from ..utils import envknobs
+
+log = logging.getLogger("opensim_tpu.server")
+
+__all__ = [
+    "ControlBlock",
+    "FleetReader",
+    "FleetTwinClient",
+    "TornGeneration",
+    "TwinPublisher",
+    "run_worker",
+    "serve_fleet",
+]
+
+# control-block layout (little-endian):
+#   0..8    magic
+#   8..16   seq        — seqlock: odd while a publish is in flight
+#   16..24  payload len
+#   24..32  generation
+#   32..    payload    — json: fingerprint, state, stale, blob segment,
+#                        array-segment directory (accounting + GC)
+_MAGIC = b"SIMFLT01"
+_HEADER = struct.Struct("<8sQQQ")
+_CONTROL_SIZE = 256 * 1024
+
+#: arrays smaller than this ride inside the pickled blob (a dedicated
+#: segment per 8-byte scalar array would be pure overhead, and zero-size
+#: arrays cannot be shm segments at all)
+_INLINE_BYTES = 64
+
+
+class TornGeneration(RuntimeError):
+    """A reader exhausted its seqlock retries without observing one stable
+    publication — the owner is either republishing faster than the reader
+    can attach or has died mid-publish. Counted in
+    ``simon_fleet_attach_retries_exhausted_total``; the caller keeps
+    serving its previously attached generation."""
+
+
+_SHM_CLS = None
+
+
+def _shm_cls():
+    """The one construction point for stdlib shm segments (OSL1701 keeps
+    every create/attach/unlink inside this file). The subclass makes
+    ``close()`` tolerate live buffer exports: at interpreter shutdown the
+    stdlib ``__del__`` closes segments in GC order, and a zero-copy numpy
+    view that outlives its segment object would otherwise spray
+    ``BufferError`` tracebacks over every worker exit (the mmap itself is
+    freed safely once the last view dies — suppressing the eager close is
+    correct, not cosmetic)."""
+    global _SHM_CLS
+    if _SHM_CLS is None:
+        from multiprocessing import shared_memory
+
+        class _Segment(shared_memory.SharedMemory):
+            def close(self) -> None:
+                try:
+                    super().close()
+                except BufferError:
+                    pass
+
+        _SHM_CLS = _Segment
+    return _SHM_CLS
+
+
+#: segment names THIS process created (it owns their tracker registration
+#: and their unlink); in-process readers — tests, the owner's own attach
+#: fallback — must not unregister them out from under the owner
+_OWNED_NAMES: set = set()
+
+
+def _attach(name: str):
+    """Attach an existing segment WITHOUT adopting ownership: Python's
+    resource tracker would otherwise unlink the owner's segment when this
+    (reader) process exits — exactly the destruction the owner/reader
+    split exists to prevent. Segments created by this very process keep
+    their registration (the owner's crash-cleanup backstop)."""
+    shm = _shm_cls()(name=name)
+    if name not in _OWNED_NAMES:
+        try:
+            from multiprocessing import resource_tracker
+
+            resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+        except Exception as e:  # pragma: no cover - tracker layout off-CPython
+            log.debug("resource-tracker unregister failed: %s: %s", type(e).__name__, e)
+    return shm
+
+
+class ControlBlock:
+    """The seqlock-guarded publication header.
+
+    One writer (the twin owner), many readers (workers). ``write`` bumps
+    ``seq`` to odd, swaps the payload, bumps to even; ``read`` snapshots
+    ``seq`` before and after and retries unless both are the same even
+    value. 8-byte aligned header writes and bounded retries make torn
+    reads impossible to observe, not merely unlikely."""
+
+    def __init__(self, name: Optional[str] = None, create: bool = False,
+                 size: int = _CONTROL_SIZE) -> None:
+        self.create = create
+        if create:
+            self.name = name or f"simon-fleet-{os.getpid()}-{secrets.token_hex(4)}"
+            self._shm = _shm_cls()(
+                name=self.name, create=True, size=size
+            )
+            _OWNED_NAMES.add(self.name)
+            self._seq = 0
+            _HEADER.pack_into(self._shm.buf, 0, _MAGIC, 0, 0, 0)
+        else:
+            if not name:
+                raise ValueError("attaching a ControlBlock requires its name")
+            self.name = name
+            self._shm = _attach(name)
+            magic = bytes(self._shm.buf[:8])
+            if magic != _MAGIC:
+                raise ValueError(
+                    f"shared-memory segment {name!r} is not a fleet control block"
+                )
+
+    # -- writer side ---------------------------------------------------------
+
+    def write(self, generation: int, payload: dict) -> None:
+        data = json.dumps(payload, sort_keys=True).encode()
+        if _HEADER.size + len(data) > self._shm.size:
+            raise ValueError(
+                f"fleet control payload ({len(data)}B) exceeds the control "
+                f"block ({self._shm.size}B); raise the control size"
+            )
+        buf = self._shm.buf
+        self._seq += 1  # odd: publication in flight
+        struct.pack_into("<Q", buf, 8, self._seq)
+        struct.pack_into("<Q", buf, 16, len(data))
+        struct.pack_into("<Q", buf, 24, generation)
+        buf[_HEADER.size : _HEADER.size + len(data)] = data
+        self._seq += 1  # even: stable
+        struct.pack_into("<Q", buf, 8, self._seq)
+
+    # -- reader side ---------------------------------------------------------
+
+    def seq(self) -> int:
+        return struct.unpack_from("<Q", self._shm.buf, 8)[0]
+
+    def poll(self) -> Optional[int]:
+        """(generation) of the current stable publication, or None before
+        the first publish / while a swap is in flight."""
+        got = self.poll_state()
+        return got[0] if got is not None else None
+
+    def poll_state(self) -> Optional[Tuple[int, int]]:
+        """(generation, seq) of the current stable publication. The seq
+        is the change detector: a republish at the SAME generation (a
+        staleness/state flip on a quiet twin) bumps it, and readers must
+        refresh their payload on any bump, not only on generation
+        moves."""
+        s1 = self.seq()
+        if s1 == 0 or s1 % 2:
+            return None
+        gen = struct.unpack_from("<Q", self._shm.buf, 24)[0]
+        if self.seq() != s1:
+            return None
+        return int(gen), s1
+
+    def read(self) -> Optional[Tuple[int, dict, int]]:
+        """One seqlock read attempt: ``(generation, payload, seq)`` or
+        None on a torn/absent publication (caller retries). The json
+        parse is inside the torn-read net on purpose: the pure-Python
+        seqlock carries no memory fences, so on a weakly-ordered CPU a
+        stable-looking seq pair can still cover torn payload bytes — a
+        parse failure IS a torn read, never an exception on the serving
+        path."""
+        s1 = self.seq()
+        if s1 == 0 or s1 % 2:
+            return None
+        _magic, _seq, n, gen = _HEADER.unpack_from(self._shm.buf, 0)
+        data = bytes(self._shm.buf[_HEADER.size : _HEADER.size + n])
+        if self.seq() != s1:
+            return None
+        try:
+            return int(gen), json.loads(data.decode()), s1
+        except ValueError:
+            return None
+
+    def close(self) -> None:
+        with contextlib.suppress(BufferError, OSError):
+            self._shm.close()
+
+    def unlink(self) -> None:
+        with contextlib.suppress(FileNotFoundError, OSError):
+            self._shm.unlink()
+        _OWNED_NAMES.discard(self.name)
+
+
+# ---------------------------------------------------------------------------
+# pickling with externalized arrays
+# ---------------------------------------------------------------------------
+
+
+class _ShmPickler(pickle.Pickler):
+    """Pickles the publication blob with every material numpy buffer
+    externalized into a content-keyed segment: the blob carries
+    ``("shmarr", segment, dtype, shape)`` stubs, the publisher writes each
+    distinct buffer exactly once, and the reader rebuilds zero-copy
+    ``np.frombuffer`` views. Pickle's memo keeps aliased arrays (the
+    encoder's arenas ARE the encoded cluster's node tensors) aliased."""
+
+    def __init__(self, file, put_array) -> None:
+        super().__init__(file, protocol=pickle.HIGHEST_PROTOCOL)
+        self._put_array = put_array
+
+    def persistent_id(self, obj):
+        if (
+            type(obj) is np.ndarray
+            and obj.dtype != object
+            and obj.nbytes >= _INLINE_BYTES
+        ):
+            name = self._put_array(obj)
+            return ("shmarr", name, obj.dtype.str, obj.shape)
+        return None
+
+
+class _ShmUnpickler(pickle.Unpickler):
+    def __init__(self, file, get_segment) -> None:
+        super().__init__(file)
+        self._get_segment = get_segment
+
+    def persistent_load(self, pid):
+        tag, name, dtype, shape = pid
+        if tag != "shmarr":
+            raise pickle.UnpicklingError(f"unknown persistent id {tag!r}")
+        shm = self._get_segment(name)
+        count = int(np.prod(shape)) if shape else 1
+        arr = np.frombuffer(shm.buf, dtype=np.dtype(dtype), count=count)
+        arr = arr.reshape(shape)
+        arr.flags.writeable = False
+        return arr
+
+
+# ---------------------------------------------------------------------------
+# owner side: the publisher
+# ---------------------------------------------------------------------------
+
+
+class TwinPublisher:
+    """Publishes generation-stamped arena deltas over shared memory.
+
+    Owned by the twin-owner process. ``publish`` is called with the warm
+    base entry's :func:`engine.prepcache.publication_parts` (under the
+    entry lock — the shared pod objects must be quiescent while they
+    pickle); unchanged buffers keep their content-keyed segments, so a
+    steady twin republishes only the blob and the control block.
+
+    Lifecycle: ``close()`` unlinks everything; it is also registered via
+    ``atexit``, and the stdlib resource tracker unlinks whatever a crash
+    leaves behind — ``/dev/shm`` hygiene is tested, not hoped for."""
+
+    def __init__(self, token: Optional[str] = None,
+                 control_size: int = _CONTROL_SIZE, keep_generations: int = 2) -> None:
+        self.token = token or f"{os.getpid()}-{secrets.token_hex(4)}"
+        self.control = ControlBlock(
+            name=f"simon-fleet-{self.token}", create=True, size=control_size
+        )
+        self.keep_generations = keep_generations
+        self._segments: Dict[str, object] = {}  # name -> SharedMemory
+        self._seg_bytes: Dict[str, int] = {}
+        self._gen_segments: "Dict[int, set]" = {}
+        self._lock = threading.Lock()
+        self.publishes_total = 0
+        self.last_generation = -1
+        self.publish_seconds = make_histogram("simon_fleet_publish_seconds", ())
+        self._closed = False
+        atexit.register(self.close)
+
+    # -- segments ------------------------------------------------------------
+
+    def _segment_name(self, data: bytes) -> str:
+        digest = hashlib.blake2b(data, digest_size=12).hexdigest()
+        return f"simon-fleet-{self.token}-{digest}"
+
+    def _put_bytes(self, data: bytes, current: set) -> str:
+        name = self._segment_name(data)
+        current.add(name)
+        if name in self._segments:
+            return name
+        try:
+            shm = _shm_cls()(name=name, create=True, size=len(data))
+            _OWNED_NAMES.add(name)
+        except FileExistsError:
+            # content-keyed: an existing same-name segment holds the same
+            # bytes (it was published by US under this run token)
+            shm = _attach(name)
+        shm.buf[: len(data)] = data
+        self._segments[name] = shm
+        self._seg_bytes[name] = len(data)
+        return name
+
+    # -- publish -------------------------------------------------------------
+
+    def publish(self, generation: int, cluster, parts: Optional[dict],
+                state: str = "live", stale: bool = False) -> dict:
+        """Write one publication: array segments, blob segment, control
+        swap (seqlock), then garbage-collect segments no generation within
+        the keep window references."""
+        t0 = time.monotonic()
+        with self._lock:
+            current: set = set()
+            arrays: List[Tuple[str, str, List[int]]] = []
+
+            def put_array(arr: np.ndarray) -> str:
+                a = np.ascontiguousarray(arr)
+                name = self._put_bytes(a.tobytes(), current)
+                arrays.append((name, a.dtype.str, list(a.shape)))
+                return name
+
+            buf = io.BytesIO()
+            _ShmPickler(buf, put_array).dump({"cluster": cluster, "parts": parts})
+            blob = self._put_bytes(buf.getvalue(), current)
+            fingerprint = hashlib.blake2b(
+                ("|".join(sorted(current)) + f"|{blob}").encode(), digest_size=16
+            ).hexdigest()
+            payload = {
+                "fingerprint": fingerprint,
+                "state": state,
+                "stale": bool(stale),
+                "blob": blob,
+                "arrays": arrays,
+                "token": self.token,
+            }
+            self.control.write(generation, payload)
+            self._gen_segments[generation] = current
+            self.publishes_total += 1
+            self.last_generation = generation
+            self._gc_segments()
+        seconds = time.monotonic() - t0
+        with RECORDER.lock:
+            self.publish_seconds.observe(seconds, ())
+        return payload
+
+    def _gc_segments(self) -> None:
+        """Unlink segments referenced by no generation in the keep window.
+        A reader attaching the PREVIOUS directory mid-swap may race an
+        unlink — its attach fails with FileNotFoundError and the seqlock
+        retry picks up the new directory; keeping one extra generation
+        makes that race rare instead of per-publish."""
+        gens = sorted(self._gen_segments)
+        keep = gens[-self.keep_generations :]
+        live: set = set()
+        for g in keep:
+            live |= self._gen_segments[g]
+        for g in gens:
+            if g not in keep:
+                del self._gen_segments[g]
+        for name in list(self._segments):
+            if name not in live:
+                shm = self._segments.pop(name)
+                self._seg_bytes.pop(name, None)
+                with contextlib.suppress(FileNotFoundError, OSError, BufferError):
+                    shm.unlink()
+                _OWNED_NAMES.discard(name)
+                with contextlib.suppress(BufferError, OSError):
+                    shm.close()
+
+    # -- accounting / teardown ----------------------------------------------
+
+    def footprint(self) -> dict:
+        with self._lock:
+            return {
+                "segments": len(self._segments) + 1,  # + control block
+                "bytes": sum(self._seg_bytes.values()) + _CONTROL_SIZE,
+                "publishes": self.publishes_total,
+                "generation": self.last_generation,
+            }
+
+    def close(self) -> None:
+        """Unlink every owned segment (idempotent; atexit-registered)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            for name, shm in self._segments.items():
+                with contextlib.suppress(FileNotFoundError, OSError, BufferError):
+                    shm.unlink()
+                _OWNED_NAMES.discard(name)
+                with contextlib.suppress(BufferError, OSError):
+                    shm.close()
+            self._segments.clear()
+            self._seg_bytes.clear()
+            self.control.unlink()
+            self.control.close()
+
+
+# ---------------------------------------------------------------------------
+# worker side: the reader
+# ---------------------------------------------------------------------------
+
+
+def attach_retries() -> int:
+    # the registered validator owns the parse/clamp and the warn-and-
+    # fall-back policy (utils/envknobs.py)
+    return int(envknobs.value("OPENSIM_FLEET_ATTACH_RETRIES"))
+
+
+class FleetReader:
+    """Attaches a publication and rebuilds the host-side view.
+
+    Attached segments are cached by (content-keyed) name, so a generation
+    that changed 2 arrays re-attaches 2 segments and reuses the rest —
+    the reader half of delta publication. Dropped cache references are
+    NOT closed eagerly: live numpy views pin the mmap via the buffer
+    protocol, and Python frees it only after the last view dies, which is
+    what makes handing zero-copy views to long-lived cache entries safe."""
+
+    def __init__(self, control_name: str, retries: Optional[int] = None) -> None:
+        self.control = ControlBlock(name=control_name, create=False)
+        self.retries = retries if retries is not None else attach_retries()
+        self._cache: Dict[str, object] = {}  # segment name -> SharedMemory
+        self.attaches_total = 0
+        self.retries_total = 0
+        self.retries_exhausted_total = 0
+        self.segment_reuse_total = 0
+        self.last_seq: Optional[int] = None  # seq validated by the last attach()
+
+    def poll(self) -> Optional[int]:
+        return self.control.poll()
+
+    def poll_state(self) -> Optional[Tuple[int, int]]:
+        return self.control.poll_state()
+
+    def _segment(self, name: str):
+        shm = self._cache.get(name)
+        if shm is None:
+            shm = _attach(name)
+            self._cache[name] = shm
+        else:
+            self.segment_reuse_total += 1
+        return shm
+
+    def attach(self) -> Tuple[int, dict, dict]:
+        """(generation, payload, blob object) for the current stable
+        publication. Retries the whole read on any torn observation — an
+        odd/changed seqlock, or a segment unlinked between the directory
+        read and the attach. Raises :class:`TornGeneration` when the
+        retry budget is exhausted."""
+        last_err: Optional[BaseException] = None
+        for attempt in range(self.retries):
+            if attempt:
+                self.retries_total += 1
+                time.sleep(min(0.05, 0.002 * attempt))
+            got = self.control.read()
+            if got is None:
+                last_err = None
+                continue
+            gen, payload, seq = got
+            try:
+                blob_shm = self._segment(payload["blob"])
+                data = bytes(blob_shm.buf[:])
+                obj = _ShmUnpickler(io.BytesIO(data), self._segment).load()
+            except FileNotFoundError as e:
+                last_err = e  # segment GC'd mid-swap: re-read the directory
+                continue
+            if self.control.seq() != seq:
+                last_err = None
+                continue  # a publish landed while we attached
+            # drop cache references no longer named by this publication
+            # (the mmaps stay alive until the last numpy view dies)
+            live = {payload["blob"]} | {name for name, _, _ in payload["arrays"]}
+            for name in [n for n in self._cache if n not in live]:
+                del self._cache[name]
+            self.attaches_total += 1
+            self.last_seq = seq
+            return gen, payload, obj
+        self.retries_exhausted_total += 1
+        raise TornGeneration(
+            f"no stable fleet publication after {self.retries} attempts"
+            + (f" (last error: {last_err})" if last_err else "")
+        )
+
+    def close(self) -> None:
+        self.control.close()
+        self._cache.clear()
+
+
+class FleetTwinClient:
+    """The worker's stand-in for the watch supervisor: same serving
+    interface (``serving_snapshot``/``state``/``metrics_lines``), backed
+    by the owner's shared-memory publication instead of a private watch
+    pipeline. On a generation change it attaches the new view, rebuilds
+    the warm base entry (``prepcache.entry_from_publication``) and swaps
+    it into the server's prep cache under the new generation key — the
+    request path then behaves exactly as with a live twin."""
+
+    key_prefix = "fleet|"
+
+    def __init__(self, control_name: str, prep_cache=None) -> None:
+        self.control_name = control_name
+        self.prep_cache = prep_cache
+        self.capacity = None  # assigned by SimonServer; bootstrap is per key
+        self.journal = None
+        self._reader: Optional[FleetReader] = None
+        self._lock = threading.Lock()
+        self._gen: Optional[int] = None
+        self._seq: Optional[int] = None  # guarded-by: _lock
+        self._cluster = None
+        self._payload: Optional[dict] = None
+        self._synced = threading.Event()
+
+    # -- lifecycle (the serve() supervisor contract) -------------------------
+
+    def start(self, wait_s: Optional[float] = None) -> bool:
+        deadline = time.monotonic() + (wait_s if wait_s is not None else 120.0)
+        attempt = 0
+        while time.monotonic() < deadline:
+            try:
+                if self._reader is None:
+                    self._reader = FleetReader(self.control_name)
+                if self._reader.poll() is not None:
+                    self._synced.set()
+                    return True
+            except (FileNotFoundError, ValueError):
+                self._reader = None  # owner not up yet
+            attempt += 1
+            time.sleep(min(0.25, 0.01 * attempt))
+        return False
+
+    def stop(self) -> None:
+        if self._reader is not None:
+            self._reader.close()
+
+    def attach_journal(self, journal) -> None:  # pragma: no cover - owner-only
+        raise RuntimeError("fleet workers do not own a journal (the twin owner does)")
+
+    def has_synced(self) -> bool:
+        return self._synced.is_set()
+
+    # -- serving interface ---------------------------------------------------
+
+    def state(self) -> str:
+        p = self._payload
+        return f"fleet-{p['state']}" if p else "fleet-attaching"
+
+    def is_stale(self) -> bool:
+        p = self._payload
+        return bool(p.get("stale")) if p else True
+
+    def serving_snapshot(self):
+        """(cluster, cache key, stale?) — None before the first attach.
+        Steady state is one seqlock poll; ANY new publication re-attaches
+        — a generation move swaps the warm base entry under the new key,
+        and a same-generation republish (the owner flipping
+        staleness/state on a quiet twin) refreshes the payload so
+        degraded responses keep their stale tag."""
+        if self._reader is None:
+            return None
+        state = self._reader.poll_state()
+        with self._lock:
+            if state is not None and state[1] != self._seq:
+                try:
+                    self._attach_locked()
+                except TornGeneration as e:
+                    log.warning("fleet attach failed (%s); serving previous generation", e)
+            if self._gen is None:
+                return None
+            return self._cluster, f"{self.key_prefix}{self._gen}", self.is_stale()
+
+    def _attach_locked(self) -> None:
+        from ..engine import prepcache
+        from ..obs import trace as tracing
+
+        gen, payload, obj = self._reader.attach()
+        if gen != self._gen:
+            key = f"{self.key_prefix}{gen}"
+            if self.prep_cache is not None and obj.get("parts") is not None:
+                entry = prepcache.entry_from_publication(f"{key}|base", obj["parts"])
+                old_gen = self._gen
+                self.prep_cache.put(f"{key}|base", entry)
+                if old_gen is not None:
+                    # trailing "|" so gen 5 cannot prefix-match gen 50's keys
+                    self.prep_cache.invalidate(f"{self.key_prefix}{old_gen}|")
+            self._cluster = obj["cluster"]
+        self._gen = gen
+        # the seq attach() VALIDATED, not the live one: a publish landing
+        # after the attach must leave this behind so the next poll
+        # re-attaches instead of silently serving the older payload
+        self._seq = self._reader.last_seq
+        self._payload = payload
+        self._synced.set()
+        tracing.event(
+            "fleet.attach", generation=gen, fingerprint=payload["fingerprint"],
+            state=payload.get("state"), stale=payload.get("stale"),
+        )
+
+    # -- telemetry -----------------------------------------------------------
+
+    def metrics_lines(self) -> List[str]:
+        r = self._reader
+        lines: List[str] = []
+        pairs = (
+            ("simon_fleet_attaches_total", r.attaches_total if r else 0),
+            ("simon_fleet_attach_retries_total", r.retries_total if r else 0),
+            (
+                "simon_fleet_attach_retries_exhausted_total",
+                r.retries_exhausted_total if r else 0,
+            ),
+            ("simon_fleet_segment_reuse_total", r.segment_reuse_total if r else 0),
+            ("simon_fleet_attach_generation", self._gen if self._gen is not None else -1),
+        )
+        for name, value in pairs:
+            lines += family_header(name)
+            lines.append(f"{name} {value}")
+        return lines
+
+
+# ---------------------------------------------------------------------------
+# worker process entry
+# ---------------------------------------------------------------------------
+
+
+def _http_base():
+    from .rest import SimonHTTPServer
+
+    return SimonHTTPServer
+
+class _ReusePortHTTPServer(_http_base()):
+    """Public listener shared across worker processes: every worker binds
+    the same port with SO_REUSEPORT and the kernel load-balances accepted
+    connections — no fd passing, and a respawned worker just binds again."""
+
+    # the stdlib default backlog of 5 RESETS the connect storm of a
+    # hundreds-of-clients closed loop before a single request is read;
+    # keep-alive means the storm is one-time, but it must survive it
+    request_queue_size = 512
+
+    def server_bind(self):
+        if not hasattr(socket, "SO_REUSEPORT"):  # pragma: no cover - linux CI
+            raise OSError(
+                "SO_REUSEPORT is unavailable on this platform; "
+                "simon server --workers needs it (docs/serving.md)"
+            )
+        self.socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        super().server_bind()
+
+
+def run_worker(port: int) -> int:
+    """One fleet worker: attach the owner's publication, serve the full
+    REST surface on the shared public port plus a loopback listener for
+    the owner's aggregation scrapes. Invoked by ``simon server`` when
+    ``OPENSIM_FLEET_ATTACH`` names a control block (the supervisor sets
+    it; operators never do)."""
+    from .rest import SimonServer, make_handler
+
+    control = envknobs.raw("OPENSIM_FLEET_ATTACH")
+    internal_raw = envknobs.raw("OPENSIM_FLEET_INTERNAL_PORT")
+    client = FleetTwinClient(control)
+    if not client.start(wait_s=120.0):
+        print(
+            f"simon server[worker]: no fleet publication at {control!r} "
+            "within 120s", flush=True,
+        )
+        return 1
+    server = SimonServer(watch=client)
+    client.prep_cache = server.prep_cache
+    server.memory.start_ticker()
+    handler = make_handler(server)
+    httpd = _ReusePortHTTPServer(("0.0.0.0", port), handler)
+    internal_httpd = None
+    if internal_raw:
+        internal_httpd = ThreadingHTTPServer(("127.0.0.1", int(internal_raw)), handler)
+        threading.Thread(
+            target=internal_httpd.serve_forever, name="simon-fleet-internal",
+            daemon=True,
+        ).start()
+
+    def _graceful(signum, frame):
+        log.info("worker received %s; draining", signal.Signals(signum).name)
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _graceful)
+        except ValueError:  # pragma: no cover - embedded use
+            break
+    print(
+        f"simon server[worker {os.getpid()}] attached to fleet "
+        f"(generation {client._gen if client._gen is not None else '?'}) "
+        f"on :{port}",
+        flush=True,
+    )
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:  # pragma: no cover
+        pass
+    finally:
+        # same drain order as the single-process server: stop admitting
+        # (queued tickets shed typed 503s, the in-flight batch completes),
+        # then detach from the publication
+        if internal_httpd is not None:
+            internal_httpd.shutdown()
+        server.close()
+        client.stop()
+        print(f"simon server[worker {os.getpid()}]: shutdown complete", flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# owner process: publisher loop + worker supervision + admin endpoint
+# ---------------------------------------------------------------------------
+
+
+def publish_interval_s() -> float:
+    # the registered validator owns the parse/clamp and the warn-and-
+    # fall-back policy (utils/envknobs.py)
+    return float(envknobs.value("OPENSIM_FLEET_PUBLISH_MS")) / 1000.0
+
+
+class _Worker:
+    def __init__(self, index: int, internal_port: int) -> None:
+        self.index = index
+        self.internal_port = internal_port
+        self.proc: Optional[subprocess.Popen] = None
+        self.spawned_at = 0.0
+        self.crashes = 0
+
+
+#: gauges whose fleet aggregate is a max, not a sum (a summed generation
+#: number is meaningless; everything else — counters, histogram buckets,
+#: queue depths — sums correctly across workers)
+_AGG_MAX = {"simon_fleet_attach_generation"}
+
+
+class FleetSupervisor:
+    """The twin-owner process: watch supervisor + journal + publisher +
+    worker supervision + the aggregated admin endpoint."""
+
+    def __init__(self, supervisor, journal, port: int, workers: int,
+                 admin_port: Optional[int] = None) -> None:
+        from ..engine.prepcache import PrepareCache
+
+        self.supervisor = supervisor
+        self.journal = journal
+        self.port = port
+        self.n_workers = workers
+        raw_admin = envknobs.raw("OPENSIM_FLEET_ADMIN_PORT")
+        self.admin_port = admin_port or (int(raw_admin) if raw_admin else port + 1)
+        self.prep_cache = PrepareCache()
+        supervisor.prep_cache = self.prep_cache
+        self.publisher = TwinPublisher()
+        self.workers = [
+            _Worker(i, self.admin_port + 1 + i) for i in range(workers)
+        ]
+        self.respawns_total = 0
+        self._published_gen: Optional[int] = None
+        self._published_stale: Optional[bool] = None
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # -- publication ---------------------------------------------------------
+
+    def publish_once(self) -> bool:
+        """Publish the twin's current generation if it moved (or its
+        staleness flipped). Returns True when a publication was written."""
+        from ..engine import prepcache
+        from ..engine.simulator import prepare
+
+        sup = self.supervisor
+        if not sup.has_synced():
+            return False
+        got = sup.serving_snapshot()  # folds pending deltas into the base entry
+        if got is None:
+            return False
+        cluster, key, stale = got
+        gen = int(key.rsplit("|", 1)[-1])
+        if gen == self._published_gen and stale == self._published_stale:
+            return False
+        base_key = f"{key}|base"
+        base = self.prep_cache.get(base_key)
+        if base is None:
+            watch = prepcache.watch_snapshot(cluster, [])  # before the build
+            base = self.prep_cache.put(
+                base_key,
+                prepcache.CacheEntry(base_key, prepare(cluster, []), watch=watch),
+            )
+        state = sup.state()
+        if base.prep is None:
+            self.publisher.publish(gen, cluster, None, state=state, stale=stale)
+        else:
+            with base.lock:
+                # the pickle walks the shared pod objects: bind state must
+                # be pristine and stay quiescent for the walk
+                base.restore()
+                parts = prepcache.publication_parts(base)
+                self.publisher.publish(gen, cluster, parts, state=state, stale=stale)
+        self._published_gen = gen
+        self._published_stale = stale
+        return True
+
+    def _publish_loop(self) -> None:
+        interval = publish_interval_s()
+        while not self._stop.is_set():
+            try:
+                self.publish_once()
+            except Exception as e:
+                log.warning("fleet publish failed: %s: %s", type(e).__name__, e)
+            self._stop.wait(interval)
+
+    # -- workers -------------------------------------------------------------
+
+    def _spawn(self, w: _Worker) -> None:
+        env = dict(os.environ)
+        env["OPENSIM_FLEET_ATTACH"] = self.publisher.control.name
+        env["OPENSIM_FLEET_INTERNAL_PORT"] = str(w.internal_port)
+        # a worker must never recurse into fleet mode
+        env.pop("OPENSIM_WORKERS_FLEET", None)
+        w.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "opensim_tpu", "server",
+                "--port", str(self.port), "--watch", "off",
+            ],
+            env=env,
+        )
+        w.spawned_at = time.monotonic()
+        log.info("fleet worker %d spawned (pid %d)", w.index, w.proc.pid)
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            for w in self.workers:
+                if self._stop.is_set():
+                    return
+                if w.proc is not None and w.proc.poll() is None:
+                    if time.monotonic() - w.spawned_at > 30.0:
+                        w.crashes = 0  # stable long enough: reset the backoff
+                    continue
+                rc = w.proc.returncode if w.proc is not None else None
+                log.warning(
+                    "fleet worker %d exited (rc=%s); respawning", w.index, rc
+                )
+                self.respawns_total += 1
+                delay = backoff_delay(w.crashes, base_delay=0.25, max_delay=5.0)
+                w.crashes += 1
+                if self._stop.wait(delay):
+                    return
+                self._spawn(w)
+            self._stop.wait(0.5)
+
+    # -- aggregation ---------------------------------------------------------
+
+    def _scrape_worker(self, w: _Worker) -> Optional[str]:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{w.internal_port}/metrics", timeout=2.0
+            ) as resp:
+                return resp.read().decode()
+        except OSError:
+            return None
+
+    def aggregate_metrics(self) -> str:
+        """The fleet /metrics body: per-worker expositions summed per
+        series (bucket ladders are shared, so histogram sums stay valid
+        histograms), plus the owner's twin/journal families and the fleet
+        families themselves."""
+        from .loadgen import parse_metrics
+
+        sums: Dict[tuple, float] = {}
+        live = 0
+        for w in self.workers:
+            text = self._scrape_worker(w)
+            if text is None:
+                continue
+            live += 1
+            for key, v in parse_metrics(text).items():
+                if key[0] in _AGG_MAX:
+                    sums[key] = max(sums.get(key, float("-inf")), v)
+                else:
+                    sums[key] = sums.get(key, 0.0) + v
+        lines: List[str] = []
+        fp = self.publisher.footprint()
+        own = [
+            ("simon_fleet_workers", live),
+            ("simon_fleet_workers_target", self.n_workers),
+            ("simon_fleet_respawns_total", self.respawns_total),
+            ("simon_fleet_publishes_total", fp["publishes"]),
+            ("simon_fleet_generation", fp["generation"]),
+            ("simon_fleet_shm_segments", fp["segments"]),
+            ("simon_fleet_shm_bytes", fp["bytes"]),
+        ]
+        for name, value in own:
+            lines += family_header(name)
+            lines.append(f"{name} {value}")
+        with RECORDER.lock:
+            lines += self.publisher.publish_seconds.render_lines()
+        if self.supervisor is not None:
+            lines += self.supervisor.metrics_lines()
+        if self.journal is not None:
+            lines += self.journal.metrics_lines()
+        emitted: set = set()
+        for (name, labels) in sorted(sums):
+            family = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if family.endswith(suffix):
+                    family = family[: -len(suffix)]
+                    break
+            if family in FAMILIES and family not in emitted:
+                lines += family_header(family)
+                emitted.add(family)
+            body = ",".join(
+                f'{k}="{escape_label_value(v)}"' for k, v in labels
+            )
+            value = sums[(name, labels)]
+            rendered = f"{value:.10g}"
+            lines.append(f"{name}{{{body}}} {rendered}" if body else f"{name} {rendered}")
+        return "\n".join(lines) + "\n"
+
+    def status(self) -> dict:
+        fp = self.publisher.footprint()
+        return {
+            "workers": [
+                {
+                    "index": w.index,
+                    "pid": w.proc.pid if w.proc is not None else None,
+                    "alive": w.proc is not None and w.proc.poll() is None,
+                    "internal_port": w.internal_port,
+                    "crashes": w.crashes,
+                }
+                for w in self.workers
+            ],
+            "target_workers": self.n_workers,
+            "respawns_total": self.respawns_total,
+            "twin_state": self.supervisor.state() if self.supervisor else "none",
+            "shm": fp,
+            "control": self.publisher.control.name,
+            "port": self.port,
+            "admin_port": self.admin_port,
+        }
+
+    def alive_workers(self) -> int:
+        return sum(
+            1 for w in self.workers if w.proc is not None and w.proc.poll() is None
+        )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        for w in self.workers:
+            self._spawn(w)
+        for target, name in (
+            (self._publish_loop, "simon-fleet-publish"),
+            (self._monitor_loop, "simon-fleet-monitor"),
+        ):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self, drain_s: float = 30.0) -> None:
+        """SIGTERM drain order: workers first (each drains its admission
+        queue and completes in-flight work), then the reflectors, then the
+        journal flush, then the shared-memory unlink."""
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        for w in self.workers:
+            if w.proc is not None and w.proc.poll() is None:
+                with contextlib.suppress(OSError):
+                    w.proc.terminate()
+        deadline = time.monotonic() + drain_s
+        for w in self.workers:
+            if w.proc is None:
+                continue
+            with contextlib.suppress(subprocess.TimeoutExpired):
+                w.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            if w.proc.poll() is None:
+                log.warning("fleet worker %d did not drain; killing", w.index)
+                with contextlib.suppress(OSError):
+                    w.proc.kill()
+                    w.proc.wait(timeout=5.0)
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        if self.journal is not None:
+            self.journal.close()
+        self.publisher.close()
+
+
+def _make_admin_handler(fleet: FleetSupervisor):
+    class AdminHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet, like the REST handler
+            pass
+
+        def _send(self, code: int, data: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            if path == "/healthz":
+                alive = fleet.alive_workers()
+                body = {
+                    "status": "ok" if alive == fleet.n_workers else "degraded",
+                    "role": "fleet-owner",
+                    "workers": alive,
+                    "target": fleet.n_workers,
+                    "generation": fleet.publisher.last_generation,
+                }
+                self._send(200, json.dumps(body).encode(), "application/json")
+            elif path == "/metrics":
+                try:
+                    text = fleet.aggregate_metrics()
+                except Exception as e:  # a worker roll mid-scrape
+                    log.warning("fleet aggregation failed: %s: %s", type(e).__name__, e)
+                    self._send(
+                        500, json.dumps({"error": str(e)}).encode(), "application/json"
+                    )
+                    return
+                self._send(200, text.encode(), "text/plain; version=0.0.4")
+            elif path == "/api/fleet/status":
+                self._send(200, json.dumps(fleet.status()).encode(), "application/json")
+            else:
+                self._send(404, b'{"error": "not found"}', "application/json")
+
+    return AdminHandler
+
+
+def serve_fleet(kubeconfig: str, master: str, port: int, watch: str,
+                journal: str, workers: int) -> int:
+    """``simon server --workers N``: the multi-process serving fleet.
+    Called by ``rest.serve`` with already-validated paths. The owner
+    process never serves simulate traffic — workers own the public port
+    via SO_REUSEPORT; the owner serves the aggregated fleet endpoint on
+    the admin port (default: public port + 1)."""
+    from .rest import build_twin
+
+    if not kubeconfig or watch == "off":
+        print(
+            "simon server: --workers needs the live twin "
+            "(--kubeconfig and --watch auto|on) — the twin owner is what "
+            "the workers attach to", flush=True,
+        )
+        return 1
+    try:
+        supervisor, jrnl = build_twin(kubeconfig, master, watch, journal)
+    except ValueError as e:
+        print(f"simon server: {e}", flush=True)
+        return 1
+    if jrnl is not None:
+        # attached BEFORE start(): the twin restores from the newest
+        # checkpoint + suffix replay during startup, like the
+        # single-process server (SimonServer wires this in its ctor)
+        supervisor.attach_journal(jrnl)
+    fleet = FleetSupervisor(supervisor, jrnl, port, workers)
+    if watch == "on":
+        if not supervisor.start(wait_s=60.0):
+            print("simon server: --watch on but the twin could not sync", flush=True)
+            supervisor.stop()
+            fleet.publisher.close()
+            return 1
+    else:
+        supervisor.start()
+    httpd = ThreadingHTTPServer(("0.0.0.0", fleet.admin_port), _make_admin_handler(fleet))
+
+    def _graceful(signum, frame):
+        log.info(
+            "fleet received %s; draining workers then owner",
+            signal.Signals(signum).name,
+        )
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _graceful)
+        except ValueError:  # pragma: no cover - embedded use
+            break
+    fleet.start()
+    print(
+        f"simon fleet listening on :{port} [{workers} workers, "
+        f"admin :{fleet.admin_port}]"
+        + (f" [journal {journal}]" if jrnl is not None else ""),
+        flush=True,
+    )
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:  # pragma: no cover
+        pass
+    finally:
+        fleet.stop()
+        print("simon fleet: shutdown complete", flush=True)
+    return 0

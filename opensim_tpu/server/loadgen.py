@@ -37,6 +37,8 @@ log = logging.getLogger("opensim_tpu.loadgen")
 __all__ = [
     "run_loadgen",
     "run_stub_benchmark",
+    "run_fleet_benchmark",
+    "placement_parity",
     "parse_metrics",
     "histogram_quantile",
     "scrape_metrics",
@@ -281,6 +283,7 @@ def run_loadgen(
     mem: str = "1Gi",
     timeout_s: float = 60.0,
     warmup_requests: int = 1,
+    metrics_url: str = "",
 ) -> dict:
     """Drive the server and report sustained QPS + latency percentiles.
 
@@ -292,11 +295,17 @@ def run_loadgen(
       completions (up to ``concurrency`` in flight; arrivals past that are
       counted ``dropped`` — client-side overload, reported, never silently
       skipped).
+
+    ``metrics_url`` overrides where the server-side histograms are
+    scraped: against a multi-worker fleet the public port lands on ONE
+    worker per connection, so the scrape must hit the fleet admin
+    endpoint (aggregated across workers) instead.
     """
     if mode not in ("closed", "open"):
         raise ValueError(f"mode must be closed|open, got {mode!r}")
     if mode == "open" and qps <= 0:
         raise ValueError("open loop needs --qps > 0")
+    metrics_url = metrics_url or url
 
     # warmup outside the measured window: the first request pays the cold
     # prepare + engine compile and would dominate a short run
@@ -305,7 +314,7 @@ def run_loadgen(
         wcli.request(_payload(999, i, replicas, cpu, mem))
     wcli.close()
 
-    before = scrape_metrics(url)
+    before = scrape_metrics(metrics_url)
     stats = _Stats()
     stop = time.monotonic() + duration_s
     dropped = [0]
@@ -367,7 +376,7 @@ def run_loadgen(
         # drain stragglers briefly so the final scrape sees them
         time.sleep(min(2.0, timeout_s))
     measured_s = time.monotonic() - t_start
-    after = scrape_metrics(url)
+    after = scrape_metrics(metrics_url)
 
     lats = sorted(stats.latencies)
     ok_match = {"endpoint": "deploy-apps", "status": "ok"}
@@ -454,10 +463,14 @@ def _seed_stub(n_nodes: int, n_pods: int):
     return stub
 
 
-def _boot_server(kubeconfig: str, port: int, admission: bool, batch_max: int):
+def _boot_server(kubeconfig: str, port: int, admission: bool, batch_max: int,
+                 workers: int = 0, queue_bound: int = 0):
     """The simon server as a SUBPROCESS: the loadgen client and the server
     must not share a GIL, or the measurement reports the client's
-    contention as server latency."""
+    contention as server latency. ``workers`` ≥ 2 boots the multi-process
+    fleet (``--workers N``); readiness then waits on the fleet admin
+    ``/healthz`` reporting every worker alive (the public port is served
+    by the workers via SO_REUSEPORT)."""
     import os
     import subprocess
     import sys
@@ -470,27 +483,40 @@ def _boot_server(kubeconfig: str, port: int, admission: bool, batch_max: int):
         OPENSIM_BATCH_MAX=str(batch_max),
         PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
     )
+    if queue_bound:
+        env["OPENSIM_QUEUE_BOUND"] = str(queue_bound)
+    cmd = [sys.executable, "-m", "opensim_tpu", "server",
+           "--kubeconfig", kubeconfig, "--port", str(port), "--watch", "auto"]
+    if workers >= 2:
+        cmd += ["--workers", str(workers)]
     proc = subprocess.Popen(
-        [sys.executable, "-m", "opensim_tpu", "server",
-         "--kubeconfig", kubeconfig, "--port", str(port), "--watch", "auto"],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
     )
     url = f"http://127.0.0.1:{port}"
-    deadline = time.monotonic() + 120.0
+    ready_url = f"http://127.0.0.1:{port + 1}/healthz" if workers >= 2 else f"{url}/healthz"
+    deadline = time.monotonic() + (240.0 if workers >= 2 else 120.0)
     attempt = 0
     while time.monotonic() < deadline:
         if proc.poll() is not None:
             out = (proc.stdout.read() or b"").decode(errors="replace")
             raise RuntimeError(f"server exited at boot (rc={proc.returncode}): {out[-2000:]}")
         try:
-            with urllib.request.urlopen(f"{url}/healthz", timeout=1.0):
+            with urllib.request.urlopen(ready_url, timeout=1.0) as resp:
+                if workers >= 2:
+                    body = json.loads(resp.read().decode())
+                    if body.get("status") != "ok" or body.get("generation", -1) < 0:
+                        raise OSError("fleet not ready")
+                    # the admin endpoint is up and every worker process is
+                    # alive; confirm the shared public port answers too
+                    with urllib.request.urlopen(f"{url}/healthz", timeout=1.0):
+                        pass
                 return proc, url
-        except OSError as e:
+        except (OSError, ValueError) as e:
             log.debug("healthz probe %d: %s", attempt, e)
             attempt += 1
             time.sleep(min(0.5, 0.05 * attempt))
     proc.kill()
-    raise RuntimeError("server did not become healthy within 120s")
+    raise RuntimeError("server did not become healthy within the boot window")
 
 
 def _warm_concurrent(url: str, n: int, timeout_s: float) -> None:
@@ -566,4 +592,274 @@ def run_stub_benchmark(
         "shed_single_flight": single["shed"],
         "single_flight": single,
         "admission": batched,
+    }
+
+
+def run_loadgen_sharded(
+    url: str,
+    concurrency: int,
+    duration_s: float,
+    procs: int,
+    metrics_url: str = "",
+    timeout_s: float = 60.0,
+) -> dict:
+    """The closed loop sharded over ``procs`` CLIENT PROCESSES: at
+    hundreds of concurrent clients a single loadgen process's GIL throttles
+    the offered load and bills client-side scheduling to the server.
+    Each shard is one ``simon loadgen`` subprocess driving
+    ``concurrency/procs`` workers; client-side QPS/shed/error counts sum
+    across shards, and the server-side percentiles come from ONE
+    before/after scrape of ``metrics_url`` around the whole run (the only
+    view that covers every shard's traffic)."""
+    import os
+    import subprocess
+    import sys
+
+    metrics_url = metrics_url or url
+    shares = [concurrency // procs] * procs
+    for i in range(concurrency % procs):
+        shares[i] += 1
+    before = scrape_metrics(metrics_url)
+    t_start = time.monotonic()
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    children = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "opensim_tpu", "loadgen", "--url", url,
+                "--mode", "closed", "--concurrency", str(share),
+                "--duration", str(duration_s), "--timeout", str(timeout_s),
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        )
+        for share in shares
+        if share > 0
+    ]
+    reports = []
+    try:
+        for c in children:
+            out, err = c.communicate(timeout=duration_s + 300.0)
+            lines = [ln for ln in out.decode().strip().splitlines() if ln.strip()]
+            if c.returncode != 0 or not lines:
+                raise RuntimeError(
+                    f"loadgen shard failed rc={c.returncode}: "
+                    f"{(err or out)[-500:].decode(errors='replace')!r}"
+                )
+            reports.append(json.loads(lines[-1]))
+    finally:
+        # one failed/hung shard must not leave its siblings hammering the
+        # server as orphans (they would skew every later measurement)
+        for c in children:
+            if c.poll() is None:
+                c.kill()
+                c.wait()
+    measured_s = time.monotonic() - t_start
+    after = scrape_metrics(metrics_url)
+    ok_match = {"endpoint": "deploy-apps", "status": "ok"}
+    batches = _counter_delta(before, after, "simon_batches_total")
+    batched_reqs = _counter_delta(before, after, "simon_batch_size_sum")
+    return {
+        "mode": "closed-sharded",
+        "client_procs": len(children),
+        "duration_s": round(measured_s, 3),
+        "concurrency": concurrency,
+        "requests": sum(r["requests"] for r in reports),
+        "ok": sum(r["ok"] for r in reports),
+        "shed": sum(r["shed"] for r in reports),
+        "errors": sum(r["errors"] for r in reports),
+        "qps": round(sum(r["qps"] for r in reports), 2),
+        "client_p99_s": max(
+            (r["client_p99_s"] for r in reports if r["client_p99_s"] is not None),
+            default=None,
+        ),
+        "server_p50_s": histogram_quantile(
+            before, after, "simon_request_seconds", 0.50, ok_match
+        ),
+        "server_p99_s": histogram_quantile(
+            before, after, "simon_request_seconds", 0.99, ok_match
+        ),
+        "queue_wait_p99_s": histogram_quantile(
+            before, after, "simon_queue_wait_seconds", 0.99
+        ),
+        "batches": int(batches),
+        "batched_requests": int(batched_reqs),
+        "mean_batch_size": round(batched_reqs / batches, 2) if batches else 0.0,
+        "shards": reports,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the fleet closed loop (ISSUE 15): N worker processes vs one process
+# ---------------------------------------------------------------------------
+
+
+def _canon_response(body: dict) -> tuple:
+    """Placement identity view of a deploy response: expanded pod names
+    carry per-process random suffixes (NOTES invariant), so pods are
+    canonicalized onto their owning workload (the name minus the final
+    suffix segment) and compared as (node, workload, count) triples plus
+    the unscheduled (workload, reason) set."""
+    def canon(ref: str) -> str:
+        # strip every trailing generated segment (10-hex expansion
+        # counters): a Deployment pod carries TWO — the ReplicaSet's and
+        # its own — and the counters are process-global, so they differ
+        # across servers by design
+        ns, _, name = ref.partition("/")
+        parts = name.split("-")
+        while len(parts) > 1 and re.fullmatch(r"[0-9a-f]{10}", parts[-1]):
+            parts.pop()
+        return f"{ns}/{'-'.join(parts)}"
+
+    placed = sorted(
+        (e["node"], sorted(canon(p) for p in e["pods"]))
+        for e in body.get("nodeStatus", [])
+    )
+    unsched = sorted(
+        (canon(u["pod"]), u["reason"]) for u in body.get("unscheduledPods", [])
+    )
+    return placed, unsched
+
+
+def _post_deploy(url: str, payload: bytes, timeout_s: float = 60.0) -> dict:
+    req = urllib.request.Request(
+        f"{url}/api/deploy-apps", data=payload,
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode())
+
+
+def placement_parity(url_a: str, url_b: str, n_probes: int = 4) -> bool:
+    """The fleet bit-identity gate, end to end over HTTP: the same deploy
+    payloads against both servers must place onto the same nodes with the
+    same per-workload counts and the same unschedulable reasons."""
+    for i in range(n_probes):
+        payload = _payload(777, i, 3, "500m", "1Gi")
+        a = _canon_response(_post_deploy(url_a, payload))
+        b = _canon_response(_post_deploy(url_b, payload))
+        if a != b:
+            log.warning("placement parity failed on probe %d: %r != %r", i, a, b)
+            return False
+    return True
+
+
+def run_fleet_benchmark(
+    workers: int = 2,
+    concurrency: int = 64,
+    duration_s: float = 8.0,
+    n_nodes: int = 8,
+    n_pods: int = 16,
+    batch_max: int = 32,
+    base_port: int = 18280,
+    queue_bound: int = 0,
+    client_procs: int = 0,
+) -> dict:
+    """The ISSUE 15 closed loop: stub apiserver → ONE single-process
+    admission server and ONE ``--workers N`` fleet (twin owner + shm
+    publication + SO_REUSEPORT workers), the same closed-loop loadgen
+    against each, plus the end-to-end placement-parity gate between them.
+    The fleet's server-side histograms come from the aggregated admin
+    endpoint (scraping the public port would sample one worker).
+    ``client_procs`` ≥ 2 shards the clients over that many loadgen
+    subprocesses (``run_loadgen_sharded``) — mandatory fidelity at
+    hundreds of concurrent clients, where one client process's GIL would
+    throttle the offered load for both measurements equally but far below
+    what the servers can actually sustain."""
+    import tempfile
+
+    stub = _seed_stub(n_nodes, n_pods)
+    tmp = tempfile.mkdtemp(prefix="loadgen-fleet-")
+    kc = stub.kubeconfig(tmp)
+    qb = queue_bound or max(64, 2 * concurrency)
+
+    def drive(url: str, metrics_url: str = "") -> dict:
+        # a measured-length warm burst at full concurrency: the batcher's
+        # big pad buckets compile lazily PER PROCESS, so without this a
+        # worker pays multi-second XLA compiles inside the measured window
+        # (randomly, per bucket) and the run-to-run variance swamps the
+        # comparison. Applied to both servers — strictly fair.
+        run_loadgen(
+            url, mode="closed", concurrency=min(concurrency, 96),
+            duration_s=max(3.0, duration_s / 3.0), warmup_requests=0,
+            metrics_url=metrics_url,
+        )
+        if client_procs >= 2:
+            return run_loadgen_sharded(
+                url, concurrency, duration_s, client_procs,
+                metrics_url=metrics_url,
+            )
+        return run_loadgen(
+            url, mode="closed", concurrency=concurrency,
+            duration_s=duration_s, metrics_url=metrics_url,
+        )
+
+    try:
+        proc, url = _boot_server(
+            kc, base_port, admission=True, batch_max=batch_max, queue_bound=qb,
+        )
+        try:
+            _warm_concurrent(url, min(16, concurrency), 60.0)
+            single = drive(url)
+        finally:
+            proc.terminate()
+            proc.wait()
+        fproc, furl = _boot_server(
+            kc, base_port + 2, admission=True, batch_max=batch_max,
+            workers=workers, queue_bound=qb,
+        )
+        admin_url = f"http://127.0.0.1:{base_port + 3}"
+        try:
+            _warm_concurrent(furl, min(16, concurrency), 60.0)
+            fleet = drive(furl, metrics_url=admin_url)
+            fleet_metrics = scrape_metrics(admin_url)
+            with urllib.request.urlopen(
+                f"{admin_url}/api/fleet/status", timeout=5.0
+            ) as resp:
+                status = json.loads(resp.read().decode())
+            # parity gate: re-boot a fresh single-process server so both
+            # sides answer the same probes against the same stub cluster
+            pproc, purl = _boot_server(
+                kc, base_port + 40, admission=True, batch_max=batch_max,
+            )
+            try:
+                parity = placement_parity(purl, furl)
+            finally:
+                pproc.terminate()
+                pproc.wait()
+        finally:
+            fproc.terminate()
+            fproc.wait()
+    finally:
+        stub.stop()
+    torn = int(
+        fleet_metrics.get(("simon_fleet_attach_retries_exhausted_total", ()), 0.0)
+    )
+    speedup = fleet["qps"] / single["qps"] if single["qps"] > 0 else float("inf")
+    return {
+        "workers": workers,
+        "concurrency": concurrency,
+        "duration_s": duration_s,
+        "nodes": n_nodes,
+        "cluster_pods": n_pods,
+        "qps_single_process": single["qps"],
+        "qps": fleet["qps"],
+        "vs_single_process": round(speedup, 2),
+        "p50_s": fleet["server_p50_s"],
+        "p99_s": fleet["server_p99_s"],
+        "p50_single_process_s": single["server_p50_s"],
+        "p99_single_process_s": single["server_p99_s"],
+        "batches": fleet["batches"],
+        "mean_batch_size": fleet["mean_batch_size"],
+        "shed": fleet["shed"],
+        "errors": fleet["errors"],
+        "placements_identical": parity,
+        "torn_generation_exhausted": torn,
+        "fleet_generation": int(
+            fleet_metrics.get(("simon_fleet_generation", ()), -1.0)
+        ),
+        "fleet_publishes": int(
+            fleet_metrics.get(("simon_fleet_publishes_total", ()), 0.0)
+        ),
+        "respawns": status.get("respawns_total", 0),
+        "single_process": single,
+        "fleet": fleet,
     }

@@ -1033,6 +1033,10 @@ class SimonServer:
                     reqbatch.BatchItem(
                         cluster=cluster0, apps=[apps[s]],
                         lo=slices[s][0], hi=slices[s][1], drops=drops,
+                        # batched explain (ISSUE 15 satellite): the rider's
+                        # audit is built from its own count_all fail rows
+                        # over the shared derive
+                        explain=tickets[s].explain,
                         # in-flight shedding (ISSUE 9 satellite): the C++
                         # sequential path re-checks this between rider scans
                         deadline=tickets[s].deadline,
@@ -1559,9 +1563,73 @@ def make_handler(server: SimonServer):
     return Handler
 
 
+class SimonHTTPServer(ThreadingHTTPServer):
+    """The serving listener: stdlib ``ThreadingHTTPServer`` with a backlog
+    sized for hundreds of concurrent keep-alive clients — the default
+    backlog of 5 resets most of a 500-client connect storm before a
+    single request is read (ISSUE 15; the fleet's SO_REUSEPORT listener
+    subclasses this sizing in server/fleet.py)."""
+
+    request_queue_size = 512
+
+
+def build_twin(kubeconfig: str, master: str, watch: str, journal: str):
+    """(watch supervisor or None, journal or None) for a serving process —
+    shared by the single-process :func:`serve` and the fleet owner
+    (``server/fleet.serve_fleet``). Raises ``ValueError`` on operator
+    errors (both callers print the message and exit 1). Paths must
+    already be validated."""
+    if watch == "on" and not kubeconfig:
+        # "require a synced twin" with nothing to sync FROM is an operator
+        # error that must not silently degrade to an empty polling server
+        raise ValueError("--watch on requires --kubeconfig")
+    supervisor = None
+    if kubeconfig and watch != "off":
+        from .watch import source_from_kubeconfig, watch_policy, WatchSupervisor
+
+        policy = watch_policy()
+        supervisor = WatchSupervisor(
+            source_from_kubeconfig(
+                kubeconfig, master or None, read_timeout_s=policy["stale_s"]
+            ),
+            policy=policy,
+        )
+    jrnl = None
+    if journal:
+        if supervisor is None:
+            # a journal with no event stream to record is an operator
+            # mistake worth failing on, not silently ignoring
+            raise ValueError(
+                "--journal requires the live twin (--kubeconfig and "
+                "--watch auto|on)"
+            )
+        from .journal import Journal, JournalError
+
+        try:
+            jrnl = Journal(journal)
+        except JournalError as e:
+            raise ValueError(str(e)) from e
+    return supervisor, jrnl
+
+
+def fleet_workers(flag: int = 0) -> int:
+    """Resolve the fleet size: the ``--workers`` flag wins, else
+    ``OPENSIM_WORKERS_FLEET``; 0/1 means single-process serving."""
+    if flag:
+        return flag
+    raw = envknobs.raw("OPENSIM_WORKERS_FLEET")
+    if not raw:
+        return 0
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        log.warning("ignoring unparseable OPENSIM_WORKERS_FLEET=%r", raw)
+        return 0
+
+
 def serve(
     kubeconfig: str = "", master: str = "", port: int = 8080,
-    watch: str = "auto", journal: str = "",
+    watch: str = "auto", journal: str = "", workers: int = 0,
 ) -> int:
     """Start the REST server. ``watch`` selects the snapshot strategy when a
     kubeconfig is configured (docs/live-twin.md):
@@ -1583,6 +1651,11 @@ def serve(
     queue drains (in-flight batch completes, queued requests shed typed
     503 ``shutting_down``), the reflectors stop, the journal is flushed +
     fsynced, and the process exits 0.
+
+    ``workers`` ≥ 2 (or ``OPENSIM_WORKERS_FLEET``) serves through the
+    multi-process fleet instead (docs/serving.md "Scaling past one
+    process"): a twin-owner process publishing arena deltas over shared
+    memory plus N worker processes sharing the port via SO_REUSEPORT.
     """
     import signal
 
@@ -1593,39 +1666,23 @@ def serve(
     kubeconfig = validate.user_path(kubeconfig, label="--kubeconfig", allow_empty=True)
     journal = validate.user_path(journal, label="--journal", allow_empty=True)
 
-    if watch == "on" and not kubeconfig:
-        # "require a synced twin" with nothing to sync FROM is an operator
-        # error that must not silently degrade to an empty polling server
-        print("simon server: --watch on requires --kubeconfig", flush=True)
+    if envknobs.raw("OPENSIM_FLEET_ATTACH"):
+        # this process IS a fleet worker (the supervisor set the knob):
+        # attach the owner's publication instead of building a twin
+        from .fleet import run_worker
+
+        return run_worker(port)
+    n_fleet = fleet_workers(workers)
+    if n_fleet >= 2:
+        from .fleet import serve_fleet
+
+        return serve_fleet(kubeconfig, master, port, watch, journal, n_fleet)
+
+    try:
+        supervisor, jrnl = build_twin(kubeconfig, master, watch, journal)
+    except ValueError as e:
+        print(f"simon server: {e}", flush=True)
         return 1
-    supervisor = None
-    if kubeconfig and watch != "off":
-        from .watch import source_from_kubeconfig, watch_policy, WatchSupervisor
-
-        policy = watch_policy()
-        supervisor = WatchSupervisor(
-            source_from_kubeconfig(
-                kubeconfig, master or None, read_timeout_s=policy["stale_s"]
-            ),
-            policy=policy,
-        )
-    jrnl = None
-    if journal:
-        if supervisor is None:
-            # a journal with no event stream to record is an operator
-            # mistake worth failing on, not silently ignoring
-            print(
-                "simon server: --journal requires the live twin "
-                "(--kubeconfig and --watch auto|on)", flush=True,
-            )
-            return 1
-        from .journal import Journal, JournalError
-
-        try:
-            jrnl = Journal(journal)
-        except JournalError as e:
-            print(f"simon server: {e}", flush=True)
-            return 1
     server = SimonServer(
         kubeconfig=kubeconfig, master=master, watch=supervisor, journal=jrnl
     )
@@ -1642,7 +1699,7 @@ def serve(
                 return 1
         else:
             supervisor.start()
-    httpd = ThreadingHTTPServer(("0.0.0.0", port), make_handler(server))
+    httpd = SimonHTTPServer(("0.0.0.0", port), make_handler(server))
     # graceful shutdown (ISSUE 11 satellite): the handler only nudges the
     # serve loop from a helper thread (httpd.shutdown() deadlocks when
     # called from the thread running serve_forever) — the drain sequence

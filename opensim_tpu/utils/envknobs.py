@@ -211,6 +211,14 @@ _SERVER = [
     Knob("OPENSIM_JOURNAL_CHECKPOINT_EVERY", "int", "4096", "Event records between journal cadence checkpoints.", _int(lo=1), on_error="raise", section="server"),
     Knob("OPENSIM_JOURNAL_KEEP", "int", "2", "Checkpoint segments retained by journal pruning.", _int(lo=1), on_error="raise", section="server"),
     Knob("OPENSIM_JOURNAL_QUEUE", "int", "65536", "Journal writer queue bound; past it records drop (counted) and the next checkpoint re-anchors.", _int(lo=1), on_error="raise", section="server"),
+    # multi-process serving fleet (server/fleet.py, docs/serving.md
+    # "Scaling past one process")
+    Knob("OPENSIM_WORKERS_FLEET", "int", "", "Fleet worker processes for `simon server` (the `--workers` flag wins; unset/0/1 = single process).", None, section="server"),
+    Knob("OPENSIM_FLEET_PUBLISH_MS", "float", "50", "Twin-owner publish cadence: how often the owner checks the twin generation and republishes arena deltas over shared memory.", _float(lo=1.0), section="server"),
+    Knob("OPENSIM_FLEET_ATTACH_RETRIES", "int", "16", "Seqlock attach retries before a worker declares the publication torn (counted in simon_fleet_attach_retries_exhausted_total).", _int(lo=1), section="server"),
+    Knob("OPENSIM_FLEET_ADMIN_PORT", "int", "", "Fleet admin port (aggregated /metrics, /healthz, /api/fleet/status). Default: public port + 1.", None, section="server"),
+    Knob("OPENSIM_FLEET_ATTACH", "str", "", "INTERNAL: shared-memory control-block name a fleet worker attaches to (set by the fleet supervisor, never by operators).", None, section="server"),
+    Knob("OPENSIM_FLEET_INTERNAL_PORT", "int", "", "INTERNAL: per-worker loopback listener port the fleet supervisor scrapes for /metrics aggregation (set by the supervisor).", None, section="server"),
 ]
 
 _OBSERVABILITY = [

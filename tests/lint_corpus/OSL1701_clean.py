@@ -1,0 +1,5 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+def grab(control_name):
+    from opensim_tpu.server.fleet import FleetReader  # the sanctioned path
+
+    return FleetReader(control_name).attach()

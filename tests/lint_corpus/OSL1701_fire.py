@@ -1,0 +1,6 @@
+# lint-corpus-path: opensim_tpu/server/fixture.py
+from multiprocessing import shared_memory
+
+
+def grab(name):
+    return shared_memory.SharedMemory(name=name)  # foreign attach

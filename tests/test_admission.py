@@ -553,3 +553,126 @@ def test_process_pool_runs_unpicklable_tasks_on_threads():
             assert pool.submit(len, (1, 2, 3)).result(timeout=30) == 3
     finally:
         pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# batched explain + vmapped-batch deadline shedding (ISSUE 15 satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_explain_requests_batch_and_match_solo_audit():
+    """``?explain=1`` deploys ride the shared batch (count_all fail rows
+    over the shared derive) and the audit — per-pod explanations and the
+    per-filter reject totals — is bit-identical to the solo explain path.
+    The unschedulable workload is the load-bearing case: its reason
+    breakdown comes entirely from the audited fail rows."""
+    payloads = [
+        {"deployments": [fx.make_fake_deployment(f"xp-{i}", 2, "500m", "1Gi").raw]}
+        for i in range(3)
+    ]
+    payloads.append(
+        {"deployments": [fx.make_fake_deployment("xhuge", 1, "640", "1Gi").raw]}
+    )
+    wl = _workloads_of(payloads)
+
+    serial = _make_server(admission=False)
+    expected = []
+    for p in payloads:
+        code, body = serial.deploy_apps(p, explain=True)
+        assert code == 200, body
+        expected.append(body)
+
+    batched = _make_server(window_s=0.25)
+    results = [None] * len(payloads)
+
+    def run(i):
+        results[i] = batched.deploy_apps(payloads[i], explain=True)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(len(payloads))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    try:
+        for i, (code, body) in enumerate(results):
+            assert code == 200, (i, body)
+            assert _canon(body, wl) == _canon(expected[i], wl)
+            # the audit payloads match too: reject totals and, for the
+            # unschedulable rider, the per-pod explanation breakdown
+            assert body.get("filterRejects") == expected[i].get("filterRejects"), i
+            got_expl = {
+                _canon_pod(u["pod"], wl): u.get("explanation")
+                for u in body["unscheduledPods"]
+            }
+            want_expl = {
+                _canon_pod(u["pod"], wl): u.get("explanation")
+                for u in expected[i]["unscheduledPods"]
+            }
+            def _strip(e):
+                if not isinstance(e, dict):
+                    return e
+                return {k: v for k, v in e.items() if k != "pod"}
+            assert {k: _strip(v) for k, v in got_expl.items()} == {
+                k: _strip(v) for k, v in want_expl.items()
+            }, i
+        assert batched.admission.batches_total >= 1, "explain traffic never batched"
+    finally:
+        batched.close()
+        serial.close()
+
+
+def test_xla_batch_sheds_expired_riders_before_dispatch(monkeypatch):
+    """Pre-dispatch deadline shedding on the vmapped path: a rider whose
+    deadline is already dead gets the typed 504 (phase=schedule) and its
+    lane is masked out of the compiled dispatch; the live riders' results
+    are untouched (their masks never included the shed rider's pods)."""
+    from opensim_tpu.engine import prepcache, reqbatch
+    from opensim_tpu.engine.simulator import AppResource, prepare
+    from opensim_tpu.resilience.deadline import DeadlineExceeded
+
+    monkeypatch.setenv("OPENSIM_BATCH_ENGINE", "xla")
+    cluster = _cluster()
+    base = prepcache.CacheEntry("b|base", prepare(cluster, []))
+    apps = []
+    for i in range(3):
+        rt = ResourceTypes()
+        rt.add(fx.make_fake_deployment(f"dl-{i}", 2, "500m", "1Gi"))
+        apps.append(AppResource("deploy", rt))
+
+    def run(deadlines):
+        with base.lock:
+            base.restore()
+            derived, slices = prepcache.derive_with_app_slices(
+                base.prep, cluster, apps, base_entry=base
+            )
+            items = [
+                reqbatch.BatchItem(
+                    cluster=cluster, apps=[apps[s]],
+                    lo=slices[s][0], hi=slices[s][1],
+                    deadline=deadlines[s],
+                )
+                for s in range(len(apps))
+            ]
+            try:
+                return reqbatch.run_request_batch(derived, items)
+            finally:
+                base.restore()
+
+    clean = run([None, None, None])
+    dead = Deadline.after(0.0)
+    assert dead.expired()
+    mixed = run([None, dead, None])
+
+    assert isinstance(mixed[1], DeadlineExceeded)
+    assert mixed[1].phase == "schedule"
+    for s in (0, 2):
+        assert not isinstance(mixed[s], BaseException)
+        want = sorted(
+            (ns.node.metadata.name, len(ns.pods))
+            for ns in clean[s].node_status if ns.pods
+        )
+        got = sorted(
+            (ns.node.metadata.name, len(ns.pods))
+            for ns in mixed[s].node_status if ns.pods
+        )
+        assert want == got, f"live rider {s} perturbed by the shed rider"

@@ -1,0 +1,440 @@
+"""Multi-process serving fleet (ISSUE 15): shared-memory twin publication.
+
+The load-bearing gates, all in-process (the subprocess end-to-end run —
+boot, crash/respawn, SO_REUSEPORT sharing — lives in ``make
+loadgen-smoke``):
+
+- seqlock: a reader attaching DURING generation swaps never observes a
+  torn view (generation and payload always agree);
+- lifecycle: close/atexit/hard-crash leave no ``/dev/shm`` segments, and
+  an exiting READER never destroys the owner's live segments;
+- parity: placements simulated through an attached publication are
+  bit-identical to the owner's own warm-base path;
+- delta: unchanged buffers keep their content-keyed segments across
+  generations, and the reader reuses its attachments.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from opensim_tpu.engine import prepcache
+from opensim_tpu.engine.simulator import AppResource, prepare, simulate
+from opensim_tpu.models import ResourceTypes, fixtures as fx
+from opensim_tpu.server.fleet import (
+    ControlBlock,
+    FleetReader,
+    FleetTwinClient,
+    TornGeneration,
+    TwinPublisher,
+)
+
+
+def _shm_names(token: str):
+    try:
+        return [f for f in os.listdir("/dev/shm") if token in f]
+    except FileNotFoundError:  # pragma: no cover - non-linux
+        pytest.skip("/dev/shm not available")
+
+
+def _cluster(n_nodes: int = 6, with_pod: bool = True) -> ResourceTypes:
+    rt = ResourceTypes()
+    for i in range(n_nodes):
+        rt.nodes.append(
+            fx.make_fake_node(
+                f"n{i:03d}", "16", "64Gi", "110",
+                fx.with_labels({"topology.kubernetes.io/zone": f"z{i % 3}"}),
+            )
+        )
+    if with_pod:
+        rt.pods.append(
+            fx.make_fake_pod("pinned", "100m", "128Mi", fx.with_node_name("n000"))
+        )
+    return rt
+
+
+def _base_entry(cluster: ResourceTypes) -> prepcache.CacheEntry:
+    return prepcache.CacheEntry("t|base", prepare(cluster, []))
+
+
+def _apps(name: str = "app-x", replicas: int = 3, cpu: str = "500m"):
+    rt = ResourceTypes()
+    rt.add(fx.make_fake_deployment(name, replicas, cpu, "1Gi"))
+    return [AppResource("deploy", rt)]
+
+
+def _placements(res):
+    return (
+        sorted((ns.node.metadata.name, len(ns.pods)) for ns in res.node_status if ns.pods),
+        sorted(u.reason for u in res.unscheduled_pods),
+    )
+
+
+def _derive_and_simulate(entry, cluster, apps):
+    with entry.lock:
+        entry.restore()
+        derived = prepcache.derive_with_apps(entry.prep, cluster, apps, base_entry=entry)
+        drop = prepcache.pad_drop_mask(entry.base_drop, len(derived.ordered))
+        try:
+            return simulate(cluster, apps, prep=derived, drop_pods=drop)
+        finally:
+            entry.restore()
+
+
+# ---------------------------------------------------------------------------
+# seqlock / torn-generation
+# ---------------------------------------------------------------------------
+
+
+def test_control_block_roundtrip_and_poll():
+    cb = ControlBlock(create=True)
+    try:
+        assert cb.poll() is None  # nothing published yet
+        cb.write(7, {"fingerprint": "abc", "arrays": [], "blob": "b"})
+        reader = ControlBlock(name=cb.name, create=False)
+        assert reader.poll() == 7
+        gen, payload, seq = reader.read()
+        assert gen == 7 and payload["fingerprint"] == "abc" and seq % 2 == 0
+        reader.close()
+    finally:
+        cb.unlink()
+        cb.close()
+
+
+def test_reader_never_observes_torn_generation():
+    """Attach during continuous generation swaps: every successful attach
+    must be self-consistent — the published array content encodes the
+    generation it was written for, and both must agree."""
+    pub = TwinPublisher()
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        gen = 0
+        while not stop.is_set():
+            gen += 1
+            # the array content is a function of the generation: a torn
+            # view (payload of gen k, arrays of gen j) cannot self-agree
+            parts = {"stamp": np.full((64,), gen, dtype=np.int64)}
+            pub.publish(gen, {"gen": gen}, parts)
+            time.sleep(0.001)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        reader = FleetReader(pub.control.name, retries=64)
+        attached = 0
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            try:
+                gen, payload, obj = reader.attach()
+            except TornGeneration:
+                continue  # bounded: counted, never a torn view
+            attached += 1
+            stamp = obj["parts"]["stamp"]
+            if obj["cluster"]["gen"] != gen or not (stamp == gen).all():
+                errors.append((gen, obj["cluster"]["gen"], stamp[0]))
+        assert attached > 10
+        assert not errors, f"torn views observed: {errors[:3]}"
+    finally:
+        stop.set()
+        t.join(timeout=5.0)
+        pub.close()
+
+
+def test_attach_retries_exhausted_is_typed():
+    cb = ControlBlock(create=True)
+    try:
+        # leave seq odd: a publish permanently in flight
+        cb.write(1, {"blob": "x", "arrays": []})
+        import struct
+
+        cb._seq += 1
+        struct.pack_into("<Q", cb._shm.buf, 8, cb._seq)
+        reader = FleetReader(cb.name, retries=3)
+        with pytest.raises(TornGeneration):
+            reader.attach()
+        assert reader.retries_exhausted_total == 1
+        reader.close()
+    finally:
+        cb.unlink()
+        cb.close()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: no leaked /dev/shm segments, reader never destroys owner state
+# ---------------------------------------------------------------------------
+
+
+def test_close_unlinks_every_segment():
+    cluster = _cluster()
+    base = _base_entry(cluster)
+    with base.lock:
+        base.restore()
+        parts = prepcache.publication_parts(base)
+    pub = TwinPublisher()
+    token = pub.token
+    pub.publish(1, cluster, parts)
+    assert _shm_names(token)  # segments exist while live
+    pub.close()
+    assert _shm_names(token) == []
+    pub.close()  # idempotent
+
+
+def test_owner_hard_crash_leaves_no_segments(tmp_path):
+    """SIGKILL the owner mid-publication: the resource tracker (a separate
+    process that survives the kill) must unlink everything — /dev/shm
+    hygiene does not depend on atexit running."""
+    script = tmp_path / "owner.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "import numpy as np\n"
+        "sys.path.insert(0, %r)\n"
+        "from opensim_tpu.server.fleet import TwinPublisher\n"
+        "pub = TwinPublisher()\n"
+        "pub.publish(1, {'x': 1}, {'a': np.zeros(1024)})\n"
+        "print(pub.token, flush=True)\n"
+        "time.sleep(60)\n" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    proc = subprocess.Popen(
+        [sys.executable, str(script)], stdout=subprocess.PIPE,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    try:
+        token = proc.stdout.readline().decode().strip()
+        assert token and _shm_names(token)
+        proc.kill()
+        proc.wait(timeout=30)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and _shm_names(token):
+            time.sleep(0.2)
+        assert _shm_names(token) == [], "resource tracker left segments behind"
+    finally:
+        if proc.poll() is None:  # pragma: no cover - cleanup on failure
+            proc.kill()
+
+
+def test_reader_exit_does_not_destroy_owner_segments(tmp_path):
+    """A worker that attaches and exits must leave the owner's segments
+    intact (the resource-tracker unregister in ``_attach``): a later
+    reader still attaches the same generation."""
+    pub = TwinPublisher()
+    try:
+        pub.publish(3, {"ok": True}, {"a": np.arange(128, dtype=np.int64)})
+        code = (
+            "import sys\n"
+            f"sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})\n"
+            "from opensim_tpu.server.fleet import FleetReader\n"
+            f"r = FleetReader({pub.control.name!r})\n"
+            "gen, payload, obj = r.attach()\n"
+            "assert gen == 3 and obj['cluster']['ok'] is True\n"
+            "print('attached', flush=True)\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, timeout=120,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        assert out.returncode == 0, out.stderr.decode()[-2000:]
+        # the owner's publication must still be fully attachable
+        r2 = FleetReader(pub.control.name)
+        gen, _payload, obj = r2.attach()
+        assert gen == 3 and (obj["parts"]["a"] == np.arange(128)).all()
+        r2.close()
+    finally:
+        pub.close()
+
+
+# ---------------------------------------------------------------------------
+# parity: attached placements == owner placements
+# ---------------------------------------------------------------------------
+
+
+def test_attached_placements_bit_identical():
+    cluster = _cluster()
+    base = _base_entry(cluster)
+    with base.lock:
+        base.restore()
+        parts = prepcache.publication_parts(base)
+    pub = TwinPublisher()
+    try:
+        pub.publish(5, cluster, parts)
+        reader = FleetReader(pub.control.name)
+        gen, payload, obj = reader.attach()
+        assert gen == 5
+        entry = prepcache.entry_from_publication("fleet|5|base", obj["parts"])
+        # the reconstructed numpy views are zero-copy and read-only
+        assert not entry.prep.ec_np.alloc.flags.writeable
+        for apps in (_apps(), _apps("huge", 1, "640")):  # placed + unschedulable
+            solo = _placements(_derive_and_simulate(base, cluster, apps))
+            fleet = _placements(_derive_and_simulate(entry, obj["cluster"], apps))
+            assert solo == fleet
+        reader.close()
+    finally:
+        pub.close()
+
+
+def test_base_drop_mask_round_trips():
+    """The twin's event-deleted pods (base_drop) must survive publication:
+    a worker's simulate excludes them exactly like the owner's."""
+    cluster = _cluster()
+    base = _base_entry(cluster)
+    with base.lock:
+        base.restore()
+        drop = np.zeros((len(base.prep.ordered),), dtype=bool)
+        drop[0] = True  # the pinned pod was DELETED by a watch event
+        base.base_drop = drop
+        parts = prepcache.publication_parts(base)
+    pub = TwinPublisher()
+    try:
+        pub.publish(6, cluster, parts)
+        reader = FleetReader(pub.control.name)
+        _gen, _payload, obj = reader.attach()
+        entry = prepcache.entry_from_publication("fleet|6|base", obj["parts"])
+        assert entry.base_drop is not None and entry.base_drop[0]
+        solo = _placements(_derive_and_simulate(base, cluster, _apps()))
+        fleet = _placements(_derive_and_simulate(entry, obj["cluster"], _apps()))
+        assert solo == fleet
+        reader.close()
+    finally:
+        pub.close()
+
+
+# ---------------------------------------------------------------------------
+# delta publication
+# ---------------------------------------------------------------------------
+
+
+def test_unchanged_buffers_keep_segments_across_generations():
+    cluster = _cluster()
+    base = _base_entry(cluster)
+    with base.lock:
+        base.restore()
+        parts = prepcache.publication_parts(base)
+    pub = TwinPublisher()
+    try:
+        p1 = pub.publish(1, cluster, parts)
+        p2 = pub.publish(2, cluster, parts)
+        n1 = {a[0] for a in p1["arrays"]}
+        n2 = {a[0] for a in p2["arrays"]}
+        assert n1 == n2  # identical content: every segment reused
+        reader = FleetReader(pub.control.name)
+        reader.attach()
+        reuse0 = reader.segment_reuse_total
+        pub.publish(3, cluster, parts)
+        gen, _p, _o = reader.attach()
+        assert gen == 3
+        assert reader.segment_reuse_total > reuse0  # attachments reused too
+        reader.close()
+    finally:
+        pub.close()
+
+
+def test_gc_drops_segments_outside_keep_window():
+    pub = TwinPublisher(keep_generations=2)
+    try:
+        names = []
+        for gen in range(1, 5):
+            p = pub.publish(gen, {"g": gen}, {"a": np.full(64, gen, np.int64)})
+            names.append({a[0] for a in p["arrays"]})
+        live = {n for f in _shm_names(pub.token) for n in [f]}
+        # generation 1/2's distinct arrays are gone; 3/4's remain
+        assert not any(n in live for n in names[0] - names[2] - names[3])
+        assert all(n in live for n in names[3])
+    finally:
+        pub.close()
+
+
+# ---------------------------------------------------------------------------
+# the worker-side client
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_twin_client_serves_and_swaps_generations():
+    cluster = _cluster()
+    base = _base_entry(cluster)
+    with base.lock:
+        base.restore()
+        parts = prepcache.publication_parts(base)
+    pub = TwinPublisher()
+    try:
+        pub.publish(1, cluster, parts, state="live", stale=False)
+        cache = prepcache.PrepareCache()
+        client = FleetTwinClient(pub.control.name, prep_cache=cache)
+        assert client.start(wait_s=10.0)
+        got = client.serving_snapshot()
+        assert got is not None
+        cl, key, stale = got
+        assert key == "fleet|1" and stale is False
+        assert cache.get("fleet|1|base") is not None
+        assert client.state() == "fleet-live"
+        # generation swap: new key served, old lineage invalidated
+        pub.publish(2, cluster, parts, state="degraded", stale=True)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            cl, key, stale = client.serving_snapshot()
+            if key == "fleet|2":
+                break
+        assert key == "fleet|2" and stale is True
+        assert cache.get("fleet|2|base") is not None
+        assert cache.get("fleet|1|base") is None
+        lines = client.metrics_lines()
+        assert any(l.startswith("simon_fleet_attaches_total 2") for l in lines)
+        assert any(
+            l.startswith("simon_fleet_attach_retries_exhausted_total 0")
+            for l in lines
+        )
+        client.stop()
+    finally:
+        pub.close()
+
+
+def test_no_prep_publication_still_serves_cluster():
+    """A twin with no schedulable pods publishes parts=None; the worker
+    serves the cluster and the REST layer's own bootstrap covers prep."""
+    cluster = _cluster(with_pod=False)
+    pub = TwinPublisher()
+    try:
+        pub.publish(4, cluster, None)
+        cache = prepcache.PrepareCache()
+        client = FleetTwinClient(pub.control.name, prep_cache=cache)
+        assert client.start(wait_s=10.0)
+        cl, key, _stale = client.serving_snapshot()
+        assert key == "fleet|4" and len(cl.nodes) == len(cluster.nodes)
+        assert cache.get("fleet|4|base") is None  # nothing published to seed
+        client.stop()
+    finally:
+        pub.close()
+
+
+def test_same_generation_republish_reaches_workers():
+    """A staleness/state flip on a quiet twin republishes at the SAME
+    generation; workers must refresh their payload (the control seq is
+    the change detector) or degraded responses lose their stale tag."""
+    cluster = _cluster()
+    base = _base_entry(cluster)
+    with base.lock:
+        base.restore()
+        parts = prepcache.publication_parts(base)
+    pub = TwinPublisher()
+    try:
+        pub.publish(9, cluster, parts, state="live", stale=False)
+        client = FleetTwinClient(pub.control.name, prep_cache=prepcache.PrepareCache())
+        assert client.start(wait_s=10.0)
+        _cl, key, stale = client.serving_snapshot()
+        assert key == "fleet|9" and stale is False
+        pub.publish(9, cluster, parts, state="degraded", stale=True)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            _cl, key, stale = client.serving_snapshot()
+            if stale:
+                break
+        assert key == "fleet|9" and stale is True
+        assert client.state() == "fleet-degraded"
+        client.stop()
+    finally:
+        pub.close()

@@ -1,22 +1,31 @@
 #!/usr/bin/env python
 """Serving-core smoke gate (``make loadgen-smoke``, part of ``make verify``).
 
-The ISSUE 8 closed loop, shortened for CI:
+Two phases, both closed loops against the canned stub apiserver:
 
-1. start the canned stub apiserver seeded with a small live cluster;
+**Phase 1 — the ISSUE 8 core** (admission queue + batching vs the seed's
+single-flight TryLock):
+
+1. start the stub apiserver seeded with a small live cluster;
 2. boot TWO live-twin simon servers as subprocesses against it — one with
-   ``OPENSIM_ADMISSION=off`` (the seed's single-flight TryLock behavior),
-   one with the admission queue + cross-request batching (the default);
-3. drive each with the closed-loop load generator
-   (``opensim_tpu/server/loadgen.py``) at the same concurrency;
+   ``OPENSIM_ADMISSION=off``, one with the admission queue (the default);
+3. drive each with the closed-loop load generator at the same concurrency;
 4. assert the admission server sustains MORE QPS than the single-flight
    baseline with zero errors, a bounded p99, and a non-empty
-   ``simon_batch_size`` histogram (batching actually engaged — a smoke
-   that passes with batching silently dead would gate nothing).
+   ``simon_batch_size`` histogram.
 
-The full-length run (the ≥4× acceptance number) is
-``python bench.py --config serving``; this gate uses shorter windows and a
-conservative margin so a loaded CI box never flakes.
+**Phase 2 — the ISSUE 15 fleet** (multi-process serving):
+
+5. boot a ``--workers 2`` fleet (twin owner publishing arena deltas over
+   shared memory + 2 SO_REUSEPORT workers) and a single-process admission
+   server, drive both with the same closed loop;
+6. assert fleet QPS ≥ the single-process run, zero errors, ZERO
+   torn-generation attach abandonments, and the end-to-end placement
+   parity gate (same payloads → same placements on both servers).
+
+The full-length run (the acceptance numbers) is
+``python bench.py --config serving [--workers N]``; this gate uses shorter
+windows and conservative margins so a loaded CI box never flakes.
 
 Exit 0 on success; 1 with a one-line reason per failed check.
 """
@@ -35,7 +44,7 @@ def fail(msg: str) -> int:
 
 
 def main() -> int:
-    from opensim_tpu.server.loadgen import run_stub_benchmark
+    from opensim_tpu.server.loadgen import run_fleet_benchmark, run_stub_benchmark
 
     report = run_stub_benchmark(
         concurrency=16, duration_s=4.0, n_nodes=6, n_pods=12,
@@ -69,6 +78,48 @@ def main() -> int:
     print("loadgen-smoke: ok — " + json.dumps(
         {k: report[k] for k in (
             "qps_single_flight", "qps", "speedup", "mean_batch_size", "p99_s"
+        )}
+    ))
+
+    # ---- phase 2: the multi-process fleet (ISSUE 15) ----------------------
+    # sharded clients + enough concurrency to engage both workers: below
+    # that the comparison is box noise (one admission process already
+    # keeps a small closed loop fed), not the fleet
+    fleet = run_fleet_benchmark(
+        workers=2, concurrency=48, duration_s=6.0, n_nodes=6, n_pods=12,
+        base_port=18860, client_procs=2,
+    )
+    print(
+        "loadgen-smoke: fleet(2w) "
+        f"{fleet['qps']:.1f} qps vs single-process "
+        f"{fleet['qps_single_process']:.1f} qps "
+        f"({fleet['vs_single_process']:.2f}x), p99 {fleet['p99_s'] or -1:.3f}s "
+        f"(single {fleet['p99_single_process_s'] or -1:.3f}s), "
+        f"gen {fleet['fleet_generation']}, respawns {fleet['respawns']}"
+    )
+    if fleet["errors"]:
+        return fail(f"fleet run had {fleet['errors']} errors")
+    if not fleet["placements_identical"]:
+        return fail("fleet placements diverged from the single-process server")
+    if fleet["torn_generation_exhausted"]:
+        return fail(
+            "workers exhausted seqlock retries "
+            f"({fleet['torn_generation_exhausted']} torn-generation abandonments)"
+        )
+    # the fleet must at least match one process (the acceptance multiple
+    # comes from the longer bench run); the 0.95 floor absorbs CI noise on
+    # a box where 2 workers already saturate the cores
+    if fleet["qps"] < fleet["qps_single_process"] * 0.95:
+        return fail(
+            f"fleet qps {fleet['qps']} below single-process "
+            f"{fleet['qps_single_process']} (x0.95 floor)"
+        )
+    if fleet["fleet_generation"] < 0 or fleet["fleet_publishes"] < 1:
+        return fail("owner never published a generation over shared memory")
+    print("loadgen-smoke: ok — " + json.dumps(
+        {k: fleet[k] for k in (
+            "qps_single_process", "qps", "vs_single_process", "p99_s",
+            "placements_identical", "torn_generation_exhausted",
         )}
     ))
     return 0

@@ -397,38 +397,90 @@ def bench_reference_example(config_path: str, extended: str, warmup: bool, label
     return 0
 
 
+def _core_guard_note(config: str, host_cores: int):
+    """Serving QPS is core-count-bound: comparing a fresh row against a
+    baseline recorded on a different core count measures the boxes, not
+    the code. Every serving row records host_cores; when the committed
+    baseline for this config was measured on a different count, the row
+    carries an explicit refusal note (and tools/perf_guard.py refuses to
+    compute ratios at all). Returns None when comparable or unknown."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_BASELINE.json")
+    try:
+        with open(path) as f:
+            baselines = json.load(f).get("baselines", {})
+    except (OSError, ValueError):
+        return None
+    for entry in baselines.values():
+        row = entry.get("row", {})
+        if row.get("config") != config or "host_cores" not in row:
+            continue
+        if int(row["host_cores"]) != host_cores:
+            return (
+                f"refused: baseline measured on {row['host_cores']} core(s), "
+                f"this box has {host_cores} — re-baseline on a same-core box"
+            )
+    return None
+
+
 def bench_serving(concurrency: int, duration_s: float) -> int:
-    """ISSUE 8 acceptance run: the closed loop against two live stub-backed
-    twin servers (single-flight vs admission queue + request-axis
-    batching), BOTH numbers in the one JSON line. The bar is qps ≥ 4×
-    qps_single_flight at bounded p99 on the same box."""
-    from opensim_tpu.server.loadgen import run_stub_benchmark
+    """ISSUE 8 + 16 acceptance run: the closed loop against live
+    stub-backed twin servers — single-flight vs admission queue +
+    request-axis batching (ISSUE 8 pair), then serial-batch vs the staged
+    admission pipeline with the placement-parity gate and the measured
+    prep-under-dispatch overlap (ISSUE 16 pair) — ALL numbers in the one
+    JSON line. The bars: qps ≥ 4× qps_single_flight at bounded p99, and
+    pipelined ≥ 2× non-pipelined (the multiple needs ≥4 host cores; the
+    row records host_cores so cross-box readers can tell)."""
+    from opensim_tpu.server.loadgen import run_pipeline_benchmark, run_stub_benchmark
 
     _stage("serving")
+    # hundreds of clients need sharded client processes or the loadgen's
+    # own GIL throttles the offered load (docs/serving.md)
+    client_procs = 4 if concurrency >= 128 else 0
     report = run_stub_benchmark(
-        concurrency=concurrency, duration_s=duration_s, base_port=18980
+        concurrency=concurrency, duration_s=duration_s, base_port=18980,
+        client_procs=client_procs,
+    )
+    _stage("serving-pipeline")
+    pipe = run_pipeline_benchmark(
+        concurrency=concurrency, duration_s=duration_s, base_port=19080,
+        client_procs=client_procs,
     )
     record = {
         "metric": (
             f"serving closed loop ({concurrency} clients, "
             f"{duration_s:.0f}s, stub-apiserver twin)"
         ),
-        "value": report["qps"],
+        "value": pipe["qps"],
         "unit": "req/s",
         "config": "serving",
-        # the acceptance pair: batched QPS vs the seed's single-flight
+        # the ISSUE 8 acceptance pair: batched QPS vs the seed's single-flight
         "qps_single_flight": report["qps_single_flight"],
+        "qps_admission": report["qps"],
         "vs_single_flight": report["speedup"],
-        "p50_s": report["p50_s"],
-        "p99_s": report["p99_s"],
+        "p50_s": pipe["p50_s"],
+        "p99_s": pipe["p99_s"],
         "p99_single_flight_s": report["p99_single_flight_s"],
-        "batches": report["batches"],
-        "mean_batch_size": report["mean_batch_size"],
-        "shed": report["shed"],
+        "batches": pipe["batches"],
+        "mean_batch_size": pipe["mean_batch_size"],
+        "shed": pipe["shed"],
         "shed_single_flight": report["shed_single_flight"],
-        "errors": report["admission"]["errors"],
+        "errors": pipe["errors"],
         "queue_wait_p99_s": report["admission"]["queue_wait_p99_s"],
+        # the ISSUE 16 acceptance pair: staged pipeline vs serial batches,
+        # same box, same stub cluster, plus the in-row parity gate
+        "qps_non_pipelined": pipe["qps_non_pipelined"],
+        "vs_non_pipelined": pipe["vs_non_pipelined"],
+        "p99_non_pipelined_s": pipe["p99_non_pipelined_s"],
+        "overlapped_batches": pipe["overlapped_batches"],
+        "prep_overlap_s": pipe["prep_overlap_s"],
+        "placements_identical": pipe["placements_identical"],
+        "client_procs": client_procs,
+        "host_cores": os.cpu_count() or 0,
     }
+    note = _core_guard_note("serving", record["host_cores"])
+    if note:
+        record["baseline_comparison"] = note
     if BACKEND_NOTE:
         record["backend_note"] = BACKEND_NOTE
     print(json.dumps(record))
@@ -483,6 +535,9 @@ def bench_serving_fleet(workers: int, concurrency: int, duration_s: float) -> in
         # fleet's headroom shows as p99 first, absolute QPS second
         "host_cores": os.cpu_count() or 0,
     }
+    note = _core_guard_note("serving-fleet", record["host_cores"])
+    if note:
+        record["baseline_comparison"] = note
     if BACKEND_NOTE:
         record["backend_note"] = BACKEND_NOTE
     print(json.dumps(record))

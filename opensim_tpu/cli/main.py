@@ -916,6 +916,46 @@ def run_profile(args) -> int:
             + ", ".join(f"{k}={v}" for k, v in sorted(events.items())),
             file=out,
         )
+    pipe = payload.get("pipeline") or {}
+    if pipe.get("batches"):
+        overlap = pipe.get("prep_overlap_s", 0.0)
+        print(
+            f"\nadmission pipeline ({'on' if pipe.get('enabled') else 'off'}): "
+            f"{pipe.get('batches', 0)} batch(es), "
+            f"{pipe.get('overlapped_batches', 0)} overlapped "
+            f"({overlap:.3f}s prep under dispatch)",
+            file=out,
+        )
+        stages = pipe.get("stages") or {}
+        if stages:
+            rows = [["Stage", "Batches", "Total s", "Mean s", "Max s"]]
+            for stage in ("prep", "dispatch", "decode"):
+                d = stages.get(stage)
+                if not d:
+                    continue
+                count = d.get("count", 0)
+                total = d.get("total_s", 0.0)
+                rows.append(
+                    [
+                        stage,
+                        str(int(count)),
+                        f"{total:.3f}",
+                        f"{(total / count if count else 0.0):.4f}",
+                        f"{d.get('max_s', 0.0):.4f}",
+                    ]
+                )
+            _table(rows, out)
+        lanes = pipe.get("lane_admitted") or {}
+        if pipe.get("lanes_enabled") and lanes:
+            promo = pipe.get("starvation_promotions", 0)
+            print(
+                "priority lanes: "
+                + ", ".join(
+                    f"{lane}={n} admitted" for lane, n in sorted(lanes.items())
+                )
+                + f", {promo} starvation promotion(s)",
+                file=out,
+            )
     return 0
 
 

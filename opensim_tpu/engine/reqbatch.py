@@ -54,7 +54,14 @@ from .simulator import (
     snapshot_bind_state,
 )
 
-__all__ = ["BatchItem", "run_request_batch", "batch_engine_mode"]
+__all__ = [
+    "BatchItem",
+    "BatchDispatch",
+    "run_request_batch",
+    "dispatch_request_batch",
+    "decode_request_batch",
+    "batch_engine_mode",
+]
 
 # request-axis pad buckets: the batch size participates in the jit
 # signature, so S is padded up to a small fixed set of shapes (padded
@@ -153,27 +160,58 @@ def _request_masks(prep: Prepared, items: List[BatchItem]) -> np.ndarray:
     return valid
 
 
-def _slice_output(batched: ScheduleOutput, s: int, P: int) -> ScheduleOutput:
-    """Request ``s``'s host-side view of the batched outputs."""
+def _slice_outputs(batched: ScheduleOutput, S: int, P: int) -> List[ScheduleOutput]:
+    """Every request's host-side view of the batched outputs in ONE
+    device→host pass per field: the per-rider ``np.asarray`` calls this
+    replaced each re-materialized the FULL batched array (N transfers of
+    the whole [S, P] tensor, the hottest decode-side span in
+    ``obs/profile.py``); converting once and slicing numpy views is the
+    vectorized path."""
+    chosen = np.asarray(batched.chosen)
+    fail_counts = np.asarray(batched.fail_counts)
+    insufficient = np.asarray(batched.insufficient)
+    gpu_take = np.asarray(batched.gpu_take)
+    static_fail = np.asarray(batched.static_fail)
     fs = batched.final_state
-    state = type(fs)(*[np.asarray(leaf)[s] for leaf in fs])
-    return ScheduleOutput(
-        chosen=np.asarray(batched.chosen)[s, :P],
-        fail_counts=np.asarray(batched.fail_counts)[s, :P],
-        insufficient=np.asarray(batched.insufficient)[s, :P],
-        gpu_take=np.asarray(batched.gpu_take)[s, :P],
-        static_fail=np.asarray(batched.static_fail)[s],
-        final_state=state,
-    )
+    leaves = [np.asarray(leaf) for leaf in fs]
+    state_type = type(fs)
+    return [
+        ScheduleOutput(
+            chosen=chosen[s, :P],
+            fail_counts=fail_counts[s, :P],
+            insufficient=insufficient[s, :P],
+            gpu_take=gpu_take[s, :P],
+            static_fail=static_fail[s],
+            final_state=state_type(*[leaf[s] for leaf in leaves]),
+        )
+        for s in range(S)
+    ]
+
+
+@dataclass
+class BatchDispatch:
+    """The engine half's outputs, handed from the dispatch stage to the
+    decode stage (server/admission.py pipeline). Everything in here is
+    host-side numpy (or a typed shed) — the decode stage never touches a
+    device buffer."""
+
+    outs: List[Optional[ScheduleOutput]]
+    shed: Dict[int, BaseException]
+    engine_name: str
+    skips: Dict[str, str]
+    pod_valid: np.ndarray
 
 
 def run_request_batch(
     prep: Prepared, items: List[BatchItem]
 ) -> List[Union[SimulateResult, BaseException]]:
     """Schedule N requests' shared stream in one batched pass and
-    demultiplex one :class:`SimulateResult` per request.
+    demultiplex one :class:`SimulateResult` per request —
+    :func:`dispatch_request_batch` followed by
+    :func:`decode_request_batch` (the staged pipeline calls the halves
+    separately so batch k+1's host prep can overlap batch k's dispatch).
 
-    The caller (``server/admission.py``) owns the base entry lock and the
+    The caller (``server/rest.py``) owns the base entry lock and the
     derived prep; this function only reads ``prep`` and restores the bind
     state it mutates. Results are bit-identical to solo runs of each
     request (mask-invalid foreign pods never touch engine state).
@@ -194,6 +232,17 @@ def run_request_batch(
     rides the shared dispatch like any other — the batch runs the
     count_all scan variant (or the C++ generic path) so its per-pod fail
     rows exist, and only that rider's decode pays the audit build."""
+    return decode_request_batch(prep, items, dispatch_request_batch(prep, items))
+
+
+def dispatch_request_batch(prep: Prepared, items: List[BatchItem]) -> BatchDispatch:
+    """The ENGINE stage: mask build + one batched schedule dispatch, no
+    decode. Lock contract (the pipeline's overlap hinges on it): this
+    function touches ONLY the derived prep's arrays and device buffers —
+    never the shared pod objects, never the base entry's bind state — so
+    the caller runs it WITHOUT the base-entry lock while the next batch's
+    prep (which does hold it) overlaps. The C++/XLA engines release the
+    GIL inside."""
     from . import nativepath
 
     P = len(prep.ordered)
@@ -303,8 +352,28 @@ def run_request_batch(
                 },
             )
             jax.block_until_ready(batched.chosen)
-        outs = [_slice_output(batched, s, P) for s in range(len(items))]
+        # ONE device→host conversion per output field for the whole batch
+        # (N redundant full-tensor transfers before — the vectorized path)
+        outs = list(_slice_outputs(batched, len(items), P))
+        for s in shed:
+            outs[s] = None
+    return BatchDispatch(
+        outs=outs, shed=shed, engine_name=engine_name, skips=skips,
+        pod_valid=pod_valid,
+    )
 
+
+def decode_request_batch(
+    prep: Prepared, items: List[BatchItem], dispatch: BatchDispatch
+) -> List[Union[SimulateResult, BaseException]]:
+    """The DECODE stage: demultiplex one :class:`SimulateResult` (or typed
+    shed) per rider from the dispatch outputs. Mutates shared pod objects
+    (binds, GPU annotations) through ``finish_decode`` and restores bind
+    state between riders and on exit — the caller MUST hold the base-entry
+    lock, exactly like the serial path."""
+    P = len(prep.ordered)
+    outs, shed = dispatch.outs, dispatch.shed
+    pod_valid = dispatch.pod_valid
     sf_rows = prep.tmpl_ids
     snap = snapshot_bind_state(prep)
     results: List[Union[SimulateResult, BaseException]] = []
@@ -316,11 +385,22 @@ def run_request_batch(
             out = outs[s]
             nstats = getattr(out, "native_stats", None)
             engine = EngineDecision(
-                name=engine_name,
-                skipped=dict(skips),
+                name=dispatch.engine_name,
+                skipped=dict(dispatch.skips),
                 native_path=nstats["path"] if nstats else None,
                 native_steps=dict(nstats["steps"]) if nstats else None,
             )
+            # the drop mask by slice assignment (vectorized): foreign
+            # riders' app ranges + this rider's own report-level drops —
+            # the old per-rider `set(drops) | _foreign(...)` built and
+            # unioned index sets spanning most of the stream
+            dropm = np.zeros(P, dtype=bool)
+            for k, other in enumerate(items):
+                if k != s:
+                    dropm[other.lo : other.hi] = True
+            if it.drops:
+                for i in it.drops:
+                    dropm[i] = True
             try:
                 unsched, statuses = finish_decode(
                     prep, out, it.cluster,
@@ -328,8 +408,9 @@ def run_request_batch(
                     np.asarray(out.fail_counts), np.asarray(out.insufficient),
                     np.asarray(out.static_fail), sf_rows,
                     pod_valid[s], np.asarray(prep.forced, dtype=bool),
-                    {}, {}, set(it.drops) | _foreign(items, s, P),
-                    None, None, None, (), engine, engine_name, it.explain,
+                    {}, {}, dropm,
+                    None, None, None, (), engine, dispatch.engine_name,
+                    it.explain,
                 )
                 results.append(
                     SimulateResult(
@@ -343,11 +424,3 @@ def run_request_batch(
     return results
 
 
-def _foreign(items: List[BatchItem], s: int, P: int) -> set:
-    """Indices of OTHER requests' app pods — excluded from request s's
-    report exactly as if they had never been in the input."""
-    out: set = set()
-    for k, it in enumerate(items):
-        if k != s:
-            out.update(range(it.lo, it.hi))
-    return out

@@ -10,10 +10,12 @@ Reference parity: ``pkg/utils/utils.go`` —
 
 from __future__ import annotations
 
+import collections
 import copy
 import json
 import os
-from typing import Dict, List, Optional, Tuple
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
 
 import yaml
 
@@ -234,75 +236,301 @@ def _fast_clone(proto: Pod, name: str) -> Pod:
     return pod
 
 
-def pods_from_replica_set(rs: Workload) -> List[Pod]:
+# ---------------------------------------------------------------------------
+# Workload-expansion proto cache (ISSUE 16): repeated workload SHAPES skip
+# the template deepcopy + sanitization + validation entirely.
+# ---------------------------------------------------------------------------
+
+#: sentinel substituted for the workload's own name inside the content key
+_NAME_PH = "\x00workload-name\x00"
+_PROTO_CACHE_CAP = 256
+
+_cache_lock = threading.Lock()
+_proto_cache: "collections.OrderedDict[str, dict]" = collections.OrderedDict()  # guarded-by: _cache_lock
+_cache_stats = {"hits": 0, "misses": 0}  # guarded-by: _cache_lock
+
+
+def _expand_cache_on() -> bool:
+    from ..utils import envknobs
+
+    return envknobs.raw("OPENSIM_EXPAND_CACHE", "1").strip().lower() not in (
+        "0", "off", "false",
+    )
+
+
+def expand_cache_stats() -> Dict[str, int]:
+    with _cache_lock:
+        return dict(_cache_stats, entries=len(_proto_cache))
+
+
+def expand_cache_clear() -> None:
+    with _cache_lock:
+        _proto_cache.clear()
+        _cache_stats["hits"] = 0
+        _cache_stats["misses"] = 0
+
+
+def _content_key(kind: str, w: Workload) -> Optional[str]:
+    """Canonical content key for a workload's expansion, with the
+    workload's OWN NAME normalized to a placeholder exactly where
+    materialization knows how to rewrite it (template metadata values and
+    the owner-name chain). The raw template spec is keyed UNNORMALIZED: a
+    name embedded inside the spec simply keys a distinct entry — never a
+    false share — so hits are guaranteed rewrite-complete.
+
+    The PARSED ``template_spec`` is keyed alongside the raw dict because
+    the two can diverge: callers may mutate the parsed object after
+    ``from_dict`` (``w.template_spec.scheduler_name = "packer"`` is how
+    tests select a profile), and the proto pod is built from the parsed
+    object — keying raw alone would hand such a workload another
+    workload's unmutated expansion."""
+    raw_spec = (w.template_raw or {}).get("spec")
+    if not raw_spec:
+        # a hand-built Workload without raw provenance cannot be keyed on
+        # content (template_spec may not round-trip) — bypass the cache
+        return None
+    nm = w.metadata.name
+
+    def norm(d: Dict[str, str]) -> Dict[str, str]:
+        return {
+            k: _NAME_PH if isinstance(v, str) and v == nm else v
+            for k, v in (d or {}).items()
+        }
+
+    try:
+        return json.dumps(
+            {
+                "kind": kind,
+                "ns": w.metadata.namespace,
+                "labels": norm(w.template_metadata.labels),
+                "annotations": norm(w.template_metadata.annotations),
+                "spec": raw_spec,
+                "pspec": repr(w.template_spec),
+                "vct": w.volume_claim_templates if kind == "StatefulSet" else None,
+            },
+            sort_keys=True,
+            default=str,
+        )
+    except (TypeError, ValueError):
+        return None
+
+
+def _name_chain(kind: str, nm: str) -> List[str]:
+    """The fresh owner-name chain a cache hit regenerates — the same
+    shapes the uncached expansions build (rand suffixes per expansion, so
+    names never repeat across requests; STS ordinals stay deterministic)."""
+    if kind == "Deployment":
+        rs = f"{nm}-{_rand_suffix()}"
+        return [nm, rs, f"{rs}-{_rand_suffix()}"]
+    if kind == "CronJob":
+        job = f"{nm}-{_rand_suffix()}"
+        return [nm, job, f"{job}-{_rand_suffix()}"]
+    if kind == "StatefulSet":
+        return [nm, f"{nm}-0"]
+    return [nm, f"{nm}-{_rand_suffix()}"]
+
+
+def _chain_from_proto(kind: str, w: Workload, proto: Pod) -> List[str]:
+    """Recover the built proto's owner-name chain (the strings a hit must
+    substitute): the intermediate owner's name IS the proto's
+    generate_name for every chained kind."""
+    if kind in ("Deployment", "CronJob"):
+        return [w.metadata.name, proto.metadata.generate_name, proto.metadata.name]
+    return [w.metadata.name, proto.metadata.name]
+
+
+def _materialize(entry: dict, w: Workload, n: int) -> List[Pod]:
+    """Copy-on-write expansion from a cached proto: fresh metadata with the
+    old name chain substituted (exact string matches only — the key
+    guarantees nothing else differs), fresh uids and rand suffixes, shared
+    immutable spec internals (the ``_fast_clone`` invariant)."""
+    proto: Pod = entry["proto"]
+    kind: str = entry["kind"]
+    old_chain: List[str] = entry["chain"]
+    new_chain = _name_chain(kind, w.metadata.name)
+    sub = {o: nw for o, nw in zip(old_chain, new_chain) if o != nw}
+
+    def s(v):
+        return sub.get(v, v) if isinstance(v, str) else v
+
+    pm = proto.metadata
+    # unchained kinds: the head's owner IS the workload (real uid); chained
+    # kinds own the head via a synthesized intermediate whose uid is fresh
+    # on the uncached path too
+    owner_uid = (w.metadata.uid or new_uid()) if len(new_chain) == 2 else new_uid()
+    meta = object.__new__(ObjectMeta)
+    meta.__dict__ = {
+        "name": new_chain[-1],
+        "namespace": pm.namespace,
+        "labels": {k: s(v) for k, v in pm.labels.items()},
+        "annotations": {k: s(v) for k, v in pm.annotations.items()},
+        "uid": new_uid(),
+        "generate_name": s(pm.generate_name),
+        "owner_references": [
+            OwnerReference(
+                kind=r.kind, name=s(r.name), uid=owner_uid,
+                api_version=r.api_version, controller=r.controller,
+            )
+            for r in pm.owner_references
+        ],
+    }
+    spec = object.__new__(type(proto.spec))
+    spec.__dict__ = proto.spec.__dict__.copy()
+    head = object.__new__(type(proto))
+    head.__dict__ = {
+        "metadata": meta,
+        "spec": spec,
+        "phase": proto.phase,
+        "raw": {**proto.raw, "metadata": meta.to_dict()} if proto.raw else {},
+    }
+    pods = [head]
+    if kind == "StatefulSet":
+        for ordinal in range(1, n):
+            pods.append(_fast_clone(head, f"{w.metadata.name}-{ordinal}"))
+    else:
+        clone_base = new_chain[-2]
+        for _ in range(n - 1):
+            pods.append(_fast_clone(head, f"{clone_base}-{_rand_suffix()}"))
+    return pods
+
+
+def _expand_cached(
+    kind: str, w: Workload, n: int, build: Callable[[], List[Pod]]
+) -> List[Pod]:
+    """Content-keyed expansion: a hit materializes from the cached proto;
+    a miss builds normally and caches a CLEAN copy of the proto (the
+    returned pods get bind-mutated by decode — the cached copy must stay
+    pristine)."""
+    if not _expand_cache_on():
+        return build()
+    key = _content_key(kind, w)
+    if key is None:
+        return build()
+    with _cache_lock:
+        entry = _proto_cache.get(key)
+        if entry is not None:
+            _proto_cache.move_to_end(key)
+            _cache_stats["hits"] += 1
+    if entry is not None:
+        return _materialize(entry, w, n)
+    pods = build()
+    if pods:
+        proto = pods[0]
+        entry = {
+            "kind": kind,
+            "chain": _chain_from_proto(kind, w, proto),
+            # _fast_clone gives the pristine copy: fresh metadata dicts +
+            # shallow spec (scalar bind fields live in the fresh __dict__,
+            # nested internals immutable post-sanitization); generate_name
+            # and owner names carry the chain for substitution
+            "proto": _fast_clone(proto, proto.metadata.name),
+        }
+        with _cache_lock:
+            _cache_stats["misses"] += 1
+            _proto_cache[key] = entry
+            _proto_cache.move_to_end(key)
+            while len(_proto_cache) > _PROTO_CACHE_CAP:
+                _proto_cache.popitem(last=False)
+    return pods
+
+
+def pods_from_replica_set(rs: Workload, _cache: bool = True) -> List[Pod]:
     n = max(rs.replicas, 0)
     if n == 0:
         return []
-    proto = make_valid_pod(_pod_from_template(rs, "ReplicaSet"))
-    proto = _add_workload_info(proto, "ReplicaSet", rs.metadata.name, rs.metadata.namespace)
-    pods = [proto]
-    for _ in range(n - 1):
-        pods.append(_fast_clone(proto, f"{rs.metadata.name}-{_rand_suffix()}"))
-    return pods
+
+    def build() -> List[Pod]:
+        proto = make_valid_pod(_pod_from_template(rs, "ReplicaSet"))
+        proto = _add_workload_info(proto, "ReplicaSet", rs.metadata.name, rs.metadata.namespace)
+        pods = [proto]
+        for _ in range(n - 1):
+            pods.append(_fast_clone(proto, f"{rs.metadata.name}-{_rand_suffix()}"))
+        return pods
+
+    if not _cache:
+        return build()
+    return _expand_cached("ReplicaSet", rs, n, build)
 
 
 def pods_from_deployment(deploy: Workload) -> List[Pod]:
     """Deployment → generated ReplicaSet → pods. The generated RS keeps the
     deployment's name (reference: generateReplicaSetFromDeployment names the
-    RS via SetObjectMetaFromObject → '<deploy>-<rand>')."""
-    rs = Workload(
-        kind="ReplicaSet",
-        metadata=ObjectMeta(
-            name=f"{deploy.metadata.name}-{_rand_suffix()}",
-            namespace=deploy.metadata.namespace,
-            labels=dict(deploy.template_metadata.labels),
-            annotations=dict(deploy.template_metadata.annotations),
-            uid=new_uid(),
-            generate_name=deploy.metadata.name,
-            owner_references=[
-                OwnerReference(kind="Deployment", name=deploy.metadata.name, uid=deploy.metadata.uid or new_uid(), api_version="apps/v1")
-            ],
-        ),
-        replicas=deploy.replicas,
-        selector=deploy.selector,
-        template_metadata=deploy.template_metadata,
-        template_spec=deploy.template_spec,
-        template_raw=deploy.template_raw,
-    )
-    return pods_from_replica_set(rs)
+    RS via SetObjectMetaFromObject → '<deploy>-<rand>'). Cached at THIS
+    level (not the synthesized RS): the RS name embeds a fresh rand suffix
+    per expansion, so only the deployment's own content is a stable key."""
+    n = max(deploy.replicas, 0)
+    if n == 0:
+        return []
+
+    def build() -> List[Pod]:
+        rs = Workload(
+            kind="ReplicaSet",
+            metadata=ObjectMeta(
+                name=f"{deploy.metadata.name}-{_rand_suffix()}",
+                namespace=deploy.metadata.namespace,
+                labels=dict(deploy.template_metadata.labels),
+                annotations=dict(deploy.template_metadata.annotations),
+                uid=new_uid(),
+                generate_name=deploy.metadata.name,
+                owner_references=[
+                    OwnerReference(kind="Deployment", name=deploy.metadata.name, uid=deploy.metadata.uid or new_uid(), api_version="apps/v1")
+                ],
+            ),
+            replicas=deploy.replicas,
+            selector=deploy.selector,
+            template_metadata=deploy.template_metadata,
+            template_spec=deploy.template_spec,
+            template_raw=deploy.template_raw,
+        )
+        return pods_from_replica_set(rs, _cache=False)
+
+    return _expand_cached("Deployment", deploy, n, build)
 
 
-def pods_from_job(job: Workload) -> List[Pod]:
+def pods_from_job(job: Workload, _cache: bool = True) -> List[Pod]:
     n = max(job.replicas, 0)
     if n == 0:
         return []
-    proto = make_valid_pod(_pod_from_template(job, "Job"))
-    proto = _add_workload_info(proto, "Job", job.metadata.name, job.metadata.namespace)
-    pods = [proto]
-    for _ in range(n - 1):
-        pods.append(_fast_clone(proto, f"{job.metadata.name}-{_rand_suffix()}"))
-    return pods
+
+    def build() -> List[Pod]:
+        proto = make_valid_pod(_pod_from_template(job, "Job"))
+        proto = _add_workload_info(proto, "Job", job.metadata.name, job.metadata.namespace)
+        pods = [proto]
+        for _ in range(n - 1):
+            pods.append(_fast_clone(proto, f"{job.metadata.name}-{_rand_suffix()}"))
+        return pods
+
+    if not _cache:
+        return build()
+    return _expand_cached("Job", job, n, build)
 
 
 def pods_from_cron_job(cj: Workload) -> List[Pod]:
     """CronJob → one manual Job instantiation → pods (reference:
     generateJobFromCronJob, pkg/utils/utils.go:204-217)."""
-    job = Workload(
-        kind="Job",
-        metadata=ObjectMeta(
-            name=f"{cj.metadata.name}-{_rand_suffix()}",
-            namespace=cj.metadata.namespace,
-            annotations={"cronjob.kubernetes.io/instantiate": "manual", **cj.template_metadata.annotations},
-            labels=dict(cj.template_metadata.labels),
-            uid=new_uid(),
-            generate_name=cj.metadata.name,
-        ),
-        replicas=cj.replicas,
-        template_metadata=cj.template_metadata,
-        template_spec=cj.template_spec,
-        template_raw=cj.template_raw,
-    )
-    return pods_from_job(job)
+    n = max(cj.replicas, 0)
+    if n == 0:
+        return []
+
+    def build() -> List[Pod]:
+        job = Workload(
+            kind="Job",
+            metadata=ObjectMeta(
+                name=f"{cj.metadata.name}-{_rand_suffix()}",
+                namespace=cj.metadata.namespace,
+                annotations={"cronjob.kubernetes.io/instantiate": "manual", **cj.template_metadata.annotations},
+                labels=dict(cj.template_metadata.labels),
+                uid=new_uid(),
+                generate_name=cj.metadata.name,
+            ),
+            replicas=cj.replicas,
+            template_metadata=cj.template_metadata,
+            template_spec=cj.template_spec,
+            template_raw=cj.template_raw,
+        )
+        return pods_from_job(job, _cache=False)
+
+    return _expand_cached("CronJob", cj, n, build)
 
 
 def pods_from_stateful_set(sts: Workload) -> List[Pod]:
@@ -311,17 +539,21 @@ def pods_from_stateful_set(sts: Workload) -> List[Pod]:
     n = max(sts.replicas, 0)
     if n == 0:
         return []
-    proto = _pod_from_template(sts, "StatefulSet")
-    proto.metadata.name = f"{sts.metadata.name}-0"
-    if proto.raw:
-        proto.raw["metadata"]["name"] = proto.metadata.name
-    proto = make_valid_pod(proto)
-    proto = _add_workload_info(proto, "StatefulSet", sts.metadata.name, sts.metadata.namespace)
-    pods = [proto]
-    for ordinal in range(1, n):
-        pods.append(_fast_clone(proto, f"{sts.metadata.name}-{ordinal}"))
-    _set_storage_annotation(pods, sts.volume_claim_templates)
-    return pods
+
+    def build() -> List[Pod]:
+        proto = _pod_from_template(sts, "StatefulSet")
+        proto.metadata.name = f"{sts.metadata.name}-0"
+        if proto.raw:
+            proto.raw["metadata"]["name"] = proto.metadata.name
+        proto = make_valid_pod(proto)
+        proto = _add_workload_info(proto, "StatefulSet", sts.metadata.name, sts.metadata.namespace)
+        pods = [proto]
+        for ordinal in range(1, n):
+            pods.append(_fast_clone(proto, f"{sts.metadata.name}-{ordinal}"))
+        _set_storage_annotation(pods, sts.volume_claim_templates)
+        return pods
+
+    return _expand_cached("StatefulSet", sts, n, build)
 
 
 def _set_storage_annotation(pods: List[Pod], volume_claim_templates: List[dict]) -> None:

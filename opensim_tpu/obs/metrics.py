@@ -104,6 +104,23 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
     "simon_shed_total": ("Requests shed at the admission queue by reason", "counter"),
     "simon_batch_size": ("Requests folded into one batched schedule dispatch", "histogram"),
     "simon_queue_wait_seconds": ("Real time-in-queue from admission to execution start", "histogram"),
+    # pipelined admission + priority lanes (server/admission.py,
+    # docs/serving.md "Continuous batching & priority lanes") —
+    # cardinality contract: stage ∈ {prep, dispatch, decode};
+    # lane ∈ {interactive, bulk}; reason reuses the typed shed reasons
+    "simon_pipeline_stage_seconds": ("Per-batch pipeline stage latency by stage (prep/dispatch/decode)", "histogram"),
+    "simon_pipeline_prep_overlap_seconds_total": (
+        "Engine-dispatch-busy seconds observed while a later batch's host prep ran (the measured overlap)", "counter",
+    ),
+    "simon_pipeline_overlapped_batches_total": (
+        "Batches whose host prep overlapped another batch's engine dispatch", "counter",
+    ),
+    "simon_lane_depth": ("Admission queue depth by priority lane", "gauge"),
+    "simon_lane_admitted_total": ("Requests admitted by priority lane", "counter"),
+    "simon_lane_shed_total": ("Requests shed at the admission queue by lane and reason", "counter"),
+    "simon_lane_starvation_promotions_total": (
+        "Bulk requests promoted past the lane weight by the starvation bound", "counter",
+    ),
     # multi-process serving fleet (server/fleet.py, docs/serving.md
     # "Scaling past one process") — owner-side families are label-free;
     # worker-side attach counters are label-free too

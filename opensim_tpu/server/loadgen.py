@@ -38,6 +38,7 @@ __all__ = [
     "run_loadgen",
     "run_stub_benchmark",
     "run_fleet_benchmark",
+    "run_pipeline_benchmark",
     "placement_parity",
     "parse_metrics",
     "histogram_quantile",
@@ -464,7 +465,8 @@ def _seed_stub(n_nodes: int, n_pods: int):
 
 
 def _boot_server(kubeconfig: str, port: int, admission: bool, batch_max: int,
-                 workers: int = 0, queue_bound: int = 0):
+                 workers: int = 0, queue_bound: int = 0,
+                 pipeline: "Optional[bool]" = None):
     """The simon server as a SUBPROCESS: the loadgen client and the server
     must not share a GIL, or the measurement reports the client's
     contention as server latency. ``workers`` ≥ 2 boots the multi-process
@@ -485,20 +487,30 @@ def _boot_server(kubeconfig: str, port: int, admission: bool, batch_max: int,
     )
     if queue_bound:
         env["OPENSIM_QUEUE_BOUND"] = str(queue_bound)
+    if pipeline is not None:
+        env["OPENSIM_PIPELINE"] = "on" if pipeline else "off"
     cmd = [sys.executable, "-m", "opensim_tpu", "server",
            "--kubeconfig", kubeconfig, "--port", str(port), "--watch", "auto"]
     if workers >= 2:
         cmd += ["--workers", str(workers)]
-    proc = subprocess.Popen(
-        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-    )
+    # Spool server output to a file, never a pipe: nobody drains the pipe
+    # during the run, and at storm concurrency the 64 KiB buffer fills with
+    # handler tracebacks (clients dropping mid-response), after which every
+    # server thread that logs blocks in write() and the drain wedges.
+    logf = open(os.path.join(os.path.dirname(kubeconfig) or ".",
+                             f"server-{port}.log"), "w+b")
+    proc = subprocess.Popen(cmd, env=env, stdout=logf, stderr=subprocess.STDOUT)
+    proc._simon_logf = logf  # closed by _stop_server
     url = f"http://127.0.0.1:{port}"
     ready_url = f"http://127.0.0.1:{port + 1}/healthz" if workers >= 2 else f"{url}/healthz"
     deadline = time.monotonic() + (240.0 if workers >= 2 else 120.0)
     attempt = 0
     while time.monotonic() < deadline:
         if proc.poll() is not None:
-            out = (proc.stdout.read() or b"").decode(errors="replace")
+            logf.flush()
+            logf.seek(0)
+            out = (logf.read() or b"").decode(errors="replace")
+            logf.close()
             raise RuntimeError(f"server exited at boot (rc={proc.returncode}): {out[-2000:]}")
         try:
             with urllib.request.urlopen(ready_url, timeout=1.0) as resp:
@@ -516,7 +528,28 @@ def _boot_server(kubeconfig: str, port: int, admission: bool, batch_max: int,
             attempt += 1
             time.sleep(min(0.5, 0.05 * attempt))
     proc.kill()
+    proc.wait()
+    logf.close()
     raise RuntimeError("server did not become healthy within the boot window")
+
+
+def _stop_server(proc) -> None:
+    """SIGTERM, bounded drain, SIGKILL fallback. The graceful drain is the
+    normal path; the kill is insurance so one wedged server cannot hang an
+    entire bench run in ``proc.wait()``."""
+    import subprocess
+
+    proc.terminate()
+    try:
+        proc.wait(timeout=60.0)
+    except subprocess.TimeoutExpired:
+        log.warning("server pid %d did not drain within 60s of SIGTERM; killing",
+                    proc.pid)
+        proc.kill()
+        proc.wait()
+    logf = getattr(proc, "_simon_logf", None)
+    if logf is not None:
+        logf.close()
 
 
 def _warm_concurrent(url: str, n: int, timeout_s: float) -> None:
@@ -543,33 +576,40 @@ def run_stub_benchmark(
     n_pods: int = 16,
     batch_max: int = 32,
     base_port: int = 18180,
+    client_procs: int = 0,
 ) -> dict:
     """The ISSUE 8 closed loop, end to end: stub apiserver → two live twin
     servers in subprocesses (single-flight vs admission queue) → closed-
     loop loadgen against each → one report carrying BOTH numbers. Used by
-    ``make loadgen-smoke`` and ``bench.py --config serving``."""
+    ``make loadgen-smoke`` and ``bench.py --config serving``.
+    ``client_procs`` ≥ 2 shards the clients over loadgen subprocesses
+    (mandatory fidelity at hundreds of clients)."""
     import tempfile
 
     stub = _seed_stub(n_nodes, n_pods)
     tmp = tempfile.mkdtemp(prefix="loadgen-")
     kc = stub.kubeconfig(tmp)
+
+    def drive(url: str) -> dict:
+        if client_procs >= 2:
+            return run_loadgen_sharded(url, concurrency, duration_s, client_procs)
+        return run_loadgen(
+            url, mode="closed", concurrency=concurrency, duration_s=duration_s
+        )
+
     try:
         proc, url = _boot_server(kc, base_port, admission=False, batch_max=batch_max)
         try:
             _warm_concurrent(url, min(16, concurrency), 60.0)
-            single = run_loadgen(url, mode="closed", concurrency=concurrency,
-                                 duration_s=duration_s)
+            single = drive(url)
         finally:
-            proc.terminate()
-            proc.wait()
+            _stop_server(proc)
         proc, url = _boot_server(kc, base_port + 1, admission=True, batch_max=batch_max)
         try:
             _warm_concurrent(url, min(16, concurrency), 60.0)
-            batched = run_loadgen(url, mode="closed", concurrency=concurrency,
-                                  duration_s=duration_s)
+            batched = drive(url)
         finally:
-            proc.terminate()
-            proc.wait()
+            _stop_server(proc)
     finally:
         stub.stop()
     speedup = (
@@ -592,6 +632,106 @@ def run_stub_benchmark(
         "shed_single_flight": single["shed"],
         "single_flight": single,
         "admission": batched,
+    }
+
+
+def run_pipeline_benchmark(
+    concurrency: int = 32,
+    duration_s: float = 8.0,
+    n_nodes: int = 8,
+    n_pods: int = 16,
+    batch_max: int = 32,
+    base_port: int = 18380,
+    client_procs: int = 0,
+    queue_bound: int = 0,
+) -> dict:
+    """The ISSUE 16 closed loop: the SAME admission server booted twice —
+    ``OPENSIM_PIPELINE=off`` (serial inline batches) vs ``on`` (staged
+    prep/dispatch/decode) — driven by the same closed-loop loadgen, plus
+    the end-to-end placement-parity gate between the two modes and the
+    measured prep-under-dispatch overlap scraped from the pipelined
+    server's own counters. ``client_procs`` ≥ 2 shards the clients over
+    loadgen subprocesses (mandatory fidelity at hundreds of clients)."""
+    import os
+    import tempfile
+
+    stub = _seed_stub(n_nodes, n_pods)
+    tmp = tempfile.mkdtemp(prefix="loadgen-pipe-")
+    kc = stub.kubeconfig(tmp)
+    qb = queue_bound or max(64, 2 * concurrency)
+
+    def drive(url: str) -> dict:
+        if client_procs >= 2:
+            return run_loadgen_sharded(url, concurrency, duration_s, client_procs)
+        return run_loadgen(
+            url, mode="closed", concurrency=concurrency, duration_s=duration_s
+        )
+
+    try:
+        proc, url = _boot_server(
+            kc, base_port, admission=True, batch_max=batch_max,
+            queue_bound=qb, pipeline=False,
+        )
+        try:
+            _warm_concurrent(url, min(16, concurrency), 60.0)
+            serial = drive(url)
+        finally:
+            _stop_server(proc)
+        pproc, purl = _boot_server(
+            kc, base_port + 2, admission=True, batch_max=batch_max,
+            queue_bound=qb, pipeline=True,
+        )
+        try:
+            _warm_concurrent(purl, min(16, concurrency), 60.0)
+            before = scrape_metrics(purl)
+            piped = drive(purl)
+            after = scrape_metrics(purl)
+            # parity gate between the two modes, against the same stub
+            # cluster: a fresh non-pipelined server answers the same
+            # probes the pipelined one does
+            sproc, surl = _boot_server(
+                kc, base_port + 40, admission=True, batch_max=batch_max,
+                pipeline=False,
+            )
+            try:
+                parity = placement_parity(surl, purl)
+            finally:
+                _stop_server(sproc)
+        finally:
+            _stop_server(pproc)
+    finally:
+        stub.stop()
+    overlap_s = _counter_delta(
+        before, after, "simon_pipeline_prep_overlap_seconds_total"
+    )
+    overlapped = _counter_delta(
+        before, after, "simon_pipeline_overlapped_batches_total"
+    )
+    batches = _counter_delta(before, after, "simon_batches_total")
+    speedup = piped["qps"] / serial["qps"] if serial["qps"] > 0 else float("inf")
+    return {
+        "concurrency": concurrency,
+        "duration_s": duration_s,
+        "nodes": n_nodes,
+        "cluster_pods": n_pods,
+        "client_procs": client_procs,
+        "host_cores": os.cpu_count() or 1,
+        "qps_non_pipelined": serial["qps"],
+        "qps": piped["qps"],
+        "vs_non_pipelined": round(speedup, 2),
+        "p50_s": piped["server_p50_s"],
+        "p99_s": piped["server_p99_s"],
+        "p50_non_pipelined_s": serial["server_p50_s"],
+        "p99_non_pipelined_s": serial["server_p99_s"],
+        "batches": int(batches),
+        "mean_batch_size": piped["mean_batch_size"],
+        "overlapped_batches": int(overlapped),
+        "prep_overlap_s": round(overlap_s, 4),
+        "shed": piped["shed"],
+        "errors": piped["errors"],
+        "placements_identical": parity,
+        "non_pipelined": serial,
+        "pipelined": piped,
     }
 
 
@@ -800,8 +940,7 @@ def run_fleet_benchmark(
             _warm_concurrent(url, min(16, concurrency), 60.0)
             single = drive(url)
         finally:
-            proc.terminate()
-            proc.wait()
+            _stop_server(proc)
         fproc, furl = _boot_server(
             kc, base_port + 2, admission=True, batch_max=batch_max,
             workers=workers, queue_bound=qb,
@@ -823,11 +962,9 @@ def run_fleet_benchmark(
             try:
                 parity = placement_parity(purl, furl)
             finally:
-                pproc.terminate()
-                pproc.wait()
+                _stop_server(pproc)
         finally:
-            fproc.terminate()
-            fproc.wait()
+            _stop_server(fproc)
     finally:
         stub.stop()
     torn = int(

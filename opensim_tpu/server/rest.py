@@ -353,6 +353,29 @@ class _BatchUnroutable(Exception):
     degrades to solo execution, it does not fail."""
 
 
+class _BatchState:
+    """In-flight batch handed between the pipeline stages (prep →
+    dispatch → decode). Everything the engine stage touches lives in
+    ``derived``/``items`` — derived prep arrays that are immutable after
+    the prep stage releases the base-entry lock (generation swaps build
+    NEW cache entries, prepcache.twin_pod_delta)."""
+
+    __slots__ = (
+        "tickets", "base", "derived", "items", "stale",
+        "prep_s", "dispatch", "dispatch_s",
+    )
+
+    def __init__(self, tickets, base, derived, items, stale, prep_s):
+        self.tickets = tickets
+        self.base = base
+        self.derived = derived
+        self.items = items
+        self.stale = stale
+        self.prep_s = prep_s
+        self.dispatch = None
+        self.dispatch_s = 0.0
+
+
 class SimonServer:
     def __init__(
         self,
@@ -415,7 +438,13 @@ class SimonServer:
             admission = admission_mod.admission_enabled()
         if admission is True:
             admission = admission_mod.AdmissionController(
-                solo_fn=self._admitted_solo, batch_fn=self._admitted_batch
+                solo_fn=self._admitted_solo, batch_fn=self._admitted_batch,
+                # staged executors (ISSUE 16): when OPENSIM_PIPELINE=on the
+                # controller runs these as a prep/dispatch/decode pipeline,
+                # overlapping batch k+1's host prep with batch k's engine
+                # dispatch; batch_fn above remains the serial fallback
+                prep_fn=self._batch_prep, dispatch_fn=self._batch_dispatch,
+                decode_fn=self._batch_decode,
             )
         self.admission = admission or None
         # serializes headroom probes (they are expensive scans) and guards
@@ -951,7 +980,24 @@ class SimonServer:
         """Fold N compatible requests onto one shared warm prep and run ONE
         request-axis batched schedule (engine/reqbatch.py), demultiplexing
         a per-request SimulateResult that is bit-identical to a solo run
-        (gated by tests/test_admission.py)."""
+        (gated by tests/test_admission.py).
+
+        Composed from the same three stage executors the pipelined path
+        runs (prep → dispatch → decode), so serial and pipelined modes
+        share ONE implementation and cannot drift."""
+        state = self._batch_prep_once(tickets)
+        if state is None:
+            return  # every rider already resolved (payload decode failures)
+        self._batch_decode(self._batch_dispatch(state))
+
+    def _batch_prep_once(self, tickets) -> Optional[_BatchState]:
+        """Pipeline stage 1 — host prep, under the base entry's lock:
+        snapshot/fingerprint, per-rider payload decode, shared
+        derive-with-slices, per-rider drop masks. Releases the lock before
+        returning: the derived prep it hands the dispatch stage is
+        immutable from here on (twin generation swaps build NEW entries —
+        prepcache.twin_pod_delta — so a swap mid-flight never mutates
+        these arrays)."""
         import time as _time
 
         import numpy as np
@@ -990,7 +1036,7 @@ class SimonServer:
             )
         tickets = kept
         if not tickets:
-            return
+            return None
         base_key = f"{fp}|base"
         base = self.prep_cache.get(base_key)
         if base is None:
@@ -1018,7 +1064,6 @@ class SimonServer:
                 if derived is None or derived.app_slices is None:
                     raise _BatchUnroutable("batch expanded to an empty stream")
                 slices = derived.app_slices
-            prep_s = _time.monotonic() - t0
             items = []
             for s in range(len(tickets)):
                 drop = prepcache.union_drop_masks(
@@ -1042,12 +1087,66 @@ class SimonServer:
                         deadline=tickets[s].deadline,
                     )
                 )
-            t1 = _time.monotonic()
+        prep_s = _time.monotonic() - t0
+        return _BatchState(tickets, base, derived, items, stale, prep_s)
+
+    def _batch_prep(self, tickets) -> Optional[_BatchState]:
+        """The pipelined controller's ``prep_fn``: `_batch_prep_once` with
+        the serial path's stale-entry contract (one internal retry after
+        eviction — a twin generation swap mid-prep lands here) and the
+        `_BatchUnroutable` → None degradation (the controller pools the
+        still-unresolved riders to full-fidelity solo runs)."""
+        from ..engine.prepcache import StaleFingerprintError
+
+        try:
             try:
-                results = reqbatch.run_request_batch(derived, items)
+                return self._batch_prep_once(tickets)
+            except StaleFingerprintError as e:
+                METRICS.bump("stale_prep_retries")
+                log.warning(
+                    "stale prepare-cache entry in batch (%s); retrying once "
+                    "after eviction", e,
+                )
+                return self._batch_prep_once(tickets)
+        except _BatchUnroutable as e:
+            log.info(
+                "batch of %d unroutable (%s); degrading to solo", len(tickets), e
+            )
+            return None
+
+    def _batch_dispatch(self, state: _BatchState) -> _BatchState:
+        """Pipeline stage 2 — the engine dispatch. Runs WITHOUT the base
+        entry's lock: it touches only the stage-1 derived prep (immutable)
+        and device buffers, and the engines release the GIL, so the NEXT
+        batch's host prep overlaps this wall-clock (the tentpole win)."""
+        import time as _time
+
+        from ..engine import reqbatch
+
+        t0 = _time.monotonic()
+        state.dispatch = reqbatch.dispatch_request_batch(state.derived, state.items)
+        state.dispatch_s = _time.monotonic() - t0
+        return state
+
+    def _batch_decode(self, state: _BatchState) -> None:
+        """Pipeline stage 3 — demultiplex per-rider results under the base
+        entry's lock (decode mutates the shared pod objects' bind state;
+        the restore discipline hands the next holder pristine state)."""
+        import time as _time
+
+        from ..engine import reqbatch
+
+        tickets, base, stale = state.tickets, state.base, state.stale
+        t1 = _time.monotonic()
+        with base.lock:
+            base.restore()
+            try:
+                results = reqbatch.decode_request_batch(
+                    state.derived, state.items, state.dispatch
+                )
             finally:
                 base.restore()
-            run_s = _time.monotonic() - t1
+        run_s = state.dispatch_s + (_time.monotonic() - t1)
         for t, res in zip(tickets, results):
             if isinstance(res, BaseException):
                 # a rider shed mid-batch (deadline expired between C++
@@ -1061,7 +1160,7 @@ class SimonServer:
                 # to every rider so per-phase histograms stay live for
                 # batched traffic (child_from_seconds exists for this)
                 tr.root.child_from_seconds(
-                    "prepare", prep_s, batched=True, batch=len(tickets)
+                    "prepare", state.prep_s, batched=True, batch=len(tickets)
                 )
                 tr.root.child_from_seconds(
                     "schedule", run_s, batched=True, batch=len(tickets)
@@ -1466,7 +1565,13 @@ def make_handler(server: SimonServer):
                 from ..obs import profile as profile_mod
 
                 try:
-                    self._send(200, profile_mod.debug_payload())
+                    payload = profile_mod.debug_payload()
+                    adm = server.admission
+                    if adm is not None:
+                        # pipelined-admission stage aggregates (ISSUE 16):
+                        # the `simon profile` pipeline table reads this
+                        payload["pipeline"] = adm.pipeline_snapshot()
+                    self._send(200, payload)
                 except Exception as e:
                     log.warning("profile debug failed: %s: %s", type(e).__name__, e)
                     self._send(500, {"error": str(e), "type": type(e).__name__})

@@ -219,6 +219,14 @@ _SERVER = [
     Knob("OPENSIM_FLEET_ADMIN_PORT", "int", "", "Fleet admin port (aggregated /metrics, /healthz, /api/fleet/status). Default: public port + 1.", None, section="server"),
     Knob("OPENSIM_FLEET_ATTACH", "str", "", "INTERNAL: shared-memory control-block name a fleet worker attaches to (set by the fleet supervisor, never by operators).", None, section="server"),
     Knob("OPENSIM_FLEET_INTERNAL_PORT", "int", "", "INTERNAL: per-worker loopback listener port the fleet supervisor scrapes for /metrics aggregation (set by the supervisor).", None, section="server"),
+    # pipelined admission + priority lanes (server/admission.py,
+    # docs/serving.md "Continuous batching & priority lanes")
+    Knob("OPENSIM_PIPELINE", "enum", "on", "`on` overlaps batch k+1 host prep with batch k engine dispatch (staged pipeline); `off` restores the serial single-batch-in-flight loop.", None, choices=("on", "off"), section="server"),
+    Knob("OPENSIM_PRIORITY_LANES", "enum", "on", "`on` splits the admission queue into interactive/bulk lanes with weighted pickup; `off` restores strict FIFO.", None, choices=("on", "off"), section="server"),
+    Knob("OPENSIM_LANE_INTERACTIVE_PODS", "int", "8", "Requests expanding to at most this many pods ride the interactive lane (explain requests always do).", _int(lo=0), section="server"),
+    Knob("OPENSIM_LANE_WEIGHT", "int", "4", "Interactive-lane pickups per bulk pickup when both lanes are non-empty (weighted round-robin ratio).", _int(lo=1), section="server"),
+    Knob("OPENSIM_LANE_STARVATION_S", "float", "0.5", "Starvation bound: a bulk request waiting longer than this is picked next regardless of lane weight.", _float(lo=0.0), section="server"),
+    Knob("OPENSIM_EXPAND_CACHE", "flag", "1", "`0` disables the workload-expansion template cache (per-request full template clone + validation).", None, section="server"),
 ]
 
 _OBSERVABILITY = [

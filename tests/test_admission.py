@@ -106,16 +106,24 @@ def _canon(body: dict, workloads):
     )
 
 
-def _make_server(window_s=None, **kwargs):
+def _make_server(window_s=None, pipelined=True, **kwargs):
     from opensim_tpu.server import admission as admission_mod
     from opensim_tpu.server.rest import SimonServer
 
     server = SimonServer(base_cluster=_cluster(), **kwargs)
     if window_s is not None and server.admission is not None:
         server.admission.stop()
+        stage_fns = (
+            dict(
+                prep_fn=server._batch_prep, dispatch_fn=server._batch_dispatch,
+                decode_fn=server._batch_decode,
+            )
+            if pipelined
+            else {}
+        )
         server.admission = admission_mod.AdmissionController(
             solo_fn=server._admitted_solo, batch_fn=server._admitted_batch,
-            window_s=window_s,
+            window_s=window_s, **stage_fns,
         )
     return server
 
@@ -676,3 +684,232 @@ def test_xla_batch_sheds_expired_riders_before_dispatch(monkeypatch):
             for ns in mixed[s].node_status if ns.pods
         )
         assert want == got, f"live rider {s} perturbed by the shed rider"
+
+
+# ---------------------------------------------------------------------------
+# pipelined admission + priority lanes (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+def _storm(server, reqs):
+    results = [None] * len(reqs)
+
+    def run(i, kind, payload):
+        results[i] = (
+            server.deploy_apps if kind == "deploy" else server.scale_apps
+        )(payload)
+
+    threads = [
+        threading.Thread(target=run, args=(i, k, p))
+        for i, (k, p) in enumerate(reqs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results
+
+
+def test_pipelined_matches_nonpipelined_under_mixed_storm():
+    """The tentpole gate: the same mixed deploy/scale storm through the
+    staged prep/dispatch/decode pipeline and through the serial inline
+    batch path produces identical placements — and the pipeline
+    demonstrably engaged (all three stage aggregates recorded)."""
+    reqs = _requests()
+    wl = _workloads_of([p for _, p in reqs])
+    serial = _make_server(window_s=0.25, pipelined=False)
+    piped = _make_server(window_s=0.25)
+    assert piped.admission.pipelined and not serial.admission.pipelined
+    try:
+        want = _storm(serial, reqs)
+        got = _storm(piped, reqs)
+        for i in range(len(reqs)):
+            assert want[i][0] == 200, (i, want[i][1])
+            assert got[i][0] == 200, (i, got[i][1])
+            assert _canon(got[i][1], wl) == _canon(want[i][1], wl), (
+                f"request {i} diverged between pipelined and serial"
+            )
+        snap = piped.admission.pipeline_snapshot()
+        assert snap["enabled"] and snap["batches"] >= 1
+        for stage in ("prep", "dispatch", "decode"):
+            assert snap["stages"].get(stage, {}).get("count", 0) >= 1, stage
+        # pipeline + lane telemetry families are live on /metrics
+        from opensim_tpu.server.rest import METRICS
+
+        text = METRICS.render(admission=piped.admission)
+        for needle in (
+            "# TYPE simon_pipeline_stage_seconds histogram",
+            "# TYPE simon_pipeline_prep_overlap_seconds_total counter",
+            "# TYPE simon_pipeline_overlapped_batches_total counter",
+            "# TYPE simon_lane_depth gauge",
+            "# TYPE simon_lane_admitted_total counter",
+            "# TYPE simon_lane_shed_total counter",
+            "# TYPE simon_lane_starvation_promotions_total counter",
+            'simon_pipeline_stage_seconds_count{stage="prep"}',
+        ):
+            assert needle in text, needle
+    finally:
+        piped.close()
+        serial.close()
+
+
+def test_generation_swap_mid_prep_retries_once_bitidentical():
+    """A stale fingerprint surfacing at the prep stage (the cache.stale
+    fault point — what a twin generation swap mid-prep looks like to the
+    pipeline) retries exactly once INSIDE prep; the storm still answers
+    bit-identically to the serial single-flight path."""
+    from opensim_tpu.resilience import faults
+    from opensim_tpu.server.rest import METRICS
+
+    reqs = _requests()
+    wl = _workloads_of([p for _, p in reqs])
+    serial = _make_server(admission=False)
+    expected = []
+    for kind, payload in reqs:
+        code, body = (
+            serial.deploy_apps if kind == "deploy" else serial.scale_apps
+        )(payload)
+        assert code == 200, body
+        expected.append(_canon(body, wl))
+
+    piped = _make_server(window_s=0.25)
+    retries0 = METRICS.stale_prep_retries
+    faults.inject("cache.stale", count=1, exc="stale")
+    try:
+        results = _storm(piped, reqs)
+        for i, (code, body) in enumerate(results):
+            assert code == 200, (i, body)
+            assert _canon(body, wl) == expected[i], f"request {i} diverged"
+        assert METRICS.stale_prep_retries - retries0 >= 1
+        assert piped.admission.batches_total >= 1
+    finally:
+        faults.clear_faults()
+        piped.close()
+        serial.close()
+
+
+def _lane_ticket(name, reps, explain=False):
+    from opensim_tpu.server import admission as admission_mod
+
+    return admission_mod.Ticket(
+        kind="deploy",
+        payload={
+            "deployments": [
+                fx.make_fake_deployment(name, reps, "100m", "128Mi").raw
+            ]
+        },
+        explain=explain,
+    )
+
+
+def _lane_controller(batch_fn, window_s=0.4, **kwargs):
+    from opensim_tpu.server import admission as admission_mod
+
+    return admission_mod.AdmissionController(
+        solo_fn=lambda t: t.resolve(result=None), batch_fn=batch_fn,
+        window_s=window_s, **kwargs,
+    )
+
+
+def test_interactive_lane_overtakes_bulk_within_weight(monkeypatch):
+    """Weighted pickup: small requests submitted AFTER large ones are
+    still drained first (interactive lane wins up to the lane weight),
+    while FIFO order is preserved within each lane."""
+    monkeypatch.setenv("OPENSIM_LANE_STARVATION_S", "30")  # isolate the weight
+    order = []
+    done = threading.Event()
+
+    def batch_fn(tickets):
+        order.extend((t.lane, t.payload["deployments"][0]["metadata"]["name"]) for t in tickets)
+        for t in tickets:
+            t.resolve(result=None)
+        done.set()
+
+    ctrl = _lane_controller(batch_fn)
+    try:
+        tickets = [
+            ctrl.submit(_lane_ticket("big-0", 50)),
+            ctrl.submit(_lane_ticket("big-1", 50)),
+            ctrl.submit(_lane_ticket("small-0", 1)),
+            ctrl.submit(_lane_ticket("small-1", 1, explain=True)),
+        ]
+        assert done.wait(timeout=30)
+        for t in tickets:
+            ctrl.wait(t)
+        assert order == [
+            ("interactive", "small-0"), ("interactive", "small-1"),
+            ("bulk", "big-0"), ("bulk", "big-1"),
+        ]
+        assert ctrl.lane_admitted == {"interactive": 2, "bulk": 2}
+    finally:
+        ctrl.stop()
+
+
+def test_bulk_starvation_bound_promotes_past_weight(monkeypatch):
+    """The starvation bound: a bulk head older than the bound is picked
+    BEFORE waiting interactive requests regardless of lane weight, and the
+    promotion is counted."""
+    monkeypatch.setenv("OPENSIM_LANE_STARVATION_S", "0")
+    order = []
+    done = threading.Event()
+
+    def batch_fn(tickets):
+        order.extend(t.lane for t in tickets)
+        for t in tickets:
+            t.resolve(result=None)
+        done.set()
+
+    ctrl = _lane_controller(batch_fn, window_s=0.3)
+    try:
+        b = ctrl.submit(_lane_ticket("big", 50))
+        i1 = ctrl.submit(_lane_ticket("small-0", 1))
+        i2 = ctrl.submit(_lane_ticket("small-1", 1))
+        assert done.wait(timeout=30)
+        for t in (b, i1, i2):
+            ctrl.wait(t)
+        assert order[0] == "bulk", order
+        assert ctrl.starvation_promotions >= 1
+        from opensim_tpu.server.rest import METRICS
+
+        text = METRICS.render(admission=ctrl)
+        assert re.search(r"simon_lane_starvation_promotions_total [1-9]", text)
+    finally:
+        ctrl.stop()
+
+
+def test_queue_full_shed_is_lane_attributed():
+    """Sheds carry their lane: a bulk request shed at the bound lands in
+    ``simon_lane_shed_total{lane="bulk",reason="queue_full"}`` alongside
+    the existing un-laned ``simon_shed_total``."""
+    from opensim_tpu.server import admission as admission_mod
+    from opensim_tpu.server.rest import METRICS
+
+    ctrl = _lane_controller(lambda ts: None, window_s=5.0, bound=1)
+    try:
+        held = ctrl.submit(_lane_ticket("held", 1))  # parks in the window
+        with pytest.raises(admission_mod.QueueFull):
+            ctrl.submit(_lane_ticket("shed-bulk", 50))
+        text = METRICS.render(admission=ctrl)
+        assert 'simon_lane_shed_total{lane="bulk",reason="queue_full"} 1' in text
+        assert 'simon_shed_total{reason="queue_full"} 1' in text
+        assert 'simon_lane_admitted_total{lane="interactive"} 1' in text
+        assert held is not None
+    finally:
+        ctrl.stop()
+
+
+def test_pipeline_off_knob_restores_serial_batch_path(monkeypatch):
+    """OPENSIM_PIPELINE=off must construct a non-pipelined controller even
+    when the staged executors are wired (the serial inline path is the
+    fallback, and the storm still answers correctly)."""
+    monkeypatch.setenv("OPENSIM_PIPELINE", "off")
+    server = _make_server(window_s=0.2)
+    try:
+        assert server.admission.pipelined is False
+        reqs = _requests()[:3]
+        results = _storm(server, reqs)
+        for code, body in results:
+            assert code == 200, body
+        assert server.admission.pipeline_snapshot()["enabled"] is False
+    finally:
+        server.close()

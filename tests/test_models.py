@@ -109,6 +109,129 @@ def test_cronjob_expansion():
     assert pods[0].metadata.annotations[ANNO_WORKLOAD_KIND] == "Job"
 
 
+# ---------------------------------------------------------------------------
+# workload-expansion proto cache (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+def _expansion_canon(pod, name):
+    """Pod content with the volatile bits (uids, rand suffixes) normalized
+    onto the workload name, for cache-on vs cache-off comparison."""
+    m = pod.metadata
+
+    def n(s):
+        return "NAME" if isinstance(s, str) and name in s else s
+
+    return {
+        "ns": m.namespace,
+        "labels": dict(m.labels),
+        "annotations": {k: n(v) for k, v in m.annotations.items()},
+        "generate_name": n(m.generate_name),
+        "owners": [(r.kind, n(r.name), r.api_version, r.controller) for r in m.owner_references],
+        "requests": pod.resource_requests(),
+        "scheduler": pod.spec.scheduler_name,
+        "volumes": pod.spec.volumes,
+        "phase": pod.phase,
+        "raw_spec": pod.raw.get("spec"),
+    }
+
+
+@pytest.mark.parametrize("kind", ["Deployment", "ReplicaSet", "StatefulSet", "Job", "CronJob"])
+def test_expand_cache_hit_is_bitidentical(kind, monkeypatch):
+    """A cache hit materializes pods identical (modulo uids/rand suffixes)
+    to a cold build, for every cached workload kind — including a hit
+    under a DIFFERENT workload name, which must be rewritten completely
+    (no cached name may leak into the materialized pods)."""
+    makers = {
+        "Deployment": (fixtures.make_fake_deployment, expand.pods_from_deployment),
+        "ReplicaSet": (fixtures.make_fake_replica_set, expand.pods_from_replica_set),
+        "StatefulSet": (fixtures.make_fake_stateful_set, expand.pods_from_stateful_set),
+        "Job": (lambda n, **kw: fixtures.make_fake_job(n, completions=3), expand.pods_from_job),
+        "CronJob": (lambda n, **kw: fixtures.make_fake_cron_job(n, completions=3), expand.pods_from_cron_job),
+    }
+    make, expander = makers[kind]
+    expand.expand_cache_clear()
+    monkeypatch.setenv("OPENSIM_EXPAND_CACHE", "0")
+    cold = expander(make("alpha", replicas=3))
+    monkeypatch.setenv("OPENSIM_EXPAND_CACHE", "1")
+    expander(make("alpha", replicas=3))  # miss populates
+    warm = expander(make("alpha", replicas=3))  # hit materializes
+    other = expander(make("beta", replicas=3))  # hit, different name
+    stats = expand.expand_cache_stats()
+    assert stats["hits"] == 2 and stats["misses"] == 1, stats
+    assert [_expansion_canon(p, "alpha") for p in warm] == [
+        _expansion_canon(p, "alpha") for p in cold
+    ]
+    for p in other:
+        blob = json.dumps(
+            {
+                "name": p.metadata.name,
+                "generate_name": p.metadata.generate_name,
+                "annotations": p.metadata.annotations,
+                "labels": p.metadata.labels,
+                "owners": [r.name for r in p.metadata.owner_references],
+            }
+        )
+        assert "alpha" not in blob, blob
+        assert "beta" in blob, blob
+    # expansions never repeat pod names (fresh rand suffixes per hit) —
+    # StatefulSets excepted: their ordinal names are deterministic by design
+    if kind != "StatefulSet":
+        names = [p.metadata.name for p in cold + warm + other]
+        assert len(names) == len(set(names)), names
+
+
+def test_expand_cache_entry_survives_caller_mutation(monkeypatch):
+    """Callers mutate returned pods (bind decode writes node_name and GPU
+    annotations): the cached proto must stay pristine, so a later hit
+    starts clean."""
+    monkeypatch.setenv("OPENSIM_EXPAND_CACHE", "1")
+    expand.expand_cache_clear()
+    first = expand.pods_from_deployment(fixtures.make_fake_deployment("mut", replicas=2))
+    for p in first:
+        p.spec.node_name = "node-x"
+        p.metadata.annotations["poison"] = "1"
+        p.metadata.labels["poison"] = "1"
+    again = expand.pods_from_deployment(fixtures.make_fake_deployment("mut", replicas=2))
+    assert expand.expand_cache_stats()["hits"] == 1
+    for p in again:
+        assert p.spec.node_name == ""
+        assert "poison" not in p.metadata.annotations
+        assert "poison" not in p.metadata.labels
+
+
+def test_expand_cache_distinct_content_never_shares(monkeypatch):
+    """Same name, different template content → distinct entries; the knob
+    off bypasses the cache entirely."""
+    monkeypatch.setenv("OPENSIM_EXPAND_CACHE", "1")
+    expand.expand_cache_clear()
+    small = expand.pods_from_deployment(fixtures.make_fake_deployment("w", 2, "100m", "128Mi"))
+    big = expand.pods_from_deployment(fixtures.make_fake_deployment("w", 2, "4", "8Gi"))
+    assert expand.expand_cache_stats()["misses"] == 2
+    assert small[0].resource_requests() != big[0].resource_requests()
+    monkeypatch.setenv("OPENSIM_EXPAND_CACHE", "0")
+    expand.pods_from_deployment(fixtures.make_fake_deployment("w", 2, "100m", "128Mi"))
+    assert expand.expand_cache_stats()["hits"] == 0
+
+
+def test_expand_cache_keys_parsed_spec_mutations(monkeypatch):
+    """Post-parse mutation of the PARSED template_spec (how tests and
+    callers select a scheduler profile) must diverge the key even though
+    the raw dict is unchanged — the proto is built from the parsed
+    object, so a raw-only key would hand the mutated workload another
+    workload's unmutated expansion (regression: segmented multi-profile
+    streams silently collapsed to one profile)."""
+    monkeypatch.setenv("OPENSIM_EXPAND_CACHE", "1")
+    expand.expand_cache_clear()
+    plain = fixtures.make_fake_deployment("lane-a", replicas=2)
+    packer = fixtures.make_fake_deployment("lane-b", replicas=2)
+    packer.template_spec.scheduler_name = "packer"
+    expand.pods_from_deployment(plain)
+    pods = expand.pods_from_deployment(packer)
+    assert all(p.spec.scheduler_name == "packer" for p in pods)
+    assert expand.expand_cache_stats()["misses"] == 2
+
+
 def test_make_valid_pod_sanitization():
     pod = Pod.from_dict(
         {

@@ -23,6 +23,16 @@ single-flight TryLock):
    torn-generation attach abandonments, and the end-to-end placement
    parity gate (same payloads → same placements on both servers).
 
+**Phase 3 — the ISSUE 16 pipeline** (staged continuous batching):
+
+7. boot the admission server twice — ``OPENSIM_PIPELINE=off`` (serial
+   inline batches) vs ``on`` (prep/dispatch/decode stages) — and drive
+   both with the same closed loop;
+8. assert the pipelined mode measured REAL overlap (prep-under-dispatch
+   seconds > 0 on the server's own counter), sustains QPS no worse than
+   the serial-batch floor, zero errors, and zero placement divergence
+   (the end-to-end parity gate between the two modes).
+
 The full-length run (the acceptance numbers) is
 ``python bench.py --config serving [--workers N]``; this gate uses shorter
 windows and conservative margins so a loaded CI box never flakes.
@@ -44,7 +54,11 @@ def fail(msg: str) -> int:
 
 
 def main() -> int:
-    from opensim_tpu.server.loadgen import run_fleet_benchmark, run_stub_benchmark
+    from opensim_tpu.server.loadgen import (
+        run_fleet_benchmark,
+        run_pipeline_benchmark,
+        run_stub_benchmark,
+    )
 
     report = run_stub_benchmark(
         concurrency=16, duration_s=4.0, n_nodes=6, n_pods=12,
@@ -108,11 +122,16 @@ def main() -> int:
         )
     # the fleet must at least match one process (the acceptance multiple
     # comes from the longer bench run); the 0.95 floor absorbs CI noise on
-    # a box where 2 workers already saturate the cores
-    if fleet["qps"] < fleet["qps_single_process"] * 0.95:
+    # a box where 2 workers already saturate the cores. Below 2 cores the
+    # fleet CANNOT match one process — two worker processes on one core
+    # are pure context-switch overhead (measured ~0.75x) — so the floor
+    # drops and the correctness gates above carry the phase.
+    cores = os.cpu_count() or 1
+    fleet_floor = 0.95 if cores >= 2 else 0.6
+    if fleet["qps"] < fleet["qps_single_process"] * fleet_floor:
         return fail(
             f"fleet qps {fleet['qps']} below single-process "
-            f"{fleet['qps_single_process']} (x0.95 floor)"
+            f"{fleet['qps_single_process']} (x{fleet_floor} floor, {cores} core(s))"
         )
     if fleet["fleet_generation"] < 0 or fleet["fleet_publishes"] < 1:
         return fail("owner never published a generation over shared memory")
@@ -120,6 +139,48 @@ def main() -> int:
         {k: fleet[k] for k in (
             "qps_single_process", "qps", "vs_single_process", "p99_s",
             "placements_identical", "torn_generation_exhausted",
+        )}
+    ))
+
+    # ---- phase 3: the staged pipeline (ISSUE 16) --------------------------
+    pipe = run_pipeline_benchmark(
+        concurrency=16, duration_s=4.0, n_nodes=6, n_pods=12,
+        base_port=18880,
+    )
+    print(
+        "loadgen-smoke: pipelined "
+        f"{pipe['qps']:.1f} qps vs serial-batch "
+        f"{pipe['qps_non_pipelined']:.1f} qps "
+        f"({pipe['vs_non_pipelined']:.2f}x on {pipe['host_cores']} core(s)), "
+        f"{pipe['overlapped_batches']}/{pipe['batches']} batches overlapped "
+        f"({pipe['prep_overlap_s']:.3f}s prep under dispatch), "
+        f"p99 {pipe['p99_s'] or -1:.3f}s"
+    )
+    if pipe["errors"]:
+        return fail(f"pipelined run had {pipe['errors']} errors")
+    if not pipe["placements_identical"]:
+        return fail("pipelined placements diverged from the serial-batch mode")
+    if pipe["prep_overlap_s"] <= 0 or pipe["overlapped_batches"] < 1:
+        return fail(
+            "pipeline measured no prep-under-dispatch overlap "
+            f"(overlap={pipe['prep_overlap_s']}s, "
+            f"overlapped_batches={pipe['overlapped_batches']})"
+        )
+    # QPS floor, not a speedup gate: the acceptance multiple needs spare
+    # cores (bench.py refuses cross-core-count comparisons for the same
+    # reason). Below 4 cores the stages all contend for the same core —
+    # overlap exists but cannot pay — so the floor only screens for a
+    # pathological slowdown there
+    floor = 0.9 if pipe["host_cores"] >= 4 else 0.7
+    if pipe["qps"] < pipe["qps_non_pipelined"] * floor:
+        return fail(
+            f"pipelined qps {pipe['qps']} below the serial-batch floor "
+            f"{pipe['qps_non_pipelined']} (x{floor}, {pipe['host_cores']} core(s))"
+        )
+    print("loadgen-smoke: ok — " + json.dumps(
+        {k: pipe[k] for k in (
+            "qps_non_pipelined", "qps", "vs_non_pipelined", "host_cores",
+            "overlapped_batches", "prep_overlap_s", "placements_identical",
         )}
     ))
     return 0

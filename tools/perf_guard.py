@@ -115,7 +115,36 @@ def load_manifest(path: str = MANIFEST) -> dict:
 
 
 def compare(row: dict, key: str, entry: dict, tolerance_only: bool = False) -> GuardReport:
-    """Compare one fresh bench row against one baseline entry."""
+    """Compare one fresh bench row against one baseline entry.
+
+    When both rows record ``host_cores`` and they differ, the comparison
+    is REFUSED outright (one verdict, no ratios): serving QPS is
+    core-count-bound, so a cross-core ratio measures the boxes, not the
+    code — re-baseline on a same-core box instead (BENCH.md)."""
+    base_cores = entry["row"].get("host_cores")
+    row_cores = row.get("host_cores")
+    if (
+        base_cores is not None
+        and row_cores is not None
+        and int(base_cores) != int(row_cores)
+    ):
+        return GuardReport(
+            baseline=key,
+            source=entry["source"],
+            verdicts=[
+                MetricVerdict(
+                    metric="host_cores", kind="exact",
+                    baseline=float(base_cores), measured=float(row_cores),
+                    ratio=None, limit=None, ok=False, enforced=True,
+                    note=(
+                        f"comparison refused: baseline measured on "
+                        f"{base_cores} core(s), this row on {row_cores} — "
+                        "time/rate ratios are not comparable across core "
+                        "counts; re-baseline on a same-core box"
+                    ),
+                )
+            ],
+        )
     verdicts: List[MetricVerdict] = []
     for name, spec in entry["metrics"].items():
         kind = spec["kind"]

@@ -7,8 +7,8 @@ test:
 	python -m pytest tests/ -q
 
 # opensim-lint: repo-specific static analyzer (docs/static-analysis.md) —
-# 23 rules incl. the interprocedural dataflow pack (OSL16xx) and the
-# fleet shm-discipline rule (OSL1701), result-cached
+# 27 rules incl. the interprocedural dataflow pack (OSL16xx) and the
+# array-contract engine (OSL18xx), result-cached
 # by content hash (.lint/cache.json: unchanged files skip their rules), a
 # SARIF artifact at a stable path for CI upload, and the detector-awake
 # corpus gate (every rule must fire on its fixture, stay quiet on the

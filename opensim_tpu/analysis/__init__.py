@@ -47,6 +47,14 @@ Rules (short name = suppression id; see docs/static-analysis.md):
     OSL1701 shm-discipline    shared-memory segment create/attach/unlink
                               outside server/fleet.py (the fleet's
                               /dev/shm hygiene owner)
+    OSL1801 array-off-policy  array built without a policy dtype reaches
+                              a contracted arena field or kernel boundary
+    OSL1802 silent-upcast     dtype promotion on a path reaching an arena
+                              write or kernel boundary (interprocedural)
+    OSL1803 shape-contract    rank/axis-order mismatch vs the declared
+                              (dtype, axes) contract
+    OSL1804 contract-abi-parity  contract registry / dtypes policy /
+                              native ScanArgs widths out of three-way sync
 
 The OSL12xx family is whole-program (symbol table + call graph + lock
 graph across all linted files); its runtime counterpart is the lock-order
@@ -54,7 +62,11 @@ sanitizer ``analysis/lockwatch.py`` (`make tsan`, ``OPENSIM_LOCKWATCH=1``).
 The OSL16xx family runs on the interprocedural dataflow engine
 (``analysis/dataflow.py``: per-function CFGs + reaching definitions,
 call-graph effect fixpoint, forward taint lattice) and the cross-language
-ABI parser (``analysis/abi.py``); see docs/static-analysis.md.
+ABI parser (``analysis/abi.py``); see docs/static-analysis.md. The
+OSL18xx family is the array-contract engine (``analysis/arrays.py``): an
+abstract interpreter computing a (dtype, rank, symbolic-axis) lattice
+over the same CFGs, checked against the contract registry in
+``encoding/dtypes.py`` and the C++ ``ScanArgs`` widths.
 """
 
 from .core import (  # noqa: F401
@@ -74,6 +86,7 @@ from .core import (  # noqa: F401
 # importing the rule modules registers them
 from . import (  # noqa: F401,E402
     rules_admission,
+    rules_arrays,
     rules_cache,
     rules_campaign,
     rules_concurrency,

@@ -38,6 +38,49 @@ def pyproject_defaults(path: str = "pyproject.toml") -> Dict[str, List[str]]:
     return out
 
 
+def _git_changed_files(roots: List[str]) -> Optional[List[str]]:
+    """Lintable files with uncommitted changes (``git status
+    --porcelain``), scoped to the configured lint roots. A modified
+    ``.cc`` engine source pulls in the native package next to it so the
+    ABI rules (OSL1604/OSL1804) re-check the boundary. Returns None when
+    not in a git checkout (caller falls back to a full run)."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["git", "status", "--porcelain"],
+            capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if proc.returncode != 0:
+        return None
+
+    def in_scope(path: str) -> bool:
+        norm = path.replace(os.sep, "/")
+        return any(
+            norm == r or norm.startswith(r.rstrip("/") + "/")
+            for r in (root.replace(os.sep, "/") for root in roots)
+        )
+
+    out: List[str] = []
+    for line in proc.stdout.splitlines():
+        if len(line) < 4:
+            continue
+        path = line[3:].strip()
+        if " -> " in path:  # rename: lint the new name
+            path = path.split(" -> ", 1)[1]
+        if not in_scope(path) or not os.path.exists(path):
+            continue
+        if path.endswith(".py") or os.path.isdir(path):
+            out.append(path)
+        elif path.endswith(".cc"):
+            mirror = os.path.join(os.path.dirname(path), "__init__.py")
+            if os.path.isfile(mirror):
+                out.append(mirror)
+    return sorted(set(out))
+
+
 def _checked_flag_paths(args):
     """Validate the path-valued flags (registered validators, OSL1603);
     raises ValueError with the usual one-liner text."""
@@ -104,6 +147,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         "on the clean variant)",
     )
     ap.add_argument(
+        "--changed",
+        action="store_true",
+        help="lint only files with uncommitted git changes under the "
+        "configured paths (plus the native package when a .cc engine "
+        "source changed) — the fast pre-commit loop; whole-program rules "
+        "see just this subset and cache in their own project slot",
+    )
+    ap.add_argument(
+        "--jobs",
+        type=int,
+        default=None,
+        metavar="N",
+        help="process-pool width for the per-file rule tier on cache "
+        "misses (default: auto-size to the machine; 1 forces serial; "
+        "results are byte-identical either way)",
+    )
+    ap.add_argument(
         "--check-typed-core",
         action="store_true",
         help="stdlib typed-core signature check (make mypy fallback)",
@@ -136,13 +196,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         rules = cfg.get("rules") or None
     paths = args.paths or cfg.get("paths") or ["opensim_tpu"]
     fmt = args.format or ("json" if args.json else "human")
+    if args.changed:
+        changed = _git_changed_files(paths)
+        if changed is None:
+            print("opensim-lint: --changed needs a git checkout", file=sys.stderr)
+            return 2
+        if not changed:
+            print("opensim-lint: --changed: no modified files under "
+                  + ", ".join(paths) + "; nothing to lint")
+            return 0
+        paths = changed
     try:
         cache_path, sarif_out, corpus_dir = _checked_flag_paths(args)
     except ValueError as e:
         print(f"opensim-lint: {e}", file=sys.stderr)
         return 2
     stats: dict = {}
-    findings = lint_paths(paths, rules=rules, stats=stats, cache_path=cache_path)
+    findings = lint_paths(
+        paths, rules=rules, stats=stats, cache_path=cache_path, jobs=args.jobs
+    )
     if sarif_out:
         out_dir = os.path.dirname(sarif_out)
         if out_dir:

@@ -1299,6 +1299,7 @@ def lint_paths(
     rules: Optional[Sequence[str]] = None,
     stats: Optional[dict] = None,
     cache_path: Optional[str] = None,
+    jobs: Optional[int] = None,
 ) -> List[Finding]:
     """Lint files/directories; directories are walked for ``.py`` files.
     Every file is parsed ONCE and the AST shared across all rules; pass a
@@ -1309,7 +1310,13 @@ def lint_paths(
     (``analysis/cache.py``): unchanged files skip their per-file rules
     (and, when nothing in the project changed, everything skips — no
     parses at all). ``stats`` then also carries ``cache_hits``,
-    ``cache_misses`` and ``project_pass`` ("reused"/"rebuilt"/"n/a")."""
+    ``cache_misses`` and ``project_pass`` ("reused"/"rebuilt"/"n/a").
+
+    ``jobs`` fans the per-file rule tier (the cache-miss loop) out over a
+    process pool; the whole-program tier stays serial (it is one shared
+    symbol table). ``None`` auto-sizes to the machine; ``1`` forces the
+    serial path. Results are byte-identical either way: workers return
+    per-file findings that are merged back in walk order."""
     t0 = time.perf_counter()
     if cache_path is None:
         contexts: List[FileContext] = []
@@ -1328,7 +1335,7 @@ def lint_paths(
             stats["rules"] = len(_select_rules(rules))
             stats["seconds"] = time.perf_counter() - t0
         return findings
-    findings = _lint_paths_cached(paths, rules, stats, cache_path)
+    findings = _lint_paths_cached(paths, rules, stats, cache_path, jobs)
     if stats is not None:
         stats["seconds"] = time.perf_counter() - t0
     return findings
@@ -1349,11 +1356,45 @@ def _companion_files(py_paths: Sequence[str]) -> List[str]:
     return out
 
 
+def _lint_file_worker(item: Tuple[str, str, Tuple[str, ...]]) -> Tuple[str, List[dict]]:
+    """Per-file rule tier for ONE file — the process-pool unit. Top-level
+    so the executor can pickle it; re-parses the source (ASTs don't cross
+    process boundaries) and runs the named rules through the same
+    ``_check_file`` dispatch as the serial path, so findings are
+    byte-identical. Returns ``(path, finding dicts)``."""
+    fpath, source, rule_names = item
+    selected = _select_rules(list(rule_names))
+    ctx, err = _make_context(source, fpath)
+    out: List[Finding] = []
+    if err is not None:
+        out.append(err)
+    if ctx is not None:
+        out.extend(_check_file(ctx, selected))
+    return fpath, [f.as_dict() for f in out]
+
+
+#: Below this many cache misses the pool's fork/import overhead exceeds
+#: the rule work; the miss loop stays serial.
+_PARALLEL_MIN_MISSES = 8
+
+
+def _resolve_jobs(jobs: Optional[int], n_misses: int) -> int:
+    """Worker count for the per-file tier. ``None``/``0`` auto-sizes to
+    the machine (capped — lint is parse-bound, not embarrassingly wide);
+    small miss counts and single-core boxes degrade to serial."""
+    if not jobs:
+        jobs = min(os.cpu_count() or 1, 8)
+    if jobs <= 1 or n_misses < _PARALLEL_MIN_MISSES:
+        return 1
+    return min(jobs, n_misses)
+
+
 def _lint_paths_cached(
     paths: Sequence[str],
     rules: Optional[Sequence[str]],
     stats: Optional[dict],
     cache_path: str,
+    jobs: Optional[int] = None,
 ) -> List[Finding]:
     """The content-hash-cached lint flow (see :mod:`analysis.cache`).
 
@@ -1418,9 +1459,11 @@ def _lint_paths_cached(
         project_findings = [Finding(**d) for d in cached_project]
         project_state = "reused"
 
-    # parse what we must: cache-missed files always; every file when the
-    # project pass has to rebuild
-    need_parse = set(misses)
+    # parse what we must: cache-missed files (unless pool workers will
+    # re-parse them in their own processes); every file when the project
+    # pass has to rebuild
+    use_jobs = _resolve_jobs(jobs, len(misses))
+    need_parse = set(misses) if use_jobs == 1 else set()
     if global_rules and cached_project is None:
         need_parse = set(order)
         project_state = "rebuilt"
@@ -1434,17 +1477,33 @@ def _lint_paths_cached(
         elif err is not None:
             parse_errors[fpath] = err
 
-    # per-file rules over the cache misses (same dispatch as _run)
+    # per-file rules over the cache misses (same dispatch as _run). With
+    # jobs > 1 the misses fan out over a process pool — each worker
+    # re-parses its file and returns finding dicts; merging back in walk
+    # order keeps the output byte-identical to the serial loop.
+    miss_results: Dict[str, List[dict]] = {}
+    if use_jobs > 1:
+        from concurrent.futures import ProcessPoolExecutor
+
+        rule_names = tuple(r.name for r in local_rules)
+        payload = [(p, sources[p], rule_names) for p in misses]
+        with ProcessPoolExecutor(max_workers=use_jobs) as pool:
+            for fpath, dicts in pool.map(_lint_file_worker, payload):
+                miss_results[fpath] = dicts
+    else:
+        for fpath in misses:
+            out: List[Finding] = []
+            err = parse_errors.get(fpath)
+            if err is not None:
+                out.append(err)
+            ctx = contexts.get(fpath)
+            if ctx is not None:
+                out.extend(_check_file(ctx, local_rules))
+            miss_results[fpath] = [f.as_dict() for f in out]
     for fpath in misses:
-        out: List[Finding] = []
-        err = parse_errors.get(fpath)
-        if err is not None:
-            out.append(err)
-        ctx = contexts.get(fpath)
-        if ctx is not None:
-            out.extend(_check_file(ctx, local_rules))
-        cache.put_file(fpath, shas[fpath], local_key, [f.as_dict() for f in out])
-        local_findings.extend(out)
+        dicts = miss_results[fpath]
+        cache.put_file(fpath, shas[fpath], local_key, dicts)
+        local_findings.extend(Finding(**d) for d in dicts)
 
     # whole-program pass when anything changed (same dispatch as _run)
     if global_rules and project_state == "rebuilt":
@@ -1469,6 +1528,7 @@ def _lint_paths_cached(
         stats["cache_hits"] = hits
         stats["cache_misses"] = len(misses)
         stats["project_pass"] = project_state
+        stats["jobs"] = use_jobs
     return findings
 
 
@@ -1486,7 +1546,8 @@ def render_human(findings: List[Finding], stats: Optional[dict] = None) -> str:
             tail += (
                 f" [cache: {stats['cache_hits']} hit / "
                 f"{stats.get('cache_misses', 0)} miss, project pass "
-                f"{stats.get('project_pass', 'n/a')}]"
+                f"{stats.get('project_pass', 'n/a')}, "
+                f"{stats.get('jobs', 1)} worker(s)]"
             )
     lines.append(tail)
     return "\n".join(lines)

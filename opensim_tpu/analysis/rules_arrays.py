@@ -1,0 +1,261 @@
+"""OSL18xx — the array-contract rule pack.
+
+Built on :mod:`analysis.arrays` (abstract interpretation of numpy/jax
+values over the dataflow CFGs, checked against the contract registry in
+``encoding/dtypes.py``) and :mod:`analysis.abi`. Four rules:
+
+- **OSL1801 array-off-policy** — an array created without (or with a
+  non-policy) dtype reaches an ``EncodedCluster``/``ScanState``/
+  ``NodeArenas`` field or a kernel-entry argument whose contract declares
+  a different width. The finding anchors at the creation site (the
+  ``np.zeros``/``np.asarray``/literal without a ``dtype=`` from
+  ``encoding/dtypes.py``), interprocedurally when the array crosses a
+  function boundary before binding.
+
+- **OSL1802 silent-upcast** — a dtype promotion (mixed-width binop,
+  ``np.where``, int true-division, float ufunc on ints, numpy's i64
+  ``sum`` accumulator) on a path that reaches an arena write or kernel
+  boundary whose contract is narrower. Anchors at the promotion site: the
+  exact expression where float32 silently became float64.
+
+- **OSL1803 shape-contract** — rank or named-axis-order mismatch against
+  the declared ``(dtype, axes)`` contract at a binding site; axis names
+  are the symbolic shape vocabulary from ``encoding/state.py`` with the
+  builder-local aliases in ``AXIS_ALIASES``. Unknown axes (``?``) never
+  fire — only a known-vs-known mismatch does.
+
+- **OSL1804 contract-abi-parity** — the three-way sync: the contract
+  registry in ``encoding/dtypes.py`` vs the policy constants it names vs
+  the ``EncodedCluster``/``ScanState`` field sets vs the native
+  ``_BUFFERS`` packing and the C++ ``ScanArgs`` widths. OSL1604 gates
+  scan_engine.cc against the ctypes mirror; this rule closes the
+  remaining drift axis — BOTH native sides narrowed while the Python
+  contract stays wide (or vice versa) — naming the exact field.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, Optional, Tuple
+
+from . import abi
+from .abi import _module_lists
+from .arrays import Contracts, _parse_dtypes_module, get_array_findings
+from .core import FileContext, Finding, ProjectContext, Rule, register
+
+
+@dataclass
+class _Site:
+    lineno: int
+    col_offset: int
+
+
+_SCOPE_PATHS = ("encoding/", "engine/", "parallel/", "native/", "ops/")
+
+
+class _ArrayRuleBase(Rule):
+    project_rule = True
+    paths = _SCOPE_PATHS
+    exclude_paths = ("tests/",)
+
+    def project_check(self, project: ProjectContext) -> Iterable[Finding]:
+        for f in get_array_findings(project):
+            if f.code == self.code:
+                yield self.finding(f.path, _Site(f.line, f.col), f.message)
+
+
+@register
+class OffPolicyArrayRule(_ArrayRuleBase):
+    name = "array-off-policy"
+    code = "OSL1801"
+    description = (
+        "array built without a policy dtype (encoding/dtypes.py) reaches a "
+        "contracted arena field or kernel boundary of a different width"
+    )
+
+
+@register
+class SilentUpcastRule(_ArrayRuleBase):
+    name = "silent-upcast"
+    code = "OSL1802"
+    description = (
+        "dtype promotion on a path reaching an arena write or kernel "
+        "boundary whose contract is narrower (interprocedural)"
+    )
+
+
+@register
+class ShapeContractRule(_ArrayRuleBase):
+    name = "shape-contract"
+    code = "OSL1803"
+    description = (
+        "rank/axis-order mismatch against the declared (dtype, axes) "
+        "contract at an arena or kernel binding"
+    )
+
+
+def _compatible(tag: str, width: str) -> bool:
+    """Contract tag vs marshalled width. bool masks cross the ctypes
+    boundary as u8 (``np.bool_`` is 1 byte) — that pairing is the one
+    sanctioned widening."""
+    return width == tag or (tag == "bool" and width == "u8")
+
+
+@register
+class ContractAbiParityRule(Rule):
+    name = "contract-abi-parity"
+    code = "OSL1804"
+    description = (
+        "contract registry, dtypes policy, EncodedCluster/ScanState fields, "
+        "native packing and C++ ScanArgs widths drifted out of three-way sync"
+    )
+    project_rule = True
+
+    def project_check(self, project: ProjectContext) -> Iterable[Finding]:
+        dtypes_ctx: Optional[FileContext] = None
+        state_ctx: Optional[FileContext] = None
+        native_ctx: Optional[FileContext] = None
+        for ctx in project.contexts:
+            p = ctx.path.replace(os.sep, "/")
+            if p.endswith("encoding/dtypes.py"):
+                dtypes_ctx = ctx
+            elif p.endswith("encoding/state.py"):
+                state_ctx = ctx
+            elif p.endswith("native/__init__.py"):
+                native_ctx = ctx
+        if dtypes_ctx is None:
+            return
+        con = _parse_dtypes_module(dtypes_ctx.tree, dtypes_ctx.path)
+        if not con.arena and not con.state:
+            return  # a dtypes.py predating the registry: nothing to gate
+
+        def anchor(fname: str) -> _Site:
+            return _Site(con.entry_lines.get(fname, 1), 0)
+
+        for msg in con.problems:
+            yield self.finding(dtypes_ctx.path, _Site(1, 0),
+                               f"contract registry parse problem: {msg}")
+
+        # 1. every contract names a policy constant that resolves
+        for table_name, table in (("ARENA_CONTRACTS", con.arena),
+                                  ("STATE_CONTRACTS", con.state)):
+            for fname, (policy, _axes) in table.items():
+                if policy not in con.policies:
+                    yield self.finding(
+                        dtypes_ctx.path, anchor(fname),
+                        f"{table_name}[{fname!r}] names `{policy}`, which is "
+                        "not a *_DTYPE policy constant in encoding/dtypes.py",
+                    )
+        for fn, params in con.kernel_args.items():
+            for pname, (policy, _axes) in params.items():
+                if policy not in con.policies:
+                    yield self.finding(
+                        dtypes_ctx.path, _Site(1, 0),
+                        f"KERNEL_ARG_CONTRACTS[{fn!r}][{pname!r}] names "
+                        f"`{policy}`, which is not a *_DTYPE policy constant",
+                    )
+
+        # 2. registry key sets == the NamedTuple field sets
+        if state_ctx is not None:
+            yield from self._check_fields(dtypes_ctx, state_ctx, con, anchor)
+
+        # 3. native packing + C++ ScanArgs widths vs the contract tags
+        if native_ctx is not None:
+            yield from self._check_native(dtypes_ctx, native_ctx, con, anchor)
+
+    # -- registry keys vs encoding/state.py -----------------------------------
+
+    def _check_fields(self, dtypes_ctx, state_ctx, con: Contracts, anchor):
+        import ast
+
+        for cls_name, table, table_name in (
+            ("EncodedCluster", con.arena, "ARENA_CONTRACTS"),
+            ("ScanState", con.state, "STATE_CONTRACTS"),
+        ):
+            fields = None
+            for node in ast.walk(state_ctx.tree):
+                if isinstance(node, ast.ClassDef) and node.name == cls_name:
+                    fields = [
+                        item.target.id
+                        for item in node.body
+                        if isinstance(item, ast.AnnAssign)
+                        and isinstance(item.target, ast.Name)
+                    ]
+                    break
+            if fields is None:
+                continue
+            for fname in fields:
+                if fname not in table:
+                    yield self.finding(
+                        dtypes_ctx.path, _Site(1, 0),
+                        f"{cls_name} field `{fname}` (encoding/state.py) has "
+                        f"no {table_name} entry — every arena field must "
+                        "declare its (policy dtype, axes) contract",
+                    )
+            for fname in table:
+                if fname not in fields:
+                    yield self.finding(
+                        dtypes_ctx.path, anchor(fname),
+                        f"{table_name} entry `{fname}` names no {cls_name} "
+                        "field (stale contract after a field removal/rename?)",
+                    )
+
+    # -- native packing + C++ widths vs contracts ------------------------------
+
+    def _contract_for(self, con: Contracts, buf_name: str) -> Optional[Tuple[str, str]]:
+        """(policy name, resolved tag) for a native buffer name, or None
+        when the buffer carries no Python-side contract (outputs,
+        profile/debug arrays)."""
+        fname = con.buffer_aliases.get(buf_name, buf_name)
+        entry = con.arena.get(fname) or con.state.get(fname)
+        if entry is None:
+            for params in con.kernel_args.values():
+                if fname in params:
+                    entry = params[fname]
+                    break
+        if entry is None:
+            return None
+        policy = entry[0]
+        tag = con.policies.get(policy)
+        return (policy, tag) if tag is not None else None
+
+    def _check_native(self, dtypes_ctx, native_ctx, con: Contracts, anchor):
+        buffers = _module_lists(native_ctx.tree).get("_BUFFERS", [])
+        for item in buffers:
+            if not isinstance(item, tuple):
+                continue
+            buf_name, width = item
+            got = self._contract_for(con, buf_name)
+            if got is None:
+                continue
+            policy, tag = got
+            if not _compatible(tag, width):
+                yield self.finding(
+                    dtypes_ctx.path, anchor(con.buffer_aliases.get(buf_name, buf_name)),
+                    f"contract-ABI width drift: `{buf_name}` is contracted "
+                    f"{policy} ({tag}) but native/__init__.py packs it as "
+                    f"{width} — narrow/widen the contract and the native "
+                    "packing together",
+                )
+        cc_path = os.path.join(os.path.dirname(native_ctx.path), "scan_engine.cc")
+        if not os.path.isfile(cc_path):
+            return
+        with open(cc_path, "r", encoding="utf-8") as fh:
+            cc_fields, _problems = abi.parse_cc_struct(fh.read())
+        for cc_name, kind in cc_fields:
+            if not kind.startswith("ptr:"):
+                continue  # scalar dims/weights carry no array contract
+            width = kind[len("ptr:"):]
+            got = self._contract_for(con, cc_name)
+            if got is None:
+                continue
+            policy, tag = got
+            if not _compatible(tag, width):
+                yield self.finding(
+                    dtypes_ctx.path, anchor(con.buffer_aliases.get(cc_name, cc_name)),
+                    f"contract-ABI width drift: `{cc_name}` is contracted "
+                    f"{policy} ({tag}) but C++ ScanArgs (scan_engine.cc) "
+                    f"declares {kind} — the contract registry and the native "
+                    "engine disagree on this field's width",
+                )

@@ -358,7 +358,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     lint_p = sub.add_parser(
         "lint",
-        help="run the opensim-lint static analyzer (22 OSL rules)",
+        help="run the opensim-lint static analyzer (27 OSL rules)",
         description=(
             "repo-specific static analyzer (docs/static-analysis.md): AST "
             "rules, whole-program lock-discipline checks, and the "
@@ -389,6 +389,16 @@ def build_parser() -> argparse.ArgumentParser:
     lint_p.add_argument(
         "--corpus", default="", metavar="DIR",
         help="run the detector-awake fixture gate over DIR after linting",
+    )
+    lint_p.add_argument(
+        "--changed", action="store_true",
+        help="lint only files with uncommitted git changes (the fast "
+        "pre-commit loop)",
+    )
+    lint_p.add_argument(
+        "--jobs", type=int, default=None, metavar="N",
+        help="process-pool width for the per-file rule tier (default: "
+        "auto; 1 = serial)",
     )
 
     sub.add_parser("version", help="print version", description="print version and commit id")
@@ -576,6 +586,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             argv2 += ["--sarif-out", args.sarif_out]
         if args.corpus:
             argv2 += ["--corpus", args.corpus]
+        if args.changed:
+            argv2.append("--changed")
+        if args.jobs is not None:
+            argv2 += ["--jobs", str(args.jobs)]
         return lint_main(argv2)
     if args.command == "gen-doc":
         try:

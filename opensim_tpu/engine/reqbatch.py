@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Union
 import jax
 import numpy as np
 
+from ..encoding.state import EncodedCluster, ScanState
 from ..models.objects import ResourceTypes
 from ..obs import trace as obs
 from ..resilience.deadline import Deadline, DeadlineExceeded
@@ -104,7 +105,8 @@ def batch_engine_mode() -> str:
 
 
 @functools.partial(jax.jit, static_argnames=("features", "unroll", "explain"))
-def _batched_schedule(ec, st0, tmpl_ids, pod_valid_masks, forced, features, unroll,
+def _batched_schedule(ec: EncodedCluster, st0: ScanState, tmpl_ids,
+                      pod_valid_masks, forced, features, unroll,
                       explain=False):
     """ALL requests in ONE compiled dispatch: ``jax.vmap`` over the
     per-request pod-validity masks prepends a request axis to the scan

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from ..encoding import vocab as V
+from ..encoding.state import EncodedCluster, ScanState
 
 MAX_NODE_SCORE = 100.0
 
@@ -510,7 +511,7 @@ class StaticTables(NamedTuple):
     spread_weight: jnp.ndarray  # [Tk] f32 log(domain count + 2) per topology key
 
 
-def precompute_static(ec, cfg=None) -> StaticTables:  # opensim-lint: jit-region
+def precompute_static(ec: EncodedCluster, cfg=None) -> StaticTables:  # opensim-lint: jit-region
     """NodeName pinning is handled by the forced-bind path in the scan step
     (pods with spec.nodeName never reach the scheduler, reference
     simulator.go:329-331), so the pin filter is NOT part of static_pass —
@@ -750,7 +751,7 @@ def precompute_core_np(ec):
     }
 
 
-def precompute_static_np(ec, cfg=None, core=None) -> StaticTables:
+def precompute_static_np(ec: EncodedCluster, cfg=None, core=None) -> StaticTables:
     """Numpy mirror of :func:`precompute_static`, op-for-op in float32, so
     the native C++ path builds its static tables with ZERO XLA compiles
     (``--backend native`` must stay ms-scale cold — a 4.7 s precompute
@@ -979,7 +980,8 @@ def score_parts(
 
 
 def pod_step(  # opensim-lint: jit-region
-    ec, stat: StaticTables, st, u, feat: Features = ALL_FEATURES, cfg=None, extra: tuple = (),
+    ec: EncodedCluster, stat: StaticTables, st: ScanState, u,
+    feat: Features = ALL_FEATURES, cfg=None, extra: tuple = (),
     count_all: bool = False,
 ) -> StepResult:
     """One pod through the full pipeline. Mirrors scheduleOne
@@ -1085,7 +1087,8 @@ def pod_step(  # opensim-lint: jit-region
     )
 
 
-def bind_update(ec, st, u, node, apply, feat: Features = ALL_FEATURES):  # opensim-lint: jit-region
+def bind_update(ec: EncodedCluster, st: ScanState, u, node, apply,
+                feat: Features = ALL_FEATURES):  # opensim-lint: jit-region
     """State transition on bind — the tensorized equivalent of the Reserve +
     Bind plugin chain writing back into the fake clientset
     (plugin/simon.go:104-126, open-gpu-share.go:147-245, open-local.go:175-254).

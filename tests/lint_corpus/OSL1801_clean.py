@@ -1,0 +1,12 @@
+# lint-corpus-path: opensim_tpu/encoding/fixture_osl1801.py
+"""Clean: the same binding with the policy dtype named at creation."""
+
+import numpy as np
+
+from opensim_tpu.encoding.dtypes import FLOAT_DTYPE
+from opensim_tpu.encoding.state import EncodedCluster
+
+
+def build(n, r):
+    alloc = np.zeros((n, r), dtype=FLOAT_DTYPE)
+    return EncodedCluster(alloc=alloc)

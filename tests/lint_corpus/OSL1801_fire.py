@@ -1,0 +1,14 @@
+# lint-corpus-path: opensim_tpu/encoding/fixture_osl1801.py
+"""Fire: an array built without a policy dtype reaches a contracted
+arena field. ``np.zeros`` defaults to float64; ``EncodedCluster.alloc``
+is contracted FLOAT_DTYPE (float32). The finding anchors at the
+creation site, not the constructor."""
+
+import numpy as np
+
+from opensim_tpu.encoding.state import EncodedCluster
+
+
+def build(n, r):
+    alloc = np.zeros((n, r))  # no dtype= -> numpy f64, off policy
+    return EncodedCluster(alloc=alloc)

@@ -1,0 +1,18 @@
+# lint-corpus-path: opensim_tpu/encoding/fixture_osl1802.py
+"""Clean: the index factor is created at the policy float width, so the
+product stays f32 end to end."""
+
+import numpy as np
+
+from opensim_tpu.encoding.dtypes import FLOAT_DTYPE
+from opensim_tpu.encoding.state import EncodedCluster
+
+
+def mix(n, r):
+    a = np.zeros((n, r), dtype=FLOAT_DTYPE)
+    idx = np.arange(n, dtype=FLOAT_DTYPE)
+    return a * idx.reshape((n, 1))
+
+
+def build(n, r):
+    return EncodedCluster(alloc=mix(n, r))

@@ -1,0 +1,20 @@
+# lint-corpus-path: opensim_tpu/encoding/fixture_osl1802.py
+"""Fire: a silent f32 x i64 -> f64 promotion inside a helper reaches
+``EncodedCluster.alloc`` (contract FLOAT_DTYPE = f32) through the
+helper's return value — the interprocedural case. The finding anchors
+at the multiplication, not at the constructor."""
+
+import numpy as np
+
+from opensim_tpu.encoding.dtypes import FLOAT_DTYPE
+from opensim_tpu.encoding.state import EncodedCluster
+
+
+def mix(n, r):
+    a = np.zeros((n, r), dtype=FLOAT_DTYPE)
+    idx = np.arange(n)  # numpy default: i64
+    return a * idx.reshape((n, 1))  # f32 x i64 -> f64, silently
+
+
+def build(n, r):
+    return EncodedCluster(alloc=mix(n, r))

@@ -1,0 +1,14 @@
+# lint-corpus-path: opensim_tpu/encoding/fixture_osl1803.py
+"""Clean: the binding's symbolic shape ``(n, r)`` normalizes to the
+contracted axes ``(N, R)`` (axis matching is case-insensitive over the
+vocabulary the contracts declare)."""
+
+import numpy as np
+
+from opensim_tpu.encoding.dtypes import FLOAT_DTYPE
+from opensim_tpu.encoding.state import EncodedCluster
+
+
+def build(n, r):
+    alloc = np.zeros((n, r), dtype=FLOAT_DTYPE)
+    return EncodedCluster(alloc=alloc)

@@ -1,0 +1,14 @@
+# lint-corpus-path: opensim_tpu/encoding/fixture_osl1803.py
+"""Fire: rank mismatch against the declared axes. ``EncodedCluster.alloc``
+is contracted ``(N, R)`` — rank 2 — but the binding supplies a rank-1
+array."""
+
+import numpy as np
+
+from opensim_tpu.encoding.dtypes import FLOAT_DTYPE
+from opensim_tpu.encoding.state import EncodedCluster
+
+
+def build(n):
+    alloc = np.zeros((n,), dtype=FLOAT_DTYPE)  # rank 1, contract wants (N, R)
+    return EncodedCluster(alloc=alloc)

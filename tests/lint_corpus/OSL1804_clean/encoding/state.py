@@ -1,0 +1,14 @@
+"""Corpus mini arena structs — field sets mirror the registry keys."""
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class EncodedCluster(NamedTuple):
+    alloc: np.ndarray
+    node_domain: np.ndarray
+
+
+class ScanState(NamedTuple):
+    used: np.ndarray

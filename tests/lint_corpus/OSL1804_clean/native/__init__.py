@@ -1,0 +1,12 @@
+"""Corpus mini native packing — widths match the contract registry."""
+
+import ctypes
+
+_F32 = ctypes.POINTER(ctypes.c_float)
+_I32 = ctypes.POINTER(ctypes.c_int32)
+
+_BUFFERS = [
+    ("alloc", _F32, "f32"),
+    ("node_domain", _I32, "i32"),
+    ("used", _F32, "f32"),
+]

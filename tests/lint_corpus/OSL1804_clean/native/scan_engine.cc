@@ -1,0 +1,7 @@
+// Corpus mini engine source — widths match the contract registry.
+struct ScanArgs {
+  int64_t N, R, Tk;
+  const float* alloc;          // [N,R]
+  const int32_t* node_domain;  // [N,Tk]
+  float* used;                 // [N,R]
+};

@@ -1,0 +1,29 @@
+"""Corpus mini contract registry (OSL1804 fire fixture).
+
+``node_domain`` is contracted INT_DTYPE (i32), but BOTH native sides in
+this fixture tree marshal it as i64 — the drift axis OSL1604 cannot see
+(the ctypes mirror and the C++ struct agree with each other)."""
+
+import numpy as np
+
+FLOAT_DTYPE = np.float32
+INT_DTYPE = np.int32
+
+AXIS_ALIASES = {
+    "n_topo": "Tk",
+}
+
+ARENA_CONTRACTS = {
+    "alloc": ("FLOAT_DTYPE", ("N", "R")),
+    "node_domain": ("INT_DTYPE", ("N", "Tk")),
+}
+
+STATE_CONTRACTS = {
+    "used": ("FLOAT_DTYPE", ("N", "R")),
+}
+
+BUFFER_FIELD_ALIASES = {}
+
+KERNEL_ARG_CONTRACTS = {}
+
+STRUCT_PARAM_NAMES = {}

@@ -1,0 +1,14 @@
+"""Corpus mini native packing — node_domain drifted wide (i64) on both
+the ctypes mirror and the C++ struct next door, consistently, while the
+contract registry still says INT_DTYPE (i32)."""
+
+import ctypes
+
+_F32 = ctypes.POINTER(ctypes.c_float)
+_I64 = ctypes.POINTER(ctypes.c_int64)
+
+_BUFFERS = [
+    ("alloc", _F32, "f32"),
+    ("node_domain", _I64, "i64"),
+    ("used", _F32, "f32"),
+]

@@ -1,0 +1,9 @@
+// Corpus mini engine source — agrees with the drifted mirror (i64), so
+// OSL1604's cc-vs-mirror comparison stays green; only the contract
+// registry knows the field should be i32.
+struct ScanArgs {
+  int64_t N, R, Tk;
+  const float* alloc;          // [N,R]
+  const int64_t* node_domain;  // [N,Tk]
+  float* used;                 // [N,R]
+};

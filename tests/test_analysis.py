@@ -375,6 +375,16 @@ def test_pyproject_defaults_are_read():
     assert "jit-boundary" in cfg.get("rules", [])
 
 
+def test_pyproject_rules_list_covers_every_registered_rule():
+    # the [tool.opensim-lint] rules array is the default selection for
+    # `make lint`: a registered rule missing from it silently never runs
+    from opensim_tpu.analysis import RULES
+    from opensim_tpu.analysis.__main__ import pyproject_defaults
+
+    cfg = pyproject_defaults(os.path.join(REPO, "pyproject.toml"))
+    assert sorted(cfg.get("rules", [])) == sorted(RULES)
+
+
 def test_cache_mutation_release_is_per_object():
     # review fix: invalidate(cluster) must NOT silence the apps mutation
     src = """

@@ -1,0 +1,238 @@
+"""OSL18xx array-contract engine: promotion-table parity with numpy/jax,
+firing and precision of the off-policy/upcast/shape rules, and the
+anchoring contract (creation site / promotion site / binding site)."""
+
+import numpy as np
+import pytest
+
+from opensim_tpu.analysis import lint_source
+from opensim_tpu.analysis.arrays import npname_to_tag, promote, promote_weak
+
+ENC_PATH = "opensim_tpu/encoding/fixture_arrays.py"
+
+_TAG_TO_NP = {
+    "bool": np.bool_,
+    "u8": np.uint8,
+    "i32": np.int32,
+    "i64": np.int64,
+    "f32": np.float32,
+    "f64": np.float64,
+}
+
+
+# -- promotion tables vs the real libraries --------------------------------
+
+
+@pytest.mark.parametrize("a", sorted(_TAG_TO_NP))
+@pytest.mark.parametrize("b", sorted(_TAG_TO_NP))
+def test_numpy_promotion_table_matches_result_type(a, b):
+    want = npname_to_tag(np.result_type(_TAG_TO_NP[a], _TAG_TO_NP[b]).name)
+    assert promote(a, b, jax_sem=False) == want
+
+
+@pytest.mark.parametrize("a", sorted(_TAG_TO_NP))
+@pytest.mark.parametrize("b", sorted(_TAG_TO_NP))
+def test_jax_promotion_table_matches_promote_types(a, b):
+    jnp = pytest.importorskip("jax.numpy")
+    want = npname_to_tag(np.dtype(jnp.promote_types(_TAG_TO_NP[a], _TAG_TO_NP[b])).name)
+    assert promote(a, b, jax_sem=True) == want
+
+
+def test_weak_scalar_promotion():
+    # NEP-50: an int scalar never widens; a float scalar widens integer
+    # arrays to the default float (f64 numpy, f32 jax) and leaves floats
+    for tag in ("bool", "u8", "i32", "i64", "f32", "f64"):
+        assert promote_weak(tag, "int", jax_sem=False) == tag
+        assert promote_weak(tag, "int", jax_sem=True) == tag
+    assert promote_weak("i32", "float", jax_sem=False) == "f64"
+    assert promote_weak("i32", "float", jax_sem=True) == "f32"
+    assert promote_weak("f32", "float", jax_sem=False) == "f32"
+    assert promote_weak("f64", "float", jax_sem=True) == "f64"
+
+
+# -- firing / precision / anchoring ----------------------------------------
+
+
+def _codes(src, rules=("array-off-policy", "silent-upcast", "shape-contract")):
+    return [(f.code, f.line) for f in lint_source(src, path=ENC_PATH, rules=rules)]
+
+
+def test_off_policy_creation_fires_at_creation_site():
+    src = (
+        "import numpy as np\n"
+        "from opensim_tpu.encoding.state import EncodedCluster\n"
+        "def build(n, r):\n"
+        "    alloc = np.zeros((n, r))\n"  # line 4: f64 by default
+        "    return EncodedCluster(alloc=alloc)\n"
+    )
+    assert _codes(src) == [("OSL1801", 4)]
+
+
+def test_policy_dtype_is_clean():
+    src = (
+        "import numpy as np\n"
+        "from opensim_tpu.encoding.dtypes import FLOAT_DTYPE\n"
+        "from opensim_tpu.encoding.state import EncodedCluster\n"
+        "def build(n, r):\n"
+        "    return EncodedCluster(alloc=np.zeros((n, r), dtype=FLOAT_DTYPE))\n"
+    )
+    assert _codes(src) == []
+
+
+def test_off_policy_kernel_argument_fires():
+    # np.arange defaults to i64; tmpl_ids is contracted INT_DTYPE (i32)
+    src = (
+        "import numpy as np\n"
+        "from opensim_tpu.ops.kernels import schedule_pods\n"
+        "def drive(ec, st0, p):\n"
+        "    ids = np.arange(p)\n"  # line 4
+        "    return schedule_pods(ec, st0, tmpl_ids=ids)\n"
+    )
+    assert _codes(src, rules=("array-off-policy",)) == [("OSL1801", 4)]
+
+
+def test_silent_upcast_fires_at_promotion_site_interprocedurally():
+    src = (
+        "import numpy as np\n"
+        "from opensim_tpu.encoding.dtypes import FLOAT_DTYPE\n"
+        "from opensim_tpu.encoding.state import EncodedCluster\n"
+        "def mix(n, r):\n"
+        "    a = np.zeros((n, r), dtype=FLOAT_DTYPE)\n"
+        "    idx = np.arange(n)\n"
+        "    return a * idx.reshape((n, 1))\n"  # line 7: f32 x i64 -> f64
+        "def build(n, r):\n"
+        "    return EncodedCluster(alloc=mix(n, r))\n"
+    )
+    findings = lint_source(src, path=ENC_PATH, rules=("silent-upcast",))
+    assert [(f.code, f.line) for f in findings] == [("OSL1802", 7)]
+    assert "f32 x i64 -> f64" in findings[0].message
+    assert "EncodedCluster.alloc" in findings[0].message
+
+
+def test_jax_semantics_do_not_flag_numpy_only_promotions():
+    # under jax.numpy, i-array x f32-array stays f32: no upcast to report
+    src = (
+        "import jax.numpy as jnp\n"
+        "from opensim_tpu.encoding.dtypes import FLOAT_DTYPE\n"
+        "from opensim_tpu.encoding.state import EncodedCluster\n"
+        "def mix(n, r):\n"
+        "    a = jnp.zeros((n, r), dtype=FLOAT_DTYPE)\n"
+        "    idx = jnp.arange(n)\n"
+        "    return a * idx.reshape((n, 1))\n"
+        "def build(n, r):\n"
+        "    return EncodedCluster(alloc=mix(n, r))\n"
+    )
+    assert _codes(src, rules=("silent-upcast",)) == []
+
+
+def test_rank_mismatch_fires_at_binding():
+    src = (
+        "import numpy as np\n"
+        "from opensim_tpu.encoding.dtypes import FLOAT_DTYPE\n"
+        "from opensim_tpu.encoding.state import EncodedCluster\n"
+        "def build(n):\n"
+        "    alloc = np.zeros((n,), dtype=FLOAT_DTYPE)\n"
+        "    return EncodedCluster(alloc=alloc)\n"  # line 6: rank 1 vs (N, R)
+    )
+    assert _codes(src, rules=("shape-contract",)) == [("OSL1803", 6)]
+
+
+def test_axis_order_mismatch_fires():
+    src = (
+        "import numpy as np\n"
+        "from opensim_tpu.encoding.dtypes import FLOAT_DTYPE\n"
+        "from opensim_tpu.encoding.state import EncodedCluster\n"
+        "def build(n, r):\n"
+        "    alloc = np.zeros((r, n), dtype=FLOAT_DTYPE)\n"
+        "    return EncodedCluster(alloc=alloc)\n"  # (R, N) vs contract (N, R)
+    )
+    assert _codes(src, rules=("shape-contract",)) == [("OSL1803", 6)]
+
+
+def test_matching_symbolic_axes_are_clean():
+    src = (
+        "import numpy as np\n"
+        "from opensim_tpu.encoding.dtypes import FLOAT_DTYPE\n"
+        "from opensim_tpu.encoding.state import EncodedCluster\n"
+        "def build(n, r):\n"
+        "    alloc = np.zeros((n, r), dtype=FLOAT_DTYPE)\n"
+        "    return EncodedCluster(alloc=alloc)\n"
+    )
+    assert _codes(src) == []
+
+
+def test_unknown_dtype_and_shape_never_fire():
+    # precision over recall: a raw parameter has no known dtype or rank
+    src = (
+        "from opensim_tpu.encoding.state import EncodedCluster\n"
+        "def build(some_array):\n"
+        "    return EncodedCluster(alloc=some_array)\n"
+    )
+    assert _codes(src) == []
+
+
+def test_scope_excludes_non_pipeline_files():
+    # same defect under cli/: outside the arena pipeline scope, no finding
+    src = (
+        "import numpy as np\n"
+        "from opensim_tpu.encoding.state import EncodedCluster\n"
+        "def build(n, r):\n"
+        "    return EncodedCluster(alloc=np.zeros((n, r)))\n"
+    )
+    assert lint_source(src, path="opensim_tpu/cli/fixture_arrays.py",
+                       rules=("array-off-policy",)) == []
+
+
+def test_where_promotes_branches_and_fires_silent_upcast():
+    # np.where(mask, f32, i64) promotes to f64 under numpy semantics
+    src = (
+        "import numpy as np\n"
+        "from opensim_tpu.encoding.dtypes import FLOAT_DTYPE\n"
+        "from opensim_tpu.encoding.state import EncodedCluster\n"
+        "def build(n, r, mask):\n"
+        "    a = np.zeros((n, r), dtype=FLOAT_DTYPE)\n"
+        "    b = np.arange(n).reshape((n, 1))\n"
+        "    alloc = np.where(mask, a, b)\n"  # line 7: f32 x i64 -> f64
+        "    return EncodedCluster(alloc=alloc)\n"
+    )
+    findings = lint_source(src, path=ENC_PATH, rules=("silent-upcast",))
+    assert [(f.code, f.line) for f in findings] == [("OSL1802", 7)]
+    assert "f32 x i64 -> f64" in findings[0].message
+
+
+def test_frombuffer_view_tracks_through_chained_reshape():
+    # frombuffer defaults to f64; the chained .reshape must not launder it
+    src = (
+        "import numpy as np\n"
+        "from opensim_tpu.encoding.state import EncodedCluster\n"
+        "def build(buf, n, r):\n"
+        "    alloc = np.frombuffer(buf).reshape((n, r))\n"  # line 4
+        "    return EncodedCluster(alloc=alloc)\n"
+    )
+    assert _codes(src, rules=("array-off-policy",)) == [("OSL1801", 4)]
+    # an explicit astype to the policy dtype sanctions the same chain
+    clean = (
+        "import numpy as np\n"
+        "from opensim_tpu.encoding.dtypes import FLOAT_DTYPE\n"
+        "from opensim_tpu.encoding.state import EncodedCluster\n"
+        "def build(buf, n, r):\n"
+        "    alloc = np.frombuffer(buf).astype(FLOAT_DTYPE).reshape((n, r))\n"
+        "    return EncodedCluster(alloc=alloc)\n"
+    )
+    assert _codes(clean) == []
+
+
+def test_integer_index_drops_leading_axis():
+    # big[(K, N, R)][0] -> (N, R): matches the alloc contract, stays clean
+    src = (
+        "import numpy as np\n"
+        "from opensim_tpu.encoding.dtypes import FLOAT_DTYPE\n"
+        "from opensim_tpu.encoding.state import EncodedCluster\n"
+        "def build(k, n, r):\n"
+        "    big = np.zeros((k, n, r), dtype=FLOAT_DTYPE)\n"
+        "    return EncodedCluster(alloc=big[0])\n"
+    )
+    assert _codes(src, rules=("shape-contract",)) == []
+    # without the index the rank-3 value violates the (N, R) contract
+    fire = src.replace("big[0]", "big")
+    assert _codes(fire, rules=("shape-contract",)) == [("OSL1803", 6)]

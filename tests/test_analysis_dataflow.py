@@ -19,7 +19,8 @@ def _codes(src, path=FIX, rules=None):
 def test_osl16xx_registered():
     by_code = {r.code for r in RULES.values()}
     assert {"OSL1601", "OSL1602", "OSL1603", "OSL1604"} <= by_code
-    assert len(RULES) == 23
+    assert {"OSL1801", "OSL1802", "OSL1803", "OSL1804"} <= by_code
+    assert len(RULES) == 27
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,120 @@
+"""OSL1804 three-way-sync regression matrix (detector-awake for the
+contract-ABI parity pass): copies of the REAL registry + native sources
+are mutated one axis at a time — contract width, policy constant value,
+both native sides at once, dropped/stale registry entries — and the rule
+must fire naming the exact field; the unmutated copies must stay green.
+
+The both-native-sides mutation is the axis OSL1604 is blind to by
+construction (the ctypes mirror and the C++ struct still agree with each
+other); this matrix proves OSL1804 covers it."""
+
+import os
+import shutil
+
+from opensim_tpu.analysis import lint_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "opensim_tpu")
+
+
+def _stage(tmp_path, mutate=None):
+    """Copy the real registry, arena structs and native sources into tmp
+    preserving the path suffixes the rule locates them by."""
+    root = os.path.join(str(tmp_path), "staged")
+    os.makedirs(os.path.join(root, "encoding"))
+    os.makedirs(os.path.join(root, "native"))
+    for rel in ("encoding/dtypes.py", "encoding/state.py",
+                "native/__init__.py", "native/scan_engine.cc"):
+        shutil.copy(os.path.join(PKG, rel), os.path.join(root, rel))
+    if mutate is not None:
+        mutate(root)
+    return root
+
+
+def _findings(root, rules=("contract-abi-parity",)):
+    return lint_paths([root], rules=list(rules))
+
+
+def _edit(root, rel, old, new, count=1):
+    path = os.path.join(root, rel)
+    with open(path) as fh:
+        src = fh.read()
+    assert old in src, f"mutation anchor {old!r} missing from {rel}"
+    with open(path, "w") as fh:
+        fh.write(src.replace(old, new, count))
+
+
+def test_real_sources_are_green(tmp_path):
+    assert _findings(_stage(tmp_path)) == []
+
+
+def test_contract_widened_against_native_fires_on_both_sides(tmp_path):
+    # registry says i64, mirror and C++ still pack i32: one finding per
+    # native side, each naming the field
+    root = _stage(tmp_path)
+    _edit(root, "encoding/dtypes.py",
+          '"node_domain": ("INT_DTYPE", ("N", "Tk")),',
+          '"node_domain": ("INT64_DTYPE", ("N", "Tk")),')
+    findings = _findings(root)
+    assert [f.code for f in findings] == ["OSL1804", "OSL1804"]
+    for f in findings:
+        assert "width drift" in f.message and "`node_domain`" in f.message
+
+
+def test_policy_constant_narrowed_fires_for_every_contracted_field(tmp_path):
+    # np.int32 -> np.int16 re-types EVERY INT_DTYPE contract at once; the
+    # native sides still pack i32
+    root = _stage(tmp_path)
+    _edit(root, "encoding/dtypes.py", "INT_DTYPE = np.int32",
+          "INT_DTYPE = np.int16")
+    findings = _findings(root)
+    assert findings and all(f.code == "OSL1804" for f in findings)
+    assert len(findings) > 10  # every i32 buffer in the mirror + ScanArgs
+    assert any("`node_domain`" in f.message for f in findings)
+
+
+def test_both_native_sides_narrowed_fires_while_abi_parity_stays_green(tmp_path):
+    # the OSL1604 blind spot: mirror AND C++ both flip to u8, consistent
+    # with each other, while the contract stays INT_DTYPE (i32)
+    root = _stage(tmp_path)
+    _edit(root, "native/__init__.py", '("node_domain", _I32, "i32")',
+          '("node_domain", _U8, "u8")')
+    _edit(root, "native/scan_engine.cc", "const int32_t* node_domain;",
+          "const uint8_t* node_domain;")
+    assert _findings(root, rules=("abi-parity",)) == []  # 1604 cannot see it
+    findings = _findings(root)
+    assert [f.code for f in findings] == ["OSL1804", "OSL1804"]
+    msgs = " | ".join(f.message for f in findings)
+    assert "`node_domain`" in msgs and "u8" in msgs
+
+
+def test_dropped_contract_entry_fires_naming_the_field(tmp_path):
+    root = _stage(tmp_path)
+    _edit(root, "encoding/dtypes.py",
+          '    "node_domain": ("INT_DTYPE", ("N", "Tk")),\n', "")
+    findings = _findings(root)
+    assert findings and all(f.code == "OSL1804" for f in findings)
+    assert any("`node_domain`" in f.message
+               and "no ARENA_CONTRACTS entry" in f.message for f in findings)
+
+
+def test_stale_contract_entry_fires(tmp_path):
+    root = _stage(tmp_path)
+    _edit(root, "encoding/dtypes.py",
+          '    "node_domain": ("INT_DTYPE", ("N", "Tk")),',
+          '    "node_domain": ("INT_DTYPE", ("N", "Tk")),\n'
+          '    "ghost_field": ("INT_DTYPE", ("N",)),')
+    findings = _findings(root)
+    assert findings and all(f.code == "OSL1804" for f in findings)
+    assert any("`ghost_field`" in f.message and "names no EncodedCluster"
+               in f.message for f in findings)
+
+
+def test_unresolvable_policy_name_fires(tmp_path):
+    root = _stage(tmp_path)
+    _edit(root, "encoding/dtypes.py",
+          '"node_domain": ("INT_DTYPE", ("N", "Tk")),',
+          '"node_domain": ("MYSTERY_DTYPE", ("N", "Tk")),')
+    findings = _findings(root)
+    assert findings and all(f.code == "OSL1804" for f in findings)
+    assert any("MYSTERY_DTYPE" in f.message for f in findings)

@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan mem-smoke perf-guard campaign-smoke
+.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan mem-smoke perf-guard campaign-smoke ha-smoke
 
 all: test
 
@@ -33,7 +33,7 @@ mypy:
 # test_watch.py drives the live twin's watch faults (disconnect/410/lost
 # event) against the canned stub apiserver mid-stream (docs/live-twin.md)
 chaos:
-	python -m pytest tests/test_chaos.py tests/test_resilience.py tests/test_watch.py tests/test_journal.py -q
+	python -m pytest tests/test_chaos.py tests/test_resilience.py tests/test_watch.py tests/test_journal.py tests/test_ha.py -q
 
 # perf gate (ISSUE 4): a small affinity workload must engage the C++
 # engine's incremental cache AND match the forced-generic path bit-for-bit
@@ -110,6 +110,14 @@ perf-guard:
 campaign-smoke:
 	python tools/campaign_smoke.py
 
+# HA control-plane gate (ISSUE 18, docs/serving.md#surviving-owner-loss):
+# loadgen driven straight through an owner SIGKILL — the tailing standby
+# takes the fenced lease and adopts the surviving workers with ZERO client
+# errors, bit-identical placements, exactly one takeover, and no orphaned
+# /dev/shm segment after teardown
+ha-smoke:
+	python tools/ha_smoke.py
+
 # runtime lock-order sanitizer (docs/static-analysis.md#make-tsan): a
 # seeded A->B/B->A inversion must be caught (detector self-test), then the
 # threaded test modules run under instrumented locks — any observed
@@ -118,8 +126,8 @@ campaign-smoke:
 tsan:
 	python tools/tsan.py
 
-# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain + loadgen + capacity + replay + lock sanitizer + memory + perf trajectory + campaigns
-verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan mem-smoke perf-guard campaign-smoke
+# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain + loadgen + capacity + replay + lock sanitizer + memory + perf trajectory + campaigns + HA failover
+verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan mem-smoke perf-guard campaign-smoke ha-smoke
 
 # run the moment the TPU tunnel opens (tools/tpu_probe_loop.sh writes
 # /tmp/opensim-tpu-watch.up): compiled-Mosaic parity suite + full bench

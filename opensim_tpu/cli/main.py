@@ -201,6 +201,23 @@ def build_parser() -> argparse.ArgumentParser:
         "a cold relist. Requires the live twin (--kubeconfig, --watch "
         "auto|on)",
     )
+    server_p.add_argument(
+        "--standby", action="store_true",
+        help="run as the HA hot standby (docs/serving.md 'Surviving owner "
+        "loss & rolling upgrades'): tail the owner's --journal live onto "
+        "a private twin and take over the fleet — fenced by the lease "
+        "epoch, at a continuous generation, adopting the surviving "
+        "workers — when the owner's lease expires or is handed over. "
+        "Requires --journal and the live twin flags; the owner enables "
+        "HA with OPENSIM_HA=1",
+    )
+    server_p.add_argument(
+        "--handover", action="store_true",
+        help="with --standby: once the journal tail reaches parity, ask "
+        "the live owner to drain and hand the fleet over (zero-downtime "
+        "rolling upgrade); without it the standby only takes over when "
+        "the lease expires",
+    )
 
     loadgen_p = sub.add_parser(
         "loadgen",
@@ -520,6 +537,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             return serve(
                 kubeconfig=args.kubeconfig, master=args.master, port=args.port,
                 watch=args.watch, journal=args.journal, workers=args.workers,
+                standby=args.standby, ha_handover=args.handover,
             )
         except ValueError as e:
             # serve()'s path validators reject control characters

@@ -139,6 +139,12 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
     ),
     "simon_fleet_attach_generation": ("Twin generation this worker last attached", "gauge"),
     "simon_fleet_segment_reuse_total": ("Segments reused across generations at attach (content-keyed delta hits)", "counter"),
+    # HA control plane (server/fleet.py, docs/serving.md "Surviving owner
+    # loss & rolling upgrades") — reason ∈ {expired, handover}
+    "simon_fleet_takeovers_total": ("Standby-to-owner takeovers by reason (expired/handover)", "counter"),
+    "simon_fleet_standby_tail_lag_records": ("Journal records the standby drained at its last tail poll (how far it had fallen behind)", "gauge"),
+    "simon_fleet_lease_age_seconds": ("Seconds since the HA lease was last renewed", "gauge"),
+    "simon_fleet_fenced_writes_total": ("Publishes refused because the lease epoch moved (a deposed owner fenced out)", "counter"),
     # latency + decision audit (this module's RECORDER)
     "simon_phase_seconds": ("Per-phase latency from the request span trees", "histogram"),
     "simon_request_seconds": ("Whole-request latency by endpoint and outcome", "histogram"),

@@ -38,6 +38,18 @@ point                  fires inside
 ``journal.corrupt``    ``Journal.recover`` — recovery from a poisoned
                        journal must degrade to a full relist with a typed
                        warning, never crash the server
+``fleet.lease_steal``  ``FleetLease.check`` (``server/fleet.py``) — the HA
+                       lease is observed held by ANOTHER epoch: the owner
+                       must fence itself (stop publishing, demote) instead
+                       of split-braining
+``journal.tail_gap``   ``JournalTailer.poll`` (``server/journal.py``) — a
+                       drained batch is lost (the tailer fell off pruned
+                       history); the standby's twin diverges until the next
+                       checkpoint record rebases it back to truth
+``shm.republish``      ``TwinPublisher.publish`` between the segment writes
+                       and the seqlock control swap — a publish dies
+                       mid-flight; readers must keep serving the previous
+                       stable generation, never a torn one
 =====================  ======================================================
 
 Activation, either route:
@@ -84,6 +96,9 @@ FAULT_POINTS = (
     "journal.write",
     "journal.fsync",
     "journal.corrupt",
+    "fleet.lease_steal",
+    "journal.tail_gap",
+    "shm.republish",
 )
 
 

@@ -50,6 +50,26 @@ process that survives even SIGKILL of the owner — unlinks whatever an
 owner crash leaves behind, so ``/dev/shm`` never accumulates garbage.
 Workers deliberately unregister their attachments from their own tracker:
 an exiting worker must never destroy the owner's live segments.
+
+**HA control plane** (ISSUE 18, docs/serving.md "Surviving owner loss &
+rolling upgrades"): with ``OPENSIM_HA=1`` the owner holds a **fenced
+lease** (:class:`FleetLease` — a JSON file beside the journal carrying a
+monotonic ``epoch``), renewed at a third of ``OPENSIM_HA_LEASE_S``. The
+epoch is woven into every shared-memory name (publisher token
+``e<epoch>-<pid>-<hex>``) and into the publication payload, and
+:meth:`TwinPublisher.publish` re-validates the lease immediately before
+the seqlock control swap — a deposed owner's late publish raises
+:class:`FencedWrite` (counted in ``simon_fleet_fenced_writes_total``)
+instead of ever becoming attachable. A hot standby (``simon server
+--standby``, :func:`serve_standby`) tails the journal live
+(:class:`~.journal.JournalTailer`) onto its own twin and takes over on
+lease expiry or explicit release (``POST /api/fleet/handover`` — the
+rolling-upgrade path): it bumps the epoch, starts a fresh
+:class:`~.watch.WatchSupervisor` from the tailed state (zero relists,
+reflectors resuming at the recorded rvs), **adopts** the surviving worker
+processes recorded in the lease, and republishes at a continuous
+generation — workers follow the lease file to the new control block
+without dropping a request.
 """
 
 from __future__ import annotations
@@ -81,8 +101,10 @@ from ..obs.metrics import (
     RECORDER,
     escape_label_value,
     family_header,
+    make_counter,
     make_histogram,
 )
+from ..resilience import faults
 from ..resilience.retry import backoff_delay
 from ..utils import envknobs
 
@@ -90,12 +112,17 @@ log = logging.getLogger("opensim_tpu.server")
 
 __all__ = [
     "ControlBlock",
+    "FencedWrite",
+    "FleetLease",
     "FleetReader",
     "FleetTwinClient",
+    "StandbyOwner",
     "TornGeneration",
     "TwinPublisher",
+    "lease_path",
     "run_worker",
     "serve_fleet",
+    "serve_standby",
 ]
 
 # control-block layout (little-endian):
@@ -121,6 +148,187 @@ class TornGeneration(RuntimeError):
     can attach or has died mid-publish. Counted in
     ``simon_fleet_attach_retries_exhausted_total``; the caller keeps
     serving its previously attached generation."""
+
+
+class FencedWrite(RuntimeError):
+    """A publish was refused because the HA lease moved past this owner's
+    epoch — the process has been deposed and must demote instead of
+    split-braining. Counted in ``simon_fleet_fenced_writes_total``; the
+    seqlock control block is left untouched, so no worker can ever attach
+    a stale-epoch generation."""
+
+
+#: the HA lease file, created beside the journal segments (the journal
+#: directory is the one piece of shared durable state the owner and the
+#: standby already agree on)
+HA_LEASE_FILENAME = "ha-lease.json"
+
+
+def lease_path(state_dir: str) -> str:
+    return os.path.join(state_dir, HA_LEASE_FILENAME)
+
+
+def ha_enabled() -> bool:
+    return bool(envknobs.value("OPENSIM_HA"))
+
+
+def ha_lease_s() -> float:
+    # the registered validator owns the parse and the raise-on-typo policy
+    return float(envknobs.value("OPENSIM_HA_LEASE_S"))
+
+
+def ha_tail_poll_s() -> float:
+    return float(envknobs.value("OPENSIM_HA_TAIL_POLL_MS")) / 1000.0
+
+
+def ha_handover_timeout_s() -> float:
+    return float(envknobs.value("OPENSIM_HA_HANDOVER_TIMEOUT_S"))
+
+
+def _pid_alive(pid: int) -> bool:
+    if not pid or pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True  # e.g. EPERM: it exists, it just isn't ours
+    return True
+
+
+class FleetLease:
+    """Fenced ownership of the fleet: one JSON file, one monotonic epoch.
+
+    The file carries ``{epoch, holder, pid, renewed_at, released, ...}``
+    plus owner metadata (control-block name, ports, worker pids) that the
+    standby needs for takeover and the workers need to re-resolve the
+    owner. Writes are atomic (temp file + ``os.replace``); there is
+    deliberately no fsync — the lease is a liveness signal, not durable
+    history, and a machine crash takes owner and lease down together.
+
+    Correctness story: ``acquire`` only steals a lease that is absent,
+    explicitly released, or older than ``lease_s``; it writes epoch+1 and
+    then **confirms after a settle window** — of two racing acquirers the
+    later write wins the file, the loser observes a foreign holder on
+    re-read and stands down. ``check``/``renew`` observe the file every
+    time: the moment another epoch appears, the holder is fenced and every
+    subsequent :meth:`TwinPublisher.publish` refuses with
+    :class:`FencedWrite`. Chaos point ``fleet.lease_steal`` forces the
+    fenced verdict deterministically.
+    """
+
+    #: settle window between the acquire write and its confirming re-read
+    ACQUIRE_CONFIRM_S = 0.05
+
+    def __init__(self, path: str, lease_s: Optional[float] = None,
+                 holder: Optional[str] = None) -> None:
+        self.path = path
+        self.lease_s = float(lease_s) if lease_s is not None else ha_lease_s()
+        self.holder = holder or f"{os.getpid()}-{secrets.token_hex(4)}"
+        self.epoch = 0  # 0 = not holding
+
+    # -- file I/O ------------------------------------------------------------
+
+    def read(self) -> Optional[dict]:
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+            return doc if isinstance(doc, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    def _write(self, doc: dict) -> None:
+        # the lease may be the journal directory's FIRST file (the owner
+        # acquires before opening the journal for append)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = f"{self.path}.{os.getpid()}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, sort_keys=True)
+        os.replace(tmp, self.path)
+
+    # -- verdicts ------------------------------------------------------------
+
+    @staticmethod
+    def age_s(doc: Optional[dict]) -> float:
+        if doc is None:
+            return float("inf")
+        try:
+            return max(0.0, time.time() - float(doc.get("renewed_at") or 0.0))
+        except (TypeError, ValueError):
+            return float("inf")
+
+    def claimable(self, doc: Optional[dict]) -> bool:
+        """Absent, explicitly released, or expired — stealable."""
+        return doc is None or bool(doc.get("released")) or self.age_s(doc) > self.lease_s
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def acquire(self, meta: Optional[dict] = None) -> Optional[int]:
+        """Take the lease (epoch+1) if it is claimable (or already ours).
+        Returns the new epoch, or None when a live foreign holder owns it
+        or a racing acquirer won the settle window."""
+        doc = self.read()
+        if doc is not None and doc.get("holder") != self.holder and not self.claimable(doc):
+            return None
+        epoch = int((doc or {}).get("epoch") or 0) + 1
+        body = {
+            "epoch": epoch, "holder": self.holder, "pid": os.getpid(),
+            "renewed_at": time.time(), "released": False,
+        }
+        body.update(meta or {})
+        self._write(body)
+        time.sleep(self.ACQUIRE_CONFIRM_S)
+        cur = self.read()
+        if (
+            cur is None
+            or cur.get("holder") != self.holder
+            or int(cur.get("epoch") or -1) != epoch
+        ):
+            return None  # lost the race: the later writer owns the file
+        self.epoch = epoch
+        return epoch
+
+    def check(self) -> bool:
+        """True while this process still holds the lease at its epoch.
+        False IS the fencing verdict — the caller must stop publishing."""
+        try:
+            faults.fault_point("fleet.lease_steal")
+        except Exception as e:
+            log.warning("fleet lease: injected steal (%s); fencing", e)
+            return False
+        doc = self.read()
+        return (
+            doc is not None
+            and doc.get("holder") == self.holder
+            and int(doc.get("epoch") or -1) == self.epoch
+            and not doc.get("released")
+        )
+
+    def renew(self, **updates) -> bool:
+        """Re-stamp ``renewed_at`` (merging ``updates`` into the metadata)
+        under our epoch. False = fenced; the caller demotes."""
+        if not self.check():
+            return False
+        doc = self.read()
+        if doc is None:
+            return False
+        doc["renewed_at"] = time.time()
+        doc.update(updates)
+        self._write(doc)
+        return True
+
+    def release(self, handover: bool = False) -> None:
+        """Mark the lease released (the graceful-handover signal: the
+        standby may take over immediately instead of waiting out the
+        expiry window). No-op when the lease is no longer ours."""
+        doc = self.read()
+        if doc is None or doc.get("holder") != self.holder:
+            return
+        doc["released"] = True
+        doc["handover"] = bool(handover)
+        doc["renewed_at"] = time.time()
+        self._write(doc)
 
 
 _SHM_CLS = None
@@ -341,8 +549,16 @@ class TwinPublisher:
     leaves behind — ``/dev/shm`` hygiene is tested, not hoped for."""
 
     def __init__(self, token: Optional[str] = None,
-                 control_size: int = _CONTROL_SIZE, keep_generations: int = 2) -> None:
-        self.token = token or f"{os.getpid()}-{secrets.token_hex(4)}"
+                 control_size: int = _CONTROL_SIZE, keep_generations: int = 2,
+                 epoch: int = 0, lease: Optional[FleetLease] = None) -> None:
+        # the epoch is woven into the token, hence into EVERY segment name
+        # and the control-block name: two owners can never collide on a
+        # shared-memory name, and a worker can see at a glance (and the
+        # payload check below can enforce) which fencing epoch published it
+        self.epoch = int(epoch)
+        self.lease = lease
+        default = f"{os.getpid()}-{secrets.token_hex(4)}"
+        self.token = token or (f"e{self.epoch}-{default}" if self.epoch else default)
         self.control = ControlBlock(
             name=f"simon-fleet-{self.token}", create=True, size=control_size
         )
@@ -352,6 +568,7 @@ class TwinPublisher:
         self._gen_segments: "Dict[int, set]" = {}
         self._lock = threading.Lock()
         self.publishes_total = 0
+        self.fenced_writes_total = 0  # guarded-by: _lock
         self.last_generation = -1
         self.publish_seconds = make_histogram("simon_fleet_publish_seconds", ())
         self._closed = False
@@ -389,6 +606,7 @@ class TwinPublisher:
         the keep window references."""
         t0 = time.monotonic()
         with self._lock:
+            self._check_fence()  # refuse before wasting segment writes
             current: set = set()
             arrays: List[Tuple[str, str, List[int]]] = []
 
@@ -411,7 +629,18 @@ class TwinPublisher:
                 "blob": blob,
                 "arrays": arrays,
                 "token": self.token,
+                "epoch": self.epoch,
             }
+            # chaos shm.republish: a publish dying HERE leaves the seqlock
+            # even and the directory untouched — readers keep the previous
+            # stable generation (the segments written above are garbage
+            # until a control swap names them; close() unlinks them)
+            faults.fault_point("shm.republish")
+            # the authoritative fencing gate: nothing a worker can attach
+            # is ever swapped in under a stale epoch. Re-checked HERE (not
+            # only at entry) because the segment writes above take real
+            # time — a lease stolen mid-publish must still fence the swap.
+            self._check_fence()
             self.control.write(generation, payload)
             self._gen_segments[generation] = current
             self.publishes_total += 1
@@ -446,6 +675,18 @@ class TwinPublisher:
                 with contextlib.suppress(BufferError, OSError):
                     shm.close()
 
+    def _check_fence(self) -> None:
+        """Raise :class:`FencedWrite` (and count it) when the HA lease no
+        longer names this owner's epoch. No-op outside HA mode."""
+        if self.lease is None:
+            return
+        if not self.lease.check():
+            self.fenced_writes_total += 1
+            raise FencedWrite(
+                f"lease epoch moved past {self.epoch}; publish refused "
+                "(this owner is deposed and must demote)"
+            )
+
     # -- accounting / teardown ----------------------------------------------
 
     def footprint(self) -> dict:
@@ -455,6 +696,8 @@ class TwinPublisher:
                 "bytes": sum(self._seg_bytes.values()) + _CONTROL_SIZE,
                 "publishes": self.publishes_total,
                 "generation": self.last_generation,
+                "fenced_writes": self.fenced_writes_total,
+                "epoch": self.epoch,
             }
 
     def close(self) -> None:
@@ -577,11 +820,23 @@ class FleetTwinClient:
 
     key_prefix = "fleet|"
 
-    def __init__(self, control_name: str, prep_cache=None) -> None:
+    #: how often a worker re-reads the HA lease file for an owner change
+    LEASE_CHECK_S = 0.25
+
+    def __init__(self, control_name: str, prep_cache=None,
+                 lease_file: str = "") -> None:
         self.control_name = control_name
         self.prep_cache = prep_cache
         self.capacity = None  # assigned by SimonServer; bootstrap is per key
         self.journal = None
+        # HA (docs/serving.md "Surviving owner loss"): when the supervisor
+        # hands us the lease path, the worker follows it — a failover
+        # republishes under a NEW control block (the epoch is in the name),
+        # and the lease file is how the worker finds it without restarting
+        self.lease_file = lease_file
+        self._lease_epoch = 0
+        self._next_lease_check = 0.0
+        self.owner_switches_total = 0
         self._reader: Optional[FleetReader] = None
         self._lock = threading.Lock()
         self._gen: Optional[int] = None
@@ -635,9 +890,11 @@ class FleetTwinClient:
         and a same-generation republish (the owner flipping
         staleness/state on a quiet twin) refreshes the payload so
         degraded responses keep their stale tag."""
-        if self._reader is None:
+        self._follow_lease()
+        reader = self._reader
+        if reader is None:
             return None
-        state = self._reader.poll_state()
+        state = reader.poll_state()
         with self._lock:
             if state is not None and state[1] != self._seq:
                 try:
@@ -648,11 +905,64 @@ class FleetTwinClient:
                 return None
             return self._cluster, f"{self.key_prefix}{self._gen}", self.is_stale()
 
+    def _follow_lease(self) -> None:
+        """Failover discovery: when the HA lease names a DIFFERENT control
+        block (a new owner took over at a higher epoch), swap readers and
+        keep serving the old mmap'd generation until the new owner's first
+        publication attaches — a worker never drops a request across a
+        failover. Throttled to one file read per LEASE_CHECK_S."""
+        if not self.lease_file:
+            return
+        now = time.monotonic()
+        if now < self._next_lease_check:
+            return
+        self._next_lease_check = now + self.LEASE_CHECK_S
+        try:
+            with open(self.lease_file, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return  # lease unreadable mid-replace or gone: keep serving
+        epoch = int(doc.get("epoch") or 0)
+        if epoch > self._lease_epoch:
+            self._lease_epoch = epoch
+        control = str(doc.get("control") or "")
+        if not control or control == self.control_name:
+            return
+        try:
+            reader = FleetReader(control)
+            if reader.poll() is None:
+                # the new owner exists but has not published yet: stay on
+                # the old (still mmap'd) generation and retry next check
+                reader.close()
+                return
+        except (FileNotFoundError, ValueError):
+            return
+        with self._lock:
+            # the old reader is dropped, NOT closed: request threads may be
+            # mid-poll on it, and the live numpy views pin its mmaps anyway
+            self._reader = reader
+            self.control_name = control
+            self._seq = None  # force a fresh attach on the next snapshot
+            self.owner_switches_total += 1
+        log.info(
+            "fleet worker: followed the lease to new owner control %s "
+            "(epoch %d)", control, epoch,
+        )
+
     def _attach_locked(self) -> None:
         from ..engine import prepcache
         from ..obs import trace as tracing
 
         gen, payload, obj = self._reader.attach()
+        ep = int(payload.get("epoch") or 0)
+        if self._lease_epoch and ep and ep < self._lease_epoch:
+            # fencing, reader side: a deposed owner raced one last publish
+            # in. Refuse it — the caller keeps serving the previous
+            # generation until the current-epoch owner publishes.
+            raise TornGeneration(
+                f"stale-epoch publication refused (epoch {ep} < lease "
+                f"epoch {self._lease_epoch})"
+            )
         if gen != self._gen:
             key = f"{self.key_prefix}{gen}"
             if self.prep_cache is not None and obj.get("parts") is not None:
@@ -736,7 +1046,7 @@ def run_worker(port: int) -> int:
 
     control = envknobs.raw("OPENSIM_FLEET_ATTACH")
     internal_raw = envknobs.raw("OPENSIM_FLEET_INTERNAL_PORT")
-    client = FleetTwinClient(control)
+    client = FleetTwinClient(control, lease_file=envknobs.raw("OPENSIM_FLEET_LEASE"))
     if not client.start(wait_s=120.0):
         print(
             f"simon server[worker]: no fleet publication at {control!r} "
@@ -805,6 +1115,15 @@ class _Worker:
         self.proc: Optional[subprocess.Popen] = None
         self.spawned_at = 0.0
         self.crashes = 0
+        # HA takeover: an adopted worker was spawned by the PREVIOUS owner
+        # and survived it — we only hold its pid, not a Popen handle
+        self.pid = 0
+        self.adopted = False
+
+    def alive(self) -> bool:
+        if self.proc is not None:
+            return self.proc.poll() is None
+        return self.adopted and self.pid > 0 and _pid_alive(self.pid)
 
 
 #: gauges whose fleet aggregate is a max, not a sum (a summed generation
@@ -818,7 +1137,8 @@ class FleetSupervisor:
     worker supervision + the aggregated admin endpoint."""
 
     def __init__(self, supervisor, journal, port: int, workers: int,
-                 admin_port: Optional[int] = None) -> None:
+                 admin_port: Optional[int] = None, lease: Optional[FleetLease] = None,
+                 adopt: Optional[list] = None, takeover_reason: str = "") -> None:
         from ..engine.prepcache import PrepareCache
 
         self.supervisor = supervisor
@@ -829,11 +1149,37 @@ class FleetSupervisor:
         self.admin_port = admin_port or (int(raw_admin) if raw_admin else port + 1)
         self.prep_cache = PrepareCache()
         supervisor.prep_cache = self.prep_cache
-        self.publisher = TwinPublisher()
-        self.workers = [
-            _Worker(i, self.admin_port + 1 + i) for i in range(workers)
-        ]
+        self.lease = lease
+        self.publisher = TwinPublisher(
+            epoch=lease.epoch if lease is not None else 0, lease=lease
+        )
+        self.workers = []
+        adopted_by_index = {
+            int(row.get("index", -1)): row for row in (adopt or [])
+        }
+        for i in range(workers):
+            row = adopted_by_index.get(i)
+            pid = int(row.get("pid") or 0) if row else 0
+            if row and pid > 0 and _pid_alive(pid):
+                # a survivor from the deposed owner: keep its recorded
+                # loopback port and pid; it follows the lease to us on its
+                # own — adopting it is what makes takeover relist-free
+                w = _Worker(i, int(row.get("internal_port") or self.admin_port + 1 + i))
+                w.pid = pid
+                w.adopted = True
+                w.spawned_at = time.monotonic()
+            else:
+                w = _Worker(i, self.admin_port + 1 + i)
+            self.workers.append(w)
+        self.takeover_reason = takeover_reason
+        self.takeovers = make_counter("simon_fleet_takeovers_total", ("reason",))
+        if takeover_reason:
+            with RECORDER.lock:
+                self.takeovers.inc(labels=(takeover_reason,))
         self.respawns_total = 0
+        self.handed_over = False
+        self._on_handover = None  # set by the serve loop: shut the admin server
+        self._fenced = threading.Event()
         self._published_gen: Optional[int] = None
         self._published_stale: Optional[bool] = None
         self._stop = threading.Event()
@@ -847,6 +1193,8 @@ class FleetSupervisor:
         from ..engine import prepcache
         from ..engine.simulator import prepare
 
+        if self._fenced.is_set():
+            return False
         sup = self.supervisor
         if not sup.has_synced():
             return False
@@ -884,9 +1232,96 @@ class FleetSupervisor:
         while not self._stop.is_set():
             try:
                 self.publish_once()
+            except FencedWrite as e:
+                log.warning("fleet publish fenced: %s", e)
+                self._demote("fenced publish")
+                return
             except Exception as e:
                 log.warning("fleet publish failed: %s: %s", type(e).__name__, e)
             self._stop.wait(interval)
+
+    # -- HA lease ------------------------------------------------------------
+
+    def _lease_doc_meta(self) -> dict:
+        return {
+            "control": self.publisher.control.name,
+            "port": self.port,
+            "admin_port": self.admin_port,
+            "n_workers": self.n_workers,
+            "generation": self.publisher.last_generation,
+            "workers": [
+                {
+                    "index": w.index,
+                    "internal_port": w.internal_port,
+                    "pid": w.proc.pid if w.proc is not None else w.pid,
+                }
+                for w in self.workers
+            ],
+        }
+
+    def _lease_loop(self) -> None:
+        assert self.lease is not None
+        interval = max(0.2, self.lease.lease_s / 3.0)
+        while not self._stop.is_set():
+            try:
+                ok = self.lease.renew(**self._lease_doc_meta())
+            except OSError as e:  # transient fs hiccup: try again next beat
+                log.warning("fleet lease renew I/O error: %s", e)
+                ok = True
+            if not ok:
+                self._demote("lease lost (stolen or expired past another acquire)")
+                return
+            self._stop.wait(interval)
+
+    def _demote(self, why: str) -> None:
+        """The lease moved under us: stop publishing and journaling NOW.
+        The epoch fence already guarantees no worker attaches anything we
+        write from here on; demotion just stops us burning the disk."""
+        if self._fenced.is_set():
+            return
+        self._fenced.set()
+        log.warning("fleet owner fenced: %s; demoting", why)
+
+        def _down():
+            # keep_workers: they belong to the NEW owner now (it adopted
+            # their pids from the lease doc); killing them would drop the
+            # very requests failover exists to save
+            self.stop(keep_workers=True)
+
+        threading.Thread(target=_down, name="simon-fleet-demote", daemon=True).start()
+
+    # -- handover (rolling upgrade) ------------------------------------------
+
+    def handover(self) -> Tuple[int, dict]:
+        """POST /api/fleet/handover: drain and release the lease with the
+        handover flag so the tailing standby takes over without waiting
+        for expiry. Returns (http_status, body)."""
+        if self.lease is None:
+            return 409, {"error": "not running in HA mode (OPENSIM_HA)"}
+        if self._fenced.is_set() or self.handed_over:
+            return 409, {"error": "already fenced or handed over"}
+        threading.Thread(
+            target=self._handover_drain, name="simon-fleet-handover", daemon=True
+        ).start()
+        return 200, {"status": "draining", "epoch": self.lease.epoch}
+
+    def _handover_drain(self) -> None:
+        log.info("fleet handover: draining owner, releasing lease")
+        self._fenced.set()  # no further publishes or lease renewals
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5.0)
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        if self.journal is not None:
+            self.journal.close(timeout=ha_handover_timeout_s())
+        if self.lease is not None:
+            with contextlib.suppress(OSError):
+                self.lease.release(handover=True)
+        self.handed_over = True
+        cb = self._on_handover
+        if cb is not None:
+            cb()
 
     # -- workers -------------------------------------------------------------
 
@@ -894,8 +1329,13 @@ class FleetSupervisor:
         env = dict(os.environ)
         env["OPENSIM_FLEET_ATTACH"] = self.publisher.control.name
         env["OPENSIM_FLEET_INTERNAL_PORT"] = str(w.internal_port)
+        if self.lease is not None:
+            # the worker follows the lease file across owner changes
+            env["OPENSIM_FLEET_LEASE"] = self.lease.path
         # a worker must never recurse into fleet mode
         env.pop("OPENSIM_WORKERS_FLEET", None)
+        w.adopted = False
+        w.pid = 0
         w.proc = subprocess.Popen(
             [
                 sys.executable, "-m", "opensim_tpu", "server",
@@ -904,6 +1344,7 @@ class FleetSupervisor:
             env=env,
         )
         w.spawned_at = time.monotonic()
+        w.pid = w.proc.pid
         log.info("fleet worker %d spawned (pid %d)", w.index, w.proc.pid)
 
     def _monitor_loop(self) -> None:
@@ -911,7 +1352,7 @@ class FleetSupervisor:
             for w in self.workers:
                 if self._stop.is_set():
                     return
-                if w.proc is not None and w.proc.poll() is None:
+                if w.alive():
                     if time.monotonic() - w.spawned_at > 30.0:
                         w.crashes = 0  # stable long enough: reset the backoff
                     continue
@@ -967,12 +1408,19 @@ class FleetSupervisor:
             ("simon_fleet_generation", fp["generation"]),
             ("simon_fleet_shm_segments", fp["segments"]),
             ("simon_fleet_shm_bytes", fp["bytes"]),
+            ("simon_fleet_fenced_writes_total", fp["fenced_writes"]),
         ]
+        if self.lease is not None:
+            age = FleetLease.age_s(self.lease.read())
+            if age != float("inf"):
+                own.append(("simon_fleet_lease_age_seconds", f"{age:.3f}"))
         for name, value in own:
             lines += family_header(name)
             lines.append(f"{name} {value}")
         with RECORDER.lock:
             lines += self.publisher.publish_seconds.render_lines()
+            takeover_lines = self.takeovers.render_lines()
+        lines += takeover_lines or family_header("simon_fleet_takeovers_total")
         if self.supervisor is not None:
             lines += self.supervisor.metrics_lines()
         if self.journal is not None:
@@ -997,12 +1445,28 @@ class FleetSupervisor:
 
     def status(self) -> dict:
         fp = self.publisher.footprint()
+        fingerprint = None
+        if self.supervisor is not None and self.supervisor.has_synced():
+            try:
+                fingerprint = self.supervisor.twin.fingerprint()
+            except Exception as e:  # pragma: no cover - racing a rebase
+                log.warning("twin fingerprint failed: %s: %s", type(e).__name__, e)
+                fingerprint = None
+        doc = self.lease.read() if self.lease is not None else None
+        age = FleetLease.age_s(doc)
         return {
+            "role": "fenced" if self._fenced.is_set() else "owner",
+            "epoch": self.lease.epoch if self.lease is not None else 0,
+            "lease_age_s": None if age == float("inf") else round(age, 3),
+            "generation": self.publisher.last_generation,
+            "fingerprint": fingerprint,
+            "fenced_writes": fp["fenced_writes"],
             "workers": [
                 {
                     "index": w.index,
-                    "pid": w.proc.pid if w.proc is not None else None,
-                    "alive": w.proc is not None and w.proc.poll() is None,
+                    "pid": w.proc.pid if w.proc is not None else (w.pid or None),
+                    "alive": w.alive(),
+                    "adopted": w.adopted,
                     "internal_port": w.internal_port,
                     "crashes": w.crashes,
                 }
@@ -1017,46 +1481,83 @@ class FleetSupervisor:
             "admin_port": self.admin_port,
         }
 
+    def healthz(self) -> dict:
+        alive = self.alive_workers()
+        return {
+            "status": "ok" if alive == self.n_workers else "degraded",
+            "role": "fenced" if self._fenced.is_set() else "fleet-owner",
+            "epoch": self.lease.epoch if self.lease is not None else 0,
+            "workers": alive,
+            "target": self.n_workers,
+            "generation": self.publisher.last_generation,
+        }
+
+    def metrics_text(self) -> str:
+        return self.aggregate_metrics()
+
     def alive_workers(self) -> int:
-        return sum(
-            1 for w in self.workers if w.proc is not None and w.proc.poll() is None
-        )
+        return sum(1 for w in self.workers if w.alive())
 
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> None:
         for w in self.workers:
+            if w.adopted:
+                log.info(
+                    "fleet worker %d adopted from previous owner (pid %d)",
+                    w.index, w.pid,
+                )
+                continue
             self._spawn(w)
-        for target, name in (
+        loops = [
             (self._publish_loop, "simon-fleet-publish"),
             (self._monitor_loop, "simon-fleet-monitor"),
-        ):
+        ]
+        if self.lease is not None:
+            loops.append((self._lease_loop, "simon-fleet-lease"))
+        for target, name in loops:
             t = threading.Thread(target=target, name=name, daemon=True)
             t.start()
             self._threads.append(t)
 
-    def stop(self, drain_s: float = 30.0) -> None:
+    def stop(self, drain_s: float = 30.0, keep_workers: bool = False) -> None:
         """SIGTERM drain order: workers first (each drains its admission
         queue and completes in-flight work), then the reflectors, then the
-        journal flush, then the shared-memory unlink."""
+        journal flush, then the shared-memory unlink. ``keep_workers``
+        (handover / fenced demotion) leaves them running — they belong to
+        the new owner now."""
         self._stop.set()
         for t in self._threads:
             t.join(timeout=5.0)
-        for w in self.workers:
-            if w.proc is not None and w.proc.poll() is None:
-                with contextlib.suppress(OSError):
-                    w.proc.terminate()
-        deadline = time.monotonic() + drain_s
-        for w in self.workers:
-            if w.proc is None:
-                continue
-            with contextlib.suppress(subprocess.TimeoutExpired):
-                w.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
-            if w.proc.poll() is None:
-                log.warning("fleet worker %d did not drain; killing", w.index)
-                with contextlib.suppress(OSError):
-                    w.proc.kill()
-                    w.proc.wait(timeout=5.0)
+        if not keep_workers:
+            for w in self.workers:
+                if w.proc is not None and w.proc.poll() is None:
+                    with contextlib.suppress(OSError):
+                        w.proc.terminate()
+                elif w.adopted and w.pid > 0 and _pid_alive(w.pid):
+                    with contextlib.suppress(OSError):
+                        os.kill(w.pid, signal.SIGTERM)
+            deadline = time.monotonic() + drain_s
+            for w in self.workers:
+                if w.proc is not None:
+                    with contextlib.suppress(subprocess.TimeoutExpired):
+                        w.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+                    if w.proc.poll() is None:
+                        log.warning("fleet worker %d did not drain; killing", w.index)
+                        with contextlib.suppress(OSError):
+                            w.proc.kill()
+                            w.proc.wait(timeout=5.0)
+                elif w.adopted and w.pid > 0:
+                    attempt = 0
+                    while _pid_alive(w.pid) and time.monotonic() < deadline:
+                        time.sleep(backoff_delay(attempt, base_delay=0.05, max_delay=0.5))
+                        attempt += 1
+                    if _pid_alive(w.pid):
+                        log.warning(
+                            "adopted fleet worker %d did not drain; killing", w.index
+                        )
+                        with contextlib.suppress(OSError):
+                            os.kill(w.pid, signal.SIGKILL)
         if self.supervisor is not None:
             self.supervisor.stop()
         if self.journal is not None:
@@ -1064,7 +1565,18 @@ class FleetSupervisor:
         self.publisher.close()
 
 
-def _make_admin_handler(fleet: FleetSupervisor):
+class _RoleBox:
+    """Indirection for the admin endpoint across a promotion: the handler
+    closes over the box, and serve_standby swaps ``current`` from the
+    StandbyOwner to the promoted FleetSupervisor without rebinding the
+    HTTP server. Both roles expose healthz()/metrics_text()/status()/
+    handover()."""
+
+    def __init__(self, current) -> None:
+        self.current = current
+
+
+def _make_admin_handler(box: _RoleBox):
     class AdminHandler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -1080,19 +1592,14 @@ def _make_admin_handler(fleet: FleetSupervisor):
 
         def do_GET(self):
             path = self.path.split("?", 1)[0]
+            role = box.current
             if path == "/healthz":
-                alive = fleet.alive_workers()
-                body = {
-                    "status": "ok" if alive == fleet.n_workers else "degraded",
-                    "role": "fleet-owner",
-                    "workers": alive,
-                    "target": fleet.n_workers,
-                    "generation": fleet.publisher.last_generation,
-                }
-                self._send(200, json.dumps(body).encode(), "application/json")
+                self._send(
+                    200, json.dumps(role.healthz()).encode(), "application/json"
+                )
             elif path == "/metrics":
                 try:
-                    text = fleet.aggregate_metrics()
+                    text = role.metrics_text()
                 except Exception as e:  # a worker roll mid-scrape
                     log.warning("fleet aggregation failed: %s: %s", type(e).__name__, e)
                     self._send(
@@ -1101,7 +1608,15 @@ def _make_admin_handler(fleet: FleetSupervisor):
                     return
                 self._send(200, text.encode(), "text/plain; version=0.0.4")
             elif path == "/api/fleet/status":
-                self._send(200, json.dumps(fleet.status()).encode(), "application/json")
+                self._send(200, json.dumps(role.status()).encode(), "application/json")
+            else:
+                self._send(404, b'{"error": "not found"}', "application/json")
+
+        def do_POST(self):
+            path = self.path.split("?", 1)[0]
+            if path == "/api/fleet/handover":
+                code, body = box.current.handover()
+                self._send(code, json.dumps(body).encode(), "application/json")
             else:
                 self._send(404, b'{"error": "not found"}', "application/json")
 
@@ -1124,6 +1639,25 @@ def serve_fleet(kubeconfig: str, master: str, port: int, watch: str,
             "the workers attach to", flush=True,
         )
         return 1
+    lease: Optional[FleetLease] = None
+    if ha_enabled():
+        if not journal:
+            print(
+                "simon server: OPENSIM_HA=1 needs --journal — the standby "
+                "tails it and the lease lives beside it (docs/serving.md)",
+                flush=True,
+            )
+            return 1
+        # acquire BEFORE build_twin: opening the journal for append
+        # truncates a torn tail, which must never race a live owner's
+        # writer — the lease is what proves there isn't one
+        lease = FleetLease(lease_path(journal))
+        if lease.acquire({"control": "", "port": port, "n_workers": workers}) is None:
+            print(
+                "simon server: HA lease is held by a live owner — start "
+                "this process with --standby to tail it instead", flush=True,
+            )
+            return 1
     try:
         supervisor, jrnl = build_twin(kubeconfig, master, watch, journal)
     except ValueError as e:
@@ -1134,7 +1668,7 @@ def serve_fleet(kubeconfig: str, master: str, port: int, watch: str,
         # checkpoint + suffix replay during startup, like the
         # single-process server (SimonServer wires this in its ctor)
         supervisor.attach_journal(jrnl)
-    fleet = FleetSupervisor(supervisor, jrnl, port, workers)
+    fleet = FleetSupervisor(supervisor, jrnl, port, workers, lease=lease)
     if watch == "on":
         if not supervisor.start(wait_s=60.0):
             print("simon server: --watch on but the twin could not sync", flush=True)
@@ -1143,7 +1677,11 @@ def serve_fleet(kubeconfig: str, master: str, port: int, watch: str,
             return 1
     else:
         supervisor.start()
-    httpd = ThreadingHTTPServer(("0.0.0.0", fleet.admin_port), _make_admin_handler(fleet))
+    box = _RoleBox(fleet)
+    httpd = ThreadingHTTPServer(("0.0.0.0", fleet.admin_port), _make_admin_handler(box))
+    fleet._on_handover = lambda: threading.Thread(
+        target=httpd.shutdown, daemon=True
+    ).start()
 
     def _graceful(signum, frame):
         log.info(
@@ -1161,7 +1699,8 @@ def serve_fleet(kubeconfig: str, master: str, port: int, watch: str,
     print(
         f"simon fleet listening on :{port} [{workers} workers, "
         f"admin :{fleet.admin_port}]"
-        + (f" [journal {journal}]" if jrnl is not None else ""),
+        + (f" [journal {journal}]" if jrnl is not None else "")
+        + (f" [HA epoch {lease.epoch}]" if lease is not None else ""),
         flush=True,
     )
     try:
@@ -1169,6 +1708,306 @@ def serve_fleet(kubeconfig: str, master: str, port: int, watch: str,
     except KeyboardInterrupt:  # pragma: no cover
         pass
     finally:
-        fleet.stop()
-        print("simon fleet: shutdown complete", flush=True)
+        fleet.stop(keep_workers=fleet.handed_over)
+        print(
+            "simon fleet: handed over" if fleet.handed_over
+            else "simon fleet: shutdown complete",
+            flush=True,
+        )
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# hot standby: tail the journal, take over on lease expiry or handover
+# ---------------------------------------------------------------------------
+
+
+class StandbyOwner:
+    """``simon server --standby``: tails the live owner's journal onto a
+    private twin (rv-monotonic apply, checkpoint rebases) and watches the
+    HA lease. When the lease expires (owner died) or is released with the
+    handover flag (rolling upgrade), it acquires the lease at epoch+1,
+    builds a real watch supervisor, preloads it with the tailed state
+    (resume rvs and all — zero relists), adopts the surviving workers
+    recorded in the lease doc, and starts publishing at a continuous
+    generation. Exposes the same admin surface as the owner on
+    ``port + 16`` (clear of the owner's admin at port+1 and the workers'
+    loopback ports above it)."""
+
+    def __init__(self, kubeconfig: str, master: str, port: int, watch: str,
+                 journal_dir: str, workers: int,
+                 auto_handover: bool = False) -> None:
+        from .journal import JournalTailer, RecoveredState
+        from .watch import ClusterTwin
+
+        self.kubeconfig = kubeconfig
+        self.master = master
+        self.port = port
+        self.watch = watch
+        self.journal_dir = journal_dir
+        self.n_workers = workers
+        self.admin_port = port + 16
+        self.lease = FleetLease(lease_path(journal_dir))
+        self.tailer = JournalTailer(journal_dir)
+        self.twin = ClusterTwin()
+        self.state = RecoveredState()
+        self.records_applied = 0
+        self.seen_checkpoint = False
+        self.seen_owner = False
+        self.auto_handover = auto_handover
+        self._handover_requested_at = 0.0
+        self.fleet: Optional[FleetSupervisor] = None
+
+    # -- tailing -------------------------------------------------------------
+
+    def _drain(self) -> int:
+        from .journal import apply_record
+
+        recs = self.tailer.poll()
+        for rec in recs:
+            apply_record(self.twin, rec, self.state)
+            if rec.get("t") == "ck":
+                self.seen_checkpoint = True
+        self.records_applied += len(recs)
+        return len(recs)
+
+    def at_parity(self) -> bool:
+        """Caught up enough to take over without a relist: at least one
+        checkpoint absorbed (the re-anchor that heals any tail gap) and
+        the last poll drained to the journal's end."""
+        return self.seen_checkpoint and self.tailer.last_lag_records == 0
+
+    # -- the standby loop ----------------------------------------------------
+
+    def run(self, stop: threading.Event) -> None:
+        """Tail until promoted or told to stop. Returns with ``self.fleet``
+        set when this process became the owner."""
+        poll_s = ha_tail_poll_s()
+        while not stop.is_set():
+            self._drain()
+            doc = self.lease.read()
+            if doc is not None:
+                self.seen_owner = True
+            if self.seen_owner and self.lease.claimable(doc):
+                reason = (
+                    "handover"
+                    if doc is not None and doc.get("handover")
+                    else "expired"
+                )
+                if self._takeover(doc, reason):
+                    return
+                # lost the acquire race (another standby won): keep tailing
+            elif (
+                self.auto_handover
+                and doc is not None
+                and not doc.get("released")
+                and self.at_parity()
+            ):
+                self._maybe_request_handover(doc)
+            stop.wait(poll_s)
+
+    def _maybe_request_handover(self, doc: dict) -> None:
+        now = time.monotonic()
+        if (
+            self._handover_requested_at
+            and now - self._handover_requested_at < ha_handover_timeout_s()
+        ):
+            return  # request outstanding; lease-expiry watching is the fallback
+        self._handover_requested_at = now
+        admin = int(doc.get("admin_port") or 0)
+        if not admin:
+            return
+
+        def _post():
+            try:
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{admin}/api/fleet/handover",
+                    data=b"", method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=5.0) as resp:
+                    resp.read()
+                log.info("standby: requested handover from owner admin :%d", admin)
+            except OSError as e:
+                log.warning("standby: handover request failed (%s); will retry", e)
+                self._handover_requested_at = 0.0
+
+        threading.Thread(
+            target=_post, name="simon-standby-handover", daemon=True
+        ).start()
+
+    # -- promotion -----------------------------------------------------------
+
+    def _takeover(self, doc: Optional[dict], reason: str) -> bool:
+        from .rest import build_twin
+
+        doc = doc or {}
+        port = int(doc.get("port") or self.port)
+        n = int(doc.get("n_workers") or 0) or self.n_workers
+        if self.lease.acquire(
+            {"control": "", "port": port, "n_workers": n}
+        ) is None:
+            log.info("standby: lost the takeover race; remaining standby")
+            return False
+        log.warning(
+            "standby: taking over as owner (reason=%s, epoch %d, "
+            "%d tailed records, generation %d)",
+            reason, self.lease.epoch, self.records_applied, self.twin.generation,
+        )
+        # one final drain: whatever the old owner flushed before it went.
+        # Opening the journal for APPEND (inside build_twin) truncates any
+        # torn tail, so this read must come first — and only runs now that
+        # the lease proves no live writer remains.
+        self._drain()
+        try:
+            supervisor, jrnl = build_twin(
+                self.kubeconfig, self.master, self.watch, self.journal_dir
+            )
+        except ValueError as e:
+            print(f"simon server[standby]: {e}", flush=True)
+            with contextlib.suppress(OSError):
+                self.lease.release()
+            return False
+        stores, gen = self.twin.snapshot_raw()
+        self.state.stores = stores
+        self.state.generation = max(self.state.generation, gen)
+        supervisor.preload_state(self.state)
+        if jrnl is not None:
+            supervisor.attach_journal(jrnl)
+        fleet = FleetSupervisor(
+            supervisor, jrnl, port, n, admin_port=self.admin_port,
+            lease=self.lease, adopt=list(doc.get("workers") or []),
+            takeover_reason=reason,
+        )
+        if self.watch == "on":
+            if not supervisor.start(wait_s=60.0):
+                log.warning("standby: twin did not sync after takeover")
+        else:
+            supervisor.start()
+        fleet.start()
+        self.fleet = fleet
+        return True
+
+    # -- admin surface (same shape as the owner's) ---------------------------
+
+    def healthz(self) -> dict:
+        return {
+            "status": "ok" if self.at_parity() else "syncing",
+            "role": "standby",
+            "generation": self.twin.generation,
+            "tail_lag_records": self.tailer.last_lag_records,
+        }
+
+    def status(self) -> dict:
+        seq, offset = self.tailer.position()
+        return {
+            "role": "standby",
+            "fingerprint": self.twin.fingerprint(),
+            "generation": self.twin.generation,
+            "records_applied": self.records_applied,
+            "at_parity": self.at_parity(),
+            "tail": {
+                "segment": seq,
+                "offset": offset,
+                "gaps_total": self.tailer.gaps_total,
+                "lag_records": self.tailer.last_lag_records,
+            },
+            "lease": self.lease.read(),
+            "admin_port": self.admin_port,
+        }
+
+    def metrics_text(self) -> str:
+        lines: List[str] = []
+        lines += family_header("simon_fleet_standby_tail_lag_records")
+        lines.append(
+            f"simon_fleet_standby_tail_lag_records {self.tailer.last_lag_records}"
+        )
+        age = FleetLease.age_s(self.lease.read())
+        if age != float("inf"):
+            lines += family_header("simon_fleet_lease_age_seconds")
+            lines.append(f"simon_fleet_lease_age_seconds {age:.3f}")
+        lines += family_header("simon_fleet_takeovers_total")
+        return "\n".join(lines) + "\n"
+
+    def handover(self) -> Tuple[int, dict]:
+        return 409, {
+            "error": "standby does not hold the lease; POST to the owner's "
+            "admin port"
+        }
+
+
+def serve_standby(kubeconfig: str, master: str, port: int, watch: str,
+                  journal: str, workers: int, handover: bool = False) -> int:
+    """``simon server --standby``: run the hot standby until it is
+    promoted (then keep serving as the fleet owner) or stopped. With
+    ``handover=True`` it asks the live owner to drain once the tail
+    reaches parity — the zero-downtime rolling-upgrade path."""
+    if not journal:
+        print(
+            "simon server: --standby needs --journal — the standby tails "
+            "the owner's journal (docs/serving.md)", flush=True,
+        )
+        return 1
+    if not kubeconfig or watch == "off":
+        print(
+            "simon server: --standby needs the live twin (--kubeconfig "
+            "and --watch auto|on) to serve after takeover", flush=True,
+        )
+        return 1
+    standby = StandbyOwner(
+        kubeconfig, master, port, watch, journal, workers,
+        auto_handover=handover,
+    )
+    box = _RoleBox(standby)
+    httpd = ThreadingHTTPServer(
+        ("0.0.0.0", standby.admin_port), _make_admin_handler(box)
+    )
+    threading.Thread(
+        target=httpd.serve_forever, name="simon-standby-admin", daemon=True
+    ).start()
+    stop = threading.Event()
+
+    def _graceful(signum, frame):
+        log.info("standby received %s; stopping", signal.Signals(signum).name)
+        stop.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _graceful)
+        except ValueError:  # pragma: no cover - embedded use
+            break
+    print(
+        f"simon standby tailing {journal} [admin :{standby.admin_port}]"
+        + (" [auto-handover]" if handover else ""),
+        flush=True,
+    )
+    try:
+        standby.run(stop)
+    except KeyboardInterrupt:  # pragma: no cover
+        stop.set()
+    fleet = standby.fleet
+    if fleet is None:
+        httpd.shutdown()
+        print("simon standby: shutdown complete", flush=True)
+        return 0
+    box.current = fleet
+    fleet._on_handover = stop.set
+    print(
+        f"simon fleet listening on :{fleet.port} [{fleet.n_workers} workers, "
+        f"admin :{standby.admin_port}] [HA epoch {standby.lease.epoch}] "
+        f"(took over: {fleet.takeover_reason})",
+        flush=True,
+    )
+    try:
+        while not stop.is_set():
+            stop.wait(0.5)
+    except KeyboardInterrupt:  # pragma: no cover
+        pass
+    finally:
+        httpd.shutdown()
+        fleet.stop(keep_workers=fleet.handed_over)
+        print(
+            "simon fleet: handed over" if fleet.handed_over
+            else "simon fleet: shutdown complete",
+            flush=True,
+        )
     return 0

@@ -1735,6 +1735,7 @@ def fleet_workers(flag: int = 0) -> int:
 def serve(
     kubeconfig: str = "", master: str = "", port: int = 8080,
     watch: str = "auto", journal: str = "", workers: int = 0,
+    standby: bool = False, ha_handover: bool = False,
 ) -> int:
     """Start the REST server. ``watch`` selects the snapshot strategy when a
     kubeconfig is configured (docs/live-twin.md):
@@ -1777,6 +1778,16 @@ def serve(
         from .fleet import run_worker
 
         return run_worker(port)
+    if standby:
+        # HA hot standby (docs/serving.md "Surviving owner loss & rolling
+        # upgrades"): tail the owner's journal, take over on lease expiry
+        # or handover — with --handover, request the handover itself
+        from .fleet import serve_standby
+
+        return serve_standby(
+            kubeconfig, master, port, watch, journal,
+            fleet_workers(workers) or 2, handover=ha_handover,
+        )
     n_fleet = fleet_workers(workers)
     if n_fleet >= 2:
         from .fleet import serve_fleet

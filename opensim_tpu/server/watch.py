@@ -716,6 +716,9 @@ class WatchSupervisor:
         # reflector dispatch keeps flowing
         self._flush_lock = threading.Lock()  # lockwatch: hold-exempt — holds across delta re-encode by design
         self._boot_rvs: Dict[str, str] = {}
+        #: in-memory state to adopt at start() instead of journal recovery
+        #: (the HA standby's pre-warmed twin; see preload_state)
+        self._preloaded = None
         # serializes event application against the anti-entropy merge (the
         # reflector threads vs the supervisor thread) and guards the
         # per-field reorder-fault holding slots
@@ -780,6 +783,16 @@ class WatchSupervisor:
             stores, gen, resume_rvs=self._boot_rvs, timeline=timeline, why=why
         )
 
+    def preload_state(self, state) -> None:
+        """Hand the supervisor an in-memory :class:`~.journal.RecoveredState`
+        to adopt INSTEAD of recovering from its journal at start() — the HA
+        standby's takeover path (server/fleet.py): the standby tailed the
+        old owner's journal onto its own twin, and the new supervisor must
+        start from that pre-warmed state (zero relists, reflectors resuming
+        at the recorded rvs), not from a disk replay of history it already
+        holds."""
+        self._preloaded = state
+
     def _restore_from_journal(self) -> bool:
         """Rebuild the twin from the journal's newest checkpoint + suffix
         replay, then resume serving WITHOUT a relist: the reflectors pick
@@ -789,7 +802,13 @@ class WatchSupervisor:
         state = self.journal.recover()
         if state is None:
             return False
-        with self._traced("journal-restore"):
+        return self._adopt_state(state, "journal-restore", "recovered")
+
+    def _adopt_state(self, state, span: str, why: str) -> bool:
+        """Seed the twin/capacity/resume-rvs from a recovered (or
+        standby-tailed) state and go live — shared by journal recovery and
+        the HA takeover."""
+        with self._traced(span):
             with self._maint_lock:
                 for field, items in state.stores.items():
                     if field in RESOURCE_BY_FIELD:
@@ -821,11 +840,11 @@ class WatchSupervisor:
             # re-anchor: the next crash must not have to replay this
             # suffix again (and a restore-time drift repair now has a
             # checkpoint to be a suffix OF)
-            self._checkpoint_now("recovered")
+            self._checkpoint_now(why)
             log.info(
-                "live twin restored from journal: generation %d "
+                "live twin %s: generation %d "
                 "(checkpoint %d + %d replayed record(s))",
-                state.generation, state.checkpoint_generation,
+                why, state.generation, state.checkpoint_generation,
                 state.records_replayed,
             )
             return True
@@ -853,6 +872,17 @@ class WatchSupervisor:
             self.journal.flush(timeout=10.0)
 
     def _run(self) -> None:
+        if self._preloaded is not None and not self._synced.is_set():
+            state, self._preloaded = self._preloaded, None
+            try:
+                self._adopt_state(state, "takeover-adopt", "takeover")
+            except Exception as e:
+                # a failed adopt degrades to the journal/bootstrap ladder
+                # below — the takeover gets slower, never stuck
+                log.warning(
+                    "takeover state adopt failed (%s: %s); falling back to "
+                    "journal recovery / relist", type(e).__name__, e,
+                )
         if self.journal is not None and not self._synced.is_set():
             try:
                 self._restore_from_journal()  # sets _synced on success

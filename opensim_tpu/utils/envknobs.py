@@ -219,6 +219,13 @@ _SERVER = [
     Knob("OPENSIM_FLEET_ADMIN_PORT", "int", "", "Fleet admin port (aggregated /metrics, /healthz, /api/fleet/status). Default: public port + 1.", None, section="server"),
     Knob("OPENSIM_FLEET_ATTACH", "str", "", "INTERNAL: shared-memory control-block name a fleet worker attaches to (set by the fleet supervisor, never by operators).", None, section="server"),
     Knob("OPENSIM_FLEET_INTERNAL_PORT", "int", "", "INTERNAL: per-worker loopback listener port the fleet supervisor scrapes for /metrics aggregation (set by the supervisor).", None, section="server"),
+    # HA control plane (server/fleet.py, docs/serving.md "Surviving owner
+    # loss & rolling upgrades")
+    Knob("OPENSIM_HA", "flag", "", "`1` enables the HA control plane: the fleet owner holds a fenced lease next to the journal and a `simon server --standby` process tails the journal, ready to take over.", None, section="server"),
+    Knob("OPENSIM_HA_LEASE_S", "float", "5", "HA lease duration in seconds: an owner that has not renewed within this window is considered dead and the standby takes over (renewal cadence is a third of it).", _float(lo=0.0, exclusive=True), on_error="raise", section="server"),
+    Knob("OPENSIM_HA_TAIL_POLL_MS", "float", "50", "Standby journal tail-follow poll cadence in ms (also the lease-expiry check cadence).", _float(lo=1.0), on_error="raise", section="server"),
+    Knob("OPENSIM_HA_HANDOVER_TIMEOUT_S", "float", "30", "Bound on an explicit handover drain (rolling upgrade): past it the requesting standby falls back to lease-expiry takeover.", _float(lo=0.0, exclusive=True), on_error="raise", section="server"),
+    Knob("OPENSIM_FLEET_LEASE", "str", "", "INTERNAL: HA lease file path a fleet worker follows to re-resolve the owner's control block after a failover (set by the fleet supervisor, never by operators).", None, section="server"),
     # pipelined admission + priority lanes (server/admission.py,
     # docs/serving.md "Continuous batching & priority lanes")
     Knob("OPENSIM_PIPELINE", "enum", "on", "`on` overlaps batch k+1 host prep with batch k engine dispatch (staged pipeline); `off` restores the serial single-batch-in-flight loop.", None, choices=("on", "off"), section="server"),

@@ -1,0 +1,316 @@
+#!/usr/bin/env python
+"""HA control-plane smoke gate (``make ha-smoke``, part of ``make verify``).
+
+The ISSUE 18 acceptance run, end to end over real subprocesses:
+
+1. start the stub apiserver seeded with a small live cluster;
+2. boot an HA owner fleet (``OPENSIM_HA=1``, ``--workers 2 --journal``):
+   fenced lease + journal + shared-memory twin publication;
+3. boot a hot standby (``simon server --standby``) and wait until its
+   journal tail reaches parity with the owner;
+4. record placement probes, then drive the public port with the
+   closed-loop load generator and **SIGKILL the owner mid-run**;
+5. the standby must take the lease, adopt the surviving workers and
+   republish — while the loadgen sees ZERO errors (the SO_REUSEPORT
+   workers keep answering from their last attached generation
+   throughout the failover window);
+6. assert the post-takeover placements are bit-identical to the
+   pre-kill probes, ``simon_fleet_takeovers_total{reason="expired"}``
+   is exactly 1, and — after everything is torn down — no orphaned
+   ``simon-fleet-*`` segment is left in ``/dev/shm`` (the resource
+   tracker outlives even a SIGKILLed owner).
+
+The assertion-grade versions of these gates (fingerprint vs a fresh
+relist, zero relists, adoption identity) live in ``tests/test_ha.py``;
+this gate is the fast always-on end-to-end check with load applied.
+
+Exit 0 on success; 1 with a one-line reason per failed check.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def fail(msg: str) -> int:
+    print(f"ha-smoke: FAIL: {msg}")
+    return 1
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _http_json(url: str, timeout: float = 3.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _http_text(url: str, timeout: float = 3.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _log_tail(path: str, n: int = 3000) -> str:
+    try:
+        with open(path) as f:
+            return f.read()[-n:]
+    except OSError:
+        return "<no log>"
+
+
+def _wait(pred, timeout: float, msg: str) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+def _metric_value(text: str, needle: str):
+    for line in text.splitlines():
+        if line.startswith(needle):
+            return float(line.rsplit(" ", 1)[-1])
+    return None
+
+
+def _spawn(argv, env, logfile):
+    # stdout goes to a FILE, never a pipe: the fleet workers inherit the
+    # fd and outlive the owner on takeover, so a pipe would never EOF
+    return subprocess.Popen(
+        argv, stdout=open(logfile, "w"), stderr=subprocess.STDOUT,
+        env=env, cwd=REPO, text=True,
+    )
+
+
+def main() -> int:  # noqa: C901 - one linear scenario, early-exit checks
+    import tempfile
+
+    from opensim_tpu.server.loadgen import (
+        _canon_response,
+        _payload,
+        _post_deploy,
+        _seed_stub,
+        run_loadgen,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="ha-smoke-")
+    shm_before = set(glob.glob("/dev/shm/simon-fleet-*"))
+    stub = _seed_stub(n_nodes=8, n_pods=16)
+    kc = stub.kubeconfig(tmp)
+    jd = os.path.join(tmp, "journal")
+    port = _free_port()
+    url = f"http://127.0.0.1:{port}"
+    owner_admin = f"http://127.0.0.1:{port + 1}"
+    sb_admin = f"http://127.0.0.1:{port + 16}"
+    env = dict(
+        os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+        OPENSIM_HA="1", OPENSIM_HA_LEASE_S="2",
+        OPENSIM_HA_TAIL_POLL_MS="25", OPENSIM_FLEET_PUBLISH_MS="50",
+        OPENSIM_JOURNAL_FSYNC="always", OPENSIM_JOURNAL_CHECKPOINT_EVERY="64",
+    )
+    owner_log = os.path.join(tmp, "owner.log")
+    sb_log = os.path.join(tmp, "standby.log")
+    owner = standby = None
+    adopted_pids: set = set()
+    try:
+        owner = _spawn(
+            [sys.executable, "-m", "opensim_tpu", "server",
+             "--kubeconfig", kc, "--watch", "on", "--journal", jd,
+             "--port", str(port), "--workers", "2", "--backend", "cpu"],
+            env, owner_log,
+        )
+
+        def owner_up():
+            if owner.poll() is not None:
+                raise RuntimeError(
+                    f"owner died at boot: {_log_tail(owner_log)}"
+                )
+            try:
+                body = _http_json(f"{owner_admin}/healthz", timeout=2.0)
+                if body.get("workers", 0) < 2 or body.get("generation", -1) < 0:
+                    return False
+                # every worker is alive AND the shared public port answers
+                _http_text(f"{url}/healthz", timeout=2.0)
+                return True
+            except OSError:
+                return False
+
+        _wait(owner_up, timeout=120.0, msg="HA owner fleet up")
+        status = _http_json(f"{owner_admin}/api/fleet/status")
+        if status.get("role") != "owner" or status.get("epoch") != 1:
+            return fail(f"owner booted in unexpected state: {status}")
+        worker_pids = {w["pid"] for w in status["workers"] if w["alive"]}
+
+        standby = _spawn(
+            [sys.executable, "-m", "opensim_tpu", "server", "--standby",
+             "--kubeconfig", kc, "--watch", "auto", "--journal", jd,
+             "--port", str(port), "--workers", "2", "--backend", "cpu"],
+            env, sb_log,
+        )
+
+        def standby_at_parity():
+            if standby.poll() is not None:
+                raise RuntimeError(
+                    f"standby died at boot: {_log_tail(sb_log)}"
+                )
+            try:
+                body = _http_json(f"{sb_admin}/api/fleet/status", timeout=2.0)
+                return body.get("role") == "standby" and body.get("at_parity")
+            except OSError:
+                return False
+
+        _wait(standby_at_parity, timeout=60.0, msg="standby tail parity")
+        print("ha-smoke: owner + standby up, standby at tail parity")
+
+        # placement identity probes, recorded BEFORE any failover
+        probes = [
+            _canon_response(
+                _post_deploy(url, _payload(777, i, 3, "500m", "1Gi"))
+            )
+            for i in range(4)
+        ]
+
+        # closed-loop load through the failover window
+        report_box: dict = {}
+
+        def drive():
+            try:
+                report_box["report"] = run_loadgen(
+                    url, mode="closed", concurrency=8, duration_s=12.0,
+                    warmup_requests=2, metrics_url=sb_admin,
+                )
+            except Exception as e:  # surfaced as a gate failure below
+                report_box["error"] = f"{type(e).__name__}: {e}"
+
+        t = threading.Thread(target=drive, daemon=True)
+        t.start()
+        time.sleep(3.0)
+        owner.kill()  # SIGKILL: no flush, no lease release, no goodbye
+        owner.wait(timeout=10)
+        print("ha-smoke: owner SIGKILLed mid-run")
+
+        def promoted():
+            if standby.poll() is not None:
+                raise RuntimeError(
+                    f"standby died during takeover: {_log_tail(sb_log)}"
+                )
+            try:
+                body = _http_json(f"{sb_admin}/api/fleet/status", timeout=2.0)
+                return body.get("role") == "owner"
+            except OSError:
+                return False
+
+        _wait(promoted, timeout=60.0, msg="standby takeover")
+        status = _http_json(f"{sb_admin}/api/fleet/status")
+        if status.get("epoch") != 2:
+            return fail(f"takeover epoch != 2: {status.get('epoch')}")
+        adopted_pids = {w["pid"] for w in status["workers"] if w.get("adopted")}
+        if adopted_pids != worker_pids:
+            return fail(
+                f"takeover respawned workers: adopted {sorted(adopted_pids)} "
+                f"!= original {sorted(worker_pids)}"
+            )
+        print(f"ha-smoke: standby took over at epoch 2, "
+              f"adopted workers {sorted(adopted_pids)}")
+
+        t.join(timeout=120.0)
+        if t.is_alive():
+            return fail("loadgen never finished")
+        if "error" in report_box:
+            return fail(f"loadgen crashed: {report_box['error']}")
+        report = report_box["report"]
+        print(f"ha-smoke: loadgen through the kill: "
+              f"qps={report['qps']} ok={report['ok']} "
+              f"shed={report['shed']} errors={report['errors']}")
+        if report["errors"] != 0:
+            return fail(
+                f"loadgen saw {report['errors']} errors across the failover"
+            )
+        if report["ok"] <= 0:
+            return fail("loadgen completed zero requests")
+
+        # bit-identical placements: the same payloads against the new
+        # owner's fleet must place exactly as before the kill
+        for i, want in enumerate(probes):
+            got = _canon_response(
+                _post_deploy(url, _payload(777, i, 3, "500m", "1Gi"))
+            )
+            if got != want:
+                return fail(
+                    f"placement diverged after takeover (probe {i}): "
+                    f"{got} != {want}"
+                )
+
+        metrics = _http_text(f"{sb_admin}/metrics")
+        takeovers = _metric_value(
+            metrics, 'simon_fleet_takeovers_total{reason="expired"}'
+        )
+        if takeovers != 1.0:
+            return fail(
+                f'simon_fleet_takeovers_total{{reason="expired"}} == '
+                f"{takeovers}, want 1"
+            )
+    except (RuntimeError, TimeoutError, OSError) as e:
+        if owner is not None:
+            print(f"ha-smoke: owner log tail:\n{_log_tail(owner_log)}")
+        if standby is not None:
+            print(f"ha-smoke: standby log tail:\n{_log_tail(sb_log)}")
+        return fail(str(e))
+    finally:
+        # the standby-turned-owner owns the adopted workers: SIGTERM it
+        # first so it reaps them, then sweep whatever is left
+        if standby is not None and standby.poll() is None:
+            standby.send_signal(signal.SIGTERM)
+            try:
+                standby.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+        for p in (owner, standby):
+            if p is not None and p.poll() is None:
+                p.kill()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+        for pid in adopted_pids:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                pass
+        stub.stop()
+
+    # /dev/shm hygiene: the SIGKILLed owner's segments must be reaped by
+    # its surviving resource tracker, the new owner's by its own shutdown
+    deadline = time.monotonic() + 15.0
+    leftovers = set(glob.glob("/dev/shm/simon-fleet-*")) - shm_before
+    while leftovers and time.monotonic() < deadline:
+        time.sleep(0.5)
+        leftovers = set(glob.glob("/dev/shm/simon-fleet-*")) - shm_before
+    if leftovers:
+        return fail(f"orphaned /dev/shm segments: {sorted(leftovers)}")
+
+    print("ha-smoke: OK (zero-error failover, bit-identical placements, "
+          "one takeover, clean /dev/shm)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

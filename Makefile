@@ -35,8 +35,9 @@ mypy:
 chaos:
 	python -m pytest tests/test_chaos.py tests/test_resilience.py tests/test_watch.py tests/test_journal.py tests/test_ha.py -q
 
-# perf gate (ISSUE 4): a small affinity workload must engage the C++
-# engine's incremental cache AND match the forced-generic path bit-for-bit
+# perf gate (ISSUE 4, widened by ISSUE 19): small affinity/ports/gpu
+# workloads must engage the C++ engine's incremental cache (with per-carry-
+# class attribution) AND match the forced-generic path bit-for-bit
 perf-smoke:
 	python tools/perf_smoke.py
 

@@ -162,6 +162,149 @@ def forced_cluster(n_nodes: int, n_bound: int) -> ResourceTypes:
     return rt
 
 
+def _tmpl_annotate(deploy, anno: dict) -> None:
+    """Pod-TEMPLATE annotations on a workload (gpu-share / open-local pod
+    requests live on the pod template, not the controller metadata)."""
+    deploy.template_metadata.annotations.update(anno)
+    deploy.template_raw.setdefault("metadata", {}).setdefault(
+        "annotations", {}
+    ).update(anno)
+
+
+def gpu_cluster(n_nodes: int) -> ResourceTypes:
+    """All-GPU fleet (ISSUE-19 envelope target): every node advertises
+    gpu-share devices — 8 × 8Gi per the reference NewGpuNodeInfo semantics
+    (per-device memory = total gpu-mem / gpu-count)."""
+    rt = ResourceTypes()
+    zones = [f"zone-{z}" for z in range(4)]
+    for i in range(n_nodes):
+        rt.nodes.append(
+            fx.make_fake_node(
+                f"node-{i:05d}", "64", "256Gi", "256",
+                fx.with_labels({"topology.kubernetes.io/zone": zones[i % len(zones)]}),
+                fx.with_allocatable({
+                    "alibabacloud.com/gpu-mem": "64Gi",
+                    "alibabacloud.com/gpu-count": "8",
+                }),
+            )
+        )
+    return rt
+
+
+def gpu_apps(n_pods: int) -> ResourceTypes:
+    """All-GPU workload mix: gpu-share templates (pod-template gpu-mem
+    annotations → the per-GPU-index headroom carry) plus whole-GPU
+    templates (spec gpu-count requests → the gc_dyn dynamic-allocatable
+    filter/score, Reserve-rewritten at every bind)."""
+    rt = ResourceTypes()
+    n_workloads = 10
+    per = n_pods // n_workloads
+    for w in range(n_workloads):
+        if w % 5 == 4:
+            rt.deployments.append(
+                fx.make_fake_deployment(
+                    f"gpu-{w}", per, "250m", "512Mi",
+                    fx.with_requests({"alibabacloud.com/gpu-count": "1"}),
+                )
+            )
+            continue
+        d = fx.make_fake_deployment(f"gpu-{w}", per, "250m", "512Mi")
+        _tmpl_annotate(d, {
+            "alibabacloud.com/gpu-mem": f"{2 + 2 * (w % 3)}Gi",
+            "alibabacloud.com/gpu-count": "1",
+        })
+        rt.deployments.append(d)
+    return rt
+
+
+def local_pv_cluster(n_nodes: int) -> ResourceTypes:
+    """All-local-PV fleet (ISSUE-19 envelope target): every node carries an
+    open-local LVM volume group plus exclusive devices."""
+    rt = ResourceTypes()
+    zones = [f"zone-{z}" for z in range(4)]
+    for i in range(n_nodes):
+        rt.nodes.append(
+            fx.make_fake_node(
+                f"node-{i:05d}", "64", "256Gi", "256",
+                fx.with_labels({"topology.kubernetes.io/zone": zones[i % len(zones)]}),
+                fx.with_node_local_storage(
+                    vgs=[{"name": "pool0", "capacity": 600 * 1024**3}],
+                    devices=[
+                        {"device": "/dev/vdb", "capacity": 100 * 1024**3, "mediaType": "ssd"},
+                        {"device": "/dev/vdc", "capacity": 100 * 1024**3, "mediaType": "ssd"},
+                    ],
+                ),
+            )
+        )
+    return rt
+
+
+def local_pv_apps(n_pods: int) -> ResourceTypes:
+    """All-local-PV workload mix: every template requests an open-local LVM
+    volume (per-disk allocation carry + the w_local score term); one
+    template in ten adds an exclusive SSD device volume."""
+    rt = ResourceTypes()
+    n_workloads = 10
+    per = n_pods // n_workloads
+    for w in range(n_workloads):
+        vols = [{
+            "size": str((5 + 5 * (w % 3)) * 1024**3),
+            "kind": "LVM", "scName": "open-local-lvm",
+        }]
+        if w == 4:
+            vols.append({
+                "size": str(20 * 1024**3),
+                "kind": "SSD", "scName": "open-local-device",
+            })
+        d = fx.make_fake_deployment(f"loc-{w}", per, "250m", "512Mi")
+        _tmpl_annotate(d, {"simon/pod-local-storage": json.dumps({"volumes": vols})})
+        rt.deployments.append(d)
+    return rt
+
+
+def _verify_envelope(cluster, apps) -> dict:
+    """ISSUE 19 in-row bit-equality gates (gpu / local-pv configs): one
+    shared Prepared encoding driven through the incremental C++ path, the
+    forced-generic C++ path, and the XLA scan — placements, failure
+    attribution, and final state must agree element-for-element."""
+    from opensim_tpu.engine import nativepath
+    from opensim_tpu.engine.scheduler import pad_pod_stream, schedule_pods
+    from opensim_tpu.engine.simulator import prepare
+
+    prep = prepare(cluster, apps, node_pad=128)
+    P = len(prep.ordered)
+    pv = np.ones(P, bool)
+    inc = nativepath.schedule(prep, pv)
+    prior = os.environ.get("OPENSIM_NATIVE_FORCE_GENERIC")
+    os.environ["OPENSIM_NATIVE_FORCE_GENERIC"] = "1"
+    try:
+        gen = nativepath.schedule(prep, pv)
+    finally:
+        if prior is None:
+            del os.environ["OPENSIM_NATIVE_FORCE_GENERIC"]
+        else:
+            os.environ["OPENSIM_NATIVE_FORCE_GENERIC"] = prior
+    t, v, f = pad_pod_stream(prep.tmpl_ids, pv, prep.forced)
+    xout = schedule_pods(prep.ec, prep.st0, t, v, f, features=prep.features)
+    inc_stats = inc.native_stats or {}
+    gen_stats = gen.native_stats or {}
+    return {
+        "verify_native_path": inc_stats.get("path"),
+        "verify_classes": (inc_stats.get("steps") or {}).get("classes") or {},
+        "placements_identical_generic": int(
+            gen_stats.get("path") == "generic"
+            and np.array_equal(inc.chosen, gen.chosen)
+            and np.array_equal(inc.fail_counts, gen.fail_counts)
+            and np.array_equal(inc.final_state.used, gen.final_state.used)
+        ),
+        "placements_identical_xla": int(
+            np.array_equal(np.asarray(xout.chosen)[:P], inc.chosen)
+            and np.array_equal(np.asarray(xout.fail_counts)[:P], inc.fail_counts)
+            and np.array_equal(np.asarray(xout.final_state.used), inc.final_state.used)
+        ),
+    }
+
+
 def bench_defrag(n_scenarios: int, n_nodes: int, n_pods: int, warmup: bool) -> int:
     """BASELINE.md config 5: parallel what-if node-drain scenarios.
     Metric: scenarios/sec/chip."""
@@ -733,10 +876,13 @@ def main() -> int:
     ap.add_argument(
         "--config",
         default="plan",
-        choices=["plan", "defrag", "affinity", "example", "gpushare", "bigu", "forced", "steady", "serving", "replay", "campaign"],
+        choices=["plan", "defrag", "affinity", "gpu", "local-pv", "example", "gpushare", "bigu", "forced", "steady", "serving", "replay", "campaign"],
         help=(
             "plan = capacity-plan wall-clock (headline); defrag = drain-scenario "
-            "sweep; affinity = interpod+spread heavy; example/gpushare = the "
+            "sweep; affinity = interpod+spread heavy; gpu = all-GPU-share + "
+            "whole-GPU (gc_dyn) envelope row; local-pv = all-open-local "
+            "LVM/device envelope row (both carry in-row bit-equality gates "
+            "vs the generic C++ path and the XLA scan); example/gpushare = the "
             "shipped example simon configs; bigu = 1000 distinct templates "
             "(big-U megakernel mode); forced = live-cluster replay (90%% "
             "pre-bound pods); steady = repeated re-simulation of one cluster "
@@ -822,10 +968,18 @@ def main() -> int:
         # 90% of the pod stream is pre-bound snapshot pods
         cluster = forced_cluster(args.nodes, int(args.pods * 0.9))
         apps = [AppResource("bench", synthetic_apps(args.pods - int(args.pods * 0.9)))]
+    elif args.config == "gpu":
+        cluster = gpu_cluster(args.nodes)
+    elif args.config == "local-pv":
+        cluster = local_pv_cluster(args.nodes)
     else:
         cluster = synthetic_cluster(args.nodes)
     if args.config == "affinity":
         apps = [AppResource("bench", affinity_apps(args.pods))]
+    elif args.config == "gpu":
+        apps = [AppResource("bench", gpu_apps(args.pods))]
+    elif args.config == "local-pv":
+        apps = [AppResource("bench", local_pv_apps(args.pods))]
     elif args.config == "bigu":
         rt = bigu_apps(args.pods)
         # per-template replica rounding changes the real pod count: keep the
@@ -864,9 +1018,11 @@ def main() -> int:
     target_s = 10.0
     record = {
         "metric": f"{_fmt(args.pods)}-pod/{_fmt(args.nodes)}-node "
-        + {"affinity": "affinity-heavy ", "bigu": "1000-template ", "forced": "forced-replay "}.get(
-            args.config, ""
-        )
+        + {
+            "affinity": "affinity-heavy ", "bigu": "1000-template ",
+            "forced": "forced-replay ", "gpu": "all-GPU-share ",
+            "local-pv": "all-local-PV ",
+        }.get(args.config, "")
         + "capacity plan wall-clock",
         "value": round(dt, 3),
         "unit": "s",
@@ -905,6 +1061,20 @@ def main() -> int:
                     key = code.name.lower() if code is not None else e.status
                     reason_hist[key] = reason_hist.get(key, 0) + 1
             record["unschedulable_reasons"] = reason_hist
+    if args.config in ("gpu", "local-pv"):
+        # ISSUE 19 in-row gates: the measured (incremental) placements must
+        # be bit-identical to the generic C++ path AND the XLA scan, and the
+        # incremental envelope must actually have engaged — a row that went
+        # generic measures the wrong thing even when it is fast enough
+        _stage("verify")
+        gates = _verify_envelope(cluster, apps)
+        record["native_engaged"] = int(
+            result.engine is not None
+            and result.engine.native_path == "incremental"
+            and gates.pop("verify_native_path") == "incremental"
+            and bool(gates.pop("verify_classes"))
+        )
+        record.update(gates)
     if os.environ.get("OPENSIM_NATIVE_PROFILE"):
         # per-stage engine timings as structured data (still ONE JSON line);
         # populated by the C++ engine when profiling is enabled

@@ -988,6 +988,31 @@ def run_profile(args) -> int:
                 + f", {promo} starvation promotion(s)",
                 file=out,
             )
+    native = payload.get("native") or {}
+    steps = native.get("steps") or {}
+    if any(steps.values()):
+        inc = int(steps.get("incremental", 0))
+        gen = int(steps.get("generic", 0))
+        total = inc + gen
+        pct = (100.0 * inc / total) if total else 0.0
+        print(
+            f"\nC++ engine paths: {inc} incremental / {gen} generic "
+            f"step(s) ({pct:.1f}% incremental)",
+            file=out,
+        )
+        classes = native.get("classes") or {}
+        if classes:
+            print(
+                "incremental carry classes: "
+                + ", ".join(f"{k}={n}" for k, n in sorted(classes.items())),
+                file=out,
+            )
+        bails = native.get("bails") or {}
+        if bails:
+            rows = [["Bail reason", "Count"]]
+            for reason, n in sorted(bails.items(), key=lambda kv: (-kv[1], kv[0])):
+                rows.append([reason, str(n)])
+            _table(rows, out)
     return 0
 
 

@@ -206,6 +206,15 @@ KERNEL_ARG_CONTRACTS: Dict[str, Dict[str, Tuple[str, Tuple[str, ...]]]] = {
         "pod_valid": ("BOOL_DTYPE", ("P",)),
         "forced": ("BOOL_DTYPE", ("P",)),
     },
+    # native scan attribution buffers (abi v5): marshalled by
+    # nativepath.schedule into ScanArgs.bail_out/class_steps; contracting
+    # them here lets OSL1804 gate the ctypes packing AND the C++ pointer
+    # width against one declared policy (counts accumulate in i64 like
+    # filter_rejects — a 32-bit slot would wrap on long campaign runs)
+    "run_scan": {
+        "bail_out": ("INT64_DTYPE", ("B",)),
+        "class_steps": ("INT64_DTYPE", ("K",)),
+    },
 }
 
 #: Parameter names conventionally bound to contract-carrying structs at the

@@ -173,6 +173,11 @@ def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=N
         # decision audit (explain=1): per-filter reject totals, kernel
         # filter-index order (always marshalled; only written under explain)
         "filter_rejects": np.zeros(kernels.NUM_FILTERS, np.int64),
+        # incremental-carry attribution (abi v5): why the envelope
+        # disengaged (_BAIL_REASONS order) + which carry classes served
+        # incremental steps (_CARRY_CLASSES order)
+        "bail_out": np.zeros(len(_BAIL_REASONS), np.int64),
+        "class_steps": np.zeros(len(_CARRY_CLASSES), np.int64),
     }
 
     dims = {
@@ -237,7 +242,8 @@ def schedule(prep, pod_valid: np.ndarray, config=None, node_valid=None, forced=N
     }
     native.run_scan(dims, weights, buffers)
 
-    stats = _path_stats(outputs["path_counts"], outputs["profile_out"])
+    stats = _path_stats(outputs["path_counts"], outputs["profile_out"],
+                        outputs["bail_out"], outputs["class_steps"])
     _attach_profile_spans(stats, P)
     return ScheduleOutput(
         chosen=outputs["chosen"],
@@ -280,6 +286,18 @@ def _attach_profile_spans(stats: dict, n_pods: int) -> None:
 
 _PROFILE_PHASES = ("delta", "full_eval", "argmax", "bind", "fail", "generic")
 
+# scan_engine.cc `enum Bail` slot order (abi v5): the three whole-scan
+# envelope gates, then the per-delta bail classes. A nonzero count names
+# exactly which gate closed the incremental path for a workload.
+_BAIL_REASONS = (
+    "force_generic", "explain", "cs",
+    "ports", "gpu", "local", "gc_dyn", "fit", "spread", "interpod", "pending",
+)
+
+# ScanArgs.class_steps slot order: incremental steps served with each
+# resource-class carry active (score = dynamic share and/or local score)
+_CARRY_CLASSES = ("ports", "gpu", "local", "score")
+
 # most recent scan's per-phase timings (OPENSIM_NATIVE_PROFILE only) — read
 # by bench.py to put a structured `native_profile` field on its JSON line.
 # Cleared at the start of every schedule() call so a run that never reached
@@ -295,10 +313,15 @@ def last_profile():
     return _LAST_PROFILE[0]
 
 
-def _path_stats(path_counts: np.ndarray, profile_out: np.ndarray) -> dict:
+def _path_stats(path_counts: np.ndarray, profile_out: np.ndarray,
+                bail_out: np.ndarray = None, class_steps: np.ndarray = None) -> dict:
     """Engine path attribution (ISSUE 4 satellite: a silent incremental-cache
     disengage must be visible): which evaluation path served the scheduled
-    steps, plus the per-phase OPENSIM_NATIVE_PROFILE timings when enabled."""
+    steps, plus the per-phase OPENSIM_NATIVE_PROFILE timings when enabled.
+    abi v5 adds *why* attribution: nonzero bail-reason counts under
+    ``steps["bails"]`` and per-carry-class engagement under
+    ``steps["classes"]`` (additive keys — rest._Metrics.record() only reads
+    the incremental/generic pair, so older consumers are unaffected)."""
     inc, gen, full = (int(x) for x in path_counts)
     if inc and gen:
         path = "mixed"
@@ -312,6 +335,14 @@ def _path_stats(path_counts: np.ndarray, profile_out: np.ndarray) -> dict:
         "path": path,
         "steps": {"incremental": inc, "generic": gen, "full_evals": full},
     }
+    if bail_out is not None:
+        bails = {_BAIL_REASONS[k]: int(v) for k, v in enumerate(bail_out) if v}
+        if bails:
+            stats["steps"]["bails"] = bails
+    if class_steps is not None:
+        classes = {_CARRY_CLASSES[k]: int(v) for k, v in enumerate(class_steps) if v}
+        if classes:
+            stats["steps"]["classes"] = classes
     if profile_out.any():
         stats["profile"] = {
             _PROFILE_PHASES[k]: {

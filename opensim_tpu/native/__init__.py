@@ -31,7 +31,7 @@ _SRC = _HERE / "scan_engine.cc"
 #: expected ``opensim_abi_version()`` — the machine-readable anchor the
 #: OSL1604 abi-parity pass checks against scan_engine.cc, and the runtime
 #: load gate below checks against the compiled library
-ABI_VERSION = 4
+ABI_VERSION = 5
 
 _DIMS = [
     "N", "R", "U", "P", "Tk", "Dp1", "A", "Hp", "Hports", "Cs", "Ti", "Tn",
@@ -95,6 +95,10 @@ _BUFFERS = [
     # decision audit (explain=1, abi v4): per-template static-filter fail
     # counts in, 11-slot per-filter reject totals out
     ("static_fail", _I32, "i32"), ("filter_rejects", _I64, "i64"),
+    # incremental-carry attribution (abi v5): 11-slot bail-reason counts
+    # (nativepath._BAIL_REASONS order) and 4-slot per-carry-class
+    # incremental step counts (ports, gpu, local, score)
+    ("bail_out", _I64, "i64"), ("class_steps", _I64, "i64"),
 ]
 
 _NP_DTYPES = {

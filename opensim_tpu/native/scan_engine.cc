@@ -154,10 +154,20 @@ struct ScanArgs {
   // (kernel filter-index order; int64 — P×N node verdicts overflow i32)
   const int32_t* static_fail;  // [U,4]
   int64_t* filter_rejects;     // [11]
+  // --- incremental-carry attribution (abi v5) ---
+  // bail_out: why the incremental envelope disengaged — the three whole-scan
+  // gates (force_generic/explain/Cs) counted once per scan, plus the
+  // per-delta bail classes (ports/gpu/local/gc_dyn/fit/spread/interpod/
+  // pending), slot order mirrored by nativepath._BAIL_REASONS.
+  // class_steps: incremental steps served with each resource-class carry
+  // active (ports, gpu-share, local-PV filter, dynamic score), so the
+  // engagement gate can assert the new envelope actually ran.
+  int64_t* bail_out;     // [11]
+  int64_t* class_steps;  // [4]
 };
 // abi-end: ScanArgs
 
-int64_t opensim_abi_version() { return 4; }
+int64_t opensim_abi_version() { return 5; }
 int64_t opensim_args_size() { return (int64_t)sizeof(ScanArgs); }
 
 }  // extern "C"
@@ -167,6 +177,14 @@ namespace {
 // Dynamic-filter slots, same order as kernels.pod_step's `masks` list
 // (F_PORTS..F_EXTRA − F_PORTS).
 enum Stage { S_PORTS = 0, S_FIT, S_SPREAD, S_INTERPOD, S_GPU, S_LOCAL, S_EXTRA, N_STAGES };
+
+// bail_out slots (nativepath._BAIL_REASONS order): B_FORCE/B_EXPLAIN/B_CS
+// are whole-scan envelope gates; the rest name which carry class's
+// feasibility/verdict shift forced a delta back to full evaluation.
+enum Bail {
+  B_FORCE = 0, B_EXPLAIN, B_CS, B_PORTS, B_GPU, B_LOCAL, B_GCDYN,
+  B_FIT, B_SPREAD, B_INTERPOD, B_PENDING, N_BAILS
+};
 
 struct Scratch {
   std::vector<uint8_t> mask[N_STAGES];  // per-stage node masks (active stages only)
@@ -238,6 +256,26 @@ struct TmplCache {
   std::vector<HardSpread> hards;
   std::vector<uint8_t> sh_mask;  // [N] AND over hards (valid when any)
   bool has_hard = false;
+  // per-resource-class carry (abi v5 envelope): a bind mutates ONLY the
+  // bound node's port_used/gpu_free/gc_dyn/vg_free/dev_free rows, so the
+  // delta recomputes that one node's verdict/raw with the exact single-node
+  // helper the batch pass uses, and any feasibility flip routes through the
+  // bail-to-full-eval contract (reductions stay over a frozen feasible set)
+  bool pt_act = false;           // host-port conflicts possible (cf_ports)
+  std::vector<int32_t> pt_ids;   // the template's port ids
+  std::vector<uint8_t> pt_mask;  // [N] (pt_act)
+  bool gp_act = false;           // gpu_mem[u] > 0 (cf_gpu)
+  float gp_memq = 1.0f, gp_cnt = 0.0f;
+  std::vector<uint8_t> gp_mask;  // [N] (gp_act)
+  bool lc_f_act = false;         // template carries local-PV requests (cf_local)
+  std::vector<uint8_t> lc_mask;  // [N] (lc_f_act)
+  bool sh_dyn = false;           // share term reads gc_dyn (gc_req > 0)
+  bool sh_hi_stale = false, sh_lo_stale = false;
+  std::vector<float> sh_val;     // [N] dynamic share value (sh_dyn)
+  bool lc_s_act = false;         // nonzero w_local with local requests
+  bool lcs_hi_stale = false, lcs_lo_stale = false;
+  std::vector<float> lc_raw;     // [N] local score raw (lc_s_act)
+  float lc_lo = 0, lc_hi = 0, lc_rng = 0;
   std::vector<float> pre;         // bal+least+na+tt accumulated in pod_step order
   std::vector<float> spr_raw, share_term, av_term, score;
   float sh_lo = 0, sh_hi = 0, sh_rng = 0, na_max = 0, tt_max = 0;
@@ -331,15 +369,22 @@ inline float share_at(const ScanArgs& a, const float* gc_dyn, int32_t u, int64_t
   return s;
 }
 
-inline uint8_t fit_at(const ScanArgs& a, int32_t u, int64_t n) {
-  // incremental-cache path only; inc_ok excludes ft_gc_dyn, so the static
-  // alloc row is always correct here (keep the tight loop branch-free)
+inline uint8_t fit_at(const ScanArgs& a, const float* gcd, int32_t u, int64_t n) {
+  // incremental-cache path only; mirrors fit_mask's two loop bodies so the
+  // single-node probe is bit-identical to the batch pass in both modes
   const float* req = a.req + (int64_t)u * a.R;
-  const float* al = a.alloc + n * a.R;
   const float* us = a.used + n * a.R;
+  if (!a.ft_gc_dyn) {
+    // static alloc row: keep the tight loop branch-free
+    const float* al = a.alloc + n * a.R;
+    uint8_t ok = 1;
+    for (int64_t r = 0; r < a.R; r++)
+      ok &= (uint8_t)(!(req[r] > 0.0f && us[r] + req[r] > al[r]));
+    return ok;
+  }
   uint8_t ok = 1;
   for (int64_t r = 0; r < a.R; r++)
-    ok &= (uint8_t)(!(req[r] > 0.0f && us[r] + req[r] > al[r]));
+    ok &= (uint8_t)(!(req[r] > 0.0f && us[r] + req[r] > alloc_at(a, gcd, n, r)));
   return ok;
 }
 
@@ -400,29 +445,35 @@ inline float spr_raw_at(const ScanArgs& a, int32_t u, int64_t n, bool* all_label
 // ---- filter stages (kernels.py ports_filter / fit_filter / spread_filter /
 // interpod_filter / gpu_filter / local_filter) ----
 
+// Single-node port verdict — the loop body of ports_mask, shared with the
+// incremental cache's bound-node recomputation (a bind only ADDS port usage
+// on one node, so every other node's cached verdict stays valid).
+inline uint8_t ports_ok_at(const ScanArgs& a, const int32_t* pids, size_t np,
+                           int64_t n) {
+  const int64_t Hq = a.Hports;
+  bool conflict = false;
+  const float* pu = a.port_used + n * Hq;
+  for (size_t k = 0; k < np && !conflict; k++) {
+    const uint8_t* crow = a.port_conflict + (int64_t)pids[k] * Hq;
+    for (int64_t q = 0; q < Hq; q++)
+      if (crow[q] && pu[q] > 0.0f) { conflict = true; break; }
+  }
+  return (uint8_t)!conflict;
+}
+
 void ports_mask(const ScanArgs& a, int32_t u, uint8_t* out) {
-  const int64_t N = a.N, Hp = a.Hp, Hq = a.Hports;
+  const int64_t N = a.N, Hp = a.Hp;
   std::vector<int32_t> pids;
   pids.reserve(Hp);
   for (int64_t h = 0; h < Hp; h++) {
     int32_t p = a.ports[u * Hp + h];
     if (p >= 0) pids.push_back(p);
   }
-  const size_t np = pids.size();
-  if (np == 0) {
+  if (pids.empty()) {
     std::memset(out, 1, N);
     return;
   }
-  for (int64_t n = 0; n < N; n++) {
-    bool conflict = false;
-    const float* pu = a.port_used + n * Hq;
-    for (size_t k = 0; k < np && !conflict; k++) {
-      const uint8_t* crow = a.port_conflict + (int64_t)pids[k] * Hq;
-      for (int64_t q = 0; q < Hq; q++)
-        if (crow[q] && pu[q] > 0.0f) { conflict = true; break; }
-    }
-    out[n] = !conflict;
-  }
+  for (int64_t n = 0; n < N; n++) out[n] = ports_ok_at(a, pids.data(), pids.size(), n);
 }
 
 void fit_mask(const ScanArgs& a, const float* gc_dyn, int32_t u, uint8_t* out) {
@@ -546,8 +597,17 @@ void interpod_mask(const ScanArgs& a, const Scratch& s, int32_t u, uint8_t* out)
   for (int64_t n = 0; n < N; n++) out[n] = ip_mask_at(a, u, n, b.any_at, b.bootstrap);
 }
 
+// Single-node gpu-share verdict — the loop body of gpu_mask, shared with
+// the incremental cache (gpu_free changes only on the bound node at bind).
+inline uint8_t gpu_ok_at(const ScanArgs& a, float memq, float cnt, int64_t n) {
+  const float* free = a.gpu_free + n * a.Gd;
+  float chunks = 0.0f;
+  for (int64_t d = 0; d < a.Gd; d++) chunks += std::floor(free[d] / memq);
+  return (uint8_t)((chunks >= cnt) && (cnt > 0.0f));
+}
+
 void gpu_mask(const ScanArgs& a, int32_t u, uint8_t* out) {
-  const int64_t N = a.N, Gd = a.Gd;
+  const int64_t N = a.N;
   float mem = a.gpu_mem[u];
   if (!(mem > 0.0f)) {
     std::memset(out, 1, N);
@@ -555,41 +615,41 @@ void gpu_mask(const ScanArgs& a, int32_t u, uint8_t* out) {
   }
   float memq = std::max(mem, 1.0f);
   float cnt = (float)a.gpu_count[u];
-  for (int64_t n = 0; n < N; n++) {
-    const float* free = a.gpu_free + n * Gd;
-    float chunks = 0.0f;
-    for (int64_t d = 0; d < Gd; d++) chunks += std::floor(free[d] / memq);
-    out[n] = (chunks >= cnt) && (cnt > 0.0f);
+  for (int64_t n = 0; n < N; n++) out[n] = gpu_ok_at(a, memq, cnt, n);
+}
+
+// Single-node local-PV verdict — the loop body of local_mask, shared with
+// the incremental cache (vg_free/dev_free change only on the bound node).
+inline uint8_t local_ok_at(const ScanArgs& a, int32_t u, int64_t n) {
+  const int64_t Vg = a.Vg, Dv = a.Dv, Mv = a.Mv;
+  float lvm = a.lvm_req[u];
+  bool ok = true;
+  if (lvm > 0.0f) {
+    float best = -BIG;
+    const float* vf = a.vg_free + n * Vg;
+    for (int64_t v = 0; v < Vg; v++) best = std::max(best, vf[v]);
+    ok = best >= lvm;
   }
+  // exclusive devices: Hall's condition on nested fit sets (volumes
+  // sorted descending — common.go:290-349)
+  for (int media = 0; media < 2 && ok; media++) {
+    const float* sizes = a.dev_req_sizes + ((int64_t)u * 2 + media) * Mv;
+    const float* df = a.dev_free + n * Dv;
+    const int32_t* dm = a.node_dev_media + n * Dv;
+    for (int64_t i = 0; i < Mv; i++) {
+      if (!(sizes[i] > 0.0f)) continue;
+      int fit_cnt = 0;
+      for (int64_t d = 0; d < Dv; d++)
+        if (dm[d] == media && df[d] >= sizes[i] && df[d] > 0.0f) fit_cnt++;
+      if (fit_cnt < (int)(i + 1)) { ok = false; break; }
+    }
+  }
+  return (uint8_t)ok;
 }
 
 void local_mask(const ScanArgs& a, int32_t u, uint8_t* out) {
-  const int64_t N = a.N, Vg = a.Vg, Dv = a.Dv, Mv = a.Mv;
-  float lvm = a.lvm_req[u];
-  for (int64_t n = 0; n < N; n++) {
-    bool ok = true;
-    if (lvm > 0.0f) {
-      float best = -BIG;
-      const float* vf = a.vg_free + n * Vg;
-      for (int64_t v = 0; v < Vg; v++) best = std::max(best, vf[v]);
-      ok = best >= lvm;
-    }
-    // exclusive devices: Hall's condition on nested fit sets (volumes
-    // sorted descending — common.go:290-349)
-    for (int media = 0; media < 2 && ok; media++) {
-      const float* sizes = a.dev_req_sizes + ((int64_t)u * 2 + media) * Mv;
-      const float* df = a.dev_free + n * Dv;
-      const int32_t* dm = a.node_dev_media + n * Dv;
-      for (int64_t i = 0; i < Mv; i++) {
-        if (!(sizes[i] > 0.0f)) continue;
-        int fit_cnt = 0;
-        for (int64_t d = 0; d < Dv; d++)
-          if (dm[d] == media && df[d] >= sizes[i] && df[d] > 0.0f) fit_cnt++;
-        if (fit_cnt < (int)(i + 1)) { ok = false; break; }
-      }
-    }
-    out[n] = ok;
-  }
+  const int64_t N = a.N;
+  for (int64_t n = 0; n < N; n++) out[n] = local_ok_at(a, u, n);
 }
 
 // ---- score raws ----
@@ -653,40 +713,46 @@ bool spread_raw(const ScanArgs& a, int32_t u, const uint8_t* feas, float* out,
   return true;
 }
 
+// Single-node local score raw — the loop body of local_raw, shared with
+// the incremental cache (same float op order, so cached raws are
+// bit-identical to a full recomputation).
+inline float local_raw_at(const ScanArgs& a, int32_t u, int64_t n) {
+  const int64_t Vg = a.Vg, Dv = a.Dv;
+  float lvm = a.lvm_req[u];
+  const float* vf = a.vg_free + n * Vg;
+  const float* vc = a.node_vg_cap + n * Vg;
+  float tight_free = BIG;
+  int64_t choice = 0;
+  for (int64_t v = 0; v < Vg; v++) {
+    float masked = (vf[v] >= lvm) ? vf[v] : BIG;
+    if (masked < tight_free) { tight_free = masked; choice = v; }
+  }
+  float vg_cap = (Vg > 0) ? vc[choice] : 0.0f;
+  float parts = (lvm > 0.0f && tight_free < BIG) ? lvm / std::max(vg_cap, 1.0f) : 0.0f;
+  float count = (lvm > 0.0f) ? 1.0f : 0.0f;
+  for (int media = 0; media < 2; media++) {
+    float size = a.dev_req[(int64_t)u * 2 + media];
+    float n_dev = (float)a.dev_req_count[(int64_t)u * 2 + media];
+    const float* df = a.dev_free + n * Dv;
+    const int32_t* dm = a.node_dev_media + n * Dv;
+    float first_cap = BIG;
+    for (int64_t d = 0; d < Dv; d++) {
+      bool fitting = dm[d] == media && df[d] >= size && df[d] > 0.0f;
+      float cap = fitting ? a.node_dev_cap[n * Dv + d] : BIG;
+      if (cap < first_cap) first_cap = cap;
+    }
+    if (size > 0.0f) {
+      parts += n_dev * size / std::max(first_cap, 1.0f);
+      count += n_dev;
+    }
+  }
+  return (count > 0.0f) ? parts / std::max(count, 1.0f) * 10.0f : 0.0f;
+}
+
 void local_raw(const ScanArgs& a, int32_t u, float* out) {
   // local_score (open-local.go:94-138, vendored common.go:487-509,:660-690)
-  const int64_t N = a.N, Vg = a.Vg, Dv = a.Dv;
-  float lvm = a.lvm_req[u];
-  for (int64_t n = 0; n < N; n++) {
-    const float* vf = a.vg_free + n * Vg;
-    const float* vc = a.node_vg_cap + n * Vg;
-    float tight_free = BIG;
-    int64_t choice = 0;
-    for (int64_t v = 0; v < Vg; v++) {
-      float masked = (vf[v] >= lvm) ? vf[v] : BIG;
-      if (masked < tight_free) { tight_free = masked; choice = v; }
-    }
-    float vg_cap = (Vg > 0) ? vc[choice] : 0.0f;
-    float parts = (lvm > 0.0f && tight_free < BIG) ? lvm / std::max(vg_cap, 1.0f) : 0.0f;
-    float count = (lvm > 0.0f) ? 1.0f : 0.0f;
-    for (int media = 0; media < 2; media++) {
-      float size = a.dev_req[(int64_t)u * 2 + media];
-      float n_dev = (float)a.dev_req_count[(int64_t)u * 2 + media];
-      const float* df = a.dev_free + n * Dv;
-      const int32_t* dm = a.node_dev_media + n * Dv;
-      float first_cap = BIG;
-      for (int64_t d = 0; d < Dv; d++) {
-        bool fitting = dm[d] == media && df[d] >= size && df[d] > 0.0f;
-        float cap = fitting ? a.node_dev_cap[n * Dv + d] : BIG;
-        if (cap < first_cap) first_cap = cap;
-      }
-      if (size > 0.0f) {
-        parts += n_dev * size / std::max(first_cap, 1.0f);
-        count += n_dev;
-      }
-    }
-    out[n] = (count > 0.0f) ? parts / std::max(count, 1.0f) * 10.0f : 0.0f;
-  }
+  const int64_t N = a.N;
+  for (int64_t n = 0; n < N; n++) out[n] = local_raw_at(a, u, n);
 }
 
 // ---- bind (kernels.bind_update) ----
@@ -837,9 +903,9 @@ void fail_accounting(ScanArgs& a, Scratch& s, const bool* act, int32_t u, int64_
 }
 
 struct EnvCtx {
-  bool act_fit, act_spread, act_interpod;
-  bool use_spr, use_share, use_avoid, use_ip;
-  float wsp, wshare, wav, wip;
+  bool act_ports, act_fit, act_spread, act_interpod, act_gpu, act_local;
+  bool use_spr, use_share, use_avoid, use_ip, use_loc;
+  float wsp, wshare, wav, wip, wloc;
 };
 
 // Decision audit (explain=1): fold one step's first-fail attribution into
@@ -863,8 +929,11 @@ inline float recombine(const TmplCache& tc, const EnvCtx& e, int64_t n) {
   return sc;
 }
 
-// Full per-template evaluation into the cache (incremental envelope:
-// active dynamic masks ⊆ {fit, spread, interpod}, no local/gpu score).
+// Full per-template evaluation into the cache. The envelope covers every
+// dynamic mask (ports/fit/spread/interpod/gpu/local) and every score term:
+// the port/gpu/local carry is per-NODE (a bind touches one node's rows),
+// the spread/interpod carry per-DOMAIN, and anything a delta cannot prove
+// unchanged bails back here.
 void full_eval_env(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e,
                    PreCtx& c, int32_t u) {
   const int64_t N = a.N;
@@ -946,6 +1015,54 @@ void full_eval_env(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e,
     // a term-less template's raw is identically 0 → range 0 → the
     // normalized term is exactly 0 for every node: treat as inactive
   }
+
+  // per-resource-class carry: template-level activation + cached per-node
+  // verdicts. A class whose template carries no relevant request is all-pass
+  // (the batch mask memsets 1) — leave it inactive so deltas cost nothing.
+  tc.pt_act = false;
+  if (e.act_ports) {
+    tc.pt_ids.clear();
+    for (int64_t h = 0; h < a.Hp; h++) {
+      int32_t p = a.ports[(int64_t)u * a.Hp + h];
+      if (p >= 0) tc.pt_ids.push_back(p);
+    }
+    tc.pt_act = !tc.pt_ids.empty();
+    if (tc.pt_act)
+      for (int64_t n = 0; n < N; n++)
+        tc.pt_mask[n] = ports_ok_at(a, tc.pt_ids.data(), tc.pt_ids.size(), n);
+  }
+  tc.gp_act = false;
+  if (e.act_gpu && a.gpu_mem[u] > 0.0f) {
+    tc.gp_act = true;
+    tc.gp_memq = std::max(a.gpu_mem[u], 1.0f);
+    tc.gp_cnt = (float)a.gpu_count[u];
+    for (int64_t n = 0; n < N; n++)
+      tc.gp_mask[n] = gpu_ok_at(a, tc.gp_memq, tc.gp_cnt, n);
+  }
+  // local-PV activation: any LVM request, aggregate device request, or
+  // per-volume size (the filter reads sizes, the score reads aggregates —
+  // one conservative flag covers both; a miss only costs an all-pass mask
+  // or an identically-zero raw, never a wrong verdict)
+  bool loc_reqs = a.lvm_req[u] > 0.0f;
+  for (int media = 0; media < 2 && !loc_reqs; media++) {
+    if (a.dev_req[(int64_t)u * 2 + media] > 0.0f) loc_reqs = true;
+    for (int64_t v = 0; v < a.Mv && !loc_reqs; v++)
+      if (a.dev_req_sizes[((int64_t)u * 2 + media) * a.Mv + v] > 0.0f) loc_reqs = true;
+  }
+  tc.lc_f_act = e.act_local && loc_reqs;
+  if (tc.lc_f_act)
+    for (int64_t n = 0; n < N; n++) tc.lc_mask[n] = local_ok_at(a, u, n);
+  // a request-less template's local raw is identically 0 → range 0 → the
+  // generic path adds wloc·0 to every node: treat as inactive (±0 never
+  // moves a comparison)
+  tc.lc_s_act = e.use_loc && loc_reqs;
+  tc.lcs_hi_stale = tc.lcs_lo_stale = false;
+  // dynamic share: only templates REQUESTING gpu-count read gc_dyn through
+  // share_at; for the rest share_at degenerates to the static share_raw row
+  // (bit-identical), so the materialized share_term stays valid
+  tc.sh_dyn = e.use_share && a.ft_gc_dyn && a.res_gc >= 0 &&
+              a.req[(int64_t)u * a.R + a.res_gc] > 0.0f;
+  tc.sh_hi_stale = tc.sh_lo_stale = false;
 
   tc.any_soft = false;
   int n_soft = 0;
@@ -1049,9 +1166,12 @@ void full_eval_env(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e,
   const uint8_t* sp = a.static_pass + (int64_t)u * N;
   const float* share = a.share_raw + (int64_t)u * N;
   float na_m = NEG, tt_m = NEG, shlo = BIG, shhi = NEG;
-  float iphi = NEG, iplo = BIG;
+  float iphi = NEG, iplo = BIG, lclo = BIG, lchi = NEG;
   for (int64_t n = 0; n < N; n++) {
-    uint8_t f = sp[n] && (e.act_fit ? fit_at(a, u, n) : 1);
+    uint8_t f = sp[n] && (e.act_fit ? fit_at(a, s.gc_dyn_ptr(), u, n) : 1);
+    if (tc.pt_act) f = f && tc.pt_mask[n];
+    if (tc.gp_act) f = f && tc.gp_mask[n];
+    if (tc.lc_f_act) f = f && tc.lc_mask[n];
     if (tc.has_hard) f = f && tc.sh_mask[n];
     if (tc.ip_f_act) f = f && tc.ip_mask[n];
     tc.feas[n] = f;
@@ -1064,9 +1184,24 @@ void full_eval_env(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e,
     }
     if (c.use_na) na_m = std::max(na_m, f ? c.na[n] : 0.0f);
     if (c.use_tt) tt_m = std::max(tt_m, f ? c.tt[n] : 0.0f);
-    if (e.use_share && f) {
-      shlo = std::min(shlo, share[n]);
-      shhi = std::max(shhi, share[n]);
+    if (e.use_share) {
+      float shv = share[n];
+      if (tc.sh_dyn) {
+        shv = share_at(a, s.gc_dyn_ptr(), u, n);
+        tc.sh_val[n] = shv;
+      }
+      if (f) {
+        shlo = std::min(shlo, shv);
+        shhi = std::max(shhi, shv);
+      }
+    }
+    if (tc.lc_s_act) {
+      float lr = local_raw_at(a, u, n);
+      tc.lc_raw[n] = lr;
+      if (f) {
+        lclo = std::min(lclo, lr);
+        lchi = std::max(lchi, lr);
+      }
     }
     if (e.use_spr && tc.any_soft) {
       if (tc.dom_mode) {
@@ -1098,6 +1233,9 @@ void full_eval_env(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e,
   tc.sh_lo = shlo;
   tc.sh_hi = shhi;
   tc.sh_rng = shhi - shlo;
+  tc.lc_lo = lclo;
+  tc.lc_hi = lchi;
+  tc.lc_rng = lchi - lclo;
   tc.ip_rhi = iphi;
   tc.ip_rlo = iplo;
   if (e.use_spr && tc.any_soft) {
@@ -1150,11 +1288,12 @@ void full_eval_env(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e,
   }
   const float* avoid = a.avoid_score + (int64_t)u * N;
   // select combines on the fly (lazy) whenever a score term's
-  // normalization scalars can move between binds (soft spread, interpod)
-  const bool lazy = (e.use_spr && tc.any_soft) || tc.ip_s_act;
+  // normalization scalars can move between binds (soft spread, interpod,
+  // dynamic gpu-share, local-PV score)
+  const bool lazy = (e.use_spr && tc.any_soft) || tc.ip_s_act || tc.sh_dyn || tc.lc_s_act;
   for (int64_t n = 0; n < N; n++) {
     tc.pre[n] = pre_at(a, c, n);
-    if (e.use_share)
+    if (e.use_share && !tc.sh_dyn)
       tc.share_term[n] =
           e.wshare * (tc.sh_rng > 0.0f ? (share[n] - tc.sh_lo) * MAXS / tc.sh_rng : 0.0f);
     if (e.use_avoid) tc.av_term[n] = e.wav * avoid[n];
@@ -1163,8 +1302,10 @@ void full_eval_env(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e,
 }
 
 // Fold the pending binds into the cache. Returns false when something it
-// cannot prove unchanged shifted (feasible-set flip) — caller re-evaluates.
-bool apply_deltas(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e, PreCtx& c) {
+// cannot prove unchanged shifted (feasible-set flip) — caller re-evaluates;
+// *why names the carry class that bailed (Bail slot, for bail_out).
+bool apply_deltas(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e, PreCtx& c,
+                  int* why) {
   const int64_t N = a.N, Tk = a.Tk, Cs = a.Cs, A = a.A;
   const int32_t u = tc.u;
   const int32_t trash_d = (int32_t)a.Dp1 - 1;
@@ -1173,12 +1314,16 @@ bool apply_deltas(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e, PreCt
     // (it only ever goes true → false; counts grow) moves every node's
     // verdict at once — re-evaluate rather than patch
     IpBoot b = ip_boot_of(a, s, u);
-    if (b.bootstrap != tc.ip_bootstrap) return false;
+    if (b.bootstrap != tc.ip_bootstrap) { *why = B_INTERPOD; return false; }
   }
   // combined feasibility of node n from the cached masks + a fresh fit
   // probe (a pending bind may have changed n's own used row)
   auto feas_of = [&](int64_t n) -> uint8_t {
-    uint8_t f = a.static_pass[(int64_t)u * N + n] && (e.act_fit ? fit_at(a, u, n) : 1);
+    uint8_t f = a.static_pass[(int64_t)u * N + n] &&
+                (e.act_fit ? fit_at(a, s.gc_dyn_ptr(), u, n) : 1);
+    if (tc.pt_act) f = f && tc.pt_mask[n];
+    if (tc.gp_act) f = f && tc.gp_mask[n];
+    if (tc.lc_f_act) f = f && tc.lc_mask[n];
     if (tc.has_hard) f = f && tc.sh_mask[n];
     if (tc.ip_f_act) f = f && tc.ip_mask[n];
     return f;
@@ -1227,7 +1372,10 @@ bool apply_deltas(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e, PreCt
           }
           if (m == tc.sh_mask[n]) continue;
           tc.sh_mask[n] = m;
-          if (feas_of(n) != tc.feas[n]) return false;  // feasible set shifted
+          if (feas_of(n) != tc.feas[n]) {  // feasible set shifted
+            *why = B_SPREAD;
+            return false;
+          }
         }
     }
 
@@ -1266,7 +1414,7 @@ bool apply_deltas(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e, PreCt
         int32_t d = a.node_domain[j * Tk + a.anti_g_topo[g]];
         if (d < trash_d) visit_ipm(d);
       }
-      if (bail) return false;
+      if (bail) { *why = B_INTERPOD; return false; }
     }
 
     // --- interpod score raw: affected members + min/max upkeep --------
@@ -1308,9 +1456,69 @@ bool apply_deltas(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e, PreCt
       }
     }
 
+    // --- per-resource-class carry: the bind touched ONLY node j's
+    // port_used/gpu_free/gc_dyn/vg_free/dev_free rows — recompute j's
+    // verdicts with the exact single-node helpers; every other node's
+    // cached verdict is untouched by construction
+    int flip_why = B_FIT;
+    if (a.ft_gc_dyn && a.res_gc >= 0 && a.req[(int64_t)u * a.R + a.res_gc] > 0.0f)
+      flip_why = B_GCDYN;
+    if (tc.pt_act) {
+      uint8_t m = ports_ok_at(a, tc.pt_ids.data(), tc.pt_ids.size(), j);
+      if (m != tc.pt_mask[j]) { tc.pt_mask[j] = m; flip_why = B_PORTS; }
+    }
+    if (tc.gp_act) {
+      uint8_t m = gpu_ok_at(a, tc.gp_memq, tc.gp_cnt, j);
+      if (m != tc.gp_mask[j]) { tc.gp_mask[j] = m; flip_why = B_GPU; }
+    }
+    if (tc.lc_f_act) {
+      uint8_t m = local_ok_at(a, u, j);
+      if (m != tc.lc_mask[j]) { tc.lc_mask[j] = m; flip_why = B_LOCAL; }
+    }
     uint8_t f = feas_of(j);
-    if (f != tc.feas[j]) return false;  // feasible set shifted: reductions stale
+    if (f != tc.feas[j]) {  // feasible set shifted: reductions stale
+      *why = flip_why;
+      return false;
+    }
     tc.pre[j] = pre_at(a, c, j);
+    // dynamic score raws at j (share under gc_dyn, local-PV): min/max via
+    // the ip_rhi/ip_rlo stale-flag pattern — update in place when the new
+    // value extends the range, recompute exactly when an extremum holder
+    // moved inward (the feasible set is frozen: flips bailed above)
+    if (tc.sh_dyn) {
+      float nv = share_at(a, s.gc_dyn_ptr(), u, j);
+      float ov = tc.sh_val[j];
+      if (nv != ov) {
+        tc.sh_val[j] = nv;
+        if (tc.feas[j]) {  // reductions are over feasible nodes only
+          if (ov == tc.sh_hi && nv < ov)
+            tc.sh_hi_stale = true;
+          else if (nv > tc.sh_hi)
+            tc.sh_hi = nv;
+          if (ov == tc.sh_lo && nv > ov)
+            tc.sh_lo_stale = true;
+          else if (nv < tc.sh_lo)
+            tc.sh_lo = nv;
+        }
+      }
+    }
+    if (tc.lc_s_act) {
+      float nv = local_raw_at(a, u, j);
+      float ov = tc.lc_raw[j];
+      if (nv != ov) {
+        tc.lc_raw[j] = nv;
+        if (tc.feas[j]) {
+          if (ov == tc.lc_hi && nv < ov)
+            tc.lcs_hi_stale = true;
+          else if (nv > tc.lc_hi)
+            tc.lc_hi = nv;
+          if (ov == tc.lc_lo && nv > ov)
+            tc.lcs_lo_stale = true;
+          else if (nv < tc.lc_lo)
+            tc.lc_lo = nv;
+        }
+      }
+    }
 
     if (e.use_spr && tc.any_soft && tc.dom_mode) {
       // single soft constraint: every member of j's domain shares one raw
@@ -1432,7 +1640,8 @@ bool apply_deltas(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e, PreCt
       // unchanged). A moved normalization scalar therefore costs nothing
       // here, where it used to rewrite term+score over the node axis.
     }
-    if (!(e.use_spr && tc.any_soft) && !tc.ip_s_act && tc.feas[j])
+    if (!(e.use_spr && tc.any_soft) && !tc.ip_s_act && !tc.sh_dyn && !tc.lc_s_act &&
+        tc.feas[j])
       tc.score[j] = recombine(tc, e, j);
   }
   if (tc.ip_hi_stale || tc.ip_lo_stale) {
@@ -1448,6 +1657,30 @@ bool apply_deltas(ScanArgs& a, Scratch& s, TmplCache& tc, const EnvCtx& e, PreCt
     tc.ip_rlo = lo;
     tc.ip_hi_stale = tc.ip_lo_stale = false;
   }
+  if (tc.sh_hi_stale || tc.sh_lo_stale) {
+    float hi = NEG, lo = BIG;
+    for (int64_t n = 0; n < N; n++)
+      if (tc.feas[n]) {
+        hi = std::max(hi, tc.sh_val[n]);
+        lo = std::min(lo, tc.sh_val[n]);
+      }
+    tc.sh_hi = hi;
+    tc.sh_lo = lo;
+    tc.sh_hi_stale = tc.sh_lo_stale = false;
+  }
+  if (tc.sh_dyn) tc.sh_rng = tc.sh_hi - tc.sh_lo;
+  if (tc.lcs_hi_stale || tc.lcs_lo_stale) {
+    float hi = NEG, lo = BIG;
+    for (int64_t n = 0; n < N; n++)
+      if (tc.feas[n]) {
+        hi = std::max(hi, tc.lc_raw[n]);
+        lo = std::min(lo, tc.lc_raw[n]);
+      }
+    tc.lc_hi = hi;
+    tc.lc_lo = lo;
+    tc.lcs_hi_stale = tc.lcs_lo_stale = false;
+  }
+  if (tc.lc_s_act) tc.lc_rng = tc.lc_hi - tc.lc_lo;
   tc.pending.clear();
   return true;
 }
@@ -1541,24 +1774,40 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
   const bool use_loc = a.ft_local && a.w_local != 0.0;
   const bool use_avoid = a.ft_prefer_avoid && a.w_prefer_avoid != 0.0;
 
-  // Incremental same-template envelope: active dynamic masks ⊆ {fit,
-  // spread, interpod} and score components limited to those whose carry
-  // dependencies are tracked per domain (used/dom_sel/dom_anti/dom_prefw
-  // — local reads vg/dev state, gpu-share reads gpu_free).
-  // OPENSIM_NATIVE_FORCE_GENERIC=1 disables the envelope outright (parity
-  // harness + attribution: a tuned number must name the path that made it).
+  // Incremental same-template envelope: every dynamic mask and score term
+  // now has carry — per-domain for spread/interpod (dom_sel/dom_anti/
+  // dom_prefw), per-NODE for ports/gpu-share/local-PV/gc_dyn (a bind
+  // mutates only the bound node's port_used/gpu_free/vg_free/dev_free
+  // rows). Only the whole-scan gates remain: explain (audits every step's
+  // verdict masks — only the generic path materializes them), a Cs beyond
+  // the spread-carry bound, and the force-generic escape hatch
+  // (OPENSIM_NATIVE_FORCE_GENERIC=1, parity harness + attribution: a tuned
+  // number must name the path that made it).
   const char* fg_env = std::getenv("OPENSIM_NATIVE_FORCE_GENERIC");
   const bool force_generic = fg_env && fg_env[0] && std::strcmp(fg_env, "0") != 0;
-  // explain mode audits every step's verdict masks — only the generic path
-  // materializes them (the incremental cache's whole point is NOT to)
   const bool explain = a.explain != 0;
-  const bool inc_ok = !force_generic && !explain && !act_ports && !act_gpu &&
-                      !act_local && !use_loc && !a.ft_gc_dyn && a.Cs <= 16;
+  const bool inc_ok = !force_generic && !explain && a.Cs <= 16;
+  if (!inc_ok && a.bail_out) {
+    // envelope-gate attribution: one count per scan per closed gate
+    if (force_generic) a.bail_out[B_FORCE]++;
+    if (explain) a.bail_out[B_EXPLAIN]++;
+    if (a.Cs > 16) a.bail_out[B_CS]++;
+  }
   constexpr size_t MAX_PENDING = 8;
   TmplCache tc;
-  EnvCtx env{act_fit, act_spread, act_interpod, use_spr, use_share,
-             use_avoid, use_ip, wsp, wshare, wav, wip};
+  EnvCtx env{act_ports, act_fit, act_spread, act_interpod, act_gpu, act_local,
+             use_spr, use_share, use_avoid, use_ip, use_loc,
+             wsp, wshare, wav, wip, wloc};
   int32_t n_inc = 0, n_gen = 0, n_full = 0;  // path attribution
+  // engagement attribution: incremental steps served with each carry class
+  // active (nativepath "classes" keys: ports, gpu, local, score)
+  auto count_classes = [&](const TmplCache& t) {
+    if (!a.class_steps) return;
+    if (t.pt_act) a.class_steps[0]++;
+    if (t.gp_act) a.class_steps[1]++;
+    if (t.lc_f_act) a.class_steps[2]++;
+    if (t.sh_dyn || t.lc_s_act) a.class_steps[3]++;
+  };
   if (inc_ok) {
     tc.feas.resize(N);
     tc.ignored.resize(N);
@@ -1572,6 +1821,11 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
     if (act_interpod) tc.ip_mask.resize(N);
     if (use_ip) tc.ip_raw.resize(N);
     if (act_spread) tc.sh_mask.resize(N);
+    if (act_ports) tc.pt_mask.resize(N);
+    if (act_gpu) tc.gp_mask.resize(N);
+    if (act_local) tc.lc_mask.resize(N);
+    if (use_loc) tc.lc_raw.resize(N);
+    if (use_share && a.ft_gc_dyn) tc.sh_val.resize(N);
     // per-domain node lists for the delta path (a real domain belongs to
     // exactly one topology key; the shared trash row gets per-key lists)
     s.dom_members.resize(a.Dp1);
@@ -1608,7 +1862,10 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
         for (int64_t d = 0; d < Gd; d++) a.gpu_take[i * Gd + d] = s.take[d];
         if (tc.valid) {
           tc.pending.push_back({p, u});
-          if (tc.pending.size() > MAX_PENDING) tc.valid = false;
+          if (tc.pending.size() > MAX_PENDING) {
+            tc.valid = false;
+            if (a.bail_out) a.bail_out[B_PENDING]++;
+          }
         }
       }
       continue;
@@ -1641,13 +1898,16 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
         // state untouched since the failed evaluation → identical verdict
         for (int k = 0; k < N_STAGES; k++) a.fail_counts[i * N_STAGES + k] = tc.fail_row[k];
         for (int64_t r = 0; r < R; r++) a.insufficient[i * R + r] = tc.ins_row[r];
+        count_classes(tc);
         continue;
       }
       prof.start();
       if (cached && !tc.pending.empty()) {
-        if (!apply_deltas(a, s, tc, env, pc)) {
+        int why = B_FIT;
+        if (!apply_deltas(a, s, tc, env, pc, &why)) {
           tc.valid = false;
           cached = false;
+          if (a.bail_out) a.bail_out[why]++;
         }
         prof.stop(0);
       }
@@ -1657,6 +1917,7 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
         n_full++;
         prof.stop(1);
       }
+      count_classes(tc);
 
       prof.start();
       // two-pass first-argmax: a branchless masked max (vectorizes), then
@@ -1669,7 +1930,9 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
       const uint8_t* fe = tc.feas.data();
       const bool lazy_spr = env.use_spr && tc.any_soft;
       const bool uip = tc.ip_s_act;
-      const bool lazy = lazy_spr || uip;
+      const bool shd = tc.sh_dyn;
+      const bool ulc = tc.lc_s_act;
+      const bool lazy = lazy_spr || uip || shd || ulc;
       const bool dm = tc.dom_mode;
       const bool hm = tc.hier_mode;
       const bool hff = tc.hier_fine_first;
@@ -1700,6 +1963,16 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
                             ? MAXS * (ipr[n] - l_ip_lo) / std::max(l_ip_rng, 1.0f)
                             : 0.0f);
       };
+      // dynamic share + local-PV score terms (abi v5): normalization
+      // scalars maintained across deltas, combined with the generic
+      // path's exact float expressions and term order (share before
+      // local, both before avoid)
+      const float* shv = shd ? tc.sh_val.data() : nullptr;
+      const float l_sh_lo = tc.sh_lo, l_sh_rng = tc.sh_rng;
+      const float l_wshare = env.wshare;
+      const float* lcr = ulc ? tc.lc_raw.data() : nullptr;
+      const float l_lc_lo = tc.lc_lo, l_lc_rng = tc.lc_rng;
+      const float l_wloc = env.wloc;
       auto sc_at = [&](int64_t n) -> float {
         if (!lazy) return sc[n];
         float v = pre[n];
@@ -1717,7 +1990,16 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
           norm = ig[n] ? 0.0f : norm;
           v += l_wsp * norm;
         }
-        if (ush) v += sht[n];
+        if (ush) {
+          if (shd)
+            v += l_wshare *
+                 (l_sh_rng > 0.0f ? (shv[n] - l_sh_lo) * MAXS / l_sh_rng : 0.0f);
+          else
+            v += sht[n];
+        }
+        if (ulc)
+          v += l_wloc *
+               (l_lc_rng > 0.0f ? (lcr[n] - l_lc_lo) * MAXS / l_lc_rng : 0.0f);
         if (uav) v += avt[n];
         return v;
       };
@@ -1772,7 +2054,16 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
         float v = pre[n];
         if (uip) v += ip_term(n);
         v += t;
-        if (ush) v += sht[n];
+        if (ush) {
+          if (shd)
+            v += l_wshare *
+                 (l_sh_rng > 0.0f ? (shv[n] - l_sh_lo) * MAXS / l_sh_rng : 0.0f);
+          else
+            v += sht[n];
+        }
+        if (ulc)
+          v += l_wloc *
+               (l_lc_rng > 0.0f ? (lcr[n] - l_lc_lo) * MAXS / l_lc_rng : 0.0f);
         if (uav) v += avt[n];
         return v;
       };
@@ -1830,12 +2121,15 @@ extern "C" int opensim_run_scan(ScanArgs* ap) {
     selected:
       if (bi < 0) {
         prof.start();
-        // fail_accounting reads every ACTIVE stage mask; under the widened
-        // envelope that can include spread/interpod (ports/gpu/local are
-        // excluded by inc_ok)
+        // fail_accounting reads every ACTIVE stage mask; under the v5
+        // envelope that is any dynamic stage (ports/fit/spread/interpod/
+        // gpu/local) — materialized here only on the cold failure path
+        if (act_ports) ports_mask(a, u, s.mask[S_PORTS].data());
         if (act_fit) fit_mask(a, s.gc_dyn_ptr(), u, s.mask[S_FIT].data());
         if (act_spread) spread_mask(a, u, s.mask[S_SPREAD].data());
         if (act_interpod) interpod_mask(a, s, u, s.mask[S_INTERPOD].data());
+        if (act_gpu) gpu_mask(a, u, s.mask[S_GPU].data());
+        if (act_local) local_mask(a, u, s.mask[S_LOCAL].data());
         fail_accounting(a, s, act, u, i);
         tc.prev_failed = true;
         for (int k = 0; k < N_STAGES; k++) tc.fail_row[k] = a.fail_counts[i * N_STAGES + k];

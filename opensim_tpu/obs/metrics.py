@@ -85,6 +85,8 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
     "simon_snapshot_stale_served_total": ("Requests served from a stale snapshot", "counter"),
     "simon_stale_prep_retries_total": ("Stale prep-cache internal retries", "counter"),
     "simon_native_steps_total": ("C++ engine scheduled steps by evaluation path", "counter"),
+    # cardinality contract: reason ∈ nativepath._BAIL_REASONS (11 values)
+    "simon_native_bail_total": ("Incremental-carry envelope bails by gate/flip reason", "counter"),
     "simon_engine_breaker_trips_total": ("Engine circuit-breaker trips", "counter"),
     "simon_engine_breaker_open": ("Engine breaker open (1) or closed (0)", "gauge"),
     "simon_faults_injected_total": ("Chaos faults injected by point", "counter"),

@@ -108,6 +108,12 @@ class _Metrics:
         # the incremental cache vs the generic re-evaluation — a silent
         # cache disengage shows up here, not just in wall-clock
         self.native_steps = {"incremental": 0, "generic": 0}
+        # bail-reason attribution (abi v5): WHY the incremental envelope
+        # disengaged, keyed by nativepath._BAIL_REASONS (sparse — only
+        # reasons actually seen), and which carry classes the incremental
+        # steps actually exercised (nativepath._CARRY_CLASSES)
+        self.native_bails: dict = {}
+        self.native_classes: dict = {}
 
     def record(self, endpoint: str, result: SimulateResult) -> None:
         # simulate wall time is no longer hand-summed here: the request
@@ -123,6 +129,27 @@ class _Metrics:
                     self.native_steps[path] += int(
                         result.engine.native_steps.get(path, 0)
                     )
+                bails = result.engine.native_steps.get("bails") or {}
+                for reason, n in bails.items():
+                    self.native_bails[reason] = (
+                        self.native_bails.get(reason, 0) + int(n)
+                    )
+                classes = result.engine.native_steps.get("classes") or {}
+                for klass, n in classes.items():
+                    self.native_classes[klass] = (
+                        self.native_classes.get(klass, 0) + int(n)
+                    )
+
+    def native_snapshot(self) -> dict:
+        """Cumulative C++ path attribution for ``/api/debug/profile``
+        (rendered by ``simon profile``): step counts by evaluation path,
+        bail reasons, and per-carry-class incremental step counts."""
+        with self.lock:
+            return {
+                "steps": dict(self.native_steps),
+                "bails": dict(self.native_bails),
+                "classes": dict(self.native_classes),
+            }
 
     def bump(self, counter: str, n: int = 1) -> None:
         with self.lock:
@@ -183,6 +210,11 @@ class _Metrics:
                 *(
                     f'simon_native_steps_total{{path="{esc(p)}"}} {n}'
                     for p, n in sorted(self.native_steps.items())
+                ),
+                *hdr("simon_native_bail_total"),
+                *(
+                    f'simon_native_bail_total{{reason="{esc(r)}"}} {n}'
+                    for r, n in sorted(self.native_bails.items())
                 ),
             ]
         breakers = sorted(breaker_mod.all_breakers().items())
@@ -1566,6 +1598,10 @@ def make_handler(server: SimonServer):
 
                 try:
                     payload = profile_mod.debug_payload()
+                    # C++ path attribution (abi v5): envelope engagement,
+                    # bail reasons, and carry-class coverage for the
+                    # `simon profile` native table
+                    payload["native"] = METRICS.native_snapshot()
                     adm = server.admission
                     if adm is not None:
                         # pipelined-admission stage aggregates (ISSUE 16):

@@ -1,5 +1,5 @@
 """OSL1604 ABI-drift regression matrix (detector-awake for the parity
-pass): copies of the REAL abi-v4 native sources are mutated one axis at a
+pass): copies of the REAL abi-v5 native sources are mutated one axis at a
 time — field order, pointer width, abi version, serial wire tag — and the
 rule must fire naming the exact drifted field; the unmutated copies must
 stay green."""
@@ -39,7 +39,7 @@ def _edit(dst, name, old, new, count=1):
         fh.write(src.replace(old, new, count))
 
 
-def test_real_abi_v4_sources_are_green(tmp_path):
+def test_real_abi_v5_sources_are_green(tmp_path):
     assert _findings(_stage(tmp_path)) == []
 
 
@@ -82,11 +82,43 @@ def test_dropped_field_fires_with_count(tmp_path):
 
 def test_abi_version_drift_fires(tmp_path):
     dst = _stage(tmp_path)
-    _edit(dst, "scan_engine.cc", "opensim_abi_version() { return 4; }",
-          "opensim_abi_version() { return 5; }")
+    _edit(dst, "scan_engine.cc", "opensim_abi_version() { return 5; }",
+          "opensim_abi_version() { return 6; }")
     findings = _findings(dst)
     assert [f.code for f in findings] == ["OSL1604"]
     assert "version drift" in findings[0].message
+
+
+def test_v5_carry_field_dropped_fires_naming_it(tmp_path):
+    # abi v5: dropping the bail_out carry buffer from the C++ struct must
+    # fail the gate, not silently narrow the attribution surface
+    dst = _stage(tmp_path)
+    _edit(dst, "scan_engine.cc", "  int64_t* bail_out;     // [11]\n", "")
+    findings = _findings(dst)
+    assert findings and all(f.code == "OSL1604" for f in findings)
+    assert any("count drift" in f.message for f in findings)
+    assert any("bail_out" in f.message for f in findings)
+
+
+def test_v5_carry_field_width_drift_fires_naming_it(tmp_path):
+    dst = _stage(tmp_path)
+    _edit(dst, "scan_engine.cc", "int64_t* class_steps;", "int32_t* class_steps;")
+    findings = _findings(dst)
+    assert [f.code for f in findings] == ["OSL1604"]
+    msg = findings[0].message
+    assert "width drift" in msg and "`class_steps`" in msg
+    assert "ptr:i32" in msg and "ptr:i64" in msg
+
+
+def test_v5_carry_field_order_swap_fires_naming_them(tmp_path):
+    dst = _stage(tmp_path)
+    _edit(dst, "scan_engine.cc",
+          "  int64_t* bail_out;     // [11]\n  int64_t* class_steps;  // [4]",
+          "  int64_t* class_steps;  // [4]\n  int64_t* bail_out;     // [11]")
+    findings = _findings(dst)
+    assert [f.code for f in findings] == ["OSL1604"]
+    msg = findings[0].message
+    assert "order drift" in msg and "`bail_out`" in msg and "`class_steps`" in msg
 
 
 def test_serial_wire_version_drift_fires(tmp_path):
@@ -99,7 +131,7 @@ def test_serial_wire_version_drift_fires(tmp_path):
 
 def test_missing_anchor_constant_fires(tmp_path):
     dst = _stage(tmp_path)
-    _edit(dst, "__init__.py", "ABI_VERSION = 4", "_NOT_THE_ANCHOR = 4")
+    _edit(dst, "__init__.py", "ABI_VERSION = 5", "_NOT_THE_ANCHOR = 5")
     findings = _findings(dst)
     assert [f.code for f in findings] == ["OSL1604"]
     assert "ABI_VERSION constant missing" in findings[0].message

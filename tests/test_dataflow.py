@@ -455,7 +455,7 @@ def test_tracer_leak_instance_and_module_state():
 
 
 # ---------------------------------------------------------------------------
-# ABI parsers against the real abi-v4 sources
+# ABI parsers against the real abi-v5 sources
 # ---------------------------------------------------------------------------
 
 
@@ -467,7 +467,7 @@ def test_abi_parsers_agree_on_real_sources():
     assert cc_problems == [] and py_problems == []
     assert len(cc_fields) == len(py_fields) > 100
     assert abi.compare_layouts(cc_fields, py_fields) == []
-    assert abi.parse_cc_abi_version(cc) == abi.parse_py_abi_version(py) == 4
+    assert abi.parse_cc_abi_version(cc) == abi.parse_py_abi_version(py) == 5
 
 
 def test_abi_compare_names_the_drifted_field():

@@ -854,6 +854,335 @@ def test_incremental_vs_generic_interpod_fuzz(seed, monkeypatch):
     _assert_same_output(nout, gout)
 
 
+# --- abi v5 per-resource-class carry: ports / gpu-share / local-PV fuzz ----
+#
+# Each class gets a 3-seed differential sweep INSIDE the widened incremental
+# envelope: the incremental path must (a) actually engage on its carry class
+# (native_steps classes attribution), (b) match the forced-generic C++ path
+# and the XLA scan bit-for-bit, and (c) replay clean against the independent
+# kube oracle. A mixed storm with forced foreign binds closes the loop.
+
+
+def _tmpl_annotate(deploy, anno):
+    """Pod-TEMPLATE annotations on a workload (gpu-share / open-local pod
+    requests live on the pod, not the controller)."""
+    deploy.template_metadata.annotations.update(anno)
+    deploy.template_raw.setdefault("metadata", {}).setdefault(
+        "annotations", {}
+    ).update(anno)
+
+
+def _ports_fuzz_case(rng):
+    """Host-port-bearing workloads over-subscribed enough to conflict: every
+    template carries ports, so each incremental step exercises the per-node
+    port-bitmap carry and binds flip verdicts (bail class B_PORTS)."""
+    cluster = ResourceTypes()
+    n_nodes = rng.randrange(6, 14)
+    for i in range(n_nodes):
+        cluster.nodes.append(
+            fx.make_fake_node(
+                f"n{i:03d}", "16", "32Gi", "110",
+                fx.with_labels({"topology.kubernetes.io/zone": f"z{rng.randrange(3)}"}),
+            )
+        )
+    app = ResourceTypes()
+    for w in range(rng.randrange(2, 5)):
+        opts = [fx.with_host_ports(
+            rng.sample([8080, 9090, 9443, 5000], rng.randrange(1, 3))
+        )]
+        if rng.random() < 0.4:
+            opts.append(
+                fx.with_topology_spread([
+                    {"maxSkew": rng.choice([1, 2]),
+                     "topologyKey": "topology.kubernetes.io/zone",
+                     "whenUnsatisfiable": rng.choice(["DoNotSchedule", "ScheduleAnyway"]),
+                     "labelSelector": {"matchLabels": {"app": f"w{w}"}}},
+                ])
+            )
+        app.deployments.append(
+            fx.make_fake_deployment(
+                f"w{w}", rng.randrange(4, n_nodes + 5), "250m", "512Mi", *opts
+            )
+        )
+    return cluster, app
+
+
+def _gpu_fuzz_case(rng):
+    """GPU-share templates (gpu-mem annotations) mixed with whole-GPU pods
+    (gpu-count spec requests → the gc_dyn dynamic allocatable): per-GPU-index
+    headroom carry + the dynamic share score term."""
+    cluster = ResourceTypes()
+    n_nodes = rng.randrange(5, 10)
+    for i in range(n_nodes):
+        opts = [fx.with_labels({"topology.kubernetes.io/zone": f"z{i % 2}"})]
+        if rng.random() < 0.8:
+            opts.append(fx.with_allocatable(
+                {"alibabacloud.com/gpu-mem": rng.choice(["16Gi", "32Gi"]),
+                 "alibabacloud.com/gpu-count": rng.choice(["2", "4"])}))
+        cluster.nodes.append(fx.make_fake_node(f"n{i:03d}", "16", "64Gi", "110", *opts))
+    app = ResourceTypes()
+    for w in range(rng.randrange(2, 5)):
+        d = fx.make_fake_deployment(
+            f"w{w}", rng.randrange(6, 20),
+            f"{rng.choice([250, 500])}m", "512Mi",
+        )
+        if rng.random() < 0.6:
+            _tmpl_annotate(d, {
+                "alibabacloud.com/gpu-mem": rng.choice(["2Gi", "4Gi", "8Gi"]),
+                "alibabacloud.com/gpu-count": rng.choice(["1", "1", "2"]),
+            })
+        else:
+            # whole-GPU: gc_dyn fit + dynamic share (Reserve rewrite)
+            d = fx.make_fake_deployment(
+                f"w{w}", rng.randrange(3, 8), "250m", "512Mi",
+                fx.with_requests(
+                    {"alibabacloud.com/gpu-count": rng.choice(["1", "1", "2"])}),
+            )
+        app.deployments.append(d)
+    return cluster, app
+
+
+def _local_fuzz_case(rng):
+    """open-local LVM + exclusive-device volumes: per-disk allocation carry
+    for the local filter AND the w_local score term (use_loc now rides the
+    incremental path)."""
+    import json as _json
+
+    cluster = ResourceTypes()
+    n_nodes = rng.randrange(5, 10)
+    for i in range(n_nodes):
+        opts = [fx.with_labels({"topology.kubernetes.io/zone": f"z{i % 2}"})]
+        if rng.random() < 0.85:
+            opts.append(fx.with_node_local_storage(
+                vgs=[{"name": "pool0",
+                      "capacity": rng.choice([50, 100, 200]) * 1024**3}],
+                devices=[
+                    {"device": "/dev/vdb",
+                     "capacity": rng.choice([40, 80]) * 1024**3,
+                     "mediaType": rng.choice(["ssd", "hdd"])},
+                    {"device": "/dev/vdc", "capacity": 60 * 1024**3,
+                     "mediaType": rng.choice(["ssd", "hdd"])},
+                ]))
+        cluster.nodes.append(fx.make_fake_node(f"n{i:03d}", "16", "64Gi", "110", *opts))
+    app = ResourceTypes()
+    for w in range(rng.randrange(2, 5)):
+        vols = [{"size": str(rng.choice([5, 10, 20]) * 1024**3), "kind": "LVM",
+                 "scName": "open-local-lvm"}]
+        if rng.random() < 0.5:
+            vols.append({"size": str(rng.choice([10, 30]) * 1024**3),
+                         "kind": rng.choice(["SSD", "HDD"]),
+                         "scName": "open-local-device"})
+        d = fx.make_fake_deployment(
+            f"w{w}", rng.randrange(4, 12), "250m", "512Mi",
+        )
+        _tmpl_annotate(d, {"simon/pod-local-storage": _json.dumps({"volumes": vols})})
+        app.deployments.append(d)
+    return cluster, app
+
+
+def _storm_fuzz_case(rng):
+    """Everything at once — ports + gpu-share + gc_dyn + local-PV + interpod
+    + spread — with forced foreign binds spliced into the stream: every carry
+    class must fold foreign deltas or bail, never drift."""
+    c1, a1 = _ports_fuzz_case(rng)
+    _c2, a2 = _gpu_fuzz_case(rng)
+    _c3, a3 = _local_fuzz_case(rng)
+    cluster = ResourceTypes()
+    # gpu + local capable node set, zoned, sized to fit all three node shapes
+    n_nodes = max(len(c1.nodes), 8)
+    for i in range(n_nodes):
+        opts = [fx.with_labels({"topology.kubernetes.io/zone": f"z{i % 3}"})]
+        if rng.random() < 0.6:
+            opts.append(fx.with_allocatable(
+                {"alibabacloud.com/gpu-mem": "16Gi",
+                 "alibabacloud.com/gpu-count": "2"}))
+        if rng.random() < 0.6:
+            opts.append(fx.with_node_local_storage(
+                vgs=[{"name": "pool0", "capacity": 100 * 1024**3}],
+                devices=[{"device": "/dev/vdb", "capacity": 80 * 1024**3,
+                          "mediaType": "ssd"}]))
+        cluster.nodes.append(fx.make_fake_node(f"n{i:03d}", "32", "64Gi", "110", *opts))
+    app = ResourceTypes()
+    for src, tag in ((a1, "p"), (a2, "g"), (a3, "l")):
+        for d in src.deployments:
+            d.metadata.name = f"{tag}-{d.metadata.name}"
+            app.deployments.append(d)
+    if rng.random() < 0.6:
+        app.deployments.append(
+            fx.make_fake_deployment(
+                "aff", rng.randrange(4, 10), "250m", "512Mi",
+                fx.with_affinity({
+                    "podAntiAffinity": {
+                        "requiredDuringSchedulingIgnoredDuringExecution": [
+                            {"labelSelector": {"matchLabels": {"app": "aff"}},
+                             "topologyKey": "kubernetes.io/hostname"}]}}),
+            )
+        )
+    return cluster, app
+
+
+def _oracle_replay(cluster, prep, chosen, oracle):
+    """Replay a placement stream against an independent kube-semantics
+    oracle: every scheduler-made bind must be oracle-feasible, every failure
+    must have no oracle-feasible node. Forced pods bypass the scheduler (but
+    still drain oracle state)."""
+    node_names = prep.meta.node_names
+    lenient = False
+    for i, pod in enumerate(prep.ordered):
+        c = int(chosen[i])
+        forced = bool(prep.forced[i])
+        if c >= 0:
+            node = oracle.by_name[node_names[c]]
+            if not forced:
+                assert oracle.feasible(pod, node), (
+                    f"engine bound {pod.metadata.name} to {node.metadata.name}; "
+                    "oracle says infeasible"
+                )
+            try:
+                oracle.bind(pod, node)
+            except (TypeError, ValueError, IndexError):
+                # a FORCED pin outside the oracle's allocation model (e.g. a
+                # device volume pinned onto a node with no free device): the
+                # oracle state now under-counts usage, so stop asserting the
+                # unscheduled side (feasible-bind asserts only get laxer)
+                assert forced, "oracle.bind failed on a scheduler-made bind"
+                lenient = True
+        elif not forced and not lenient:
+            feas = [n.metadata.name for n in cluster.nodes if oracle.feasible(pod, n)]
+            assert not feas, (
+                f"{pod.metadata.name} unscheduled but oracle finds {feas}"
+            )
+
+
+def _class_fuzz(monkeypatch, cluster, app, klass, ext_oracle):
+    """Shared body: incremental vs XLA (_assert_match) vs forced-generic,
+    engagement attribution on `klass`, then the oracle replay."""
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_k8s_oracle import ExtOracle, Oracle
+
+    prep = prepare(cluster, [AppResource("fuzz", app)], node_pad=128)
+    if prep is None:
+        pytest.skip("empty workload")
+    nout = _assert_match(prep)  # incremental vs XLA scan
+    steps = nout.native_stats["steps"]
+    assert steps["generic"] == 0, steps
+    assert steps.get("classes", {}).get(klass, 0) > 0, (
+        f"incremental path never engaged the {klass} carry: {steps}"
+    )
+    pv = np.ones(len(prep.ordered), bool)
+    _force_generic(monkeypatch)
+    gout = nativepath.schedule(prep, pv)
+    assert gout.native_stats["path"] == "generic"
+    assert gout.native_stats["steps"].get("bails", {}).get("force_generic", 0) > 0
+    _assert_same_output(nout, gout)
+    monkeypatch.delenv("OPENSIM_NATIVE_FORCE_GENERIC")
+    oracle = (ExtOracle if ext_oracle else Oracle)(cluster.nodes)
+    _oracle_replay(cluster, prep, nout.chosen, oracle)
+    return nout
+
+
+@pytest.mark.parametrize("seed", [211, 223, 251])
+def test_incremental_vs_generic_ports_fuzz(seed, monkeypatch):
+    rng = random.Random(seed)
+    cluster, app = _ports_fuzz_case(rng)
+    _class_fuzz(monkeypatch, cluster, app, "ports", ext_oracle=False)
+
+
+@pytest.mark.parametrize("seed", [307, 311, 331])
+def test_incremental_vs_generic_gpu_share_fuzz(seed, monkeypatch):
+    rng = random.Random(seed)
+    cluster, app = _gpu_fuzz_case(rng)
+    _class_fuzz(monkeypatch, cluster, app, "gpu", ext_oracle=True)
+
+
+@pytest.mark.parametrize("seed", [401, 409, 419])
+def test_incremental_vs_generic_local_pv_fuzz(seed, monkeypatch):
+    rng = random.Random(seed)
+    cluster, app = _local_fuzz_case(rng)
+    nout = _class_fuzz(monkeypatch, cluster, app, "local", ext_oracle=True)
+    # the w_local SCORE term must ride the incremental path too
+    assert nout.native_stats["steps"]["classes"].get("score", 0) > 0
+
+
+@pytest.mark.parametrize("seed", [503, 509, 521])
+def test_incremental_mixed_storm_forced_binds_fuzz(seed, monkeypatch):
+    """All carry classes at once with forced foreign binds spliced every 7th
+    pod: incremental vs generic vs XLA vs the extension oracle."""
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_k8s_oracle import ExtOracle
+
+    rng = random.Random(seed)
+    cluster, app = _storm_fuzz_case(rng)
+    n_nodes = len(cluster.nodes)
+
+    def patch(app_name, pods):
+        for i, p in enumerate(pods):
+            if i % 7 == 3:
+                p.spec.node_name = f"n{i % n_nodes:03d}"
+
+    prep = prepare(
+        cluster, [AppResource("fuzz", app)], node_pad=128, patch_pods_fn=patch
+    )
+    if prep is None:
+        pytest.skip("empty workload")
+    assert prep.forced.sum() > 3
+    nout = _assert_match(prep)  # incremental vs XLA, forced pins included
+    classes = nout.native_stats["steps"].get("classes", {})
+    assert classes, nout.native_stats["steps"]
+    pv = np.ones(len(prep.ordered), bool)
+    _force_generic(monkeypatch)
+    gout = nativepath.schedule(prep, pv)
+    _assert_same_output(nout, gout)
+    monkeypatch.delenv("OPENSIM_NATIVE_FORCE_GENERIC")
+    _oracle_replay(cluster, prep, nout.chosen, ExtOracle(cluster.nodes))
+
+
+def test_class_failure_reasons_parity_through_simulate(monkeypatch):
+    """Explanation parity on the new carry classes: unscheduled reason
+    strings from the incremental native path must equal the XLA scan's for
+    over-capacity ports, gpu-share, and local-PV workloads."""
+    import json as _json
+
+    def build():
+        cluster = ResourceTypes()
+        for i in range(3):
+            cluster.nodes.append(
+                fx.make_fake_node(
+                    f"n{i:03d}", "16", "32Gi", "110",
+                    fx.with_allocatable({"alibabacloud.com/gpu-mem": "8Gi",
+                                         "alibabacloud.com/gpu-count": "2"}),
+                    fx.with_node_local_storage(
+                        vgs=[{"name": "pool0", "capacity": 20 * 1024**3}]),
+                )
+            )
+        app = ResourceTypes()
+        app.deployments.append(
+            fx.make_fake_deployment("ports", 5, "100m", "128Mi",
+                                    fx.with_host_ports([8080])))
+        gpu = fx.make_fake_deployment("gpu", 6, "100m", "128Mi")
+        _tmpl_annotate(gpu, {"alibabacloud.com/gpu-mem": "4Gi",
+                             "alibabacloud.com/gpu-count": "1"})
+        app.deployments.append(gpu)
+        loc = fx.make_fake_deployment("loc", 4, "100m", "128Mi")
+        _tmpl_annotate(loc, {"simon/pod-local-storage": _json.dumps(
+            {"volumes": [{"size": str(15 * 1024**3), "kind": "LVM",
+                          "scName": "open-local-lvm"}]})})
+        app.deployments.append(loc)
+        return cluster, [AppResource("a", app)]
+
+    def reasons():
+        res = simulate(*build())
+        return res, sorted(u.reason for u in res.unscheduled_pods)
+
+    res_native, native_reasons = reasons()
+    assert res_native.engine.name == "native"
+    assert res_native.engine.native_path == "incremental"
+    monkeypatch.setenv("OPENSIM_DISABLE_NATIVE", "1")
+    _res_xla, xla_reasons = reasons()
+    assert native_reasons == xla_reasons
+    assert native_reasons, "expected over-capacity failures in every class"
+
+
 def test_scanargs_struct_lockstep():
     """The C++ ScanArgs struct and the ctypes mirror must agree FIELD BY
     COUNT (ISSUE 4 satellite): opensim_args_size() catches size drift at

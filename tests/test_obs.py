@@ -518,6 +518,35 @@ def test_native_profile_attaches_child_spans():
     assert native_spans[0].attrs.get("native_path")
 
 
+def test_native_bail_attribution_reaches_metrics_and_profile(monkeypatch):
+    """Bail-reason attribution (abi v5): a forced-generic run must surface
+    as ``simon_native_bail_total{reason="force_generic"}`` in /metrics and
+    in the cumulative native snapshot served by /api/debug/profile."""
+    from opensim_tpu import native
+    from opensim_tpu.server import rest
+
+    if not native.available():
+        pytest.skip("C++ engine not built on this host")
+    monkeypatch.setenv("OPENSIM_NATIVE_FORCE_GENERIC", "1")
+    server = rest.SimonServer(base_cluster=_cluster())
+    try:
+        code, _body = server.deploy_apps(_payload())
+        assert code == 200
+        snap = rest.METRICS.native_snapshot()
+        if not any(snap["steps"].values()):
+            pytest.skip("native engine did not serve this run")
+        text = rest.METRICS.render()
+        m = re.search(r'simon_native_bail_total\{reason="force_generic"\} (\d+)', text)
+        assert m and int(m.group(1)) > 0, text
+        assert snap["bails"].get("force_generic", 0) > 0
+        assert snap["steps"].get("generic", 0) > 0
+    finally:
+        # METRICS is process-global: unwind this test's contribution
+        with rest.METRICS.lock:
+            rest.METRICS.native_bails.clear()
+            rest.METRICS.native_classes.clear()
+
+
 @pytest.mark.slow
 def test_bench_trace_flag_emits_chrome_json(tmp_path):
     """`bench.py --trace out.json` (acceptance bar): one JSON result line

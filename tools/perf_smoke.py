@@ -1,8 +1,7 @@
 #!/usr/bin/env python
 """Perf smoke gate (`make perf-smoke`, wired into `make verify`).
 
-Runs a small affinity-heavy workload (the ISSUE-4 shape: required + preferred
-interpod terms plus hard topology spread) through the C++ scan engine twice:
+Runs small feature-heavy workloads through the C++ scan engine twice each:
 
 1. normally — asserting the INCREMENTAL same-template cache actually served
    the scheduled steps (a silent disengage back to the generic path is the
@@ -10,6 +9,15 @@ interpod terms plus hard topology spread) through the C++ scan engine twice:
 2. with OPENSIM_NATIVE_FORCE_GENERIC=1 — asserting placements, failure
    attribution and the final count tensors are bit-identical, so the cache
    can never trade correctness for the speed it reports.
+
+Three scenarios cover the envelope's load-bearing carry classes (ISSUE 19):
+
+- ``affinity`` — the ISSUE-4 shape: required + preferred interpod terms
+  plus hard topology spread;
+- ``ports`` — every template carries host ports (per-node port-bitmap
+  carry; classes attribution must show ``ports``);
+- ``gpu`` — gpu-share + whole-GPU templates (per-GPU-index headroom carry
+  and the gc_dyn dynamic share score; classes must show ``gpu``).
 
 Prints one JSON line and exits nonzero on any violation.
 """
@@ -24,26 +32,30 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 
-def main() -> int:
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    from opensim_tpu import native
-    from opensim_tpu.engine import nativepath
-    from opensim_tpu.engine.simulator import AppResource, prepare
+def _ports_apps(n_pods):
+    """All templates carry host ports: each incremental step exercises the
+    per-node port-bitmap carry."""
+    from opensim_tpu.models import ResourceTypes, fixtures as fx
 
-    import bench
+    rt = ResourceTypes()
+    n_workloads = 8
+    per = n_pods // n_workloads
+    port_sets = ([8080], [9090], [8080, 9443], [5000], [9443], [5000, 9090], [8443], [7070])
+    for w in range(n_workloads):
+        rt.deployments.append(
+            fx.make_fake_deployment(
+                f"ports-{w}", per, "250m", "512Mi",
+                fx.with_host_ports(port_sets[w]),
+            )
+        )
+    return rt
 
-    if not native.available():
-        # match the test suites' behavior: environments without a C++
-        # toolchain skip native-dependent gates instead of failing verify
-        print(json.dumps({"skipped": f"native engine unavailable: {native.load_error()}"}))
-        return 0
 
-    # the knob under test must not leak in from (or stomp) the caller's env
-    prior_fg = os.environ.pop("OPENSIM_NATIVE_FORCE_GENERIC", None)
+def _run_scenario(name, cluster, apps, required_class, nativepath, prepare):
+    """One engagement + bit-equality pass; returns (record, error|None)."""
+    from opensim_tpu.engine.simulator import AppResource
 
-    cluster = bench.synthetic_cluster(200)
-    apps = [AppResource("smoke", bench.affinity_apps(2000))]
-    prep = prepare(cluster, apps, node_pad=128)
+    prep = prepare(cluster, [AppResource(name, apps)], node_pad=128)
     pv = np.ones(len(prep.ordered), bool)
 
     t0 = time.time()
@@ -58,13 +70,9 @@ def main() -> int:
         out_gen = nativepath.schedule(prep, pv)
         t_gen = time.time() - t0
     finally:
-        if prior_fg is None:
-            del os.environ["OPENSIM_NATIVE_FORCE_GENERIC"]
-        else:
-            os.environ["OPENSIM_NATIVE_FORCE_GENERIC"] = prior_fg
+        del os.environ["OPENSIM_NATIVE_FORCE_GENERIC"]
 
     record = {
-        "metric": "perf-smoke (2k-pod/200-node affinity, incremental vs generic)",
         "native_path": stats.get("path"),
         "native_steps": steps,
         "incremental_s": round(t_inc, 3),
@@ -72,22 +80,70 @@ def main() -> int:
         "forced_path": (out_gen.native_stats or {}).get("path"),
     }
 
-    if stats.get("path") != "incremental":
-        record["error"] = (
-            "incremental cache did not engage on the affinity workload "
+    error = None
+    if stats.get("path") != "incremental" or int(steps.get("incremental", 0)) <= 0:
+        error = (
+            f"{name}: incremental cache did not engage "
             f"(path={stats.get('path')!r}, steps={steps})"
         )
+    elif required_class and int((steps.get("classes") or {}).get(required_class, 0)) <= 0:
+        error = (
+            f"{name}: incremental path never exercised the {required_class!r} "
+            f"carry class (classes={steps.get('classes')})"
+        )
     elif (out_gen.native_stats or {}).get("path") != "generic":
-        record["error"] = "OPENSIM_NATIVE_FORCE_GENERIC=1 did not force the generic path"
+        error = f"{name}: OPENSIM_NATIVE_FORCE_GENERIC=1 did not force the generic path"
     elif not np.array_equal(out_inc.chosen, out_gen.chosen):
         mism = int((out_inc.chosen != out_gen.chosen).sum())
-        record["error"] = f"{mism} placement mismatches incremental vs generic"
+        error = f"{name}: {mism} placement mismatches incremental vs generic"
     elif not np.array_equal(out_inc.fail_counts, out_gen.fail_counts):
-        record["error"] = "failure attribution differs incremental vs generic"
+        error = f"{name}: failure attribution differs incremental vs generic"
     elif not np.array_equal(out_inc.final_state.used, out_gen.final_state.used) or not np.array_equal(
         out_inc.final_state.dom_sel, out_gen.final_state.dom_sel
     ):
-        record["error"] = "final state differs incremental vs generic"
+        error = f"{name}: final state differs incremental vs generic"
+    return record, error
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from opensim_tpu import native
+    from opensim_tpu.engine import nativepath
+    from opensim_tpu.engine.simulator import prepare
+
+    import bench
+
+    if not native.available():
+        # match the test suites' behavior: environments without a C++
+        # toolchain skip native-dependent gates instead of failing verify
+        print(json.dumps({"skipped": f"native engine unavailable: {native.load_error()}"}))
+        return 0
+
+    # the knob under test must not leak in from (or stomp) the caller's env
+    prior_fg = os.environ.pop("OPENSIM_NATIVE_FORCE_GENERIC", None)
+
+    scenarios = (
+        ("affinity", bench.synthetic_cluster(200), bench.affinity_apps(2000), "interpod"),
+        ("ports", bench.synthetic_cluster(200), _ports_apps(2000), "ports"),
+        ("gpu", bench.gpu_cluster(200), bench.gpu_apps(2000), "gpu"),
+    )
+
+    record = {
+        "metric": "perf-smoke (2k-pod/200-node affinity+ports+gpu, incremental vs generic)",
+    }
+    try:
+        for name, cluster, apps, klass in scenarios:
+            # the affinity scenario predates the classes attribution split
+            # and is gated on engagement + equality only
+            required = klass if klass in ("ports", "gpu", "local", "score") else None
+            scen, error = _run_scenario(name, cluster, apps, required, nativepath, prepare)
+            record[name] = scen
+            if error:
+                record["error"] = error
+                break
+    finally:
+        if prior_fg is not None:
+            os.environ["OPENSIM_NATIVE_FORCE_GENERIC"] = prior_fg
 
     print(json.dumps(record))
     return 1 if "error" in record else 0

@@ -1,5 +1,5 @@
 # parity with the reference's Makefile targets (build/test), TPU edition
-.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan mem-smoke perf-guard campaign-smoke ha-smoke
+.PHONY: test test-quick test-slow tpu-revalidate bench bench-all bench-serial docs native all lint mypy verify chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan mem-smoke perf-guard campaign-smoke ha-smoke dash-smoke
 
 all: test
 
@@ -119,6 +119,15 @@ campaign-smoke:
 ha-smoke:
 	python tools/ha_smoke.py
 
+# fleet-observability gate (ISSUE 20, docs/observability.md "Watching
+# the fleet"): a live 2-worker fleet under load must serve a non-empty
+# time-series ring and a conformant SLO endpoint, render byte-stable
+# `simon dash --once --json` rows, expose zero duplicate series at the
+# aggregated admin /metrics, stitch the owner's publication span into
+# worker request traces, and lose no measurable QPS with OPENSIM_TRACE=0
+dash-smoke:
+	python tools/dash_smoke.py
+
 # runtime lock-order sanitizer (docs/static-analysis.md#make-tsan): a
 # seeded A->B/B->A inversion must be caught (detector self-test), then the
 # threaded test modules run under instrumented locks — any observed
@@ -127,8 +136,8 @@ ha-smoke:
 tsan:
 	python tools/tsan.py
 
-# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain + loadgen + capacity + replay + lock sanitizer + memory + perf trajectory + campaigns + HA failover
-verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan mem-smoke perf-guard campaign-smoke ha-smoke
+# the CI gate: static analysis + types + tier-1 tests + chaos + perf + obs + twin + explain + loadgen + capacity + replay + lock sanitizer + memory + perf trajectory + campaigns + HA failover + fleet observability
+verify: lint mypy test-quick chaos perf-smoke obs-smoke twin-smoke explain-smoke loadgen-smoke capacity-smoke replay-smoke tsan mem-smoke perf-guard campaign-smoke ha-smoke dash-smoke
 
 # run the moment the TPU tunnel opens (tools/tpu_probe_loop.sh writes
 # /tmp/opensim-tpu-watch.up): compiled-Mosaic parity suite + full bench

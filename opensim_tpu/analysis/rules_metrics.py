@@ -26,6 +26,8 @@ registry helpers; see docs/static-analysis.md.
 from __future__ import annotations
 
 import ast
+import os
+import re
 from typing import Iterable
 
 from .core import FileContext, Finding, Rule, dotted_name, register
@@ -71,3 +73,104 @@ class MetricRegistryRule(Rule):
                 f"obs.metrics.{replacement}(...) so cardinality governance "
                 "stays in one place",
             )
+
+
+# ---------------------------------------------------------------------------
+# OSL1901 family-doc-sync — the FAMILIES registry and the metrics table in
+# docs/observability.md name the same families
+# ---------------------------------------------------------------------------
+
+_DOC_NAME = "observability.md"
+_DOC_ROW = re.compile(r"^\|\s*`([A-Za-z_:][A-Za-z0-9_:]*)`", re.M)
+_WALK_UP_MAX = 6
+
+
+def _parse_families(tree: ast.Module):
+    """(names, lineno) of the module-level ``FAMILIES`` dict literal, or
+    (None, 1) when the module has none."""
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.target is not None:
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "FAMILIES":
+                value = getattr(node, "value", None)
+                if not isinstance(value, ast.Dict):
+                    return None, node.lineno
+                names = set()
+                for key in value.keys:
+                    if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                        names.add(key.value)
+                return names, node.lineno
+    return None, 1
+
+
+def _find_doc(start_dir: str):
+    """Walk up from the registry module's directory looking for
+    ``docs/observability.md`` (repo layout and corpus fixtures both
+    resolve within a few levels)."""
+    d = start_dir or "."
+    for _ in range(_WALK_UP_MAX):
+        candidate = os.path.join(d, "docs", _DOC_NAME)
+        if os.path.isfile(candidate):
+            return candidate
+        parent = os.path.dirname(d) or "."
+        if parent == d:
+            break
+        d = parent
+    return None
+
+
+@register
+class FamilyDocSyncRule(Rule):
+    name = "family-doc-sync"
+    code = "OSL1901"
+    description = (
+        "metric family registered in obs/metrics.py FAMILIES but missing "
+        "from the docs/observability.md metrics table (or vice versa)"
+    )
+    # the registry module is the single anchor (OSL1101); the doc table is
+    # its human-readable mirror — this rule is the sync gate between them
+    paths = ("obs/metrics.py",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        families, lineno = _parse_families(ctx.tree)
+        if families is None:
+            return
+        anchor = _Anchor(lineno)
+        doc_path = _find_doc(os.path.dirname(os.path.abspath(ctx.path)))
+        if doc_path is None:
+            yield self.finding(
+                ctx.path, anchor,
+                f"cannot verify family/doc sync: docs/{_DOC_NAME} not found "
+                "above the FAMILIES registry (the metrics table lives there)",
+            )
+            return
+        with open(doc_path, "r", encoding="utf-8") as fh:
+            documented = set(_DOC_ROW.findall(fh.read()))
+        # only exposition families belong to the table; the doc may show
+        # other backticked first-cells (env knobs, endpoints) in other
+        # tables — restrict the reverse check to simon_* names
+        documented = {n for n in documented if n.startswith("simon_")}
+        for name in sorted(families - documented):
+            yield self.finding(
+                ctx.path, anchor,
+                f"family {name!r} is registered in FAMILIES but missing from "
+                f"the docs/{_DOC_NAME} metrics table — document it (help "
+                "text, type, labels) or unregister it",
+            )
+        for name in sorted(documented - families):
+            yield self.finding(
+                ctx.path, anchor,
+                f"family {name!r} appears in the docs/{_DOC_NAME} metrics "
+                "table but is not registered in FAMILIES — stale doc row "
+                "(the family was removed or renamed)",
+            )
+
+
+class _Anchor:
+    def __init__(self, lineno: int) -> None:
+        self.lineno = lineno
+        self.col_offset = 0

@@ -286,6 +286,32 @@ def build_parser() -> argparse.ArgumentParser:
     )
     top_p.add_argument("--timeout", type=float, default=60.0, help="per-request client timeout seconds")
 
+    dash_p = sub.add_parser(
+        "dash",
+        help="live fleet dashboard (QPS, latency, freshness, lanes, SLO burn)",
+        description=(
+            "render the fleet's live terminal view (docs/observability.md "
+            "'Watching the fleet') from the time-series ring and the SLO "
+            "engine of a running server or fleet admin endpoint: fleet QPS "
+            "and p50/p99 from merged per-worker histograms, event-to-"
+            "servable freshness per pipeline stage, admission lane depths, "
+            "takeover markers and multi-window SLO burn rates. Refreshes "
+            "in place until interrupted; --once prints one frame"
+        ),
+    )
+    dash_p.add_argument("--url", required=True, help="base URL of the server or fleet admin endpoint (http://host:port)")
+    dash_p.add_argument("--once", action="store_true", help="print one frame and exit instead of refreshing")
+    dash_p.add_argument("--json", action="store_true", help="print the structured rows as JSON (stable key order)")
+    dash_p.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh interval in seconds (default 2)",
+    )
+    dash_p.add_argument(
+        "--range", default="5m", dest="range_spec", metavar="RANGE",
+        help="ring query range: bare seconds or <n><s|m|h|d> (default 5m)",
+    )
+    dash_p.add_argument("--timeout", type=float, default=10.0, help="per-request client timeout seconds")
+
     mem_p = sub.add_parser(
         "mem",
         help="memory observatory: arena/cache footprint of a live server",
@@ -580,6 +606,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             return run_top(args)
         except KeyboardInterrupt:
             return 0
+    if args.command == "dash":
+        try:
+            return run_dash(args)
+        except KeyboardInterrupt:
+            return 0
     if args.command == "mem":
         return run_mem(args)
     if args.command == "profile":
@@ -672,6 +703,30 @@ def run_top(args) -> int:
         else:
             print(rendered)
             return 0
+
+
+def run_dash(args) -> int:
+    """``simon dash``: fetch the ring + SLO surfaces, render via the pure
+    row functions in ``cli/dash.py`` (one frame with ``--once``, refresh
+    in place otherwise — watch(1) semantics like ``simon top``)."""
+    import json as _json
+    import time as _time
+
+    from .dash import dash_rows, fetch_dash, format_dash
+
+    while True:
+        payload = fetch_dash(args.url, args.range_spec, timeout_s=args.timeout)
+        if args.json:
+            rendered = _json.dumps(dash_rows(payload), sort_keys=True)
+        else:
+            rendered = format_dash(payload)
+        if args.once:
+            print(rendered)
+            # both surfaces down = nothing was dashboarded; exit nonzero
+            # so smoke harnesses notice
+            return 1 if ("timeseries" not in payload and "slo" not in payload) else 0
+        print(f"\x1b[2J\x1b[H{rendered}", flush=True)
+        _time.sleep(max(0.1, args.interval))
 
 
 def run_defrag(args) -> int:

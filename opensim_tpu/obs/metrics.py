@@ -21,6 +21,7 @@ corrupt a scrape.
 from __future__ import annotations
 
 import math
+import re
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -29,12 +30,18 @@ __all__ = [
     "FAMILIES",
     "CounterVec",
     "HistogramVec",
+    "MetricKey",
     "MetricsRecorder",
     "RECORDER",
+    "bucket_deltas",
+    "counter_delta",
     "escape_label_value",
     "family_header",
+    "histogram_quantile",
     "make_counter",
     "make_histogram",
+    "parse_metrics",
+    "scrape_metrics",
 ]
 
 # fixed bucket upper bounds in seconds (the +Inf bucket is implicit):
@@ -59,6 +66,14 @@ WATCH_APPLY_BUCKETS: Tuple[float, ...] = (
 #: exceed allocatable on over-committed nodes) — capacity-shaped buckets
 UTILIZATION_BUCKETS: Tuple[float, ...] = (
     0.1, 0.25, 0.5, 0.625, 0.75, 0.875, 0.95, 1.0,
+)
+
+#: event-to-servable freshness (obs/fleetobs.py): the publish loop alone
+#: adds up to OPENSIM_FLEET_PUBLISH_MS, so the ladder starts at ms scale
+#: and reaches the minutes a wedged worker would show
+FRESHNESS_BUCKETS: Tuple[float, ...] = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+    10.0, 30.0, 60.0,
 )
 
 #: THE metric-family registry: ``name -> (help, type)`` for every family
@@ -218,6 +233,21 @@ FAMILIES: Dict[str, Tuple[str, str]] = {
     "simon_phase_profile_exclusive_seconds_total": (
         "Cumulative exclusive span seconds (children subtracted) by span name", "counter",
     ),
+    # fleet-wide observability (ISSUE 20, obs/fleetobs.py): the event-to-
+    # servable freshness pipeline — stage ∈ {journaled, published,
+    # attached, served}, each measured from watch-event acceptance on the
+    # owner's wall clock (owner and workers share a host)
+    "simon_fleet_freshness_seconds": (
+        "Event-to-servable latency by pipeline stage, from watch-event acceptance", "histogram",
+    ),
+    # time-series ring (obs/timeseries.py): sampling liveness + disk bound
+    "simon_ts_samples_total": ("Time-series ring samples recorded", "counter"),
+    "simon_ts_window_bytes": ("Bytes held by the on-disk time-series ring", "gauge"),
+    "simon_ts_windows": ("Delta-encoded windows resident in the time-series ring", "gauge"),
+    # SLO engine (obs/slo.py): burn rate = observed bad fraction over the
+    # window divided by the objective's error budget (1.0 = burning budget
+    # exactly at the sustainable rate); slo/window are a fixed small set
+    "simon_slo_burn_rate": ("SLO burn rate by objective and evaluation window", "gauge"),
 }
 
 
@@ -280,6 +310,130 @@ def _fmt_le(bound: float) -> str:
         return "+Inf"
     s = f"{bound:g}"
     return s
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text-format READING (stdlib only) — the inverse of the render
+# path above, shared by the loadgen harness (server/loadgen.py), the fleet
+# aggregator (server/fleet.py), and the time-series ring (obs/timeseries.py)
+# so per-worker histograms are merged once, correctly, in one place
+# (ISSUE 20 satellite; this code started life inside loadgen).
+# ---------------------------------------------------------------------------
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+([0-9eE+.\-]+|\+Inf|NaN)$"
+)
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+MetricKey = Tuple[str, Tuple[Tuple[str, str], ...]]
+
+
+def parse_metrics(text: str) -> Dict[MetricKey, float]:
+    """Exposition text → ``{(name, sorted label items): value}``."""
+    out: Dict[MetricKey, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        if not m:
+            continue
+        name, labels_body, value = m.groups()
+        labels = tuple(sorted(
+            (k, v.replace('\\"', '"').replace("\\\\", "\\"))
+            for k, v in _LABEL.findall(labels_body or "")
+        ))
+        out[(name, labels)] = float(value)
+    return out
+
+
+def scrape_metrics(url: str, timeout_s: float = 10.0) -> Dict[MetricKey, float]:
+    import urllib.request
+
+    with urllib.request.urlopen(f"{url}/metrics", timeout=timeout_s) as resp:
+        return parse_metrics(resp.read().decode())
+
+
+def _series_delta(after_v: float, before_v: float) -> float:
+    """Cumulative-series delta with counter-reset handling (the PromQL
+    ``rate()`` convention): a decrease means the process restarted and the
+    counter began again at zero, so the post-reset value IS the delta —
+    without this a worker restart mid-measurement reports a negative
+    count and poisons every merged quantile."""
+    d = after_v - before_v
+    return after_v if d < 0 else d
+
+
+def bucket_deltas(
+    before: Dict[MetricKey, float],
+    after: Dict[MetricKey, float],
+    family: str,
+    match: Dict[str, str],
+) -> List[Tuple[float, float]]:
+    """Sorted ``(le, cumulative delta)`` for one histogram family,
+    aggregated over every series whose labels are a superset of ``match``
+    (summing cumulative bucket counts across series is legal — they share
+    the bucket ladder). A series absent from ``before`` (a worker that
+    joined mid-measurement, or an empty first scrape) contributes its full
+    ``after`` value; a series that DECREASED is a counter reset and
+    contributes its post-reset value."""
+    sums: Dict[float, float] = {}
+    for (name, labels), v in after.items():
+        if name != f"{family}_bucket":
+            continue
+        ld = dict(labels)
+        if any(ld.get(k) != want for k, want in match.items()):
+            continue
+        le = math.inf if ld.get("le") == "+Inf" else float(ld.get("le", "inf"))
+        sums[le] = sums.get(le, 0.0) + _series_delta(v, before.get((name, labels), 0.0))
+    return sorted(sums.items())
+
+
+def histogram_quantile(
+    before: Dict[MetricKey, float],
+    after: Dict[MetricKey, float],
+    family: str,
+    q: float,
+    match: Optional[Dict[str, str]] = None,
+) -> Optional[float]:
+    """PromQL ``histogram_quantile`` over the scrape DELTA (so a long-lived
+    server's history does not pollute the run's distribution): linear
+    interpolation inside the target bucket. None when the delta is empty."""
+    buckets = bucket_deltas(before, after, family, match or {})
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    target = q * total
+    prev_le, prev_cum = 0.0, 0.0
+    for le, cum in buckets:
+        if cum >= target:
+            if math.isinf(le):
+                return prev_le  # tail bucket: the lower bound is the honest answer
+            if cum == prev_cum:
+                return le
+            return prev_le + (le - prev_le) * (target - prev_cum) / (cum - prev_cum)
+        prev_le, prev_cum = le, cum
+    return buckets[-1][0]
+
+
+def counter_delta(
+    before: Dict[MetricKey, float],
+    after: Dict[MetricKey, float],
+    name: str,
+    match: Optional[Dict[str, str]] = None,
+) -> float:
+    """Summed counter delta across matching series, reset-safe (see
+    :func:`bucket_deltas`)."""
+    total = 0.0
+    for (n, labels), v in after.items():
+        if n != name:
+            continue
+        ld = dict(labels)
+        if match and any(ld.get(k) != want for k, want in match.items()):
+            continue
+        total += _series_delta(v, before.get((n, labels), 0.0))
+    return total
 
 
 class CounterVec:

@@ -82,6 +82,7 @@ import json
 import logging
 import os
 import pickle
+import re
 import secrets
 import signal
 import socket
@@ -90,12 +91,14 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs.fleetobs import FRESHNESS
 from ..obs.metrics import (
     FAMILIES,
     RECORDER,
@@ -103,6 +106,7 @@ from ..obs.metrics import (
     family_header,
     make_counter,
     make_histogram,
+    parse_metrics,
 )
 from ..resilience import faults
 from ..resilience.retry import backoff_delay
@@ -605,6 +609,11 @@ class TwinPublisher:
         swap (seqlock), then garbage-collect segments no generation within
         the keep window references."""
         t0 = time.monotonic()
+        # publication stamp (ISSUE 20): fold pending accepted-event ids
+        # into a trace dict BEFORE taking self._lock — FRESHNESS takes
+        # RECORDER.lock, and this publisher deliberately never nests the
+        # two (see publish_seconds below)
+        trace_info = FRESHNESS.publication(generation)
         with self._lock:
             self._check_fence()  # refuse before wasting segment writes
             current: set = set()
@@ -630,6 +639,10 @@ class TwinPublisher:
                 "arrays": arrays,
                 "token": self.token,
                 "epoch": self.epoch,
+                # cross-process stitching: publication span id + carried
+                # event ids (bounded, PUB_EVENTS_MAX) ride the control
+                # block to every attaching worker
+                "trace": trace_info,
             }
             # chaos shm.republish: a publish dying HERE leaves the seqlock
             # even and the directory untouched — readers keep the previous
@@ -980,9 +993,19 @@ class FleetTwinClient:
         self._seq = self._reader.last_seq
         self._payload = payload
         self._synced.set()
+        # worker-side freshness stage + the stitching handoff: remember
+        # the owner's publication span/event ids for this generation
+        trace_info = payload.get("trace")
+        FRESHNESS.attached(gen, trace_info)
+        pub = trace_info if isinstance(trace_info, dict) else {}
         tracing.event(
             "fleet.attach", generation=gen, fingerprint=payload["fingerprint"],
             state=payload.get("state"), stale=payload.get("stale"),
+            publication_span=pub.get("span"),
+            publication_age_s=(
+                round(time.time() - float(pub["pub_ts"]), 6)
+                if pub.get("pub_ts") else None
+            ),
         )
 
     # -- telemetry -----------------------------------------------------------
@@ -1003,7 +1026,21 @@ class FleetTwinClient:
         for name, value in pairs:
             lines += family_header(name)
             lines.append(f"{name} {value}")
+        # worker-side freshness stages (attached/served)
+        lines += FRESHNESS.metrics_lines()
         return lines
+
+    def stitch_info(self) -> Tuple[Optional[int], Optional[dict]]:
+        """(serving generation, owner publication trace dict) for the
+        request being served RIGHT NOW — the REST layer stamps both onto
+        the request trace so the flight recorder can graft the owner-side
+        publication subtree under it. Also closes the freshness pipeline:
+        the first request per generation observes the ``served`` stage."""
+        with self._lock:
+            gen = self._gen
+        if gen is None:
+            return None, None
+        return gen, FRESHNESS.note_served(gen)
 
 
 # ---------------------------------------------------------------------------
@@ -1131,6 +1168,91 @@ class _Worker:
 #: queue depths — sums correctly across workers)
 _AGG_MAX = {"simon_fleet_attach_generation"}
 
+#: families additionally exposed per worker as `{worker="<index>"}` series
+#: next to the summed family (ISSUE 20 satellite). An allowlist, not
+#: everything: per-worker copies of all ~100 families would multiply the
+#: admin endpoint's cardinality by the fleet size for series nobody
+#: breaks down per worker.
+_PER_WORKER = {
+    "simon_request_seconds",
+    "simon_requests_total",
+    "simon_lane_depth",
+    "simon_fleet_attach_generation",
+    "simon_fleet_attaches_total",
+    "simon_fleet_freshness_seconds",
+}
+
+_TYPE_LINE = re.compile(r"^# TYPE (\S+) ", re.M)
+
+
+def _family_of(name: str) -> str:
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def render_aggregated(worker_texts: List[Optional[str]],
+                      owner_text: str = "") -> str:
+    """Merge per-worker /metrics expositions (index = worker id; None =
+    scrape failed) with the owner's own exposition into ONE body:
+
+    - every series summed across processes (bucket ladders are shared, so
+      histogram sums stay valid histograms; ``_AGG_MAX`` families take the
+      max — a summed generation number is meaningless);
+    - ``_PER_WORKER`` families additionally rendered per worker with a
+      ``worker="<index>"`` label next to the summed series (same family,
+      same header — exposition-format conformant, zero duplicate series
+      because the label set differs);
+    - exactly one ``# HELP``/``# TYPE`` header per family, including
+      sample-less families that appeared header-only in any input.
+
+    Module-level and pure so the conformance test can drive it with
+    canned texts — no shared memory, no live workers."""
+    sums: Dict[tuple, float] = {}
+    labeled: Dict[tuple, float] = {}
+    header_only: set = set(_TYPE_LINE.findall(owner_text))
+    for key, v in parse_metrics(owner_text).items():
+        if key[0] in _AGG_MAX:
+            sums[key] = max(sums.get(key, float("-inf")), v)
+        else:
+            sums[key] = sums.get(key, 0.0) + v
+    for i, text in enumerate(worker_texts):
+        if text is None:
+            continue
+        header_only |= set(_TYPE_LINE.findall(text))
+        for (name, labels), v in parse_metrics(text).items():
+            key = (name, labels)
+            if name in _AGG_MAX:
+                sums[key] = max(sums.get(key, float("-inf")), v)
+            else:
+                sums[key] = sums.get(key, 0.0) + v
+            if _family_of(name) in _PER_WORKER:
+                labeled[(name, labels + (("worker", str(i)),))] = v
+    by_family: Dict[str, List[tuple]] = {}
+    for store in (sums, labeled):
+        for key in store:
+            by_family.setdefault(_family_of(key[0]), [])
+    lines: List[str] = []
+
+    def _render(store: Dict[tuple, float], name: str, labels: tuple) -> None:
+        body = ",".join(f'{k}="{escape_label_value(v)}"' for k, v in labels)
+        rendered = f"{store[(name, labels)]:.10g}"
+        lines.append(f"{name}{{{body}}} {rendered}" if body else f"{name} {rendered}")
+
+    for family in sorted(by_family):
+        if family in FAMILIES:
+            lines += family_header(family)
+        header_only.discard(family)
+        for name, labels in sorted(k for k in sums if _family_of(k[0]) == family):
+            _render(sums, name, labels)
+        for name, labels in sorted(k for k in labeled if _family_of(k[0]) == family):
+            _render(labeled, name, labels)
+    for family in sorted(header_only):
+        if family in FAMILIES:
+            lines += family_header(family)
+    return "\n".join(lines) + "\n"
+
 
 class FleetSupervisor:
     """The twin-owner process: watch supervisor + journal + publisher +
@@ -1177,6 +1299,12 @@ class FleetSupervisor:
             with RECORDER.lock:
                 self.takeovers.inc(labels=(takeover_reason,))
         self.respawns_total = 0
+        # time-series ring + SLO engine (ISSUE 20): wired by
+        # start_timeseries() — NOT the ctor, so tests can build a
+        # supervisor without a sampler thread or disk ring
+        self.timeseries = None
+        self.slo = None
+        self._sampler = None
         self.handed_over = False
         self._on_handover = None  # set by the serve loop: shut the admin server
         self._fenced = threading.Event()
@@ -1379,25 +1507,11 @@ class FleetSupervisor:
         except OSError:
             return None
 
-    def aggregate_metrics(self) -> str:
-        """The fleet /metrics body: per-worker expositions summed per
-        series (bucket ladders are shared, so histogram sums stay valid
-        histograms), plus the owner's twin/journal families and the fleet
-        families themselves."""
-        from .loadgen import parse_metrics
-
-        sums: Dict[tuple, float] = {}
-        live = 0
-        for w in self.workers:
-            text = self._scrape_worker(w)
-            if text is None:
-                continue
-            live += 1
-            for key, v in parse_metrics(text).items():
-                if key[0] in _AGG_MAX:
-                    sums[key] = max(sums.get(key, float("-inf")), v)
-                else:
-                    sums[key] = sums.get(key, 0.0) + v
+    def _owner_metrics_text(self, live: int) -> str:
+        """The owner process's OWN exposition (fleet gauges, publisher
+        histogram, twin/journal families, time-series ring + SLO engine).
+        Fed through :func:`render_aggregated` like a worker text so every
+        family renders exactly one header at the admin endpoint."""
         lines: List[str] = []
         fp = self.publisher.footprint()
         own = [
@@ -1425,23 +1539,19 @@ class FleetSupervisor:
             lines += self.supervisor.metrics_lines()
         if self.journal is not None:
             lines += self.journal.metrics_lines()
-        emitted: set = set()
-        for (name, labels) in sorted(sums):
-            family = name
-            for suffix in ("_bucket", "_sum", "_count"):
-                if family.endswith(suffix):
-                    family = family[: -len(suffix)]
-                    break
-            if family in FAMILIES and family not in emitted:
-                lines += family_header(family)
-                emitted.add(family)
-            body = ",".join(
-                f'{k}="{escape_label_value(v)}"' for k, v in labels
-            )
-            value = sums[(name, labels)]
-            rendered = f"{value:.10g}"
-            lines.append(f"{name}{{{body}}} {rendered}" if body else f"{name} {rendered}")
+        if self.timeseries is not None:
+            lines += self.timeseries.metrics_lines()
+        if self.slo is not None:
+            lines += self.slo.metrics_lines()
         return "\n".join(lines) + "\n"
+
+    def aggregate_metrics(self) -> str:
+        """The fleet /metrics body: per-worker expositions merged with the
+        owner's own families (:func:`render_aggregated` — summed series,
+        ``worker=``-labeled per-worker copies, one header per family)."""
+        texts = [self._scrape_worker(w) for w in self.workers]
+        live = sum(1 for t in texts if t is not None)
+        return render_aggregated(texts, self._owner_metrics_text(live))
 
     def status(self) -> dict:
         fp = self.publisher.footprint()
@@ -1494,6 +1604,35 @@ class FleetSupervisor:
 
     def metrics_text(self) -> str:
         return self.aggregate_metrics()
+
+    def timeseries_payload(self, family: str = "",
+                           range_s: Optional[float] = None) -> Optional[dict]:
+        """``GET /api/debug/timeseries`` body (None → the caller answers
+        503: the ring is not running, e.g. a standby's admin surface)."""
+        if self.timeseries is None:
+            return None
+        return {
+            "stats": self.timeseries.stats(),
+            "samples": self.timeseries.query(family=family, range_s=range_s),
+        }
+
+    def slo_payload(self) -> Optional[dict]:
+        """``GET /api/fleet/slo`` body (None → 503, no engine)."""
+        if self.slo is None:
+            return None
+        return self.slo.evaluate()
+
+    def start_timeseries(self) -> None:
+        """Boot the on-disk time-series ring, the sampler (scraping this
+        supervisor's own aggregated exposition) and the SLO engine."""
+        from ..obs.slo import SLOEngine
+        from ..obs.timeseries import TimeSeriesRing, TimeSeriesSampler
+
+        ts_dir = str(envknobs.value("OPENSIM_TS_DIR") or "") or None
+        self.timeseries = TimeSeriesRing(directory=ts_dir)
+        self.slo = SLOEngine(self.timeseries)
+        self._sampler = TimeSeriesSampler(self.timeseries, self.aggregate_metrics)
+        self._sampler.start()
 
     def alive_workers(self) -> int:
         return sum(1 for w in self.workers if w.alive())
@@ -1558,10 +1697,14 @@ class FleetSupervisor:
                         )
                         with contextlib.suppress(OSError):
                             os.kill(w.pid, signal.SIGKILL)
+        if self._sampler is not None:
+            self._sampler.stop()
         if self.supervisor is not None:
             self.supervisor.stop()
         if self.journal is not None:
             self.journal.close()
+        if self.timeseries is not None:
+            self.timeseries.close()
         self.publisher.close()
 
 
@@ -1591,8 +1734,41 @@ def _make_admin_handler(box: _RoleBox):
             self.wfile.write(data)
 
         def do_GET(self):
-            path = self.path.split("?", 1)[0]
+            path, _, query = self.path.partition("?")
             role = box.current
+            if path == "/api/debug/timeseries":
+                from ..obs.timeseries import parse_duration_s
+
+                q = urllib.parse.parse_qs(query)
+                try:
+                    range_s = parse_duration_s((q.get("range") or [""])[0])
+                except ValueError as e:
+                    self._send(
+                        400, json.dumps({"error": str(e)}).encode(),
+                        "application/json",
+                    )
+                    return
+                payload = getattr(role, "timeseries_payload", lambda **kw: None)(
+                    family=(q.get("family") or [""])[0], range_s=range_s
+                )
+                if payload is None:  # standby / ring not running
+                    self._send(
+                        503, b'{"error": "time-series ring not running"}',
+                        "application/json",
+                    )
+                    return
+                self._send(200, json.dumps(payload).encode(), "application/json")
+                return
+            if path == "/api/fleet/slo":
+                payload = getattr(role, "slo_payload", lambda: None)()
+                if payload is None:
+                    self._send(
+                        503, b'{"error": "SLO engine not running"}',
+                        "application/json",
+                    )
+                    return
+                self._send(200, json.dumps(payload).encode(), "application/json")
+                return
             if path == "/healthz":
                 self._send(
                     200, json.dumps(role.healthz()).encode(), "application/json"
@@ -1696,6 +1872,7 @@ def serve_fleet(kubeconfig: str, master: str, port: int, watch: str,
         except ValueError:  # pragma: no cover - embedded use
             break
     fleet.start()
+    fleet.start_timeseries()
     print(
         f"simon fleet listening on :{port} [{workers} workers, "
         f"admin :{fleet.admin_port}]"
@@ -1884,6 +2061,9 @@ class StandbyOwner:
         else:
             supervisor.start()
         fleet.start()
+        # a fresh ring (or, with OPENSIM_TS_DIR set, the previous owner's
+        # re-adopted one) — takeover markers keep accumulating
+        fleet.start_timeseries()
         self.fleet = fleet
         return True
 

@@ -72,6 +72,7 @@ import zlib
 from collections import deque
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
+from ..obs.fleetobs import FRESHNESS
 from ..obs.metrics import RECORDER, family_header, make_counter, make_histogram
 from ..resilience import faults
 from ..utils import envknobs
@@ -368,11 +369,17 @@ class Journal:
                 self._thread.start()
             self._cond.notify()
 
-    def record_event(self, field: str, ev_type: str, obj: dict, generation: int) -> None:
-        """One ACCEPTED twin event (``apply_event`` returned a change)."""
+    def record_event(self, field: str, ev_type: str, obj: dict, generation: int,
+                     eid: str = "", ts: Optional[float] = None) -> None:
+        """One ACCEPTED twin event (``apply_event`` returned a change).
+        ``eid``/``ts`` are the fleet-trace acceptance stamp (ISSUE 20):
+        the id rides the record so replay and the flight recorder can
+        correlate journal lines with stitched request traces."""
         rv = str(((obj.get("metadata") or {}).get("resourceVersion")) or "")
-        rec = {"t": "ev", "ts": time.time(), "gen": generation, "f": field,
-               "k": ev_type, "o": obj}
+        rec = {"t": "ev", "ts": ts if ts is not None else time.time(),
+               "gen": generation, "f": field, "k": ev_type, "o": obj}
+        if eid:
+            rec["eid"] = eid
         with self._cond:
             if rv:
                 self._last_rvs[field] = rv
@@ -541,6 +548,11 @@ class Journal:
         with RECORDER.lock:
             self.records_total.inc((rec["t"],))
             self.bytes_total += len(payload) + _FRAME
+            if rec["t"] == "ev" and rec.get("eid"):
+                # journaled stage of the freshness pipeline: the stamped
+                # acceptance time is in the record itself (RECORDER.lock
+                # is an RLock; FRESHNESS shares it)
+                FRESHNESS.event_journaled(float(rec["ts"]))
         if rec["t"] == "ev":
             self._events_since_ck += 1
         elif rec["t"] == "ck":

@@ -46,101 +46,21 @@ __all__ = [
 ]
 
 # ---------------------------------------------------------------------------
-# Prometheus text-format reading (stdlib only)
+# Prometheus text-format reading: the parse/merge/quantile machinery moved
+# to obs/metrics.py (ISSUE 20 satellite — the fleet aggregator and the
+# time-series ring need the same bucket-merge code); re-exported here so
+# every published name (`from ..server.loadgen import parse_metrics`, the
+# smoke tools, bench.py) keeps working.
 # ---------------------------------------------------------------------------
 
-_SAMPLE = re.compile(
-    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+([0-9eE+.\-]+|\+Inf|NaN)$"
+from ..obs.metrics import (  # noqa: E402  (re-export, see __all__)
+    MetricKey,
+    histogram_quantile,
+    parse_metrics,
+    scrape_metrics,
 )
-_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
-
-MetricKey = Tuple[str, Tuple[Tuple[str, str], ...]]
-
-
-def parse_metrics(text: str) -> Dict[MetricKey, float]:
-    """Exposition text → ``{(name, sorted label items): value}``."""
-    out: Dict[MetricKey, float] = {}
-    for line in text.splitlines():
-        if not line or line.startswith("#"):
-            continue
-        m = _SAMPLE.match(line)
-        if not m:
-            continue
-        name, labels_body, value = m.groups()
-        labels = tuple(sorted(
-            (k, v.replace('\\"', '"').replace("\\\\", "\\"))
-            for k, v in _LABEL.findall(labels_body or "")
-        ))
-        out[(name, labels)] = float(value)
-    return out
-
-
-def scrape_metrics(url: str, timeout_s: float = 10.0) -> Dict[MetricKey, float]:
-    with urllib.request.urlopen(f"{url}/metrics", timeout=timeout_s) as resp:
-        return parse_metrics(resp.read().decode())
-
-
-def _bucket_deltas(
-    before: Dict[MetricKey, float],
-    after: Dict[MetricKey, float],
-    family: str,
-    match: Dict[str, str],
-) -> List[Tuple[float, float]]:
-    """Sorted ``(le, cumulative delta)`` for one histogram family,
-    aggregated over every series whose labels are a superset of ``match``
-    (summing cumulative bucket counts across series is legal — they share
-    the bucket ladder)."""
-    sums: Dict[float, float] = {}
-    for (name, labels), v in after.items():
-        if name != f"{family}_bucket":
-            continue
-        ld = dict(labels)
-        if any(ld.get(k) != want for k, want in match.items()):
-            continue
-        le = math.inf if ld.get("le") == "+Inf" else float(ld.get("le", "inf"))
-        sums[le] = sums.get(le, 0.0) + v - before.get((name, labels), 0.0)
-    return sorted(sums.items())
-
-
-def histogram_quantile(
-    before: Dict[MetricKey, float],
-    after: Dict[MetricKey, float],
-    family: str,
-    q: float,
-    match: Optional[Dict[str, str]] = None,
-) -> Optional[float]:
-    """PromQL ``histogram_quantile`` over the scrape DELTA (so a long-lived
-    server's history does not pollute the run's distribution): linear
-    interpolation inside the target bucket. None when the delta is empty."""
-    buckets = _bucket_deltas(before, after, family, match or {})
-    if not buckets:
-        return None
-    total = buckets[-1][1]
-    if total <= 0:
-        return None
-    target = q * total
-    prev_le, prev_cum = 0.0, 0.0
-    for le, cum in buckets:
-        if cum >= target:
-            if math.isinf(le):
-                return prev_le  # tail bucket: the lower bound is the honest answer
-            if cum == prev_cum:
-                return le
-            return prev_le + (le - prev_le) * (target - prev_cum) / (cum - prev_cum)
-        prev_le, prev_cum = le, cum
-    return buckets[-1][0]
-
-
-def _counter_delta(before, after, name: str, match: Optional[Dict[str, str]] = None) -> float:
-    total = 0.0
-    for (n, labels), v in after.items():
-        if n != name:
-            continue
-        ld = dict(labels)
-        if match and any(ld.get(k) != want for k, want in match.items()):
-            continue
-        total += v - before.get((n, labels), 0.0)
-    return total
+from ..obs.metrics import bucket_deltas as _bucket_deltas  # noqa: E402,F401
+from ..obs.metrics import counter_delta as _counter_delta  # noqa: E402
 
 
 # ---------------------------------------------------------------------------

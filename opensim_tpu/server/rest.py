@@ -519,6 +519,72 @@ class SimonServer:
             timeline=self.capacity.timeline if self.capacity is not None else None,
             journal=journal,
         )
+        # time-series ring + SLO engine (ISSUE 20, obs/timeseries.py /
+        # obs/slo.py): like the memory ticker, only serve() starts them —
+        # tests construct SimonServer freely without a sampler thread
+        self.timeseries = None
+        self.slo = None
+        self._ts_sampler = None
+
+    def metrics_text(self) -> str:
+        """THE /metrics body (handler + time-series sampler share it):
+        the request-layer families plus, when the ring is running, its
+        own telemetry and the SLO burn-rate gauges."""
+        text = METRICS.render(
+            prep_cache=self.prep_cache, watch=self.watch,
+            admission=self.admission, capacity=self.capacity,
+            journal=self.journal, memory=self.memory,
+        )
+        extra: List[str] = []
+        if self.timeseries is not None:
+            extra += self.timeseries.metrics_lines()
+        if self.slo is not None:
+            extra += self.slo.metrics_lines()
+        return text + ("\n".join(extra) + "\n" if extra else "")
+
+    def start_timeseries(self) -> None:
+        """Boot the on-disk time-series ring, the self-scrape sampler and
+        the SLO engine (idempotent; serve() calls this)."""
+        from ..obs.slo import SLOEngine
+        from ..obs.timeseries import TimeSeriesRing, TimeSeriesSampler
+
+        if self.timeseries is not None:
+            return
+        ts_dir = str(envknobs.value("OPENSIM_TS_DIR") or "") or None
+        self.timeseries = TimeSeriesRing(directory=ts_dir)
+        self.slo = SLOEngine(self.timeseries)
+        self._ts_sampler = TimeSeriesSampler(self.timeseries, self.metrics_text)
+        self._ts_sampler.start()
+
+    def _stamp_fleet_trace(self, tr) -> None:
+        """Cross-process stitching (ISSUE 20): when serving from a fleet
+        twin client, stamp the serving generation and the owner's
+        publication span ids onto the request trace. Free with tracing
+        off (``tr is None``) and on non-fleet servers (no ``stitch_info``
+        on the watch object) — the fast path is two attribute reads."""
+        if tr is None:
+            return
+        stitch = getattr(self.watch, "stitch_info", None)
+        if stitch is None:
+            return
+        try:
+            gen, pub = stitch()
+        except Exception as e:  # a torn reader mid-swap must not fail the request
+            log.debug("fleet stitch skipped: %s: %s", type(e).__name__, e)
+            return
+        if gen is None:
+            return
+        tr.serving_generation = gen  # the flight recorder keys the graft on this
+        attrs = {"serving_generation": gen}
+        if isinstance(pub, dict):
+            if pub.get("span"):
+                attrs["fleet_publication"] = pub["span"]
+            events = [e[0] for e in pub.get("events") or []]
+            if events:
+                # comma-joined, not a list: span attrs are primitives so
+                # they survive the tree's JSON export verbatim
+                attrs["fleet_events"] = ",".join(events)
+        tr.root.set(**attrs)
 
     def close(self) -> None:
         """Graceful teardown (docs/serving.md "Shutting down"): stop the
@@ -528,6 +594,10 @@ class SimonServer:
         last accepted event. Idempotent."""
         if self.admission is not None:
             self.admission.stop()
+        if self._ts_sampler is not None:
+            self._ts_sampler.stop()
+        if self.timeseries is not None:
+            self.timeseries.close()
         if self.journal is not None:
             self.journal.close()
         self.memory.stop()
@@ -1287,6 +1357,7 @@ class SimonServer:
                     # real time-in-queue on the span tree (also histogrammed
                     # as simon_queue_wait_seconds by the controller)
                     tr.root.child_from_seconds("queue", ticket.queue_s)
+                self._stamp_fleet_trace(tr)
                 tr.finish(status=status, http_status=code)
                 FLIGHT_RECORDER.record(tr)
                 RECORDER.observe_trace(tr)
@@ -1381,6 +1452,7 @@ class SimonServer:
                         METRICS.record(endpoint, result)
                     RECORDER.observe_request(endpoint, seconds, status=status)
                 if tr is not None:
+                    self._stamp_fleet_trace(tr)
                     tr.finish(status=status, http_status=code)
                     FLIGHT_RECORDER.record(tr)
                     RECORDER.observe_trace(tr)
@@ -1522,11 +1594,7 @@ def make_handler(server: SimonServer):
             if self.path == "/healthz":
                 self._send(200, {"status": "ok"})
             elif self.path == "/metrics":
-                data = METRICS.render(
-                    prep_cache=server.prep_cache, watch=server.watch,
-                    admission=server.admission, capacity=server.capacity,
-                    journal=server.journal, memory=server.memory,
-                ).encode()
+                data = server.metrics_text().encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(data)))
@@ -1624,7 +1692,47 @@ def make_handler(server: SimonServer):
                 if tr is None:
                     self._send(404, {"error": f"no recorded trace for request id {rid!r}"})
                 else:
-                    self._send(200, tr.tree())
+                    body = tr.tree()
+                    # stitched fleet trace (ISSUE 20): graft the owner-side
+                    # publication subtree under the worker-side tree
+                    gen = getattr(tr, "serving_generation", None)
+                    if gen is not None:
+                        from ..obs.fleetobs import publication_tree
+
+                        fleet_node = publication_tree(gen)
+                        if fleet_node is not None:
+                            body["fleet"] = fleet_node
+                    self._send(200, body)
+            elif self.path.split("?", 1)[0] == "/api/debug/timeseries":
+                # the on-disk time-series ring (ISSUE 20): serve() starts
+                # it; bare SimonServer constructions answer 503
+                if server.timeseries is None:
+                    self._send(503, {"error": "time-series ring not running"})
+                else:
+                    from urllib.parse import parse_qs
+
+                    from ..obs.timeseries import parse_duration_s
+
+                    q = parse_qs(self.path.partition("?")[2])
+                    try:
+                        range_s = parse_duration_s(q.get("range", [""])[-1])
+                    except ValueError as e:
+                        self._send(400, {"error": str(e)})
+                    else:
+                        self._send(200, {
+                            "stats": server.timeseries.stats(),
+                            "samples": server.timeseries.query(
+                                family=q.get("family", [""])[-1],
+                                range_s=range_s,
+                            ),
+                        })
+            elif self.path.split("?", 1)[0] == "/api/fleet/slo":
+                # SLO burn rates (ISSUE 20, obs/slo.py) — same surface the
+                # fleet admin endpoint serves
+                if server.slo is None:
+                    self._send(503, {"error": "SLO engine not running"})
+                else:
+                    self._send(200, server.slo.evaluate())
             elif self.path.startswith("/api/debug/placements/"):
                 # decision audit (ISSUE 7): the per-pod placement
                 # explanations of an explain=1 request, keyed by request id
@@ -1842,6 +1950,9 @@ def serve(
     # the long-lived server process runs it — library/test constructions
     # of SimonServer sample on demand instead
     server.memory.start_ticker()
+    # time-series ring + SLO engine (ISSUE 20): long-lived servers only,
+    # same rationale as the ticker
+    server.start_timeseries()
     if supervisor is not None:
         supervisor.prep_cache = server.prep_cache
         if watch == "on":

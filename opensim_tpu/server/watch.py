@@ -68,6 +68,7 @@ if TYPE_CHECKING:
 
 from ..models.objects import ResourceTypes
 from ..obs import trace as tracing
+from ..obs.fleetobs import FRESHNESS, new_event_id
 from ..utils import envknobs
 from ..obs.metrics import RECORDER, escape_label_value, family_header
 from ..obs.recorder import FLIGHT_RECORDER
@@ -1022,12 +1023,18 @@ class WatchSupervisor:
             gen = self.twin.generation
         if change is None:
             return
+        # acceptance stamp (ISSUE 20): the event id rides the journal
+        # record and, once gen is published, the control-block payload —
+        # the anchor of the stitched fleet trace and the t=0 of every
+        # simon_fleet_freshness_seconds stage
+        eid, ts = new_event_id(), time.time()
+        FRESHNESS.event_accepted(eid, gen, ts)
         if self.journal is not None:
             # ACCEPTED events only (rv-monotonic no-ops never reach here):
             # an O(1) bounded-queue enqueue, never I/O — the journal's
             # writer thread drains it off this path, so dispatch hold
             # times stay tsan-clean
-            self.journal.record_event(field, ev_type, obj, gen)
+            self.journal.record_event(field, ev_type, obj, gen, eid=eid, ts=ts)
         if self.capacity is not None:
             try:
                 self.capacity.on_twin_change(field, ev_type, obj, change, gen)
@@ -1402,4 +1409,7 @@ class WatchSupervisor:
                 *hdr("simon_twin_generation"),
                 f"simon_twin_generation {self.twin.generation}",
             ]
+        # owner-side freshness stages (journaled/published); worker-side
+        # processes render the same family from their own tracker
+        lines += FRESHNESS.metrics_lines()
         return lines

@@ -244,6 +244,14 @@ _OBSERVABILITY = [
     Knob("OPENSIM_CAPACITY_TIMELINE_N", "int", "512", "Capacity timeline ring capacity (generation-keyed samples).", _int(lo=1), section="observability"),
     Knob("OPENSIM_HEADROOM_PROFILES", "spec", "small=500m:1Gi,large=4:8Gi", "Registered headroom probe profiles: `name=cpu:mem[:max_replicas],...` (validated loudly).", None, on_error="raise", section="observability"),
     Knob("OPENSIM_MEM_TICKER_S", "float", "10", "Low-rate memory watermark sampling cadence in seconds (0 disables the ticker).", _float(lo=0.0), section="observability"),
+    # time-series ring + SLO engine (obs/timeseries.py, obs/slo.py,
+    # docs/observability.md "Watching the fleet")
+    Knob("OPENSIM_TS_INTERVAL_S", "float", "5", "Time-series ring sampling cadence: every registered metric family is sampled into the on-disk ring at this interval.", _float(lo=0.0, exclusive=True), on_error="raise", section="observability"),
+    Knob("OPENSIM_TS_WINDOWS", "int", "48", "Time-series ring bound: sealed delta-encoded windows kept on disk (oldest evicted first).", _int(lo=2), on_error="raise", section="observability"),
+    Knob("OPENSIM_TS_WINDOW_SAMPLES", "int", "60", "Samples per time-series window before it seals to disk (windows × window_samples × interval = retention).", _int(lo=2), on_error="raise", section="observability"),
+    Knob("OPENSIM_TS_DIR", "path", "", "Time-series ring directory (persists across restarts and is re-adopted on boot). Default: a private tempdir removed on shutdown.", None, section="observability"),
+    Knob("OPENSIM_SLO", "spec", "availability:99.9,latency_p99:99:2.5,freshness:99:30", "Declarative SLOs: `name:target_pct[:threshold_s],...` with kinds availability/latency_p99/freshness (validated loudly).", None, on_error="raise", section="observability"),
+    Knob("OPENSIM_SLO_WINDOWS", "spec", "5m,1h", "SLO burn-rate evaluation windows: `<number><s|m|h|d>,...` (multi-window burn-rate alerting).", None, on_error="raise", section="observability"),
 ]
 
 _PLANNER = [

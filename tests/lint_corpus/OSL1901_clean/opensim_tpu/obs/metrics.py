@@ -1,0 +1,6 @@
+"""Fixture: registry and doc table name exactly the same families."""
+
+FAMILIES = {
+    "simon_requests_total": ("Requests served by endpoint", "counter"),
+    "simon_request_seconds": ("Whole-request latency", "histogram"),
+}

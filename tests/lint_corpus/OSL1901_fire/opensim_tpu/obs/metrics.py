@@ -1,0 +1,8 @@
+"""Fixture: FAMILIES and the doc table drifted BOTH directions —
+`simon_registered_only_total` has no doc row, and the doc documents
+`simon_doc_only_total` which is not registered."""
+
+FAMILIES = {
+    "simon_requests_total": ("Requests served by endpoint", "counter"),
+    "simon_registered_only_total": ("Registered but undocumented", "counter"),
+}

@@ -20,7 +20,8 @@ def test_osl16xx_registered():
     by_code = {r.code for r in RULES.values()}
     assert {"OSL1601", "OSL1602", "OSL1603", "OSL1604"} <= by_code
     assert {"OSL1801", "OSL1802", "OSL1803", "OSL1804"} <= by_code
-    assert len(RULES) == 27
+    assert "OSL1901" in by_code
+    assert len(RULES) == 28
 
 
 # ---------------------------------------------------------------------------

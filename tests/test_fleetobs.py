@@ -1,0 +1,625 @@
+"""Fleet-wide observability (ISSUE 20, docs/observability.md "Watching
+the fleet"): cross-process trace stitching, the event-to-servable
+freshness pipeline, the bounded on-disk time-series ring, and the SLO
+burn-rate engine.
+
+The load-bearing gates:
+
+- reader edge cases: merged histogram quantiles survive an empty worker,
+  a +Inf-only tail, and a counter reset (the PromQL ``rate()`` rules);
+- stitching: an owner-stamped watch event id rides the journal record
+  and the shm publication, and lands on a worker request trace plus the
+  grafted ``fleet.publication`` subtree — across a REAL publisher/client
+  pair with two attached workers;
+- freshness: every pipeline stage histogram moves under a twin storm;
+- ring: the on-disk footprint stays bounded by construction and the
+  delta encoding round-trips EXACTLY (equality, not tolerance);
+- SLO: burn rates match hand-computed windows, short windows without
+  data say ``no_data`` instead of lying with 0.0;
+- takeover: the marker is visible in ``simon dash`` rows both from
+  crafted samples (unit) and through a real owner SIGKILL (e2e, riding
+  the HA harness from test_ha.py).
+"""
+
+import json
+import math
+import os
+import time
+
+import pytest
+
+from opensim_tpu.engine import prepcache
+from opensim_tpu.engine.simulator import prepare
+from opensim_tpu.models import ResourceTypes, fixtures as fx
+from opensim_tpu.obs.fleetobs import (
+    FRESHNESS,
+    PUB_EVENTS_MAX,
+    new_event_id,
+    publication_tree,
+)
+from opensim_tpu.obs.metrics import (
+    RECORDER,
+    bucket_deltas,
+    counter_delta,
+    histogram_quantile,
+    parse_metrics,
+)
+from opensim_tpu.obs.recorder import FLIGHT_RECORDER
+from opensim_tpu.obs.slo import Objective, SLOEngine, parse_objectives, parse_windows
+from opensim_tpu.obs.timeseries import (
+    TimeSeriesRing,
+    parse_duration_s,
+    render_series_key,
+)
+from opensim_tpu.server.fleet import FleetTwinClient, TwinPublisher
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    RECORDER.reset()
+    FRESHNESS.reset()
+    FLIGHT_RECORDER.clear()
+    yield
+    RECORDER.reset()
+    FRESHNESS.reset()
+    FLIGHT_RECORDER.clear()
+
+
+def _cluster(n_nodes=6):
+    rt = ResourceTypes()
+    for i in range(n_nodes):
+        rt.nodes.append(
+            fx.make_fake_node(
+                f"n{i:03d}", "16", "64Gi", "110",
+                fx.with_labels({"topology.kubernetes.io/zone": f"z{i % 3}"}),
+            )
+        )
+    rt.pods.append(fx.make_fake_pod("pinned", "100m", "128Mi", fx.with_node_name("n000")))
+    return rt
+
+
+def _publication_parts(cluster):
+    base = prepcache.CacheEntry("t|base", prepare(cluster, []))
+    with base.lock:
+        base.restore()
+        return prepcache.publication_parts(base)
+
+
+# ---------------------------------------------------------------------------
+# bucket-merge edge cases (ISSUE 20 satellite: the shared reader in
+# obs/metrics.py that loadgen, dash and the SLO engine all consume)
+# ---------------------------------------------------------------------------
+
+_LADDER = """\
+simon_request_seconds_bucket{{le="0.1",worker="{w}"}} {a}
+simon_request_seconds_bucket{{le="1",worker="{w}"}} {b}
+simon_request_seconds_bucket{{le="+Inf",worker="{w}"}} {c}
+simon_request_seconds_count{{worker="{w}"}} {c}
+"""
+
+
+def test_bucket_merge_empty_worker_contributes_full_after_value():
+    """A worker that joined mid-measurement (absent from the ``before``
+    scrape) contributes its full ``after`` value — not a crash, not a
+    silent drop."""
+    before = parse_metrics(_LADDER.format(w="0", a=10, b=20, c=20))
+    after = parse_metrics(
+        _LADDER.format(w="0", a=30, b=60, c=60)
+        + _LADDER.format(w="1", a=5, b=40, c=40)
+    )
+    deltas = dict(bucket_deltas(before, after, "simon_request_seconds", {}))
+    assert deltas[0.1] == (30 - 10) + 5
+    assert deltas[1.0] == (60 - 20) + 40
+    assert deltas[math.inf] == (60 - 20) + 40
+    assert counter_delta(before, after, "simon_request_seconds_count") == 40 + 40
+
+
+def test_bucket_merge_counter_reset_uses_post_reset_value():
+    """A decreased cumulative series means the worker restarted: the
+    post-reset value IS the delta (the PromQL convention) — without it a
+    restart mid-run poisons every merged quantile with negatives."""
+    before = parse_metrics(_LADDER.format(w="0", a=100, b=200, c=200))
+    after = parse_metrics(_LADDER.format(w="0", a=3, b=7, c=7))
+    deltas = dict(bucket_deltas(before, after, "simon_request_seconds", {}))
+    assert deltas[0.1] == 3 and deltas[1.0] == 7 and deltas[math.inf] == 7
+    assert counter_delta(before, after, "simon_request_seconds_count") == 7
+    q = histogram_quantile(before, after, "simon_request_seconds", 0.5)
+    assert q is not None and 0.0 <= q <= 1.0
+
+
+def test_quantile_in_inf_tail_returns_last_finite_bound():
+    """Mass landing past the last finite bucket: the honest answer for a
+    quantile in the +Inf bucket is the last finite bound, never inf."""
+    before: dict = {}
+    after = parse_metrics(_LADDER.format(w="0", a=0, b=1, c=100))
+    assert histogram_quantile(before, after, "simon_request_seconds", 0.99) == 1.0
+
+
+def test_quantile_none_on_empty_delta_and_superset_match():
+    text = _LADDER.format(w="0", a=4, b=8, c=8)
+    scrape = parse_metrics(text)
+    # zero traffic between scrapes → None, not 0.0
+    assert histogram_quantile(scrape, scrape, "simon_request_seconds", 0.5) is None
+    # match is a label SUPERSET filter: an unmatched label selects nothing
+    assert (
+        histogram_quantile({}, scrape, "simon_request_seconds", 0.5,
+                           match={"worker": "7"})
+        is None
+    )
+    assert histogram_quantile(
+        {}, scrape, "simon_request_seconds", 0.5, match={"worker": "0"}
+    ) is not None
+
+
+def test_parse_duration_grammar():
+    assert parse_duration_s("300") == 300.0
+    assert parse_duration_s("5m") == 300.0
+    assert parse_duration_s("1h") == 3600.0
+    assert parse_duration_s("2d") == 172800.0
+    assert parse_duration_s("") is None
+    assert parse_duration_s(None) is None
+    with pytest.raises(ValueError):
+        parse_duration_s("five minutes")
+
+
+# ---------------------------------------------------------------------------
+# cross-process trace stitching (the tentpole): one stitched tree per
+# request, across a real publisher/client pair
+# ---------------------------------------------------------------------------
+
+
+def test_stitched_trace_across_two_worker_fleet():
+    """Owner accepts an event → publishes generation 1 → two workers
+    attach → a request served from the twin carries the owner's event id
+    and publication span, and the flight-recorder tree grafts the
+    owner-side ``fleet.publication`` subtree under the request."""
+    from opensim_tpu.server import rest
+
+    cluster = _cluster()
+    parts = _publication_parts(cluster)
+    eid = new_event_id()
+    FRESHNESS.event_accepted(eid, 1, time.time())
+    pub = TwinPublisher()
+    clients = []
+    server = None
+    try:
+        pub.publish(1, cluster, parts, state="live", stale=False)
+        info = FRESHNESS.pub_info(1)
+        assert info is not None and [e for e, _ in info["events"]] == [eid]
+        for _ in range(2):  # a two-worker fleet: both attach the same publication
+            c = FleetTwinClient(pub.control.name, prep_cache=prepcache.PrepareCache())
+            assert c.start(wait_s=10.0)
+            clients.append(c)
+        server = rest.SimonServer(watch=clients[0])
+        clients[0].prep_cache = server.prep_cache
+        rid = "stitch-e2e-000001"
+        code, _body = server.deploy_apps(
+            {"deployments": [fx.make_fake_deployment("web", 3, "500m", "1Gi").raw]},
+            request_id=rid,
+        )
+        assert code == 200
+        tr = FLIGHT_RECORDER.get(rid)
+        assert tr is not None
+        # worker-side stamps on the request root
+        assert tr.serving_generation == 1
+        assert tr.root.attrs["fleet_publication"] == info["span"]
+        assert eid in tr.root.attrs["fleet_events"].split(",")
+        # worker-side engine spans coexist with the fleet stamps — one tree
+        span_names = {sp.name for sp in tr.walk()}
+        assert "snapshot" in span_names
+        # the grafted owner-side subtree (what /api/debug/requests/<id>
+        # returns as the "fleet" section)
+        node = publication_tree(tr.serving_generation)
+        assert node is not None and node["name"] == "fleet.publication"
+        assert node["span"] == info["span"]
+        (ev,) = node["events"]
+        assert ev["event_id"] == eid
+        assert ev["accept_to_publish_s"] >= 0.0
+        assert ev["accept_to_attach_s"] >= ev["accept_to_publish_s"] - 1e-6
+        assert ev["accept_to_serve_s"] >= ev["accept_to_attach_s"] - 1e-6
+        assert node["first_served_unix"] >= node["published_unix"] - 1e-6
+    finally:
+        if server is not None:
+            server.close()
+        for c in clients:
+            c.stop()
+        pub.close()
+
+
+def test_freshness_histogram_moves_under_twin_storm():
+    """A publish storm (events accepted, generation published, five times
+    over) moves the owner-side ``published`` stage once per accepted
+    event; an attaching worker then moves ``attached`` and ``served`` for
+    the carried ids. FRESHNESS is per-process in a real fleet — the reset
+    between the two halves recreates that split in-process."""
+    cluster = _cluster(3)
+    pub = TwinPublisher()
+    client = None
+    try:
+        accepted = 0
+        for gen in range(1, 6):
+            for _ in range(3):
+                FRESHNESS.event_accepted(new_event_id(), gen, time.time())
+                accepted += 1
+            pub.publish(gen, cluster, None)
+        scrape = parse_metrics("\n".join(FRESHNESS.metrics_lines()))
+        assert counter_delta(
+            {}, scrape, "simon_fleet_freshness_seconds_count", {"stage": "published"}
+        ) == accepted
+
+        FRESHNESS.reset()  # now play the worker process
+        client = FleetTwinClient(pub.control.name, prep_cache=prepcache.PrepareCache())
+        assert client.start(wait_s=10.0)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            _cl, key, _stale = client.serving_snapshot()
+            if key == "fleet|5":
+                break
+            time.sleep(0.01)
+        gen, info = client.stitch_info()  # first service closes the pipeline
+        assert gen == 5 and isinstance(info, dict)
+        scrape = parse_metrics("\n".join(FRESHNESS.metrics_lines()))
+        counts = {
+            stage: counter_delta(
+                {}, scrape, "simon_fleet_freshness_seconds_count", {"stage": stage}
+            )
+            for stage in ("attached", "served")
+        }
+        # the worker attached generation 5, whose payload carries that
+        # publication's folded events (3 here, well under the cap)
+        assert 0 < counts["attached"] <= PUB_EVENTS_MAX
+        assert 0 < counts["served"] <= counts["attached"]
+    finally:
+        if client is not None:
+            client.stop()
+        pub.close()
+
+
+def test_publication_caps_carried_event_ids():
+    for i in range(PUB_EVENTS_MAX * 3):
+        FRESHNESS.event_accepted(new_event_id(), 1, time.time())
+    info = FRESHNESS.publication(1)
+    assert len(info["events"]) == PUB_EVENTS_MAX
+    scrape = parse_metrics("\n".join(FRESHNESS.metrics_lines()))
+    # every folded event was still OBSERVED, only the carried ids are capped
+    assert counter_delta(
+        {}, scrape, "simon_fleet_freshness_seconds_count", {"stage": "published"}
+    ) == PUB_EVENTS_MAX * 3
+
+
+def test_journal_record_carries_event_id_and_journaled_stage(tmp_path):
+    from opensim_tpu.server.journal import Journal
+
+    journal = Journal(str(tmp_path / "journal"), policy={"fsync": "always"})
+    try:
+        ts = time.time()
+        journal.record_event(
+            "pods", "ADDED",
+            {"metadata": {"name": "p", "namespace": "default", "resourceVersion": "2"}},
+            2, eid="abc123def456", ts=ts,
+        )
+        assert journal.flush(timeout=10.0)
+    finally:
+        journal.close()
+    raw = ""
+    for root, _dirs, files in os.walk(str(tmp_path / "journal")):
+        for name in files:
+            with open(os.path.join(root, name), errors="ignore") as f:
+                raw += f.read()
+    assert '"eid": "abc123def456"' in raw or '"eid":"abc123def456"' in raw
+    scrape = parse_metrics("\n".join(FRESHNESS.metrics_lines()))
+    assert counter_delta(
+        {}, scrape, "simon_fleet_freshness_seconds_count", {"stage": "journaled"}
+    ) == 1
+
+
+# ---------------------------------------------------------------------------
+# the time-series ring: bounded by construction, exact round-trip
+# ---------------------------------------------------------------------------
+
+
+def _sample(i: int):
+    """A scrape with delta-unfriendly floats (0.1 steps do NOT invert
+    exactly in IEEE754 — the encoder must fall back to absolutes)."""
+    return {
+        ("simon_requests_total", ()): float(i * 7),
+        ("simon_request_seconds_sum", ()): i * 0.1,
+        ("simon_request_seconds_bucket", (("le", "+Inf"),)): float(i),
+        ("simon_lane_depth", (("lane", "interactive"),)): float(i % 3),
+    }
+
+
+def test_ring_is_bounded_and_roundtrips_exactly(tmp_path):
+    d = str(tmp_path / "ring")
+    ring = TimeSeriesRing(directory=d, windows=3, window_samples=4)
+    appended = []
+    for i in range(20):  # 5 full windows through a 3-window ring
+        ts = 1000.0 + i
+        series = _sample(i)
+        ring.append(ts, series)
+        appended.append((ts, {render_series_key(k): v for k, v in series.items()}))
+    files = [n for n in os.listdir(d) if n.startswith("win-") and n.endswith(".json")]
+    assert len(files) <= 2  # windows-1 sealed files + the in-memory open window
+    st = ring.stats()
+    assert st["windows"] <= 3 and st["bytes"] > 0
+    got = ring.query()
+    # the ring kept the NEWEST samples and every surviving value is
+    # bit-for-bit equal to what was appended — equality, not tolerance
+    assert 4 <= len(got) <= 12
+    assert got == appended[-len(got):]
+    ring.close()
+    # explicit directory: close() keeps the files for post-mortems
+    assert sorted(os.listdir(d)) == sorted(files)
+
+
+def test_ring_adopts_existing_directory_and_keeps_bound(tmp_path):
+    d = str(tmp_path / "ring")
+    ring = TimeSeriesRing(directory=d, windows=3, window_samples=2)
+    for i in range(8):
+        ring.append(1000.0 + i, _sample(i))
+    ring.close()
+    reborn = TimeSeriesRing(directory=d, windows=3, window_samples=2)
+    assert reborn.stats()["windows"] == 2  # previous run's sealed files adopted
+    tail = reborn.query()[-1]
+    assert tail[0] == 1007.0
+    for i in range(8, 12):
+        reborn.append(1000.0 + i, _sample(i))
+    files = [n for n in os.listdir(d) if n.startswith("win-")]
+    assert len(files) <= 2
+    reborn.close()
+
+
+def test_ring_query_family_and_range_filters(tmp_path):
+    ring = TimeSeriesRing(directory=str(tmp_path / "r"), windows=4, window_samples=3)
+    for i in range(7):
+        ring.append(1000.0 + i * 10, _sample(i))
+    fam = ring.query(family="simon_request_seconds")
+    assert fam and all(
+        k.split("{", 1)[0] in
+        ("simon_request_seconds_sum", "simon_request_seconds_bucket")
+        for _ts, s in fam for k in s
+    )
+    recent = ring.query(range_s=25.0, now=1060.0)
+    assert [ts for ts, _ in recent] == [1040.0, 1050.0, 1060.0]
+    with pytest.raises(ValueError):
+        parse_duration_s("1w")  # the HTTP layer rejects, never silently ignores
+    ring.close()
+
+
+# ---------------------------------------------------------------------------
+# the SLO engine: burn rates vs hand-computed windows
+# ---------------------------------------------------------------------------
+
+
+def _slo_scrape(ok, err, under_100ms, fresh_under_30, fresh_total):
+    total = ok + err
+    return {
+        ("simon_request_seconds_count", (("endpoint", "deploy-apps"), ("status", "ok"))): float(ok),
+        ("simon_request_seconds_count", (("endpoint", "deploy-apps"), ("status", "error"))): float(err),
+        ("simon_request_seconds_bucket", (("endpoint", "deploy-apps"), ("le", "0.1"), ("status", "ok"))): float(under_100ms),
+        ("simon_request_seconds_bucket", (("endpoint", "deploy-apps"), ("le", "+Inf"), ("status", "ok"))): float(total),
+        ("simon_fleet_freshness_seconds_bucket", (("le", "30"), ("stage", "served"))): float(fresh_under_30),
+        ("simon_fleet_freshness_seconds_bucket", (("le", "+Inf"), ("stage", "served"))): float(fresh_total),
+        ("simon_fleet_freshness_seconds_count", (("stage", "served"),)): float(fresh_total),
+    }
+
+
+def test_slo_burn_rates_match_hand_computed_windows(tmp_path):
+    ring = TimeSeriesRing(directory=str(tmp_path / "r"), windows=4, window_samples=64)
+    # t=900: 100 requests, all good;  t=1000: +100 requests of which 10
+    # errored and 10 (of the ok ones… by bucket: 90 stayed under 100ms)
+    ring.append(900.0, _slo_scrape(ok=100, err=0, under_100ms=100,
+                                   fresh_under_30=0, fresh_total=0))
+    ring.append(1000.0, _slo_scrape(ok=190, err=10, under_100ms=190,
+                                    fresh_under_30=95, fresh_total=100))
+    objectives = [
+        Objective("availability", 99.0),
+        Objective("latency_p99", 99.0, 0.1),
+        Objective("freshness", 99.0, 30.0),
+    ]
+    engine = SLOEngine(ring, objectives=objectives,
+                       windows=[("5m", 300.0), ("30s", 30.0)])
+    payload = engine.evaluate(now=1000.0)
+    rows = {r["name"]: r for r in payload["objectives"]}
+    # availability: bad=10 of total=100 new requests; budget=1% → burn 10×
+    win = rows["availability"]["windows"]["5m"]
+    assert (win["bad"], win["total"], win["burn_rate"]) == (10.0, 100.0, 10.0)
+    # latency: 90 of 100 new under the 0.1 bound → 10 bad → burn 10×
+    win = rows["latency_p99"]["windows"]["5m"]
+    assert (win["bad"], win["total"], win["burn_rate"]) == (10.0, 100.0, 10.0)
+    assert win["bucket_bound_s"] == 0.1
+    # freshness: 95 of 100 served under 30s → 5 bad → burn 5×
+    win = rows["freshness"]["windows"]["5m"]
+    assert (win["bad"], win["total"], win["burn_rate"]) == (5.0, 100.0, 5.0)
+    assert win["bucket_bound_s"] == 30.0
+    # the 30s window holds ONE sample → no_data, burn pinned to 0.0:
+    # an SLO must say "I don't know" rather than "all is well"
+    for name in rows:
+        short = rows[name]["windows"]["30s"]
+        assert short["no_data"] is True and short["burn_rate"] == 0.0
+    lines = engine.metrics_lines(now=1000.0)
+    assert 'simon_slo_burn_rate{slo="availability",window="5m"} 10' in lines
+    assert 'simon_slo_burn_rate{slo="freshness",window="30s"} 0' in lines
+    ring.close()
+
+
+def test_slo_and_window_parsers_fail_loudly():
+    objs = parse_objectives("availability:99.9,latency_p99:99:2.5,freshness:99:30")
+    assert [(o.kind, o.target_pct, o.threshold_s) for o in objs] == [
+        ("availability", 99.9, None), ("latency_p99", 99.0, 2.5),
+        ("freshness", 99.0, 30.0),
+    ]
+    assert abs(objs[0].budget - 0.001) < 1e-12
+    with pytest.raises(ValueError):
+        parse_objectives("latency_p99:99")  # threshold required
+    with pytest.raises(ValueError):
+        parse_objectives("uptime:99")  # unknown kind
+    with pytest.raises(ValueError):
+        parse_objectives("availability:100")  # target must be in (0, 100)
+    assert parse_windows("5m,1h") == [("5m", 300.0), ("1h", 3600.0)]
+    with pytest.raises(ValueError):
+        parse_windows("5x")
+
+
+# ---------------------------------------------------------------------------
+# simon dash rows: pure, byte-stable, takeover markers visible
+# ---------------------------------------------------------------------------
+
+
+def _dash_payload():
+    def enc(series):
+        return {render_series_key(k): v for k, v in series.items()}
+
+    s0 = dict(_slo_scrape(ok=100, err=0, under_100ms=100,
+                          fresh_under_30=0, fresh_total=0))
+    s0[("simon_requests_total", ())] = 100.0
+    s0[("simon_fleet_takeovers_total", (("reason", "expired"),))] = 0.0
+    s1 = dict(_slo_scrape(ok=190, err=10, under_100ms=190,
+                          fresh_under_30=95, fresh_total=100))
+    s1[("simon_requests_total", ())] = 200.0
+    s1[("simon_fleet_takeovers_total", (("reason", "expired"),))] = 1.0
+    s1[("simon_lane_depth", (("lane", "interactive"),))] = 2.0
+    # a worker-labeled copy of the summed counter: dash must NOT double-count
+    s1[("simon_requests_total", (("worker", "0"),))] = 200.0
+    return {
+        "timeseries": {
+            "stats": {"windows": 1, "window_capacity": 4},
+            "samples": [[900.0, enc(s0)], [950.0, enc(s1)]],
+        },
+        "slo": {
+            "objectives": [{
+                "name": "availability", "target_pct": 99.0,
+                "windows": {"5m": {"burn_rate": 10.0, "no_data": False}},
+            }],
+        },
+    }
+
+
+def test_dash_rows_takeover_marker_and_single_counting():
+    from opensim_tpu.cli.dash import dash_rows, format_dash
+
+    rows = dash_rows(_dash_payload())
+    assert rows["qps"] == pytest.approx(100.0 / 50.0)  # 100 new requests / 50 s
+    assert rows["takeovers"] == [{"unix": 950.0, "reason": "expired", "count": 1.0}]
+    assert rows["lanes"] == {"interactive": 2.0}
+    assert rows["slo"][0]["windows"]["5m"]["burn_rate"] == 10.0
+    text = format_dash(_dash_payload())
+    assert "takeover  reason=expired" in text
+    assert "slo       availability" in text
+
+
+def test_dash_rows_are_byte_stable():
+    from opensim_tpu.cli.dash import dash_rows
+
+    payload = _dash_payload()
+    a = json.dumps(dash_rows(payload), sort_keys=True)
+    b = json.dumps(dash_rows(json.loads(json.dumps(payload))), sort_keys=True)
+    assert a == b
+
+
+def test_dash_degrades_per_surface():
+    from opensim_tpu.cli.dash import dash_rows, format_dash
+
+    payload = {"timeseries_error": "503: standby", "slo_error": "503: standby"}
+    rows = dash_rows(payload)
+    assert rows["samples"] == 0 and "qps" not in rows
+    assert "timeseries unavailable" in format_dash(payload)
+
+
+# ---------------------------------------------------------------------------
+# end to end: the takeover marker survives an owner SIGKILL
+# ---------------------------------------------------------------------------
+
+
+def test_takeover_marker_recorded_through_owner_sigkill(tmp_path):
+    """SIGKILL the HA owner: the promoted standby boots its OWN ring
+    (``serve_fleet`` wires ``start_timeseries`` on promotion), the ring
+    samples ``simon_fleet_takeovers_total{reason="expired"}``, and the
+    dash rows render the takeover as a timeline marker — the operator
+    sees the failover next to the latency it caused. Rides the HA
+    harness from test_ha.py (same topology, observability assertions)."""
+    import urllib.error
+
+    from opensim_tpu.cli.dash import dash_rows, fetch_dash
+    from opensim_tpu.server.stubapi import StubApiServer
+    from test_ha import (
+        _drain_kill, _ha_env, _http_json, _free_port, _owner_up,
+        _pod_dict, _seed, _spawn_owner, _spawn_standby, _wait,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    stub = StubApiServer(bookmark_interval_s=0.1).start()
+    _seed(stub)
+    kc = stub.kubeconfig(tmp_path)
+    jd = str(tmp_path / "journal")
+    port = _free_port()
+    env = dict(
+        _ha_env(repo),
+        OPENSIM_TS_INTERVAL_S="0.2",  # sample fast so markers appear in seconds
+        OPENSIM_TS_WINDOWS="4", OPENSIM_TS_WINDOW_SAMPLES="16",
+    )
+    owner_log = str(tmp_path / "owner.log")
+    sb_log = str(tmp_path / "standby.log")
+    owner = standby = None
+    sb_admin = port + 16
+    try:
+        owner = _spawn_owner(repo, kc, jd, port, env, owner_log)
+        _wait(
+            _owner_up(port + 1, owner, owner_log),
+            timeout=120.0, msg="HA owner fleet up",
+        )
+
+        def owner_ring_sampling():
+            try:
+                doc = _http_json(f"http://127.0.0.1:{port + 1}/api/debug/timeseries")
+                return len(doc.get("samples") or []) >= 2
+            except (OSError, urllib.error.HTTPError):
+                return False
+
+        _wait(owner_ring_sampling, timeout=30.0, msg="owner ring to sample")
+
+        standby = _spawn_standby(repo, kc, jd, port, env, sb_log)
+
+        def standby_tailing():
+            if standby.poll() is not None:
+                raise AssertionError("standby died early")
+            try:
+                body = _http_json(f"http://127.0.0.1:{sb_admin}/api/fleet/status")
+                return body["role"] == "standby" and body["at_parity"]
+            except OSError:
+                return False
+
+        _wait(standby_tailing, timeout=60.0, msg="standby to tail to parity")
+        # a standby has no ring: the endpoint says 503, and dash degrades
+        # to an error field instead of dying
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _http_json(f"http://127.0.0.1:{sb_admin}/api/debug/timeseries")
+        assert err.value.code == 503
+        payload = fetch_dash(f"http://127.0.0.1:{sb_admin}", timeout_s=3.0)
+        assert "timeseries_error" in payload
+
+        for i in range(10):
+            stub.upsert("/api/v1/pods", _pod_dict(f"storm-{i}", rv=1000 + i))
+        owner.kill()  # SIGKILL: no flush, no release, no goodbye
+        owner.wait(timeout=10)
+
+        def marker_visible():
+            try:
+                rows = dash_rows(
+                    fetch_dash(f"http://127.0.0.1:{sb_admin}", timeout_s=3.0)
+                )
+            except (OSError, ValueError):
+                return False
+            return any(
+                m["reason"] == "expired" for m in rows.get("takeovers") or []
+            )
+
+        _wait(marker_visible, timeout=90.0, msg="takeover marker in dash rows")
+        # the promoted owner's SLO engine answers over the same ring
+        slo = _http_json(f"http://127.0.0.1:{sb_admin}/api/fleet/slo")
+        assert {row["name"] for row in slo["objectives"]} == {
+            "availability", "latency_p99", "freshness",
+        }
+    finally:
+        _drain_kill(owner, standby)
+        stub.stop()

@@ -18,7 +18,7 @@ import pytest
 from opensim_tpu.engine.simulator import AppResource, simulate
 from opensim_tpu.models import ResourceTypes, fixtures as fx
 from opensim_tpu.obs import trace as tracing
-from opensim_tpu.obs.metrics import RECORDER, escape_label_value
+from opensim_tpu.obs.metrics import RECORDER, escape_label_value, parse_metrics
 from opensim_tpu.obs.recorder import FLIGHT_RECORDER, FlightRecorder
 from opensim_tpu.resilience import breaker as breaker_mod
 from opensim_tpu.resilience import faults
@@ -625,6 +625,47 @@ def _split_labels(body: str):
     return out
 
 
+def _assert_exposition_conformant(text):
+    """The exposition contract every scrape surface must meet: one
+    # HELP/# TYPE per family, every sample matches the Prometheus
+    grammar, no series emitted twice. Returns the families that rendered
+    at least one sample."""
+    helped, typed, seen_series = set(), {}, set()
+    families_with_samples = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert name not in helped, f"duplicate HELP for {name}"
+            helped.add(name)
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            assert name not in typed, f"duplicate TYPE for {name}"
+            assert kind in ("counter", "gauge", "histogram", "summary"), line
+            typed[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"sample line fails the exposition grammar: {line!r}"
+        name, _, labels_body, _value = m.groups()
+        series_key = (name, labels_body or "")
+        assert series_key not in seen_series, f"duplicate series: {line!r}"
+        seen_series.add(series_key)
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = name[: -len(suffix)] if name.endswith(suffix) else None
+            if base and typed.get(base) == "histogram":
+                family = base
+        families_with_samples.add(family)
+        assert family in typed, f"sample {name!r} has no # TYPE header"
+        assert family in helped, f"sample {name!r} has no # HELP header"
+        for part in _split_labels(labels_body or ""):
+            assert _LABEL_RE.match(part), f"bad label in {line!r}: {part!r}"
+    return families_with_samples
+
+
 def test_metrics_exposition_conformance(tmp_path):
     """Every series in /metrics has # HELP/# TYPE, names and labels match
     the Prometheus grammar, and no series is emitted twice — regression-
@@ -663,39 +704,7 @@ def test_metrics_exposition_conformance(tmp_path):
         prep_cache=server.prep_cache, admission=server.admission,
         capacity=server.capacity, journal=journal, memory=server.memory,
     )
-    helped, typed, seen_series = set(), {}, set()
-    families_with_samples = set()
-    for line in text.splitlines():
-        if not line:
-            continue
-        if line.startswith("# HELP "):
-            name = line.split()[2]
-            assert name not in helped, f"duplicate HELP for {name}"
-            helped.add(name)
-            continue
-        if line.startswith("# TYPE "):
-            _, _, name, kind = line.split(None, 3)
-            assert name not in typed, f"duplicate TYPE for {name}"
-            assert kind in ("counter", "gauge", "histogram", "summary"), line
-            typed[name] = kind
-            continue
-        assert not line.startswith("#"), f"unknown comment line: {line!r}"
-        m = _SAMPLE_RE.match(line)
-        assert m, f"sample line fails the exposition grammar: {line!r}"
-        name, _, labels_body, _value = m.groups()
-        series_key = (name, labels_body or "")
-        assert series_key not in seen_series, f"duplicate series: {line!r}"
-        seen_series.add(series_key)
-        family = name
-        for suffix in ("_bucket", "_sum", "_count"):
-            base = name[: -len(suffix)] if name.endswith(suffix) else None
-            if base and typed.get(base) == "histogram":
-                family = base
-        families_with_samples.add(family)
-        assert family in typed, f"sample {name!r} has no # TYPE header"
-        assert family in helped, f"sample {name!r} has no # HELP header"
-        for part in _split_labels(labels_body or ""):
-            assert _LABEL_RE.match(part), f"bad label in {line!r}: {part!r}"
+    families_with_samples = _assert_exposition_conformant(text)
     # the families this PR added are present and populated
     for required in (
         "simon_filter_reject_total",
@@ -740,6 +749,56 @@ def test_metrics_exposition_conformance(tmp_path):
         "simon_phase_profile_exclusive_seconds_total",
     ):
         assert required in families_with_samples, f"{required} missing from /metrics"
+
+
+def test_aggregated_metrics_exposition_conformance(tmp_path):
+    """The fleet admin's aggregated /metrics (ISSUE 20 satellite) meets
+    the SAME exposition contract as a single process: one header per
+    family even when every worker ships it, summed series next to
+    ``{worker="i"}``-labeled breakdowns with zero duplicates, and
+    max-not-sum for the generation gauge."""
+    from opensim_tpu.server import rest
+    from opensim_tpu.server.fleet import render_aggregated
+
+    server = rest.SimonServer(base_cluster=_cluster())
+    code, _ = server.deploy_apps(_payload())
+    assert code == 200
+    server.cluster_report()
+    worker_text = server.metrics_text()
+    # two workers with identical traffic plus the owner's own exposition
+    # (the owner ships watch/journal families, not request histograms)
+    agg = render_aggregated([worker_text, worker_text], owner_text="")
+    _assert_exposition_conformant(agg)
+    single = parse_metrics(worker_text)
+    merged = parse_metrics(agg)
+    key = ("simon_request_seconds_count",
+           (("endpoint", "deploy-apps"), ("status", "ok")))
+    # backward compat: the summed family keeps its unlabeled shape...
+    assert merged[key] == 2 * single[key]
+    # ...and the per-worker breakdown rides next to it, same family
+    for worker in ("0", "1"):
+        labeled = (key[0], key[1] + (("worker", worker),))
+        assert merged[labeled] == single[key]
+    # the per-worker allowlist is a fence: unlisted families never grow
+    # worker-labeled copies (cardinality × fleet size otherwise)
+    assert not any(
+        "worker" in dict(labels) and not name.startswith((
+            "simon_request_seconds", "simon_requests_total", "simon_lane_depth",
+            "simon_fleet_",
+        ))
+        for name, labels in merged
+    )
+    # a dead worker (failed scrape) degrades to the survivors' sum
+    one = parse_metrics(render_aggregated([worker_text, None]))
+    assert one[key] == single[key]
+    # gauges in the max-set aggregate as max, not a meaningless sum
+    gen_text = (
+        "# TYPE simon_fleet_attach_generation gauge\n"
+        "simon_fleet_attach_generation 7\n"
+    )
+    gen_text2 = gen_text.replace("7", "9")
+    merged_gen = parse_metrics(render_aggregated([gen_text, gen_text2]))
+    assert merged_gen[("simon_fleet_attach_generation", ())] == 9.0
 
 
 def test_capacity_node_series_capped_under_1k_node_twin():

@@ -1,0 +1,424 @@
+#!/usr/bin/env python
+"""Fleet-observability smoke gate (``make dash-smoke``, part of
+``make verify``).
+
+The ISSUE 20 acceptance run, end to end over a real 2-worker fleet:
+
+1. start the stub apiserver, boot ``simon server --workers 2`` against it
+   with a fast time-series cadence (``OPENSIM_TS_INTERVAL_S=0.2``), and
+   feed watch events so publications carry stamped event ids;
+2. drive a closed-loop load burst, then assert the ring answered:
+   ``GET /api/debug/timeseries`` non-empty, family + range filters
+   honored, ``GET /api/fleet/slo`` shape-conformant with burn rates for
+   every default objective and window;
+3. ``simon dash``: rendering one fetched payload twice is byte-stable
+   (the contract behind ``--once --json``), and the CLI subprocess
+   prints valid JSON and exits 0;
+4. the aggregated admin ``/metrics`` has zero duplicate series, one
+   header per family, and the per-worker ``{worker="i"}`` breakdowns
+   riding next to the summed families;
+5. cross-process stitching: a request traced on a worker carries the
+   owner's publication span + event ids, and ``/api/debug/requests/<id>``
+   grafts the ``fleet.publication`` subtree under the worker's own
+   admission/engine spans;
+6. reboot the fleet with ``OPENSIM_TRACE=0``: no traces are recorded and
+   the sustained QPS keeps a generous floor of the traced run's — the
+   dormant observability path must cost nothing measurable.
+
+Exit 0 on success; 1 with a one-line reason per failed check.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def fail(msg: str) -> int:
+    print(f"dash-smoke: FAIL: {msg}")
+    return 1
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _http_json(url: str, timeout: float = 5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _http_text(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def _log_tail(path: str, n: int = 3000) -> str:
+    try:
+        with open(path) as f:
+            return f.read()[-n:]
+    except OSError:
+        return "<no log>"
+
+
+def _wait(pred, timeout: float, msg: str) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+def _spawn(argv, env, logfile):
+    return subprocess.Popen(
+        argv, stdout=open(logfile, "w"), stderr=subprocess.STDOUT,
+        env=env, cwd=REPO, text=True,
+    )
+
+
+def _pod(name, rv):
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": name, "namespace": "default",
+                     "resourceVersion": str(rv)},
+        "spec": {"containers": [
+            {"name": "c", "resources": {"requests": {"cpu": "50m"}}}
+        ]},
+        "status": {"phase": "Pending"},
+    }
+
+
+def _boot_fleet(stub_kc, tmp, tag, extra_env):
+    port = _free_port()
+    env = dict(
+        os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+        OPENSIM_FLEET_PUBLISH_MS="50",
+        OPENSIM_TS_INTERVAL_S="0.2",
+        OPENSIM_TS_WINDOWS="6", OPENSIM_TS_WINDOW_SAMPLES="32",
+        **extra_env,
+    )
+    logfile = os.path.join(tmp, f"owner-{tag}.log")
+    proc = _spawn(
+        [sys.executable, "-m", "opensim_tpu", "server",
+         "--kubeconfig", stub_kc, "--watch", "on",
+         "--port", str(port), "--workers", "2", "--backend", "cpu"],
+        env, logfile,
+    )
+
+    def up():
+        if proc.poll() is not None:
+            raise RuntimeError(f"fleet[{tag}] died at boot: {_log_tail(logfile)}")
+        try:
+            body = _http_json(f"http://127.0.0.1:{port + 1}/healthz", timeout=2.0)
+            if body.get("workers", 0) < 2:
+                return False
+            _http_text(f"http://127.0.0.1:{port}/healthz", timeout=2.0)
+            return True
+        except OSError:
+            return False
+
+    _wait(up, timeout=120.0, msg=f"fleet[{tag}] up")
+    return proc, port, logfile
+
+
+def _shutdown(proc):
+    if proc is not None and proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=10)
+
+
+def _check_timeseries(admin: str):
+    doc = _http_json(f"{admin}/api/debug/timeseries?range=5m")
+    samples = doc.get("samples") or []
+    if len(samples) < 2:
+        return f"ring has {len(samples)} samples after the burst (want >= 2)"
+    stats = doc.get("stats") or {}
+    if stats.get("window_capacity") != 6:
+        return f"ring stats missing/wrong capacity: {stats}"
+    fam = _http_json(
+        f"{admin}/api/debug/timeseries?family=simon_request_seconds&range=5m"
+    )
+    for _ts, series in fam.get("samples") or []:
+        for key in series:
+            name = key.split("{", 1)[0]
+            if not name.startswith("simon_request_seconds"):
+                return f"family filter leaked series {key!r}"
+    try:
+        _http_json(f"{admin}/api/debug/timeseries?range=bogus")
+        return "a garbage ?range= was accepted (want HTTP 400)"
+    except urllib.error.HTTPError as e:
+        if e.code != 400:
+            return f"garbage ?range= returned HTTP {e.code} (want 400)"
+    return None
+
+
+def _check_slo(admin: str):
+    doc = _http_json(f"{admin}/api/fleet/slo")
+    names = {row.get("name") for row in doc.get("objectives") or []}
+    if names != {"availability", "latency_p99", "freshness"}:
+        return f"SLO objectives {sorted(names)} != default trio"
+    for row in doc["objectives"]:
+        windows = row.get("windows") or {}
+        if set(windows) != {"5m", "1h"}:
+            return f"SLO windows {sorted(windows)} != default 5m/1h"
+        for label, win in windows.items():
+            if not isinstance(win.get("burn_rate"), (int, float)):
+                return f"{row['name']}/{label} has no numeric burn_rate: {win}"
+            if "no_data" not in win and win.get("samples", 99) < 2:
+                return f"{row['name']}/{label} underpopulated without no_data"
+    return None
+
+
+def _check_dash(admin: str):
+    from opensim_tpu.cli.dash import dash_rows, fetch_dash
+
+    payload = fetch_dash(admin, range_spec="5m", timeout_s=5.0)
+    if "timeseries" not in payload or "slo" not in payload:
+        return f"dash payload incomplete: {sorted(payload)}"
+    a = json.dumps(dash_rows(payload), sort_keys=True)
+    b = json.dumps(dash_rows(json.loads(json.dumps(payload))), sort_keys=True)
+    if a != b:
+        return "dash rows are not byte-stable for one payload"
+    rows = dash_rows(payload)
+    if rows.get("samples", 0) < 2 or "qps" not in rows:
+        return f"dash rows missing traffic section: {sorted(rows)}"
+    cli = subprocess.run(
+        [sys.executable, "-m", "opensim_tpu", "dash", "--url", admin,
+         "--once", "--json"],
+        capture_output=True, text=True, timeout=60,
+        env=dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu"), cwd=REPO,
+    )
+    if cli.returncode != 0:
+        return f"simon dash --once --json exited {cli.returncode}: {cli.stderr[-500:]}"
+    try:
+        cli_rows = json.loads(cli.stdout)
+    except ValueError:
+        return f"simon dash --once --json printed non-JSON: {cli.stdout[:200]!r}"
+    if "ring" not in cli_rows:
+        return f"simon dash JSON missing ring stats: {sorted(cli_rows)}"
+    return None
+
+
+def _check_aggregated_metrics(admin: str):
+    text = _http_text(f"{admin}/metrics")
+    seen, helped, typed = set(), set(), set()
+    worker_labeled = summed = False
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            if name in helped:
+                return f"duplicate HELP header for {name}"
+            helped.add(name)
+            continue
+        if line.startswith("# TYPE "):
+            name = line.split()[2]
+            if name in typed:
+                return f"duplicate TYPE header for {name}"
+            typed.add(name)
+            continue
+        key = line.rsplit(" ", 1)[0]
+        if key in seen:
+            return f"duplicate series at the aggregated endpoint: {key!r}"
+        seen.add(key)
+        if key.startswith("simon_request_seconds_count"):
+            if 'worker="' in key:
+                worker_labeled = True
+            else:
+                summed = True
+    if not (worker_labeled and summed):
+        return (
+            "aggregated endpoint missing "
+            + ("worker-labeled " if not worker_labeled else "summed ")
+            + "request series"
+        )
+    for needle in ("simon_ts_samples_total", "simon_slo_burn_rate",
+                   "simon_fleet_freshness_seconds"):
+        if needle not in text:
+            return f"{needle} missing from the aggregated endpoint"
+    return None
+
+
+def _check_stitched_trace(url: str, stub):
+    from opensim_tpu.models import fixtures as fx
+
+    payload = json.dumps(
+        {"deployments": [fx.make_fake_deployment("stitch", 3, "500m", "1Gi").raw]}
+    ).encode()
+    deadline = time.monotonic() + 60.0
+    last = "no attempt completed"
+    attempt = 0
+    while time.monotonic() < deadline:
+        attempt += 1
+        # fresh watch events, so the next publication carries stamped ids
+        stub.upsert("/api/v1/pods", _pod(f"stitch-{attempt}", rv=5000 + attempt))
+        time.sleep(0.3)
+        rid = f"stitch-{attempt:04d}"
+        req = urllib.request.Request(
+            f"{url}/api/deploy-apps", data=payload, method="POST",
+            headers={"X-Simon-Request-Id": rid},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                if resp.status != 200:
+                    last = f"deploy returned HTTP {resp.status}"
+                    continue
+                resp.read()
+        except OSError as e:
+            last = f"deploy failed: {e}"
+            continue
+        # SO_REUSEPORT: the debug read must land on the SAME worker that
+        # served the request — retry new connections until it does
+        tree = None
+        for _ in range(24):
+            try:
+                tree = _http_json(f"{url}/api/debug/requests/{rid}", timeout=5.0)
+                break
+            except urllib.error.HTTPError as e:
+                if e.code != 404:
+                    return f"debug endpoint returned HTTP {e.code}"
+                time.sleep(0.05)
+            except OSError as e:
+                last = f"debug read failed: {e}"
+                time.sleep(0.05)
+        if tree is None:
+            last = "could not reach the serving worker's flight recorder"
+            continue
+        attrs = (tree.get("spans") or {}).get("attrs") or {}
+        fleet = tree.get("fleet") or {}
+        child_names = {
+            c.get("name") for c in (tree.get("spans") or {}).get("children") or []
+        }
+        if not {"schedule", "decode"} & child_names:
+            return f"worker trace has no engine spans: {sorted(child_names)}"
+        if "serving_generation" not in attrs:
+            last = "request trace not stamped with serving_generation"
+            continue
+        if fleet.get("name") != "fleet.publication" or not fleet.get("span"):
+            last = f"no fleet.publication graft on the trace: {sorted(fleet)}"
+            continue
+        if attrs.get("fleet_publication") != fleet["span"]:
+            last = "trace and graft disagree on the publication span"
+            continue
+        carried = [e.get("event_id") for e in fleet.get("events") or []]
+        if not carried:
+            last = "publication carried no event ids (timing); retrying"
+            continue
+        stamped = set(str(attrs.get("fleet_events") or "").split(",")) - {""}
+        if stamped != set(carried):
+            return (
+                f"owner event ids {carried} != worker trace stamp "
+                f"{sorted(stamped)}"
+            )
+        print(
+            f"dash-smoke: stitched trace OK (gen {attrs['serving_generation']}, "
+            f"{len(carried)} carried event id(s), publication span {fleet['span']})"
+        )
+        return None
+    return f"stitched trace never materialized: {last}"
+
+
+def main() -> int:  # noqa: C901 - one linear scenario, early-exit checks
+    import tempfile
+
+    from opensim_tpu.server.loadgen import _seed_stub, run_loadgen
+
+    tmp = tempfile.mkdtemp(prefix="dash-smoke-")
+    stub = _seed_stub(n_nodes=8, n_pods=16)
+    kc = stub.kubeconfig(tmp)
+    owner = None
+    try:
+        owner, port, _logfile = _boot_fleet(kc, tmp, "traced", {})
+        url = f"http://127.0.0.1:{port}"
+        admin = f"http://127.0.0.1:{port + 1}"
+
+        report = run_loadgen(
+            url, mode="closed", concurrency=4, duration_s=3.0,
+            warmup_requests=2, metrics_url=admin,
+        )
+        if report.get("errors", 1) != 0:
+            return fail(f"traced burst saw errors: {report}")
+        qps_traced = report.get("qps", 0.0)
+        print(f"dash-smoke: traced burst {qps_traced} qps")
+
+        # the sampler needs a couple of ticks spanning the burst
+        def sampled():
+            try:
+                doc = _http_json(f"{admin}/api/debug/timeseries?range=5m")
+                return len(doc.get("samples") or []) >= 2
+            except OSError:
+                return False
+
+        _wait(sampled, timeout=20.0, msg="ring samples after the burst")
+
+        for check, label in (
+            (_check_timeseries, "timeseries"),
+            (_check_slo, "slo"),
+            (_check_dash, "dash"),
+            (_check_aggregated_metrics, "aggregated metrics"),
+        ):
+            err = check(admin)
+            if err:
+                return fail(f"[{label}] {err}")
+            print(f"dash-smoke: {label} OK")
+
+        err = _check_stitched_trace(url, stub)
+        if err:
+            return fail(f"[stitching] {err}")
+
+        _shutdown(owner)
+
+        # dormant mode: OPENSIM_TRACE=0 must record nothing and keep QPS
+        owner, port, _logfile = _boot_fleet(
+            kc, tmp, "untraced", {"OPENSIM_TRACE": "0"}
+        )
+        url = f"http://127.0.0.1:{port}"
+        admin = f"http://127.0.0.1:{port + 1}"
+        report = run_loadgen(
+            url, mode="closed", concurrency=4, duration_s=3.0,
+            warmup_requests=2, metrics_url=admin,
+        )
+        if report.get("errors", 1) != 0:
+            return fail(f"untraced burst saw errors: {report}")
+        qps_off = report.get("qps", 0.0)
+        print(f"dash-smoke: untraced burst {qps_off} qps")
+        recorded = _http_json(f"{url}/api/debug/requests").get("requests")
+        if recorded:
+            return fail(
+                f"OPENSIM_TRACE=0 still recorded {len(recorded)} trace(s)"
+            )
+        # generous floor: the dormant path must not collapse throughput
+        # (tight ratios flake in CI; a real regression is far below 0.5x)
+        if qps_off < 0.5 * qps_traced:
+            return fail(
+                f"untraced qps {qps_off} < 0.5x traced {qps_traced} — "
+                "the dormant tracing path is not free"
+            )
+        print("dash-smoke: PASS")
+        return 0
+    finally:
+        _shutdown(owner)
+        stub.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
